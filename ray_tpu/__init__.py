@@ -1,0 +1,49 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+A ground-up re-design of the capabilities of the reference (jcoffi/ray,
+Ray ~2.42) for TPU hardware: Ray-style tasks/actors/objects as the control
+plane, with JAX/XLA/Pallas owning the device data plane — collectives over
+ICI/DCN via `jax.lax` inside `shard_map` over device meshes rather than
+NCCL/plasma transfers (see SURVEY.md for the blueprint).
+
+Public surface (reference parity: python/ray/__init__.py):
+
+    import ray_tpu
+
+    ray_tpu.init()
+
+    @ray_tpu.remote
+    def f(x): return x * 2
+
+    ref = f.remote(21)
+    assert ray_tpu.get(ref) == 42
+"""
+
+from .api import (
+    ActorHandle,
+    ObjectRef,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    method,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from . import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorHandle", "ObjectRef", "available_resources", "cancel",
+    "cluster_resources", "exceptions", "get", "get_actor",
+    "get_runtime_context", "init", "is_initialized", "kill", "method",
+    "put", "remote", "shutdown", "wait", "__version__",
+]

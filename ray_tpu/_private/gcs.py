@@ -1,0 +1,301 @@
+"""In-process GCS-equivalent: cluster metadata, object/actor directories, KV.
+
+TPU-native collapse of the reference's GCS server (src/ray/gcs/gcs_server/:
+GcsActorManager, GcsKvManager, GcsNodeManager, object directory in
+ownership_based_object_directory.h). On a single host the service runs as
+thread-safe in-memory state inside the driver; the multi-host story (SURVEY.md
+§7 Phase 1) moves this behind the same interface over gRPC. Persistence is a
+pluggable snapshot (the reference's in_memory_store_client default).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..exceptions import ObjectLostError
+from . import protocol as P
+from .ids import ActorID, ObjectID, TaskID, WorkerID
+
+# Object lifecycle states (reference: object directory + reference_count.h)
+PENDING = "pending"
+READY = "ready"
+ERROR = "error"
+LOST = "lost"
+
+# Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState)
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+@dataclass
+class ObjectEntry:
+    state: str = PENDING
+    # location: (LOC_INLINE, bytes) | (LOC_SHM, size) | (LOC_ERROR, blob)
+    location: Optional[Tuple] = None
+    size: int = 0
+    refcount: int = 0
+    # Producing task spec retained for lineage reconstruction
+    # (reference: ReferenceCounter lineage pinning, reference_count.h:72-146).
+    lineage: Optional[P.TaskSpec] = None
+    pending_free: bool = False
+    event: threading.Event = field(default_factory=threading.Event)
+
+
+@dataclass
+class ActorEntry:
+    spec: P.ActorSpec
+    state: str = ACTOR_PENDING
+    worker_id: Optional[WorkerID] = None
+    restarts_used: int = 0
+    death_cause: Optional[str] = None
+    ready_event: threading.Event = field(default_factory=threading.Event)
+    creation_error: Optional[bytes] = None
+
+
+class ObjectDirectory:
+    """Owner-side object table: state, location, refcount, lineage."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries: Dict[ObjectID, ObjectEntry] = {}
+        self._on_ready: List[Callable[[ObjectID], None]] = []
+        self._on_free: List[Callable[[List[ObjectID]], None]] = []
+
+    def subscribe_ready(self, cb: Callable[[ObjectID], None]):
+        self._on_ready.append(cb)
+
+    def subscribe_free(self, cb: Callable[[List[ObjectID]], None]):
+        self._on_free.append(cb)
+
+    def register_pending(self, oid: ObjectID, lineage: Optional[P.TaskSpec]):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                e = ObjectEntry()
+                self._entries[oid] = e
+            e.state = PENDING
+            e.lineage = lineage
+            e.event.clear()
+
+    def register_ready(self, oid: ObjectID, location: Tuple, size: int = 0,
+                       lineage: Optional[P.TaskSpec] = None):
+        with self._lock:
+            e = self._entries.setdefault(oid, ObjectEntry())
+            e.state = ERROR if location[0] == P.LOC_ERROR else READY
+            e.location = location
+            e.size = size
+            if lineage is not None:
+                e.lineage = lineage
+            e.event.set()
+            pending_free = e.pending_free
+        for cb in self._on_ready:
+            cb(oid)
+        if pending_free:
+            self.decref(oid, 0)  # re-run free logic
+
+    def mark_lost(self, oid: ObjectID):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                e.state = LOST
+                e.location = None
+                e.event.clear()
+
+    def entry(self, oid: ObjectID) -> Optional[ObjectEntry]:
+        with self._lock:
+            return self._entries.get(oid)
+
+    def location(self, oid: ObjectID) -> Optional[Tuple]:
+        with self._lock:
+            e = self._entries.get(oid)
+            return e.location if e else None
+
+    def wait_ready(self, oid: ObjectID, timeout: Optional[float]) -> ObjectEntry:
+        e = self.entry(oid)
+        if e is None:
+            raise ObjectLostError(oid.hex(), f"Unknown object {oid.hex()}")
+        if not e.event.wait(timeout):
+            from ..exceptions import GetTimeoutError
+            raise GetTimeoutError(
+                f"Get timed out waiting for object {oid.hex()}")
+        return e
+
+    # -- reference counting (driver-side python refs) ----------------------
+    def incref(self, oid: ObjectID):
+        with self._lock:
+            e = self._entries.setdefault(oid, ObjectEntry())
+            e.refcount += 1
+
+    def decref(self, oid: ObjectID, delta: int = 1):
+        freed = None
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                return
+            e.refcount -= delta
+            if e.refcount <= 0:
+                if e.state == PENDING:
+                    # Producing task still running; free once it lands.
+                    e.pending_free = True
+                else:
+                    del self._entries[oid]
+                    freed = [oid]
+        if freed:
+            for cb in self._on_free:
+                cb(freed)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            counts: Dict[str, int] = {}
+            total = 0
+            for e in self._entries.values():
+                counts[e.state] = counts.get(e.state, 0) + 1
+                total += e.size
+            counts["bytes"] = total
+            return counts
+
+
+class ActorDirectory:
+    """Actor table + named-actor registry (reference: GcsActorManager)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._actors: Dict[ActorID, ActorEntry] = {}
+        self._named: Dict[Tuple[str, str], ActorID] = {}
+
+    def register(self, spec: P.ActorSpec) -> ActorEntry:
+        with self._lock:
+            if spec.name:
+                key = (spec.namespace, spec.name)
+                if key in self._named:
+                    existing = self._actors.get(self._named[key])
+                    if existing is not None and existing.state != ACTOR_DEAD:
+                        raise ValueError(
+                            f"Actor name '{spec.name}' already taken in "
+                            f"namespace '{spec.namespace}'")
+                self._named[key] = spec.actor_id
+            entry = ActorEntry(spec=spec)
+            self._actors[spec.actor_id] = entry
+            return entry
+
+    def get(self, actor_id: ActorID) -> Optional[ActorEntry]:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def get_by_name(self, name: str, namespace: str) -> Optional[ActorEntry]:
+        with self._lock:
+            aid = self._named.get((namespace, name))
+            return self._actors.get(aid) if aid else None
+
+    def set_alive(self, actor_id: ActorID, worker_id: WorkerID):
+        with self._lock:
+            e = self._actors[actor_id]
+            e.state = ACTOR_ALIVE
+            e.worker_id = worker_id
+            e.ready_event.set()
+
+    def set_restarting(self, actor_id: ActorID):
+        with self._lock:
+            e = self._actors[actor_id]
+            e.state = ACTOR_RESTARTING
+            e.restarts_used += 1
+            e.ready_event.clear()
+
+    def set_dead(self, actor_id: ActorID, cause: str = "",
+                 creation_error: Optional[bytes] = None):
+        with self._lock:
+            e = self._actors.get(actor_id)
+            if e is None:
+                return
+            e.state = ACTOR_DEAD
+            e.death_cause = cause
+            e.creation_error = creation_error
+            e.ready_event.set()
+            if e.spec.name:
+                self._named.pop((e.spec.namespace, e.spec.name), None)
+
+    def list(self) -> List[ActorEntry]:
+        with self._lock:
+            return list(self._actors.values())
+
+
+class KvStore:
+    """Internal KV (reference: GcsKvManager / ray internal kv)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, Dict[str, bytes]] = {}
+
+    def put(self, key: str, value: bytes, namespace: str = "default",
+            overwrite: bool = True) -> bool:
+        with self._lock:
+            ns = self._data.setdefault(namespace, {})
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def get(self, key: str, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(namespace, {}).get(key)
+
+    def delete(self, key: str, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._data.get(namespace, {}).pop(key, None) is not None
+
+    def keys(self, prefix: str = "", namespace: str = "default") -> List[str]:
+        with self._lock:
+            return [k for k in self._data.get(namespace, {}) if
+                    k.startswith(prefix)]
+
+
+class Pubsub:
+    """Minimal pubsub for cluster events (reference: src/ray/pubsub/)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: Dict[str, List[Callable[[Any], None]]] = {}
+
+    def subscribe(self, channel: str, cb: Callable[[Any], None]):
+        with self._lock:
+            self._subs.setdefault(channel, []).append(cb)
+
+    def publish(self, channel: str, message: Any):
+        with self._lock:
+            cbs = list(self._subs.get(channel, []))
+        for cb in cbs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+class Gcs:
+    """The aggregate metadata service handle."""
+
+    def __init__(self):
+        self.objects = ObjectDirectory()
+        self.actors = ActorDirectory()
+        self.kv = KvStore()
+        self.pubsub = Pubsub()
+        self.start_time = time.time()
+        self.node_id_hex = None  # filled by Node
+        # Task event log for state API / timeline (reference: GcsTaskManager)
+        self._task_events: List[dict] = []
+        self._task_events_lock = threading.Lock()
+        self.max_task_events = 10000
+
+    def record_task_event(self, event: dict):
+        with self._task_events_lock:
+            self._task_events.append(event)
+            if len(self._task_events) > self.max_task_events:
+                del self._task_events[: len(self._task_events) // 2]
+
+    def task_events(self) -> List[dict]:
+        with self._task_events_lock:
+            return list(self._task_events)

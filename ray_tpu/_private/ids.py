@@ -1,0 +1,119 @@
+"""Unique identifiers for objects, tasks, actors, nodes, and placement groups.
+
+TPU-native re-design of the reference's id scheme (reference:
+src/ray/common/id.h and python/ray/includes/unique_ids.pxi). We keep the same
+conceptual id families but use a flat 16-byte random payload — the reference's
+embedded job/actor indices exist to support cross-language workers and
+multi-job GCS sharing, which this framework does not need.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ID_SIZE = 16
+
+
+class BaseID:
+    """A fixed-size binary id with hex repr and fast hashing."""
+
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != _ID_SIZE:
+            raise ValueError(
+                f"{type(self).__name__} must be {_ID_SIZE} bytes, got {len(id_bytes)}"
+            )
+        self._bytes = id_bytes
+        self._hash = hash(id_bytes)
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(_ID_SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * _ID_SIZE
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * _ID_SIZE)
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class ObjectID(BaseID):
+    """Identifies one immutable object in the object store."""
+
+
+class TaskID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class JobID(BaseID):
+    pass
+
+
+class _Counter:
+    """Thread-safe monotonically increasing counter (for return-index ids)."""
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value
+
+
+def object_id_for_return(task_id: TaskID, index: int) -> ObjectID:
+    """Deterministically derive the i-th return object id of a task.
+
+    Mirrors the reference's scheme where return ids are computed from the task
+    id plus a return index (src/ray/common/id.h ObjectID::FromIndex) so that
+    lineage reconstruction can re-derive them.
+    """
+    payload = bytearray(task_id.binary())
+    payload[0] ^= (index + 1) & 0xFF
+    payload[1] ^= ((index + 1) >> 8) & 0xFF
+    return ObjectID(bytes(payload))

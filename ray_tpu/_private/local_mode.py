@@ -1,0 +1,136 @@
+"""Local mode: inline, same-process execution for debugging.
+
+Reference parity: ray.init(local_mode=True) (worker.py LOCAL_MODE). Tasks run
+synchronously at submit time; objects live in a dict. Useful for debugging
+user code and for unit tests that don't exercise the distributed runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ..exceptions import TaskError
+from . import protocol as P
+from . import serialization
+from .ids import ActorID, ObjectID
+from .resources import detect_node_resources
+
+
+class LocalRuntime:
+    def __init__(self):
+        self._objects: Dict[ObjectID, Tuple[str, Any]] = {}  # ("ok"|"err", v)
+        self._actors: Dict[ActorID, Any] = {}
+        self._actor_specs: Dict[ActorID, P.ActorSpec] = {}
+        self._named: Dict[Tuple[str, str], ActorID] = {}
+        self._fns: Dict[str, Any] = {}
+        self._resources = detect_node_resources()
+        self._lock = threading.RLock()
+
+    # -- objects -----------------------------------------------------------
+    def put(self, value: Any) -> ObjectID:
+        oid = ObjectID.from_random()
+        self._objects[oid] = ("ok", value)
+        return oid
+
+    def get(self, object_ids: List[ObjectID], timeout=None) -> List[Any]:
+        out = []
+        for oid in object_ids:
+            status, value = self._objects[oid]
+            if status == "err":
+                raise value
+            out.append(value)
+        return out
+
+    def wait(self, object_ids, num_returns, timeout, fetch_local=True):
+        ready = [o for o in object_ids if o in self._objects][:num_returns]
+        rs = set(ready)
+        return ready, [o for o in object_ids if o not in rs]
+
+    def incref(self, oid):  # refcounting is moot in local mode
+        pass
+
+    def decref(self, oid):
+        pass
+
+    # -- tasks -------------------------------------------------------------
+    def _resolve(self, arg: P.Arg) -> Any:
+        if arg.kind == "value":
+            return serialization.loads(arg.data)
+        status, value = self._objects[arg.object_id]
+        if status == "err":
+            raise value
+        return value
+
+    def _run(self, fn, spec: P.TaskSpec):
+        try:
+            args = [self._resolve(a) for a in spec.args]
+            kwargs = {k: self._resolve(a) for k, a in spec.kwargs.items()}
+            result = fn(*args, **kwargs)
+            values = [result] if spec.num_returns == 1 else list(result)
+            for rid, v in zip(spec.return_ids, values):
+                self._objects[rid] = ("ok", v)
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, task_repr=spec.name)
+            for rid in spec.return_ids:
+                self._objects[rid] = ("err", err)
+
+    def submit_task(self, spec: P.TaskSpec):
+        fn = self._fns.get(spec.fn_id)
+        if fn is None:
+            fn = cloudpickle.loads(spec.fn_blob)
+            self._fns[spec.fn_id] = fn
+        self._run(fn, spec)
+
+    # -- actors ------------------------------------------------------------
+    def create_actor(self, spec: P.ActorSpec):
+        cls = cloudpickle.loads(spec.cls_blob)
+        args = [self._resolve(a) for a in spec.args]
+        kwargs = {k: self._resolve(a) for k, a in spec.kwargs.items()}
+        self._actors[spec.actor_id] = cls(*args, **kwargs)
+        self._actor_specs[spec.actor_id] = spec
+        if spec.name:
+            self._named[(spec.namespace, spec.name)] = spec.actor_id
+
+    def submit_actor_task(self, spec: P.TaskSpec):
+        inst = self._actors.get(spec.actor_id)
+        if inst is None:
+            from ..exceptions import ActorDiedError
+            err = ActorDiedError()
+            for rid in spec.return_ids:
+                self._objects[rid] = ("err", err)
+            return
+        self._run(getattr(inst, spec.method_name), spec)
+
+    def get_actor(self, name: str, namespace: Optional[str]):
+        aid = self._named.get((namespace or "default", name))
+        if aid is None:
+            raise ValueError(f"Failed to look up actor '{name}'")
+        return self._actor_specs[aid]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._actors.pop(actor_id, None)
+
+    def cancel(self, object_id, force=False, recursive=True):
+        pass  # tasks already ran inline
+
+    # -- introspection -----------------------------------------------------
+    def cluster_resources(self):
+        return dict(self._resources)
+
+    def available_resources(self):
+        return dict(self._resources)
+
+    def gcs_request(self, op: str, **kwargs):
+        if op in ("cluster_resources", "available_resources"):
+            return dict(self._resources)
+        if op == "list_actors":
+            return [{"actor_id": a.hex(), "state": "ALIVE"}
+                    for a in self._actors]
+        return None
+
+    def shutdown(self):
+        self._objects.clear()
+        self._actors.clear()

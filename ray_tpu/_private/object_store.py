@@ -1,0 +1,213 @@
+"""Shared-memory host object store (plasma equivalent).
+
+TPU-native analogue of the reference's per-node plasma store
+(src/ray/object_manager/plasma/: ObjectStore, PlasmaAllocator over mmap'd
+files). Instead of a store daemon + fd-passing protocol (plasma's fling.cc),
+every process maps objects directly from files under ``/dev/shm`` — the same
+backing plasma uses — named by object id. Creation/seal/free bookkeeping lives
+with the owner (driver) which is the single writer of the directory, so no
+cross-process allocator lock is needed.
+
+Zero-copy: readers mmap the file and deserialize with out-of-band buffers
+aliasing the mapping (serialization.py), so a numpy array "read" from the
+store shares pages with the writer. ``mmap.close()`` raises BufferError while
+aliased views are live, which we use as the pinning mechanism (plasma's
+client-side pin, object_lifecycle_manager.cc, done by the OS for free).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from ..exceptions import ObjectStoreFullError
+from . import serialization
+from .ids import ObjectID
+
+# Objects at or below this size are kept inline in the owner's memory store
+# and shipped inside control messages, like the reference's in-memory store
+# for inlined small returns (core_worker/store_provider/memory_store).
+INLINE_THRESHOLD = 100 * 1024
+
+
+def _default_capacity() -> int:
+    """Default store capacity: 30% of /dev/shm (reference defaults plasma to
+    30% of system memory, ray_config_def.h object_store_memory)."""
+    try:
+        st = os.statvfs("/dev/shm")
+        return int(st.f_bsize * st.f_bavail * 0.5)
+    except OSError:
+        return 2 << 30
+
+
+class _Segment:
+    __slots__ = ("path", "mm", "size", "file_exists")
+
+    def __init__(self, path: str, mm: mmap.mmap, size: int):
+        self.path = path
+        self.mm = mm
+        self.size = size
+        self.file_exists = True
+
+
+class ObjectStore:
+    """Maps object ids to shm segments; every process has one client instance.
+
+    The owner process (driver) additionally enforces capacity. Workers create
+    segments for task returns and the owner adopts the accounting when the
+    task reply arrives.
+    """
+
+    def __init__(self, session_dir: str, capacity: Optional[int] = None):
+        self._dir = session_dir
+        os.makedirs(session_dir, exist_ok=True)
+        self._capacity = capacity or _default_capacity()
+        self._segments: Dict[ObjectID, _Segment] = {}
+        self._used = 0
+        self._graveyard = []  # mmaps with live exported buffers
+        self._lock = threading.RLock()
+
+    # -- paths -------------------------------------------------------------
+    def _path(self, object_id: ObjectID) -> str:
+        return os.path.join(self._dir, object_id.hex())
+
+    @property
+    def used_bytes(self) -> int:
+        return self._used
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    # -- write path --------------------------------------------------------
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        """Allocate a segment and return a writable view (then `seal`)."""
+        with self._lock:
+            if self._used + size > self._capacity:
+                self._collect_graveyard()
+                if self._used + size > self._capacity:
+                    raise ObjectStoreFullError(
+                        f"Object of {size} bytes does not fit: "
+                        f"{self._used}/{self._capacity} bytes used."
+                    )
+            path = self._path(object_id)
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            self._segments[object_id] = _Segment(path, mm, size)
+            self._used += size
+            return memoryview(mm)
+
+    def put_serialized(self, object_id: ObjectID,
+                       sobj: serialization.SerializedObject) -> int:
+        size = sobj.total_size
+        view = self.create(object_id, size)
+        try:
+            sobj.write_into(view)
+        finally:
+            view.release()
+        return size
+
+    def put(self, object_id: ObjectID, value: Any) -> int:
+        return self.put_serialized(object_id, serialization.serialize(value))
+
+    # -- read path ---------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._segments or os.path.exists(self._path(object_id))
+
+    def _open(self, object_id: ObjectID) -> _Segment:
+        with self._lock:
+            seg = self._segments.get(object_id)
+            if seg is None or seg.mm is None:
+                path = self._path(object_id)
+                size = os.path.getsize(path)
+                fd = os.open(path, os.O_RDWR)
+                try:
+                    mm = mmap.mmap(fd, size)
+                finally:
+                    os.close(fd)
+                if seg is None:
+                    # Readers do not own capacity accounting; only creators do.
+                    seg = _Segment(path, mm, size)
+                    self._segments[object_id] = seg
+                else:  # adopted placeholder: attach the mapping
+                    seg.mm = mm
+            return seg
+
+    def get(self, object_id: ObjectID) -> Any:
+        """Deserialize an object, zero-copy for array buffers."""
+        seg = self._open(object_id)
+        return serialization.deserialize(memoryview(seg.mm))
+
+    def get_raw(self, object_id: ObjectID) -> memoryview:
+        return memoryview(self._open(object_id).mm)
+
+    def adopt(self, object_id: ObjectID, size: int):
+        """Owner-side accounting for a segment created by another process."""
+        with self._lock:
+            if object_id not in self._segments:
+                self._used += size
+                # Lazily opened on first get; record a placeholder w/ size.
+                path = self._path(object_id)
+                seg = _Segment(path, None, size)  # type: ignore[arg-type]
+                self._segments[object_id] = seg
+
+    # -- free path ---------------------------------------------------------
+    def free(self, object_id: ObjectID):
+        with self._lock:
+            seg = self._segments.pop(object_id, None)
+            if seg is None:
+                try:
+                    os.unlink(self._path(object_id))
+                except OSError:
+                    pass
+                return
+            if seg.file_exists:
+                try:
+                    os.unlink(seg.path)
+                except OSError:
+                    pass
+                seg.file_exists = False
+            self._used -= seg.size
+            if seg.mm is not None:
+                try:
+                    seg.mm.close()
+                except BufferError:
+                    # Live numpy views alias this mapping; the OS keeps pages
+                    # until the map closes. Retry on future allocations.
+                    self._graveyard.append(seg.mm)
+
+    def _collect_graveyard(self):
+        alive = []
+        for mm in self._graveyard:
+            try:
+                mm.close()
+            except BufferError:
+                alive.append(mm)
+        self._graveyard = alive
+
+    def release(self, object_id: ObjectID):
+        """Close a reader-side mapping without freeing the object."""
+        with self._lock:
+            seg = self._segments.pop(object_id, None)
+            if seg is not None and seg.mm is not None:
+                try:
+                    seg.mm.close()
+                except BufferError:
+                    self._graveyard.append(seg.mm)
+
+    def shutdown(self):
+        import shutil
+        with self._lock:
+            for oid in list(self._segments):
+                self.free(oid)
+            self._collect_graveyard()
+            # Files written by workers that never reported back (crashes)
+            # are not in _segments; sweep the whole session dir.
+            shutil.rmtree(self._dir, ignore_errors=True)

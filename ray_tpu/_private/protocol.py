@@ -1,0 +1,122 @@
+"""Wire protocol between the driver (owner/scheduler) and worker processes.
+
+TPU-native collapse of the reference's three-process control plane (GCS +
+raylet + core worker talking gRPC, SURVEY.md §1): on a single host the
+driver process hosts the GCS-equivalent metadata service and the
+raylet-equivalent scheduler in threads, and talks to worker processes over
+``multiprocessing`` duplex pipes. Bulk data never rides these pipes — objects
+above the inline threshold go through the shared-memory object store
+(object_store.py), mirroring the reference's grpc-for-control /
+plasma-for-data split (SURVEY.md §1 process topology).
+
+All messages are tuples ``(msg_type, payload_dict)`` serialized with
+cloudpickle (closures ride along with task specs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, ObjectID, TaskID, WorkerID
+
+# ---------------------------------------------------------------------------
+# Message types: driver -> worker
+EXEC_TASK = "exec_task"          # run a normal task or actor method
+CREATE_ACTOR = "create_actor"    # instantiate an actor on this worker
+CANCEL_TASK = "cancel"           # raise TaskCancelledError in the exec thread
+RELEASE_OBJECTS = "release"      # drop cached shm mappings
+SHUTDOWN = "shutdown"            # clean exit
+REPLY = "reply"                  # response to a worker-originated request
+
+# Message types: worker -> driver
+TASK_DONE = "task_done"
+ACTOR_READY = "actor_ready"
+OWNED_PUT = "owned_put"          # worker did put(); driver adopts ownership
+GET_LOCATIONS = "get_locations"  # blocking object-location lookup
+WAIT_OBJECTS = "wait_objects"
+SUBMIT_TASK = "submit_task"      # nested task submission from inside a task
+SUBMIT_ACTOR_TASK = "submit_actor_task"
+CREATE_ACTOR_REQ = "create_actor_req"  # nested actor creation
+GET_ACTOR = "get_actor"          # named actor lookup
+KILL_ACTOR = "kill_actor"
+GCS_REQUEST = "gcs_request"      # generic metadata op (KV, named actors, ...)
+
+# Object location kinds
+LOC_INLINE = "inline"            # bytes travel in the message
+LOC_SHM = "shm"                  # object lives in the shared-memory store
+LOC_PENDING = "pending"
+LOC_ERROR = "error"
+
+
+@dataclass
+class Arg:
+    """One task argument: either an inline serialized value or an object ref.
+
+    Mirrors the reference's TaskArg (by-value vs by-reference,
+    src/ray/common/task/task_spec.h).
+    """
+    kind: str                    # "value" | "ref"
+    data: bytes = b""            # serialized value when kind == "value"
+    object_id: Optional[ObjectID] = None
+    location: Optional[Tuple] = None  # resolved location for refs
+
+
+@dataclass
+class TaskSpec:
+    """Everything a worker needs to run one task invocation.
+
+    Reference parity: src/ray/common/task/task_spec.h TaskSpecification, less
+    cross-language fields.
+    """
+    task_id: TaskID
+    fn_id: str                       # content id of the function/actor method
+    fn_blob: Optional[bytes]         # cloudpickled fn; None if worker cached
+    args: List[Arg] = field(default_factory=list)
+    kwargs: Dict[str, Arg] = field(default_factory=dict)
+    return_ids: List[ObjectID] = field(default_factory=list)
+    num_returns: int = 1
+    name: str = ""
+    # Actor task fields
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    # Scheduling
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    placement_group_id: Optional[bytes] = None
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: Any = None
+    runtime_env: Optional[dict] = None
+
+
+@dataclass
+class ActorSpec:
+    actor_id: ActorID
+    cls_id: str
+    cls_blob: Optional[bytes]
+    args: List[Arg] = field(default_factory=list)
+    kwargs: Dict[str, Arg] = field(default_factory=dict)
+    name: Optional[str] = None
+    namespace: str = "default"
+    max_concurrency: int = 1
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    resources: Dict[str, float] = field(default_factory=dict)
+    placement_group_id: Optional[bytes] = None
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: Any = None
+    runtime_env: Optional[dict] = None
+    lifetime: Optional[str] = None   # None | "detached"
+    method_meta: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class WorkerConfig:
+    """Boot configuration for a spawned worker process."""
+    worker_id: WorkerID
+    session_dir: str
+    store_dir: str
+    resources: Dict[str, float]
+    env: Dict[str, str] = field(default_factory=dict)
+    log_dir: Optional[str] = None

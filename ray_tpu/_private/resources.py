@@ -1,0 +1,126 @@
+"""Resource detection: CPUs, memory, and TPU chips.
+
+TPU-native port of the reference's accelerator-manager protocol
+(python/ray/_private/accelerators/accelerator.py:5 AcceleratorManager,
+tpu.py:70 TPUAcceleratorManager): autodetect chips via GKE env vars or GCE
+metadata conventions, expose them as a first-class ``TPU`` resource plus an
+accelerator-type resource, and compute the pod-slice head resource name
+(``TPU-<version>-<chips>-head``) used for gang scheduling (tpu.py:330-377).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional
+
+# Valid per-host chip counts (reference: tpu.py:14 TPU_VALID_CHIP_OPTIONS).
+TPU_VALID_CHIP_OPTIONS = (1, 2, 4, 8)
+
+# GKE TPU env conventions (reference: tpu.py:16-44).
+GKE_TPU_ACCELERATOR_TYPE_ENV = "TPU_ACCELERATOR_TYPE"
+GKE_TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+GKE_TPU_NAME_ENV = "TPU_NAME"
+
+NUM_CHIPS_OVERRIDE_ENV = "RAY_TPU_NUM_CHIPS"
+ACCEL_TYPE_OVERRIDE_ENV = "RAY_TPU_ACCELERATOR_TYPE"
+
+
+class TPUAcceleratorManager:
+    """Detects local TPU chips and manages visibility isolation."""
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        override = os.environ.get(NUM_CHIPS_OVERRIDE_ENV)
+        if override is not None:
+            return int(override)
+        # GKE sets the accelerator type (e.g. "v5litepod-8").
+        accel_type = os.environ.get(GKE_TPU_ACCELERATOR_TYPE_ENV)
+        if accel_type:
+            try:
+                total = int(accel_type.rsplit("-", 1)[1])
+                return min(total, 8)
+            except (IndexError, ValueError):
+                pass
+        # TPU VMs expose chips as /dev/accel* or vfio devices.
+        for pattern in ("/dev/accel*", "/dev/vfio/[0-9]*"):
+            devices = glob.glob(pattern)
+            if devices:
+                return len(devices)
+        return 0
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        override = os.environ.get(ACCEL_TYPE_OVERRIDE_ENV)
+        if override:
+            return override
+        accel_type = os.environ.get(GKE_TPU_ACCELERATOR_TYPE_ENV)
+        if accel_type:
+            # "v5litepod-8" -> "TPU-V5LITEPOD" (reference: tpu.py version
+            # parsing + util/accelerators/accelerators.py type constants).
+            version = accel_type.split("-", 1)[0].upper()
+            return f"TPU-{version}"
+        return None
+
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float) -> tuple:
+        if quantity not in TPU_VALID_CHIP_OPTIONS:
+            return (False,
+                    f"TPU request must be one of {TPU_VALID_CHIP_OPTIONS}, "
+                    f"got {quantity} (reference: tpu.py:14)")
+        return (True, None)
+
+    @staticmethod
+    def get_visible_chips_env(chip_ids) -> Dict[str, str]:
+        """Env for a worker pinned to `chip_ids` (reference: tpu.py:170-193
+        sets TPU_VISIBLE_CHIPS / TPU_CHIPS_PER_HOST_BOUNDS)."""
+        n = len(chip_ids)
+        env = {
+            "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chip_ids),
+            "JAX_PLATFORMS": "",
+        }
+        bounds = {1: "1,1,1", 2: "1,2,1", 4: "2,2,1", 8: "2,4,1"}
+        if n in bounds:
+            env["TPU_CHIPS_PER_HOST_BOUNDS"] = bounds[n]
+            env["TPU_HOST_BOUNDS"] = "1,1,1"
+        return env
+
+    @staticmethod
+    def get_current_pod_name() -> Optional[str]:
+        return os.environ.get(GKE_TPU_NAME_ENV)
+
+    @staticmethod
+    def get_pod_head_resource(accel_type: str, total_chips: int) -> str:
+        """Slice-head resource for gang scheduling a pod slice
+        (reference: tpu.py:330-377, resource `TPU-<ver>-<chips>-head`)."""
+        return f"{accel_type}-{total_chips}-head"
+
+
+def detect_node_resources(num_cpus: Optional[int] = None,
+                          num_tpus: Optional[int] = None,
+                          resources: Optional[Dict[str, float]] = None
+                          ) -> Dict[str, float]:
+    """Build the node's static resource vector (reference: services.py
+    resource autodetection feeding the raylet's static resources)."""
+    out: Dict[str, float] = {}
+    out["CPU"] = float(num_cpus if num_cpus is not None
+                       else (os.cpu_count() or 1))
+    chips = num_tpus if num_tpus is not None else \
+        TPUAcceleratorManager.get_current_node_num_accelerators()
+    if chips:
+        out["TPU"] = float(chips)
+        accel_type = TPUAcceleratorManager.get_current_node_accelerator_type()
+        if accel_type:
+            out[accel_type] = float(chips)
+    try:
+        import psutil  # type: ignore
+        out["memory"] = float(psutil.virtual_memory().total)
+    except Exception:
+        try:
+            out["memory"] = float(
+                os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"))
+        except (ValueError, OSError):
+            pass
+    if resources:
+        out.update(resources)
+    return out

@@ -1,0 +1,761 @@
+"""Driver runtime: the Node that owns the GCS, scheduler, and object store.
+
+TPU-native collapse of the reference's head-node process set — GCS server +
+raylet + driver core worker (SURVEY.md §3.1 ray.init call stack) — into one
+process with threads. The driver is the *owner* of all objects and tasks it
+submits, holding the reference-counting and lineage state the reference keeps
+in the core worker's ReferenceCounter/TaskManager
+(src/ray/core_worker/reference_count.h:66, task_manager.cc).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+    TaskUnschedulableError,
+    WorkerCrashedError,
+)
+from . import gcs as gcs_mod
+from . import protocol as P
+from . import serialization
+from .ids import ActorID, NodeID, ObjectID, TaskID
+from .object_store import INLINE_THRESHOLD, ObjectStore
+from .resources import detect_node_resources
+from .scheduler import ResourceManager, Scheduler, WorkerHandle, WorkerPool
+
+
+def _gc_stale_sessions(max_age_s: float = 6 * 3600):
+    """Sweep shm/session dirs left by crashed runs (reference: ray's session
+    dir GC in _private/utils.py). Only removes dirs older than `max_age_s`
+    so concurrent live sessions are untouched."""
+    import glob
+    import shutil
+    now = time.time()
+    for d in glob.glob("/dev/shm/ray_tpu_session_*") + glob.glob(
+            "/tmp/ray_tpu_sessions/session_*"):
+        try:
+            if now - os.path.getmtime(d) > max_age_s:
+                shutil.rmtree(d, ignore_errors=True)
+        except OSError:
+            pass
+
+
+class _ActorState:
+    """Driver-side per-actor submit queue (reference: ActorTaskSubmitter +
+    SequentialActorSubmitQueue, transport/actor_task_submitter.cc:158)."""
+
+    __slots__ = ("spec", "worker", "ready", "dead", "queue", "lock",
+                 "in_flight")
+
+    def __init__(self, spec: P.ActorSpec):
+        self.spec = spec
+        self.worker: Optional[WorkerHandle] = None
+        self.ready = False
+        self.dead = False
+        self.lock = threading.Lock()
+        # Ordered pending (spec, unresolved_deps) items.
+        self.queue: collections.deque = collections.deque()
+        self.in_flight: Set[bytes] = set()
+
+
+class Node:
+    """The driver-side runtime (head node)."""
+
+    def __init__(self, num_cpus=None, num_tpus=None, resources=None,
+                 namespace: str = "default", session_dir: Optional[str] = None,
+                 object_store_memory: Optional[int] = None):
+        self.namespace = namespace
+        self.node_id = NodeID.from_random()
+        _gc_stale_sessions()
+        session_name = f"session_{int(time.time())}_{uuid.uuid4().hex[:8]}"
+        self.session_dir = session_dir or os.path.join(
+            "/tmp/ray_tpu_sessions", session_name)
+        self.store_dir = os.path.join("/dev/shm", f"ray_tpu_{session_name}")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.store = ObjectStore(self.store_dir,
+                                 capacity=object_store_memory)
+        self.gcs = gcs_mod.Gcs()
+        self.gcs.node_id_hex = self.node_id.hex()
+        totals = detect_node_resources(num_cpus, num_tpus, resources)
+        self.resources_mgr = ResourceManager(totals)
+        self.pool = WorkerPool(
+            self.session_dir, self.store_dir,
+            on_worker_message=self._on_worker_message,
+            on_worker_death=self._on_worker_death)
+        ncpu = int(totals.get("CPU", 4))
+        self.scheduler = Scheduler(
+            self.resources_mgr, self.pool, self._dispatch,
+            max_workers=max(ncpu, 4),
+            is_object_ready=self._is_object_ready)
+        self._handler_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="handler")
+        self._fn_registry: Dict[str, bytes] = {}
+        self._retries_used: Dict[bytes, int] = {}
+        self._cancel_requested: Set[bytes] = set()
+        self._actors: Dict[ActorID, _ActorState] = {}
+        self._actor_dep_waiters: Dict[ObjectID, List[Tuple[_ActorState, list]]] = {}
+        self._actor_dep_lock = threading.Lock()
+        self._ready_cond = threading.Condition()
+        self.gcs.objects.subscribe_ready(self._on_object_ready)
+        self.gcs.objects.subscribe_free(self._on_objects_freed)
+        self._shutdown = False
+        atexit.register(self.shutdown)
+
+    # ------------------------------------------------------------------
+    # object plane (owner side)
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectID:
+        oid = ObjectID.from_random()
+        sobj = serialization.serialize(value)
+        if sobj.total_size <= INLINE_THRESHOLD:
+            self.gcs.objects.register_ready(
+                oid, (P.LOC_INLINE, sobj.to_bytes()), sobj.total_size)
+        else:
+            size = self.store.put_serialized(oid, sobj)
+            self.gcs.objects.register_ready(oid, (P.LOC_SHM, size), size)
+        return oid
+
+    def _read_location(self, oid: ObjectID, location: Tuple) -> Any:
+        kind = location[0]
+        if kind == P.LOC_INLINE:
+            value = serialization.deserialize(location[1])
+        elif kind == P.LOC_SHM:
+            value = self.store.get(oid)
+        elif kind == P.LOC_ERROR:
+            raise serialization.deserialize(location[1])
+        else:
+            raise ObjectLostError(oid.hex())
+        if isinstance(value, TaskError):
+            raise value
+        return value
+
+    def _ensure_ready(self, oid: ObjectID,
+                      timeout: Optional[float]) -> gcs_mod.ObjectEntry:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for _attempt in range(4):
+            entry = self.gcs.objects.entry(oid)
+            if entry is None:
+                raise ObjectLostError(oid.hex())
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            if not entry.event.wait(remaining):
+                raise GetTimeoutError(
+                    f"Get timed out on object {oid.hex()}")
+            if entry.state == gcs_mod.LOST:
+                # Lineage reconstruction (reference: ObjectRecoveryManager,
+                # object_recovery_manager.h:38): resubmit the producing task.
+                if entry.lineage is None:
+                    raise ObjectLostError(oid.hex())
+                self._resubmit_for_recovery(entry.lineage)
+                continue
+            return entry
+        raise ObjectLostError(oid.hex(), "reconstruction attempts exhausted")
+
+    def _resubmit_for_recovery(self, spec: P.TaskSpec):
+        for rid in spec.return_ids:
+            self.gcs.objects.register_pending(rid, spec)
+        unresolved = self._unresolved_deps(spec)
+        self.scheduler.submit(spec, unresolved)
+
+    def get(self, object_ids: List[ObjectID],
+            timeout: Optional[float] = None) -> List[Any]:
+        # One overall deadline for the whole call, not per object.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        entries = []
+        for oid in object_ids:
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            entries.append(self._ensure_ready(oid, remaining))
+        return [self._read_location(oid, e.location)
+                for oid, e in zip(object_ids, entries)]
+
+    def get_locations(self, object_ids: List[ObjectID],
+                      timeout: Optional[float] = None) -> List[Tuple]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for oid in object_ids:
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            out.append(self._ensure_ready(oid, remaining).location)
+        return out
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int,
+             timeout: Optional[float], fetch_local: bool = True):
+        if num_returns > len(object_ids):
+            raise ValueError(
+                f"num_returns ({num_returns}) exceeds the number of "
+                f"objects waited on ({len(object_ids)})")
+        if num_returns < 1:
+            raise ValueError("num_returns must be >= 1")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._ready_cond:
+            while True:
+                ready = [oid for oid in object_ids
+                         if (e := self.gcs.objects.entry(oid)) is not None
+                         and e.event.is_set()]
+                if len(ready) >= num_returns:
+                    ready = ready[:num_returns]
+                    break
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                self._ready_cond.wait(
+                    timeout=remaining if remaining is not None else 1.0)
+        ready_set = set(ready)
+        not_ready = [oid for oid in object_ids if oid not in ready_set]
+        return ready, not_ready
+
+    def _is_object_ready(self, oid: ObjectID) -> bool:
+        e = self.gcs.objects.entry(oid)
+        return e is not None and e.event.is_set()
+
+    def incref(self, oid: ObjectID):
+        self.gcs.objects.incref(oid)
+
+    def decref(self, oid: ObjectID):
+        if not self._shutdown:
+            self.gcs.objects.decref(oid)
+
+    def _on_object_ready(self, oid: ObjectID):
+        self.scheduler.notify_object_ready(oid)
+        self._flush_actor_dep_waiters(oid)
+        with self._ready_cond:
+            self._ready_cond.notify_all()
+
+    def _on_objects_freed(self, oids: List[ObjectID]):
+        shm_oids = []
+        for oid in oids:
+            self.store.free(oid)
+            shm_oids.append(oid)
+        if shm_oids:
+            def _broadcast():
+                for h in list(self.pool.workers.values()):
+                    if h.alive:
+                        try:
+                            h.send(P.RELEASE_OBJECTS,
+                                   {"object_ids": shm_oids})
+                        except Exception:
+                            pass
+            self._handler_pool.submit(_broadcast)
+
+    # ------------------------------------------------------------------
+    # task submission (owner side)
+    # ------------------------------------------------------------------
+    def register_function(self, fn_id: str, blob: bytes):
+        self._fn_registry.setdefault(fn_id, blob)
+
+    def _pin_task_args(self, spec) -> None:
+        """Pin ref arguments for the task's lifetime so a caller dropping
+        its ObjectRef before dispatch can't free an argument out from under
+        the task (reference: ReferenceCounter submitted-task references,
+        reference_count.h:66)."""
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a.kind == "ref":
+                self.gcs.objects.incref(a.object_id)
+
+    def _unpin_task_args(self, spec) -> None:
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a.kind == "ref":
+                self.gcs.objects.decref(a.object_id)
+
+    def _unresolved_deps(self, spec: P.TaskSpec) -> Set[ObjectID]:
+        unresolved = set()
+        args = list(spec.args) + list(spec.kwargs.values())
+        for a in args:
+            if a.kind == "ref":
+                e = self.gcs.objects.entry(a.object_id)
+                if e is None or not e.event.is_set():
+                    unresolved.add(a.object_id)
+        return unresolved
+
+    def submit_task(self, spec: P.TaskSpec):
+        if spec.fn_blob is not None:
+            self.register_function(spec.fn_id, spec.fn_blob)
+        self._pin_task_args(spec)
+        for rid in spec.return_ids:
+            self.gcs.objects.register_pending(rid, spec)
+        self.gcs.record_task_event({
+            "task_id": spec.task_id.hex(), "name": spec.name,
+            "state": "PENDING", "ts": time.time()})
+        self.scheduler.submit(spec, self._unresolved_deps(spec))
+
+    def _resolve_arg_locations(self, spec) -> None:
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a.kind == "ref":
+                a.location = self.gcs.objects.location(a.object_id)
+
+    def _dispatch(self, spec, worker: Optional[WorkerHandle]):
+        """Scheduler callback: ship a ready task/actor-creation to a worker."""
+        if isinstance(spec, P.ActorSpec):
+            self._dispatch_actor_creation(spec, worker)
+            return
+        if worker is None:
+            blob = serialization.dumps(TaskUnschedulableError(
+                f"Task {spec.name} demands {spec.resources}, which exceeds "
+                f"cluster totals {self.resources_mgr.totals}"))
+            for rid in spec.return_ids:
+                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._unpin_task_args(spec)
+            return
+        self._resolve_arg_locations(spec)
+        send_spec = spec
+        if spec.fn_id in worker.fn_cache:
+            send_spec = P.TaskSpec(**{**spec.__dict__, "fn_blob": None})
+        else:
+            if spec.fn_blob is None:
+                send_spec = P.TaskSpec(
+                    **{**spec.__dict__,
+                       "fn_blob": self._fn_registry.get(spec.fn_id)})
+            worker.fn_cache.add(spec.fn_id)
+        worker.running[spec.task_id.binary()] = spec
+        try:
+            worker.send(P.EXEC_TASK, {"spec": send_spec})
+        except Exception:
+            worker.running.pop(spec.task_id.binary(), None)
+            self._handle_worker_failure_for_task(spec)
+
+    def _on_task_done(self, handle: WorkerHandle, payload: dict):
+        task_id: TaskID = payload["task_id"]
+        spec = handle.running.pop(task_id.binary(), None)
+        is_actor_task = payload.get("actor_id") is not None
+        if spec is not None and not is_actor_task:
+            self.resources_mgr.release(spec.resources)
+            self.pool.push_idle(handle)
+            self.scheduler.notify_worker_free()
+        if spec is None:
+            return
+        if is_actor_task:
+            st = self._actors.get(payload["actor_id"])
+            if st is not None:
+                st.in_flight.discard(task_id.binary())
+        error = payload.get("error")
+        if error is not None:
+            if spec.retry_exceptions and self._retry_budget(spec):
+                self._resubmit(spec)
+                return
+            self._unpin_task_args(spec)
+            for rid in spec.return_ids:
+                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, error))
+        else:
+            self._unpin_task_args(spec)
+            for rid, loc in zip(spec.return_ids, payload["results"]):
+                size = loc[1] if loc[0] == P.LOC_SHM else len(loc[1])
+                if loc[0] == P.LOC_SHM:
+                    self.store.adopt(rid, size)
+                    self.gcs.objects.register_ready(
+                        rid, (P.LOC_SHM, size), size, lineage=spec)
+                else:
+                    self.gcs.objects.register_ready(
+                        rid, loc, size, lineage=spec)
+        self.gcs.record_task_event({
+            "task_id": task_id.hex(), "name": spec.name,
+            "state": "FAILED" if error is not None else "FINISHED",
+            "ts": time.time()})
+
+    def _retry_budget(self, spec: P.TaskSpec) -> bool:
+        used = self._retries_used.get(spec.task_id.binary(), 0)
+        if used >= spec.max_retries:
+            return False
+        self._retries_used[spec.task_id.binary()] = used + 1
+        return True
+
+    def _resubmit(self, spec: P.TaskSpec):
+        for rid in spec.return_ids:
+            self.gcs.objects.register_pending(rid, spec)
+        self.scheduler.submit(spec, self._unresolved_deps(spec))
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    def create_actor(self, spec: P.ActorSpec):
+        entry = self.gcs.actors.register(spec)
+        st = _ActorState(spec)
+        self._actors[spec.actor_id] = st
+        self._pin_task_args(spec)
+        unresolved = self._unresolved_deps(spec)
+        self.scheduler.submit(spec, unresolved)
+        return entry
+
+    def _dispatch_actor_creation(self, spec: P.ActorSpec,
+                                 worker: Optional[WorkerHandle]):
+        st = self._actors[spec.actor_id]
+        if worker is None:
+            blob = serialization.dumps(TaskUnschedulableError(
+                f"Actor {spec.cls_id} demands {spec.resources}, which "
+                f"exceeds cluster totals {self.resources_mgr.totals}"))
+            self._fail_actor(st, blob, "infeasible resources")
+            self._unpin_task_args(spec)
+            return
+        worker.dedicated_actor = spec.actor_id
+        st.worker = worker
+        self._resolve_arg_locations(spec)
+        try:
+            worker.send(P.CREATE_ACTOR, {"spec": spec})
+        except Exception:
+            self._fail_actor(st, serialization.dumps(
+                ActorDiedError("actor worker died during creation")),
+                "worker send failed")
+
+    def _on_actor_ready(self, handle: WorkerHandle, payload: dict):
+        actor_id = payload["actor_id"]
+        st = self._actors.get(actor_id)
+        if st is None:
+            return
+        error = payload.get("error")
+        self._unpin_task_args(st.spec)
+        if error is not None:
+            self._fail_actor(st, error, "creation failed")
+            handle.kill()  # death callback releases resources
+            return
+        self.gcs.actors.set_alive(actor_id, handle.worker_id)
+        with st.lock:
+            st.ready = True
+        self._flush_actor_queue(st)
+
+    def _fail_actor(self, st: _ActorState, error_blob: bytes, cause: str):
+        self.gcs.actors.set_dead(st.spec.actor_id, cause,
+                                 creation_error=error_blob)
+        with st.lock:
+            st.dead = True
+            pending = list(st.queue)
+            st.queue.clear()
+        for item in pending:
+            for rid in item[0].return_ids:
+                self.gcs.objects.register_ready(
+                    rid, (P.LOC_ERROR, error_blob))
+            self._unpin_task_args(item[0])
+
+    def submit_actor_task(self, spec: P.TaskSpec):
+        st = self._actors.get(spec.actor_id)
+        entry = self.gcs.actors.get(spec.actor_id)
+        if st is None or entry is None:
+            raise ValueError(f"Unknown actor {spec.actor_id}")
+        for rid in spec.return_ids:
+            self.gcs.objects.register_pending(rid, spec)
+        if st.dead:
+            blob = entry.creation_error or serialization.dumps(
+                ActorDiedError(f"Actor {spec.actor_id.hex()} is dead "
+                               f"({entry.death_cause})"))
+            for rid in spec.return_ids:
+                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            return
+        self._pin_task_args(spec)
+        unresolved = self._unresolved_deps(spec)
+        item = [spec, unresolved]
+        with st.lock:
+            st.queue.append(item)
+        if unresolved:
+            with self._actor_dep_lock:
+                for oid in unresolved:
+                    self._actor_dep_waiters.setdefault(oid, []).append(
+                        (st, item))
+            # Close the check-then-register race (a dep may have become
+            # ready between the snapshot and waiter registration).
+            for oid in list(unresolved):
+                if self._is_object_ready(oid):
+                    with self._actor_dep_lock:
+                        item[1].discard(oid)
+        self._flush_actor_queue(st)
+
+    def _flush_actor_dep_waiters(self, oid: ObjectID):
+        with self._actor_dep_lock:
+            waiters = self._actor_dep_waiters.pop(oid, None)
+        if not waiters:
+            return
+        states = []
+        for st, item in waiters:
+            item[1].discard(oid)
+            if not item[1] and st not in states:
+                states.append(st)
+        for st in states:
+            self._flush_actor_queue(st)
+
+    def _flush_actor_queue(self, st: _ActorState):
+        """Send head-of-line tasks whose deps are resolved, preserving
+        submission order (reference: sequential_actor_submit_queue.cc)."""
+        to_send = []
+        with st.lock:
+            if not st.ready or st.dead or st.worker is None:
+                return
+            while st.queue and not st.queue[0][1]:
+                spec, _ = st.queue.popleft()
+                st.in_flight.add(spec.task_id.binary())
+                to_send.append(spec)
+            worker = st.worker
+        for spec in to_send:
+            self._resolve_arg_locations(spec)
+            worker.running[spec.task_id.binary()] = spec
+            try:
+                worker.send(P.EXEC_TASK, {"spec": spec})
+            except Exception:
+                pass  # death path handles in-flight failures
+
+    def get_actor(self, name: str, namespace: Optional[str] = None):
+        entry = self.gcs.actors.get_by_name(name,
+                                            namespace or self.namespace)
+        if entry is None or entry.state == gcs_mod.ACTOR_DEAD:
+            raise ValueError(f"Failed to look up actor '{name}'")
+        return entry.spec
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        st = self._actors.get(actor_id)
+        if st is None:
+            return
+        with st.lock:
+            st.dead = True
+            worker = st.worker
+        if no_restart:
+            st.spec.max_restarts = 0
+        blob = serialization.dumps(ActorDiedError(
+            f"Actor {actor_id.hex()} was killed via kill()"))
+        self._fail_actor(st, blob, "killed")
+        if worker is not None:
+            # Resource release and in-flight failure happen in the worker
+            # death callback, which kill() leaves armed.
+            worker.kill()
+
+    # ------------------------------------------------------------------
+    # worker failure handling
+    # ------------------------------------------------------------------
+    def _on_worker_death(self, handle: WorkerHandle):
+        self.pool.remove(handle)
+        self.scheduler.on_worker_removed(handle)
+        aid = handle.dedicated_actor
+        running = dict(handle.running)
+        handle.running.clear()
+        if aid is not None:
+            self._on_actor_worker_death(aid, running)
+            return
+        for spec in running.values():
+            self.resources_mgr.release(spec.resources)
+            self._handle_worker_failure_for_task(spec)
+        self.scheduler.notify_worker_free()
+
+    def _handle_worker_failure_for_task(self, spec: P.TaskSpec):
+        if spec.task_id.binary() in self._cancel_requested:
+            blob = serialization.dumps(
+                TaskCancelledError(spec.task_id.hex()))
+            for rid in spec.return_ids:
+                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._unpin_task_args(spec)
+            return
+        if self._retry_budget(spec):
+            self._resubmit(spec)
+        else:
+            blob = serialization.dumps(WorkerCrashedError(
+                f"The worker running task {spec.name} died "
+                f"(retries exhausted)."))
+            for rid in spec.return_ids:
+                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._unpin_task_args(spec)
+
+    def _on_actor_worker_death(self, actor_id: ActorID,
+                               running: Dict[bytes, P.TaskSpec]):
+        st = self._actors.get(actor_id)
+        entry = self.gcs.actors.get(actor_id)
+        if st is None or entry is None:
+            return
+        self.resources_mgr.release(st.spec.resources)
+        blob = serialization.dumps(ActorDiedError(
+            f"Actor {actor_id.hex()}'s worker process died."))
+        for spec in running.values():
+            for rid in spec.return_ids:
+                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._unpin_task_args(spec)
+        with st.lock:
+            already_dead = st.dead
+        if already_dead:
+            return
+        if entry.restarts_used < st.spec.max_restarts:
+            # Elastic restart: replay the creation spec on a fresh worker
+            # (reference: GcsActorManager restart path; state transitions in
+            # gcs.proto ActorTableData).
+            self.gcs.actors.set_restarting(actor_id)
+            with st.lock:
+                st.ready = False
+                st.worker = None
+            # Re-pin creation args for the replayed creation (they were
+            # unpinned when the first creation completed).
+            self._pin_task_args(st.spec)
+            self.scheduler.submit(st.spec, self._unresolved_deps(st.spec))
+        else:
+            self._fail_actor(st, blob, "worker died")
+
+    # ------------------------------------------------------------------
+    # cancellation
+    # ------------------------------------------------------------------
+    def cancel(self, object_id: ObjectID, force: bool = False,
+               recursive: bool = True):
+        entry = self.gcs.objects.entry(object_id)
+        if entry is None or entry.lineage is None:
+            return
+        spec = entry.lineage
+        task_id = spec.task_id
+        self._cancel_requested.add(task_id.binary())
+        if self.scheduler.try_cancel(task_id):
+            blob = serialization.dumps(TaskCancelledError(task_id.hex()))
+            for rid in spec.return_ids:
+                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._unpin_task_args(spec)
+            return
+        for h in list(self.pool.workers.values()):
+            if task_id.binary() in h.running:
+                if force:
+                    h.kill()
+                else:
+                    h.send(P.CANCEL_TASK, {"task_id": task_id})
+                return
+
+    # ------------------------------------------------------------------
+    # worker message routing
+    # ------------------------------------------------------------------
+    def _reply(self, handle: WorkerHandle, req_id, result=None,
+               error: Optional[BaseException] = None):
+        payload = {"req_id": req_id,
+                   "result": {"__error__": error} if error is not None
+                   else result}
+        try:
+            handle.send(P.REPLY, payload)
+        except Exception:
+            pass
+
+    def _on_worker_message(self, handle: WorkerHandle, msg_type: str,
+                           payload: dict):
+        if msg_type == P.TASK_DONE:
+            self._on_task_done(handle, payload)
+        elif msg_type == P.ACTOR_READY:
+            self._on_actor_ready(handle, payload)
+        elif msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS):
+            self._handler_pool.submit(
+                self._handle_blocking_request, handle, msg_type, payload)
+        else:
+            self._handle_quick_request(handle, msg_type, payload)
+
+    def _handle_blocking_request(self, handle: WorkerHandle, msg_type: str,
+                                 payload: dict):
+        req_id = payload["req_id"]
+        try:
+            if msg_type == P.GET_LOCATIONS:
+                locs = self.get_locations(payload["object_ids"],
+                                          payload.get("timeout"))
+                self._reply(handle, req_id, locs)
+            else:
+                ready, not_ready = self.wait(
+                    payload["object_ids"], payload["num_returns"],
+                    payload.get("timeout"))
+                self._reply(handle, req_id, (ready, not_ready))
+        except BaseException as e:  # noqa: BLE001
+            self._reply(handle, req_id, error=e)
+
+    def _handle_quick_request(self, handle: WorkerHandle, msg_type: str,
+                              payload: dict):
+        req_id = payload.get("req_id")
+        try:
+            if msg_type == P.OWNED_PUT:
+                oid = payload["object_id"]
+                if "inline" in payload:
+                    self.gcs.objects.register_ready(
+                        oid, (P.LOC_INLINE, payload["inline"]),
+                        len(payload["inline"]))
+                else:
+                    size = payload["size"]
+                    self.store.adopt(oid, size)
+                    self.gcs.objects.register_ready(
+                        oid, (P.LOC_SHM, size), size)
+                self._reply(handle, req_id, True)
+            elif msg_type == P.SUBMIT_TASK:
+                self.submit_task(payload["spec"])
+                self._reply(handle, req_id, True)
+            elif msg_type == P.SUBMIT_ACTOR_TASK:
+                self.submit_actor_task(payload["spec"])
+                self._reply(handle, req_id, True)
+            elif msg_type == P.CREATE_ACTOR_REQ:
+                self.create_actor(payload["spec"])
+                self._reply(handle, req_id, True)
+            elif msg_type == P.GET_ACTOR:
+                spec = self.get_actor(payload["name"], payload["namespace"])
+                safe = P.ActorSpec(**{**spec.__dict__, "cls_blob": None,
+                                      "args": [], "kwargs": {}})
+                self._reply(handle, req_id, safe)
+            elif msg_type == P.KILL_ACTOR:
+                self.kill_actor(payload["actor_id"], payload["no_restart"])
+                self._reply(handle, req_id, True)
+            elif msg_type == P.GCS_REQUEST:
+                result = self._gcs_op(payload["op"], payload["kwargs"])
+                self._reply(handle, req_id, result)
+            else:
+                self._reply(handle, req_id,
+                            error=ValueError(f"unknown message {msg_type}"))
+        except BaseException as e:  # noqa: BLE001
+            self._reply(handle, req_id, error=e)
+
+    def _gcs_op(self, op: str, kwargs: dict) -> Any:
+        if op == "cluster_resources":
+            return self.cluster_resources()
+        if op == "available_resources":
+            return self.available_resources()
+        if op == "kv_put":
+            return self.gcs.kv.put(**kwargs)
+        if op == "kv_get":
+            return self.gcs.kv.get(**kwargs)
+        if op == "kv_del":
+            return self.gcs.kv.delete(**kwargs)
+        if op == "kv_keys":
+            return self.gcs.kv.keys(**kwargs)
+        if op == "list_actors":
+            return [{"actor_id": e.spec.actor_id.hex(),
+                     "class_name": e.spec.cls_id.split(":")[0],
+                     "state": e.state, "name": e.spec.name}
+                    for e in self.gcs.actors.list()]
+        if op == "task_events":
+            return self.gcs.task_events()
+        if op == "object_stats":
+            return self.gcs.objects.stats()
+        raise ValueError(f"unknown gcs op {op}")
+
+    # parity with WorkerClient so library code is context-agnostic
+    def gcs_request(self, op: str, **kwargs) -> Any:
+        return self._gcs_op(op, kwargs)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def cluster_resources(self) -> Dict[str, float]:
+        totals, _ = self.resources_mgr.snapshot()
+        return totals
+
+    def available_resources(self) -> Dict[str, float]:
+        _, avail = self.resources_mgr.snapshot()
+        return avail
+
+    # ------------------------------------------------------------------
+    def prestart_workers(self, n: int):
+        self.scheduler.prestart(n)
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self.scheduler.stop()
+            self.pool.shutdown()
+            self.store.shutdown()
+        except Exception:
+            pass
+        from . import state
+        if state.get_node() is self:
+            state.set_node(None)

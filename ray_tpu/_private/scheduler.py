@@ -1,0 +1,505 @@
+"""Raylet-equivalent: worker pool, resource accounting, task dispatch.
+
+TPU-native collapse of the reference's per-node scheduling stack —
+NodeManager + LocalTaskManager + ClusterTaskManager + WorkerPool
+(src/ray/raylet/node_manager.cc, local_task_manager.cc:121,
+scheduling/cluster_task_manager.cc:44, worker_pool.cc:447,1355) — into an
+in-driver scheduler. The reference's worker *lease* protocol collapses to
+direct dispatch: the scheduler owns both the resource view and the worker
+pool, so "request lease → grant → push task" becomes "acquire resources →
+pop worker → send EXEC_TASK".
+
+Resources are float vectors like the reference's (fixed-point there,
+src/ray/common/scheduling/fixed_point.h; python floats suffice here). TPU
+chips are first-class resources; a worker scheduled onto chips gets
+``TPU_VISIBLE_CHIPS`` pinned in its environment before it can import jax,
+mirroring the reference's accelerator isolation
+(python/ray/_private/accelerators/tpu.py:170-193).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
+
+from . import protocol as P
+from .ids import ObjectID, TaskID, WorkerID
+
+
+class ResourceManager:
+    """Cluster resource bookkeeping (reference: ClusterResourceManager /
+    LocalResourceManager, src/ray/raylet/scheduling/)."""
+
+    def __init__(self, totals: Dict[str, float]):
+        self._lock = threading.Lock()
+        self.totals = dict(totals)
+        self.available = dict(totals)
+
+    def try_acquire(self, demand: Dict[str, float]) -> bool:
+        with self._lock:
+            for k, v in demand.items():
+                if v > 0 and self.available.get(k, 0.0) + 1e-9 < v:
+                    return False
+            for k, v in demand.items():
+                if v > 0:
+                    self.available[k] = self.available.get(k, 0.0) - v
+            return True
+
+    def release(self, demand: Dict[str, float]):
+        with self._lock:
+            for k, v in demand.items():
+                if v > 0:
+                    self.available[k] = min(
+                        self.available.get(k, 0.0) + v,
+                        self.totals.get(k, float("inf")))
+
+    def feasible(self, demand: Dict[str, float]) -> bool:
+        """Could this demand EVER be satisfied? (infeasible-task detection,
+        reference: cluster_task_manager.cc infeasible queue)."""
+        with self._lock:
+            return all(
+                v <= self.totals.get(k, 0.0) + 1e-9
+                for k, v in demand.items() if v > 0)
+
+    def add_total(self, resources: Dict[str, float]):
+        with self._lock:
+            for k, v in resources.items():
+                self.totals[k] = self.totals.get(k, 0.0) + v
+                self.available[k] = self.available.get(k, 0.0) + v
+
+    def snapshot(self) -> Tuple[Dict[str, float], Dict[str, float]]:
+        with self._lock:
+            return dict(self.totals), dict(self.available)
+
+
+class WorkerHandle:
+    """Driver-side handle to one worker process (reference: the raylet's
+    view of a leased worker, worker_pool.h)."""
+
+    def __init__(self, worker_id: WorkerID, proc, conn, env_key: str,
+                 env: Dict[str, str]):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.conn = conn
+        self.env_key = env_key
+        self.env = env
+        self.send_lock = threading.Lock()
+        self.recv_thread: Optional[threading.Thread] = None
+        self.dedicated_actor = None   # ActorID when pinned to an actor
+        self.running: Dict[bytes, P.TaskSpec] = {}  # in-flight tasks
+        self.fn_cache: Set[str] = set()
+        self.chip_ids: List[int] = []  # TPU chips pinned to this worker
+        self.alive = True
+        # Set once the death callback has run (or been suppressed during
+        # pool shutdown) so it fires exactly once.
+        self.death_handled = False
+
+    def send(self, msg_type: str, payload: dict):
+        import cloudpickle
+        data = cloudpickle.dumps((msg_type, payload))
+        with self.send_lock:
+            self.conn.send_bytes(data)
+
+    def kill(self):
+        """Terminate the process. The recv loop's EOF fires the death
+        callback, which fails in-flight tasks and releases resources — so
+        `alive` is cleared (no new work) but death handling still runs."""
+        self.alive = False
+        try:
+            self.proc.terminate()
+        except Exception:
+            pass
+
+
+class WorkerPool:
+    """Spawns and pools worker processes (reference: WorkerPool,
+    src/ray/raylet/worker_pool.cc:447 StartWorkerProcess / :1355 PopWorker)."""
+
+    def __init__(self, session_dir: str, store_dir: str,
+                 on_worker_message: Callable, on_worker_death: Callable,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self._session_dir = session_dir
+        self._store_dir = store_dir
+        self._on_message = on_worker_message
+        self._on_death = on_worker_death
+        self._base_env = worker_env or {}
+        self._authkey = os.urandom(16)
+        self._lock = threading.Lock()
+        self._idle: Dict[str, Deque[WorkerHandle]] = collections.defaultdict(
+            collections.deque)
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+
+    def start_worker(self, env_key: str = "",
+                     extra_env: Optional[Dict[str, str]] = None
+                     ) -> WorkerHandle:
+        """Launch `python -m ray_tpu._private.worker_proc` (reference:
+        worker_pool.cc:447 StartWorkerProcess execs default_worker.py) and
+        hand it a duplex unix-socket connection."""
+        import subprocess
+        import sys
+        from multiprocessing.connection import Listener
+
+        import cloudpickle
+
+        worker_id = WorkerID.from_random()
+        env = dict(self._base_env)
+        # Workers never implicitly grab the TPU: the chip belongs to whoever
+        # the scheduler assigned it to (accelerator isolation, tpu.py:170).
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        if extra_env:
+            env.update(extra_env)
+        address = os.path.join(self._session_dir,
+                               f"w_{worker_id.hex()[:16]}.sock")
+        listener = Listener(address, family="AF_UNIX",
+                            authkey=self._authkey)
+        proc_env = dict(os.environ)
+        proc_env.update(env)
+        proc_env["RAY_TPU_WORKER_SOCKET"] = address
+        proc_env["RAY_TPU_WORKER_AUTHKEY"] = self._authkey.hex()
+        proc_env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            + os.pathsep + proc_env.get("PYTHONPATH", ""))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+            env=proc_env, cwd=os.getcwd(),
+            start_new_session=False)
+        # accept() with a poll loop: a worker that dies on boot (bad env,
+        # OOM kill) must not hang the dispatch thread forever.
+        import socket as _socket
+        import time as _time
+        listener._listener._socket.settimeout(0.5)
+        conn = None
+        deadline = _time.monotonic() + 60.0
+        while conn is None:
+            try:
+                conn = listener.accept()
+            except _socket.timeout:
+                if proc.poll() is not None:
+                    listener.close()
+                    raise RuntimeError(
+                        f"worker process exited with code "
+                        f"{proc.returncode} before connecting")
+                if _time.monotonic() > deadline:
+                    proc.terminate()
+                    listener.close()
+                    raise RuntimeError(
+                        "worker process failed to connect within 60s")
+        listener.close()
+        try:
+            os.unlink(address)
+        except OSError:
+            pass
+        config = P.WorkerConfig(
+            worker_id=worker_id, session_dir=self._session_dir,
+            store_dir=self._store_dir, resources={}, env=env)
+        conn.send_bytes(cloudpickle.dumps(config))
+        handle = WorkerHandle(worker_id, proc, conn, env_key, env)
+        t = threading.Thread(target=self._recv_loop, args=(handle,),
+                             daemon=True, name=f"recv-{worker_id.hex()[:8]}")
+        handle.recv_thread = t
+        with self._lock:
+            self.workers[worker_id] = handle
+        t.start()
+        return handle
+
+    def _recv_loop(self, handle: WorkerHandle):
+        import cloudpickle
+        while True:
+            try:
+                data = handle.conn.recv_bytes()
+            except (EOFError, OSError):
+                break
+            msg_type, payload = cloudpickle.loads(data)
+            self._on_message(handle, msg_type, payload)
+        if not handle.death_handled:
+            handle.death_handled = True
+            handle.alive = False
+            self._on_death(handle)
+
+    def pop_idle(self, env_key: str = "") -> Optional[WorkerHandle]:
+        with self._lock:
+            dq = self._idle.get(env_key)
+            while dq:
+                h = dq.popleft()
+                if h.alive:
+                    return h
+            return None
+
+    def push_idle(self, handle: WorkerHandle):
+        if not handle.alive or handle.dedicated_actor is not None:
+            return
+        with self._lock:
+            self._idle[handle.env_key].append(handle)
+
+    def remove(self, handle: WorkerHandle):
+        with self._lock:
+            self.workers.pop(handle.worker_id, None)
+            dq = self._idle.get(handle.env_key)
+            if dq:
+                try:
+                    dq.remove(handle)
+                except ValueError:
+                    pass
+
+    def idle_count(self, env_key: str = "") -> int:
+        with self._lock:
+            return len(self._idle.get(env_key, ()))
+
+    def shutdown(self):
+        with self._lock:
+            handles = list(self.workers.values())
+        for h in handles:
+            h.death_handled = True  # suppress failure handling at shutdown
+            try:
+                h.send(P.SHUTDOWN, {})
+            except Exception:
+                pass
+        for h in handles:
+            try:
+                h.proc.wait(timeout=0.5)
+            except Exception:
+                pass
+            if h.proc.poll() is None:
+                h.kill()
+
+
+class PendingTask:
+    __slots__ = ("spec", "unresolved", "callback")
+
+    def __init__(self, spec: P.TaskSpec, unresolved: Set[ObjectID],
+                 callback=None):
+        self.spec = spec
+        self.unresolved = unresolved
+        self.callback = callback
+
+
+class Scheduler:
+    """Dependency-aware resource scheduler (reference: ClusterTaskManager
+    QueueAndScheduleTask/ScheduleAndDispatchTasks,
+    cluster_task_manager.cc:44,141 + DependencyManager,
+    raylet/dependency_manager.cc)."""
+
+    def __init__(self, resources: ResourceManager, pool: WorkerPool,
+                 dispatch_fn: Callable[[P.TaskSpec, WorkerHandle], None],
+                 max_workers: Optional[int] = None,
+                 is_object_ready: Optional[Callable[[ObjectID], bool]] = None):
+        self.resources = resources
+        self.pool = pool
+        self._dispatch_fn = dispatch_fn
+        self._is_object_ready = is_object_ready or (lambda oid: False)
+        # TPU chip allocator: specific chip ids handed to workers so two
+        # workers never share a chip (reference: tpu.py visible-chips
+        # isolation; the resource COUNT alone can't prevent collisions).
+        self._free_chips = list(range(int(resources.totals.get("TPU", 0))))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._ready: Deque[P.TaskSpec] = collections.deque()
+        self._waiting: Dict[ObjectID, List[PendingTask]] = {}
+        self._cancelled: Set[bytes] = set()
+        ncpu = os.cpu_count() or 4
+        self._max_workers = max_workers or max(ncpu, 4)
+        self._started_workers = 0
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="scheduler")
+        self._thread.start()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, spec: P.TaskSpec, unresolved: Set[ObjectID]):
+        with self._cond:
+            if unresolved:
+                pt = PendingTask(spec, set(unresolved))
+                for oid in unresolved:
+                    self._waiting.setdefault(oid, []).append(pt)
+                # Close the check-then-register race: a dep may have become
+                # ready between the caller's snapshot and this registration,
+                # in which case its notify already fired and will not recur.
+                for oid in list(pt.unresolved):
+                    if self._is_object_ready(oid):
+                        pt.unresolved.discard(oid)
+                        pts = self._waiting.get(oid)
+                        if pts is not None:
+                            try:
+                                pts.remove(pt)
+                            except ValueError:
+                                pass
+                            if not pts:
+                                del self._waiting[oid]
+                if not pt.unresolved:
+                    self._ready.append(pt.spec)
+            else:
+                self._ready.append(spec)
+            self._cond.notify()
+
+    def notify_object_ready(self, oid: ObjectID):
+        with self._cond:
+            pts = self._waiting.pop(oid, None)
+            if not pts:
+                return
+            for pt in pts:
+                pt.unresolved.discard(oid)
+                if not pt.unresolved:
+                    self._ready.append(pt.spec)
+            self._cond.notify()
+
+    def notify_worker_free(self):
+        with self._cond:
+            self._cond.notify()
+
+    def try_cancel(self, task_id: TaskID) -> bool:
+        """Remove a queued task; returns True if it had not been dispatched."""
+        with self._cond:
+            for i, spec in enumerate(self._ready):
+                if spec.task_id == task_id:
+                    del self._ready[i]
+                    return True
+            for pts in self._waiting.values():
+                for pt in list(pts):
+                    if pt.spec.task_id == task_id:
+                        pts.remove(pt)
+                        return True
+            self._cancelled.add(task_id.binary())
+            return False
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._ready) + sum(
+                len(v) for v in self._waiting.values())
+
+    # -- dispatch loop -----------------------------------------------------
+    def _env_key_for(self, spec) -> str:
+        n = int(spec.resources.get("TPU", 0))
+        return f"tpu:{n}" if n > 0 else ""
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._ready and not self._stop:
+                    self._cond.wait(timeout=1.0)
+                if self._stop:
+                    return
+                spec = self._ready.popleft()
+            tid = getattr(spec, "task_id", None)
+            if tid is not None and tid.binary() in self._cancelled:
+                self._cancelled.discard(tid.binary())
+                continue
+            if not self._try_dispatch(spec):
+                # Resources or workers unavailable: requeue at the back and
+                # block briefly to avoid a hot spin (the reference parks such
+                # tasks in the NotDispatched queue until a resource event).
+                with self._cond:
+                    self._ready.append(spec)
+                    self._cond.wait(timeout=0.05)
+
+    def _try_dispatch(self, spec) -> bool:
+        demand = spec.resources
+        is_actor_creation = isinstance(spec, P.ActorSpec)
+        if not self.resources.feasible(demand):
+            # Infeasible forever: surface as task error via dispatch_fn(None).
+            self._dispatch_fn(spec, None)
+            return True
+        if not self.resources.try_acquire(demand):
+            return False
+        env_key = self._env_key_for(spec)
+        worker = self.pool.pop_idle(env_key)
+        if worker is not None and is_actor_creation and env_key == "":
+            # An idle pooled worker becomes a dedicated actor process; it no
+            # longer counts against the task-pool cap. (TPU workers are
+            # never counted, so only the generic pool decrements.)
+            with self._lock:
+                self._started_workers -= 1
+        if worker is None:
+            try:
+                worker = self._maybe_start_worker(
+                    env_key, spec, dedicated=is_actor_creation)
+            except Exception:
+                worker = None  # boot failure: release + retry later
+        if worker is None:
+            self.resources.release(demand)
+            return False
+        self._dispatch_fn(spec, worker)
+        return True
+
+    def on_worker_removed(self, handle: WorkerHandle):
+        """A worker died; open a cap slot / return its chips."""
+        with self._lock:
+            if handle.dedicated_actor is None and handle.env_key == "":
+                self._started_workers -= 1
+            if handle.chip_ids:
+                self._free_chips.extend(handle.chip_ids)
+                handle.chip_ids = []
+        self.notify_worker_free()
+
+    def _maybe_start_worker(self, env_key: str, spec,
+                            dedicated: bool = False
+                            ) -> Optional[WorkerHandle]:
+        with self._lock:
+            # Actor workers are dedicated processes and bypass the pool cap
+            # (the reference starts a fresh worker per actor too); only
+            # generic pooled workers count against it.
+            if not dedicated and env_key == "":
+                if self._started_workers >= self._max_workers:
+                    return None
+                self._started_workers += 1
+        extra_env = {}
+        chip_ids: List[int] = []
+        if env_key.startswith("tpu:"):
+            # Pin specific chips before the worker can import jax
+            # (reference: tpu.py set_current_process_visible_accelerator_ids);
+            # specific ids (not just counts) so concurrent TPU workers never
+            # collide on a chip.
+            nchips = int(spec.resources.get("TPU", 1))
+            with self._lock:
+                if len(self._free_chips) < nchips:
+                    reclaim = True
+                else:
+                    chip_ids = [self._free_chips.pop()
+                                for _ in range(nchips)]
+                    reclaim = False
+            if reclaim:
+                # Idle TPU workers hold chips; reclaim by retiring them and
+                # retrying once their death returns the chips.
+                self._reclaim_idle_tpu_workers()
+                return None
+            extra_env = {
+                "JAX_PLATFORMS": "",
+                "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in chip_ids),
+            }
+        handle = self.pool.start_worker(env_key, extra_env)
+        handle.chip_ids = chip_ids
+        return handle
+
+    def _reclaim_idle_tpu_workers(self):
+        for key in list(self.pool._idle.keys()):
+            if not key.startswith("tpu:"):
+                continue
+            while True:
+                h = self.pool.pop_idle(key)
+                if h is None:
+                    break
+                try:
+                    h.send(P.SHUTDOWN, {})
+                except Exception:
+                    h.kill()
+
+    def prestart(self, n: int):
+        """Warm the pool (reference: worker_pool.cc prestart)."""
+        def _start():
+            h = self.pool.start_worker("")
+            self.pool.push_idle(h)
+            self.notify_worker_free()
+        with self._lock:
+            n = min(n, self._max_workers - self._started_workers)
+            self._started_workers += max(0, n)
+        threads = [threading.Thread(target=_start, daemon=True)
+                   for _ in range(max(0, n))]
+        for t in threads:
+            t.start()
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()

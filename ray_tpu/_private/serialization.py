@@ -1,0 +1,134 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+TPU-native analogue of the reference's serialization stack
+(python/ray/_private/serialization.py + includes/serialization.pxi): arbitrary
+Python objects go through cloudpickle; numpy (and host-side jax) arrays are
+split out as out-of-band PickleBuffers so they can be written to — and later
+mapped zero-copy out of — the shared-memory object store.
+
+Wire format of a serialized object:
+
+    [8B u64: meta length][meta: cloudpickle bytes]
+    [8B u64: num buffers][per buffer: 8B u64 offset, 8B u64 length]
+    [64-byte-aligned buffer payloads...]
+
+Deserialization passes memoryview slices of the source buffer straight into
+``pickle.loads(buffers=...)``, so a numpy array read from shared memory aliases
+the shm pages (zero copy), like plasma clients mapping objects in the
+reference (src/ray/object_manager/plasma/).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+_ALIGN = 64
+_U64 = struct.Struct("<Q")
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _to_host(obj: Any) -> Any:
+    """Convert device jax arrays to host numpy before pickling.
+
+    The host object store holds CPU bytes; device tensors move over ICI/DCN via
+    XLA collectives, not through this store (SURVEY.md §2.1 translation note).
+    """
+    try:
+        import jax
+        import numpy as np
+
+        if isinstance(obj, jax.Array):
+            return np.asarray(obj)
+    except Exception:
+        pass
+    return obj
+
+
+class SerializedObject:
+    """A serialized object: metadata bytes + raw out-of-band buffers."""
+
+    __slots__ = ("meta", "buffers")
+
+    def __init__(self, meta: bytes, buffers: List):
+        self.meta = meta
+        self.buffers = buffers
+
+    @property
+    def total_size(self) -> int:
+        header = 16 + 16 * len(self.buffers)
+        size = _align(len(self.meta) + header)
+        for b in self.buffers:
+            size += _align(len(b))
+        return size
+
+    def write_into(self, dst: memoryview) -> int:
+        """Write the wire format into `dst`; returns bytes written."""
+        meta = self.meta
+        nbuf = len(self.buffers)
+        header = 16 + 16 * nbuf
+        # Buffer payloads start after the aligned header+meta region.
+        offset = _align(header + len(meta))
+        offsets: List[Tuple[int, int]] = []
+        for b in self.buffers:
+            blen = len(b)
+            offsets.append((offset, blen))
+            offset += _align(blen)
+        pos = 0
+        dst[pos:pos + 8] = _U64.pack(len(meta)); pos += 8
+        dst[pos:pos + 8] = _U64.pack(nbuf); pos += 8
+        for off, blen in offsets:
+            dst[pos:pos + 8] = _U64.pack(off); pos += 8
+            dst[pos:pos + 8] = _U64.pack(blen); pos += 8
+        dst[pos:pos + len(meta)] = meta
+        for (off, blen), b in zip(offsets, self.buffers):
+            dst[off:off + blen] = b if isinstance(b, (bytes, bytearray, memoryview)) else memoryview(b)
+        return offset
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        n = self.write_into(memoryview(out))
+        return bytes(out[:n])
+
+
+def serialize(obj: Any) -> SerializedObject:
+    buffers: List[memoryview] = []
+
+    def _cb(pb: pickle.PickleBuffer):
+        buffers.append(pb.raw())
+        return False  # out-of-band
+
+    obj = _to_host(obj)
+    meta = cloudpickle.dumps(obj, protocol=5, buffer_callback=_cb)
+    return SerializedObject(meta, buffers)
+
+
+def deserialize(src: memoryview | bytes) -> Any:
+    view = memoryview(src)
+    meta_len = _U64.unpack(view[0:8])[0]
+    nbuf = _U64.unpack(view[8:16])[0]
+    pos = 16
+    bufs = []
+    for _ in range(nbuf):
+        off = _U64.unpack(view[pos:pos + 8])[0]
+        blen = _U64.unpack(view[pos + 8:pos + 16])[0]
+        bufs.append(view[off:off + blen])
+        pos += 16
+    header = 16 + 16 * nbuf
+    meta = view[header:header + meta_len]
+    return pickle.loads(meta, buffers=bufs)
+
+
+def dumps(obj: Any) -> bytes:
+    """One-shot serialize to contiguous bytes (for control messages)."""
+    return serialize(obj).to_bytes()
+
+
+def loads(data: bytes | memoryview) -> Any:
+    return deserialize(data)

@@ -1,0 +1,63 @@
+"""Process-global runtime context.
+
+Either a driver `Node` (runtime.py) or a `Worker` (worker_proc.py) is bound
+here; the public API (ray_tpu/api.py) dispatches through `current()`, like
+the reference's `global_worker` singleton (python/ray/_private/worker.py:427).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+_node = None          # driver-side Node
+_worker = None        # worker-side Worker
+_local_runtime = None  # local-mode inline runtime
+
+
+def set_node(node):
+    global _node
+    _node = node
+
+
+def set_worker_context(worker):
+    global _worker
+    _worker = worker
+
+
+def set_local_runtime(rt):
+    global _local_runtime
+    _local_runtime = rt
+
+
+def get_node():
+    return _node
+
+
+def is_initialized() -> bool:
+    return _node is not None or _worker is not None or _local_runtime is not None
+
+
+def is_driver() -> bool:
+    return _worker is None
+
+
+def current():
+    """The active runtime client: Node (driver), WorkerClient, or local."""
+    if _worker is not None:
+        return _worker.client
+    if _node is not None:
+        return _node
+    if _local_runtime is not None:
+        return _local_runtime
+    raise RuntimeError(
+        "ray_tpu has not been initialized; call ray_tpu.init() first "
+        "(auto-init also happens on first .remote() call).")
+
+
+def current_or_none():
+    if _worker is not None:
+        return _worker.client
+    if _node is not None:
+        return _node
+    return _local_runtime

@@ -1,0 +1,356 @@
+"""Worker process: task/actor execution loop.
+
+TPU-native analogue of the reference's worker process stack — the
+`default_worker.py` entrypoint running `CoreWorker.run_task_loop`
+(python/ray/_private/workers/default_worker.py:297, _raylet.pyx:3035) and the
+server side of task transport (TaskReceiver + scheduling queues,
+src/ray/core_worker/transport/). One process == one worker; an actor worker
+holds exactly one actor instance, like the reference.
+
+Threading model:
+  * main thread: recv loop over the duplex pipe to the driver; it only routes
+    (never blocks on user code), like the reference's io_service.
+  * task pool: normal tasks run on a thread pool (driver admission-controls
+    how many run concurrently via resource accounting).
+  * actor executor: ordered single thread by default (the reference's
+    ActorSchedulingQueue sequencing); `max_concurrency>1` uses a pool, and
+    async actors get a dedicated asyncio event loop (the reference's fibers,
+    transport/fiber.h).
+
+Nested API calls (get/put/remote inside a task) round-trip to the driver over
+the same pipe with request ids; replies are routed to waiting futures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import inspect
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ..exceptions import TaskCancelledError, TaskError
+from . import protocol as P
+from . import serialization
+from .ids import ActorID, ObjectID, TaskID
+from .object_store import INLINE_THRESHOLD, ObjectStore
+
+
+class WorkerClient:
+    """Worker-side client for the driver's GCS/scheduler services.
+
+    The in-worker counterpart of the reference's CoreWorker submission side
+    (core_worker.cc SubmitTask/Put/Get) — everything proxies to the owner
+    (driver) over the pipe.
+    """
+
+    def __init__(self, worker):
+        self._worker = worker
+
+    def _request(self, msg_type: str, payload: dict) -> Any:
+        return self._worker.request(msg_type, payload)
+
+    # -- objects ----------------------------------------------------------
+    def put(self, value: Any) -> ObjectID:
+        oid = ObjectID.from_random()
+        sobj = serialization.serialize(value)
+        if sobj.total_size <= INLINE_THRESHOLD:
+            self._request(P.OWNED_PUT, {"object_id": oid, "inline": sobj.to_bytes()})
+        else:
+            size = self._worker.store.put_serialized(oid, sobj)
+            self._request(P.OWNED_PUT, {"object_id": oid, "size": size})
+        return oid
+
+    def get_locations(self, object_ids: List[ObjectID], timeout=None) -> List:
+        return self._request(
+            P.GET_LOCATIONS, {"object_ids": object_ids, "timeout": timeout})
+
+    def get(self, object_ids: List[ObjectID], timeout=None) -> List[Any]:
+        locs = self.get_locations(object_ids, timeout)
+        out = []
+        for oid, loc in zip(object_ids, locs):
+            out.append(self._worker.read_location(oid, loc))
+        return out
+
+    def wait(self, object_ids, num_returns, timeout, fetch_local=True):
+        return self._request(P.WAIT_OBJECTS, {
+            "object_ids": object_ids, "num_returns": num_returns,
+            "timeout": timeout})
+
+    # -- tasks / actors ---------------------------------------------------
+    def submit_task(self, spec: P.TaskSpec):
+        self._request(P.SUBMIT_TASK, {"spec": spec})
+
+    def submit_actor_task(self, spec: P.TaskSpec):
+        self._request(P.SUBMIT_ACTOR_TASK, {"spec": spec})
+
+    def create_actor(self, spec: P.ActorSpec):
+        self._request(P.CREATE_ACTOR_REQ, {"spec": spec})
+
+    def get_actor(self, name: str, namespace: Optional[str]):
+        return self._request(P.GET_ACTOR, {"name": name, "namespace": namespace})
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool):
+        self._request(P.KILL_ACTOR, {"actor_id": actor_id,
+                                     "no_restart": no_restart})
+
+    def gcs_request(self, op: str, **kwargs) -> Any:
+        return self._request(P.GCS_REQUEST, {"op": op, "kwargs": kwargs})
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.gcs_request("cluster_resources")
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.gcs_request("available_resources")
+
+
+class Worker:
+    def __init__(self, conn, config: P.WorkerConfig):
+        self.conn = conn
+        self.config = config
+        self.store = ObjectStore(config.store_dir)
+        self.client = WorkerClient(self)
+        self._send_lock = threading.Lock()
+        self._req_counter = 0
+        self._req_lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._fn_cache: Dict[str, Any] = {}
+        self._task_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="task")
+        self._running: Dict[bytes, int] = {}  # task_id bytes -> thread ident
+        self._running_lock = threading.Lock()
+        # Actor state
+        self._actor_instance = None
+        self._actor_spec: Optional[P.ActorSpec] = None
+        self._actor_executor: Optional[ThreadPoolExecutor] = None
+        self._actor_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._shutdown = threading.Event()
+
+    # -- plumbing ----------------------------------------------------------
+    def send(self, msg_type: str, payload: dict):
+        data = cloudpickle.dumps((msg_type, payload))
+        with self._send_lock:
+            self.conn.send_bytes(data)
+
+    def request(self, msg_type: str, payload: dict) -> Any:
+        with self._req_lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+        fut: Future = Future()
+        self._pending[req_id] = fut
+        payload = dict(payload)
+        payload["req_id"] = req_id
+        self.send(msg_type, payload)
+        result = fut.result()
+        if isinstance(result, dict) and result.get("__error__") is not None:
+            raise result["__error__"]
+        return result
+
+    def read_location(self, oid: ObjectID, loc) -> Any:
+        kind = loc[0]
+        if kind == P.LOC_INLINE:
+            value = serialization.deserialize(loc[1])
+        elif kind == P.LOC_SHM:
+            value = self.store.get(oid)
+        elif kind == P.LOC_ERROR:
+            raise serialization.deserialize(loc[1])
+        else:
+            raise RuntimeError(f"unresolvable location {kind} for {oid}")
+        if isinstance(value, TaskError):
+            raise value
+        return value
+
+    def resolve_arg(self, arg: P.Arg) -> Any:
+        if arg.kind == "value":
+            return serialization.deserialize(arg.data)
+        return self.read_location(arg.object_id, arg.location)
+
+    # -- task execution ----------------------------------------------------
+    def _load_fn(self, spec: P.TaskSpec):
+        fn = self._fn_cache.get(spec.fn_id)
+        if fn is None:
+            if spec.fn_blob is None:
+                raise RuntimeError(f"function {spec.fn_id} not cached on worker")
+            fn = cloudpickle.loads(spec.fn_blob)
+            self._fn_cache[spec.fn_id] = fn
+        return fn
+
+    def _package_returns(self, spec: P.TaskSpec, result: Any) -> List:
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"Task {spec.name} declared num_returns="
+                    f"{spec.num_returns} but returned {len(values)} values")
+        locs = []
+        for oid, value in zip(spec.return_ids, values):
+            sobj = serialization.serialize(value)
+            if sobj.total_size <= INLINE_THRESHOLD:
+                locs.append((P.LOC_INLINE, sobj.to_bytes()))
+            else:
+                size = self.store.put_serialized(oid, sobj)
+                locs.append((P.LOC_SHM, size))
+        return locs
+
+    def _execute(self, spec: P.TaskSpec):
+        tid = spec.task_id.binary()
+        with self._running_lock:
+            self._running[tid] = threading.get_ident()
+        try:
+            args = [self.resolve_arg(a) for a in spec.args]
+            kwargs = {k: self.resolve_arg(a) for k, a in spec.kwargs.items()}
+            if spec.actor_id is not None:
+                if self._actor_instance is None:
+                    raise RuntimeError("actor task on non-actor worker")
+                method = getattr(self._actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = self._run_coroutine(result)
+            else:
+                fn = self._load_fn(spec)
+                result = fn(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = asyncio.run(result)
+            locs = self._package_returns(spec, result)
+            self.send(P.TASK_DONE, {
+                "task_id": spec.task_id, "results": locs, "error": None,
+                "actor_id": spec.actor_id})
+        except BaseException as e:  # noqa: BLE001 — all errors ship to owner
+            if isinstance(e, TaskCancelledError):
+                err = e
+            else:
+                err = TaskError(e, task_repr=spec.name,
+                                remote_tb=traceback.format_exc())
+            try:
+                blob = serialization.dumps(err)
+            except Exception:
+                blob = serialization.dumps(
+                    TaskError(RuntimeError(repr(e)), task_repr=spec.name))
+            self.send(P.TASK_DONE, {
+                "task_id": spec.task_id, "results": None, "error": blob,
+                "actor_id": spec.actor_id})
+        finally:
+            with self._running_lock:
+                self._running.pop(tid, None)
+
+    def _run_coroutine(self, coro):
+        loop = self._ensure_actor_loop()
+        return asyncio.run_coroutine_threadsafe(coro, loop).result()
+
+    def _ensure_actor_loop(self) -> asyncio.AbstractEventLoop:
+        if self._actor_loop is None:
+            loop = asyncio.new_event_loop()
+            t = threading.Thread(target=loop.run_forever, daemon=True,
+                                 name="actor-asyncio")
+            t.start()
+            self._actor_loop = loop
+        return self._actor_loop
+
+    # -- actor lifecycle ---------------------------------------------------
+    def _create_actor(self, spec: P.ActorSpec):
+        try:
+            cls = self._fn_cache.get(spec.cls_id)
+            if cls is None:
+                cls = cloudpickle.loads(spec.cls_blob)
+                self._fn_cache[spec.cls_id] = cls
+            args = [self.resolve_arg(a) for a in spec.args]
+            kwargs = {k: self.resolve_arg(a) for k, a in spec.kwargs.items()}
+            self._actor_instance = cls(*args, **kwargs)
+            self._actor_spec = spec
+            n = max(1, spec.max_concurrency)
+            self._actor_executor = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="actor")
+            self.send(P.ACTOR_READY, {"actor_id": spec.actor_id, "error": None})
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, task_repr=f"{spec.cls_id}.__init__",
+                            remote_tb=traceback.format_exc())
+            self.send(P.ACTOR_READY, {"actor_id": spec.actor_id,
+                                      "error": serialization.dumps(err)})
+
+    # -- cancellation ------------------------------------------------------
+    def _cancel(self, task_id: TaskID):
+        """Raise TaskCancelledError inside the executing thread (the
+        reference interrupts running tasks similarly via
+        execute_task_with_cancellation_handler, _raylet.pyx:2077)."""
+        with self._running_lock:
+            ident = self._running.get(task_id.binary())
+        if ident is not None:
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(ident),
+                ctypes.py_object(TaskCancelledError))
+
+    # -- main loop ---------------------------------------------------------
+    def run(self):
+        while not self._shutdown.is_set():
+            try:
+                data = self.conn.recv_bytes()
+            except (EOFError, OSError):
+                break
+            msg_type, payload = cloudpickle.loads(data)
+            if msg_type == P.EXEC_TASK:
+                spec: P.TaskSpec = payload["spec"]
+                if spec.actor_id is not None and self._actor_executor is not None:
+                    self._actor_executor.submit(self._execute, spec)
+                else:
+                    self._task_pool.submit(self._execute, spec)
+            elif msg_type == P.REPLY:
+                fut = self._pending.pop(payload["req_id"], None)
+                if fut is not None:
+                    fut.set_result(payload.get("result"))
+            elif msg_type == P.CREATE_ACTOR:
+                threading.Thread(
+                    target=self._create_actor, args=(payload["spec"],),
+                    daemon=True).start()
+            elif msg_type == P.CANCEL_TASK:
+                self._cancel(payload["task_id"])
+            elif msg_type == P.RELEASE_OBJECTS:
+                for oid in payload["object_ids"]:
+                    self.store.release(oid)
+            elif msg_type == P.SHUTDOWN:
+                break
+        self._shutdown.set()
+        if self._actor_instance is not None:
+            # Best-effort __ray_terminate__-style atexit hook parity.
+            term = getattr(self._actor_instance, "__on_exit__", None)
+            if callable(term):
+                try:
+                    term()
+                except Exception:
+                    pass
+        os._exit(0)
+
+
+def worker_main(conn, config: P.WorkerConfig):
+    for k, v in config.env.items():
+        os.environ[k] = v
+    sys.path.insert(0, os.getcwd())
+    from . import state
+    worker = Worker(conn, config)
+    state.set_worker_context(worker)
+    worker.run()
+
+
+def _main():
+    """Worker process entrypoint (reference:
+    python/ray/_private/workers/default_worker.py). Launched as
+    ``python -m ray_tpu._private.worker_proc`` so the driver's ``__main__``
+    is never re-executed in workers."""
+    from multiprocessing.connection import Client
+
+    address = os.environ["RAY_TPU_WORKER_SOCKET"]
+    authkey = bytes.fromhex(os.environ["RAY_TPU_WORKER_AUTHKEY"])
+    conn = Client(address, family="AF_UNIX", authkey=authkey)
+    config: P.WorkerConfig = cloudpickle.loads(conn.recv_bytes())
+    worker_main(conn, config)
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,0 +1,553 @@
+"""Public API: init/shutdown, @remote tasks and actors, get/put/wait.
+
+TPU-native re-implementation of the reference's core Python API surface
+(python/ray/_private/worker.py init:1275 get:2649 put:754,
+remote_function.py:303 _remote, actor.py ActorClass/ActorHandle). Semantics
+follow the reference: `.remote()` is async and returns ObjectRefs; top-level
+ObjectRef arguments are resolved to values before execution; actor method
+calls execute in submission order; passing/returning refs composes.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ._private import protocol as P
+from ._private import serialization, state
+from ._private.ids import ActorID, ObjectID, TaskID, object_id_for_return
+from .exceptions import TaskError
+
+_init_lock = threading.Lock()
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "method", "get", "put",
+    "wait", "kill", "cancel", "get_actor", "ObjectRef", "ActorHandle",
+    "cluster_resources", "available_resources", "get_runtime_context",
+]
+
+
+# ---------------------------------------------------------------------------
+# ObjectRef
+# ---------------------------------------------------------------------------
+class ObjectRef:
+    """A future for an object in the cluster (reference: ObjectRef in
+    includes/object_ref.pxi). Driver-held refs participate in ownership
+    reference counting; dropping the last ref frees the object."""
+
+    __slots__ = ("_id", "_owned", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, _incref: bool = True):
+        self._id = object_id
+        self._owned = False
+        if _incref and state.is_driver():
+            rt = state.current_or_none()
+            if rt is not None and hasattr(rt, "incref"):
+                rt.incref(object_id)
+                self._owned = True
+
+    @classmethod
+    def _from_binary(cls, id_bytes: bytes) -> "ObjectRef":
+        return cls(ObjectID(id_bytes))
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from concurrent.futures import Future
+        fut: Future = Future()
+
+        def _resolve():
+            try:
+                fut.set_result(get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        import asyncio
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, lambda: get(self)).__await__()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef._from_binary, (self._id.binary(),))
+
+    def __del__(self):
+        if self._owned:
+            rt = state.current_or_none()
+            if rt is not None and hasattr(rt, "decref"):
+                try:
+                    rt.decref(self._id)
+                except Exception:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# init / shutdown
+# ---------------------------------------------------------------------------
+def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: str = "default", object_store_memory: Optional[int] = None,
+         ignore_reinit_error: bool = False, local_mode: bool = False,
+         runtime_env: Optional[dict] = None, log_to_driver: bool = True,
+         prestart_workers: Optional[int] = None,
+         **_compat_kwargs):
+    """Start the runtime (reference: worker.py:1275 ray.init)."""
+    with _init_lock:
+        if state.is_initialized():
+            if ignore_reinit_error:
+                return get_runtime_context()
+            raise RuntimeError(
+                "ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        if local_mode:
+            from ._private.local_mode import LocalRuntime
+            state.set_local_runtime(LocalRuntime())
+            return get_runtime_context()
+        from ._private.runtime import Node
+        node = Node(num_cpus=num_cpus, num_tpus=num_tpus,
+                    resources=resources, namespace=namespace,
+                    object_store_memory=object_store_memory)
+        state.set_node(node)
+        if prestart_workers is None:
+            prestart_workers = min(int(node.cluster_resources().get("CPU", 4)),
+                                   8)
+        if prestart_workers:
+            node.prestart_workers(prestart_workers)
+        return get_runtime_context()
+
+
+def shutdown():
+    rt = state.get_node()
+    if rt is not None:
+        rt.shutdown()
+    state.set_node(None)
+    state.set_local_runtime(None)
+
+
+def is_initialized() -> bool:
+    return state.is_initialized()
+
+
+# ---------------------------------------------------------------------------
+# argument marshalling
+# ---------------------------------------------------------------------------
+def _make_args(args: Sequence, kwargs: Dict) -> tuple:
+    out_args, out_kwargs = [], {}
+    for a in args:
+        if isinstance(a, ObjectRef):
+            out_args.append(P.Arg(kind="ref", object_id=a.id))
+        else:
+            out_args.append(P.Arg(kind="value", data=serialization.dumps(a)))
+    for k, a in kwargs.items():
+        if isinstance(a, ObjectRef):
+            out_kwargs[k] = P.Arg(kind="ref", object_id=a.id)
+        else:
+            out_kwargs[k] = P.Arg(kind="value", data=serialization.dumps(a))
+    return out_args, out_kwargs
+
+
+def _build_resources(opts: Dict, default_num_cpus: float = 1) -> Dict[str, float]:
+    res = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    res["CPU"] = float(default_num_cpus if num_cpus is None else num_cpus)
+    num_tpus = opts.get("num_tpus")
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    if opts.get("num_gpus"):
+        res["GPU"] = float(opts["num_gpus"])
+    if opts.get("accelerator_type"):
+        res[opts["accelerator_type"]] = 0.001
+    if opts.get("memory"):
+        res["memory"] = float(opts["memory"])
+    return res
+
+
+# ---------------------------------------------------------------------------
+# remote functions
+# ---------------------------------------------------------------------------
+class RemoteFunction:
+    """Reference parity: python/ray/remote_function.py."""
+
+    def __init__(self, fn, options: Optional[Dict] = None):
+        self._fn = fn
+        self._opts = dict(options or {})
+        self._fn_id = (f"{getattr(fn, '__module__', 'm')}."
+                       f"{getattr(fn, '__qualname__', 'f')}:"
+                       f"{uuid.uuid4().hex[:16]}")
+        self._blob: Optional[bytes] = None
+        self._blob_lock = threading.Lock()
+        functools.update_wrapper(self, fn)
+
+    def _get_blob(self) -> bytes:
+        if self._blob is None:
+            with self._blob_lock:
+                if self._blob is None:
+                    import cloudpickle
+                    self._blob = cloudpickle.dumps(self._fn)
+        return self._blob
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; "
+            f"use '{self.__name__}.remote()'.")
+
+    def options(self, **overrides) -> "RemoteFunction":
+        rf = RemoteFunction.__new__(RemoteFunction)
+        rf._fn = self._fn
+        rf._opts = {**self._opts, **overrides}
+        rf._fn_id = self._fn_id
+        rf._blob = self._blob
+        rf._blob_lock = self._blob_lock
+        functools.update_wrapper(rf, self._fn)
+        return rf
+
+    def __reduce__(self):
+        # Remote functions captured inside other remote functions must ship
+        # to workers; rebuild sans locks, preserving fn_id so the driver's
+        # function registry stays keyed consistently.
+        return (RemoteFunction._reconstruct,
+                (self._fn, self._opts, self._fn_id))
+
+    @staticmethod
+    def _reconstruct(fn, opts, fn_id):
+        rf = RemoteFunction(fn, opts)
+        rf._fn_id = fn_id
+        return rf
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        if not state.is_initialized():
+            init(ignore_reinit_error=True)
+        rt = state.current()
+        opts = self._opts
+        num_returns = int(opts.get("num_returns", 1))
+        task_id = TaskID.from_random()
+        return_ids = [object_id_for_return(task_id, i)
+                      for i in range(num_returns)]
+        s_args, s_kwargs = _make_args(args, kwargs)
+        spec = P.TaskSpec(
+            task_id=task_id, fn_id=self._fn_id, fn_blob=self._get_blob(),
+            args=s_args, kwargs=s_kwargs, return_ids=return_ids,
+            num_returns=num_returns, name=opts.get("name", self.__name__),
+            resources=_build_resources(opts),
+            max_retries=int(opts.get("max_retries", 3)),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            runtime_env=opts.get("runtime_env"))
+        refs = [ObjectRef(rid) for rid in return_ids]
+        rt.submit_task(spec)
+        return refs[0] if num_returns == 1 else refs
+
+
+# ---------------------------------------------------------------------------
+# actors
+# ---------------------------------------------------------------------------
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 options: Optional[Dict] = None):
+        self._handle = handle
+        self._name = name
+        self._opts = dict(options or {})
+
+    def options(self, **overrides) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name,
+                           {**self._opts, **overrides})
+
+    def remote(self, *args, **kwargs):
+        return self._handle._actor_method_call(
+            self._name, args, kwargs, self._opts)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._name}' cannot be called directly; "
+            f"use '.{self._name}.remote()'.")
+
+
+class ActorHandle:
+    """Reference parity: python/ray/actor.py ActorHandle."""
+
+    def __init__(self, actor_id: ActorID, cls_id: str,
+                 method_meta: Dict[str, Dict]):
+        self._actor_id = actor_id
+        self._cls_id = cls_id
+        self._method_meta = method_meta
+
+    @property
+    def _id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name):
+        meta = object.__getattribute__(self, "_method_meta")
+        if name in meta:
+            return ActorMethod(self, name, meta[name])
+        raise AttributeError(
+            f"Actor {self._cls_id} has no method '{name}'")
+
+    def _actor_method_call(self, method_name: str, args, kwargs,
+                           opts: Dict):
+        rt = state.current()
+        meta = self._method_meta.get(method_name, {})
+        num_returns = int(opts.get("num_returns",
+                                   meta.get("num_returns", 1)))
+        task_id = TaskID.from_random()
+        return_ids = [object_id_for_return(task_id, i)
+                      for i in range(num_returns)]
+        s_args, s_kwargs = _make_args(args, kwargs)
+        spec = P.TaskSpec(
+            task_id=task_id, fn_id=f"{self._cls_id}.{method_name}",
+            fn_blob=None, args=s_args, kwargs=s_kwargs,
+            return_ids=return_ids, num_returns=num_returns,
+            name=f"{self._cls_id.split(':')[0]}.{method_name}",
+            actor_id=self._actor_id, method_name=method_name,
+            max_retries=0)
+        refs = [ObjectRef(rid) for rid in return_ids]
+        rt.submit_actor_task(spec)
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._cls_id,
+                              self._method_meta))
+
+    def __repr__(self):
+        return (f"ActorHandle({self._cls_id.split(':')[0]}, "
+                f"{self._actor_id.hex()[:12]})")
+
+
+def method(*, num_returns: int = 1, concurrency_group: Optional[str] = None):
+    """Per-method options decorator (reference: actor.py ray.method)."""
+    def deco(fn):
+        fn.__ray_tpu_method_opts__ = {
+            "num_returns": num_returns,
+            "concurrency_group": concurrency_group,
+        }
+        return fn
+    return deco
+
+
+class ActorClass:
+    """Reference parity: python/ray/actor.py ActorClass."""
+
+    def __init__(self, cls, options: Optional[Dict] = None):
+        self._cls = cls
+        self._opts = dict(options or {})
+        self._cls_id = (f"{getattr(cls, '__module__', 'm')}."
+                        f"{getattr(cls, '__qualname__', 'C')}:"
+                        f"{uuid.uuid4().hex[:16]}")
+        self._blob: Optional[bytes] = None
+        self._method_meta = self._build_method_meta(cls)
+
+    @staticmethod
+    def _build_method_meta(cls) -> Dict[str, Dict]:
+        meta = {}
+        for name in dir(cls):
+            if name.startswith("__") and name not in ("__call__",):
+                continue
+            attr = inspect.getattr_static(cls, name)
+            if callable(attr) or isinstance(attr, (staticmethod,
+                                                   classmethod)):
+                opts = getattr(attr, "__ray_tpu_method_opts__", {})
+                meta[name] = dict(opts)
+        return meta
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote().")
+
+    def options(self, **overrides) -> "ActorClass":
+        ac = ActorClass.__new__(ActorClass)
+        ac._cls = self._cls
+        ac._opts = {**self._opts, **overrides}
+        ac._cls_id = self._cls_id
+        ac._blob = self._blob
+        ac._method_meta = self._method_meta
+        return ac
+
+    def __reduce__(self):
+        return (ActorClass._reconstruct,
+                (self._cls, self._opts, self._cls_id))
+
+    @staticmethod
+    def _reconstruct(cls, opts, cls_id):
+        ac = ActorClass(cls, opts)
+        ac._cls_id = cls_id
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        if not state.is_initialized():
+            init(ignore_reinit_error=True)
+        rt = state.current()
+        if self._blob is None:
+            import cloudpickle
+            self._blob = cloudpickle.dumps(self._cls)
+        opts = self._opts
+        actor_id = ActorID.from_random()
+        s_args, s_kwargs = _make_args(args, kwargs)
+        is_async = any(
+            inspect.iscoroutinefunction(getattr(self._cls, n, None))
+            for n in self._method_meta)
+        max_concurrency = opts.get("max_concurrency")
+        if max_concurrency is None:
+            max_concurrency = 1000 if is_async else 1
+        spec = P.ActorSpec(
+            actor_id=actor_id, cls_id=self._cls_id, cls_blob=self._blob,
+            args=s_args, kwargs=s_kwargs, name=opts.get("name"),
+            namespace=opts.get("namespace", "default"),
+            max_concurrency=int(max_concurrency),
+            max_restarts=int(opts.get("max_restarts", 0)),
+            max_task_retries=int(opts.get("max_task_retries", 0)),
+            # Actors hold 0 CPU while alive unless explicitly requested
+            # (reference semantics: actors don't reserve CPUs for their
+            # lifetime, which is how 40k+ actors fit on small clusters).
+            resources=_build_resources(opts, default_num_cpus=0),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            runtime_env=opts.get("runtime_env"),
+            lifetime=opts.get("lifetime"),
+            method_meta=self._method_meta)
+        rt.create_actor(spec)
+        return ActorHandle(actor_id, self._cls_id, self._method_meta)
+
+
+# ---------------------------------------------------------------------------
+# the @remote decorator
+# ---------------------------------------------------------------------------
+def remote(*args, **options):
+    """@remote / @remote(num_cpus=..., num_tpus=..., ...) for functions and
+    classes (reference: worker.py ray.remote)."""
+    if len(args) == 1 and not options and callable(args[0]):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("remote() takes keyword options only")
+
+    def deco(target):
+        if inspect.isclass(target):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# get / put / wait / kill / cancel
+# ---------------------------------------------------------------------------
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    """Reference parity: worker.py:2649 ray.get."""
+    rt = state.current()
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(
+                f"get() expects ObjectRef(s), got {type(r).__name__}")
+    values = rt.get([r.id for r in ref_list], timeout)
+    return values[0] if single else values
+
+
+def put(value: Any) -> ObjectRef:
+    """Reference parity: worker.py:754 put_object."""
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed.")
+    if not state.is_initialized():
+        init(ignore_reinit_error=True)
+    rt = state.current()
+    return ObjectRef(rt.put(value))
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    """Reference parity: worker.py ray.wait."""
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    rt = state.current()
+    by_id = {r.id: r for r in refs}
+    ready_ids, not_ready_ids = rt.wait(
+        [r.id for r in refs], num_returns, timeout, fetch_local)
+    return ([by_id[i] for i in ready_ids],
+            [by_id[i] for i in not_ready_ids])
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    state.current().kill_actor(actor._id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    rt = state.current()
+    if hasattr(rt, "cancel"):
+        rt.cancel(ref.id, force, recursive)
+    else:
+        raise RuntimeError("cancel() is only supported from the driver")
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    """Look up a named actor (reference: ray.get_actor)."""
+    rt = state.current()
+    spec = rt.get_actor(name, namespace)
+    return ActorHandle(spec.actor_id, spec.cls_id, spec.method_meta)
+
+
+def cluster_resources() -> Dict[str, float]:
+    return state.current().cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return state.current().available_resources()
+
+
+# ---------------------------------------------------------------------------
+# runtime context
+# ---------------------------------------------------------------------------
+class RuntimeContext:
+    """Reference parity: python/ray/runtime_context.py."""
+
+    @property
+    def is_initialized(self) -> bool:
+        return state.is_initialized()
+
+    def get_node_id(self) -> str:
+        node = state.get_node()
+        if node is not None:
+            return node.node_id.hex()
+        rt = state.current_or_none()
+        if rt is not None and hasattr(rt, "gcs_request"):
+            return "worker-node"
+        return ""
+
+    @property
+    def namespace(self) -> str:
+        node = state.get_node()
+        return node.namespace if node is not None else "default"
+
+    def get_worker_id(self) -> str:
+        from ._private import state as st
+        if st._worker is not None:
+            return st._worker.config.worker_id.hex()
+        return "driver"
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

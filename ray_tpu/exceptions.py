@@ -1,0 +1,132 @@
+"""Exception hierarchy for ray_tpu.
+
+Mirrors the user-visible surface of the reference's python/ray/exceptions.py —
+the names users catch in application code — without its cross-language error
+payloads (single-language framework).
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayError(RayTpuError):
+    """Alias base kept for reference API parity (reference: exceptions.py)."""
+
+
+class TaskError(RayError):
+    """Wraps an exception raised inside a remote task.
+
+    Re-raised at `get()` on the caller, carrying the remote traceback
+    (reference: python/ray/exceptions.py RayTaskError).
+    """
+
+    def __init__(self, cause: BaseException, task_repr: str = "",
+                 remote_tb: str | None = None):
+        self.cause = cause
+        self.task_repr = task_repr
+        if remote_tb is None:
+            try:
+                remote_tb = "".join(traceback.format_exception(
+                    type(cause), cause, cause.__traceback__))
+            except Exception:
+                remote_tb = repr(cause)
+        self.remote_tb = remote_tb
+        super().__init__(str(cause))
+
+    def __str__(self):
+        return (
+            f"{type(self.cause).__name__} in remote task {self.task_repr}\n"
+            f"--- remote traceback ---\n{self.remote_tb}"
+        )
+
+    def __reduce__(self):
+        try:
+            import pickle
+            pickle.dumps(self.cause)
+            cause = self.cause
+        except Exception:
+            cause = RuntimeError(repr(self.cause))
+        return (TaskError, (cause, self.task_repr, self.remote_tb))
+
+
+# Reference-parity alias (python/ray/exceptions.py RayTaskError).
+RayTaskError = TaskError
+
+
+class ActorError(RayError):
+    """Base for actor-related failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor died before or while executing the task
+    (reference: exceptions.py RayActorError)."""
+
+    def __init__(self, message: str = "The actor died unexpectedly."):
+        super().__init__(message)
+
+
+RayActorError = ActorDiedError
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class WorkerCrashedError(RayError):
+    """The worker process executing a task died
+    (reference: exceptions.py WorkerCrashedError)."""
+
+
+class ObjectLostError(RayError):
+    """An object was evicted or its node died, and reconstruction failed
+    (reference: exceptions.py ObjectLostError)."""
+
+    def __init__(self, object_id_hex: str, message: str | None = None):
+        self.object_id_hex = object_id_hex
+        super().__init__(
+            message or f"Object {object_id_hex} was lost and could not be "
+            "reconstructed."
+        )
+
+
+class ObjectStoreFullError(RayError):
+    """The object store is out of memory and eviction could not make room."""
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    """`get()` timed out (reference: exceptions.py GetTimeoutError)."""
+
+
+class TaskCancelledError(RayError):
+    """The task was cancelled (reference: exceptions.py TaskCancelledError)."""
+
+    def __init__(self, task_id_hex: str | None = None):
+        self.task_id_hex = task_id_hex
+        super().__init__(
+            f"Task {task_id_hex} was cancelled." if task_id_hex
+            else "This task was cancelled."
+        )
+
+
+class TaskUnschedulableError(RayError):
+    """The task's resource demand can never be satisfied by the cluster."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+
+
+class RuntimeEnvSetupError(RayError):
+    """Setting up the runtime environment for a task/actor failed."""
+
+
+class PlacementGroupSchedulingError(RayError):
+    """Placement group bundles could not be reserved."""
+
+
+class CrossSystemError(RayError):
+    """Error raised by a subsystem (train/data/tune/serve) controller."""

@@ -1,0 +1,46 @@
+"""Test fixtures (reference strategy: python/ray/tests/conftest.py —
+`ray_start_regular`-style local clusters; SURVEY.md §4).
+
+Collective / mesh tests run against a virtual 8-device CPU mesh, the
+reference's pattern of CPU-only collective suites mirroring the GPU ones
+(util/collective/tests/single_node_cpu_tests vs distributed_gpu_tests).
+"""
+
+import os
+import sys
+
+# Must be set before the first jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    """Module-shared cluster (reference: ray_start_regular_shared)."""
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Fresh cluster per test (reference: ray_start_regular)."""
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    """Test calls init() itself (reference: conftest.py:449 shutdown_only)."""
+    yield
+    ray_tpu.shutdown()

@@ -1,0 +1,52 @@
+"""Core tests that own their cluster lifecycle (fresh init/shutdown each).
+
+Kept separate from test_core.py so they don't fight the module-shared
+cluster fixture (reference pattern: tests tagged exclusive in
+python/ray/tests/BUILD).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+
+
+class TestCancellation:
+    def test_cancel_queued(self, shutdown_only):
+        import ray_tpu as rt
+        rt.init(num_cpus=1, prestart_workers=1)
+
+        @rt.remote
+        def blocker():
+            time.sleep(30)
+
+        @rt.remote
+        def victim():
+            return 1
+
+        b = blocker.remote()
+        time.sleep(0.5)  # let blocker occupy the only CPU
+        v = victim.remote()
+        rt.cancel(v)
+        from ray_tpu.exceptions import TaskCancelledError
+        with pytest.raises((TaskCancelledError, TaskError)):
+            rt.get(v, timeout=5)
+        rt.cancel(b, force=True)
+
+
+
+
+class TestCustomResources:
+    def test_custom_resources(self, shutdown_only):
+        import ray_tpu as rt
+        rt.init(num_cpus=2, resources={"widget": 2})
+
+        @rt.remote(resources={"widget": 1})
+        def uses_widget():
+            return "w"
+
+        assert rt.get(uses_widget.remote()) == "w"
+
+
