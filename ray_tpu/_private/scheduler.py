@@ -147,7 +147,10 @@ class WorkerPool:
         env = dict(self._base_env)
         # Workers never implicitly grab the TPU: the chip belongs to whoever
         # the scheduler assigned it to (accelerator isolation, tpu.py:170).
+        # PALLAS_AXON_POOL_IPS="" suppresses environments whose
+        # sitecustomize force-registers a TPU backend in every interpreter.
         env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("PALLAS_AXON_POOL_IPS", "")
         if extra_env:
             env.update(extra_env)
         address = os.path.join(self._session_dir,
@@ -464,10 +467,18 @@ class Scheduler:
                 # retrying once their death returns the chips.
                 self._reclaim_idle_tpu_workers()
                 return None
-            extra_env = {
-                "JAX_PLATFORMS": "",
-                "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in chip_ids),
-            }
+            from .resources import TPUAcceleratorManager
+            extra_env = TPUAcceleratorManager.get_visible_chips_env(chip_ids)
+            # JAX_PLATFORMS="" (auto-detect) unless the parent names a
+            # non-cpu platform plugin the worker must reuse; a driver pinned
+            # to cpu must NOT push cpu onto a TPU-assigned worker.
+            parent_platform = os.environ.get("JAX_PLATFORMS", "")
+            if parent_platform and parent_platform != "cpu":
+                extra_env["JAX_PLATFORMS"] = parent_platform
+            # Images whose sitecustomize registers the TPU plugin key on
+            # this var; TPU workers need the real value, cpu workers get "".
+            extra_env["PALLAS_AXON_POOL_IPS"] = os.environ.get(
+                "PALLAS_AXON_POOL_IPS", "")
         handle = self.pool.start_worker(env_key, extra_env)
         handle.chip_ids = chip_ids
         return handle

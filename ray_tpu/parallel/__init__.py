@@ -1,0 +1,24 @@
+"""ray_tpu.parallel: first-class mesh parallelism strategies.
+
+This layer is where the framework *exceeds* the reference (SURVEY.md §2.4):
+the reference ships only data-parallel in-tree and leaves TP/PP/SP/EP to
+external libraries over placement groups + NCCL; here they are native mesh
+strategies over jax.sharding + shard_map:
+
+* mesh.py       — MeshConfig/make_mesh: dp/fsdp/tp/pp/sp/ep axes over a
+                  TPU slice (or a forced-CPU test mesh).
+* ops.py        — in-jit collective ops (lax.psum et al.) — the ICI hot
+                  path counterpart of ray_tpu.util.collective.
+* partition.py  — logical-axis partition rules (Megatron-style TP,
+                  ZeRO/FSDP param sharding).
+* pipeline.py   — pipeline parallelism via shard_map + ppermute.
+* sequence.py   — sequence/context parallelism (ring attention driver).
+"""
+
+from .mesh import MeshConfig, best_mesh_shape, make_mesh  # noqa: F401
+from .partition import (  # noqa: F401
+    PartitionRules,
+    fsdp_rules,
+    logical_to_mesh_axes,
+    tp_rules,
+)

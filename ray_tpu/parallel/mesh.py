@@ -1,0 +1,116 @@
+"""Device mesh construction for TPU slices.
+
+The TPU-native analogue of the reference's process-group bootstrap
+(train/torch/config.py:66-153 _setup_torch_process_group): instead of
+`dist.init_process_group(nccl)`, parallelism is declared as a
+`jax.sharding.Mesh` with named axes, and XLA inserts ICI/DCN collectives
+from sharding annotations (scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert collectives).
+
+Axis conventions used across the framework:
+  * ``dp``   — data parallel (batch sharding; gradient psum)
+  * ``fsdp`` — param/optimizer sharding (ZeRO-equivalent; psum_scatter)
+  * ``tp``   — tensor parallel (Megatron partition of matmuls)
+  * ``pp``   — pipeline stages
+  * ``sp``   — sequence/context parallel (ring attention axis)
+  * ``ep``   — expert parallel (MoE all_to_all axis)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "ep", "tp")
+
+
+@dataclass
+class MeshConfig:
+    """Declarative mesh shape. Unset axes default to 1. `dp=-1` means
+    "absorb all remaining devices" (like the reference ScalingConfig's
+    num_workers covering the worker group)."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        fixed = {"fsdp": self.fsdp, "tp": self.tp, "pp": self.pp,
+                 "sp": self.sp, "ep": self.ep}
+        known = int(np.prod(list(fixed.values())))
+        dp = self.dp
+        if dp == -1:
+            if n_devices % known != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {known}")
+            dp = n_devices // known
+        total = dp * known
+        if total != n_devices:
+            raise ValueError(
+                f"Mesh shape {dict(dp=dp, **fixed)} needs {total} devices, "
+                f"have {n_devices}")
+        return {"dp": dp, **fixed}
+
+
+def best_mesh_shape(n_devices: int, model_parallel: int = 1
+                    ) -> Tuple[int, int]:
+    """(dp, tp) split for n devices given a model-parallel degree."""
+    if n_devices % model_parallel != 0:
+        raise ValueError(f"{n_devices} % {model_parallel} != 0")
+    return n_devices // model_parallel, model_parallel
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None,
+              axis_names: Optional[Sequence[str]] = None):
+    """Build a Mesh with the framework's axis names.
+
+    On real hardware, uses jax's device topology ordering
+    (mesh_utils.create_device_mesh) so ICI neighbours land adjacent on the
+    mesh; on CPU test backends it falls back to a plain reshape.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    shape_map = config.resolve(len(devices))
+    names = tuple(axis_names or [a for a in AXIS_ORDER])
+    shape = tuple(shape_map.get(a, 1) for a in names)
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=np.array(devices))
+    except Exception:
+        dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def make_1d_mesh(axis: str = "dp", devices: Optional[Sequence] = None):
+    import jax
+    from jax.sharding import Mesh
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (axis,))
+
+
+def mesh_axis_size(mesh, axis: str) -> int:
+    return int(mesh.shape.get(axis, 1))
+
+
+def local_slice_info() -> Dict[str, object]:
+    """Host's view of the slice (reference: tpu.py pod metadata —
+    worker id, pod name, chips per host)."""
+    import jax
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+    }

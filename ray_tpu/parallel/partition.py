@@ -1,0 +1,95 @@
+"""Partition rules: logical array axes -> mesh axes.
+
+TPU-native equivalent of what the reference leaves to external libraries
+(Megatron/DeepSpeed over placement groups, SURVEY.md §2.4 TP/FSDP rows):
+parameters carry *logical* axis names ("embed", "mlp", "heads", "kv", ...)
+and a rule table maps them to mesh axes, yielding
+jax.sharding.PartitionSpecs. Swapping rule tables re-shards the same model
+(pure TP, FSDP, or combined) without touching model code — the
+compiler-friendly analogue of wrapping modules in DDP/FSDP classes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+MeshAxis = Union[None, str, Tuple[str, ...]]
+
+
+class PartitionRules:
+    """Ordered (logical_axis -> mesh_axis) table."""
+
+    def __init__(self, rules: Sequence[Tuple[str, MeshAxis]]):
+        self._rules: Dict[str, MeshAxis] = dict(rules)
+
+    def mesh_axis(self, logical: Optional[str]) -> MeshAxis:
+        if logical is None:
+            return None
+        return self._rules.get(logical)
+
+    def spec(self, logical_axes: Sequence[Optional[str]]):
+        """PartitionSpec for an array annotated with logical axis names."""
+        from jax.sharding import PartitionSpec
+        return PartitionSpec(
+            *[self.mesh_axis(a) for a in logical_axes])
+
+    def with_overrides(self, overrides: Sequence[Tuple[str, MeshAxis]]
+                       ) -> "PartitionRules":
+        merged = dict(self._rules)
+        merged.update(dict(overrides))
+        return PartitionRules(list(merged.items()))
+
+    def items(self):
+        return self._rules.items()
+
+
+def tp_rules() -> PartitionRules:
+    """Megatron-style tensor parallelism: shard the MLP hidden and the
+    attention heads over `tp`; batch over `dp`; sequence over `sp`."""
+    return PartitionRules([
+        ("batch", "dp"),
+        ("seq", "sp"),
+        ("embed", None),
+        ("mlp", "tp"),
+        ("heads", "tp"),
+        ("kv", None),
+        ("head_dim", None),
+        ("vocab", "tp"),
+        ("expert", "ep"),
+        ("stage", "pp"),
+    ])
+
+
+def fsdp_rules() -> PartitionRules:
+    """ZeRO-3-style fully sharded params: shard the embed axis of every
+    weight over `fsdp` (psum_scatter grads, all_gather params on use)."""
+    return tp_rules().with_overrides([
+        ("embed", "fsdp"),
+    ])
+
+
+def logical_to_mesh_axes(param_logical: Dict[str, Sequence[Optional[str]]],
+                         rules: PartitionRules):
+    """Map a pytree-of-logical-axes dict to a dict of PartitionSpecs."""
+    return {k: rules.spec(v) for k, v in param_logical.items()}
+
+
+def named_sharding(mesh, rules: PartitionRules,
+                   logical_axes: Sequence[Optional[str]]):
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, rules.spec(logical_axes))
+
+
+def tree_shardings(mesh, rules: PartitionRules, logical_tree):
+    """Pytree of NamedShardings from a matching pytree of logical-axis
+    tuples (leaves are tuples/lists of axis names)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def _one(axes):
+        return NamedSharding(mesh, rules.spec(axes))
+
+    return jax.tree.map(
+        _one, logical_tree,
+        is_leaf=lambda x: isinstance(x, (tuple, list)) and all(
+            a is None or isinstance(a, str) for a in x))

@@ -1,0 +1,4 @@
+from .actor_pool import ActorPool  # noqa: F401
+from .queue import Queue  # noqa: F401
+
+__all__ = ["ActorPool", "Queue"]

@@ -1,0 +1,104 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py ActorPool)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+from .. import api
+
+
+class ActorPool:
+    """Round-robins work over a fixed set of actors with a bounded number
+    of in-flight submissions per actor, same contract as the reference."""
+
+    def __init__(self, actors: List):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits = []
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef (reference: ActorPool.submit)."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout=None):
+        """Next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        idx = self._next_return_index
+        while idx not in self._index_to_future:
+            self._drain_one(timeout)
+        future = self._index_to_future.pop(idx)
+        self._next_return_index += 1
+        self._return_actor(future)
+        return api.get(future, timeout=timeout)
+
+    def get_next_unordered(self, timeout=None):
+        """Next result in completion order."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        while not self._index_to_future:
+            self._drain_one(timeout)
+        ready, _ = api.wait(
+            list(self._index_to_future.values()), num_returns=1,
+            timeout=timeout)
+        if not ready:
+            raise TimeoutError("Timed out waiting for a result")
+        future = ready[0]
+        for idx, f in list(self._index_to_future.items()):
+            if f == future:
+                del self._index_to_future[idx]
+                if idx == self._next_return_index:
+                    self._next_return_index += 1
+                break
+        self._return_actor(future)
+        return api.get(future, timeout=timeout)
+
+    def _drain_one(self, timeout):
+        raise TimeoutError("Result not yet available")
+
+    def _return_actor(self, future):
+        actor = self._future_to_actor.pop(future, None)
+        if actor is None:
+            return
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor):
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def map(self, fn: Callable, values: List[Any]):
+        """Yields results in order (reference: ActorPool.map)."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: List[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

@@ -1,0 +1,249 @@
+"""XLA-backed collective group: TPU ICI/DCN device collectives.
+
+TPU-native replacement for the reference's NCCL group
+(python/ray/util/collective/collective_group/nccl_collective_group.py:128
+NCCLGroup) and its GLOO CPU mirror: one rank per worker process, rendezvous
+through the GCS KV store (replacing the named-actor `Rendezvous` holding an
+NCCLUniqueID, nccl_collective_group.py:29-124), and a `jax.distributed`
+runtime + device mesh replacing cupy-NCCL communicators.
+
+Every op builds a global jax.Array whose leading axis is sharded across the
+group's processes and runs a tiny jitted program whose output sharding forces
+XLA to insert the collective (all-reduce, all-gather, reduce-scatter) — so on
+TPU the bytes ride ICI, and on CPU the same code path rides the
+jax.distributed gRPC transport. This is the "same test matrix against a
+host-CPU jax backend vs real ICI" pattern from SURVEY.md §4.
+
+Constraint: `jax.distributed.initialize` is once-per-process, so all groups
+in one process must span the same process set (the reference's NCCL comms
+have an analogous one-comm-per-device-set restriction).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..types import (
+    AllGatherOptions,
+    AllReduceOptions,
+    BarrierOptions,
+    BroadcastOptions,
+    RecvOptions,
+    ReduceOp,
+    ReduceOptions,
+    ReduceScatterOptions,
+    SendOptions,
+)
+from .base_collective_group import BaseGroup
+
+_KV_NS = "collective"
+_init_lock = threading.Lock()
+_distributed_state: Dict[str, object] = {}
+
+
+def _kv():
+    from ...._private import state
+    return state.current()
+
+
+def _kv_put(key: str, value: bytes):
+    _kv().gcs_request("kv_put", key=key, value=value, namespace=_KV_NS)
+
+
+def _kv_get(key: str) -> Optional[bytes]:
+    return _kv().gcs_request("kv_get", key=key, namespace=_KV_NS)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _rendezvous(group_name: str, world_size: int, rank: int,
+                timeout_s: float = 60.0) -> str:
+    """Agree on a jax.distributed coordinator address via the GCS KV
+    (reference: Rendezvous via named actor, nccl_collective_group.py:29)."""
+    key = f"{group_name}/coordinator"
+    if rank == 0:
+        addr = f"127.0.0.1:{_free_port()}"
+        _kv_put(key, addr.encode())
+        return addr
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        raw = _kv_get(key)
+        if raw:
+            return raw.decode()
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"Rendezvous for group '{group_name}' timed out after {timeout_s}s")
+
+
+def ensure_distributed(coordinator: str, world_size: int, rank: int):
+    """Initialize the jax.distributed runtime exactly once per process
+    (replaces dist.init_process_group / NCCL comm init)."""
+    with _init_lock:
+        if _distributed_state:
+            prev = _distributed_state
+            if (prev["world_size"] != world_size or prev["rank"] != rank):
+                raise RuntimeError(
+                    "jax.distributed already initialized with a different "
+                    f"topology ({prev}); one process set per process.")
+            return
+        import jax
+        if world_size > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world_size,
+                process_id=rank)
+        _distributed_state.update(
+            {"world_size": world_size, "rank": rank,
+             "coordinator": coordinator})
+
+
+class XLAGroup(BaseGroup):
+    """One collective group == one 1-D 'world' device mesh."""
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        coordinator = _rendezvous(group_name, world_size, rank)
+        ensure_distributed(coordinator, world_size, rank)
+        import jax
+        self._jax = jax
+        # One representative device per process => 'world' axis length equals
+        # the number of ranks regardless of chips-per-host.
+        per_proc: Dict[int, object] = {}
+        for d in sorted(jax.devices(), key=lambda d: (d.process_index, d.id)):
+            per_proc.setdefault(d.process_index, d)
+        if len(per_proc) != world_size:
+            raise RuntimeError(
+                f"Group '{group_name}': expected {world_size} processes, "
+                f"found {len(per_proc)} in the jax runtime.")
+        from jax.sharding import Mesh
+        self._devices = [per_proc[i] for i in sorted(per_proc)]
+        self._mesh = Mesh(np.array(self._devices), ("world",))
+        self._local_device = per_proc[jax.process_index()]
+        self._jit_cache: Dict[Tuple, object] = {}
+
+    @classmethod
+    def backend(cls) -> str:
+        return "xla"
+
+    # -- plumbing ----------------------------------------------------------
+    def _sharding(self, spec):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self._mesh, PartitionSpec(*spec))
+
+    def _global_from_local(self, tensor):
+        """Stack per-rank tensors into a (world, *shape) global array whose
+        leading axis is sharded one-slice-per-process."""
+        import jax
+        import jax.numpy as jnp
+        x = jnp.asarray(tensor)
+        local = jax.device_put(x[None], self._local_device)
+        return jax.make_array_from_single_device_arrays(
+            (self._world_size,) + x.shape,
+            self._sharding(("world",)),
+            [local])
+
+    def _jit(self, key, builder):
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = builder()
+            self._jit_cache[key] = fn
+        return fn
+
+    def _read_replicated(self, garr) -> np.ndarray:
+        return np.asarray(garr.addressable_shards[0].data)
+
+    @staticmethod
+    def _reduce_fn(op: ReduceOp):
+        import jax.numpy as jnp
+        return {ReduceOp.SUM: jnp.sum, ReduceOp.PRODUCT: jnp.prod,
+                ReduceOp.MIN: jnp.min, ReduceOp.MAX: jnp.max}[op]
+
+    # -- collectives -------------------------------------------------------
+    def allreduce(self, tensor, opts: AllReduceOptions = AllReduceOptions()):
+        """All ranks get reduce(tensor over ranks). XLA lowers the sharded
+        reduction to an AllReduce over ICI (the jit path's lax.psum
+        equivalent, reference API collective.py:258)."""
+        import jax
+        garr = self._global_from_local(tensor)
+        red = self._reduce_fn(opts.reduceOp)
+        key = ("allreduce", opts.reduceOp, garr.shape, str(garr.dtype))
+        fn = self._jit(key, lambda: jax.jit(
+            lambda g: red(g, axis=0),
+            out_shardings=self._sharding(())))
+        return self._read_replicated(fn(garr))
+
+    def allgather(self, tensor, opts: AllGatherOptions = AllGatherOptions()):
+        """Returns the stacked (world, *shape) array on every rank
+        (reference API collective.py:423)."""
+        import jax
+        garr = self._global_from_local(tensor)
+        key = ("allgather", garr.shape, str(garr.dtype))
+        fn = self._jit(key, lambda: jax.jit(
+            lambda g: g, out_shardings=self._sharding(())))
+        return self._read_replicated(fn(garr))
+
+    def reducescatter(self, tensor,
+                      opts: ReduceScatterOptions = ReduceScatterOptions()):
+        """Each rank gets its 1/world chunk of the reduced tensor
+        (reference API collective.py:472). Requires dim0 % world == 0."""
+        import jax
+        if tensor.shape[0] % self._world_size != 0:
+            raise ValueError(
+                f"reducescatter needs dim0 divisible by world size "
+                f"({tensor.shape[0]} % {self._world_size})")
+        garr = self._global_from_local(tensor)
+        red = self._reduce_fn(opts.reduceOp)
+        key = ("reducescatter", opts.reduceOp, garr.shape, str(garr.dtype))
+        fn = self._jit(key, lambda: jax.jit(
+            lambda g: red(g, axis=0),
+            out_shardings=self._sharding(("world",))))
+        out = fn(garr)
+        return np.asarray(out.addressable_shards[0].data)
+
+    def reduce(self, tensor, opts: ReduceOptions = ReduceOptions()):
+        """Reduce to root (reference collective.py:311); other ranks get
+        the reduced value too (XLA all-reduce; harmless superset)."""
+        return self.allreduce(
+            tensor, AllReduceOptions(reduceOp=opts.reduceOp))
+
+    def broadcast(self, tensor, opts: BroadcastOptions = BroadcastOptions()):
+        """src_rank's tensor to all (reference collective.py:373)."""
+        import jax.numpy as jnp
+        x = jnp.asarray(tensor)
+        mask = 1.0 if self._rank == opts.src_rank else 0.0
+        contrib = np.asarray(x) * mask
+        return self.allreduce(contrib)
+
+    def barrier(self, opts: BarrierOptions = BarrierOptions()):
+        self.allreduce(np.zeros((1,), dtype=np.float32))
+
+    def send(self, tensor, opts: SendOptions):
+        """P2P send (reference collective.py:531). Implemented as a gang op:
+        all ranks enter, dst reads the gathered slice — correct though not
+        minimal-traffic; a ppermute fast path lands with the pipeline layer."""
+        self.allgather(np.asarray(tensor))
+        return None
+
+    def recv(self, shape_dtype_or_tensor, opts: RecvOptions):
+        import numpy as _np
+        if isinstance(shape_dtype_or_tensor, tuple):
+            shape, dtype = shape_dtype_or_tensor
+            template = _np.zeros(shape, dtype=dtype)
+        else:
+            template = _np.asarray(shape_dtype_or_tensor)
+        gathered = self.allgather(template)
+        return gathered[opts.src_rank]
+
+    def destroy_group(self):
+        self._jit_cache.clear()

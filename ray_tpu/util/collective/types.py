@@ -1,0 +1,93 @@
+"""Collective types (reference: python/ray/util/collective/types.py).
+
+The reference's backends are NCCL (GPU) and GLOO (CPU host nets); the
+TPU-native backends are:
+
+* ``xla``  — device collectives compiled by XLA: on TPU they ride ICI/DCN,
+  on CPU they ride the jax.distributed gRPC transport. This replaces both
+  NCCL (device data) and GLOO (the CPU test mirror) with ONE code path, the
+  pattern SURVEY.md §4 calls out (same test matrix on CPU jax backend vs
+  real ICI).
+* ``local`` — degenerate single-process group for world_size == 1.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from datetime import timedelta
+
+
+class Backend:
+    """Backend name constants (reference: types.py:29-41 Backend enum)."""
+
+    XLA = "xla"
+    LOCAL = "local"
+    # Aliases accepted for reference compatibility; both map to xla.
+    NCCL = "xla"
+    GLOO = "xla"
+
+    def __new__(cls, name: str = "xla"):
+        name = (name or "xla").lower()
+        if name in ("xla", "nccl", "gloo", "tpu", "ici"):
+            return "xla"
+        if name == "local":
+            return "local"
+        raise ValueError(f"Unsupported collective backend: {name}")
+
+
+class ReduceOp(enum.IntEnum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+
+
+unset_timeout = timedelta(milliseconds=-1)
+
+
+@dataclass
+class AllReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout: timedelta = unset_timeout
+
+
+@dataclass
+class BarrierOptions:
+    timeout: timedelta = unset_timeout
+
+
+@dataclass
+class ReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout: timedelta = unset_timeout
+
+
+@dataclass
+class AllGatherOptions:
+    timeout: timedelta = unset_timeout
+
+
+@dataclass
+class BroadcastOptions:
+    src_rank: int = 0
+    timeout: timedelta = unset_timeout
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout: timedelta = unset_timeout
+
+
+@dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout: timedelta = unset_timeout
+
+
+@dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout: timedelta = unset_timeout

@@ -1,0 +1,120 @@
+"""Distributed Queue backed by an actor (reference:
+python/ray/util/queue.py Queue/_QueueActor)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+from .. import api
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@api.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import collections
+        self.maxsize = maxsize
+        self.items = collections.deque()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def empty(self) -> bool:
+        return not self.items
+
+    def full(self) -> bool:
+        return 0 < self.maxsize <= len(self.items)
+
+    def put(self, item) -> bool:
+        if 0 < self.maxsize <= len(self.items):
+            return False
+        self.items.append(item)
+        return True
+
+    def put_batch(self, items) -> int:
+        n = 0
+        for item in items:
+            if 0 < self.maxsize <= len(self.items):
+                break
+            self.items.append(item)
+            n += 1
+        return n
+
+    def get(self):
+        if not self.items:
+            return (False, None)
+        return (True, self.items.popleft())
+
+    def get_batch(self, n: int):
+        out = []
+        while self.items and len(out) < n:
+            out.append(self.items.popleft())
+        return out
+
+
+class Queue:
+    """Same surface as the reference's util Queue; blocking semantics are
+    implemented caller-side by polling the queue actor."""
+
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = actor_options or {}
+        self.maxsize = maxsize
+        self.actor = _QueueActor.options(**opts).remote(maxsize)
+
+    def qsize(self) -> int:
+        return api.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return api.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return api.get(self.actor.full.remote())
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if api.get(self.actor.put.remote(item)):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full()
+            time.sleep(0.01)
+
+    def put_nowait(self, item: Any):
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: List[Any]):
+        n = api.get(self.actor.put_batch.remote(items))
+        if n != len(items):
+            raise Full(f"only {n}/{len(items)} items fit")
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = api.get(self.actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty()
+            time.sleep(0.01)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        return api.get(self.actor.get_batch.remote(num_items))
+
+    def shutdown(self, force: bool = False):
+        api.kill(self.actor)

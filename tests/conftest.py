@@ -9,12 +9,21 @@ reference's pattern of CPU-only collective suites mirroring the GPU ones
 import os
 import sys
 
-# Must be set before the first jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests run against the CPU backend with 8 virtual devices (SURVEY.md §4:
+# the CPU mirror of the device suites). XLA_FLAGS must be set before the
+# first backend init; jax.config is used for platform selection because
+# some images force-register a TPU backend via sitecustomize in a way that
+# overrides the JAX_PLATFORMS env var.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["XLA_FLAGS"] = _flags
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Worker subprocesses spawned by ray_tpu set their own env; the driver-side
+# jax (this process) is pinned to cpu here:
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
