@@ -1,0 +1,143 @@
+"""Benchmark entry: prints ONE JSON line.
+
+Headline metric: single-client sync task throughput, the reference's core
+microbenchmark ("single client tasks sync", 1,013.2/s committed CI result,
+BASELINE.md / release/perf_metrics/microbenchmark.json, suite defined in
+python/ray/_private/ray_perf.py:174-189). Extras carry the wider suite:
+async task throughput, actor call rates, put/get, and — when a TPU is
+attached — flagship GPT train-step tokens/s.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_TASKS_SYNC = 1013.2  # reference microbenchmark.json
+
+
+def bench_core(extras):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=min(os.cpu_count() or 4, 16))
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    @ray_tpu.remote
+    class NopActor:
+        def nop(self):
+            return None
+
+    # warmup: spin up workers, cache functions
+    ray_tpu.get([nop.remote() for _ in range(100)])
+
+    # single client tasks sync (ray_perf.py:174 pattern)
+    n = 1000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(nop.remote())
+    sync_rate = n / (time.perf_counter() - t0)
+
+    # single client tasks async: submit all, get all (ray_perf.py:181)
+    n = 5000
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    async_rate = n / (time.perf_counter() - t0)
+
+    # 1:1 actor calls sync / async (ray_perf.py:196-232)
+    actor = NopActor.remote()
+    ray_tpu.get(actor.nop.remote())
+    n = 1000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(actor.nop.remote())
+    actor_sync = n / (time.perf_counter() - t0)
+    n = 5000
+    t0 = time.perf_counter()
+    ray_tpu.get([actor.nop.remote() for _ in range(n)])
+    actor_async = n / (time.perf_counter() - t0)
+
+    # put/get small + put gigabytes (ray_perf.py:120-146)
+    import numpy as np
+    small = np.zeros(1000, dtype=np.float64)
+    n = 1000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(ray_tpu.put(small))
+    put_get_rate = n / (time.perf_counter() - t0)
+
+    big = np.zeros((1 << 28,), dtype=np.uint8)  # 256 MB
+    t0 = time.perf_counter()
+    iters = 4
+    for _ in range(iters):
+        ref = ray_tpu.put(big)
+        del ref
+    put_gbps = iters * big.nbytes / (time.perf_counter() - t0) / 1e9
+
+    ray_tpu.shutdown()
+    extras.update({
+        "tasks_async_per_s": round(async_rate, 1),
+        "actor_calls_sync_per_s": round(actor_sync, 1),
+        "actor_calls_async_per_s": round(actor_async, 1),
+        "put_get_per_s": round(put_get_rate, 1),
+        "put_gb_per_s": round(put_gbps, 2),
+        "baseline_tasks_async_per_s": 8032.4,
+        "baseline_actor_sync_per_s": 1985.8,
+        "baseline_put_gb_per_s": 18.52,
+    })
+    return sync_rate
+
+
+def bench_tpu(extras):
+    try:
+        import jax
+        if jax.devices()[0].platform != "tpu":
+            return
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models import GPTConfig, make_train_step
+
+        cfg = GPTConfig(vocab_size=32000, d_model=512, n_heads=8,
+                        n_layers=8, d_ff=2048, max_seq_len=1024)
+        init_state, train_step = make_train_step(cfg)
+        state = init_state(jax.random.PRNGKey(0))
+        B, S = 8, 1024
+        tokens = np.random.randint(0, cfg.vocab_size, (B, S),
+                                   dtype=np.int32)
+        batch = (jnp.asarray(tokens), jnp.asarray(np.roll(tokens, -1, 1)))
+        state, _ = train_step(state, batch)  # compile
+        jax.block_until_ready(state)
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = train_step(state, batch)
+        jax.block_until_ready(state)
+        dt = (time.perf_counter() - t0) / iters
+        extras["tpu_train_tokens_per_s"] = round(B * S / dt, 1)
+        extras["tpu_train_step_ms"] = round(dt * 1e3, 2)
+        extras["tpu_model"] = "gpt-42M-bf16"
+    except Exception as e:  # TPU benches are best-effort
+        extras["tpu_error"] = f"{type(e).__name__}: {e}"
+
+
+def main():
+    extras = {}
+    sync_rate = bench_core(extras)
+    bench_tpu(extras)
+    print(json.dumps({
+        "metric": "tasks_per_second_sync",
+        "value": round(sync_rate, 1),
+        "unit": "tasks/s",
+        "vs_baseline": round(sync_rate / BASELINE_TASKS_SYNC, 3),
+        "extras": extras,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""ray_tpu.models: flagship model families, TPU-first.
+
+Pure-jax parameter pytrees with logical sharding axes (no framework
+classes): the same model runs single-chip, TP, FSDP, or SP by swapping
+partition rule tables (ray_tpu.parallel.partition)."""
+
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    gpt_forward,
+    gpt_init,
+    gpt_loss,
+    gpt_param_axes,
+    make_train_step,
+)

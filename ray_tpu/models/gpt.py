@@ -1,0 +1,221 @@
+"""Flagship model: decoder-only transformer (GPT family), TPU-first.
+
+Role in the framework: the model the reference's ML baselines fine-tune
+with external torch code (BASELINE.md GPT-2 fine-tune config) exists here
+natively — bf16 matmuls for the MXU, fp32 norms/softmax, rotary attention
+via the Pallas flash kernel, logical-axis annotations so
+parallel.partition rule tables shard it for TP/FSDP/SP without touching
+model code, and `jax.checkpoint` rematerialization on each block to trade
+FLOPs for HBM.
+
+Params are a plain dict pytree; `gpt_param_axes` returns the matching
+pytree of logical axis tuples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+from ..ops.layers import rms_norm, rope
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def gpt2_small(cls) -> "GPTConfig":
+        """GPT-2 124M-equivalent (the reference's fine-tune baseline)."""
+        return cls(vocab_size=50304, d_model=768, n_heads=12, n_layers=12,
+                   d_ff=3072, max_seq_len=1024)
+
+    @classmethod
+    def tiny(cls) -> "GPTConfig":
+        return cls(vocab_size=512, d_model=64, n_heads=4, n_layers=2,
+                   d_ff=128, max_seq_len=128)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+def _layer_init(key, cfg: GPTConfig) -> Dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    d, f = cfg.d_model, cfg.d_ff
+    scale = d ** -0.5
+    out_scale = scale / (2 * cfg.n_layers) ** 0.5
+    return {
+        "ln1": jnp.ones((d,), dtype=jnp.float32),
+        "wqkv": (jax.random.normal(k1, (d, 3 * d)) * scale
+                 ).astype(cfg.dtype),
+        "wo": (jax.random.normal(k2, (d, d)) * out_scale
+               ).astype(cfg.dtype),
+        "ln2": jnp.ones((d,), dtype=jnp.float32),
+        "w1": (jax.random.normal(k3, (d, f)) * scale).astype(cfg.dtype),
+        "w2": (jax.random.normal(k4, (f, d)) * out_scale
+               ).astype(cfg.dtype),
+    }
+
+
+def gpt_init(key, cfg: GPTConfig) -> Dict:
+    keys = jax.random.split(key, cfg.n_layers + 2)
+    params = {
+        "embed": (jax.random.normal(keys[0],
+                                    (cfg.vocab_size, cfg.d_model))
+                  * cfg.d_model ** -0.5).astype(cfg.dtype),
+        "lnf": jnp.ones((cfg.d_model,), dtype=jnp.float32),
+        "layers": [_layer_init(keys[i + 1], cfg)
+                   for i in range(cfg.n_layers)],
+    }
+    if not cfg.tie_embeddings:
+        params["head"] = (jax.random.normal(
+            keys[-1], (cfg.d_model, cfg.vocab_size))
+            * cfg.d_model ** -0.5).astype(cfg.dtype)
+    return params
+
+
+def gpt_param_axes(cfg: GPTConfig) -> Dict:
+    """Logical axis names per parameter (parallel.partition rule input)."""
+    layer = {
+        "ln1": ("embed",),
+        "wqkv": ("embed", "mlp"),   # heads concat: shard like mlp over tp
+        "wo": ("mlp", "embed"),
+        "ln2": ("embed",),
+        "w1": ("embed", "mlp"),
+        "w2": ("mlp", "embed"),
+    }
+    axes = {
+        "embed": ("vocab", "embed"),
+        "lnf": ("embed",),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+    if not cfg.tie_embeddings:
+        axes["head"] = ("embed", "vocab")
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _block(x, layer, cfg: GPTConfig):
+    b, s, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    # Attention
+    y = rms_norm(x, layer["ln1"])
+    qkv = jnp.einsum("bsd,de->bse", y, layer["wqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = rope(q.reshape(b, s, h, hd).transpose(0, 2, 1, 3))
+    k = rope(k.reshape(b, s, h, hd).transpose(0, 2, 1, 3))
+    v = v.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    attn = flash_attention(q, k, v, True, None)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+    x = x + jnp.einsum("bsd,de->bse", attn, layer["wo"])
+    # MLP (gelu; fused into the matmuls by XLA)
+    y = rms_norm(x, layer["ln2"])
+    hminner = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, layer["w1"]))
+    x = x + jnp.einsum("bsf,fd->bsd", hminner, layer["w2"])
+    return x
+
+
+def gpt_forward(params: Dict, tokens, cfg: GPTConfig):
+    """tokens [batch, seq] int32 -> logits [batch, seq, vocab] (fp32)."""
+    x = jnp.take(params["embed"], tokens, axis=0)
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(
+            functools.partial(_block, cfg=cfg),
+            policy=jax.checkpoint_policies.nothing_saveable)
+        for layer in params["layers"]:
+            x = block(x, layer)
+    else:
+        for layer in params["layers"]:
+            x = _block(x, layer, cfg)
+    x = rms_norm(x, params["lnf"])
+    head = params.get("head")
+    if head is None:
+        head = params["embed"].T
+    return jnp.einsum("bsd,dv->bsv", x, head).astype(jnp.float32)
+
+
+def gpt_loss(params: Dict, batch: Tuple, cfg: GPTConfig):
+    """Next-token cross entropy; batch = (tokens, targets) [b, s]."""
+    tokens, targets = batch
+    logits = gpt_forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# ---------------------------------------------------------------------------
+# training step
+# ---------------------------------------------------------------------------
+def make_train_step(cfg: GPTConfig, optimizer=None,
+                    donate: bool = True,
+                    mesh=None, rules=None):
+    """Build (init_state, train_step). train_step is jit-compiled; with a
+    mesh + partition rules, params/opt-state carry NamedShardings and XLA
+    inserts the dp gradient psum / tp collectives from the shardings
+    (scaling-book recipe — no explicit pmap/DDP wrapper)."""
+    import optax
+
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+
+    def init_state(key):
+        params = gpt_init(key, cfg)
+        if mesh is not None and rules is not None:
+            params = shard_params(params, cfg, mesh, rules)
+        opt_state = optimizer.init(params)
+        return {"params": params, "opt_state": opt_state,
+                "step": jnp.zeros((), dtype=jnp.int32)}
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(gpt_loss)(
+            state["params"], batch, cfg)
+        updates, new_opt = optimizer.update(
+            grads, state["opt_state"], state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"params": new_params, "opt_state": new_opt,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    donate_argnums = (0,) if donate else ()
+    return init_state, jax.jit(train_step, donate_argnums=donate_argnums)
+
+
+def shard_params(params: Dict, cfg: GPTConfig, mesh, rules):
+    """Place a param pytree onto a mesh per the logical-axis rule table."""
+    from jax.sharding import NamedSharding
+
+    axes = gpt_param_axes(cfg)
+    leaves, treedef = jax.tree.flatten(params)
+    # Axis tuples are themselves pytrees, so flatten the axes tree only
+    # down to the params tree's structure.
+    axes_leaves = treedef.flatten_up_to(axes)
+    placed = [
+        jax.device_put(p, NamedSharding(mesh, rules.spec(ax)))
+        for p, ax in zip(leaves, axes_leaves)
+    ]
+    return jax.tree.unflatten(treedef, placed)
+
+
+def shard_batch(batch, mesh, axis: str = "dp"):
+    from jax.sharding import NamedSharding, PartitionSpec
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

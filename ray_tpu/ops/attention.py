@@ -1,0 +1,175 @@
+"""Causal multi-head attention: Pallas TPU flash kernel + jax reference.
+
+Net-new vs the reference codebase (SURVEY.md §2.4: no attention kernels
+in-tree — torch users bring their own): a blockwise online-softmax
+(flash) attention kernel written for the TPU memory hierarchy — Q tiles
+stream through VMEM, K/V per (batch, head) resident in VMEM, accumulation
+in fp32 — with a jax reference used on non-TPU backends and as the custom
+VJP backward (rematerialized), trading FLOPs for HBM traffic exactly where
+the MXU is idle anyway.
+
+Layout: [batch, heads, seq, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (CPU tests, autodiff backward)
+# ---------------------------------------------------------------------------
+def mha_reference(q, k, v, causal: bool = True,
+                  sm_scale: Optional[float] = None):
+    """Plain XLA attention; numerically the ground truth for the kernel."""
+    *_, seq_q, head_dim = q.shape
+    seq_k = k.shape[-2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(head_dim)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k,
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(
+            jnp.ones((seq_q, seq_k), dtype=bool), k=seq_k - seq_q)
+        logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+                  causal: bool, block_q: int, block_k: int, seq_len: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (block_q, d)
+    head_dim = q.shape[-1]
+
+    num_kv_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        # Only blocks at or left of the diagonal contribute.
+        num_kv_blocks = jnp.minimum(
+            num_kv_blocks, (qi + 1) * block_q // block_k
+            + (1 if (block_q % block_k) else 0))
+
+    def body(kb, carry):
+        acc, m_i, l_i = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, bk)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_i - m_new)
+        l_new = alpha * l_i + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, head_dim), dtype=jnp.float32)
+    m0 = jnp.full((block_q,), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+    acc, m_f, l_f = jax.lax.fori_loop(0, num_kv_blocks, body,
+                                      (acc0, m0, l0))
+    o_ref[0] = (acc / l_f[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, sm_scale: float,
+                   block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, heads, seq_len, head_dim = q.shape
+    bh = batch * heads
+    qf = q.reshape(bh, seq_len, head_dim)
+    kf = k.reshape(bh, seq_len, head_dim)
+    vf = v.reshape(bh, seq_len, head_dim)
+
+    block_q = min(block_q, seq_len)
+    block_k = min(block_k, seq_len)
+    grid = (bh, pl.cdiv(seq_len, block_q))
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=seq_len)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim),
+                         lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, seq_len, head_dim),
+                         lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, seq_len, head_dim),
+                         lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim),
+                               lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+    )(qf, kf, vf)
+    return out.reshape(batch, heads, seq_len, head_dim)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None):
+    """Flash attention: Pallas kernel on TPU, reference elsewhere.
+
+    Differentiable: the VJP recomputes attention with the reference
+    implementation (rematerialization — SURVEY.md hard-part #5 tradeoff:
+    extra FLOPs instead of storing the (seq, seq) probability matrix).
+    """
+    return _flash_attention_impl(q, k, v, causal, sm_scale)
+
+
+def _flash_attention_impl(q, k, v, causal, sm_scale):
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    seq_len = q.shape[-2]
+    if _on_tpu() and seq_len >= 128 and seq_len % 128 == 0:
+        return _flash_forward(q, k, v, causal, scale,
+                              block_q=128, block_k=128)
+    return mha_reference(q, k, v, causal, scale)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale):
+    out = _flash_attention_impl(q, k, v, causal, sm_scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal, sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,46 @@
+"""Elementwise / normalization layers used by the model stack.
+
+These stay as plain jax ops on purpose: XLA fuses them into surrounding
+matmuls (HBM-bandwidth guidance — don't hand-schedule what the compiler
+already fuses); Pallas is reserved for ops XLA can't fuse (attention).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """RMSNorm; computed in fp32, cast back to input dtype."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_angles(seq_len: int, head_dim: int, base: float = 10000.0):
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    inv_freq = 1.0 / (base ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = pos[:, None] * inv_freq[None, :]        # (seq, d/2)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def rope(x, position_offset: int = 0, base: float = 10000.0):
+    """Rotary position embedding for [batch, heads, seq, head_dim]."""
+    *_, seq_len, head_dim = x.shape
+    cos, sin = _rope_angles(seq_len + position_offset, head_dim, base)
+    cos = cos[position_offset:][None, None, :, :]
+    sin = sin[position_offset:][None, None, :, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def swiglu(x, w_gate, w_up, w_down):
+    """SwiGLU MLP: down( silu(x@gate) * (x@up) )."""
+    gate = jax.nn.silu(jnp.einsum("...d,df->...f", x, w_gate))
+    up = jnp.einsum("...d,df->...f", x, w_up)
+    return jnp.einsum("...f,fd->...d", gate * up, w_down)
