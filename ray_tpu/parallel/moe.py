@@ -1,0 +1,125 @@
+"""Mixture-of-Experts with expert parallelism over the `ep` mesh axis.
+
+Net-new vs the reference (SURVEY.md §2.4: EP "Absent"): top-k token
+routing with capacity-bounded dense dispatch — einsum-based combine/
+dispatch (compiler-friendly, no dynamic shapes) and `lax.all_to_all`
+shuffles across the expert axis when experts are sharded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top2_gating(logits, capacity: int):
+    """Top-2 gating with capacity dropping (Switch/GShard style).
+
+    logits: [tokens, experts]. Returns (dispatch [T, E, C] bool-ish,
+    combine [T, E, C] float, aux_loss scalar).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    def one_route(p, mask_prev, offset):
+        idx = jnp.argmax(jnp.where(mask_prev, -jnp.inf, p), axis=-1)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        # 1-based position of each token within its expert's queue,
+        # continuing after `offset` slots already taken by earlier routes
+        # (GShard: second-choice positions start after all first choices).
+        pos = (jnp.cumsum(onehot, axis=0) + offset[None, :]) * onehot
+        keep = (pos > 0) & (pos <= capacity)
+        pos0 = jnp.clip(pos - 1, 0, capacity - 1).astype(jnp.int32)
+        return idx, onehot, keep, pos0
+
+    zero_off = jnp.zeros((e,), dtype=jnp.float32)
+    idx1, oh1, keep1, pos1 = one_route(
+        probs, jnp.zeros_like(probs, dtype=bool), zero_off)
+    mask1 = oh1.astype(bool)
+    count1 = jnp.sum(oh1, axis=0)
+    idx2, oh2, keep2, pos2 = one_route(probs, mask1, count1)
+
+    g1 = jnp.sum(probs * oh1, axis=-1)
+    g2 = jnp.sum(probs * oh2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    cap_oh = functools.partial(jax.nn.one_hot, num_classes=capacity,
+                               dtype=jnp.float32)
+    # [T, E, C] dispatch/combine tensors
+    d1 = oh1[:, :, None] * cap_oh(jnp.sum(pos1 * oh1.astype(jnp.int32),
+                                          axis=-1))[:, None, :]
+    d2 = oh2[:, :, None] * cap_oh(jnp.sum(pos2 * oh2.astype(jnp.int32),
+                                          axis=-1))[:, None, :]
+    keep1f = jnp.sum(keep1 * oh1.astype(bool), axis=-1,
+                     keepdims=True)[:, :, None]
+    keep2f = jnp.sum(keep2 * oh2.astype(bool), axis=-1,
+                     keepdims=True)[:, :, None]
+    combine = (d1 * g1[:, None, None] * keep1f
+               + d2 * g2[:, None, None] * keep2f)
+    dispatch = combine > 0
+    # load-balancing aux loss (GShard eq. 4)
+    density = jnp.mean(oh1, axis=0)
+    density_probs = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_probs) * (e ** 2) / e
+    return dispatch, combine, aux
+
+
+def moe_layer(x, gate_w, expert_w1, expert_w2,
+              capacity_factor: float = 1.25,
+              axis_name: Optional[str] = None):
+    """Top-2 MoE FFN. x: [tokens, d]; gate_w: [d, E];
+    expert_w1: [E, d, f]; expert_w2: [E, f, d].
+
+    With `axis_name`, call INSIDE shard_map with expert tensors sharded on
+    the expert axis: tokens are all_to_all'ed to their experts' shards and
+    back (the `ragged_all_to_all`-style dispatch, SURVEY.md §2.4 EP row).
+    Without, experts compute locally (einsum over E).
+    """
+    t, d = x.shape
+    e = gate_w.shape[-1]
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+
+    if axis_name is None:
+        capacity = max(1, int(capacity_factor * t * 2 / e))
+        dispatch, combine, aux = top2_gating(logits, capacity)
+        # [E, C, d] expert inputs
+        xe = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
+                        dispatch.astype(jnp.float32))
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe,
+                                   expert_w1.astype(jnp.float32)))
+        ye = jnp.einsum("ecf,efd->ecd", h, expert_w2.astype(jnp.float32))
+        y = jnp.einsum("ecd,tec->td", ye, combine)
+        return y.astype(x.dtype), aux
+
+    # Expert-parallel path: this shard owns e_local = E / n experts and a
+    # token shard; tokens travel to their experts' shards and back.
+    n = lax.axis_size(axis_name)
+    e_local = expert_w1.shape[0]
+    capacity = max(1, int(capacity_factor * t * 2 / e))
+    dispatch, combine, aux = top2_gating(logits, capacity)
+    # Per-expert input buffers built from MY tokens: [E, C, d], grouped by
+    # destination shard -> [n_dest, e_local, C, d].
+    xe = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
+                    dispatch.astype(jnp.float32))
+    xe = xe.reshape(n, e_local, capacity, d)
+    # all_to_all: recv[src, i] = tokens from shard `src` for my expert i.
+    recv = lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=0)
+    # Fold sources into the capacity axis: [e_local, n*C, d].
+    xin = recv.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, d)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin,
+                               expert_w1.astype(jnp.float32)))
+    ye = jnp.einsum("ecf,efd->ecd", h, expert_w2.astype(jnp.float32))
+    # Route outputs back to their source shards.
+    back = ye.reshape(e_local, n, capacity, d).transpose(1, 0, 2, 3)
+    got = lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0)
+    # got[j, i] = my tokens' outputs from expert (j * e_local + i):
+    # reassemble the global expert axis in that order -> [E, C, d].
+    ye_all = got.reshape(e, capacity, d)
+    y = jnp.einsum("ecd,tec->td", ye_all, combine)
+    aux = lax.pmean(aux, axis_name)
+    return y.astype(x.dtype), aux

@@ -13,6 +13,18 @@ from typing import Optional, Sequence, Union
 AxisName = Union[str, Sequence[str]]
 
 
+def pvary(tree, axis_name):
+    """Mark values as device-varying over `axis_name` for shard_map's
+    varying-manual-axes type system (no-op on jax versions without it).
+    Needed on scan/fori_loop carries initialized from constants."""
+    import jax
+    from jax import lax
+    try:
+        return jax.tree.map(lambda x: lax.pvary(x, (axis_name,)), tree)
+    except AttributeError:
+        return tree
+
+
 def allreduce(x, axis_name: AxisName = "dp"):
     """Sum across an axis (lax.psum == NCCL allreduce over ICI)."""
     from jax import lax

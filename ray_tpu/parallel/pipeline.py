@@ -1,0 +1,100 @@
+"""Pipeline parallelism over the `pp` mesh axis.
+
+Net-new vs the reference (SURVEY.md §2.4: PP "Not in-tree", built by users
+from ADAG multi-actor pipelines): here a pipeline is a compiled SPMD
+program — stage parameters are sharded over `pp`, microbatches flow
+stage-to-stage via `lax.ppermute`, and the whole GPipe schedule is a
+`lax.scan` inside one jit (the XLA analogue of a CompiledDAG of actors,
+dag/compiled_dag_node.py:767, with ICI hops instead of NCCL p2p channels).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x,
+                   axis_name: str = "pp", n_microbatches: int = None):
+    """Run a GPipe pipeline; call INSIDE shard_map over `axis_name`.
+
+    stage_fn(params, activations) -> activations, applied by every rank to
+    its own stage. `x`: this rank's microbatch stack
+    [n_micro_local, ...batch...] — the global batch is split over
+    microbatches, each microbatch visits every stage in ring order.
+
+    Schedule: n_micro + n_stages - 1 ticks. At tick t, stage s processes
+    microbatch (t - s) when 0 <= t - s < n_micro. Activations hop
+    stage->stage+1 between ticks via ppermute; outputs complete at the
+    last stage and are rotated back to stage 0's slot for collection.
+    """
+    n_stages = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    n_micro = x.shape[0]
+    total_ticks = n_micro + n_stages - 1
+
+    from .ops import pvary
+    state = jnp.zeros_like(x[0])          # current activation on this rank
+    outputs = jnp.zeros_like(x)           # completed microbatches
+    state, outputs = pvary((state, outputs), axis_name)
+
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 ingests microbatch t (if any remain); other stages use
+        # the activation that just hopped in.
+        feed = x[jnp.minimum(t, n_micro - 1)]
+        state = jnp.where(rank == 0,
+                          jnp.where(t < n_micro, feed, state), state)
+        mb_idx = t - rank                 # microbatch this stage holds
+        active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
+        new_state = stage_fn(stage_params, state)
+        state = jnp.where(active, new_state, state)
+        # Last stage completes microbatch mb_idx.
+        is_done = jnp.logical_and(active, rank == n_stages - 1)
+        outputs = jnp.where(
+            is_done,
+            lax.dynamic_update_index_in_dim(
+                outputs, state, jnp.maximum(mb_idx, 0), 0),
+            outputs)
+        # Hop activations forward around the ring.
+        state = lax.ppermute(state, axis_name, fwd_perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(
+        tick, (state, outputs), jnp.arange(total_ticks))
+    # Completed outputs live on the last stage; broadcast to all ranks so
+    # the caller sees replicated results (psum over one-hot contribution).
+    contrib = jnp.where(rank == n_stages - 1, outputs,
+                        jnp.zeros_like(outputs))
+    return lax.psum(contrib, axis_name)
+
+
+def make_pipelined_fn(mesh, stage_fn: Callable, n_microbatches: int,
+                      axis_name: str = "pp",
+                      params_spec=None, x_spec=None):
+    """shard_map + jit wrapper: stage_params stacked on axis 0 (one slice
+    per stage, sharded over `pp`); x global [n_micro * mb_size, ...]."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    params_spec = params_spec if params_spec is not None else P(axis_name)
+    x_spec = x_spec if x_spec is not None else P()
+
+    def local_fn(stage_params, x):
+        # stage_params arrive with a leading stage axis of length 1.
+        own = jax.tree.map(lambda p: p[0], stage_params)
+        xm = x.reshape((n_microbatches, -1) + x.shape[1:])
+        out = pipeline_apply(
+            lambda pr, a: stage_fn(pr, a), own, xm, axis_name)
+        return out.reshape((-1,) + out.shape[2:])
+
+    fn = shard_map(local_fn, mesh=mesh,
+                   in_specs=(params_spec, x_spec),
+                   out_specs=x_spec)
+    return jax.jit(fn)

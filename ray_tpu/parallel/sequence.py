@@ -1,0 +1,139 @@
+"""Sequence/context parallelism: ring attention over an ICI ring.
+
+Net-new vs the reference (SURVEY.md §2.4: SP/CP "Absent — must be built
+natively"): causal ring attention — each device holds a sequence shard of
+Q/K/V; K/V blocks rotate around the `sp` mesh axis via `lax.ppermute`
+while each device accumulates blockwise attention with a running online
+softmax, so peak memory is O(S_local²) and the KV transfers overlap with
+block compute on the ICI ring. Ulysses-style all-to-all head/sequence
+re-sharding is provided as the alternative strategy.
+
+Use inside shard_map (see `sequence_parallel_attention` for the wrapper).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import DEFAULT_MASK_VALUE
+
+
+from .ops import pvary as _pvary
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One blockwise attention contribution with stable statistics.
+
+    Returns (unnormalized_out fp32, row_max fp32, row_sumexp fp32).
+    q: [B,H,Sq,D], k/v: [B,H,Sk,D], mask broadcastable [Sq,Sk] bool.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    m = jnp.max(s, axis=-1)                          # [B,H,Sq]
+    # Fully-masked rows: keep exp() finite.
+    m_safe = jnp.maximum(m, DEFAULT_MASK_VALUE / 2)
+    p = jnp.exp(s - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v
+                     ).astype(jnp.float32)
+    return out, m_safe, l
+
+
+def ring_attention(q, k, v, axis_name: str = "sp",
+                   sm_scale: Optional[float] = None):
+    """Causal ring attention; call INSIDE shard_map over `axis_name`.
+
+    q/k/v: local sequence shards [B, H, S_local, D]; global sequence is the
+    concatenation over the axis in rank order. Returns [B, H, S_local, D].
+    """
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s_local = q.shape[-2]
+
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
+    tri_mask = qpos >= kpos                 # within-shard causal
+    full_mask = jnp.ones((s_local, s_local), dtype=bool)
+    zero_mask = jnp.zeros((s_local, s_local), dtype=bool)
+
+    # Rotate K/V around the ring: after t steps, we hold the block that
+    # originated at rank (rank - t) mod n.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(t, carry):
+        kt, vt, acc, m, l = carry
+        src = (rank - t) % n
+        # src < rank: fully visible. src == rank: causal. src > rank: none.
+        mask = jnp.where(src < rank, full_mask,
+                         jnp.where(src == rank, tri_mask, zero_mask))
+        out_b, m_b, l_b = _block_attn(q, kt, vt, scale, mask)
+        # online softmax merge
+        m_new = jnp.maximum(m, m_b)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_b - m_new)
+        acc = acc * alpha[..., None] + out_b * beta[..., None]
+        l = l * alpha + l_b * beta
+        kt = lax.ppermute(kt, axis_name, perm)
+        vt = lax.ppermute(vt, axis_name, perm)
+        return kt, vt, acc, m_new, l
+
+    b, h, _, d = q.shape
+    acc0 = jnp.zeros((b, h, s_local, d), dtype=jnp.float32)
+    m0 = jnp.full((b, h, s_local), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), dtype=jnp.float32)
+    # Inside shard_map, loop carries must carry the device-varying type
+    # from the start (the body mixes them with per-shard data).
+    acc0, m0, l0 = _pvary((acc0, m0, l0), axis_name)
+    _, _, acc, m, l = lax.fori_loop(
+        0, n, body, (k, v, acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def sequence_parallel_attention(mesh, q, k, v, axis_name: str = "sp",
+                                sm_scale: Optional[float] = None):
+    """shard_map wrapper: q/k/v are global [B, H, S, D] arrays (sharded or
+    not); the sequence axis is split over `axis_name` and ring attention
+    runs on the shards."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          sm_scale=sm_scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp",
+                      sm_scale: Optional[float] = None,
+                      attn_fn=None):
+    """Ulysses/DeepSpeed-style sequence parallelism; call INSIDE shard_map.
+
+    all_to_all swaps the sharded axis from sequence to heads, computes full
+    (local) attention per head group, and swaps back. Requires
+    n_heads % axis_size == 0. q/k/v: [B, H, S_local, D].
+    """
+    from ..ops.attention import mha_reference
+
+    attn = attn_fn or mha_reference
+    # [B, H, S/n, D] -> [B, H/n, S, D]
+    q = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                       tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                       tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                       tiled=True)
+    out = attn(q, k, v, True, sm_scale)
+    # back: [B, H/n, S, D] -> [B, H, S/n, D]
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
