@@ -1,0 +1,52 @@
+"""ray_tpu.train: distributed training orchestration (Train-equivalent).
+
+Reference parity (SURVEY.md §2.5 Ray Train): DataParallelTrainer contract
+(`train_loop_per_worker`, ScalingConfig, report/get_checkpoint), backend
+hooks, directory checkpoints, failure-retry controller. The device
+boundary is jax.distributed + mesh sharding instead of torch DDP.
+
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def train_loop(config):
+        ...
+        train.report({"loss": loss}, checkpoint=ckpt)
+
+    result = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=4, use_tpu=True),
+    ).fit()
+"""
+
+from .backend import BackendConfig, JaxBackendConfig, TorchBackendConfig
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from .trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+    TorchTrainer,
+)
+
+__all__ = [
+    "BackendConfig", "BaseTrainer", "Checkpoint", "CheckpointConfig",
+    "CheckpointManager", "DataParallelTrainer", "FailureConfig",
+    "JaxBackendConfig", "JaxTrainer", "Result", "RunConfig",
+    "ScalingConfig", "TorchBackendConfig", "TorchTrainer",
+    "get_checkpoint", "get_context", "get_dataset_shard",
+    "get_world_rank", "get_world_size", "report",
+]

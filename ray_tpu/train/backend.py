@@ -1,0 +1,92 @@
+"""Training backends: per-framework worker-group setup hooks.
+
+Reference parity: train/_internal/backend_executor.py Backend hooks —
+`_TorchBackend.on_start` runs dist.init_process_group (torch/config.py:156),
+the TF backend writes TF_CONFIG, the torch-XLA backend sets XLA env vars
+(torch/xla/config.py:20,120). The TPU-native `JaxBackend.on_start` replaces
+all of that with the jax.distributed runtime + (optionally) a device mesh:
+the DEVICE-COLLECTIVE BOUNDARY of SURVEY.md §3.4 becomes mesh construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class BackendConfig:
+    """Base backend config (reference: train/backend.py BackendConfig)."""
+
+    def backend_name(self) -> str:
+        return "noop"
+
+    def on_start(self, context) -> None:
+        """Runs INSIDE each training worker before the train loop."""
+
+    def on_shutdown(self, context) -> None:
+        pass
+
+
+@dataclass
+class JaxBackendConfig(BackendConfig):
+    """Brings up the jax distributed runtime across the worker group
+    (replacing `dist.init_process_group(nccl|gloo)`, torch/config.py:115).
+
+    After on_start, `jax.devices()` inside every worker spans the whole
+    group: each worker contributes its visible TPU chips (or one CPU
+    device on test backends) and data-parallel training proceeds by mesh
+    sharding, not gradient hooks.
+    """
+
+    coordinator_port: Optional[int] = None
+    group_name: str = "train"
+    init_distributed: bool = True
+
+    def backend_name(self) -> str:
+        return "jax"
+
+    def on_start(self, context) -> None:
+        if not self.init_distributed or context.world_size <= 1:
+            return
+        from ..util.collective.collective_group.xla_collective_group import (
+            _rendezvous,
+            ensure_distributed,
+        )
+        group = f"{self.group_name}/{context.experiment_name}"
+        coordinator = _rendezvous(group, context.world_size,
+                                  context.world_rank)
+        ensure_distributed(coordinator, context.world_size,
+                           context.world_rank)
+
+
+@dataclass
+class TorchBackendConfig(BackendConfig):
+    """torch.distributed process group over gloo for CPU-side torch code
+    (reference: train/torch/config.py TorchConfig). Kept for users moving
+    host-side torch data pipelines; device math belongs to jax."""
+
+    backend: str = "gloo"
+    init_method: str = "tcp"
+
+    def backend_name(self) -> str:
+        return "torch"
+
+    def on_start(self, context) -> None:
+        if context.world_size <= 1:
+            return
+        import torch.distributed as dist
+
+        if dist.is_initialized():
+            return
+        from ..util.collective.collective_group.xla_collective_group import (
+            _rendezvous,
+        )
+        addr = _rendezvous(f"torch/{context.experiment_name}",
+                           context.world_size, context.world_rank)
+        host, port = addr.rsplit(":", 1)
+        dist.init_process_group(
+            backend=self.backend,
+            init_method=f"tcp://{host}:{port}",
+            world_size=context.world_size,
+            rank=context.world_rank)

@@ -1,0 +1,65 @@
+"""Train/AIR config objects (reference: python/ray/air/config.py —
+ScalingConfig, RunConfig, FailureConfig :397, CheckpointConfig)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers and what each reserves (reference:
+    air/config.py ScalingConfig; `use_tpu` replaces `use_gpu`, and
+    `topology` names a pod-slice shape for gang placement)."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None        # e.g. "v5e-8" (slice gang hint)
+
+    # reference-compat alias
+    use_gpu: bool = False
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """(reference: air/config.py:397 FailureConfig.max_failures)"""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """(reference: air/config.py CheckpointConfig)"""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    """(reference: air/config.py RunConfig)"""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        return base

@@ -1,0 +1,255 @@
+"""Trainers: the `.fit()` surface.
+
+Reference parity: train/base_trainer.py:649 BaseTrainer.fit +
+train/data_parallel_trainer.py:429 DataParallelTrainer.training_loop +
+the controller state machine of train v2
+(v2/_internal/execution/controller/controller.py:91), collapsed into a
+polling loop with failure-retry: create worker gang -> run loop ->
+aggregate reports/checkpoints -> on worker failure, restart the gang from
+the latest checkpoint up to FailureConfig.max_failures.
+
+`JaxTrainer` is the TPU-native analogue of TorchTrainer: its backend hook
+builds the jax.distributed runtime instead of a torch process group.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..exceptions import ActorDiedError, RayError, TaskError
+from .backend import BackendConfig, JaxBackendConfig
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import RunConfig, ScalingConfig
+from .session import TrainContext
+from .worker_group import WorkerGroup
+
+
+@dataclass
+class Result:
+    """(reference: python/ray/air/result.py Result)"""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[BaseException] = None
+    metrics_dataframe: Optional[Any] = None
+
+    @property
+    def best_checkpoints(self):
+        return [(self.checkpoint, self.metrics)] if self.checkpoint else []
+
+
+class BaseTrainer:
+    """(reference: train/base_trainer.py BaseTrainer)"""
+
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self) -> Callable:
+        """Wrap for the Tune controller (reference: base_trainer.py:901):
+        returns a function trainable running this trainer's loop with
+        per-trial config merged in."""
+        trainer = self
+
+        def _trainable(config: Dict):
+            import copy
+            t = copy.copy(trainer)
+            merged = dict(getattr(trainer, "train_loop_config", None) or {})
+            merged.update(config or {})
+            t.train_loop_config = merged
+            result = t.fit()
+            if result.error is not None:
+                raise result.error
+            return result.metrics
+
+        _trainable.__name__ = type(self).__name__
+        return _trainable
+
+
+class DataParallelTrainer(BaseTrainer):
+    """(reference: train/data_parallel_trainer.py DataParallelTrainer)
+
+    Runs `train_loop_per_worker` on `scaling_config.num_workers` actor
+    processes; the backend hook wires the device runtime; reports and
+    checkpoints flow back to the controller.
+    """
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint,
+                         datasets=datasets)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or BackendConfig()
+
+    # ------------------------------------------------------------------
+    def _experiment_paths(self):
+        name = self.run_config.name or \
+            f"{type(self).__name__}_{time.strftime('%Y%m%d_%H%M%S')}"
+        exp_dir = os.path.join(self.run_config.resolved_storage_path(),
+                               name)
+        os.makedirs(exp_dir, exist_ok=True)
+        return name, exp_dir
+
+    def _split_datasets(self, num_workers: int
+                        ) -> Optional[List[Dict[str, Any]]]:
+        """Shard datasets across workers (reference:
+        train/_internal/data_config.py DataConfig.configure)."""
+        if not self.datasets:
+            return None
+        shards: List[Dict[str, Any]] = [dict() for _ in range(num_workers)]
+        for key, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                try:
+                    splits = ds.streaming_split(num_workers)
+                except Exception:
+                    splits = [ds] * num_workers
+                for i in range(num_workers):
+                    shards[i][key] = splits[i]
+            elif isinstance(ds, (list, tuple)):
+                for i in range(num_workers):
+                    shards[i][key] = list(ds[i::num_workers])
+            else:
+                for i in range(num_workers):
+                    shards[i][key] = ds
+        return shards
+
+    def fit(self) -> Result:
+        if not api.is_initialized():
+            api.init(ignore_reinit_error=True)
+        name, exp_dir = self._experiment_paths()
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            os.path.join(exp_dir, "checkpoints"),
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order)
+        max_failures = self.run_config.failure_config.max_failures
+        restore = self.resume_from_checkpoint
+        last_metrics: Dict[str, Any] = {}
+        attempt = 0
+        error: Optional[BaseException] = None
+
+        while True:
+            group = WorkerGroup(self.scaling_config.num_workers,
+                                self.scaling_config.worker_resources())
+            try:
+                uid = uuid.uuid4().hex[:8]
+
+                def make_context(rank: int) -> TrainContext:
+                    return TrainContext(
+                        world_size=self.scaling_config.num_workers,
+                        world_rank=rank, local_rank=rank,
+                        trial_name=name,
+                        experiment_name=f"{name}_{uid}",
+                        storage_path=exp_dir)
+
+                group.setup(make_context, self.backend_config,
+                            restore or manager.latest,
+                            self._split_datasets(group.num_workers))
+                run_refs = group.run(self.train_loop_per_worker,
+                                     self.train_loop_config)
+                last_metrics, error = self._poll_until_done(
+                    group, run_refs, manager, last_metrics)
+            except (ActorDiedError, TaskError, RayError) as e:
+                error = e
+            finally:
+                group.shutdown()
+            if error is None:
+                break
+            attempt += 1
+            if attempt > max_failures:
+                break
+            # Elastic restart from the latest checkpoint (reference:
+            # train v2 failure_handling + controller state machine).
+            restore = manager.latest
+            error = None
+
+        return Result(metrics=last_metrics,
+                      checkpoint=manager.latest, path=exp_dir,
+                      error=error)
+
+    def _poll_until_done(self, group: WorkerGroup, run_refs,
+                         manager: CheckpointManager,
+                         last_metrics: Dict[str, Any]):
+        pending = list(run_refs)
+        error: Optional[BaseException] = None
+        while pending and error is None:
+            ready, pending = api.wait(pending, num_returns=1, timeout=0.2)
+            self._drain_reports(group, manager, last_metrics)
+            for ref in ready:
+                try:
+                    api.get(ref)
+                except BaseException as e:  # noqa: BLE001
+                    error = e
+                    break
+        # final drain
+        try:
+            self._drain_reports(group, manager, last_metrics)
+        except Exception:
+            pass
+        return last_metrics, error
+
+    def _drain_reports(self, group: WorkerGroup,
+                       manager: CheckpointManager,
+                       last_metrics: Dict[str, Any]):
+        all_reports = group.poll_all(timeout=30.0)
+        for rank, reports in enumerate(all_reports):
+            for rep in reports:
+                ckpt = rep.get("checkpoint")
+                if ckpt is not None and rank == 0:
+                    managed = self._adopt_checkpoint(manager, ckpt)
+                    manager.register(managed, rep["metrics"])
+                if rank == 0:
+                    last_metrics.update(rep["metrics"])
+
+    @staticmethod
+    def _adopt_checkpoint(manager: CheckpointManager,
+                          ckpt: Checkpoint) -> Checkpoint:
+        if os.path.commonpath(
+                [manager.storage_path,
+                 os.path.abspath(ckpt.path)]) == manager.storage_path:
+            return ckpt
+        dst = manager.next_checkpoint_path()
+        shutil.copytree(ckpt.path, dst, dirs_exist_ok=True)
+        shutil.rmtree(ckpt.path, ignore_errors=True)
+        return Checkpoint(dst)
+
+
+class JaxTrainer(DataParallelTrainer):
+    """TPU-native TorchTrainer analogue (reference: train/torch/
+    torch_trainer.py surface; backend = jax.distributed + mesh)."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 jax_config: Optional[JaxBackendConfig] = None,
+                 **kwargs):
+        kwargs.pop("backend_config", None)
+        super().__init__(train_loop_per_worker,
+                         backend_config=jax_config or JaxBackendConfig(),
+                         **kwargs)
+
+
+# Reference-compat alias: TorchTrainer users port by renaming.
+TorchTrainer = JaxTrainer

@@ -1,0 +1,196 @@
+"""Train-equivalent tests (reference strategy: train/tests run WorkerGroup
+on plain CPU actors — SURVEY.md §4 library-specific fakes)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    DataParallelTrainer,
+    FailureConfig,
+    JaxBackendConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+class TestCheckpoint:
+    def test_state_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        state = {"w": jnp.arange(6).reshape(2, 3),
+                 "nested": {"b": jnp.ones(4)}, "step": jnp.int32(7)}
+        ckpt = Checkpoint.from_state(state, str(tmp_path / "ck"))
+        restored = ckpt.to_state()
+        np.testing.assert_array_equal(restored["w"], np.arange(6).reshape(2, 3))
+        np.testing.assert_array_equal(restored["nested"]["b"], np.ones(4))
+        assert int(restored["step"]) == 7
+
+    def test_manager_keep_n(self, tmp_path):
+        from ray_tpu.train import CheckpointManager
+        mgr = CheckpointManager(str(tmp_path), num_to_keep=2)
+        for i in range(4):
+            p = mgr.next_checkpoint_path()
+            os.makedirs(p)
+            open(os.path.join(p, "data"), "w").write(str(i))
+            mgr.register(Checkpoint(p), {"i": i})
+        assert len(mgr.all()) == 2
+        assert mgr.latest is not None
+
+    def test_manager_best_by_score(self, tmp_path):
+        from ray_tpu.train import CheckpointManager
+        mgr = CheckpointManager(str(tmp_path), num_to_keep=None,
+                                score_attribute="acc")
+        for acc in [0.1, 0.9, 0.5]:
+            p = mgr.next_checkpoint_path()
+            os.makedirs(p)
+            mgr.register(Checkpoint(p), {"acc": acc})
+        assert mgr.best is not None
+        best_metrics = [m for c, m in mgr.all() if c.path == mgr.best.path]
+        assert best_metrics[0]["acc"] == 0.9
+
+
+class TestDataParallelTrainer:
+    def test_basic_fit(self, ray_start_shared, tmp_path):
+        def loop(config):
+            for i in range(3):
+                train.report({"loss": 10.0 - i, "iter": i})
+
+        result = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="basic",
+                                 storage_path=str(tmp_path)),
+        ).fit()
+        assert result.error is None
+        assert result.metrics["iter"] == 2
+
+    def test_context(self, ray_start_shared, tmp_path):
+        def loop(config):
+            ctx = train.get_context()
+            train.report({"rank": ctx.world_rank,
+                          "ws": ctx.world_size})
+
+        result = DataParallelTrainer(
+            loop, scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="ctx", storage_path=str(tmp_path)),
+        ).fit()
+        assert result.error is None
+        assert result.metrics["ws"] == 2
+        assert result.metrics["rank"] == 0  # metrics come from rank 0
+
+    def test_train_loop_config(self, ray_start_shared, tmp_path):
+        def loop(config):
+            train.report({"lr": config["lr"]})
+
+        result = DataParallelTrainer(
+            loop, train_loop_config={"lr": 0.125},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="cfg", storage_path=str(tmp_path)),
+        ).fit()
+        assert result.metrics["lr"] == 0.125
+
+    def test_checkpoint_flow(self, ray_start_shared, tmp_path):
+        def loop(config):
+            import tempfile
+
+            import jax.numpy as jnp
+            ctx = train.get_context()
+            start = 0
+            ckpt = train.get_checkpoint()
+            if ckpt is not None:
+                start = int(ckpt.to_state()["step"])
+            for step in range(start, start + 2):
+                if ctx.world_rank == 0:
+                    d = tempfile.mkdtemp()
+                    c = Checkpoint.from_state(
+                        {"step": jnp.int32(step + 1)}, d)
+                    train.report({"step": step + 1}, checkpoint=c)
+                else:
+                    train.report({"step": step + 1})
+
+        trainer = DataParallelTrainer(
+            loop, scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="ck", storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        assert result.checkpoint is not None
+        assert int(result.checkpoint.to_state()["step"]) == 2
+
+        # resume continues from the saved step
+        result2 = DataParallelTrainer(
+            loop, scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="ck2", storage_path=str(tmp_path)),
+            resume_from_checkpoint=result.checkpoint,
+        ).fit()
+        assert int(result2.checkpoint.to_state()["step"]) == 4
+
+    def test_worker_error_surfaces(self, ray_start_shared, tmp_path):
+        def loop(config):
+            raise RuntimeError("train-loop-failure")
+
+        result = DataParallelTrainer(
+            loop, scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="err", storage_path=str(tmp_path)),
+        ).fit()
+        assert result.error is not None
+        assert "train-loop-failure" in str(result.error)
+
+    def test_failure_retry_recovers(self, ray_start_shared, tmp_path):
+        marker = str(tmp_path / "attempted")
+
+        def loop(config):
+            import os
+            if not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                raise RuntimeError("first attempt dies")
+            train.report({"ok": 1})
+
+        result = DataParallelTrainer(
+            loop, train_loop_config={"marker": marker},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="retry", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1)),
+        ).fit()
+        assert result.error is None
+        assert result.metrics["ok"] == 1
+
+
+class TestJaxTrainer:
+    def test_distributed_jax_training(self, ray_start_shared, tmp_path):
+        """2 workers, jax.distributed over CPU: data-parallel psum of a
+        toy gradient — the DEVICE-COLLECTIVE BOUNDARY test (SURVEY §3.4)."""
+
+        def loop(config):
+            import jax
+            import jax.numpy as jnp
+            ctx = train.get_context()
+            assert jax.process_count() == 2
+            # mean of per-worker values over the global device mesh
+            from ray_tpu.util import collective as col
+            from ray_tpu.util.collective.collective_group import (
+                xla_collective_group as xg)
+            g = col.init_collective_group(
+                2, ctx.world_rank, "xla",
+                f"traincheck/{ctx.experiment_name}")
+            grad = np.full((4,), float(ctx.world_rank + 1),
+                           dtype=np.float32)
+            total = g.allreduce(grad)
+            train.report({"sum0": float(total[0])})
+
+        import numpy as np
+        result = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="jaxdist",
+                                 storage_path=str(tmp_path)),
+        ).fit()
+        assert result.error is None, result.error
+        assert result.metrics["sum0"] == 3.0  # 1 + 2
