@@ -42,6 +42,9 @@ class ObjectEntry:
     # Producing task spec retained for lineage reconstruction
     # (reference: ReferenceCounter lineage pinning, reference_count.h:72-146).
     lineage: Optional[P.TaskSpec] = None
+    # ObjectIDs serialized inside this object's value: they stay pinned
+    # while this object lives (reference: nested refs in reference_count.h).
+    nested_ids: List[ObjectID] = field(default_factory=list)
     pending_free: bool = False
     event: threading.Event = field(default_factory=threading.Event)
 
@@ -83,7 +86,12 @@ class ObjectDirectory:
             e.event.clear()
 
     def register_ready(self, oid: ObjectID, location: Tuple, size: int = 0,
-                       lineage: Optional[P.TaskSpec] = None):
+                       lineage: Optional[P.TaskSpec] = None,
+                       nested_ids: Optional[List[ObjectID]] = None):
+        if nested_ids:
+            # Pin nested refs BEFORE publishing the containing object.
+            for nid in nested_ids:
+                self.incref(nid)
         with self._lock:
             e = self._entries.setdefault(oid, ObjectEntry())
             e.state = ERROR if location[0] == P.LOC_ERROR else READY
@@ -91,6 +99,8 @@ class ObjectDirectory:
             e.size = size
             if lineage is not None:
                 e.lineage = lineage
+            if nested_ids:
+                e.nested_ids.extend(nested_ids)
             e.event.set()
             pending_free = e.pending_free
         for cb in self._on_ready:
@@ -133,6 +143,7 @@ class ObjectDirectory:
 
     def decref(self, oid: ObjectID, delta: int = 1):
         freed = None
+        nested = None
         with self._lock:
             e = self._entries.get(oid)
             if e is None:
@@ -145,9 +156,13 @@ class ObjectDirectory:
                 else:
                     del self._entries[oid]
                     freed = [oid]
+                    nested = e.nested_ids
         if freed:
             for cb in self._on_free:
                 cb(freed)
+        if nested:
+            for nid in nested:
+                self.decref(nid)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -30,6 +30,7 @@ SHUTDOWN = "shutdown"            # clean exit
 REPLY = "reply"                  # response to a worker-originated request
 
 # Message types: worker -> driver
+REF_COUNT = "ref_count"          # oneway borrow incref/decref from a worker
 TASK_DONE = "task_done"
 ACTOR_READY = "actor_ready"
 OWNED_PUT = "owned_put"          # worker did put(); driver adopts ownership
@@ -60,6 +61,9 @@ class Arg:
     data: bytes = b""            # serialized value when kind == "value"
     object_id: Optional[ObjectID] = None
     location: Optional[Tuple] = None  # resolved location for refs
+    # Refs serialized INSIDE a by-value argument; pinned by the owner for
+    # the task's lifetime (reference: reference_count.h nested refs).
+    nested_ids: List[ObjectID] = field(default_factory=list)
 
 
 @dataclass

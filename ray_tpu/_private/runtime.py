@@ -259,18 +259,22 @@ class Node:
         self._fn_registry.setdefault(fn_id, blob)
 
     def _pin_task_args(self, spec) -> None:
-        """Pin ref arguments for the task's lifetime so a caller dropping
-        its ObjectRef before dispatch can't free an argument out from under
-        the task (reference: ReferenceCounter submitted-task references,
-        reference_count.h:66)."""
+        """Pin ref arguments (top-level and nested inside values) for the
+        task's lifetime so a caller dropping its ObjectRef before dispatch
+        can't free an argument out from under the task (reference:
+        ReferenceCounter submitted-task references, reference_count.h:66)."""
         for a in list(spec.args) + list(spec.kwargs.values()):
             if a.kind == "ref":
                 self.gcs.objects.incref(a.object_id)
+            for oid in a.nested_ids:
+                self.gcs.objects.incref(oid)
 
     def _unpin_task_args(self, spec) -> None:
         for a in list(spec.args) + list(spec.kwargs.values()):
             if a.kind == "ref":
                 self.gcs.objects.decref(a.object_id)
+            for oid in a.nested_ids:
+                self.gcs.objects.decref(oid)
 
     def _unresolved_deps(self, spec: P.TaskSpec) -> Set[ObjectID]:
         unresolved = set()
@@ -352,15 +356,19 @@ class Node:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, error))
         else:
             self._unpin_task_args(spec)
-            for rid, loc in zip(spec.return_ids, payload["results"]):
+            nested_lists = payload.get("nested") or [[]] * len(
+                spec.return_ids)
+            for rid, loc, nested in zip(spec.return_ids,
+                                        payload["results"], nested_lists):
                 size = loc[1] if loc[0] == P.LOC_SHM else len(loc[1])
                 if loc[0] == P.LOC_SHM:
                     self.store.adopt(rid, size)
                     self.gcs.objects.register_ready(
-                        rid, (P.LOC_SHM, size), size, lineage=spec)
+                        rid, (P.LOC_SHM, size), size, lineage=spec,
+                        nested_ids=nested)
                 else:
                     self.gcs.objects.register_ready(
-                        rid, loc, size, lineage=spec)
+                        rid, loc, size, lineage=spec, nested_ids=nested)
         self.gcs.record_task_event({
             "task_id": task_id.hex(), "name": spec.name,
             "state": "FAILED" if error is not None else "FINISHED",
@@ -635,7 +643,13 @@ class Node:
 
     def _on_worker_message(self, handle: WorkerHandle, msg_type: str,
                            payload: dict):
-        if msg_type == P.TASK_DONE:
+        if msg_type == P.REF_COUNT:
+            # Oneway borrow count from a worker (no reply).
+            if payload["delta"] > 0:
+                self.gcs.objects.incref(payload["object_id"])
+            else:
+                self.gcs.objects.decref(payload["object_id"])
+        elif msg_type == P.TASK_DONE:
             self._on_task_done(handle, payload)
         elif msg_type == P.ACTOR_READY:
             self._on_actor_ready(handle, payload)
@@ -667,15 +681,16 @@ class Node:
         try:
             if msg_type == P.OWNED_PUT:
                 oid = payload["object_id"]
+                nested = payload.get("nested") or []
                 if "inline" in payload:
                     self.gcs.objects.register_ready(
                         oid, (P.LOC_INLINE, payload["inline"]),
-                        len(payload["inline"]))
+                        len(payload["inline"]), nested_ids=nested)
                 else:
                     size = payload["size"]
                     self.store.adopt(oid, size)
                     self.gcs.objects.register_ready(
-                        oid, (P.LOC_SHM, size), size)
+                        oid, (P.LOC_SHM, size), size, nested_ids=nested)
                 self._reply(handle, req_id, True)
             elif msg_type == P.SUBMIT_TASK:
                 self.submit_task(payload["spec"])

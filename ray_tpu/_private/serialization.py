@@ -20,14 +20,38 @@ reference (src/ray/object_manager/plasma/).
 
 from __future__ import annotations
 
+import contextlib
 import pickle
 import struct
+import threading
 from typing import Any, List, Tuple
 
 import cloudpickle
 
 _ALIGN = 64
 _U64 = struct.Struct("<Q")
+
+# Thread-local collector: while active, ObjectRef.__reduce__ records every
+# ref being serialized so the owner can pin nested refs for the lifetime of
+# the task they ride in (reference: ReferenceCounter tracking of refs
+# serialized inside task arguments, reference_count.h:66).
+_ref_collector = threading.local()
+
+
+@contextlib.contextmanager
+def collect_object_refs():
+    prev = getattr(_ref_collector, "ids", None)
+    _ref_collector.ids = []
+    try:
+        yield _ref_collector.ids
+    finally:
+        _ref_collector.ids = prev
+
+
+def note_serialized_ref(object_id):
+    ids = getattr(_ref_collector, "ids", None)
+    if ids is not None:
+        ids.append(object_id)
 
 
 def _align(n: int) -> int:

@@ -56,15 +56,35 @@ class WorkerClient:
     def _request(self, msg_type: str, payload: dict) -> Any:
         return self._worker.request(msg_type, payload)
 
+    # -- borrow refcounting (oneway; pipe ordering guarantees the incref
+    # from arg deserialization lands before this task's TASK_DONE unpin) --
+    def incref(self, object_id: ObjectID):
+        try:
+            self._worker.send(P.REF_COUNT,
+                              {"object_id": object_id, "delta": 1})
+        except Exception:
+            pass
+
+    def decref(self, object_id: ObjectID):
+        try:
+            self._worker.send(P.REF_COUNT,
+                              {"object_id": object_id, "delta": -1})
+        except Exception:
+            pass
+
     # -- objects ----------------------------------------------------------
     def put(self, value: Any) -> ObjectID:
         oid = ObjectID.from_random()
-        sobj = serialization.serialize(value)
+        with serialization.collect_object_refs() as nested:
+            sobj = serialization.serialize(value)
         if sobj.total_size <= INLINE_THRESHOLD:
-            self._request(P.OWNED_PUT, {"object_id": oid, "inline": sobj.to_bytes()})
+            self._request(P.OWNED_PUT, {"object_id": oid,
+                                        "inline": sobj.to_bytes(),
+                                        "nested": list(nested)})
         else:
             size = self._worker.store.put_serialized(oid, sobj)
-            self._request(P.OWNED_PUT, {"object_id": oid, "size": size})
+            self._request(P.OWNED_PUT, {"object_id": oid, "size": size,
+                                        "nested": list(nested)})
         return oid
 
     def get_locations(self, object_ids: List[ObjectID], timeout=None) -> List:
@@ -181,7 +201,7 @@ class Worker:
             self._fn_cache[spec.fn_id] = fn
         return fn
 
-    def _package_returns(self, spec: P.TaskSpec, result: Any) -> List:
+    def _package_returns(self, spec: P.TaskSpec, result: Any):
         if spec.num_returns == 1:
             values = [result]
         else:
@@ -190,15 +210,17 @@ class Worker:
                 raise ValueError(
                     f"Task {spec.name} declared num_returns="
                     f"{spec.num_returns} but returned {len(values)} values")
-        locs = []
+        locs, nested_per_return = [], []
         for oid, value in zip(spec.return_ids, values):
-            sobj = serialization.serialize(value)
+            with serialization.collect_object_refs() as nested:
+                sobj = serialization.serialize(value)
+            nested_per_return.append(list(nested))
             if sobj.total_size <= INLINE_THRESHOLD:
                 locs.append((P.LOC_INLINE, sobj.to_bytes()))
             else:
                 size = self.store.put_serialized(oid, sobj)
                 locs.append((P.LOC_SHM, size))
-        return locs
+        return locs, nested_per_return
 
     def _execute(self, spec: P.TaskSpec):
         tid = spec.task_id.binary()
@@ -219,10 +241,10 @@ class Worker:
                 result = fn(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     result = asyncio.run(result)
-            locs = self._package_returns(spec, result)
+            locs, nested = self._package_returns(spec, result)
             self.send(P.TASK_DONE, {
                 "task_id": spec.task_id, "results": locs, "error": None,
-                "actor_id": spec.actor_id})
+                "nested": nested, "actor_id": spec.actor_id})
         except BaseException as e:  # noqa: BLE001 — all errors ship to owner
             if isinstance(e, TaskCancelledError):
                 err = e

@@ -43,7 +43,9 @@ class ObjectRef:
     def __init__(self, object_id: ObjectID, _incref: bool = True):
         self._id = object_id
         self._owned = False
-        if _incref and state.is_driver():
+        if _incref:
+            # Drivers incref synchronously; workers send an oneway borrow
+            # message (reference: borrower bookkeeping, reference_count.h).
             rt = state.current_or_none()
             if rt is not None and hasattr(rt, "incref"):
                 rt.incref(object_id)
@@ -92,6 +94,7 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
+        serialization.note_serialized_ref(self._id)
         return (ObjectRef._from_binary, (self._id.binary(),))
 
     def __del__(self):
@@ -156,16 +159,25 @@ def is_initialized() -> bool:
 # ---------------------------------------------------------------------------
 def _make_args(args: Sequence, kwargs: Dict) -> tuple:
     out_args, out_kwargs = [], {}
+
+    def _value_arg(a):
+        # Refs nested inside arguments (lists, datasets, ...) are recorded
+        # so the owner pins them for the task's lifetime (Ray semantics:
+        # a ref serialized into task args stays alive for the task).
+        with serialization.collect_object_refs() as nested:
+            data = serialization.dumps(a)
+        return P.Arg(kind="value", data=data, nested_ids=list(nested))
+
     for a in args:
         if isinstance(a, ObjectRef):
             out_args.append(P.Arg(kind="ref", object_id=a.id))
         else:
-            out_args.append(P.Arg(kind="value", data=serialization.dumps(a)))
+            out_args.append(_value_arg(a))
     for k, a in kwargs.items():
         if isinstance(a, ObjectRef):
             out_kwargs[k] = P.Arg(kind="ref", object_id=a.id)
         else:
-            out_kwargs[k] = P.Arg(kind="value", data=serialization.dumps(a))
+            out_kwargs[k] = _value_arg(a)
     return out_args, out_kwargs
 
 

@@ -1,0 +1,34 @@
+"""ray_tpu.data: distributed datasets (Data-equivalent).
+
+Reference parity (SURVEY.md §2.5 Ray Data): lazy block-based Datasets,
+map_batches over task or TPU-actor pools, distributed shuffle/sort/
+groupby, iter_batches/streaming_split feeding trainers.
+
+    import ray_tpu.data as rd
+
+    ds = rd.range(10_000).map_batches(preprocess)
+    preds = ds.map_batches(Predictor, concurrency=2, num_tpus=1)
+"""
+
+from .block import Block  # noqa: F401
+from .dataset import ActorPoolStrategy, Dataset, GroupedData  # noqa: F401
+from .read_api import (  # noqa: F401
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "ActorPoolStrategy", "Block", "Dataset", "GroupedData", "from_arrow",
+    "from_items", "from_numpy", "from_pandas", "range",
+    "read_binary_files", "read_csv", "read_json", "read_numpy",
+    "read_parquet", "read_text",
+]

@@ -1,0 +1,102 @@
+"""Blocks: the unit of distributed data.
+
+Reference parity: python/ray/data/block.py (`Block = Union[pyarrow.Table,
+pandas.DataFrame]` :59, BlockAccessor :256). The canonical in-memory block
+here is a dict of numpy column arrays — zero-copy through the shm object
+store (serialization.py out-of-band buffers) and directly feedable to jax —
+with conversions to/from pandas and pyarrow at the edges.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+
+
+def block_from_rows(rows: Sequence[Any]) -> Block:
+    """Build a column block from python rows (dicts or scalars)."""
+    if not rows:
+        return {}
+    first = rows[0]
+    if isinstance(first, dict):
+        cols: Dict[str, List] = {k: [] for k in first}
+        for r in rows:
+            for k in cols:
+                cols[k].append(r[k])
+        return {k: np.asarray(v) for k, v in cols.items()}
+    return {"item": np.asarray(list(rows))}
+
+
+def block_length(block: Block) -> int:
+    if not block:
+        return 0
+    return len(next(iter(block.values())))
+
+
+def block_slice(block: Block, start: int, end: int) -> Block:
+    return {k: v[start:end] for k, v in block.items()}
+
+def block_take_indices(block: Block, idx) -> Block:
+    return {k: v[idx] for k, v in block.items()}
+
+
+def block_concat(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if block_length(b) > 0]
+    if not blocks:
+        return {}
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def block_to_rows(block: Block) -> Iterator[Dict[str, Any]]:
+    n = block_length(block)
+    keys = list(block.keys())
+    for i in range(n):
+        yield {k: block[k][i] for k in keys}
+
+
+def block_schema(block: Block) -> Dict[str, str]:
+    return {k: str(v.dtype) for k, v in block.items()}
+
+
+def block_size_bytes(block: Block) -> int:
+    return sum(v.nbytes for v in block.values())
+
+
+# -- batch format conversion (reference: BlockAccessor.to_batch_format) ----
+def to_batch_format(block: Block, batch_format: Optional[str]):
+    if batch_format in (None, "default", "numpy"):
+        return block
+    if batch_format == "pandas":
+        import pandas as pd
+        return pd.DataFrame({k: list(v) if v.ndim > 1 else v
+                             for k, v in block.items()})
+    if batch_format == "pyarrow":
+        import pyarrow as pa
+        return pa.table({k: v for k, v in block.items()})
+    raise ValueError(f"Unknown batch_format: {batch_format}")
+
+
+def from_batch_format(batch) -> Block:
+    if batch is None:
+        return {}
+    if isinstance(batch, dict):
+        return {k: np.asarray(v) for k, v in batch.items()}
+    try:
+        import pandas as pd
+        if isinstance(batch, pd.DataFrame):
+            return {c: batch[c].to_numpy() for c in batch.columns}
+    except ImportError:
+        pass
+    try:
+        import pyarrow as pa
+        if isinstance(batch, pa.Table):
+            return {c: np.asarray(batch[c]) for c in batch.column_names}
+    except ImportError:
+        pass
+    if isinstance(batch, np.ndarray):
+        return {"item": batch}
+    raise TypeError(f"Cannot interpret batch of type {type(batch)}")

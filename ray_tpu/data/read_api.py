@@ -1,0 +1,145 @@
+"""Dataset creation (reference: python/ray/data/read_api.py — range,
+from_items/from_numpy/from_pandas/from_arrow, read_parquet/csv/json/text).
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import api
+from . import block as B
+from .dataset import Dataset, _Plan, _RefBundle
+
+
+def _make_source(blocks: List[B.Block]) -> Dataset:
+    def source():
+        return [_RefBundle(api.put(blk), B.block_length(blk))
+                for blk in blocks]
+    return Dataset(_Plan(source, [], "source"))
+
+
+def _split_even(n: int, parts: int) -> List[tuple]:
+    import builtins
+    parts = max(1, min(parts, n)) if n else 1
+    step = (n + parts - 1) // parts if n else 0
+    # builtins.range: the module-level `range` below shadows it.
+    return ([(s, min(s + step, n)) for s in builtins.range(0, n, step)]
+            or [(0, 0)])
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    """ray.data.range parity: one 'id' column of int64."""
+    parts = override_num_blocks or min(max(1, n // 1000), 64) or 1
+    blocks = [{"id": np.arange(s, e, dtype=np.int64)}
+              for s, e in _split_even(n, parts)]
+    return _make_source(blocks)
+
+
+def from_items(items: Sequence[Any],
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    parts = override_num_blocks or min(max(1, len(items) // 1000), 64) or 1
+    blocks = [B.block_from_rows(list(items[s:e]))
+              for s, e in _split_even(len(items), parts)]
+    return _make_source(blocks)
+
+
+def from_numpy(arr: np.ndarray, column: str = "data",
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    parts = override_num_blocks or 8
+    blocks = [{column: arr[s:e]}
+              for s, e in _split_even(len(arr), parts)]
+    return _make_source(blocks)
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    blocks = [B.from_batch_format(df) for df in dfs]
+    return _make_source(blocks)
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    blocks = [B.from_batch_format(t) for t in tables]
+    return _make_source(blocks)
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pattern = os.path.join(p, f"*{suffix or ''}*") \
+                if suffix else os.path.join(p, "*")
+            out.extend(sorted(globlib.glob(pattern)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    return [p for p in out if os.path.isfile(p)]
+
+
+@api.remote
+def _read_file(path: str, fmt: str) -> B.Block:
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        return B.from_batch_format(pq.read_table(path))
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+        return B.from_batch_format(pacsv.read_csv(path))
+    if fmt == "json":
+        import pyarrow.json as pajson
+        return B.from_batch_format(pajson.read_json(path))
+    if fmt == "text":
+        with open(path) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return {"text": np.asarray(lines)}
+    if fmt == "numpy":
+        return {"data": np.load(path)}
+    if fmt == "binary":
+        with open(path, "rb") as f:
+            return {"bytes": np.asarray([f.read()], dtype=object)}
+    raise ValueError(fmt)
+
+
+def _read(paths, fmt: str, suffix: Optional[str]) -> Dataset:
+    files = _expand_paths(paths, suffix)
+    if not files:
+        raise FileNotFoundError(f"No files matched {paths!r}")
+
+    def source():
+        refs = [_read_file.remote(p, fmt) for p in files]
+        blocks = api.get(refs)
+        return [_RefBundle(r, B.block_length(blk))
+                for r, blk in zip(refs, blocks)]
+    return Dataset(_Plan(source, [], f"read_{fmt}"))
+
+
+def read_parquet(paths, **kwargs) -> Dataset:
+    return _read(paths, "parquet", ".parquet")
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    return _read(paths, "csv", ".csv")
+
+
+def read_json(paths, **kwargs) -> Dataset:
+    return _read(paths, "json", ".json")
+
+
+def read_text(paths, **kwargs) -> Dataset:
+    return _read(paths, "text", None)
+
+
+def read_numpy(paths, **kwargs) -> Dataset:
+    return _read(paths, "numpy", ".npy")
+
+
+def read_binary_files(paths, **kwargs) -> Dataset:
+    return _read(paths, "binary", None)

@@ -1,0 +1,212 @@
+"""Data layer tests (reference strategy: python/ray/data/tests suites)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+
+class TestCreation:
+    def test_range(self, ray_start_shared):
+        ds = rd.range(100)
+        assert ds.count() == 100
+        assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+
+    def test_from_items(self, ray_start_shared):
+        ds = rd.from_items([{"a": i, "b": str(i)} for i in range(10)])
+        assert ds.count() == 10
+        assert ds.schema()["a"] == "int64"
+
+    def test_from_numpy(self, ray_start_shared):
+        ds = rd.from_numpy(np.arange(50, dtype=np.float32), column="x")
+        assert ds.count() == 50
+        assert ds.take(1)[0]["x"] == 0.0
+
+    def test_from_pandas(self, ray_start_shared):
+        import pandas as pd
+        df = pd.DataFrame({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+        ds = rd.from_pandas(df)
+        assert ds.count() == 3
+        out = ds.to_pandas()
+        assert list(out["y"]) == ["a", "b", "c"]
+
+
+class TestTransforms:
+    def test_map_batches_fn(self, ray_start_shared):
+        ds = rd.range(100).map_batches(
+            lambda b: {"id": b["id"] * 2})
+        assert ds.take(3) == [{"id": 0}, {"id": 2}, {"id": 4}]
+
+    def test_map_batches_batch_size(self, ray_start_shared):
+        sizes = []
+
+        def record(b):
+            return {"n": np.array([len(b["id"])])}
+
+        ds = rd.range(100, override_num_blocks=1).map_batches(
+            record, batch_size=30)
+        counts = [r["n"] for r in ds.take_all()]
+        assert counts == [30, 30, 30, 10]
+
+    def test_map_batches_actor_pool(self, ray_start_shared):
+        class AddConst:
+            def __init__(self, c=100):
+                self.c = c
+
+            def __call__(self, batch):
+                return {"id": batch["id"] + self.c}
+
+        ds = rd.range(20, override_num_blocks=4).map_batches(
+            AddConst, concurrency=2)
+        out = sorted(r["id"] for r in ds.take_all())
+        assert out == [i + 100 for i in range(20)]
+
+    def test_map_and_filter_and_flat_map(self, ray_start_shared):
+        ds = rd.range(10).map(lambda r: {"id": r["id"] + 1})
+        ds = ds.filter(lambda r: r["id"] % 2 == 0)
+        assert sorted(r["id"] for r in ds.take_all()) == [2, 4, 6, 8, 10]
+        ds2 = rd.range(3).flat_map(
+            lambda r: [{"id": r["id"]}, {"id": r["id"] + 10}])
+        assert ds2.count() == 6
+
+    def test_column_ops(self, ray_start_shared):
+        ds = rd.range(5).add_column("sq", lambda b: b["id"] ** 2)
+        assert ds.take(3)[2]["sq"] == 4
+        assert "id" not in rd.range(5).add_column(
+            "sq", lambda b: b["id"] ** 2).drop_columns(["id"]).schema()
+        assert rd.range(5).rename_columns(
+            {"id": "idx"}).schema() == {"idx": "int64"}
+
+    def test_chaining(self, ray_start_shared):
+        ds = (rd.range(1000)
+              .map_batches(lambda b: {"id": b["id"] + 1})
+              .filter(lambda r: r["id"] % 10 == 0)
+              .map_batches(lambda b: {"id": b["id"] // 10}))
+        assert ds.count() == 100
+
+
+class TestReorg:
+    def test_repartition(self, ray_start_shared):
+        ds = rd.range(100, override_num_blocks=10).repartition(4)
+        assert ds.num_blocks() == 4
+        assert ds.count() == 100
+
+    def test_random_shuffle(self, ray_start_shared):
+        ds = rd.range(200, override_num_blocks=4).random_shuffle(seed=7)
+        vals = [r["id"] for r in ds.take_all()]
+        assert sorted(vals) == list(range(200))
+        assert vals != list(range(200))
+
+    def test_sort(self, ray_start_shared):
+        rng = np.random.default_rng(3)
+        items = [{"k": int(v)} for v in rng.permutation(500)]
+        ds = rd.from_items(items, override_num_blocks=8).sort("k")
+        vals = [r["k"] for r in ds.take_all()]
+        assert vals == sorted(vals)
+        ds2 = rd.from_items(items, override_num_blocks=8).sort(
+            "k", descending=True)
+        vals2 = [r["k"] for r in ds2.take_all()]
+        assert vals2 == sorted(vals2, reverse=True)
+
+    def test_limit_union(self, ray_start_shared):
+        assert rd.range(100).limit(7).count() == 7
+        u = rd.range(5).union(rd.range(3))
+        assert u.count() == 8
+
+
+class TestGroupBy:
+    def test_count_sum_mean(self, ray_start_shared):
+        items = [{"g": i % 3, "v": float(i)} for i in range(30)]
+        ds = rd.from_items(items, override_num_blocks=4)
+        counts = {r["g"]: r["count()"]
+                  for r in ds.groupby("g").count().take_all()}
+        assert counts == {0: 10, 1: 10, 2: 10}
+        sums = {r["g"]: r["sum(v)"]
+                for r in ds.groupby("g").sum("v").take_all()}
+        assert sums[0] == sum(float(i) for i in range(0, 30, 3))
+
+    def test_map_groups(self, ray_start_shared):
+        items = [{"g": i % 2, "v": float(i)} for i in range(10)]
+        ds = rd.from_items(items, override_num_blocks=2)
+        out = ds.groupby("g").map_groups(
+            lambda grp: {"g": grp["g"][:1], "n": np.array([len(grp["v"])])})
+        got = {r["g"]: r["n"] for r in out.take_all()}
+        assert got == {0: 5, 1: 5}
+
+
+class TestConsumption:
+    def test_iter_batches(self, ray_start_shared):
+        ds = rd.range(100, override_num_blocks=7)
+        batches = list(ds.iter_batches(batch_size=32))
+        sizes = [len(b["id"]) for b in batches]
+        assert sum(sizes) == 100
+        assert all(s == 32 for s in sizes[:-1])
+
+    def test_iter_batches_pandas(self, ray_start_shared):
+        import pandas as pd
+        ds = rd.range(10)
+        b = next(iter(ds.iter_batches(batch_size=5,
+                                      batch_format="pandas")))
+        assert isinstance(b, pd.DataFrame)
+
+    def test_split(self, ray_start_shared):
+        shards = rd.range(100, override_num_blocks=8).split(4)
+        assert len(shards) == 4
+        assert sum(s.count() for s in shards) == 100
+
+    def test_streaming_split_feeds_all_rows(self, ray_start_shared):
+        shards = rd.range(64, override_num_blocks=8).streaming_split(2)
+        seen = []
+        for s in shards:
+            for batch in s.iter_batches(batch_size=8):
+                seen.extend(batch["id"].tolist())
+        assert sorted(seen) == list(range(64))
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, ray_start_shared, tmp_path):
+        ds = rd.range(50, override_num_blocks=3)
+        files = ds.write_parquet(str(tmp_path / "pq"))
+        assert len(files) == 3
+        back = rd.read_parquet(str(tmp_path / "pq"))
+        assert back.count() == 50
+        assert sorted(r["id"] for r in back.take_all()) == list(range(50))
+
+    def test_csv_roundtrip(self, ray_start_shared, tmp_path):
+        ds = rd.from_items([{"a": i, "b": i * 2} for i in range(10)])
+        ds.write_csv(str(tmp_path / "csv"))
+        back = rd.read_csv(str(tmp_path / "csv"))
+        assert back.count() == 10
+
+    def test_read_text(self, ray_start_shared, tmp_path):
+        p = tmp_path / "f.txt"
+        p.write_text("alpha\nbeta\ngamma\n")
+        ds = rd.read_text(str(p))
+        assert [r["text"] for r in ds.take_all()] == \
+            ["alpha", "beta", "gamma"]
+
+
+class TestTrainIntegration:
+    def test_dataset_shard_in_trainer(self, ray_start_shared, tmp_path):
+        from ray_tpu import train
+        from ray_tpu.train import DataParallelTrainer, RunConfig, \
+            ScalingConfig
+
+        ds = rd.range(64, override_num_blocks=8)
+
+        def loop(config):
+            shard = train.get_dataset_shard("train")
+            total = 0
+            for batch in shard.iter_batches(batch_size=8):
+                total += int(batch["id"].sum())
+            train.report({"total": total})
+
+        result = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="ds", storage_path=str(tmp_path)),
+            datasets={"train": ds},
+        ).fit()
+        assert result.error is None, result.error
+        assert result.metrics["total"] > 0
