@@ -91,6 +91,10 @@ class Node:
         self.gcs.node_id_hex = self.node_id.hex()
         totals = detect_node_resources(num_cpus, num_tpus, resources)
         self.resources_mgr = ResourceManager(totals)
+        from .placement import PlacementGroupManager
+        self.pg_manager = PlacementGroupManager(self.resources_mgr)
+        self._pg_ready_refs: Dict[str, ObjectID] = {}
+        self._pg_ready_lock = threading.Lock()
         self.pool = WorkerPool(
             self.session_dir, self.store_dir,
             on_worker_message=self._on_worker_message,
@@ -126,6 +130,47 @@ class Node:
         else:
             size = self.store.put_serialized(oid, sobj)
             self.gcs.objects.register_ready(oid, (P.LOC_SHM, size), size)
+        return oid
+
+    def placement_group_ready_ref(self, pg_id_hex: str) -> ObjectID:
+        """An ObjectID that resolves to True once the PG's bundles are
+        reserved (the reference's ``pg.ready()`` ObjectRef,
+        util/placement_group.py:41). Backed by a watcher thread instead of a
+        task so readiness costs no worker. One ref + one watcher per group
+        (cached, pinned) so ready()-polling loops can't accumulate threads
+        or pending objects."""
+        entry = self.pg_manager.get(pg_id_hex)
+        if entry is None:
+            oid = ObjectID.from_random()
+            blob = serialization.dumps(
+                ValueError(f"Unknown placement group {pg_id_hex}"))
+            self.gcs.objects.register_ready(oid, (P.LOC_ERROR, blob))
+            return oid
+        with self._pg_ready_lock:
+            oid = self._pg_ready_refs.get(pg_id_hex)
+            if oid is not None and self.gcs.objects.entry(oid) is not None:
+                return oid
+            oid = ObjectID.from_random()
+            self.gcs.objects.register_pending(oid, None)
+            # Pin: survives user ObjectRefs coming and going.
+            self.gcs.objects.incref(oid)
+            self._pg_ready_refs[pg_id_hex] = oid
+
+        def _watch():
+            entry.ready_event.wait()
+            from . import placement as pl
+            if entry.state == pl.PG_CREATED:
+                sobj = serialization.serialize(True)
+                self.gcs.objects.register_ready(
+                    oid, (P.LOC_INLINE, sobj.to_bytes()), sobj.total_size)
+            else:
+                blob = serialization.dumps(TaskUnschedulableError(
+                    entry.error or f"Placement group {pg_id_hex} "
+                    f"is {entry.state}"))
+                self.gcs.objects.register_ready(oid, (P.LOC_ERROR, blob))
+
+        threading.Thread(target=_watch, daemon=True,
+                         name=f"pg-ready-{pg_id_hex[:8]}").start()
         return oid
 
     def _read_location(self, oid: ObjectID, location: Tuple) -> Any:
@@ -653,7 +698,9 @@ class Node:
             self._on_task_done(handle, payload)
         elif msg_type == P.ACTOR_READY:
             self._on_actor_ready(handle, payload)
-        elif msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS):
+        elif msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS, P.GCS_REQUEST):
+            # GCS requests may block (placement-group waits), so they run on
+            # the handler pool, never the per-worker recv thread.
             self._handler_pool.submit(
                 self._handle_blocking_request, handle, msg_type, payload)
         else:
@@ -667,6 +714,9 @@ class Node:
                 locs = self.get_locations(payload["object_ids"],
                                           payload.get("timeout"))
                 self._reply(handle, req_id, locs)
+            elif msg_type == P.GCS_REQUEST:
+                result = self._gcs_op(payload["op"], payload["kwargs"])
+                self._reply(handle, req_id, result)
             else:
                 ready, not_ready = self.wait(
                     payload["object_ids"], payload["num_returns"],
@@ -740,6 +790,32 @@ class Node:
             return self.gcs.task_events()
         if op == "object_stats":
             return self.gcs.objects.stats()
+        if op == "pg_create":
+            e = self.pg_manager.create(
+                kwargs["pg_id_hex"], kwargs["bundles"], kwargs["strategy"],
+                kwargs.get("name", ""))
+            return e.state
+        if op == "pg_remove":
+            return self.pg_manager.remove(kwargs["pg_id_hex"])
+        if op == "pg_wait_ready":
+            return self.pg_manager.wait_ready(kwargs["pg_id_hex"],
+                                              kwargs.get("timeout"))
+        if op == "pg_table":
+            return self.pg_manager.table()
+        if op == "pg_get_by_name":
+            e = self.pg_manager.get_by_name(kwargs["name"])
+            if e is None:
+                return None
+            return {"pg_id_hex": e.pg_id_hex, "bundles": e.bundles,
+                    "strategy": e.strategy, "state": e.state, "name": e.name}
+        if op == "pg_validate":
+            e = self.pg_manager.get(kwargs["pg_id_hex"])
+            if e is None:
+                raise ValueError(
+                    f"Unknown placement group {kwargs['pg_id_hex']}")
+            self.pg_manager.validate_demand(
+                e, kwargs["resources"], kwargs["bundle_index"])
+            return True
         raise ValueError(f"unknown gcs op {op}")
 
     # parity with WorkerClient so library code is context-agnostic
@@ -766,6 +842,7 @@ class Node:
             return
         self._shutdown = True
         try:
+            self.pg_manager.shutdown()
             self.scheduler.stop()
             self.pool.shutdown()
             self.store.shutdown()

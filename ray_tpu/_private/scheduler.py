@@ -36,6 +36,12 @@ class ResourceManager:
         self._lock = threading.Lock()
         self.totals = dict(totals)
         self.available = dict(totals)
+        # Formatted (placement-group) resources retired by remove():
+        # key -> base resource to which later releases are redirected
+        # (wildcard keys), or None to drop (indexed keys, which alias the
+        # wildcard amount). Prevents phantom re-creation of removed keys
+        # when an in-flight task finishes after the group is removed.
+        self._retired: Dict[str, Optional[str]] = {}
 
     def try_acquire(self, demand: Dict[str, float]) -> bool:
         with self._lock:
@@ -50,10 +56,17 @@ class ResourceManager:
     def release(self, demand: Dict[str, float]):
         with self._lock:
             for k, v in demand.items():
-                if v > 0:
-                    self.available[k] = min(
-                        self.available.get(k, 0.0) + v,
-                        self.totals.get(k, float("inf")))
+                if v <= 0:
+                    continue
+                if k not in self.totals:
+                    # Retired placement-group resource: redirect the release
+                    # to the base resource (wildcard) or drop it (indexed).
+                    k = self._retired.get(k)
+                    if k is None:
+                        continue
+                self.available[k] = min(
+                    self.available.get(k, 0.0) + v,
+                    self.totals.get(k, float("inf")))
 
     def feasible(self, demand: Dict[str, float]) -> bool:
         """Could this demand EVER be satisfied? (infeasible-task detection,
@@ -68,6 +81,27 @@ class ResourceManager:
             for k, v in resources.items():
                 self.totals[k] = self.totals.get(k, 0.0) + v
                 self.available[k] = self.available.get(k, 0.0) + v
+
+    def retire_group_resources(self, formatted_totals: Dict[str, float],
+                               base_of: Dict[str, Optional[str]]):
+        """Remove a placement group's formatted capacity (reference:
+        PlacementGroupResourceManager::ReturnBundle). The *unused* fraction
+        of each wildcard resource returns to its base resource immediately;
+        the in-use fraction returns when the holding tasks release (their
+        formatted release is redirected through ``_retired``)."""
+        with self._lock:
+            returned: Dict[str, float] = {}
+            for k, v in formatted_totals.items():
+                avail = self.available.pop(k, 0.0)
+                self.totals.pop(k, None)
+                base = base_of.get(k)
+                self._retired[k] = base
+                if base is not None:
+                    returned[base] = returned.get(base, 0.0) + avail
+            for k, v in returned.items():
+                self.available[k] = min(
+                    self.available.get(k, 0.0) + v,
+                    self.totals.get(k, float("inf")))
 
     def snapshot(self) -> Tuple[Dict[str, float], Dict[str, float]]:
         with self._lock:
@@ -374,7 +408,8 @@ class Scheduler:
 
     # -- dispatch loop -----------------------------------------------------
     def _env_key_for(self, spec) -> str:
-        n = int(spec.resources.get("TPU", 0))
+        from .placement import tpu_chips_in_demand
+        n = tpu_chips_in_demand(spec.resources)
         return f"tpu:{n}" if n > 0 else ""
 
     def _loop(self):
@@ -454,7 +489,8 @@ class Scheduler:
             # (reference: tpu.py set_current_process_visible_accelerator_ids);
             # specific ids (not just counts) so concurrent TPU workers never
             # collide on a chip.
-            nchips = int(spec.resources.get("TPU", 1))
+            from .placement import tpu_chips_in_demand
+            nchips = tpu_chips_in_demand(spec.resources) or 1
             with self._lock:
                 if len(self._free_chips) < nchips:
                     reclaim = True

@@ -42,6 +42,15 @@ from .ids import ActorID, ObjectID, TaskID
 from .object_store import INLINE_THRESHOLD, ObjectStore
 
 
+# Per-thread currently-executing task spec (reference: the worker's
+# runtime context / current task in _private/worker.py + runtime_context.py).
+_task_ctx = threading.local()
+
+
+def current_task_spec() -> Optional[P.TaskSpec]:
+    return getattr(_task_ctx, "spec", None)
+
+
 class WorkerClient:
     """Worker-side client for the driver's GCS/scheduler services.
 
@@ -226,6 +235,7 @@ class Worker:
         tid = spec.task_id.binary()
         with self._running_lock:
             self._running[tid] = threading.get_ident()
+        _task_ctx.spec = spec
         try:
             args = [self.resolve_arg(a) for a in spec.args]
             kwargs = {k: self.resolve_arg(a) for k, a in spec.kwargs.items()}
@@ -260,6 +270,7 @@ class Worker:
                 "task_id": spec.task_id, "results": None, "error": blob,
                 "actor_id": spec.actor_id})
         finally:
+            _task_ctx.spec = None
             with self._running_lock:
                 self._running.pop(tid, None)
 
@@ -371,7 +382,11 @@ def _main():
     authkey = bytes.fromhex(os.environ["RAY_TPU_WORKER_AUTHKEY"])
     conn = Client(address, family="AF_UNIX", authkey=authkey)
     config: P.WorkerConfig = cloudpickle.loads(conn.recv_bytes())
-    worker_main(conn, config)
+    # Under ``-m`` this file executes as ``__main__``; delegate to the
+    # canonical import so module-level state (_task_ctx, caches) is the
+    # single copy user code reaches via `import ray_tpu._private.worker_proc`.
+    from ray_tpu._private import worker_proc as _canonical
+    _canonical.worker_main(conn, config)
 
 
 if __name__ == "__main__":

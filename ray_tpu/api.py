@@ -197,6 +197,46 @@ def _build_resources(opts: Dict, default_num_cpus: float = 1) -> Dict[str, float
     return res
 
 
+def _apply_placement(opts: Dict, resources: Dict[str, float]):
+    """Resolve placement-group options into the formatted-resource demand
+    rewrite (reference: ray_option_utils + BundleSpecification resource
+    formatting; scheme in _private/placement.py). Returns
+    (pg_id_hex or None, bundle_index, rewritten_resources)."""
+    from ._private.placement import rewrite_demand_for_pg
+    from .util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+    strategy = opts.get("scheduling_strategy")
+    pg = opts.get("placement_group")
+    bundle_index = int(opts.get("placement_group_bundle_index", -1))
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pg = strategy.placement_group
+        bundle_index = int(strategy.placement_group_bundle_index)
+    if pg is None or getattr(pg, "is_empty", False):
+        # Inherit the caller task's group when it was created with
+        # capture_child_tasks (reference: placement-group capture semantics).
+        from ._private import worker_proc
+        cur = worker_proc.current_task_spec()
+        if cur is not None and cur.placement_group_id:
+            cur_strategy = cur.scheduling_strategy
+            if (isinstance(cur_strategy, PlacementGroupSchedulingStrategy)
+                    and cur_strategy.placement_group_capture_child_tasks):
+                pg_id = cur.placement_group_id
+                # Same validation as the explicit path: a child of a
+                # removed group must fail fast, not park forever.
+                state.current().gcs_request(
+                    "pg_validate", pg_id_hex=pg_id, resources=resources,
+                    bundle_index=-1)
+                return pg_id, -1, rewrite_demand_for_pg(
+                    resources, pg_id, -1)
+        return None, -1, resources
+    pg_id_hex = pg.id if hasattr(pg, "id") else str(pg)
+    state.current().gcs_request(
+        "pg_validate", pg_id_hex=pg_id_hex, resources=resources,
+        bundle_index=bundle_index)
+    return (pg_id_hex, bundle_index,
+            rewrite_demand_for_pg(resources, pg_id_hex, bundle_index))
+
+
 # ---------------------------------------------------------------------------
 # remote functions
 # ---------------------------------------------------------------------------
@@ -259,13 +299,17 @@ class RemoteFunction:
         return_ids = [object_id_for_return(task_id, i)
                       for i in range(num_returns)]
         s_args, s_kwargs = _make_args(args, kwargs)
+        pg_id, bundle_index, resources = _apply_placement(
+            opts, _build_resources(opts))
         spec = P.TaskSpec(
             task_id=task_id, fn_id=self._fn_id, fn_blob=self._get_blob(),
             args=s_args, kwargs=s_kwargs, return_ids=return_ids,
             num_returns=num_returns, name=opts.get("name", self.__name__),
-            resources=_build_resources(opts),
+            resources=resources,
             max_retries=int(opts.get("max_retries", 3)),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            placement_group_id=pg_id,
+            placement_group_bundle_index=bundle_index,
             scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=opts.get("runtime_env"))
         refs = [ObjectRef(rid) for rid in return_ids]
@@ -423,6 +467,8 @@ class ActorClass:
         max_concurrency = opts.get("max_concurrency")
         if max_concurrency is None:
             max_concurrency = 1000 if is_async else 1
+        _actor_pg_id, _actor_bundle_index, _actor_resources = \
+            _apply_placement(opts, _build_resources(opts, default_num_cpus=0))
         spec = P.ActorSpec(
             actor_id=actor_id, cls_id=self._cls_id, cls_blob=self._blob,
             args=s_args, kwargs=s_kwargs, name=opts.get("name"),
@@ -433,7 +479,9 @@ class ActorClass:
             # Actors hold 0 CPU while alive unless explicitly requested
             # (reference semantics: actors don't reserve CPUs for their
             # lifetime, which is how 40k+ actors fit on small clusters).
-            resources=_build_resources(opts, default_num_cpus=0),
+            resources=_actor_resources,
+            placement_group_id=_actor_pg_id,
+            placement_group_bundle_index=_actor_bundle_index,
             scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=opts.get("runtime_env"),
             lifetime=opts.get("lifetime"),

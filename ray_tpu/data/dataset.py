@@ -108,9 +108,14 @@ def _partition_block(blk: B.Block, n: int, mode, key, boundaries, seed):
         vals = blk[key]
         assign = np.searchsorted(boundaries, vals, side="right")
     else:  # groupby hash
+        # Deterministic cross-process hash: Python's hash() is salted per
+        # process for str/bytes (PYTHONHASHSEED), and partition maps run in
+        # different workers — the same key MUST land in the same partition.
+        import zlib
         vals = blk[key]
         assign = np.array(
-            [hash(v) % n for v in vals.tolist()], dtype=np.int64)
+            [zlib.crc32(repr(v).encode()) % n for v in vals.tolist()],
+            dtype=np.int64)
     return tuple(
         B.block_take_indices(blk, np.nonzero(assign == i)[0])
         for i in range(n))
@@ -441,8 +446,11 @@ class Dataset:
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Distributed two-phase shuffle (reference: dataset.py
-        random_shuffle; map-side hash partition + reduce-side permute)."""
-        return self._shuffle_like("shuffle", seed=seed or 0,
+        random_shuffle; map-side hash partition + reduce-side permute).
+        Unseeded calls produce a fresh permutation each execution (seed=None
+        flows through to per-call fresh RNGs); seed=0 is honored as a real
+        seed, distinct from unseeded."""
+        return self._shuffle_like("shuffle", seed=seed,
                                   name="RandomShuffle")
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":

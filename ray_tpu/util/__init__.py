@@ -1,4 +1,16 @@
 from .actor_pool import ActorPool  # noqa: F401
+from .placement_group import (  # noqa: F401
+    PlacementGroup,
+    get_current_placement_group,
+    get_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
 from .queue import Queue  # noqa: F401
 
-__all__ = ["ActorPool", "Queue"]
+__all__ = [
+    "ActorPool", "PlacementGroup", "Queue", "get_current_placement_group",
+    "get_placement_group", "placement_group", "placement_group_table",
+    "remove_placement_group",
+]

@@ -1,0 +1,157 @@
+"""Placement groups: gang reservation of resource bundles.
+
+Reference parity: python/ray/util/placement_group.py (placement_group()
+:145, PlacementGroup handle :41, remove/get/table helpers) over the
+GCS-side manager (gcs_placement_group_manager.cc). The TPU-era point of a
+placement group is *slice gang scheduling*: reserve all hosts/chips of a
+pod slice atomically so an SPMD mesh program can launch across them
+(SURVEY.md §7 Phase 1); the bundle-reservation scheme is formatted group
+resources, see _private/placement.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+from .. import api as _api
+from .._private import state as _state
+from .._private.placement import (  # noqa: F401  (re-exported strategies)
+    PACK, SPREAD, STRICT_PACK, STRICT_SPREAD, rewrite_demand_for_pg)
+
+
+class PlacementGroup:
+    """Handle to a placement group (reference: util/placement_group.py:41)."""
+
+    def __init__(self, id: str, bundles: Optional[List[Dict[str, float]]] = None):
+        self.id = id
+        self._bundles = bundles
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def empty() -> "PlacementGroup":
+        return PlacementGroup("")
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.id
+
+    def _fetch_bundles(self) -> List[Dict[str, float]]:
+        with self._lock:
+            if self._bundles is None:
+                table = _state.current().gcs_request("pg_table")
+                info = table.get(self.id)
+                if info is None:
+                    raise ValueError(f"Unknown placement group {self.id}")
+                self._bundles = [info["bundles"][i]
+                                 for i in sorted(info["bundles"])]
+            return self._bundles
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return self._fetch_bundles()
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._fetch_bundles())
+
+    def ready(self) -> "_api.ObjectRef":
+        """ObjectRef resolving to True when all bundles are reserved; use
+        ``ray_tpu.get(pg.ready(), timeout=...)`` (reference semantics)."""
+        rt = _state.current()
+        if hasattr(rt, "placement_group_ready_ref"):
+            return _api.ObjectRef(rt.placement_group_ready_ref(self.id))
+        # Worker context: readiness via a zero-resource probe task on the
+        # driver (the gcs_request wait runs on the driver's handler pool).
+        pg_id = self.id
+
+        @_api.remote
+        def _pg_ready() -> bool:
+            return _state.current().gcs_request(
+                "pg_wait_ready", pg_id_hex=pg_id, timeout=None)
+
+        return _pg_ready.options(num_cpus=0).remote()
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        """Block until ready; False on timeout (reference:
+        PlacementGroup.wait)."""
+        try:
+            return bool(_state.current().gcs_request(
+                "pg_wait_ready", pg_id_hex=self.id,
+                timeout=timeout_seconds))
+        except Exception:
+            raise
+
+    def __eq__(self, other):
+        return isinstance(other, PlacementGroup) and other.id == self.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self._bundles))
+
+    def __repr__(self):
+        return f"PlacementGroup(id={self.id[:16]})"
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str = "",
+                    lifetime: Optional[str] = None,
+                    _max_cpu_fraction_per_node: Optional[float] = None
+                    ) -> PlacementGroup:
+    """Create a placement group (reference: util/placement_group.py:145).
+
+    Returns immediately; reservation is asynchronous. Use ``pg.ready()`` /
+    ``pg.wait()`` to block on it.
+    """
+    if not _state.is_initialized():
+        _api.init(ignore_reinit_error=True)
+    pg_id = uuid.uuid4().hex
+    bundles = [dict(b) for b in bundles]
+    _state.current().gcs_request(
+        "pg_create", pg_id_hex=pg_id, bundles=bundles, strategy=strategy,
+        name=name)
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    """Release all bundles (reference: util/placement_group.py
+    remove_placement_group). Running tasks keep their workers until they
+    finish; no new tasks can target the group."""
+    _state.current().gcs_request("pg_remove", pg_id_hex=pg.id)
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    info = _state.current().gcs_request("pg_get_by_name", name=name)
+    if info is None:
+        raise ValueError(f"Failed to look up placement group '{name}'")
+    return PlacementGroup(info["pg_id_hex"], info["bundles"])
+
+
+def placement_group_table(pg: Optional[PlacementGroup] = None) -> dict:
+    table = _state.current().gcs_request("pg_table")
+    if pg is not None:
+        return table.get(pg.id, {})
+    return table
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    """The placement group of the currently executing task/actor, if any
+    (reference: util/placement_group.py get_current_placement_group)."""
+    from .._private import worker_proc
+    spec = worker_proc.current_task_spec()
+    if spec is None or not getattr(spec, "placement_group_id", None):
+        return None
+    return PlacementGroup(spec.placement_group_id.decode()
+                          if isinstance(spec.placement_group_id, bytes)
+                          else spec.placement_group_id)
+
+
+def check_placement_group_index(pg: PlacementGroup, bundle_index: int):
+    if bundle_index >= pg.bundle_count or bundle_index < -1:
+        raise ValueError(
+            f"placement_group_bundle_index must be -1 or in "
+            f"[0, {pg.bundle_count}), got {bundle_index}")

@@ -1,0 +1,72 @@
+"""Scheduling strategies (reference parity:
+python/ray/util/scheduling_strategies.py).
+
+``PlacementGroupSchedulingStrategy`` pins a task/actor into a placement
+group's reserved bundles; ``NodeAffinitySchedulingStrategy`` targets a
+specific node. On the single-resource-view runtime node affinity is
+trivially satisfied for the local node id and infeasible otherwise (hard)
+or ignored (soft)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class PlacementGroupSchedulingStrategy:
+    """Schedule into a placement group's reserved bundles.
+
+    Reference: util/scheduling_strategies.py PlacementGroupSchedulingStrategy.
+    """
+
+    def __init__(self, placement_group,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: Optional[bool] = None):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = (
+            placement_group_capture_child_tasks)
+
+    def __repr__(self):
+        return (f"PlacementGroupSchedulingStrategy(pg="
+                f"{self.placement_group.id[:8]}, bundle="
+                f"{self.placement_group_bundle_index})")
+
+
+class NodeAffinitySchedulingStrategy:
+    """Reference: util/scheduling_strategies.py NodeAffinitySchedulingStrategy."""
+
+    def __init__(self, node_id: str, soft: bool = False,
+                 _spill_on_unavailable: bool = False,
+                 _fail_on_unavailable: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+        self._spill_on_unavailable = _spill_on_unavailable
+        self._fail_on_unavailable = _fail_on_unavailable
+
+
+class In:
+    def __init__(self, *values):
+        self.values = list(values)
+
+
+class NotIn:
+    def __init__(self, *values):
+        self.values = list(values)
+
+
+class Exists:
+    pass
+
+
+class DoesNotExist:
+    pass
+
+
+class NodeLabelSchedulingStrategy:
+    """Reference: util/scheduling_strategies.py NodeLabelSchedulingStrategy
+    (hard/soft label expressions)."""
+
+    def __init__(self, hard: Optional[dict] = None,
+                 soft: Optional[dict] = None):
+        self.hard = hard or {}
+        self.soft = soft or {}

@@ -126,6 +126,17 @@ class TestGroupBy:
                 for r in ds.groupby("g").sum("v").take_all()}
         assert sums[0] == sum(float(i) for i in range(0, 30, 3))
 
+    def test_string_keys_cross_process(self, ray_start_shared):
+        # String keys hash-partition in separate worker processes; Python's
+        # per-process str-hash salt must not split a key across partitions
+        # (regression: silent duplicate groups with wrong sums).
+        items = [{"g": ["apple", "banana", "cherry"][i % 3], "v": 1.0}
+                 for i in range(30)]
+        ds = rd.from_items(items, override_num_blocks=4)
+        sums = {r["g"]: r["sum(v)"]
+                for r in ds.groupby("g").sum("v").take_all()}
+        assert sums == {"apple": 10.0, "banana": 10.0, "cherry": 10.0}
+
     def test_map_groups(self, ray_start_shared):
         items = [{"g": i % 2, "v": float(i)} for i in range(10)]
         ds = rd.from_items(items, override_num_blocks=2)

@@ -1,0 +1,155 @@
+"""Placement group tests (reference test model:
+python/ray/tests/test_placement_group*.py — create/ready/remove, bundle
+demand rewrite, capacity accounting, strategy validation)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_create_ready_remove(rt):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert ray_tpu.get(pg.ready(), timeout=10) is True
+    assert pg.wait(5)
+    table = placement_group_table(pg)
+    assert table["state"] == "CREATED"
+    assert table["strategy"] == "PACK"
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= 2.0 + 1e-9  # 2 of 4 CPUs reserved
+    remove_placement_group(pg)
+    assert placement_group_table(pg)["state"] == "REMOVED"
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] >= 4.0 - 1e-9
+
+
+def test_task_in_pg(rt):
+    pg = placement_group([{"CPU": 2}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote
+    def f():
+        return "ok"
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    out = ray_tpu.get(f.options(
+        num_cpus=1, scheduling_strategy=strategy).remote(), timeout=30)
+    assert out == "ok"
+    remove_placement_group(pg)
+
+
+def test_actor_in_pg(rt):
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+
+
+def test_demand_exceeding_bundle_rejected(rt):
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError):
+        f.options(num_cpus=2,
+                  scheduling_strategy=PlacementGroupSchedulingStrategy(
+                      placement_group=pg,
+                      placement_group_bundle_index=0)).remote()
+    remove_placement_group(pg)
+
+
+def test_infeasible_pg_errors_on_ready(rt):
+    pg = placement_group([{"CPU": 64}])
+    with pytest.raises(ray_tpu.exceptions.TaskUnschedulableError):
+        ray_tpu.get(pg.ready(), timeout=10)
+
+
+def test_strict_spread_single_node_infeasible(rt):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    with pytest.raises(ray_tpu.exceptions.TaskUnschedulableError):
+        ray_tpu.get(pg.ready(), timeout=10)
+
+
+def test_pending_pg_acquires_after_release(rt):
+    pg1 = placement_group([{"CPU": 3}])
+    assert pg1.wait(10)
+    pg2 = placement_group([{"CPU": 3}])  # can't fit while pg1 holds 3/4
+    assert placement_group_table(pg2)["state"] == "PENDING"
+    remove_placement_group(pg1)
+    assert ray_tpu.get(pg2.ready(), timeout=10) is True
+    remove_placement_group(pg2)
+
+
+def test_remove_with_task_in_flight_keeps_accounting_sane(rt):
+    # Removing a PG while one of its tasks runs must not mint phantom
+    # formatted resources or lose base capacity when the task finishes.
+    pg = placement_group([{"CPU": 2}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote
+    def slow():
+        import time
+        time.sleep(1.0)
+        return 1
+
+    ref = slow.options(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg,
+            placement_group_bundle_index=0)).remote()
+    import time
+    time.sleep(0.4)  # task is running and holds 1 formatted CPU
+    remove_placement_group(pg)
+    assert ray_tpu.get(ref, timeout=30) == 1
+    time.sleep(0.3)  # let the release land
+    avail = ray_tpu.available_resources()
+    # All 4 base CPUs back; no *_group_* keys left behind.
+    assert avail["CPU"] >= 4.0 - 1e-9, avail
+    assert not any("_group_" in k for k in avail), avail
+
+
+def test_bundle_index_below_minus_one_rejected(rt):
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError):
+        f.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg,
+            placement_group_bundle_index=-2)).remote()
+    remove_placement_group(pg)
+
+
+def test_invalid_bundles_rejected(rt):
+    with pytest.raises(ValueError):
+        placement_group([])
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
