@@ -59,6 +59,9 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)
     verbose: int = 1
+    # Stop conditions for tune trials, e.g. {"training_iteration": 10}
+    # (reference: air.RunConfig(stop=...)).
+    stop: Optional[Dict[str, float]] = None
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -1,0 +1,45 @@
+"""ray_tpu.tune: hyperparameter tuning over trial actors.
+
+Reference parity: python/ray/tune (Tuner.fit tuner.py:344, TuneController
+event loop execution/tune_controller.py:68, searchers tune/search/,
+schedulers tune/schedulers/). Trials are actor processes; TPU trials
+reserve chips through the same resource scheduler as everything else, so
+a `tune.with_resources(fn, {"TPU": 1})` sweep time-shares the slice.
+"""
+
+from ..train.config import CheckpointConfig, FailureConfig, RunConfig
+from .result_grid import Result, ResultGrid
+from .schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .session import get_checkpoint, get_trial_dir, get_trial_id, report
+from .trainable import Trainable, with_parameters, with_resources
+from .tuner import TuneConfig, Tuner
+
+ASHAScheduler = AsyncHyperBandScheduler  # reference alias (tune.schedulers)
+
+__all__ = [
+    "ASHAScheduler", "AsyncHyperBandScheduler", "CheckpointConfig",
+    "FIFOScheduler", "FailureConfig", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining", "Result", "ResultGrid",
+    "RunConfig", "Trainable", "TrialScheduler", "TuneConfig", "Tuner",
+    "choice", "get_checkpoint", "get_trial_dir", "get_trial_id",
+    "grid_search", "loguniform", "qrandint", "quniform", "randint",
+    "report", "sample_from", "uniform", "with_parameters",
+    "with_resources",
+]

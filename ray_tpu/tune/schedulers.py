@@ -1,0 +1,209 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Reference parity: python/ray/tune/schedulers/ — FIFOScheduler
+(trial_scheduler.py), AsyncHyperBandScheduler/ASHA (async_hyperband.py),
+HyperBandScheduler (hyperband.py), MedianStoppingRule
+(median_stopping_rule.py), PopulationBasedTraining (pbt.py). The TPU build
+keeps the decision interface (CONTINUE/STOP + PBT's exploit) and drives it
+from the TuneController's result-poll loop.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    """Decision hook invoked on every reported result."""
+
+    def set_metric(self, metric: str, mode: str):
+        self._metric = metric
+        self._mode = mode
+
+    def _has_metric(self, result: Dict) -> bool:
+        m = getattr(self, "_metric", None)
+        return m is not None and m in result
+
+    def _score(self, result: Dict) -> float:
+        v = float(result[self._metric])
+        return v if self._mode == "max" else -v
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        pass
+
+    # PBT-only hook; (src_trial_id, mutated_config) or None
+    def exploit_decision(self, trial_id: str,
+                         configs: Dict[str, Dict]) -> Optional[Tuple[str, Dict]]:
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (reference: trial_scheduler.py)."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: async_hyperband.py AsyncHyperBandScheduler).
+
+    Rungs at grace_period * reduction_factor^k; a trial reaching a rung
+    stops unless its score is in the top 1/reduction_factor of results
+    recorded at that rung so far (asynchronous promotion — no waiting for
+    the full cohort, the property that makes ASHA scale).
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4, brackets: int = 1):
+        self._time_attr = time_attr
+        self._max_t = max_t
+        self._grace = grace_period
+        self._rf = reduction_factor
+        if metric:
+            self.set_metric(metric, mode or "max")
+        # rung milestone -> recorded scores
+        self._rungs: Dict[int, List[float]] = {}
+        milestone = grace_period
+        self._milestones = []
+        while milestone < max_t:
+            self._milestones.append(int(milestone))
+            milestone *= reduction_factor
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = int(result.get(self._time_attr, 0))
+        if t >= self._max_t:
+            return STOP
+        if not self._has_metric(result):
+            # Results missing the metric (warmup reports etc.) pass through
+            # rather than crashing the experiment (reference tolerance).
+            return CONTINUE
+        decision = CONTINUE
+        for m in self._milestones:
+            if t == m:
+                score = self._score(result)
+                rung = self._rungs.setdefault(m, [])
+                rung.append(score)
+                k = max(1, int(math.ceil(len(rung) / self._rf)))
+                top = sorted(rung, reverse=True)[:k]
+                if score < top[-1]:
+                    decision = STOP
+        return decision
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Synchronous HyperBand approximated by ASHA rung logic (reference:
+    hyperband.py; the async variant dominates it in practice and shares
+    the successive-halving core)."""
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average score falls below the median of
+    the other trials' running averages at the same step (reference:
+    median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        if metric:
+            self.set_metric(metric, mode or "max")
+        self._running: Dict[str, List[float]] = {}
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        if not self._has_metric(result):
+            return CONTINUE
+        t = int(result.get(self._time_attr, 0))
+        scores = self._running.setdefault(trial_id, [])
+        scores.append(self._score(result))
+        if t < self._grace:
+            return CONTINUE
+        others = [sum(v) / len(v) for k, v in self._running.items()
+                  if k != trial_id and v]
+        if len(others) < self._min_samples:
+            return CONTINUE
+        median = sorted(others)[len(others) // 2]
+        mine = sum(scores) / len(scores)
+        return STOP if mine < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: pbt.py PopulationBasedTraining): every
+    perturbation_interval steps, a bottom-quantile trial clones the
+    checkpoint of a top-quantile trial and continues with mutated
+    hyperparameters."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self._time_attr = time_attr
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_p = resample_probability
+        self._rng = random.Random(seed)
+        if metric:
+            self.set_metric(metric, mode or "max")
+        self._last_perturb: Dict[str, int] = {}
+        self._latest: Dict[str, float] = {}
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        if self._has_metric(result):
+            self._latest[trial_id] = self._score(result)
+        return CONTINUE
+
+    def should_perturb(self, trial_id: str, result: Dict) -> bool:
+        t = int(result.get(self._time_attr, 0))
+        last = self._last_perturb.get(trial_id, 0)
+        if t - last >= self._interval:
+            self._last_perturb[trial_id] = t
+            return True
+        return False
+
+    def exploit_decision(self, trial_id: str,
+                         configs: Dict[str, Dict]) -> Optional[Tuple[str, Dict]]:
+        """If `trial_id` is bottom-quantile, pick a top-quantile source and
+        a mutated clone of its config (reference: pbt.py _exploit)."""
+        if len(self._latest) < 2:
+            return None
+        ranked = sorted(self._latest.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        k = max(1, int(n * self._quantile))
+        bottom = {tid for tid, _ in ranked[:k]}
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial_id not in bottom:
+            return None
+        src = self._rng.choice(top)
+        if src == trial_id:
+            return None
+        return src, self._mutate(configs[src])
+
+    def _mutate(self, config: Dict) -> Dict:
+        from .search import Domain
+        out = dict(config)
+        for key, spec in self._mutations.items():
+            if self._rng.random() < self._resample_p or key not in out:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    out[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            else:
+                cur = out[key]
+                if isinstance(cur, (int, float)):
+                    factor = self._rng.choice([0.8, 1.2])
+                    out[key] = type(cur)(cur * factor)
+        return out

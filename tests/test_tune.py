@@ -1,0 +1,255 @@
+"""Tune tests (reference test model: python/ray/tune/tests/ —
+test_tune_run, searcher/scheduler suites, experiment restore)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@pytest.fixture(scope="module")
+def rt(tmp_path_factory):
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def storage(tmp_path):
+    return str(tmp_path)
+
+
+class TestSearchSpaces:
+    def test_grid_and_samples(self):
+        from ray_tpu.tune.search import generate_variants
+        space = {"a": tune.grid_search([1, 2, 3]),
+                 "b": tune.uniform(0.0, 1.0),
+                 "c": "fixed"}
+        variants = generate_variants(space, num_samples=2, seed=0)
+        assert len(variants) == 6
+        assert sorted(v["a"] for v in variants) == [1, 1, 2, 2, 3, 3]
+        assert all(0.0 <= v["b"] <= 1.0 for v in variants)
+        assert all(v["c"] == "fixed" for v in variants)
+
+    def test_domains(self):
+        import random
+        rng = random.Random(0)
+        assert 1 <= tune.randint(1, 10).sample(rng) < 10
+        assert tune.choice(["x", "y"]).sample(rng) in ("x", "y")
+        v = tune.loguniform(1e-4, 1e-1).sample(rng)
+        assert 1e-4 <= v <= 1e-1
+        q = tune.quniform(0, 1, 0.25).sample(rng)
+        assert q in (0.0, 0.25, 0.5, 0.75, 1.0)
+
+    def test_nested_grid_search_expands(self):
+        from ray_tpu.tune.search import generate_variants
+        space = {"opt": {"lr": tune.grid_search([0.1, 0.01]),
+                         "name": "sgd"},
+                 "top": tune.grid_search([1, 2])}
+        variants = generate_variants(space, 1, seed=0)
+        assert len(variants) == 4
+        assert {v["opt"]["lr"] for v in variants} == {0.1, 0.01}
+        assert all(v["opt"]["name"] == "sgd" for v in variants)
+
+    def test_sample_from(self):
+        from ray_tpu.tune.search import generate_variants
+        space = {"a": tune.grid_search([2, 4]),
+                 "b": tune.sample_from(lambda spec: spec.config.a * 10)}
+        variants = generate_variants(space, 1, seed=0)
+        assert {(v["a"], v["b"]) for v in variants} == {(2, 20), (4, 40)}
+
+
+class TestTunerFit:
+    def test_grid_sweep_best_result(self, rt, storage):
+        def trainable(config):
+            # quadratic with max at x=3
+            score = -(config["x"] - 3) ** 2
+            tune.report({"score": score})
+
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=tune.RunConfig(storage_path=storage))
+        grid = tuner.fit()
+        assert len(grid) == 5
+        assert grid.num_errors == 0
+        best = grid.get_best_result()
+        assert best.config["x"] == 3
+        assert best.metrics["score"] == 0
+
+    def test_multi_iteration_and_stop_condition(self, rt, storage):
+        def trainable(config):
+            for i in range(100):
+                tune.report({"loss": 1.0 / (i + 1)})
+
+        tuner = tune.Tuner(
+            trainable, param_space={},
+            tune_config=tune.TuneConfig(metric="loss", mode="min"),
+            run_config=tune.RunConfig(
+                storage_path=storage, stop={"training_iteration": 5}))
+        grid = tuner.fit()
+        assert grid[0].metrics["training_iteration"] <= 6
+
+    def test_trial_error_surfaces(self, rt, storage):
+        def trainable(config):
+            if config["x"] == 1:
+                raise RuntimeError("boom")
+            tune.report({"score": config["x"]})
+
+        grid = tune.Tuner(
+            trainable, param_space={"x": tune.grid_search([0, 1])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=tune.RunConfig(storage_path=storage)).fit()
+        assert grid.num_errors == 1
+        assert "boom" in grid.errors[0]
+        assert grid.get_best_result().config["x"] == 0
+
+    def test_checkpoint_report_and_best(self, rt, storage):
+        def trainable(config):
+            import tempfile
+            for i in range(3):
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "it.txt"), "w") as f:
+                    f.write(str(i))
+                tune.report({"score": i},
+                            checkpoint=Checkpoint.from_directory(d))
+
+        grid = tune.Tuner(
+            trainable, param_space={},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=tune.RunConfig(storage_path=storage)).fit()
+        r = grid[0]
+        assert r.checkpoint is not None
+        with open(os.path.join(r.checkpoint.path, "it.txt")) as f:
+            assert f.read() == "2"
+
+
+class TestSchedulers:
+    def test_asha_stops_bad_trials(self, rt, storage):
+        def trainable(config):
+            for i in range(16):
+                tune.report({"score": config["quality"] * (i + 1)})
+
+        # Sequential, best-first: async SHA only cuts a trial when its rung
+        # score is outside the top 1/rf of scores recorded so far, so the
+        # later (worse) trials stop at the first rung deterministically.
+        grid = tune.Tuner(
+            trainable,
+            param_space={"quality": tune.grid_search([5.0, 2.0, 1.0])},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max",
+                max_concurrent_trials=1,
+                scheduler=tune.ASHAScheduler(
+                    max_t=16, grace_period=2, reduction_factor=2)),
+            run_config=tune.RunConfig(storage_path=storage)).fit()
+        best = grid.get_best_result()
+        assert best.config["quality"] == 5.0
+        iters = {r.config["quality"]: r.metrics.get("training_iteration", 0)
+                 for r in grid}
+        assert iters[5.0] == 16          # leader runs to max_t
+        assert iters[2.0] < 16           # cut at a rung
+        assert iters[1.0] < 16
+
+    def test_median_stopping_rule_unit(self):
+        rule = tune.MedianStoppingRule(metric="acc", mode="max",
+                                       grace_period=1,
+                                       min_samples_required=2)
+        from ray_tpu.tune.schedulers import CONTINUE, STOP
+        for step in range(1, 4):
+            assert rule.on_result("good1", {
+                "training_iteration": step, "acc": 0.9}) == CONTINUE
+            assert rule.on_result("good2", {
+                "training_iteration": step, "acc": 0.8}) == CONTINUE
+        assert rule.on_result("bad", {
+            "training_iteration": 2, "acc": 0.1}) == STOP
+
+    def test_pbt_exploit_unit(self):
+        pbt = tune.PopulationBasedTraining(
+            metric="score", mode="max", perturbation_interval=2,
+            hyperparam_mutations={"lr": tune.loguniform(1e-4, 1e-1)},
+            seed=0)
+        pbt.on_result("weak", {"training_iteration": 2, "score": 0.1})
+        pbt.on_result("strong", {"training_iteration": 2, "score": 0.9})
+        assert pbt.should_perturb("weak", {"training_iteration": 2})
+        decision = pbt.exploit_decision(
+            "weak", {"weak": {"lr": 1e-3}, "strong": {"lr": 1e-2}})
+        assert decision is not None
+        src, cfg = decision
+        assert src == "strong"
+        assert "lr" in cfg
+        # top trial never exploits
+        assert pbt.exploit_decision(
+            "strong", {"weak": {"lr": 1e-3}, "strong": {"lr": 1e-2}}) is None
+
+
+class TestRestore:
+    def test_tuner_restore_completes_unfinished(self, rt, storage):
+        def trainable(config):
+            tune.report({"score": config["x"]})
+
+        exp = "restore_exp"
+        tuner = tune.Tuner(
+            trainable, param_space={"x": tune.grid_search([1, 2, 3])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=tune.RunConfig(name=exp, storage_path=storage))
+        grid = tuner.fit()
+        assert len(grid) == 3
+        # Simulate an interruption: rewrite one trial as still PENDING.
+        import json
+        state_path = os.path.join(storage, exp, "tuner_state.json")
+        with open(state_path) as f:
+            state = json.load(f)
+        state["trials"][1]["state"] = "PENDING"
+        state["trials"][1]["last_result"] = {}
+        with open(state_path, "w") as f:
+            json.dump(state, f)
+        grid2 = tune.Tuner.restore(
+            os.path.join(storage, exp), trainable).fit()
+        assert len(grid2) == 3
+        assert grid2.num_errors == 0
+        assert grid2.get_best_result().config["x"] == 3
+
+
+class TestClassTrainable:
+    def test_class_api(self, rt, storage):
+        class MyTrainable(tune.Trainable):
+            def setup(self, config):
+                self.x = config["x"]
+                self.total = 0
+
+            def step(self):
+                self.total += self.x
+                return {"total": self.total,
+                        "done": self.training_iteration >= 2}
+
+            def save_checkpoint(self, d):
+                with open(os.path.join(d, "t.txt"), "w") as f:
+                    f.write(str(self.total))
+                return d
+
+        grid = tune.Tuner(
+            MyTrainable, param_space={"x": tune.grid_search([1, 10])},
+            tune_config=tune.TuneConfig(metric="total", mode="max"),
+            run_config=tune.RunConfig(storage_path=storage)).fit()
+        best = grid.get_best_result()
+        assert best.config["x"] == 10
+        assert best.metrics["total"] == 30
+
+    def test_with_parameters_class(self, rt, storage):
+        class P(tune.Trainable):
+            def setup(self, config, bonus=0):
+                self.v = config["x"] + bonus
+
+            def step(self):
+                return {"v": self.v, "done": True}
+
+        bound = tune.with_parameters(P, bonus=100)
+        grid = tune.Tuner(
+            bound, param_space={"x": tune.grid_search([1, 2])},
+            tune_config=tune.TuneConfig(metric="v", mode="max"),
+            run_config=tune.RunConfig(storage_path=storage)).fit()
+        assert grid.get_best_result().metrics["v"] == 102
