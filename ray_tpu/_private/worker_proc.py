@@ -159,6 +159,7 @@ class Worker:
         self._actor_spec: Optional[P.ActorSpec] = None
         self._actor_executor: Optional[ThreadPoolExecutor] = None
         self._actor_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._actor_loop_lock = threading.Lock()
         self._shutdown = threading.Event()
 
     # -- plumbing ----------------------------------------------------------
@@ -279,13 +280,19 @@ class Worker:
         return asyncio.run_coroutine_threadsafe(coro, loop).result()
 
     def _ensure_actor_loop(self) -> asyncio.AbstractEventLoop:
-        if self._actor_loop is None:
-            loop = asyncio.new_event_loop()
-            t = threading.Thread(target=loop.run_forever, daemon=True,
-                                 name="actor-asyncio")
-            t.start()
-            self._actor_loop = loop
-        return self._actor_loop
+        # Lock-guarded: concurrent first async calls from the actor's
+        # executor threads must not each create a loop — all coroutines of
+        # one actor share ONE loop (the reference's per-actor asyncio loop,
+        # _raylet.pyx async actor path), or futures created on one loop get
+        # resolved on another and their waiters never wake.
+        with self._actor_loop_lock:
+            if self._actor_loop is None:
+                loop = asyncio.new_event_loop()
+                t = threading.Thread(target=loop.run_forever, daemon=True,
+                                     name="actor-asyncio")
+                t.start()
+                self._actor_loop = loop
+            return self._actor_loop
 
     # -- actor lifecycle ---------------------------------------------------
     def _create_actor(self, spec: P.ActorSpec):

@@ -1,0 +1,244 @@
+"""ray_tpu.serve — online inference serving.
+
+Reference parity: python/ray/serve/api.py (serve.deployment :246,
+serve.run, serve.start, serve.delete, serve.status) over the TPU-native
+control plane: a controller actor reconciles replica actors
+(_private/controller.py), routers do power-of-two-choices scheduling
+(handle.py), @serve.batch pads request batches into XLA-friendly bucket
+shapes (batching.py), and an HTTP proxy fronts applications
+(_private/proxy.py).
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, x):
+            return self.predict(x)
+
+    handle = serve.run(Model.bind())
+    handle.remote({"x": 1}).result()
+"""
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .handle import DeploymentHandle, DeploymentResponse
+from .batching import batch, pad_batch_to_bucket
+
+_proxy = None  # module-level HTTP proxy singleton (per driver process)
+
+
+class Application:
+    """A bound deployment DAG node (reference: serve/api.py Application /
+    dag build via .bind)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    """Reference: serve/deployment.py Deployment."""
+
+    def __init__(self, target: Union[type, Callable], name: str,
+                 config: DeploymentConfig):
+        self._target = target
+        self.name = name
+        self.config = config
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                autoscaling_config: Optional[AutoscalingConfig] = None,
+                ray_actor_options: Optional[Dict] = None,
+                user_config: Optional[Any] = None,
+                health_check_period_s: Optional[float] = None,
+                health_check_timeout_s: Optional[float] = None) -> "Deployment":
+        import copy
+        cfg = copy.deepcopy(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = autoscaling_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        if user_config is not None:
+            cfg.user_config = user_config
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if health_check_timeout_s is not None:
+            cfg.health_check_timeout_s = health_check_timeout_s
+        return Deployment(self._target, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            f"Deployment {self.name} cannot be called directly; deploy via "
+            "serve.run(deployment.bind(...)) and call the handle.")
+
+
+def deployment(_target=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 8,
+               autoscaling_config: Optional[Union[AutoscalingConfig,
+                                                  Dict]] = None,
+               ray_actor_options: Optional[Dict] = None,
+               user_config: Optional[Any] = None,
+               health_check_period_s: float = 2.0,
+               health_check_timeout_s: float = 10.0):
+    """@serve.deployment decorator (reference: serve/api.py:246)."""
+    if isinstance(autoscaling_config, dict):
+        autoscaling_config = AutoscalingConfig(**autoscaling_config)
+
+    def deco(target):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=dict(ray_actor_options or {}),
+            user_config=user_config,
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s)
+        return Deployment(target, name or target.__name__, cfg)
+
+    if _target is not None:
+        return deco(_target)
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# deploy / run
+# ---------------------------------------------------------------------------
+def _collect_deployments(app: Application, out: Dict[str, Application]):
+    """DFS over the bound DAG: nested Applications become handle args."""
+    for a in list(app.args) + list(app.kwargs.values()):
+        if isinstance(a, Application):
+            _collect_deployments(a, out)
+    existing = out.get(app.deployment.name)
+    if existing is not None and existing.deployment._target \
+            is not app.deployment._target:
+        raise ValueError(
+            f"Two different deployments named '{app.deployment.name}'")
+    out[app.deployment.name] = app
+
+
+def _to_controller_spec(app: Application, app_name: str) -> Dict[str, Any]:
+    import cloudpickle
+    d = app.deployment
+
+    def _sub(a):
+        if isinstance(a, Application):
+            return DeploymentHandle(a.deployment.name, app_name)
+        return a
+
+    args = tuple(_sub(a) for a in app.args)
+    kwargs = {k: _sub(v) for k, v in app.kwargs.items()}
+    cfg = d.config
+    return {
+        "name": d.name,
+        "cls_blob": cloudpickle.dumps(d._target),
+        "init_args": args,
+        "init_kwargs": kwargs,
+        "actor_options": dict(cfg.ray_actor_options),
+        "max_ongoing_requests": cfg.max_ongoing_requests,
+        "autoscaling_config": cfg.autoscaling_config,
+        "user_config": cfg.user_config,
+        "initial_replicas": cfg.initial_replicas,
+        "health_check_period_s": cfg.health_check_period_s,
+        "health_check_timeout_s": cfg.health_check_timeout_s,
+    }
+
+
+def start(http_options: Optional[HTTPOptions] = None, *,
+          detached: bool = True):
+    """Start Serve (controller + HTTP proxy) without deploying an app
+    (reference: serve/api.py serve.start)."""
+    global _proxy
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    from ._private.controller import get_controller
+    controller = get_controller()
+    if _proxy is None and http_options is not False:
+        from ._private.proxy import HTTPProxy
+        opts = http_options or HTTPOptions(port=0)
+        _proxy = HTTPProxy(controller, opts.host, opts.port)
+    return controller
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/",
+        http_options: Optional[HTTPOptions] = None,
+        _blocking: bool = True) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress deployment
+    (reference: serve/api.py serve.run)."""
+    controller = start(http_options)
+    apps: Dict[str, Application] = {}
+    _collect_deployments(app, apps)
+    specs = [_to_controller_spec(a, name) for a in apps.values()]
+    ingress = app.deployment.name
+    ray_tpu.get(controller.deploy_application.remote(
+        name, specs, route_prefix, ingress))
+    return DeploymentHandle(ingress, name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    from ._private.controller import get_controller
+    controller = get_controller()
+    routes = ray_tpu.get(controller.get_route_table.remote())
+    for _prefix, (app, ingress) in routes.items():
+        if app == name:
+            return DeploymentHandle(ingress, app)
+    deps = ray_tpu.get(controller.list_deployments.remote())
+    for dep, info in deps.items():
+        if info.get("app") == name:
+            return DeploymentHandle(dep, name)
+    raise ValueError(f"No application named '{name}'")
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def status() -> Dict[str, Any]:
+    """Reference: serve/api.py serve.status."""
+    from ._private.controller import get_controller
+    return ray_tpu.get(get_controller().list_deployments.remote())
+
+
+def delete(name: str):
+    from ._private.controller import get_controller
+    ray_tpu.get(get_controller().delete_application.remote(name))
+
+
+def shutdown():
+    """Tear down all applications, the controller, and the proxy."""
+    global _proxy
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None
+    try:
+        from ._private.controller import CONTROLLER_NAME
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.graceful_shutdown.remote())
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+
+
+def proxy_address() -> Optional[str]:
+    return f"http://{_proxy.host}:{_proxy.port}" if _proxy else None
+
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "batch",
+    "delete", "deployment", "get_app_handle", "get_deployment_handle",
+    "pad_batch_to_bucket", "proxy_address", "run", "shutdown", "start",
+    "status",
+]

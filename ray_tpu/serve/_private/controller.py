@@ -1,0 +1,253 @@
+"""ServeController: the control-plane actor.
+
+Reference: python/ray/serve/_private/controller.py:84 (ServeController) +
+deployment_state.py / application_state.py (reconciliation) +
+autoscaling_state.py (replica autoscaling). One async actor owns desired
+state (applications -> deployments -> target replica counts), runs a
+reconcile loop that starts/stops/heals replica actors, and broadcasts
+replica membership + routes to routers/proxies over long-poll
+(long_poll.py). The request path never touches this actor.
+"""
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from .long_poll import LongPollHost
+from .replica import start_replica
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _DeploymentState:
+    def __init__(self, info: Dict[str, Any]):
+        self.info = info                  # config fields, cls_blob, args
+        self.replicas: List = []          # live actor handles
+        self.replica_seq = 0              # monotonic replica name suffix
+        self.target = info["initial_replicas"]
+        self.last_upscale_ok_t = 0.0      # autoscaling decision debounce
+        self.last_downscale_ok_t = 0.0
+
+
+class ServeController:
+    """Async controller actor (reference: controller.py:84)."""
+
+    def __init__(self):
+        self._apps: Dict[str, List[str]] = {}           # app -> deployments
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._routes: Dict[str, tuple] = {}             # prefix -> (app, dep)
+        self._long_poll = LongPollHost()
+        self._shutdown = False
+        # The reconcile task is started lazily from the first async method:
+        # __init__ runs on the worker's main thread, while async actor
+        # methods run on the dedicated actor event loop (worker_proc.py
+        # _ensure_actor_loop) — the task must live on that loop.
+        self._loop_task = None
+
+    def _ensure_loop_task(self):
+        if self._loop_task is None or self._loop_task.done():
+            if not self._shutdown:
+                self._loop_task = asyncio.get_event_loop().create_task(
+                    self._reconcile_loop())
+
+    # -- API used by serve.run / handles / proxy ---------------------------
+    async def deploy_application(self, app_name: str,
+                                 deployments: List[Dict[str, Any]],
+                                 route_prefix: Optional[str],
+                                 ingress: str) -> bool:
+        """Reference: application_state.py apply_app_config."""
+        self._ensure_loop_task()
+        old = set(self._apps.get(app_name, []))
+        new_names = []
+        for dep in deployments:
+            name = dep["name"]
+            new_names.append(name)
+            existing = self._deployments.get(name)
+            if existing is not None and self._same_target(existing.info, dep):
+                # In-place update: user_config / replica count only.
+                existing.info.update(dep)
+                if dep.get("autoscaling_config") is None:
+                    existing.target = dep["initial_replicas"]
+                if dep.get("user_config") is not None:
+                    for r in existing.replicas:
+                        r.reconfigure.remote(dep["user_config"])
+                continue
+            if existing is not None:
+                await self._stop_deployment(name)
+            self._deployments[name] = _DeploymentState(dep)
+        for stale in old - set(new_names):
+            await self._stop_deployment(stale)
+            self._deployments.pop(stale, None)
+        self._apps[app_name] = new_names
+        if route_prefix is not None:
+            self._routes[route_prefix] = (app_name, ingress)
+            self._long_poll.notify_changed("routes", dict(self._routes))
+        await self._reconcile_once()
+        return True
+
+    @staticmethod
+    def _same_target(old_info: Dict, new_info: Dict) -> bool:
+        return (old_info["cls_blob"] == new_info["cls_blob"]
+                and old_info["init_args"] == new_info["init_args"]
+                and old_info["init_kwargs"] == new_info["init_kwargs"]
+                and old_info["actor_options"] == new_info["actor_options"])
+
+    async def delete_application(self, app_name: str) -> bool:
+        self._ensure_loop_task()
+        for name in self._apps.pop(app_name, []):
+            await self._stop_deployment(name)
+            self._deployments.pop(name, None)
+        self._routes = {p: v for p, v in self._routes.items()
+                        if v[0] != app_name}
+        self._long_poll.notify_changed("routes", dict(self._routes))
+        return True
+
+    async def graceful_shutdown(self) -> bool:
+        self._shutdown = True
+        for name in list(self._deployments):
+            await self._stop_deployment(name)
+        self._deployments.clear()
+        self._apps.clear()
+        return True
+
+    async def listen_for_change(self, snapshot_ids: Dict[str, int],
+                                timeout_s: float = 30.0):
+        self._ensure_loop_task()
+        return await self._long_poll.listen_for_change(snapshot_ids,
+                                                       timeout_s)
+
+    async def get_replica_snapshot(self, deployment: str) -> List:
+        self._ensure_loop_task()
+        st = self._deployments.get(deployment)
+        return list(st.replicas) if st else []
+
+    async def get_route_table(self) -> Dict[str, tuple]:
+        self._ensure_loop_task()
+        return dict(self._routes)
+
+    async def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        self._ensure_loop_task()
+        return {
+            name: {"target_replicas": st.target,
+                   "live_replicas": len(st.replicas),
+                   "app": next((a for a, ds in self._apps.items()
+                                if name in ds), None)}
+            for name, st in self._deployments.items()
+        }
+
+    # -- reconciliation ----------------------------------------------------
+    async def _stop_deployment(self, name: str):
+        st = self._deployments.get(name)
+        if st is None:
+            return
+        for r in st.replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        st.replicas = []
+        self._long_poll.notify_changed(f"replicas::{name}", [])
+
+    def _start_one(self, name: str, st: _DeploymentState):
+        info = st.info
+        st.replica_seq += 1
+        return start_replica(
+            name, st.replica_seq, info["cls_blob"], info["init_args"],
+            info["init_kwargs"], info["actor_options"],
+            info["max_ongoing_requests"], info.get("user_config"))
+
+    async def _reconcile_once(self):
+        for name, st in self._deployments.items():
+            changed = False
+            while len(st.replicas) < st.target:
+                st.replicas.append(self._start_one(name, st))
+                changed = True
+            while len(st.replicas) > st.target:
+                victim = st.replicas.pop()
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:
+                    pass
+                changed = True
+            if changed:
+                self._long_poll.notify_changed(
+                    f"replicas::{name}", list(st.replicas))
+
+    async def _health_and_autoscale(self):
+        now = time.monotonic()
+        for name, st in self._deployments.items():
+            # Health: replace dead replicas (reference:
+            # deployment_state.py check_and_update_replicas).
+            alive, dead = [], 0
+            for r in st.replicas:
+                try:
+                    ok = await asyncio.wait_for(
+                        r.check_health.remote(),
+                        timeout=st.info["health_check_timeout_s"])
+                    if ok:
+                        alive.append(r)
+                    else:
+                        dead += 1
+                except Exception:
+                    dead += 1
+            if dead or len(alive) != len(st.replicas):
+                st.replicas = alive
+                self._long_poll.notify_changed(
+                    f"replicas::{name}", list(st.replicas))
+            # Autoscale on total ongoing requests (reference:
+            # autoscaling_policy.py replica-count policy).
+            cfg = st.info.get("autoscaling_config")
+            if cfg is None or not st.replicas:
+                continue
+            try:
+                lens = await asyncio.gather(
+                    *[r.get_queue_len.remote() for r in st.replicas])
+            except Exception:
+                continue
+            desired = cfg.desired_replicas(float(sum(lens)),
+                                           len(st.replicas))
+            if desired > st.target:
+                if st.last_upscale_ok_t == 0.0:
+                    st.last_upscale_ok_t = now
+                if now - st.last_upscale_ok_t >= cfg.upscale_delay_s:
+                    st.target = desired
+                    st.last_upscale_ok_t = 0.0
+                st.last_downscale_ok_t = 0.0
+            elif desired < st.target:
+                if st.last_downscale_ok_t == 0.0:
+                    st.last_downscale_ok_t = now
+                if now - st.last_downscale_ok_t >= cfg.downscale_delay_s:
+                    st.target = desired
+                    st.last_downscale_ok_t = 0.0
+                st.last_upscale_ok_t = 0.0
+            else:
+                st.last_upscale_ok_t = st.last_downscale_ok_t = 0.0
+
+    async def _reconcile_loop(self):
+        tick = 0
+        while not self._shutdown:
+            try:
+                await self._reconcile_once()
+                if tick % 4 == 1:
+                    await self._health_and_autoscale()
+            except Exception:
+                pass
+            tick += 1
+            await asyncio.sleep(0.5)
+
+    async def ping(self) -> bool:
+        self._ensure_loop_task()
+        return True
+
+
+def get_controller():
+    """Get-or-create the named controller actor (reference:
+    serve/_private/api.py _get_global_client)."""
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    handle = ray_tpu.remote(ServeController).options(
+        name=CONTROLLER_NAME, max_concurrency=1000).remote()
+    ray_tpu.get(handle.ping.remote())
+    return handle

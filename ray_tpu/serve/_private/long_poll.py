@@ -1,0 +1,88 @@
+"""Long-poll config broadcast.
+
+Reference: python/ray/serve/_private/long_poll.py — LongPollHost (:204)
+held by the controller publishes keyed snapshots; LongPollClient (:66)
+blocks on `listen_for_change(snapshot_ids)` and wakes when any watched
+key advances. Here the host is plain asyncio state inside the async
+controller actor; clients run a daemon thread of repeated long-poll actor
+calls (the control plane stays off the TPU data path entirely).
+"""
+import asyncio
+import threading
+from typing import Any, Callable, Dict
+
+
+class LongPollHost:
+    """Keyed snapshot store with async change notification."""
+
+    def __init__(self):
+        self._snapshots: Dict[str, Any] = {}
+        self._versions: Dict[str, int] = {}
+        self._event = asyncio.Event()
+
+    def notify_changed(self, key: str, snapshot: Any):
+        self._snapshots[key] = snapshot
+        self._versions[key] = self._versions.get(key, 0) + 1
+        self._event.set()
+
+    async def listen_for_change(self, snapshot_ids: Dict[str, int],
+                                timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Return {key: (version, snapshot)} for every watched key whose
+        version is newer than the client's; block (up to timeout) when
+        nothing changed.  Empty dict on timeout — the client just re-polls.
+        """
+        deadline = asyncio.get_event_loop().time() + timeout_s
+        while True:
+            updates = {
+                key: (self._versions[key], self._snapshots[key])
+                for key, seen in snapshot_ids.items()
+                if self._versions.get(key, 0) > seen
+            }
+            if updates:
+                return updates
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                return {}
+            self._event.clear()
+            try:
+                await asyncio.wait_for(self._event.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                return {}
+
+
+class LongPollClient:
+    """Daemon-thread client: watches keys on the controller handle and
+    invokes callbacks with fresh snapshots (reference: long_poll.py:66)."""
+
+    def __init__(self, controller_handle,
+                 key_listeners: Dict[str, Callable[[Any], None]]):
+        self._controller = controller_handle
+        self._listeners = dict(key_listeners)
+        self._snapshot_ids = {k: 0 for k in key_listeners}
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serve-long-poll")
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        import ray_tpu
+        while not self._stopped.is_set():
+            try:
+                updates = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        self._snapshot_ids, 5.0),
+                    timeout=60.0)
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                self._stopped.wait(0.5)
+                continue
+            for key, (version, snapshot) in (updates or {}).items():
+                self._snapshot_ids[key] = version
+                try:
+                    self._listeners[key](snapshot)
+                except Exception:
+                    pass

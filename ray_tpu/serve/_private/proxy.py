@@ -1,0 +1,138 @@
+"""HTTP proxy: routes requests to application ingress deployments.
+
+Reference: python/ray/serve/_private/proxy.py:752 (HTTPProxy),
+proxy_request (:418) — per-node proxy matching routes by longest prefix
+and forwarding to a DeploymentHandle; the route table is pushed from the
+controller over long-poll.
+
+Implementation: a ThreadingHTTPServer in the driver process (stdlib-only;
+the image bakes no ASGI server). Each request thread blocks on the
+handle's DeploymentResponse, which is fine — the proxy is control-plane;
+replica compute is where TPU time goes.
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from .long_poll import LongPollClient
+
+
+class _ProxyState:
+    def __init__(self, controller):
+        self._controller = controller
+        self._routes: Dict[str, tuple] = {}
+        self._handles: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._long_poll = LongPollClient(
+            controller, {"routes": self._update_routes})
+        import ray_tpu
+        try:
+            self._update_routes(
+                ray_tpu.get(controller.get_route_table.remote()))
+        except Exception:
+            pass
+
+    def _update_routes(self, routes: Dict[str, tuple]):
+        with self._lock:
+            self._routes = dict(routes or {})
+
+    def match(self, path: str) -> Optional[tuple]:
+        """Longest-prefix route match (reference: proxy.py route matching)."""
+        with self._lock:
+            best = None
+            for prefix, target in self._routes.items():
+                norm = prefix.rstrip("/") or "/"
+                if path == norm or path.startswith(
+                        norm if norm.endswith("/") else norm + "/") \
+                        or norm == "/":
+                    if best is None or len(norm) > len(best[0]):
+                        best = (norm, target)
+            return best[1] if best else None
+
+    def handle_for(self, deployment: str, app: str):
+        with self._lock:
+            h = self._handles.get(deployment)
+        if h is None:
+            from ..handle import DeploymentHandle
+            h = DeploymentHandle(deployment, app)
+            with self._lock:
+                self._handles[deployment] = h
+        return h
+
+    def stop(self):
+        self._long_poll.stop()
+
+
+def _make_handler(proxy_state: _ProxyState):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # silence per-request stderr spam
+            pass
+
+        def _respond(self, code: int, body, content_type="application/json"):
+            if isinstance(body, (dict, list)):
+                payload = json.dumps(body).encode()
+            elif isinstance(body, str):
+                payload = body.encode()
+                content_type = "text/plain"
+            elif isinstance(body, bytes):
+                payload = body
+                content_type = "application/octet-stream"
+            else:
+                payload = json.dumps({"result": repr(body)}).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _serve(self):
+            if self.path == "/-/healthz":
+                return self._respond(200, "success")
+            if self.path == "/-/routes":
+                with proxy_state._lock:
+                    return self._respond(
+                        200, {p: t[0] for p, t in
+                              proxy_state._routes.items()})
+            target = proxy_state.match(self.path.split("?")[0])
+            if target is None:
+                return self._respond(404, {"error": "no route"})
+            app, deployment = target
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            try:
+                body = json.loads(raw) if raw else None
+            except Exception:
+                body = raw.decode(errors="replace")
+            request = {"path": self.path, "method": self.command,
+                       "body": body}
+            try:
+                handle = proxy_state.handle_for(deployment, app)
+                result = handle.remote(request).result(timeout_s=60.0)
+                self._respond(200, result)
+            except Exception as e:
+                self._respond(500, {"error": str(e)})
+
+        do_GET = do_POST = do_PUT = do_DELETE = _serve
+
+    return Handler
+
+
+class HTTPProxy:
+    """Proxy server lifecycle (reference: proxy.py HTTPProxy)."""
+
+    def __init__(self, controller, host: str = "127.0.0.1",
+                 port: int = 8000):
+        self._state = _ProxyState(controller)
+        self._server = ThreadingHTTPServer(
+            (host, port), _make_handler(self._state))
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="serve-http-proxy")
+        self._thread.start()
+
+    def stop(self):
+        self._state.stop()
+        self._server.shutdown()
+        self._server.server_close()

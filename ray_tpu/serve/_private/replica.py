@@ -1,0 +1,102 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Reference: python/ray/serve/_private/replica.py — the replica actor
+receives requests pushed by routers, tracks ongoing-request count (the
+router's power-of-two signal), runs health checks and reconfigure.
+
+TPU note: a replica is where a `jax.jit` model lives; the actor's
+`ray_actor_options` reserve TPU chips so the scheduler gives each replica
+exclusive chips, and requests run through serve.batch batching so XLA
+compiles a handful of bucket shapes once.
+"""
+import asyncio
+import inspect
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+class Replica:
+    """User-code host (reference: replica.py UserCallableWrapper)."""
+
+    def __init__(self, cls_blob: bytes, init_args: tuple,
+                 init_kwargs: dict, deployment_name: str,
+                 user_config: Optional[Any] = None):
+        import cloudpickle
+        target = cloudpickle.loads(cls_blob)
+        self._deployment_name = deployment_name
+        self._ongoing = 0
+        if inspect.isclass(target):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            # Function deployment: the function IS the request handler.
+            self._callable = target
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    def _apply_user_config(self, user_config):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is None:
+            raise ValueError(
+                f"Deployment {self._deployment_name} passed user_config but "
+                "its class defines no reconfigure(user_config) method")
+        fn(user_config)
+
+    async def handle_request(self, method_name: str, args: tuple,
+                             kwargs: dict) -> Any:
+        """Run one request through the user callable.
+
+        Sync user code is offloaded to a thread so the replica's event loop
+        keeps serving concurrent requests (reference fibers/asyncio model:
+        replica.py + transport/fiber.h).
+        """
+        self._ongoing += 1
+        try:
+            if inspect.isfunction(self._callable) or inspect.ismethod(
+                    self._callable) or not hasattr(
+                        self._callable, method_name):
+                target = self._callable  # function deployment
+            else:
+                target = getattr(self._callable, method_name)
+            if inspect.iscoroutinefunction(target):
+                return await target(*args, **kwargs)
+            return await asyncio.get_event_loop().run_in_executor(
+                None, lambda: target(*args, **kwargs))
+        finally:
+            self._ongoing -= 1
+
+    async def get_queue_len(self) -> int:
+        """Power-of-two probe (reference: replica scheduler queue-length
+        probes, pow_2_scheduler.py:52)."""
+        return self._ongoing
+
+    async def reconfigure(self, user_config) -> bool:
+        self._apply_user_config(user_config)
+        return True
+
+    async def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            out = fn()
+            if inspect.isawaitable(out):
+                out = await out
+            return bool(out) if out is not None else True
+        return True
+
+    async def prepare_shutdown(self) -> bool:
+        fn = getattr(self._callable, "__del__", None)
+        return True
+
+
+def start_replica(deployment_name: str, replica_idx: int, cls_blob: bytes,
+                  init_args: tuple, init_kwargs: dict,
+                  actor_options: Dict[str, Any],
+                  max_ongoing_requests: int,
+                  user_config: Optional[Any] = None):
+    """Spawn one replica actor (reference: deployment_state.py
+    _start_replica)."""
+    opts = dict(actor_options)
+    opts.setdefault("name", f"SERVE_REPLICA::{deployment_name}#{replica_idx}")
+    opts["max_concurrency"] = max(int(max_ongoing_requests) * 2, 16)
+    return ray_tpu.remote(Replica).options(**opts).remote(
+        cls_blob, init_args, init_kwargs, deployment_name, user_config)

@@ -1,0 +1,114 @@
+"""@serve.batch — dynamic request batching.
+
+Reference: python/ray/serve/batching.py (@serve.batch decorator). On TPU
+this is the load-bearing inference feature: individual requests are
+queued and flushed as one batch into the wrapped method, so the replica's
+`jax.jit` model sees a small set of padded bucket sizes (powers of two up
+to max_batch_size) and compiles once per bucket instead of once per
+request count — recompilation is the classic XLA serving footgun.
+"""
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+def _bucket(n: int, max_batch_size: int) -> int:
+    """Next power-of-two bucket ≥ n (≤ max_batch_size)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max_batch_size)
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = batch_wait_timeout_s
+        self._queue: List = []           # (item, future)
+        self._flusher: Optional[asyncio.Task] = None
+
+    async def submit(self, instance, item: Any) -> Any:
+        fut = asyncio.get_event_loop().create_future()
+        self._queue.append((item, fut))
+        if len(self._queue) >= self._max:
+            await self._flush(instance)
+        elif self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.get_event_loop().create_task(
+                self._delayed_flush(instance))
+        return await fut
+
+    async def _delayed_flush(self, instance):
+        await asyncio.sleep(self._wait)
+        await self._flush(instance)
+
+    async def _flush(self, instance):
+        if not self._queue:
+            return
+        batch, self._queue = self._queue, []
+        items = [b[0] for b in batch]
+        try:
+            if instance is not None:
+                outs = self._fn(instance, items)
+            else:
+                outs = self._fn(items)
+            if asyncio.iscoroutine(outs):
+                outs = await outs
+            if len(outs) != len(items):
+                raise ValueError(
+                    f"@serve.batch function returned {len(outs)} results "
+                    f"for a batch of {len(items)}")
+            for (_, fut), out in zip(batch, outs):
+                if not fut.done():
+                    fut.set_result(out)
+        except Exception as e:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorate an async method taking List[item] -> List[result]; callers
+    invoke it with single items (reference: serve/batching.py)."""
+
+    def deco(fn):
+        queues = {}  # per-instance (or None for free functions)
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:
+                instance, item = args
+            elif len(args) == 1:
+                instance, item = None, args[0]
+            else:
+                raise TypeError("@serve.batch methods take one argument")
+            key = id(instance)
+            q = queues.get(key)
+            if q is None:
+                q = queues[key] = _BatchQueue(
+                    fn, max_batch_size, batch_wait_timeout_s)
+            return await q.submit(instance, item)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco
+
+
+def pad_batch_to_bucket(arrays, max_batch_size: int, pad_value=0):
+    """Stack a list of equal-shape arrays into one batch padded to the next
+    power-of-two bucket — the jit-cache-friendly shape policy. Returns
+    (batched_array, real_count)."""
+    import numpy as np
+    n = len(arrays)
+    b = _bucket(n, max_batch_size)
+    stacked = np.stack(arrays)
+    if b > n:
+        pad = np.full((b - n,) + stacked.shape[1:], pad_value,
+                      dtype=stacked.dtype)
+        stacked = np.concatenate([stacked, pad])
+    return stacked, n

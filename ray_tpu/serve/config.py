@@ -1,0 +1,56 @@
+"""Serve configuration objects.
+
+Reference parity: python/ray/serve/config.py (DeploymentConfig,
+AutoscalingConfig, HTTPOptions) — re-designed for TPU replicas: a
+deployment's `ray_actor_options` may reserve TPU chips, and batching
+(batching.py) pads to fixed bucket shapes so each replica's jitted model
+compiles once per bucket instead of once per request shape.
+"""
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Reference: serve/config.py AutoscalingConfig +
+    serve/_private/autoscaling_policy.py (replica-count policy)."""
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 10.0
+    look_back_period_s: float = 5.0
+
+    def desired_replicas(self, total_ongoing: float, current: int) -> int:
+        if current == 0:
+            return max(self.min_replicas, 1)
+        want = total_ongoing / max(self.target_ongoing_requests, 1e-9)
+        import math
+        want = int(math.ceil(want))
+        return max(self.min_replicas, min(self.max_replicas, want))
+
+
+@dataclass
+class DeploymentConfig:
+    """Reference: serve/config.py DeploymentConfig."""
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 10.0
+    user_config: Optional[Any] = None
+    graceful_shutdown_timeout_s: float = 5.0
+
+    @property
+    def initial_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            return self.autoscaling_config.min_replicas
+        return self.num_replicas
+
+
+@dataclass
+class HTTPOptions:
+    """Reference: serve/config.py HTTPOptions."""
+    host: str = "127.0.0.1"
+    port: int = 8000

@@ -1,0 +1,154 @@
+"""DeploymentHandle: the client-side request path.
+
+Reference: python/ray/serve/handle.py (DeploymentHandle/DeploymentResponse)
++ serve/_private/router.py:321,578 (Router.assign_request) +
+replica_scheduler/pow_2_scheduler.py:52 (PowerOfTwoChoicesReplicaScheduler).
+
+The router keeps a local in-flight count per replica (decremented via the
+object-ref done callback) and samples two replicas per request, routing to
+the less loaded — the power-of-two-choices policy. Replica membership is
+pushed by the controller over long-poll, so the data path never blocks on
+the control plane.
+"""
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from .._private import state as _state
+from ._private.long_poll import LongPollClient
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference: handle.py
+    DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def __await__(self):
+        return self._ref.__await__()
+
+
+class _Router:
+    """Pow-2 replica scheduler over the current replica set."""
+
+    def __init__(self, deployment_name: str, controller):
+        self._deployment = deployment_name
+        self._lock = threading.Lock()
+        self._replicas: List = []
+        self._inflight: Dict[int, int] = {}
+        self._ready = threading.Event()
+        self._long_poll = LongPollClient(
+            controller,
+            {f"replicas::{deployment_name}": self._update_replicas})
+        # Seed synchronously so the first request doesn't wait a poll cycle.
+        try:
+            snap = ray_tpu.get(
+                controller.get_replica_snapshot.remote(deployment_name))
+            if snap:
+                self._update_replicas(snap)
+        except Exception:
+            pass
+
+    def _update_replicas(self, replicas: List):
+        with self._lock:
+            self._replicas = list(replicas)
+            self._inflight = {i: self._inflight.get(i, 0)
+                              for i in range(len(self._replicas))}
+        if self._replicas:
+            self._ready.set()
+        else:
+            self._ready.clear()
+
+    def _pick(self) -> int:
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        a, b = random.sample(range(n), 2)
+        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+
+    def assign_request(self, method_name: str, args: tuple, kwargs: dict,
+                       timeout_s: float = 30.0):
+        if not self._ready.wait(timeout=timeout_s):
+            raise TimeoutError(
+                f"No replicas of '{self._deployment}' became available "
+                f"within {timeout_s}s")
+        with self._lock:
+            idx = self._pick()
+            replica = self._replicas[idx]
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        ref = replica.handle_request.remote(method_name, args, kwargs)
+
+        def _done(_):
+            with self._lock:
+                if idx in self._inflight and self._inflight[idx] > 0:
+                    self._inflight[idx] -= 1
+        try:
+            ref.future().add_done_callback(_done)
+        except Exception:
+            pass
+        return ref
+
+    def shutdown(self):
+        self._long_poll.stop()
+
+
+class DeploymentHandle:
+    """Callable handle to a deployment (reference: handle.py:~200).
+
+    Picklable: reconnects to the named controller actor on deserialize, so
+    handles can be passed into other replicas for model composition.
+    """
+
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method = method_name
+        self._router: Optional[_Router] = None
+        self._lock = threading.Lock()
+
+    # -- pickling ----------------------------------------------------------
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method))
+
+    # -- routing -----------------------------------------------------------
+    def _get_router(self) -> _Router:
+        with self._lock:
+            if self._router is None:
+                from ._private.controller import get_controller
+                self._router = _Router(self.deployment_name, get_controller())
+            return self._router
+
+    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self.app_name,
+                             method_name or self._method)
+        h._router = self._router
+        return h
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
+                      else v) for k, v in kwargs.items()}
+        ref = self._get_router().assign_request(self._method, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def shutdown(self):
+        with self._lock:
+            if self._router is not None:
+                self._router.shutdown()
+                self._router = None

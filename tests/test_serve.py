@@ -1,0 +1,195 @@
+"""Serve layer tests (reference strategy: serve/tests/ unit + e2e suites,
+e.g. test_deploy.py, test_handle.py, test_batching.py, test_proxy.py)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.config import AutoscalingConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_apps():
+    yield
+    # Delete apps between tests but keep controller/proxy warm.
+    try:
+        for app in {i.get("app") for i in serve.status().values()}:
+            if app:
+                serve.delete(app)
+    except Exception:
+        pass
+
+
+def test_function_deployment():
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind(), name="fn_app", route_prefix="/double")
+    assert handle.remote(21).result(timeout_s=30) == 42
+
+
+def test_class_deployment_multiple_replicas():
+    @serve.deployment(num_replicas=3)
+    class Counter:
+        def __init__(self, base):
+            self.base = base
+
+        def __call__(self, x):
+            return self.base + x
+
+        def which(self):
+            import os
+            return os.getpid()
+
+    handle = serve.run(Counter.bind(100), name="cls_app",
+                       route_prefix="/counter")
+    results = [handle.remote(i).result(timeout_s=30) for i in range(10)]
+    assert results == [100 + i for i in range(10)]
+    # Pow-2 routing should spread across >1 replica process.
+    pids = {handle.which.remote().result(timeout_s=30) for _ in range(20)}
+    assert len(pids) >= 2
+
+
+def test_model_composition():
+    @serve.deployment
+    class Preprocessor:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre  # DeploymentHandle
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result(timeout_s=30)
+            return y * 10
+
+    handle = serve.run(Model.bind(Preprocessor.bind()), name="comp_app",
+                       route_prefix="/comp")
+    assert handle.remote(4).result(timeout_s=30) == 50
+
+
+def test_serve_batch():
+    @serve.deployment
+    class BatchModel:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        async def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 2 for i in items]
+
+        def seen(self):
+            return self.batch_sizes
+
+    handle = serve.run(BatchModel.bind(), name="batch_app",
+                       route_prefix="/batch")
+    responses = [handle.remote(i) for i in range(8)]
+    assert [r.result(timeout_s=30) for r in responses] == [
+        i * 2 for i in range(8)]
+    sizes = handle.seen.remote().result(timeout_s=30)
+    assert max(sizes) > 1  # actually batched
+
+
+def test_http_proxy():
+    @serve.deployment
+    def ingress(request):
+        return {"method": request["method"], "echo": request["body"]}
+
+    serve.run(ingress.bind(), name="http_app", route_prefix="/api")
+    addr = serve.proxy_address()
+    assert addr is not None
+    # health endpoint
+    with urllib.request.urlopen(addr + "/-/healthz", timeout=10) as r:
+        assert r.read() == b"success"
+    req = urllib.request.Request(
+        addr + "/api", data=json.dumps({"x": 5}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out == {"method": "POST", "echo": {"x": 5}}
+
+
+def test_user_config_reconfigure():
+    @serve.deployment(user_config={"scale": 2})
+    class Scaler:
+        def __init__(self):
+            self.scale = 1
+
+        def reconfigure(self, cfg):
+            self.scale = cfg["scale"]
+
+        def __call__(self, x):
+            return x * self.scale
+
+    handle = serve.run(Scaler.bind(), name="cfg_app", route_prefix="/scale")
+    assert handle.remote(3).result(timeout_s=30) == 6
+    # In-place redeploy with new user_config (same code/args).
+    serve.run(Scaler.options(user_config={"scale": 5}).bind(),
+              name="cfg_app", route_prefix="/scale")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if handle.remote(3).result(timeout_s=30) == 15:
+            break
+        time.sleep(0.2)
+    assert handle.remote(3).result(timeout_s=30) == 15
+
+
+def test_status_and_delete():
+    @serve.deployment(num_replicas=2)
+    def noop(_):
+        return "ok"
+
+    serve.run(noop.bind(), name="del_app", route_prefix="/del")
+    st = serve.status()
+    assert "noop" in st and st["noop"]["target_replicas"] == 2
+    serve.delete("del_app")
+    assert "noop" not in serve.status()
+
+
+def test_autoscaling_policy_math():
+    cfg = AutoscalingConfig(min_replicas=1, max_replicas=10,
+                            target_ongoing_requests=2.0)
+    assert cfg.desired_replicas(0.0, 4) == 1      # idle -> min
+    assert cfg.desired_replicas(8.0, 2) == 4      # 8 ongoing / 2 per = 4
+    assert cfg.desired_replicas(100.0, 4) == 10   # capped at max
+    assert cfg.desired_replicas(0.0, 0) == 1
+
+
+def test_autoscaling_e2e_upscale():
+    @serve.deployment(autoscaling_config=AutoscalingConfig(
+        min_replicas=1, max_replicas=3, target_ongoing_requests=1.0,
+        upscale_delay_s=0.0, downscale_delay_s=60.0))
+    class Slow:
+        async def __call__(self, x):
+            import asyncio
+            await asyncio.sleep(12.0)
+            return x
+
+    handle = serve.run(Slow.bind(), name="auto_app", route_prefix="/slow")
+    responses = [handle.remote(i) for i in range(6)]
+    deadline = time.time() + 30
+    scaled = False
+    while time.time() < deadline:
+        info = serve.status().get("Slow", {})
+        if info.get("target_replicas", 1) > 1:
+            scaled = True
+            break
+        time.sleep(0.5)
+    assert scaled, f"no upscale happened: {serve.status()}"
+    for r in responses:
+        r.result(timeout_s=60)
