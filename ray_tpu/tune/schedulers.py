@@ -70,6 +70,9 @@ class AsyncHyperBandScheduler(TrialScheduler):
             self.set_metric(metric, mode or "max")
         # rung milestone -> recorded scores
         self._rungs: Dict[int, List[float]] = {}
+        # rung milestone -> trial_ids already recorded there (a trial hits
+        # each rung once even when its reports skip the exact milestone).
+        self._rung_members: Dict[int, set] = {}
         milestone = grace_period
         self._milestones = []
         while milestone < max_t:
@@ -86,7 +89,12 @@ class AsyncHyperBandScheduler(TrialScheduler):
             return CONTINUE
         decision = CONTINUE
         for m in self._milestones:
-            if t == m:
+            # Reference ASHA cuts at t >= milestone (async_hyperband.py):
+            # trials whose report cadence skips the exact milestone value
+            # still get evaluated, once, at the first report past it.
+            members = self._rung_members.setdefault(m, set())
+            if t >= m and trial_id not in members:
+                members.add(trial_id)
                 score = self._score(result)
                 rung = self._rungs.setdefault(m, [])
                 rung.append(score)

@@ -35,7 +35,8 @@ class QUniform(Domain):
 
     def sample(self, rng):
         v = rng.uniform(self.lower, self.upper)
-        return round(v / self.q) * self.q
+        # Clamp: rounding to a q-multiple can land outside [lower, upper].
+        return min(self.upper, max(self.lower, round(v / self.q) * self.q))
 
 
 class LogUniform(Domain):
@@ -65,7 +66,8 @@ class QRandInt(Domain):
 
     def sample(self, rng):
         v = rng.randint(self.lower, self.upper)
-        return int(round(v / self.q) * self.q)
+        return int(min(self.upper,
+                       max(self.lower, round(v / self.q) * self.q)))
 
 
 class Choice(Domain):
@@ -135,15 +137,20 @@ def _is_grid(v) -> bool:
     return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
 
 
+_SEP = "\x1f"  # internal nesting separator
+
+
 def _flatten_space(space: Dict[str, Any], prefix: str = ""
                    ) -> Dict[str, Any]:
     """Flatten nested dict spaces to path keys so nested grid_search
     participates in the cartesian product (reference: format_vars /
-    resolve_nested_dict in tune/search/variant_generator.py)."""
+    resolve_nested_dict in tune/search/variant_generator.py). The internal
+    separator is \\x1f, not '/', so user keys containing slashes survive
+    the round trip."""
     flat: Dict[str, Any] = {}
     for k, v in space.items():
         if isinstance(v, dict) and not _is_grid(v):
-            flat.update(_flatten_space(v, prefix + str(k) + "/"))
+            flat.update(_flatten_space(v, prefix + str(k) + _SEP))
         else:
             flat[prefix + str(k)] = v
     return flat
@@ -152,7 +159,7 @@ def _flatten_space(space: Dict[str, Any], prefix: str = ""
 def _unflatten(cfg: Dict[str, Any]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     for k, v in cfg.items():
-        parts = k.split("/")
+        parts = k.split(_SEP)
         d = out
         for p in parts[:-1]:
             d = d.setdefault(p, {})

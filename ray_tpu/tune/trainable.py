@@ -52,6 +52,16 @@ def wrap_trainable(trainable) -> Callable[[Dict], None]:
                 ckpt = session.get_checkpoint()
                 if ckpt is not None:
                     obj.load_checkpoint(ckpt.path)
+                    # Restore the iteration counter alongside model state so
+                    # a retried trial continues counting (and its stop
+                    # condition / scheduler rungs) where it left off
+                    # (reference: Trainable.restore, tune/trainable/).
+                    meta_path = os.path.join(ckpt.path, ".tune_metadata")
+                    if os.path.exists(meta_path):
+                        import json
+                        with open(meta_path) as f:
+                            obj.training_iteration = json.load(f).get(
+                                "training_iteration", 0)
                 while True:
                     result = obj.step()
                     obj.training_iteration += 1
@@ -60,7 +70,14 @@ def wrap_trainable(trainable) -> Callable[[Dict], None]:
                     ckpt_dir = tempfile.mkdtemp(prefix="trainable_ckpt_")
                     try:
                         saved = obj.save_checkpoint(ckpt_dir)
+                        meta_dir = saved if isinstance(saved, str) \
+                            else ckpt_dir
                         if saved or os.listdir(ckpt_dir):
+                            import json
+                            with open(os.path.join(
+                                    meta_dir, ".tune_metadata"), "w") as f:
+                                json.dump({"training_iteration":
+                                           obj.training_iteration}, f)
                             # session.report copies the dir into the trial
                             # dir, so the temp original is always removable.
                             session.report(

@@ -229,7 +229,9 @@ class _TuneController:
             api.get(t.run_ref, timeout=30)
             self._drain_reports(t)
             t.state = TERMINATED
-        except BaseException as e:  # noqa: BLE001
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001
             self._drain_reports(t)
             t.failures += 1
             if t.failures <= self._rc.failure_config.max_failures:
@@ -323,6 +325,9 @@ class _TuneController:
                 running.append(t)
             # poll: completed run() refs first, then live report buffers
             done_refs = [t.run_ref for t in running]
+            if not done_refs:
+                time.sleep(0.05)
+                continue
             ready, _ = api.wait(done_refs, num_returns=1, timeout=0.05)
             ready_set = {r.id.binary() for r in ready}
             for t in list(running):
