@@ -243,8 +243,17 @@ class Worker:
             if spec.actor_id is not None:
                 if self._actor_instance is None:
                     raise RuntimeError("actor task on non-actor worker")
-                method = getattr(self._actor_instance, spec.method_name)
-                result = method(*args, **kwargs)
+                if spec.method_name == "__adag_exec_loop__":
+                    # Compiled-DAG persistent loop (reference: the
+                    # worker-side executable-task loop in
+                    # dag/compiled_dag_node.py); occupies this executor
+                    # slot until the DAG is torn down.
+                    from ..dag.compiled import _run_actor_loop
+                    result = _run_actor_loop(self._actor_instance,
+                                             *args, **kwargs)
+                else:
+                    method = getattr(self._actor_instance, spec.method_name)
+                    result = method(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     result = self._run_coroutine(result)
             else:

@@ -289,6 +289,12 @@ class RemoteFunction:
         rf._fn_id = fn_id
         return rf
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (reference: dag_node binding in
+        remote_function.py / dag/function_node.py)."""
+        from .dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         if not state.is_initialized():
             init(ignore_reinit_error=True)
@@ -334,6 +340,11 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._actor_method_call(
             self._name, args, kwargs, self._opts)
+
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (reference: dag/class_node.py)."""
+        from .dag import ClassMethodNode
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -517,6 +528,8 @@ def remote(*args, **options):
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None):
     """Reference parity: worker.py:2649 ray.get."""
+    if hasattr(refs, "_compiled_dag_get"):  # CompiledDAGRef duck-type
+        return refs._compiled_dag_get(timeout)
     rt = state.current()
     single = isinstance(refs, ObjectRef)
     ref_list = [refs] if single else list(refs)

@@ -1,0 +1,161 @@
+"""DAG / compiled graph tests (reference strategy:
+dag/tests/experimental/test_accelerated_dag.py + test_dag_api.py)."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, inc):
+        self.inc = inc
+        self.calls = 0
+
+    def add(self, x):
+        self.calls += 1
+        return x + self.inc
+
+    def add2(self, x, y):
+        return x + y
+
+    def boom(self, x):
+        raise ValueError("boom")
+
+    def ncalls(self):
+        return self.calls
+
+
+def test_dynamic_dag_execute():
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    a = Adder.remote(10)
+    with InputNode() as inp:
+        d = double.bind(inp)
+        out = a.add.bind(d)
+    assert ray_tpu.get(out.execute(5)) == 20
+    assert ray_tpu.get(out.execute(7)) == 24
+
+
+def test_dynamic_multi_output_and_input_attr():
+    @ray_tpu.remote
+    def mul(x, k):
+        return x * k
+
+    with InputNode() as inp:
+        m1 = mul.bind(inp["a"], 2)
+        m2 = mul.bind(inp["b"], 3)
+        dag = MultiOutputNode([m1, m2])
+    r1, r2 = dag.execute(a=5, b=7)
+    assert ray_tpu.get(r1) == 10 and ray_tpu.get(r2) == 21
+
+
+def test_compiled_dag_chain():
+    a = Adder.remote(1)
+    b = Adder.remote(100)
+    with InputNode() as inp:
+        mid = a.add.bind(inp)
+        out = b.add.bind(mid)
+    compiled = out.experimental_compile()
+    try:
+        for i in range(10):
+            assert compiled.execute(i).get() == i + 101
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_multi_output_fan():
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    with InputNode() as inp:
+        o1 = a.add.bind(inp)
+        o2 = b.add.bind(inp)
+        dag = MultiOutputNode([o1, o2])
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(5):
+            assert compiled.execute(i).get() == [i + 1, i + 2]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_error_propagates_and_recovers():
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        out = a.boom.bind(inp)
+    compiled = out.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            compiled.execute(1).get()
+        # the loop survives an error and keeps serving
+        with pytest.raises(ValueError, match="boom"):
+            compiled.execute(2).get()
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_faster_than_dynamic():
+    """The point of compilation: per-iteration overhead drops well below
+    task submission cost (reference microbench: compiled ~100x)."""
+    a = Adder.remote(0)
+    with InputNode() as inp:
+        out = a.add.bind(inp)
+
+    n = 50
+    t0 = time.perf_counter()
+    for i in range(n):
+        ray_tpu.get(out.execute(i))
+    dyn = time.perf_counter() - t0
+
+    compiled = out.experimental_compile()
+    try:
+        compiled.execute(0).get()  # warm
+        t0 = time.perf_counter()
+        for i in range(n):
+            compiled.execute(i).get()
+        comp = time.perf_counter() - t0
+    finally:
+        compiled.teardown()
+    assert comp < dyn, f"compiled {comp:.4f}s not faster than dynamic {dyn:.4f}s"
+
+
+def test_compiled_teardown_releases_actor():
+    a = Adder.remote(5)
+    with InputNode() as inp:
+        out = a.add.bind(inp)
+    compiled = out.experimental_compile()
+    assert compiled.execute(1).get() == 6
+    compiled.teardown()
+    # after teardown the actor serves normal calls again
+    assert ray_tpu.get(a.add.remote(1)) == 6
+
+
+def test_fuse_functions_jax():
+    import jax.numpy as jnp
+
+    @ray_tpu.remote
+    def scale(x):
+        return x * 2.0
+
+    @ray_tpu.remote
+    def shift(x):
+        return x + 1.0
+
+    with InputNode() as inp:
+        out = shift.bind(scale.bind(inp))
+    fused = out.compile_fused(jit=True)
+    x = jnp.arange(8.0)
+    np.testing.assert_allclose(np.asarray(fused(x)),
+                               np.arange(8.0) * 2.0 + 1.0)
