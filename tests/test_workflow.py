@@ -1,0 +1,109 @@
+"""Workflow tests (reference strategy: python/ray/workflow/tests/ —
+test_basic_workflows.py, test_recovery.py)."""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster(tmp_path_factory):
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    workflow.init(str(tmp_path_factory.mktemp("wf_storage")))
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def add(x, y):
+    return x + y
+
+
+@ray_tpu.remote
+def mul(x, k):
+    return x * k
+
+
+def test_run_basic():
+    with InputNode() as inp:
+        dag = add.bind(mul.bind(inp, 3), 1)
+    assert workflow.run(dag, 5, workflow_id="wf_basic") == 16
+    assert workflow.get_status("wf_basic") == workflow.SUCCESSFUL
+    assert workflow.get_output("wf_basic") == 16
+
+
+def test_multi_output():
+    with InputNode() as inp:
+        dag = MultiOutputNode([mul.bind(inp, 2), mul.bind(inp, 5)])
+    assert workflow.run(dag, 3, workflow_id="wf_multi") == [6, 15]
+
+
+def test_resume_skips_completed_steps(tmp_path):
+    marker = str(tmp_path / "ran_steps")
+    os.makedirs(marker)
+
+    @ray_tpu.remote
+    def record(x, tag, marker_dir):
+        # Count executions per step via marker files.
+        n = len([f for f in os.listdir(marker_dir) if f.startswith(tag)])
+        open(os.path.join(marker_dir, f"{tag}_{n}"), "w").close()
+        return x + 1
+
+    @ray_tpu.remote
+    def flaky(x, marker_dir):
+        if not os.path.exists(os.path.join(marker_dir, "armed")):
+            open(os.path.join(marker_dir, "armed"), "w").close()
+            raise RuntimeError("injected failure")
+        return x * 10
+
+    with InputNode() as inp:
+        step1 = record.bind(inp, "s1", marker)
+        dag = flaky.bind(step1, marker)
+
+    with pytest.raises(Exception):
+        workflow.run(dag, 1, workflow_id="wf_resume", max_retries=0)
+    assert workflow.get_status("wf_resume") == workflow.FAILED
+
+    out = workflow.resume("wf_resume")
+    assert out == 20
+    assert workflow.get_status("wf_resume") == workflow.SUCCESSFUL
+    # step1 ran exactly once across run + resume (checkpointed).
+    s1_runs = [f for f in os.listdir(marker) if f.startswith("s1_")]
+    assert len(s1_runs) == 1
+
+
+def test_continuation():
+    @ray_tpu.remote
+    def final(x):
+        return x + 100
+
+    @ray_tpu.remote
+    def decide(x):
+        return final.bind(x)  # returns a sub-DAG -> continuation
+
+    with InputNode() as inp:
+        dag = decide.bind(inp)
+    assert workflow.run(dag, 5, workflow_id="wf_cont") == 105
+
+
+def test_run_async_and_list():
+    with InputNode() as inp:
+        dag = mul.bind(inp, 7)
+    ref = workflow.run_async(dag, 6, workflow_id="wf_async")
+    assert ray_tpu.get(ref) == 42
+    ids = dict(workflow.list_all())
+    assert ids.get("wf_async") == workflow.SUCCESSFUL
+    listed = workflow.list_all(status_filter=[workflow.SUCCESSFUL])
+    assert ("wf_async", workflow.SUCCESSFUL) in listed
+
+
+def test_delete():
+    with InputNode() as inp:
+        dag = mul.bind(inp, 2)
+    workflow.run(dag, 1, workflow_id="wf_del")
+    workflow.delete("wf_del")
+    assert workflow.get_status("wf_del") is None
+    assert "wf_del" not in dict(workflow.list_all())
