@@ -174,6 +174,20 @@ class ObjectDirectory:
             counts["bytes"] = total
             return counts
 
+    def list_entries(self, limit: int = 1000) -> List[dict]:
+        """State-API view (reference: GcsTaskManager object listing via
+        util/state)."""
+        with self._lock:
+            out = []
+            for oid, e in self._entries.items():
+                out.append({
+                    "object_id": oid.hex(), "state": e.state,
+                    "size": e.size, "refcount": e.refcount,
+                    "location": e.location[0] if e.location else None})
+                if len(out) >= limit:
+                    break
+            return out
+
 
 class ActorDirectory:
     """Actor table + named-actor registry (reference: GcsActorManager)."""

@@ -371,6 +371,10 @@ class Node:
                        "fn_blob": self._fn_registry.get(spec.fn_id)})
             worker.fn_cache.add(spec.fn_id)
         worker.running[spec.task_id.binary()] = spec
+        self.gcs.record_task_event({
+            "task_id": spec.task_id.hex(), "name": spec.name,
+            "state": "RUNNING", "worker_id": worker.worker_id.hex(),
+            "ts": time.time()})
         try:
             worker.send(P.EXEC_TASK, {"spec": send_spec})
         except Exception:
@@ -790,6 +794,22 @@ class Node:
             return self.gcs.task_events()
         if op == "object_stats":
             return self.gcs.objects.stats()
+        if op == "list_objects":
+            return self.gcs.objects.list_entries(
+                limit=kwargs.get("limit", 1000))
+        if op == "list_workers":
+            return [{"worker_id": wid.hex(),
+                     "pid": h.proc.pid if h.proc else None,
+                     "dedicated_actor": (h.dedicated_actor.hex()
+                                         if h.dedicated_actor else None),
+                     "running_tasks": len(h.running)}
+                    for wid, h in self.pool.workers.items()]
+        if op == "list_nodes":
+            totals, avail = self.resources_mgr.snapshot()
+            return [{"node_id": self.gcs.node_id_hex, "alive": True,
+                     "resources_total": totals,
+                     "resources_available": avail,
+                     "start_time": self.gcs.start_time}]
         if op == "pg_create":
             e = self.pg_manager.create(
                 kwargs["pg_id_hex"], kwargs["bundles"], kwargs["strategy"],

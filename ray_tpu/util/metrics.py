@@ -1,0 +1,196 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference parity: python/ray/util/metrics.py (Counter, Gauge,
+Histogram over includes/metric.pxi; C++ defs src/ray/stats/metric.h:103)
++ the Prometheus exposition the per-node MetricsAgent provides
+(_private/metrics_agent.py:483, prometheus_exporter.py).
+
+Process-local registry; `prometheus_text()` renders the standard text
+format, `start_metrics_server(port)` serves it on /metrics so a scraper
+(or the dashboard) can pull from each process.
+"""
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY: Dict[str, "Metric"] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    """Base (reference: util/metrics.py Metric)."""
+
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name or not name.replace("_", "").isalnum():
+            raise ValueError(f"Invalid metric name {name!r}")
+        self._name = name
+        self._desc = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, float] = {}
+        with _REG_LOCK:
+            _REGISTRY[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        extra = set(merged) - set(self._tag_keys)
+        if extra:
+            raise ValueError(
+                f"Unknown tag(s) {sorted(extra)} for metric {self._name}; "
+                f"declared tag_keys={self._tag_keys}")
+        return merged
+
+    @property
+    def info(self) -> Dict:
+        return {"name": self._name, "description": self._desc,
+                "tag_keys": self._tag_keys,
+                "default_tags": dict(self._default_tags)}
+
+    def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        with self._lock:
+            return [(self._name, dict(k), v)
+                    for k, v in self._values.items()]
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc requires value > 0")
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict] = None):
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = float(value)
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            boundaries = [0.1, 1.0, 10.0]
+        if any(b <= 0 for b in boundaries):
+            raise ValueError(
+                f"Histogram boundaries must be positive, got {boundaries}")
+        self._bounds = sorted(float(b) for b in boundaries)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict] = None):
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self._bounds))
+            for i, b in enumerate(self._bounds):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def _samples(self):
+        out = []
+        with self._lock:
+            for key, counts in self._counts.items():
+                tags = dict(key)
+                cum = 0
+                for b, c in zip(self._bounds, counts):
+                    cum += c
+                    out.append((f"{self._name}_bucket",
+                                {**tags, "le": str(b)}, float(cum)))
+                out.append((f"{self._name}_bucket",
+                            {**tags, "le": "+Inf"},
+                            float(self._totals[key])))
+                out.append((f"{self._name}_sum", tags, self._sums[key]))
+                out.append((f"{self._name}_count", tags,
+                            float(self._totals[key])))
+        return out
+
+
+def prometheus_text() -> str:
+    """Standard Prometheus text exposition of all registered metrics
+    (reference: _private/prometheus_exporter.py)."""
+    lines = []
+    with _REG_LOCK:
+        metrics = list(_REGISTRY.values())
+    for m in metrics:
+        lines.append(f"# HELP {m._name} {m._desc}")
+        lines.append(f"# TYPE {m._name} {m.TYPE}")
+        for name, tags, value in m._samples():
+            if tags:
+                tag_s = ",".join(f'{k}="{v}"'
+                                 for k, v in sorted(tags.items()))
+                lines.append(f"{name}{{{tag_s}}} {value}")
+            else:
+                lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+_server = None
+
+
+def start_metrics_server(port: int = 0, host: str = "127.0.0.1") -> int:
+    """Serve /metrics for Prometheus scraping; returns the bound port."""
+    global _server
+    if _server is not None:
+        return _server.server_address[1]
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    _server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=_server.serve_forever, daemon=True,
+                     name="metrics-server").start()
+    return _server.server_address[1]
+
+
+def stop_metrics_server():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()
+        _server = None
+
+
+def clear_registry():
+    with _REG_LOCK:
+        _REGISTRY.clear()
+
+
+__all__ = ["Counter", "Gauge", "Histogram", "Metric", "clear_registry",
+           "prometheus_text", "start_metrics_server",
+           "stop_metrics_server"]

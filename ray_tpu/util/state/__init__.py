@@ -1,0 +1,141 @@
+"""State observability API.
+
+Reference parity: python/ray/util/state/api.py (`list_tasks`,
+`list_actors`, `list_objects`, `list_nodes`, `list_placement_groups`,
+`list_workers`, `summarize_*`) driven by the task-event store
+(GcsTaskManager, gcs/gcs_server/gcs_task_manager.cc) — here the Node's
+in-process event log (gcs.py record_task_event). `timeline()` exports
+Chrome-trace JSON like `ray timeline` (_private/state.py).
+"""
+import json
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from ..._private import state as _state
+
+
+def _gcs(op: str, **kwargs):
+    rt = _state.current()
+    return rt.gcs_request(op, **kwargs)
+
+
+def _match(row: Dict[str, Any], filters) -> bool:
+    for f in filters or []:
+        key, op, value = f
+        have = row.get(key)
+        if op == "=" and not str(have) == str(value):
+            return False
+        if op == "!=" and str(have) == str(value):
+            return False
+    return True
+
+
+def list_tasks(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    """Latest state per task (reference: state/api.py list_tasks)."""
+    events = _gcs("task_events")
+    latest: Dict[str, Dict[str, Any]] = {}
+    first_ts: Dict[str, float] = {}
+    for ev in events:
+        tid = ev["task_id"]
+        first_ts.setdefault(tid, ev["ts"])
+        cur = latest.get(tid)
+        if cur is None or ev["ts"] >= cur["ts"]:
+            latest[tid] = ev
+    rows = []
+    for tid, ev in latest.items():
+        row = {"task_id": tid, "name": ev.get("name"),
+               "state": ev.get("state"),
+               "worker_id": ev.get("worker_id"),
+               "start_time": first_ts.get(tid), "end_time": ev["ts"]
+               if ev.get("state") in ("FINISHED", "FAILED") else None}
+        if _match(row, filters):
+            rows.append(row)
+        if len(rows) >= limit:
+            break
+    return rows
+
+
+def list_actors(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = [r for r in _gcs("list_actors") if _match(r, filters)]
+    return rows[:limit]
+
+
+def list_objects(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = [r for r in _gcs("list_objects", limit=limit)
+            if _match(r, filters)]
+    return rows[:limit]
+
+
+def list_nodes(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    return [r for r in _gcs("list_nodes") if _match(r, filters)][:limit]
+
+
+def list_workers(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    return [r for r in _gcs("list_workers") if _match(r, filters)][:limit]
+
+
+def list_placement_groups(filters=None,
+                          limit: int = 1000) -> List[Dict[str, Any]]:
+    table = _gcs("pg_table")
+    rows = []
+    for pg_id, info in table.items():
+        row = dict(info)
+        row["placement_group_id"] = pg_id
+        if _match(row, filters):
+            rows.append(row)
+    return rows[:limit]
+
+
+# -- summaries (reference: state/api.py summarize_*) ------------------------
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    by_name: Dict[str, Dict[str, int]] = defaultdict(
+        lambda: defaultdict(int))
+    for row in list_tasks(limit=100000):
+        by_name[row["name"] or "?"][row["state"]] += 1
+    return {k: dict(v) for k, v in by_name.items()}
+
+
+def summarize_actors() -> Dict[str, Dict[str, int]]:
+    by_cls: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    for row in list_actors(limit=100000):
+        by_cls[row.get("class_name", "?")][row["state"]] += 1
+    return {k: dict(v) for k, v in by_cls.items()}
+
+
+def summarize_objects() -> Dict[str, int]:
+    return _gcs("object_stats")
+
+
+# -- timeline ---------------------------------------------------------------
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace export of task execution spans (reference:
+    ray.timeline, _private/state.py — consumed at chrome://tracing)."""
+    events = _gcs("task_events")
+    runs: Dict[str, Dict[str, Any]] = {}
+    trace: List[Dict[str, Any]] = []
+    for ev in events:
+        tid = ev["task_id"]
+        if ev["state"] == "RUNNING":
+            runs[tid] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and tid in runs:
+            start = runs.pop(tid)
+            trace.append({
+                "name": ev.get("name") or tid[:8],
+                "cat": "task", "ph": "X",
+                "ts": start["ts"] * 1e6,
+                "dur": max(0.0, (ev["ts"] - start["ts"])) * 1e6,
+                "pid": "ray_tpu",
+                "tid": start.get("worker_id", "driver")[:8],
+                "args": {"task_id": tid, "state": ev["state"]},
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+__all__ = ["list_actors", "list_nodes", "list_objects",
+           "list_placement_groups", "list_tasks", "list_workers",
+           "summarize_actors", "summarize_objects", "summarize_tasks",
+           "timeline"]

@@ -1,0 +1,114 @@
+"""State API / metrics / timeline tests (reference strategy:
+python/ray/tests/test_state_api.py, test_metrics_agent.py)."""
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics
+from ray_tpu.util import state as state_api
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+    metrics.stop_metrics_server()
+
+
+def test_list_tasks_and_summary():
+    @ray_tpu.remote
+    def observed_task(x):
+        return x
+
+    ray_tpu.get([observed_task.remote(i) for i in range(5)])
+    tasks = state_api.list_tasks()
+    mine = [t for t in tasks if t["name"] == "observed_task"]
+    assert len(mine) == 5
+    assert all(t["state"] == "FINISHED" for t in mine)
+    summary = state_api.summarize_tasks()
+    assert summary["observed_task"]["FINISHED"] == 5
+    # filters
+    finished = state_api.list_tasks(filters=[("state", "=", "FINISHED")])
+    assert all(t["state"] == "FINISHED" for t in finished)
+
+
+def test_list_actors_nodes_workers_objects():
+    @ray_tpu.remote
+    class Obs:
+        def ping(self):
+            return 1
+
+    a = Obs.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = state_api.list_actors()
+    assert any(r["class_name"].endswith("Obs") and r["state"] == "ALIVE"
+               for r in actors)
+    nodes = state_api.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    assert nodes[0]["resources_total"].get("CPU") == 4
+    workers = state_api.list_workers()
+    assert len(workers) >= 1
+    ref = ray_tpu.put(list(range(1000)))
+    objs = state_api.list_objects()
+    assert any(o["object_id"] == ref.hex() for o in objs)
+    assert state_api.summarize_objects().get("ready", 0) >= 1
+    del ref
+
+
+def test_timeline_export(tmp_path):
+    @ray_tpu.remote
+    def traced(x):
+        import time
+        time.sleep(0.01)
+        return x
+
+    ray_tpu.get([traced.remote(i) for i in range(3)])
+    out = str(tmp_path / "timeline.json")
+    trace = state_api.timeline(out)
+    spans = [t for t in trace if t["name"] == "traced"]
+    assert len(spans) >= 3
+    assert all(t["ph"] == "X" and t["dur"] > 0 for t in spans)
+    import json
+    with open(out) as f:
+        assert json.load(f) == trace
+
+
+def test_metrics_counter_gauge_histogram():
+    metrics.clear_registry()
+    c = metrics.Counter("req_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    g = metrics.Gauge("inflight", "in flight")
+    g.set(7)
+    h = metrics.Histogram("latency_s", "latency", boundaries=[0.1, 1.0],
+                          tag_keys=("route",))
+    h.observe(0.05, tags={"route": "/a"})
+    h.observe(0.5, tags={"route": "/a"})
+    h.observe(5.0, tags={"route": "/a"})
+    text = metrics.prometheus_text()
+    assert 'req_total{route="/a"} 3.0' in text
+    assert 'req_total{route="/b"} 1.0' in text
+    assert "inflight 7.0" in text
+    assert 'latency_s_bucket{le="0.1",route="/a"} 1.0' in text
+    assert 'latency_s_bucket{le="1.0",route="/a"} 2.0' in text
+    assert 'latency_s_bucket{le="+Inf",route="/a"} 3.0' in text
+    assert 'latency_s_count{route="/a"} 3.0' in text
+    with pytest.raises(ValueError):
+        c.inc(tags={"bogus": "x"})
+    with pytest.raises(ValueError):
+        metrics.Histogram("bad_bounds", boundaries=[-1.0])
+    with pytest.raises(ValueError):
+        c.inc(0)
+
+
+def test_metrics_http_endpoint():
+    metrics.clear_registry()
+    metrics.Gauge("scrape_me").set(42)
+    port = metrics.start_metrics_server(port=0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        body = r.read().decode()
+    assert "scrape_me 42.0" in body
