@@ -150,6 +150,13 @@ class PlacementGroupManager:
                 self._ensure_retry_thread()
         return entry
 
+    def pending_entries(self) -> List[PlacementGroupEntry]:
+        """PGs awaiting reservation — the autoscaler's gang-demand signal
+        (reference: GcsAutoscalerStateManager pending PG demands)."""
+        with self._lock:
+            return [e for e in self._groups.values()
+                    if e.state == PG_PENDING]
+
     def _total_demand(self, bundles) -> Dict[str, float]:
         total: Dict[str, float] = {}
         for b in bundles:

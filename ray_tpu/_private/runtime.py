@@ -804,6 +804,13 @@ class Node:
                                          if h.dedicated_actor else None),
                      "running_tasks": len(h.running)}
                     for wid, h in self.pool.workers.items()]
+        if op == "resource_demands":
+            demands = self.scheduler.pending_demands()
+            pending_pgs = [
+                {"bundles": e.bundles, "strategy": e.strategy}
+                for e in self.pg_manager.pending_entries()
+            ] if hasattr(self.pg_manager, "pending_entries") else []
+            return {"demands": demands, "placement_groups": pending_pgs}
         if op == "list_nodes":
             totals, avail = self.resources_mgr.snapshot()
             return [{"node_id": self.gcs.node_id_hex, "alive": True,

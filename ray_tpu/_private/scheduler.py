@@ -406,6 +406,13 @@ class Scheduler:
             return len(self._ready) + sum(
                 len(v) for v in self._waiting.values())
 
+    def pending_demands(self) -> list:
+        """Resource demands of queued-but-undispatched work — the
+        autoscaler's upscale signal (reference: load_metrics.py pending
+        demands fed to resource_demand_scheduler.py)."""
+        with self._cond:
+            return [dict(s.resources or {}) for s in self._ready]
+
     # -- dispatch loop -----------------------------------------------------
     def _env_key_for(self, spec) -> str:
         from .placement import tpu_chips_in_demand

@@ -1,0 +1,22 @@
+"""ray_tpu.autoscaler — demand-driven node/slice provisioning.
+
+Reference parity: python/ray/autoscaler/ (StandardAutoscaler,
+resource_demand_scheduler, NodeProvider plugins, FakeMultiNodeProvider)
+re-designed around TPU slice atomicity (SURVEY §2.2: autoscaler v1+v2,
+§7 phase 6).
+"""
+from .autoscaler import (LoadSource, Monitor, RuntimeLoadSource,
+                         StandardAutoscaler, StaticLoadSource)
+from .config import (ClusterConfig, NodeTypeConfig, load_config,
+                     tpu_slice_node_type)
+from .node_provider import (FakeMultiNodeProvider, NodeProvider,
+                            TAG_NODE_KIND, TAG_NODE_TYPE, TAG_SLICE_ID)
+from .resource_demand_scheduler import get_nodes_to_launch
+
+__all__ = [
+    "ClusterConfig", "FakeMultiNodeProvider", "LoadSource", "Monitor",
+    "NodeProvider", "NodeTypeConfig", "RuntimeLoadSource",
+    "StandardAutoscaler", "StaticLoadSource", "TAG_NODE_KIND",
+    "TAG_NODE_TYPE", "TAG_SLICE_ID", "get_nodes_to_launch", "load_config",
+    "tpu_slice_node_type",
+]

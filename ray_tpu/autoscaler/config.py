@@ -1,0 +1,90 @@
+"""Cluster config: node types with TPU slice topology.
+
+Reference: the autoscaler YAML schema (autoscaler/ray-schema.json:
+available_node_types with resources/min_workers/max_workers) — expressed
+as dataclasses; `load_config` accepts a dict or a YAML path.
+
+TPU slice node types carry `hosts_per_node` (a v4-16 "node" = one slice
+of 4 hosts) and per-HOST resources; the aggregate slice resources the
+demand scheduler packs against include the `TPU-<gen>-head` gang
+resource the placement layer uses (reference: _private/accelerators/
+tpu.py:330-377 pod-slice resources).
+"""
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]          # per HOST
+    min_workers: int = 0
+    max_workers: int = 10
+    hosts_per_node: int = 1              # >1 => TPU slice (atomic gang)
+    node_config: Dict[str, Any] = field(default_factory=dict)
+    # Added ONCE per slice (not per host): gang markers like
+    # "TPU-v4-16-head" (reference: tpu.py:330-377).
+    slice_extra: Dict[str, float] = field(default_factory=dict)
+
+    def slice_resources(self) -> Dict[str, float]:
+        """Aggregate resources of one launch unit (the whole slice)."""
+        agg = {k: v * self.hosts_per_node for k, v in self.resources.items()}
+        for k, v in self.slice_extra.items():
+            agg[k] = agg.get(k, 0.0) + v
+        return agg
+
+
+@dataclass
+class ClusterConfig:
+    node_types: Dict[str, NodeTypeConfig]
+    max_workers: int = 64                # cluster-wide cap (launch units)
+    idle_timeout_s: float = 60.0
+    upscaling_speed: float = 1.0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ClusterConfig":
+        nts = {}
+        for name, spec in d.get("available_node_types", {}).items():
+            nts[name] = NodeTypeConfig(
+                name=name,
+                resources=dict(spec.get("resources", {})),
+                min_workers=int(spec.get("min_workers", 0)),
+                max_workers=int(spec.get("max_workers", 10)),
+                hosts_per_node=int(spec.get("hosts_per_node", 1)),
+                node_config=dict(spec.get("node_config", {})))
+        if not nts:
+            raise ValueError("config needs available_node_types")
+        return cls(
+            node_types=nts,
+            max_workers=int(d.get("max_workers", 64)),
+            idle_timeout_s=float(d.get("idle_timeout_minutes", 1.0)) * 60.0,
+            upscaling_speed=float(d.get("upscaling_speed", 1.0)))
+
+
+def tpu_slice_node_type(name: str, generation: str, chips: int,
+                        chips_per_host: int = 4,
+                        cpus_per_host: int = 120,
+                        min_workers: int = 0,
+                        max_workers: int = 4) -> NodeTypeConfig:
+    """Convenience: a `TPU-<gen>-<chips>` slice node type with the head
+    gang resource (reference naming: tpu.py:330-377,
+    e.g. TPU-v4-16-head)."""
+    hosts = max(1, chips // chips_per_host)
+    per_host = {"CPU": float(cpus_per_host),
+                "TPU": float(min(chips, chips_per_host))}
+    return NodeTypeConfig(
+        name=name, resources=per_host, min_workers=min_workers,
+        max_workers=max_workers, hosts_per_node=hosts,
+        slice_extra={f"TPU-{generation}-{chips}-head": 1.0})
+
+
+def load_config(source) -> ClusterConfig:
+    if isinstance(source, ClusterConfig):
+        return source
+    if isinstance(source, dict):
+        return ClusterConfig.from_dict(source)
+    if isinstance(source, str):
+        import yaml
+        with open(source) as f:
+            return ClusterConfig.from_dict(yaml.safe_load(f))
+    raise TypeError(f"Cannot load cluster config from {type(source)}")
