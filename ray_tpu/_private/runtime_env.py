@@ -1,0 +1,91 @@
+"""Runtime environments: per-task/actor isolated worker environments.
+
+Reference: python/ray/_private/runtime_env/ — plugins for env_vars,
+working_dir, py_modules (plugin.py; the agent creates envs on demand and
+caches by URI). Here the env is applied at worker-process boot: the
+scheduler folds a stable hash of the runtime env into the worker pool
+key, so processes are only reused for matching envs (the reference's
+cache-by-URI, collapsed to cache-by-process).
+
+Supported fields:
+  env_vars     {str: str}    set in the worker's process environment
+  working_dir  str (path)    worker chdirs here and prepends to sys.path
+  py_modules   [str (path)]  prepended to sys.path
+Gated (raise at validation, like the reference when the backing tool is
+absent): pip, conda, container — this image forbids installs (no egress).
+"""
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+ENV_VAR = "RAY_TPU_RUNTIME_ENV"
+_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+_GATED = {"pip", "conda", "container", "uv"}
+
+
+def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    if not runtime_env:
+        return {}
+    if not isinstance(runtime_env, dict):
+        raise TypeError(f"runtime_env must be a dict, got "
+                        f"{type(runtime_env).__name__}")
+    for key in runtime_env:
+        if key in _GATED:
+            raise ValueError(
+                f"runtime_env field '{key}' requires package installation, "
+                "which this environment gates off (no egress); vendor the "
+                "code via working_dir/py_modules instead")
+        if key not in _SUPPORTED:
+            raise ValueError(f"Unknown runtime_env field '{key}' "
+                             f"(supported: {sorted(_SUPPORTED)})")
+    ev = runtime_env.get("env_vars", {})
+    if not all(isinstance(k, str) and isinstance(v, str)
+               for k, v in ev.items()):
+        raise TypeError("runtime_env env_vars must be {str: str}")
+    wd = runtime_env.get("working_dir")
+    if wd is not None and not os.path.isdir(wd):
+        raise ValueError(f"runtime_env working_dir '{wd}' does not exist")
+    for p in runtime_env.get("py_modules", []):
+        if not os.path.exists(p):
+            raise ValueError(f"runtime_env py_module '{p}' does not exist")
+    return dict(runtime_env)
+
+
+def env_hash(runtime_env: Optional[Dict[str, Any]]) -> str:
+    """Stable key for worker-pool segregation (reference: runtime env URI
+    hashing in runtime_env/plugin.py)."""
+    if not runtime_env:
+        return ""
+    blob = json.dumps(runtime_env, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def worker_extra_env(runtime_env: Optional[Dict[str, Any]]
+                     ) -> Dict[str, str]:
+    """Environment to inject at worker-process start."""
+    if not runtime_env:
+        return {}
+    extra = dict(runtime_env.get("env_vars", {}))
+    payload = {k: v for k, v in runtime_env.items() if k != "env_vars"}
+    if payload:
+        extra[ENV_VAR] = json.dumps(payload)
+    return extra
+
+
+def apply_in_worker():
+    """Called at worker boot (worker_proc main): apply working_dir /
+    py_modules from the env payload."""
+    payload = os.environ.get(ENV_VAR)
+    if not payload:
+        return
+    import sys
+    spec = json.loads(payload)
+    wd = spec.get("working_dir")
+    if wd:
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+    for p in spec.get("py_modules", []):
+        if p not in sys.path:
+            sys.path.insert(0, p)

@@ -415,9 +415,16 @@ class Scheduler:
 
     # -- dispatch loop -----------------------------------------------------
     def _env_key_for(self, spec) -> str:
+        from . import runtime_env as re_mod
         from .placement import tpu_chips_in_demand
         n = tpu_chips_in_demand(spec.resources)
-        return f"tpu:{n}" if n > 0 else ""
+        key = f"tpu:{n}" if n > 0 else ""
+        re_hash = re_mod.env_hash(getattr(spec, "runtime_env", None))
+        if re_hash:
+            # Segregate the worker pool per runtime env (reference: env
+            # caching by URI, _private/runtime_env/plugin.py).
+            key = f"{key}|re:{re_hash}" if key else f"re:{re_hash}"
+        return key
 
     def _loop(self):
         while True:
@@ -522,6 +529,10 @@ class Scheduler:
             # this var; TPU workers need the real value, cpu workers get "".
             extra_env["PALLAS_AXON_POOL_IPS"] = os.environ.get(
                 "PALLAS_AXON_POOL_IPS", "")
+        spec_re = getattr(spec, "runtime_env", None)
+        if spec_re:
+            from . import runtime_env as re_mod
+            extra_env.update(re_mod.worker_extra_env(spec_re))
         handle = self.pool.start_worker(env_key, extra_env)
         handle.chip_ids = chip_ids
         return handle

@@ -381,6 +381,10 @@ def worker_main(conn, config: P.WorkerConfig):
     for k, v in config.env.items():
         os.environ[k] = v
     sys.path.insert(0, os.getcwd())
+    # Apply working_dir / py_modules runtime env (reference: the runtime
+    # env agent preparing the env before the worker serves tasks).
+    from . import runtime_env as re_mod
+    re_mod.apply_in_worker()
     from . import state
     worker = Worker(conn, config)
     state.set_worker_context(worker)

@@ -181,6 +181,13 @@ def _make_args(args: Sequence, kwargs: Dict) -> tuple:
     return out_args, out_kwargs
 
 
+def _validate_runtime_env(runtime_env):
+    if not runtime_env:
+        return None
+    from ._private import runtime_env as re_mod
+    return re_mod.validate(runtime_env)
+
+
 def _build_resources(opts: Dict, default_num_cpus: float = 1) -> Dict[str, float]:
     res = dict(opts.get("resources") or {})
     num_cpus = opts.get("num_cpus")
@@ -317,7 +324,7 @@ class RemoteFunction:
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_index,
             scheduling_strategy=opts.get("scheduling_strategy"),
-            runtime_env=opts.get("runtime_env"))
+            runtime_env=_validate_runtime_env(opts.get("runtime_env")))
         refs = [ObjectRef(rid) for rid in return_ids]
         rt.submit_task(spec)
         return refs[0] if num_returns == 1 else refs
@@ -494,7 +501,7 @@ class ActorClass:
             placement_group_id=_actor_pg_id,
             placement_group_bundle_index=_actor_bundle_index,
             scheduling_strategy=opts.get("scheduling_strategy"),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_validate_runtime_env(opts.get("runtime_env")),
             lifetime=opts.get("lifetime"),
             method_meta=self._method_meta)
         rt.create_actor(spec)

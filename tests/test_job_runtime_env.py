@@ -1,0 +1,129 @@
+"""Job submission + runtime env tests (reference strategy:
+dashboard/modules/job/tests/test_job_manager.py,
+python/ray/tests/test_runtime_env*.py)."""
+import os
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job import (FAILED, JobSubmissionClient, STOPPED, SUCCEEDED)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# -- runtime envs -----------------------------------------------------------
+def test_runtime_env_env_vars():
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "tpu42"}})
+    def read_flag():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_flag.remote()) == "tpu42"
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("MY_FLAG")
+
+    # env-var workers are segregated from the generic pool
+    assert ray_tpu.get(read_plain.remote()) is None
+
+
+def test_runtime_env_working_dir_and_py_modules(tmp_path):
+    pkg = tmp_path / "vendored_mod"
+    pkg.mkdir()
+    (pkg / "vendored_lib_xyz.py").write_text("VALUE = 1234\n")
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "data.txt").write_text("hello-wd")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd),
+                                 "py_modules": [str(pkg)]})
+    def use_env():
+        import vendored_lib_xyz
+        with open("data.txt") as f:
+            return vendored_lib_xyz.VALUE, f.read()
+
+    assert ray_tpu.get(use_env.remote()) == (1234, "hello-wd")
+
+
+def test_runtime_env_on_actor():
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_ENV": "yes"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_ENV")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote()) == "yes"
+
+
+def test_runtime_env_validation():
+    with pytest.raises(ValueError, match="gates off"):
+        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        def f():
+            pass
+        f.remote()
+    with pytest.raises(ValueError, match="Unknown runtime_env"):
+        @ray_tpu.remote(runtime_env={"bogus_field": 1})
+        def g():
+            pass
+        g.remote()
+    with pytest.raises(ValueError, match="does not exist"):
+        @ray_tpu.remote(runtime_env={"working_dir": "/nonexistent_xyz"})
+        def h():
+            pass
+        h.remote()
+
+
+# -- jobs -------------------------------------------------------------------
+def test_job_submit_success_and_logs():
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job says hi')\"")
+    assert client.wait_until_finish(job_id, 60) == SUCCEEDED
+    assert "job says hi" in client.get_job_logs(job_id)
+    info = client.get_job_info(job_id)
+    assert info["return_code"] == 0
+    assert job_id in [j["job_id"] for j in client.list_jobs()]
+
+
+def test_job_failure():
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; sys.exit(3)\"")
+    assert client.wait_until_finish(job_id, 60) == FAILED
+    assert client.get_job_info(job_id)["return_code"] == 3
+
+
+def test_job_env_vars_and_stop():
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \""
+                   "import os,time; print(os.environ['JOB_VAR']); "
+                   "time.sleep(60)\"",
+        runtime_env={"env_vars": {"JOB_VAR": "injected"}})
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if "injected" in client.get_job_logs(job_id):
+            break
+        time.sleep(0.2)
+    assert "injected" in client.get_job_logs(job_id)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finish(job_id, 30) in (STOPPED, FAILED)
+
+
+def test_job_delete_and_duplicate_id():
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c pass", submission_id="raysubmit_dup")
+    client.wait_until_finish(job_id, 60)
+    with pytest.raises(ValueError, match="already exists"):
+        client.submit_job(entrypoint="true", submission_id="raysubmit_dup")
+    assert client.delete_job(job_id)
+    with pytest.raises(ValueError, match="No job"):
+        client.get_job_status(job_id)
