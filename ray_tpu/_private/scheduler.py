@@ -195,10 +195,16 @@ class WorkerPool:
         proc_env.update(env)
         proc_env["RAY_TPU_WORKER_SOCKET"] = address
         proc_env["RAY_TPU_WORKER_AUTHKEY"] = self._authkey.hex()
-        proc_env["PYTHONPATH"] = (
-            os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))))
-            + os.pathsep + proc_env.get("PYTHONPATH", ""))
+        # Workers inherit the driver's import paths (reference: workers
+        # receive the driver's sys.path via the job config / runtime env)
+        # so by-reference pickles of driver-module functions resolve.
+        driver_paths = [p for p in sys.path if p and os.path.isdir(p)]
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        proc_env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root] + driver_paths
+            + ([proc_env["PYTHONPATH"]] if proc_env.get("PYTHONPATH")
+               else []))
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_proc"],
             env=proc_env, cwd=os.getcwd(),

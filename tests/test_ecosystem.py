@@ -1,0 +1,106 @@
+"""Ecosystem shim tests (reference strategy: python/ray/tests/
+test_multiprocessing.py, test_joblib.py, test_iter.py)."""
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_group import ActorGroup
+from ray_tpu.util.iter import from_items, from_range
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def _addmul(a, b):
+    return a * 10 + b
+
+
+def test_pool_map_apply():
+    with Pool(3) as p:
+        assert p.map(_sq, range(10)) == [i * i for i in range(10)]
+        assert p.apply(_addmul, (3, 4)) == 34
+        r = p.apply_async(_sq, (9,))
+        assert r.get(timeout=30) == 81
+        assert p.starmap(_addmul, [(1, 2), (3, 4)]) == [12, 34]
+
+
+def test_pool_imap_and_unordered():
+    with Pool(2) as p:
+        assert list(p.imap(_sq, range(8), chunksize=3)) == [
+            i * i for i in range(8)]
+        assert sorted(p.imap_unordered(_sq, range(8))) == sorted(
+            i * i for i in range(8))
+
+
+def test_pool_initializer_and_close():
+    def _init(v):
+        import os
+        os.environ["POOL_INIT"] = str(v)
+
+    def _read(_):
+        import os
+        return os.environ.get("POOL_INIT")
+
+    p = Pool(2, initializer=_init, initargs=(7,))
+    assert p.map(_read, range(2)) == ["7", "7"]
+    p.close()
+    with pytest.raises(ValueError):
+        p.apply(_sq, (1,))
+    p.join()
+    p.terminate()
+
+
+def test_joblib_backend():
+    import joblib
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
+
+
+def test_parallel_iterator():
+    it = from_items(list(range(12)), num_shards=3)
+    out = sorted(it.for_each(_sq).gather_sync())
+    assert out == sorted(i * i for i in range(12))
+
+    evens = from_range(10, num_shards=2).filter(lambda x: x % 2 == 0)
+    assert sorted(evens.gather_async()) == [0, 2, 4, 6, 8]
+
+    batched = from_items([1, 2, 3, 4, 5, 6], num_shards=2).batch(2)
+    batches = list(batched.gather_sync())
+    assert all(len(b) <= 2 for b in batches)
+    assert sorted(x for b in batches for x in b) == [1, 2, 3, 4, 5, 6]
+
+    u = from_items([1, 2], 1).union(from_items([3, 4], 1))
+    assert sorted(u.gather_sync()) == [1, 2, 3, 4]
+    assert u.num_shards() == 2
+    assert len(from_range(100, 4).take(5)) == 5
+
+
+def test_actor_group():
+    class Member:
+        def __init__(self, base):
+            self.base = base
+
+        def val(self, x):
+            return self.base + x
+
+        def whoami(self, rank):
+            return rank
+
+    g = ActorGroup(Member, 4, init_args=(100,))
+    assert len(g) == 4
+    assert g.execute("val", 5) == [105] * 4
+    assert g.execute_single(2, "val", 1) == 101
+    assert g.execute_with_rank("whoami") == [0, 1, 2, 3]
+    g.shutdown()
+    assert len(g) == 0
