@@ -1,0 +1,18 @@
+"""ray_tpu.rllib — reinforcement learning.
+
+Reference parity: rllib/ (SURVEY §2.5) — Algorithm/AlgorithmConfig,
+EnvRunnerGroup of sampling actors, a JAX Learner whose update is
+mesh-data-parallel (ICI gradient psum compiled by XLA instead of NCCL
+DDP), RLModule model abstraction, PPO + DQN algorithm families.
+"""
+from .algorithms.algorithm import Algorithm, AlgorithmConfig
+from .algorithms.dqn import DQN, DQNConfig
+from .algorithms.ppo import PPO, PPOConfig
+from .core.learner import JaxLearner
+from .core.rl_module import DQNModule, PPOModule, RLModule
+from .env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from .utils.replay_buffers import ReplayBuffer
+
+__all__ = ["Algorithm", "AlgorithmConfig", "DQN", "DQNConfig", "DQNModule",
+           "EnvRunnerGroup", "JaxLearner", "PPO", "PPOConfig", "PPOModule",
+           "RLModule", "ReplayBuffer", "SingleAgentEnvRunner"]

@@ -1,0 +1,169 @@
+"""Algorithm + AlgorithmConfig: the RLlib user surface.
+
+Reference parity: rllib/algorithms/algorithm.py:233 (Algorithm — a
+Trainable driving EnvRunnerGroup sampling + Learner updates per train())
+and algorithm_config.py (the fluent AlgorithmConfig builder:
+.environment().env_runners().training().build()).
+"""
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, Optional, Union
+
+import numpy as np
+
+from ..env.env_runner import EnvRunnerGroup
+
+
+class AlgorithmConfig:
+    """Reference: algorithm_config.py fluent builder."""
+
+    ALGO_CLS = None  # set by subclasses
+
+    def __init__(self):
+        self.env_spec: Union[str, Callable, None] = None
+        self.env_config: Dict = {}
+        self.num_env_runners: int = 2
+        self.rollout_fragment_length: int = 200
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.train_batch_size: int = 400
+        self.hidden: tuple = (64, 64)
+        self.seed: int = 0
+        self.extra: Dict[str, Any] = {}
+
+    def environment(self, env=None, *, env_config: Optional[Dict] = None):
+        if env is not None:
+            self.env_spec = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr=None, gamma=None, train_batch_size=None,
+                 model=None, **kwargs):
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if model is not None and "hidden" in model:
+            self.hidden = tuple(model["hidden"])
+        self.extra.update(kwargs)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "Algorithm":
+        return self.ALGO_CLS(self)
+
+
+def _env_dims(env_spec, env_config) -> tuple:
+    from ..env.env_runner import _make_env
+    env = _make_env(env_spec, env_config or {})
+    obs_dim = int(np.prod(env.observation_space.shape))
+    num_actions = int(env.action_space.n)
+    env.close()
+    return obs_dim, num_actions
+
+
+class Algorithm:
+    """Reference: algorithm.py:233 (train/evaluate/save/restore)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._total_steps = 0
+        self._episode_returns: list = []
+        obs_dim, num_actions = _env_dims(config.env_spec, config.env_config)
+        self.module = self._build_module(obs_dim, num_actions)
+        self.learner = self._build_learner()
+        self.env_runner_group = EnvRunnerGroup(
+            config.env_spec, config.env_config, self.module,
+            num_env_runners=config.num_env_runners, seed=config.seed)
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+
+    # subclass hooks
+    def _build_module(self, obs_dim: int, num_actions: int):
+        raise NotImplementedError
+
+    def _build_learner(self):
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- public API --------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        result = self.training_step()
+        self.iteration += 1
+        metrics = self.env_runner_group.collect_metrics()
+        self._episode_returns.extend(
+            m["episode_return"] for m in metrics)
+        recent = self._episode_returns[-100:]
+        result.update({
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(recent)) if recent
+            else float("nan"),
+            "num_episodes": len(self._episode_returns),
+            "num_env_steps_sampled_lifetime": self._total_steps,
+            "time_this_iter_s": time.perf_counter() - t0,
+        })
+        return result
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, float]:
+        """Greedy rollouts on a fresh env (reference:
+        Algorithm.evaluate)."""
+        from ..env.env_runner import _make_env
+        env = _make_env(self.config.env_spec, self.config.env_config)
+        params = self.learner.get_weights()
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=10_000 + ep)
+            done, total = False, 0.0
+            while not done:
+                a = self.module.forward_inference(
+                    params, np.asarray(obs, np.float32)[None])
+                obs, rew, term, trunc, _ = env.step(int(a[0]))
+                total += float(rew)
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"evaluation_return_mean": float(np.mean(returns)),
+                "evaluation_return_max": float(np.max(returns))}
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(os.path.join(checkpoint_dir, "algorithm.pkl"), "wb") as f:
+            pickle.dump({"learner_state": self.learner.get_state(),
+                         "iteration": self.iteration,
+                         "total_steps": self._total_steps}, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str):
+        with open(os.path.join(checkpoint_dir, "algorithm.pkl"), "rb") as f:
+            st = pickle.load(f)
+        self.learner.set_state(st["learner_state"])
+        self.iteration = st["iteration"]
+        self._total_steps = st["total_steps"]
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+
+    def stop(self):
+        self.env_runner_group.stop()
+
+    # Tune integration: Algorithm is usable as a trainable
+    # (reference: Algorithm IS a Trainable).
+    def step(self) -> Dict[str, Any]:
+        return self.train()

@@ -1,0 +1,84 @@
+"""DQN (reference: rllib/algorithms/dqn/ — replay buffer + target
+network + epsilon-greedy exploration, double-Q loss)."""
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.learner import JaxLearner
+from ..core.rl_module import DQNModule
+from ..utils.replay_buffers import ReplayBuffer
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def make_dqn_loss(gamma: float):
+    def dqn_loss(params, module, batch):
+        """Double-DQN TD loss (reference: dqn learner compute_loss):
+        online net picks argmax a', target net evaluates it."""
+        q = module.apply(params, batch["obs"])
+        q_taken = jnp.take_along_axis(
+            q, batch["actions"][:, None].astype(jnp.int32), -1)[:, 0]
+        q_next_online = module.apply(params, batch["next_obs"])
+        next_a = jnp.argmax(q_next_online, -1)
+        q_next_target = jnp.take_along_axis(
+            batch["target_q_next"], next_a[:, None], -1)[:, 0]
+        nonterm = 1.0 - batch["terminateds"].astype(jnp.float32)
+        target = batch["rewards"] + gamma * nonterm * q_next_target
+        td = q_taken - jax.lax.stop_gradient(target)
+        loss = jnp.mean(jnp.square(td))
+        return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
+                      "q_mean": jnp.mean(q_taken)}
+    return dqn_loss
+
+
+class DQN(Algorithm):
+    def __init__(self, config):
+        super().__init__(config)
+        self.buffer = ReplayBuffer(
+            int(config.extra.get("buffer_capacity", 50_000)),
+            seed=config.seed)
+        self.target_params = self.learner.get_weights()
+        self._target_q = jax.jit(
+            lambda p, obs: self.module.apply(p, obs))
+
+    def _build_module(self, obs_dim, num_actions):
+        return DQNModule(obs_dim, num_actions, self.config.hidden)
+
+    def _build_learner(self):
+        return JaxLearner(self.module, make_dqn_loss(self.config.gamma),
+                          lr=self.config.lr, seed=self.config.seed)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        eps_start = float(cfg.extra.get("epsilon_start", 1.0))
+        eps_end = float(cfg.extra.get("epsilon_end", 0.05))
+        eps_iters = float(cfg.extra.get("epsilon_iters", 20))
+        epsilon = max(eps_end, eps_start - (eps_start - eps_end)
+                      * self.iteration / eps_iters)
+        for frag in self.env_runner_group.sample(
+                cfg.rollout_fragment_length, epsilon=epsilon):
+            self.buffer.add_batch(frag)
+            self._total_steps += len(frag["rewards"])
+        stats: Dict = {"epsilon": epsilon}
+        warmup = int(cfg.extra.get("learning_starts", 1000))
+        if len(self.buffer) >= max(warmup, cfg.train_batch_size):
+            for _ in range(int(cfg.extra.get("updates_per_iter", 8))):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                batch["target_q_next"] = np.asarray(self._target_q(
+                    self.target_params, jnp.asarray(batch["next_obs"])))
+                stats.update(self.learner.update(batch))
+        if self.iteration % int(
+                cfg.extra.get("target_update_freq", 5)) == 0:
+            self.target_params = self.learner.get_weights()
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+        return stats
+
+
+class DQNConfig(AlgorithmConfig):
+    ALGO_CLS = DQN
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 64

@@ -1,0 +1,142 @@
+"""PPO (reference: rllib/algorithms/ppo/ — ppo.py, ppo_learner,
+default PPO RLModule): clipped surrogate objective + GAE, minibatch
+epochs, all math jitted in the learner (mesh-DP when devices allow).
+"""
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.learner import JaxLearner
+from ..core.rl_module import PPOModule
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def make_ppo_loss(clip: float = 0.2, vf_coeff: float = 0.5,
+                  entropy_coeff: float = 0.01):
+    """Clipped surrogate + value + entropy (reference: ppo_torch_learner
+    compute_loss_for_module; coefficients match PPOConfig.training)."""
+
+    def ppo_loss(params, module, batch):
+        logits, values = module.apply(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1
+        )[:, 0]
+        ratio = jnp.exp(logp - batch["action_logp"])
+        adv = batch["advantages"]
+        surrogate = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        policy_loss = -jnp.mean(surrogate)
+        vf_loss = 0.5 * jnp.mean((values - batch["value_targets"]) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    return ppo_loss
+
+
+ppo_loss = make_ppo_loss()  # default-coefficient loss (tests, docs)
+
+
+def compute_gae(batch: Dict[str, np.ndarray], gamma: float,
+                lam: float = 0.95, bootstrap_value: float = 0.0,
+                trunc_next_values: "np.ndarray" = None
+                ) -> Dict[str, np.ndarray]:
+    """Generalized advantage estimation over a rollout fragment
+    (reference: rllib/evaluation/postprocessing.py compute_advantages).
+
+    `bootstrap_value` is V(s_N) for a fragment cut mid-episode — without
+    it the last transitions see a zero future and targets bias low.
+    `trunc_next_values[t]` (optional, full-length) supplies V(next_obs_t)
+    for steps truncated mid-fragment, whose successor row belongs to the
+    NEXT episode."""
+    rewards = batch["rewards"]
+    values = batch["vf_preds"]
+    terminated = batch["terminateds"].astype(np.float32)
+    truncated = np.logical_and(batch["truncateds"],
+                               ~batch["terminateds"])
+    trunc_or_term = np.logical_or(
+        batch["terminateds"], batch["truncateds"]).astype(np.float32)
+    n = len(rewards)
+    adv = np.zeros(n, np.float32)
+    last = 0.0
+    # Bootstrap with V(s_{t+1}) within the fragment; episode boundaries
+    # cut the recursion. Truncations bootstrap, terminations don't.
+    next_values = np.append(values[1:], np.float32(bootstrap_value))
+    if trunc_next_values is not None:
+        next_values = np.where(truncated, trunc_next_values, next_values)
+    for t in reversed(range(n)):
+        nonterm = 1.0 - terminated[t]
+        boundary = 1.0 - trunc_or_term[t]
+        delta = rewards[t] + gamma * next_values[t] * nonterm - values[t]
+        last = delta + gamma * lam * boundary * last
+        adv[t] = last
+    targets = adv + values
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    out = dict(batch)
+    out["advantages"] = adv
+    out["value_targets"] = targets.astype(np.float32)
+    return out
+
+
+class PPO(Algorithm):
+    def _build_module(self, obs_dim, num_actions):
+        return PPOModule(obs_dim, num_actions, self.config.hidden)
+
+    def _build_learner(self):
+        ex = self.config.extra
+        loss = make_ppo_loss(
+            clip=float(ex.get("clip_param", 0.2)),
+            vf_coeff=float(ex.get("vf_loss_coeff", 0.5)),
+            entropy_coeff=float(ex.get("entropy_coeff", 0.01)))
+        return JaxLearner(self.module, loss, lr=self.config.lr,
+                          seed=self.config.seed)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        frags = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        params = self.learner.get_weights()
+
+        def _gae(b):
+            bootstrap = 0.0
+            if not (b["terminateds"][-1] or b["truncateds"][-1]):
+                _, v = self.module.apply(
+                    params, b["next_obs"][-1:].astype(np.float32))
+                bootstrap = float(v[0])
+            trunc_nv = None
+            trunc = np.logical_and(b["truncateds"], ~b["terminateds"])
+            if trunc.any():
+                _, v_all = self.module.apply(
+                    params, b["next_obs"].astype(np.float32))
+                trunc_nv = np.asarray(v_all)
+            return compute_gae(b, cfg.gamma, cfg.extra.get("lambda_", 0.95),
+                               bootstrap_value=bootstrap,
+                               trunc_next_values=trunc_nv)
+
+        frags = [_gae(b) for b in frags]
+        batch = {k: np.concatenate([f[k] for f in frags])
+                 for k in frags[0]}
+        self._total_steps += len(batch["rewards"])
+        n = len(batch["rewards"])
+        idx = np.arange(n)
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        num_epochs = int(cfg.extra.get("num_epochs", 4))
+        minibatch = int(cfg.extra.get("minibatch_size", 128))
+        stats = {}
+        for _ in range(num_epochs):
+            rng.shuffle(idx)
+            for s in range(0, n, minibatch):
+                mb = idx[s:s + minibatch]
+                if len(mb) < 2:
+                    continue
+                stats = self.learner.update(
+                    {k: v[mb] for k, v in batch.items()})
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+        return dict(stats)
+
+
+class PPOConfig(AlgorithmConfig):
+    ALGO_CLS = PPO

@@ -1,0 +1,122 @@
+"""RLModule: the model abstraction.
+
+Reference parity: rllib/core/rl_module/rl_module.py:260 (RLModule with
+forward_inference / forward_exploration / forward_train) re-designed for
+JAX: a module is a pure flax.linen network + explicit param pytrees, so
+the same definition runs in env-runner actors (numpy in, actions out) and
+in the learner's jitted/pjit'ed update.
+"""
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class MLPEncoder(nn.Module):
+    """Shared torso (reference: rllib's default MLP encoder,
+    catalog/model configs)."""
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, x):
+        for h in self.hidden:
+            x = nn.tanh(nn.Dense(h)(x))
+        return x
+
+
+class ActorCriticNet(nn.Module):
+    """Policy logits + value head (PPO-style)."""
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        z = MLPEncoder(self.hidden)(obs)
+        logits = nn.Dense(self.num_actions)(z)
+        value = jnp.squeeze(nn.Dense(1)(z), -1)
+        return logits, value
+
+
+class QNet(nn.Module):
+    """Q-values per action (DQN-style)."""
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        z = MLPEncoder(self.hidden)(obs)
+        return nn.Dense(self.num_actions)(z)
+
+
+class RLModule:
+    """Reference: rl_module.py:260. Stateless apply + explicit params."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+        self.net = self._build_net()
+
+    def _build_net(self) -> nn.Module:
+        raise NotImplementedError
+
+    def init_params(self, seed: int = 0):
+        dummy = jnp.zeros((1, self.obs_dim), jnp.float32)
+        return self.net.init(jax.random.PRNGKey(seed), dummy)["params"]
+
+    def apply(self, params, obs):
+        return self.net.apply({"params": params}, obs)
+
+    # -- the three forward modes (reference naming) ------------------------
+    def forward_inference(self, params, obs: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def forward_exploration(self, params, obs: np.ndarray, rng: np.random
+                            .Generator, **kw) -> Tuple[np.ndarray, Dict]:
+        raise NotImplementedError
+
+    def __reduce__(self):
+        return (type(self), (self.obs_dim, self.num_actions, self.hidden))
+
+
+class PPOModule(RLModule):
+    """Reference: rllib/algorithms/ppo default module."""
+
+    def _build_net(self):
+        return ActorCriticNet(self.num_actions, self.hidden)
+
+    def forward_inference(self, params, obs):
+        logits, _ = self.apply(params, jnp.asarray(obs))
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    def forward_exploration(self, params, obs, rng, **kw):
+        logits, value = self.apply(params, jnp.asarray(obs))
+        logits = np.asarray(logits)
+        value = np.asarray(value)
+        z = logits - logits.max(-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(-1, keepdims=True)
+        actions = np.array([rng.choice(self.num_actions, p=pi) for pi in p])
+        logp = np.log(p[np.arange(len(actions)), actions] + 1e-12)
+        return actions, {"vf_preds": value, "action_logp": logp}
+
+
+class DQNModule(RLModule):
+    """Reference: rllib/algorithms/dqn default module."""
+
+    def _build_net(self):
+        return QNet(self.num_actions, self.hidden)
+
+    def forward_inference(self, params, obs):
+        q = self.apply(params, jnp.asarray(obs))
+        return np.asarray(jnp.argmax(q, axis=-1))
+
+    def forward_exploration(self, params, obs, rng, epsilon: float = 0.1,
+                            **kw):
+        greedy = self.forward_inference(params, obs)
+        explore = rng.integers(0, self.num_actions, size=greedy.shape)
+        mask = rng.random(greedy.shape) < epsilon
+        return np.where(mask, explore, greedy), {}

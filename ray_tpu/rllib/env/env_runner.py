@@ -1,0 +1,131 @@
+"""EnvRunner: environment-sampling actors.
+
+Reference parity: rllib/env/single_agent_env_runner.py:65 (base
+env_runner.py:28) — an actor owning env instances + a copy of the module,
+producing sample batches; the EnvRunnerGroup fans sampling across N
+runner actors (rllib/env/env_runner_group.py).
+
+Sampling stays on CPU/numpy in the runners; only the learner touches the
+TPU — the split that keeps chips busy with batched updates instead of
+per-step single-row inference.
+"""
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+
+
+def _make_env(env_spec: Union[str, Callable], env_config: Dict):
+    if callable(env_spec):
+        return env_spec(env_config)
+    import gymnasium as gym
+    return gym.make(env_spec, **env_config)
+
+
+class SingleAgentEnvRunner:
+    """Reference: single_agent_env_runner.py:65."""
+
+    def __init__(self, env_spec, env_config: Dict, module, seed: int = 0):
+        self.env = _make_env(env_spec, env_config or {})
+        self.module = module
+        self.params = None
+        self.rng = np.random.default_rng(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._episode_len = 0
+        self._completed: List[Dict[str, float]] = []
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def sample(self, num_steps: int, explore: bool = True,
+               **explore_kw) -> Dict[str, np.ndarray]:
+        """Collect num_steps transitions (truncating episodes as needed).
+        Returns a columnar batch (reference: SampleBatch columns)."""
+        assert self.params is not None, "set_weights first"
+        cols: Dict[str, List] = {k: [] for k in
+                                 ("obs", "actions", "rewards", "terminateds",
+                                  "truncateds", "next_obs")}
+        extras: Dict[str, List] = {}
+        for _ in range(num_steps):
+            obs_b = np.asarray(self._obs, np.float32)[None]
+            if explore:
+                action, info = self.module.forward_exploration(
+                    self.params, obs_b, self.rng, **explore_kw)
+            else:
+                action, info = self.module.forward_inference(
+                    self.params, obs_b), {}
+            a = int(action[0])
+            nxt, rew, term, trunc, _ = self.env.step(a)
+            cols["obs"].append(np.asarray(self._obs, np.float32))
+            cols["actions"].append(a)
+            cols["rewards"].append(float(rew))
+            cols["terminateds"].append(bool(term))
+            cols["truncateds"].append(bool(trunc))
+            cols["next_obs"].append(np.asarray(nxt, np.float32))
+            for k, v in info.items():
+                extras.setdefault(k, []).append(np.asarray(v[0]))
+            self._episode_return += float(rew)
+            self._episode_len += 1
+            if term or trunc:
+                self._completed.append({
+                    "episode_return": self._episode_return,
+                    "episode_len": self._episode_len})
+                self._episode_return = 0.0
+                self._episode_len = 0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        batch = {k: np.asarray(v) for k, v in cols.items()}
+        for k, v in extras.items():
+            batch[k] = np.asarray(v)
+        return batch
+
+    def get_metrics(self) -> List[Dict[str, float]]:
+        out, self._completed = self._completed, []
+        return out
+
+    def ping(self) -> bool:
+        return True
+
+
+class EnvRunnerGroup:
+    """Reference: env_runner_group.py — N runner actors + fan-out."""
+
+    def __init__(self, env_spec, env_config: Dict, module,
+                 num_env_runners: int = 2, seed: int = 0):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        Runner = ray_tpu.remote(SingleAgentEnvRunner)
+        self._runners = [
+            Runner.remote(env_spec, env_config, module, seed + i)
+            for i in range(max(1, num_env_runners))]
+        ray_tpu.get([r.ping.remote() for r in self._runners])
+
+    def __len__(self):
+        return len(self._runners)
+
+    def sync_weights(self, params):
+        ray_tpu.get([r.set_weights.remote(params) for r in self._runners])
+
+    def sample(self, steps_per_runner: int,
+               **explore_kw) -> List[Dict[str, np.ndarray]]:
+        return ray_tpu.get([
+            r.sample.remote(steps_per_runner, **explore_kw)
+            for r in self._runners])
+
+    def collect_metrics(self) -> List[Dict[str, float]]:
+        out = []
+        for m in ray_tpu.get([r.get_metrics.remote()
+                              for r in self._runners]):
+            out.extend(m)
+        return out
+
+    def stop(self):
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,126 @@
+"""RLlib-equivalent tests (reference strategy: rllib's learning_tests —
+small-env smoke + learning-progress checks, e.g.
+rllib/tuned_examples/ppo/cartpole_ppo.py)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (DQNConfig, PPOConfig, ReplayBuffer)
+from ray_tpu.rllib.algorithms.ppo import compute_gae
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_gae_math():
+    batch = {
+        "rewards": np.array([1.0, 1.0, 1.0], np.float32),
+        "vf_preds": np.array([0.5, 0.5, 0.5], np.float32),
+        "terminateds": np.array([False, False, True]),
+        "truncateds": np.array([False, False, False]),
+    }
+    out = compute_gae(dict(batch), gamma=1.0, lam=1.0)
+    # Terminal step: target = reward = 1.0
+    assert out["value_targets"][2] == pytest.approx(1.0)
+    # First step bootstraps through the fragment: 1+1+1 = 3
+    assert out["value_targets"][0] == pytest.approx(3.0)
+    assert out["advantages"].mean() == pytest.approx(0.0, abs=1e-6)
+
+
+def test_replay_buffer():
+    buf = ReplayBuffer(capacity=10)
+    batch = {"obs": np.arange(8, dtype=np.float32).reshape(8, 1),
+             "actions": np.arange(8)}
+    buf.add_batch(batch)
+    assert len(buf) == 8
+    s = buf.sample(16)
+    assert s["obs"].shape == (16, 1)
+    buf.add_batch(batch)  # wraps around capacity
+    assert len(buf) == 10
+
+
+def test_ppo_cartpole_learns():
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, rollout_fragment_length=512)
+            .training(lr=1e-3, gamma=0.99,
+                      num_epochs=8, minibatch_size=256)
+            .debugging(seed=0)
+            .build())
+    try:
+        first = algo.train()
+        assert "total_loss" in first and "policy_loss" in first
+        for _ in range(11):
+            result = algo.train()
+        assert result["training_iteration"] == 12
+        assert result["num_env_steps_sampled_lifetime"] > 10000
+        # CartPole random play is ~20 return (trailing-100 mean);
+        # learning must clearly beat it.
+        assert result["episode_return_mean"] > 40, result
+    finally:
+        algo.stop()
+
+
+def test_ppo_checkpoint_roundtrip(tmp_path):
+    algo = (PPOConfig().environment("CartPole-v1")
+            .env_runners(num_env_runners=1, rollout_fragment_length=64)
+            .build())
+    try:
+        algo.train()
+        path = algo.save(str(tmp_path / "ckpt"))
+        w_before = algo.learner.get_weights()
+        algo.train()
+        algo.restore(path)
+        w_after = algo.learner.get_weights()
+        import jax
+        leaves_b = jax.tree.leaves(w_before)
+        leaves_a = jax.tree.leaves(w_after)
+        for a, b in zip(leaves_a, leaves_b):
+            np.testing.assert_allclose(a, b)
+        assert algo.iteration == 1
+    finally:
+        algo.stop()
+
+
+def test_dqn_cartpole_smoke():
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, rollout_fragment_length=128)
+            .training(lr=1e-3, train_batch_size=64,
+                      learning_starts=256, updates_per_iter=4)
+            .build())
+    try:
+        for _ in range(4):
+            result = algo.train()
+        assert "td_error_mean" in result  # buffer warmed, updates ran
+        assert result["epsilon"] < 1.0
+        ev = algo.evaluate(num_episodes=2)
+        assert "evaluation_return_mean" in ev
+    finally:
+        algo.stop()
+
+
+def test_learner_mesh_dp():
+    """The learner shards batches over the virtual device mesh (conftest
+    pins 8 CPU devices) — DP axis present, params replicated."""
+    import jax
+    from ray_tpu.rllib import JaxLearner, PPOModule
+    from ray_tpu.rllib.algorithms.ppo import ppo_loss
+    assert len(jax.devices()) == 8
+    module = PPOModule(4, 2)
+    learner = JaxLearner(module, ppo_loss, use_mesh=True)
+    assert learner._mesh is not None
+    n = 64
+    batch = {
+        "obs": np.random.randn(n, 4).astype(np.float32),
+        "actions": np.random.randint(0, 2, n),
+        "action_logp": np.full(n, -0.69, np.float32),
+        "advantages": np.random.randn(n).astype(np.float32),
+        "value_targets": np.random.randn(n).astype(np.float32),
+    }
+    out = learner.update(batch)
+    assert np.isfinite(out["total_loss"])
