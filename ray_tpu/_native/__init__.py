@@ -1,0 +1,243 @@
+"""ctypes bindings for the native (C++) runtime components.
+
+Reference: the role of _raylet.pyx — binding Python to the C++ layer —
+without Cython (not baked into this image): a plain C ABI + ctypes.
+
+Builds lazily with g++ on first use (cached as _native/libray_tpu.so,
+rebuilt when sources are newer). Everything degrades gracefully: callers
+check `available()` and fall back to the pure-Python paths.
+"""
+import ctypes
+import mmap as _mmap
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = [os.path.join(_DIR, "src", f) for f in ("store.cpp", "transfer.cpp")]
+_SO = os.path.join(_DIR, "libray_tpu.so")
+_lock = threading.Lock()
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_m = os.path.getmtime(_SO)
+    return any(os.path.getmtime(s) > so_m for s in _SRC)
+
+
+def _build():
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-pthread", "-std=c++17",
+           "-o", _SO + ".tmp"] + _SRC
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{proc.stderr}")
+    os.replace(_SO + ".tmp", _SO)
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if _needs_build():
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception as e:  # noqa: BLE001
+            _build_error = str(e)
+            return None
+        # signatures
+        lib.rt_store_create.restype = ctypes.c_void_p
+        lib.rt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rt_store_open.restype = ctypes.c_void_p
+        lib.rt_store_open.argtypes = [ctypes.c_char_p]
+        lib.rt_store_create_obj.restype = ctypes.c_int64
+        lib.rt_store_create_obj.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.rt_store_seal.restype = ctypes.c_int
+        lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_put.restype = ctypes.c_int64
+        lib.rt_store_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_void_p, ctypes.c_uint64]
+        lib.rt_store_get.restype = ctypes.c_int
+        lib.rt_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+        lib.rt_store_contains.restype = ctypes.c_int
+        lib.rt_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_release.restype = ctypes.c_int
+        lib.rt_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_delete.restype = ctypes.c_int
+        lib.rt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        for f in ("rt_store_used", "rt_store_capacity",
+                  "rt_store_num_objects", "rt_store_evictions"):
+            getattr(lib, f).restype = ctypes.c_uint64
+            getattr(lib, f).argtypes = [ctypes.c_void_p]
+        lib.rt_store_close.restype = None
+        lib.rt_store_close.argtypes = [ctypes.c_void_p]
+        lib.rt_store_unlink.argtypes = [ctypes.c_char_p]
+        lib.rt_transfer_serve.restype = ctypes.c_void_p
+        lib.rt_transfer_serve.argtypes = [ctypes.c_void_p, ctypes.c_uint16]
+        lib.rt_transfer_port.restype = ctypes.c_uint16
+        lib.rt_transfer_port.argtypes = [ctypes.c_void_p]
+        lib.rt_transfer_stop.restype = None
+        lib.rt_transfer_stop.argtypes = [ctypes.c_void_p]
+        lib.rt_transfer_pull.restype = ctypes.c_int
+        lib.rt_transfer_pull.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,
+            ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+class NativeStore:
+    """Python handle to the C++ arena store (plasma-client equivalent).
+
+    Reads are zero-copy: Python maps the same arena file and returns
+    memoryview slices at the offsets the C side hands out.
+    """
+
+    def __init__(self, path: str, capacity: Optional[int] = None,
+                 create: bool = True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_build_error}")
+        self._lib = lib
+        self.path = path
+        if create:
+            self._h = lib.rt_store_create(path.encode(),
+                                          int(capacity or (1 << 30)))
+        else:
+            self._h = lib.rt_store_open(path.encode())
+        if not self._h:
+            raise RuntimeError(
+                f"failed to {'create' if create else 'open'} arena {path}")
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._map = _mmap.mmap(fd, os.path.getsize(path))
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._map)
+
+    # -- object API --------------------------------------------------------
+    @staticmethod
+    def _key(object_id) -> bytes:
+        b = object_id if isinstance(object_id, bytes) else object_id.binary()
+        if len(b) != 16:
+            raise ValueError(f"ids must be 16 bytes, got {len(b)}")
+        return b
+
+    def put(self, object_id, data) -> int:
+        data = bytes(data) if not isinstance(data, (bytes, bytearray,
+                                                    memoryview)) else data
+        buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
+        off = self._lib.rt_store_put(self._h, self._key(object_id),
+                                     buf, len(data))
+        if off == -2:
+            raise FileExistsError("object already in store")
+        if off < 0:
+            raise MemoryError(f"arena full (rc={off})")
+        return off
+
+    def create(self, object_id, size: int) -> memoryview:
+        """Two-phase create: returns a writable view; call seal() after."""
+        off = self._lib.rt_store_create_obj(self._h, self._key(object_id),
+                                            size)
+        if off == -2:
+            raise FileExistsError("object already in store")
+        if off < 0:
+            raise MemoryError(f"arena full (rc={off})")
+        return self._view[off:off + size]
+
+    def seal(self, object_id):
+        if self._lib.rt_store_seal(self._h, self._key(object_id)) != 0:
+            raise KeyError("seal: object not in CREATED state")
+
+    def get(self, object_id) -> memoryview:
+        """Zero-copy read view; pins the object (call release() when
+        done, plasma client semantics)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rt_store_get(self._h, self._key(object_id),
+                                    ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            raise KeyError(f"object not found/sealed")
+        return self._view[off.value:off.value + size.value]
+
+    def contains(self, object_id) -> bool:
+        return bool(self._lib.rt_store_contains(self._h,
+                                                self._key(object_id)))
+
+    def release(self, object_id):
+        self._lib.rt_store_release(self._h, self._key(object_id))
+
+    def delete(self, object_id):
+        rc = self._lib.rt_store_delete(self._h, self._key(object_id))
+        if rc == -2:
+            raise RuntimeError("object pinned by a reader")
+
+    # -- stats -------------------------------------------------------------
+    def used_bytes(self) -> int:
+        return self._lib.rt_store_used(self._h)
+
+    def capacity(self) -> int:
+        return self._lib.rt_store_capacity(self._h)
+
+    def num_objects(self) -> int:
+        return self._lib.rt_store_num_objects(self._h)
+
+    def evictions(self) -> int:
+        return self._lib.rt_store_evictions(self._h)
+
+    def close(self, unlink: bool = False):
+        if self._h:
+            try:
+                self._view.release()
+                self._map.close()
+            except (BufferError, ValueError):
+                pass
+            self._lib.rt_store_close(self._h)
+            if unlink:
+                self._lib.rt_store_unlink(self.path.encode())
+            self._h = None
+
+
+class TransferServer:
+    """Serves this node's arena to peers (reference: ObjectManager server
+    side)."""
+
+    def __init__(self, store: NativeStore, port: int = 0):
+        self._lib = store._lib
+        self._h = self._lib.rt_transfer_serve(store._h, port)
+        if not self._h:
+            raise RuntimeError("failed to start transfer server")
+        self.port = self._lib.rt_transfer_port(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.rt_transfer_stop(self._h)
+            self._h = None
+
+
+def pull(local: NativeStore, host: str, port: int, object_id) -> None:
+    """Pull one object from a peer into the local arena (reference:
+    PullManager)."""
+    rc = local._lib.rt_transfer_pull(
+        local._h, host.encode(), port, NativeStore._key(object_id))
+    if rc == -2:
+        raise KeyError("object not on remote")
+    if rc != 0:
+        raise RuntimeError(f"pull failed (rc={rc})")

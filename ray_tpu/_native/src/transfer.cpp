@@ -1,0 +1,178 @@
+// Chunked node-to-node object transfer over TCP.
+//
+// Reference: src/ray/object_manager/ — ObjectManager (object_manager.h:117)
+// with PushManager/PullManager moving objects between nodes' plasma stores
+// in chunks over gRPC (object_buffer_pool.h chunking). Re-designed to a
+// minimal pull protocol (no gRPC dependency): a per-node server thread
+// serves GET <id> straight out of the local arena (store.cpp); the client
+// pulls into its own arena with create/seal, chunked so huge objects
+// never need a contiguous userspace staging buffer.
+//
+// Wire format (little-endian):
+//   request:  [16B id]
+//   response: [u64 size | payload]  (size == UINT64_MAX => not found)
+//
+// DCN/ICI note: this path carries HOST objects (control data, CPU
+// arrays). Device tensors never travel here — they move inside XLA
+// programs over ICI (SURVEY §2.1 translation note).
+
+#include <cstdint>
+#include <cstring>
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+extern "C" {
+struct Store;
+int64_t rt_store_create_obj(Store*, const uint8_t*, uint64_t);
+int rt_store_seal(Store*, const uint8_t*);
+int rt_store_get(Store*, const uint8_t*, uint64_t*, uint64_t*);
+int rt_store_release(Store*, const uint8_t*);
+uint8_t* rt_store_base_ptr(Store*);
+}
+
+namespace {
+
+constexpr uint32_t kIdLen = 16;
+constexpr uint64_t kChunk = 1 << 20;  // 1 MiB chunks
+
+struct Server {
+  Store* store;
+  int listen_fd;
+  uint16_t port;
+  pthread_t thread;
+  volatile bool stop;
+};
+
+bool read_exact(int fd, void* buf, uint64_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r; n -= static_cast<uint64_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, uint64_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r <= 0) return false;
+    p += r; n -= static_cast<uint64_t>(r);
+  }
+  return true;
+}
+
+void serve_conn(Server* sv, int cfd) {
+  uint8_t id[kIdLen];
+  while (read_exact(cfd, id, kIdLen)) {
+    uint64_t off = 0, size = 0;
+    if (rt_store_get(sv->store, id, &off, &size) != 0) {
+      uint64_t missing = UINT64_MAX;
+      if (!write_exact(cfd, &missing, 8)) break;
+      continue;
+    }
+    bool ok = write_exact(cfd, &size, 8);
+    uint8_t* base = rt_store_base_ptr(sv->store);
+    for (uint64_t sent = 0; ok && sent < size; sent += kChunk) {
+      uint64_t n = size - sent < kChunk ? size - sent : kChunk;
+      ok = write_exact(cfd, base + off + sent, n);
+    }
+    rt_store_release(sv->store, id);  // drop the read pin
+    if (!ok) break;
+  }
+  close(cfd);
+}
+
+void* server_loop(void* arg) {
+  Server* sv = static_cast<Server*>(arg);
+  while (!sv->stop) {
+    int cfd = accept(sv->listen_fd, nullptr, nullptr);
+    if (cfd < 0) { if (sv->stop) break; continue; }
+    int one = 1;
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    serve_conn(sv, cfd);
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+Server* rt_transfer_serve(Store* store, uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(port);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  Server* sv = new Server{store, fd, ntohs(addr.sin_port), {}, false};
+  pthread_create(&sv->thread, nullptr, server_loop, sv);
+  return sv;
+}
+
+uint16_t rt_transfer_port(Server* sv) { return sv->port; }
+
+void rt_transfer_stop(Server* sv) {
+  sv->stop = true;
+  shutdown(sv->listen_fd, SHUT_RDWR);
+  close(sv->listen_fd);
+  pthread_join(sv->thread, nullptr);
+  delete sv;
+}
+
+// Pull one object from a remote node into the local store.
+// Returns 0 ok, -1 connect error, -2 not found remotely, -3 local alloc.
+int rt_transfer_pull(Store* local, const char* host, uint16_t port,
+                     const uint8_t* id) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int rc = -1;
+  do {
+    if (!write_exact(fd, id, kIdLen)) break;
+    uint64_t size = 0;
+    if (!read_exact(fd, &size, 8)) break;
+    if (size == UINT64_MAX) { rc = -2; break; }
+    int64_t off = rt_store_create_obj(local, id, size);
+    if (off == -2) { rc = 0; break; }  // already present locally
+    if (off < 0) { rc = -3; break; }
+    uint8_t* base = rt_store_base_ptr(local);
+    bool ok = true;
+    for (uint64_t got = 0; ok && got < size; got += kChunk) {
+      uint64_t n = size - got < kChunk ? size - got : kChunk;
+      ok = read_exact(fd, base + off + got, n);
+    }
+    if (!ok) break;
+    rt_store_seal(local, id);
+    rt_store_release(local, id);  // drop creator pin; owner managed now
+    rc = 0;
+  } while (false);
+  close(fd);
+  return rc;
+}
+
+}  // extern "C"

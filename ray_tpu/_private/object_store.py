@@ -211,3 +211,106 @@ class ObjectStore:
             # Files written by workers that never reported back (crashes)
             # are not in _segments; sweep the whole session dir.
             shutil.rmtree(self._dir, ignore_errors=True)
+
+
+class ArenaObjectStore:
+    """Native-arena backend (opt-in: RAY_TPU_NATIVE_STORE=1).
+
+    Backed by the C++ plasma-equivalent (_native/src/store.cpp): one
+    shared mmap arena + process-shared allocator instead of a file per
+    object — one mmap syscall total instead of one per object, which is
+    the many-small-objects win. Tradeoff: reads COPY out of the arena
+    (the file-per-object store reads zero-copy and relies on the OS
+    keeping unlinked pages alive; arena space is recycled, so aliasing
+    views into it would be unsafe). Owner refcounting pins every object
+    until free(), so the arena's LRU eviction never reclaims a tracked
+    object out from under the GCS.
+    """
+
+    def __init__(self, session_dir: str, capacity: Optional[int] = None):
+        from .. import _native
+        os.makedirs(session_dir, exist_ok=True)
+        self._path = os.path.join(session_dir, "arena.shm")
+        self._capacity = capacity or _default_capacity()
+        try:
+            self._store = _native.NativeStore(
+                self._path, self._capacity, create=True)
+            self._owner = True
+        except (RuntimeError, FileExistsError):
+            self._store = _native.NativeStore(self._path, create=False)
+            self._owner = False
+
+    def used_bytes(self) -> int:
+        return self._store.used_bytes()
+
+    def capacity(self) -> int:
+        return self._store.capacity()
+
+    def put_serialized(self, object_id: ObjectID,
+                       sobj: serialization.SerializedObject) -> int:
+        size = sobj.total_size
+        try:
+            view = self._store.create(object_id, size)
+        except MemoryError as e:
+            raise ObjectStoreFullError(str(e)) from e
+        try:
+            sobj.write_into(view)
+        finally:
+            view.release()
+        self._store.seal(object_id)
+        # creator pin retained: owner-driven free() is the only reclaim
+        return size
+
+    def put(self, object_id: ObjectID, value: Any) -> int:
+        return self.put_serialized(object_id, serialization.serialize(value))
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._store.contains(object_id)
+
+    def get(self, object_id: ObjectID) -> Any:
+        view = self._store.get(object_id)
+        try:
+            data = bytes(view)  # copy: arena pages are recycled on free
+        finally:
+            view.release()
+            self._store.release(object_id)
+        return serialization.deserialize(memoryview(data))
+
+    def get_raw(self, object_id: ObjectID) -> memoryview:
+        view = self._store.get(object_id)
+        try:
+            data = bytes(view)
+        finally:
+            view.release()
+            self._store.release(object_id)
+        return memoryview(data)
+
+    def adopt(self, object_id: ObjectID, size: int):
+        # Accounting lives in the shared arena header; nothing to adopt.
+        pass
+
+    def free(self, object_id: ObjectID):
+        try:
+            self._store.release(object_id)  # drop creator pin
+            self._store.delete(object_id)
+        except (KeyError, RuntimeError):
+            pass
+
+    def release(self, object_id: ObjectID):
+        pass  # reads copy; nothing stays pinned
+
+    def shutdown(self):
+        self._store.close(unlink=self._owner)
+
+
+def create_store(session_dir: str, capacity: Optional[int] = None):
+    """Pick the store backend (native arena when RAY_TPU_NATIVE_STORE=1
+    and the C++ lib builds; file-per-object otherwise)."""
+    if os.environ.get("RAY_TPU_NATIVE_STORE") == "1":
+        try:
+            from .. import _native
+            if _native.available():
+                return ArenaObjectStore(session_dir, capacity)
+        except Exception:
+            pass
+    return ObjectStore(session_dir, capacity)

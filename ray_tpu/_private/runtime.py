@@ -32,7 +32,7 @@ from . import gcs as gcs_mod
 from . import protocol as P
 from . import serialization
 from .ids import ActorID, NodeID, ObjectID, TaskID
-from .object_store import INLINE_THRESHOLD, ObjectStore
+from .object_store import INLINE_THRESHOLD, ObjectStore, create_store
 from .resources import detect_node_resources
 from .scheduler import ResourceManager, Scheduler, WorkerHandle, WorkerPool
 
@@ -85,7 +85,7 @@ class Node:
             "/tmp/ray_tpu_sessions", session_name)
         self.store_dir = os.path.join("/dev/shm", f"ray_tpu_{session_name}")
         os.makedirs(self.session_dir, exist_ok=True)
-        self.store = ObjectStore(self.store_dir,
+        self.store = create_store(self.store_dir,
                                  capacity=object_store_memory)
         self.gcs = gcs_mod.Gcs()
         self.gcs.node_id_hex = self.node_id.hex()

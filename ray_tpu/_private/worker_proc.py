@@ -39,7 +39,7 @@ from ..exceptions import TaskCancelledError, TaskError
 from . import protocol as P
 from . import serialization
 from .ids import ActorID, ObjectID, TaskID
-from .object_store import INLINE_THRESHOLD, ObjectStore
+from .object_store import INLINE_THRESHOLD, ObjectStore, create_store
 
 
 # Per-thread currently-executing task spec (reference: the worker's
@@ -143,7 +143,7 @@ class Worker:
     def __init__(self, conn, config: P.WorkerConfig):
         self.conn = conn
         self.config = config
-        self.store = ObjectStore(config.store_dir)
+        self.store = create_store(config.store_dir)
         self.client = WorkerClient(self)
         self._send_lock = threading.Lock()
         self._req_counter = 0
