@@ -50,11 +50,31 @@ class TPUAcceleratorManager:
         return 0
 
     @staticmethod
+    def _gce_metadata(path: str) -> Optional[str]:
+        """GCE metadata server lookup (reference: tpu.py:14-44 — the
+        accelerator-type/topology detection on plain TPU VMs). Short
+        timeout + total failure tolerance: off-GCP this must cost ~nothing.
+        """
+        try:
+            import urllib.request
+            req = urllib.request.Request(
+                "http://metadata.google.internal/computeMetadata/v1/"
+                f"instance/attributes/{path}",
+                headers={"Metadata-Flavor": "Google"})
+            with urllib.request.urlopen(req, timeout=0.5) as r:
+                return r.read().decode().strip()
+        except Exception:
+            return None
+
+    @staticmethod
     def get_current_node_accelerator_type() -> Optional[str]:
         override = os.environ.get(ACCEL_TYPE_OVERRIDE_ENV)
         if override:
             return override
         accel_type = os.environ.get(GKE_TPU_ACCELERATOR_TYPE_ENV)
+        if accel_type is None and os.environ.get("RAY_TPU_USE_GCE_METADATA"):
+            accel_type = TPUAcceleratorManager._gce_metadata(
+                "accelerator-type")
         if accel_type:
             # "v5litepod-8" -> "TPU-V5LITEPOD" (reference: tpu.py version
             # parsing + util/accelerators/accelerators.py type constants).

@@ -1,0 +1,18 @@
+"""ray_tpu.llm — batch LLM inference pipelines.
+
+Reference parity: python/ray/llm/_internal/batch/ — a Processor chains
+stages (chat template -> tokenize -> inference -> detokenize,
+stages/chat_template_stage.py, tokenize_stage.py, http_request_stage.py)
+over Ray Data. Here stages run over ray_tpu.data Datasets via
+map_batches; the inference stage is TPU-native: a jitted greedy-decode
+loop over the in-repo GPT model on TPU actors (`num_tpus=1` actor pool),
+with power-of-two padding so XLA compiles a few bucket shapes
+(reference has no engine in-tree either — llm/ is the pipeline layer).
+"""
+from .batch import (ChatTemplateStage, DetokenizeStage, GPTInferenceStage,
+                    HttpRequestStage, Processor, ProcessorConfig,
+                    TokenizeStage, build_processor)
+
+__all__ = ["ChatTemplateStage", "DetokenizeStage", "GPTInferenceStage",
+           "HttpRequestStage", "Processor", "ProcessorConfig",
+           "TokenizeStage", "build_processor"]

@@ -1,0 +1,227 @@
+"""Batch pipeline stages (reference: llm/_internal/batch/stages/).
+
+Every stage is a map_batches-compatible callable over columnar dict
+batches. Stateful stages (tokenizer, model) are callable CLASSES so the
+data layer hosts them in an actor pool and state is built once per actor
+(reference: stages run as Ray Data actor-pool UDFs).
+"""
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class ChatTemplateStage:
+    """Render chat messages into a prompt string (reference:
+    chat_template_stage.py). Uses the tokenizer's template when a model
+    id is given, else a plain role-tagged format."""
+
+    def __init__(self, model: Optional[str] = None,
+                 input_column: str = "messages",
+                 output_column: str = "prompt"):
+        self._in = input_column
+        self._out = output_column
+        self._tok = None
+        if model is not None:
+            from transformers import AutoTokenizer
+            self._tok = AutoTokenizer.from_pretrained(model)
+
+    def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        prompts = []
+        for messages in batch[self._in]:
+            if isinstance(messages, str):
+                messages = json.loads(messages)
+            if self._tok is not None:
+                prompts.append(self._tok.apply_chat_template(
+                    messages, tokenize=False, add_generation_prompt=True))
+            else:
+                prompts.append("\n".join(
+                    f"<|{m['role']}|>: {m['content']}" for m in messages
+                ) + "\n<|assistant|>:")
+        out = dict(batch)
+        out[self._out] = prompts
+        return out
+
+
+class TokenizeStage:
+    """Prompt -> token ids (reference: tokenize_stage.py). Falls back to
+    a built-in byte tokenizer when no model id is given (no downloads)."""
+
+    def __init__(self, model: Optional[str] = None,
+                 input_column: str = "prompt",
+                 output_column: str = "tokens",
+                 max_length: int = 512):
+        self._in, self._out = input_column, output_column
+        self._max = max_length
+        self._tok = None
+        if model is not None:
+            from transformers import AutoTokenizer
+            self._tok = AutoTokenizer.from_pretrained(model)
+
+    def _encode(self, text: str) -> List[int]:
+        if self._tok is not None:
+            return self._tok.encode(text)[: self._max]
+        return list(text.encode("utf-8"))[: self._max]
+
+    def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(batch)
+        out[self._out] = [np.asarray(self._encode(p), np.int32)
+                          for p in batch[self._in]]
+        return out
+
+
+class DetokenizeStage:
+    """Token ids -> text (reference: detokenize stage)."""
+
+    def __init__(self, model: Optional[str] = None,
+                 input_column: str = "generated_tokens",
+                 output_column: str = "generated_text"):
+        self._in, self._out = input_column, output_column
+        self._tok = None
+        if model is not None:
+            from transformers import AutoTokenizer
+            self._tok = AutoTokenizer.from_pretrained(model)
+
+    def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        texts = []
+        for toks in batch[self._in]:
+            toks = [int(t) for t in toks]
+            if self._tok is not None:
+                texts.append(self._tok.decode(toks))
+            else:
+                texts.append(bytes(t % 256 for t in toks).decode(
+                    "utf-8", errors="replace"))
+        out = dict(batch)
+        out[self._out] = texts
+        return out
+
+
+class HttpRequestStage:
+    """POST each row to an endpoint (reference: http_request_stage.py —
+    the hosted-LLM path). Serial per batch; no egress in tests."""
+
+    def __init__(self, url: str, payload_column: str = "payload",
+                 output_column: str = "response",
+                 headers: Optional[Dict[str, str]] = None,
+                 timeout_s: float = 30.0):
+        self._url = url
+        self._in, self._out = payload_column, output_column
+        self._headers = dict(headers or {})
+        self._timeout = timeout_s
+
+    def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        import urllib.request
+        responses = []
+        for payload in batch[self._in]:
+            data = json.dumps(payload).encode() \
+                if not isinstance(payload, (bytes, str)) else (
+                    payload.encode() if isinstance(payload, str) else payload)
+            req = urllib.request.Request(
+                self._url, data=data,
+                headers={"Content-Type": "application/json",
+                         **self._headers})
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                responses.append(r.read().decode())
+        out = dict(batch)
+        out[self._out] = responses
+        return out
+
+
+class GPTInferenceStage:
+    """TPU-native generation stage: greedy decode with the in-repo GPT
+    (models/gpt.py) — prompts padded to power-of-two buckets so the
+    jitted decode compiles once per bucket (the XLA serving rule)."""
+
+    def __init__(self, config=None, params=None, max_new_tokens: int = 8,
+                 input_column: str = "tokens",
+                 output_column: str = "generated_tokens"):
+        import jax
+        from ..models.gpt import GPTConfig, gpt_forward, gpt_init
+        self._cfg = config or GPTConfig.tiny()
+        key = jax.random.PRNGKey(0)
+        self._params = params if params is not None else gpt_init(
+            key, self._cfg)
+        self._max_new = max_new_tokens
+        self._in, self._out = input_column, output_column
+
+        import jax.numpy as jnp
+
+        def _decode(params, tokens):
+            # tokens: [B, T] padded; greedy argmax loop via lax.scan over
+            # a fixed number of new tokens (static shapes for XLA).
+            def step(toks, _):
+                logits = gpt_forward(params, toks, self._cfg)
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+                toks = jnp.concatenate(
+                    [toks[:, 1:], nxt[:, None]], axis=1)
+                return toks, nxt
+
+            _, news = jax.lax.scan(step, tokens, None,
+                                   length=self._max_new)
+            return news.T  # [B, max_new]
+
+        self._decode = jax.jit(_decode)
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        toks_list = batch[self._in]
+        vocab = self._cfg.vocab_size
+        max_len = min(self._bucket(max(len(t) for t in toks_list)),
+                      self._cfg.max_seq_len)
+        padded = np.zeros((len(toks_list), max_len), np.int32)
+        for i, t in enumerate(toks_list):
+            t = np.asarray(t)[-max_len:] % vocab
+            padded[i, max_len - len(t):] = t  # left-pad (decode reads tail)
+        news = np.asarray(self._decode(self._params, jnp.asarray(padded)))
+        out = dict(batch)
+        out[self._out] = [news[i] for i in range(len(toks_list))]
+        return out
+
+
+@dataclass
+class ProcessorConfig:
+    """Reference: batch/processor config objects."""
+    model: Optional[str] = None          # HF id for tokenizer/template
+    batch_size: int = 16
+    concurrency: int = 1
+    max_new_tokens: int = 8
+    use_chat_template: bool = False
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class Processor:
+    """Chains stages over a Dataset (reference: batch/processor.py)."""
+
+    def __init__(self, stages: List[Any], batch_size: int = 16):
+        self.stages = list(stages)
+        self.batch_size = batch_size
+
+    def __call__(self, dataset):
+        for stage in self.stages:
+            if isinstance(stage, type):
+                dataset = dataset.map_batches(
+                    stage, batch_size=self.batch_size)
+            else:
+                dataset = dataset.map_batches(
+                    stage, batch_size=self.batch_size)
+        return dataset
+
+
+def build_processor(config: ProcessorConfig) -> Processor:
+    """Standard pipeline: [chat template] -> tokenize -> generate ->
+    detokenize (reference: build_llm_processor)."""
+    stages: List[Any] = []
+    if config.use_chat_template:
+        stages.append(ChatTemplateStage(config.model))
+    stages.append(TokenizeStage(config.model))
+    stages.append(GPTInferenceStage(max_new_tokens=config.max_new_tokens))
+    stages.append(DetokenizeStage(config.model))
+    return Processor(stages, batch_size=config.batch_size)

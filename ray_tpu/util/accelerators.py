@@ -1,0 +1,32 @@
+"""Accelerator type constants (reference:
+python/ray/util/accelerators/accelerators.py:22-25 TPU type constants,
+used with `accelerator_type=` on tasks/actors for type-affinity
+scheduling)."""
+
+TPU_V2 = "TPU-V2"
+TPU_V3 = "TPU-V3"
+TPU_V4 = "TPU-V4"
+TPU_V5P = "TPU-V5P"
+TPU_V5LITEPOD = "TPU-V5LITEPOD"
+TPU_V6E = "TPU-V6E"
+
+# chips per host by generation (standard TPU VM topologies)
+TPU_CHIPS_PER_HOST = {
+    TPU_V2: 4, TPU_V3: 4, TPU_V4: 4, TPU_V5P: 4,
+    TPU_V5LITEPOD: 8, TPU_V6E: 8,
+}
+
+ALL_TPU_TYPES = tuple(TPU_CHIPS_PER_HOST)
+
+
+def chips_per_host(accel_type: str) -> int:
+    return TPU_CHIPS_PER_HOST.get(accel_type, 4)
+
+
+def pod_slice_head_resource(accel_type: str, total_chips: int) -> str:
+    """`TPU-<ver>-<chips>-head` gang resource (reference: tpu.py:330-377)."""
+    return f"{accel_type}-{total_chips}-head"
+
+
+def pod_slice_num_hosts(accel_type: str, total_chips: int) -> int:
+    return max(1, total_chips // chips_per_host(accel_type))

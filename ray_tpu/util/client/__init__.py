@@ -1,0 +1,25 @@
+"""Remote-driver client (Ray Client equivalent).
+
+Reference parity: python/ray/util/client/ (+ARCHITECTURE.md) — a thin
+driver on a laptop proxies `ray.*` calls over the wire to a server
+inside the cluster (util/client/server/server.py RayletServicer,
+protobuf/ray_client.proto). Here: a multiprocessing.connection listener
+in the cluster process; the client ships cloudpickled functions/classes
+and holds ClientObjectRef/ClientActorHandle ids. Device data never
+crosses this link — only host args/results (the reference has the same
+property: the client is control-plane).
+
+Server:  from ray_tpu.util.client import server
+         server.serve("127.0.0.1", 20001)          # in-cluster process
+Client:  import ray_tpu.util.client as client
+         conn = client.connect("127.0.0.1:20001")
+         ref = conn.remote(fn).remote(args)
+         conn.get(ref)
+"""
+from .common import (ClientActorHandle, ClientObjectRef,
+                     ClientRemoteFunction)
+from .client import ClientConnection, connect
+from . import server
+
+__all__ = ["ClientActorHandle", "ClientConnection", "ClientObjectRef",
+           "ClientRemoteFunction", "connect", "server"]

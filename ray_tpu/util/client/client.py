@@ -1,0 +1,90 @@
+"""Client side of the remote driver (reference: util/client/worker.py —
+the Worker that proxies API calls over the channel)."""
+import threading
+import uuid
+from multiprocessing.connection import Client as _MpClient
+from typing import Any, List, Optional, Union
+
+import cloudpickle
+
+from .common import (ClientActorClass, ClientActorHandle, ClientObjectRef,
+                     ClientRemoteFunction)
+from .server import AUTHKEY
+
+
+class ClientConnection:
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self._conn = _MpClient((host, int(port)), family="AF_INET",
+                               authkey=AUTHKEY)
+        self._lock = threading.Lock()
+        assert self._request("ping")["ok"]
+
+    # -- plumbing ----------------------------------------------------------
+    def _request(self, op: str, **payload) -> dict:
+        payload["op"] = op
+        with self._lock:
+            self._conn.send_bytes(cloudpickle.dumps(payload))
+            result = cloudpickle.loads(self._conn.recv_bytes())
+        if not result.pop("__ok__", False):
+            raise RuntimeError(
+                f"client call failed: {result.get('error')}\n"
+                f"{result.get('traceback', '')}")
+        return result
+
+    @staticmethod
+    def _strip(args, kwargs):
+        def conv(a):
+            if isinstance(a, ClientObjectRef):
+                return {"__client_ref__": True, "ref_id": a.ref_id}
+            return a
+        return (tuple(conv(a) for a in args),
+                {k: conv(v) for k, v in kwargs.items()})
+
+    def _call(self, op: str, *, args=(), kwargs=None, **extra
+              ) -> ClientObjectRef:
+        args, kwargs = self._strip(args, kwargs or {})
+        out = self._request(op, args=args, kwargs=kwargs, **extra)
+        return ClientObjectRef(self, out["ref_id"])
+
+    def _create_actor(self, cls_id: str, args, kwargs) -> ClientActorHandle:
+        args, kwargs = self._strip(args, kwargs or {})
+        out = self._request("create_actor", cls_id=cls_id, args=args,
+                            kwargs=kwargs)
+        return ClientActorHandle(self, out["actor_id"])
+
+    # -- API (mirrors ray_tpu.*) ------------------------------------------
+    def remote(self, target) -> Union[ClientRemoteFunction,
+                                      ClientActorClass]:
+        blob = cloudpickle.dumps(target)
+        if isinstance(target, type):
+            cls_id = f"c_{uuid.uuid4().hex}"
+            self._request("register_class", cls_id=cls_id, blob=blob)
+            return ClientActorClass(self, cls_id, target.__name__)
+        fn_id = f"f_{uuid.uuid4().hex}"
+        self._request("register_fn", fn_id=fn_id, blob=blob)
+        return ClientRemoteFunction(self, fn_id, target.__name__)
+
+    def get(self, refs: Union[ClientObjectRef, List[ClientObjectRef]],
+            *, timeout: Optional[float] = None) -> Any:
+        single = isinstance(refs, ClientObjectRef)
+        ref_list = [refs] if single else list(refs)
+        out = self._request("get", ref_ids=[r.ref_id for r in ref_list],
+                            timeout=timeout)
+        values = cloudpickle.loads(out["values"])
+        return values[0] if single else values
+
+    def put(self, value: Any) -> ClientObjectRef:
+        out = self._request("put", blob=cloudpickle.dumps(value))
+        return ClientObjectRef(self, out["ref_id"])
+
+    def close(self):
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+def connect(address: str) -> ClientConnection:
+    """Reference: ray.init("ray://host:port") client-mode entry."""
+    return ClientConnection(address)

@@ -1,0 +1,138 @@
+"""In-cluster client server (reference: util/client/server/server.py
+RayletServicer — executes proxied ray.* calls in the cluster on behalf
+of remote drivers; one session's refs/actors are tracked and released
+on disconnect)."""
+import threading
+import traceback
+from multiprocessing.connection import Listener
+from typing import Any, Dict
+
+import cloudpickle
+
+import ray_tpu
+
+AUTHKEY = b"ray_tpu-client"
+
+
+class _Session:
+    """Per-connection state (reference: server-side per-client tracking)."""
+
+    def __init__(self):
+        self.fns: Dict[str, Any] = {}        # fn_id -> RemoteFunction
+        self.classes: Dict[str, Any] = {}    # cls_id -> ActorClass
+        self.refs: Dict[str, Any] = {}       # ref_id -> ObjectRef
+        self.actors: Dict[str, Any] = {}     # actor_id -> ActorHandle
+
+    def release_all(self):
+        for a in self.actors.values():
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self.refs.clear()
+        self.actors.clear()
+
+
+def _handle(session: _Session, op: str, payload: Dict[str, Any]):
+    if op == "ping":
+        return {"ok": True}
+    if op == "register_fn":
+        fn = cloudpickle.loads(payload["blob"])
+        rf = ray_tpu.remote(fn)
+        session.fns[payload["fn_id"]] = rf
+        return {"ok": True}
+    if op == "register_class":
+        cls = cloudpickle.loads(payload["blob"])
+        session.classes[payload["cls_id"]] = ray_tpu.remote(cls)
+        return {"ok": True}
+    if op == "task":
+        rf = session.fns[payload["fn_id"]]
+        args, kwargs = _resolve(session, payload)
+        ref = rf.remote(*args, **kwargs)
+        session.refs[ref.hex()] = ref
+        return {"ref_id": ref.hex()}
+    if op == "create_actor":
+        cls = session.classes[payload["cls_id"]]
+        args, kwargs = _resolve(session, payload)
+        handle = cls.remote(*args, **kwargs)
+        aid = handle._id.hex()
+        session.actors[aid] = handle
+        return {"actor_id": aid}
+    if op == "actor_method":
+        handle = session.actors[payload["actor_id"]]
+        args, kwargs = _resolve(session, payload)
+        ref = getattr(handle, payload["method"]).remote(*args, **kwargs)
+        session.refs[ref.hex()] = ref
+        return {"ref_id": ref.hex()}
+    if op == "get":
+        refs = [session.refs[r] for r in payload["ref_ids"]]
+        values = ray_tpu.get(refs, timeout=payload.get("timeout"))
+        return {"values": cloudpickle.dumps(values)}
+    if op == "put":
+        ref = ray_tpu.put(cloudpickle.loads(payload["blob"]))
+        session.refs[ref.hex()] = ref
+        return {"ref_id": ref.hex()}
+    if op == "release":
+        session.refs.pop(payload["ref_id"], None)
+        return {"ok": True}
+    raise ValueError(f"unknown op {op}")
+
+
+def _resolve(session: _Session, payload):
+    """Client refs in args become server-side ObjectRefs."""
+    from .common import ClientObjectRef
+
+    def conv(a):
+        if isinstance(a, dict) and a.get("__client_ref__"):
+            return session.refs[a["ref_id"]]
+        return a
+
+    args = tuple(conv(a) for a in payload.get("args", ()))
+    kwargs = {k: conv(v) for k, v in payload.get("kwargs", {}).items()}
+    return args, kwargs
+
+
+def _serve_conn(conn):
+    session = _Session()
+    try:
+        while True:
+            try:
+                msg = cloudpickle.loads(conn.recv_bytes())
+            except (EOFError, OSError):
+                break
+            try:
+                result = _handle(session, msg["op"], msg)
+                result["__ok__"] = True
+            except Exception as e:  # noqa: BLE001
+                result = {"__ok__": False, "error": repr(e),
+                          "traceback": traceback.format_exc()}
+            conn.send_bytes(cloudpickle.dumps(result))
+    finally:
+        session.release_all()
+        conn.close()
+
+
+def serve(host: str = "127.0.0.1", port: int = 0,
+          blocking: bool = False):
+    """Start the client server; returns (host, port). The cluster must be
+    init()ed in this process."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    listener = Listener((host, port), family="AF_INET", authkey=AUTHKEY)
+    bound = listener.address
+
+    def _accept_loop():
+        while True:
+            try:
+                conn = listener.accept()
+            except (OSError, EOFError):
+                break
+            threading.Thread(target=_serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    t = threading.Thread(target=_accept_loop, daemon=True,
+                         name="client-server")
+    t.start()
+    if blocking:
+        t.join()
+    return bound
