@@ -47,7 +47,10 @@ def _gc_stale_sessions(max_age_s: float = 6 * 3600):
     for d in glob.glob("/dev/shm/ray_tpu_session_*") + glob.glob(
             "/tmp/ray_tpu_sessions/session_*"):
         try:
-            if now - os.path.getmtime(d) > max_age_s:
+            age = now - os.path.getmtime(d)
+            # Empty dirs are husks (a late worker re-created the dir
+            # after the driver's shutdown rmtree) — sweep those fast.
+            if age > max_age_s or (age > 120 and not os.listdir(d)):
                 shutil.rmtree(d, ignore_errors=True)
         except OSError:
             pass

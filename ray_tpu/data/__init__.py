@@ -11,7 +11,9 @@ groupby, iter_batches/streaming_split feeding trainers.
 """
 
 from .block import Block  # noqa: F401
+from .context import DataContext  # noqa: F401
 from .dataset import ActorPoolStrategy, Dataset, GroupedData  # noqa: F401
+from .streaming import DataIterator  # noqa: F401
 from .read_api import (  # noqa: F401
     from_arrow,
     from_items,
@@ -27,7 +29,8 @@ from .read_api import (  # noqa: F401
 )
 
 __all__ = [
-    "ActorPoolStrategy", "Block", "Dataset", "GroupedData", "from_arrow",
+    "ActorPoolStrategy", "Block", "DataContext", "DataIterator", "Dataset",
+    "GroupedData", "from_arrow",
     "from_items", "from_numpy", "from_pandas", "range",
     "read_binary_files", "read_csv", "read_json", "read_numpy",
     "read_parquet", "read_text",

@@ -1,0 +1,50 @@
+"""DataContext: execution configuration for Datasets.
+
+Reference parity: python/ray/data/context.py DataContext — a per-driver
+singleton consulted at execution time (target block sizes, streaming
+executor limits). Kept deliberately small: the TPU build's streaming
+executor needs an in-flight bundle cap (backpressure) and batch prefetch
+depth; block-size targeting happens in the read/repartition layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    """Execution options (reference: data/context.py DataContext).
+
+    target_max_block_size: soft cap on block bytes produced by reads.
+    max_in_flight_bundles: streaming-executor backpressure — the max
+        number of block-chains submitted but not yet consumed. Bounds
+        object-store footprint the way the reference's
+        resource_manager + backpressure_policy bound operator memory.
+    prefetch_batches: iter_batches read-ahead depth.
+    """
+
+    target_max_block_size: int = 128 * 1024 * 1024
+    max_in_flight_bundles: int = max(4, (os.cpu_count() or 4))
+    prefetch_batches: int = 2
+    # Preserve submission order when streaming (determinism); False lets
+    # bundles be yielded as they complete.
+    preserve_order: bool = True
+
+    _lock = threading.Lock()
+    _current: Optional["DataContext"] = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = DataContext()
+            return cls._current
+
+    @classmethod
+    def _set_current(cls, ctx: "DataContext") -> None:
+        with cls._lock:
+            cls._current = ctx

@@ -184,9 +184,14 @@ def _write_block(blk: B.Block, path: str, fmt: str, index: int) -> str:
 
 class _MapBatchesActorPool:
     """Actor-pool compute for map_batches (reference:
-    ActorPoolMapOperator, operators/actor_pool_map_operator.py:34)."""
+    ActorPoolMapOperator, operators/actor_pool_map_operator.py:34).
+
+    Supports bulk `map` (plan execution) and per-bundle `submit`
+    (streaming execution: round-robin dispatch, one in-flight chain per
+    call — the streaming executor caps total in-flight)."""
 
     def __init__(self, fn_cls, pool_size, opts, ctor_args, ctor_kwargs):
+        self._rr = 0
         @api.remote
         class _BatchMapper:
             def __init__(self, blob):
@@ -215,6 +220,13 @@ class _MapBatchesActorPool:
             for _ in range(pool_size)
         ]
 
+    def submit(self, blk_ref, batch_size, batch_format, fn_args,
+               fn_kwargs):
+        actor = self.actors[self._rr % len(self.actors)]
+        self._rr += 1
+        return actor.apply.remote(blk_ref, batch_size, batch_format,
+                                  fn_args, fn_kwargs)
+
     def map(self, bundles, batch_size, batch_format, fn_args, fn_kwargs):
         from ..util.actor_pool import ActorPool
         pool = ActorPool(self.actors)
@@ -239,23 +251,37 @@ class _MapBatchesActorPool:
 # plan
 # ---------------------------------------------------------------------------
 class _Stage:
+    """One plan stage. `fn` is the bulk executor (all bundles at once —
+    barriers like shuffle need it); `make_submitter`, when present, marks
+    the stage streamable: it returns (submit, close) where submit maps
+    one block ref to the stage-output ref via a single remote call, so the
+    streaming executor can pipeline bundles through stage chains
+    (reference: streaming_executor.py operator topology)."""
+
     def __init__(self, name: str,
-                 fn: Callable[[List[_RefBundle]], List[_RefBundle]]):
+                 fn: Callable[[List[_RefBundle]], List[_RefBundle]],
+                 make_submitter: Optional[Callable] = None):
         self.name = name
         self.fn = fn
+        self.make_submitter = make_submitter
 
 
 class _Plan:
     def __init__(self, source: Callable[[], List[_RefBundle]],
                  stages: Optional[List[_Stage]] = None,
-                 name: str = "dataset"):
+                 name: str = "dataset",
+                 iter_source: Optional[Callable] = None):
         self.source = source
         self.stages = stages or []
         self.name = name
+        # Optional lazy source: yields (ref, rows) without blocking on all
+        # reads up front (streaming path).
+        self.iter_source = iter_source
         self._cache: Optional[List[_RefBundle]] = None
 
     def with_stage(self, stage: _Stage) -> "_Plan":
-        p = _Plan(self.source, self.stages + [stage], self.name)
+        p = _Plan(self.source, self.stages + [stage], self.name,
+                  self.iter_source)
         # Chain from materialized prefix if present.
         if self._cache is not None:
             cached = self._cache
@@ -336,6 +362,16 @@ class Dataset:
                                     tuple(fn_args), fn_kwargs)
                 finally:
                     pool.shutdown()
+
+            def make_submitter():
+                pool = _MapBatchesActorPool(
+                    fn, compute.pool_size, opts, tuple(fn_constructor_args),
+                    fn_constructor_kwargs)
+
+                def submit(ref):
+                    return pool.submit(ref, batch_size, batch_format,
+                                       tuple(fn_args), fn_kwargs)
+                return submit, pool.shutdown
         else:
             def stage_fn(bundles: List[_RefBundle]) -> List[_RefBundle]:
                 task = _apply_batches.options(**opts) if opts \
@@ -347,8 +383,17 @@ class Dataset:
                 return [_RefBundle(r, B.block_length(blk))
                         for r, blk in zip(refs, blocks)]
 
+            def make_submitter():
+                task = _apply_batches.options(**opts) if opts \
+                    else _apply_batches
+
+                def submit(ref):
+                    return task.remote(ref, fn, batch_size, batch_format,
+                                       tuple(fn_args), fn_kwargs)
+                return submit, None
+
         return Dataset(self._plan.with_stage(
-            _Stage("MapBatches", stage_fn)))
+            _Stage("MapBatches", stage_fn, make_submitter)))
 
     def _row_op(self, fn, kind: str, name: str) -> "Dataset":
         def stage_fn(bundles):
@@ -356,7 +401,11 @@ class Dataset:
             blocks = api.get(refs)
             return [_RefBundle(r, B.block_length(blk))
                     for r, blk in zip(refs, blocks)]
-        return Dataset(self._plan.with_stage(_Stage(name, stage_fn)))
+
+        def make_submitter():
+            return (lambda ref: _apply_rows.remote(ref, fn, kind)), None
+        return Dataset(self._plan.with_stage(
+            _Stage(name, stage_fn, make_submitter)))
 
     def map(self, fn: Callable) -> "Dataset":
         return self._row_op(fn, "map", "Map")
@@ -536,37 +585,51 @@ class Dataset:
         for row in self.take(n):
             print(row)
 
+    def _iter_bundles(self):
+        """Streaming bundle iterator. If every stage is streamable
+        (per-bundle submitters), pump bundles through the chain with the
+        streaming executor — stage N of bundle i overlaps stage 1 of
+        bundle i+k, with an in-flight cap for backpressure (reference:
+        StreamingExecutor, streaming_executor.py:48). Plans containing a
+        barrier (shuffle/sort/repartition) fall back to bulk execution."""
+        from . import streaming
+        plan = self._plan
+        if plan._cache is not None or \
+                any(st.make_submitter is None for st in plan.stages):
+            for b in plan.execute():
+                yield (b.ref, b.num_rows)
+            return
+        subs, closers = [], []
+        try:
+            for st in plan.stages:
+                submit, close = st.make_submitter()
+                subs.append(submit)
+                if close is not None:
+                    closers.append(close)
+            if plan.iter_source is not None:
+                src = plan.iter_source()
+            else:
+                src = ((b.ref, b.num_rows) for b in plan.source())
+            yield from streaming.stream_bundles(src, subs)
+        finally:
+            for c in closers:
+                c()
+
     def iter_rows(self) -> Iterator[Dict]:
-        for b in self._plan.execute():
-            yield from B.block_to_rows(api.get(b.ref))
+        for ref, _ in self._iter_bundles():
+            yield from B.block_to_rows(api.get(ref))
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False,
                      prefetch_batches: int = 1) -> Iterator:
-        """(reference: dataset.py:4092 iter_batches)"""
-        leftover: Optional[B.Block] = None
-        for b in self._plan.execute():
-            blk = api.get(b.ref)
-            if leftover is not None:
-                blk = B.block_concat([leftover, blk])
-                leftover = None
-            n = B.block_length(blk)
-            if batch_size is None:
-                if n:
-                    yield B.to_batch_format(blk, batch_format)
-                continue
-            pos = 0
-            while n - pos >= batch_size:
-                yield B.to_batch_format(
-                    B.block_slice(blk, pos, pos + batch_size),
-                    batch_format)
-                pos += batch_size
-            if pos < n:
-                leftover = B.block_slice(blk, pos, n)
-        if leftover is not None and B.block_length(leftover) and \
-                not drop_last:
-            yield B.to_batch_format(leftover, batch_format)
+        """(reference: dataset.py:4092 iter_batches) — streamed: blocks
+        are produced by in-flight task chains while earlier batches are
+        consumed."""
+        from . import streaming
+        blocks = streaming.iter_blocks(self._iter_bundles())
+        yield from streaming.batches_from_blocks(
+            blocks, batch_size, batch_format, drop_last)
 
     def iter_torch_batches(self, **kwargs):
         for batch in self.iter_batches(
@@ -609,10 +672,16 @@ class Dataset:
         return out
 
     def streaming_split(self, n: int, *, equal: bool = True,
-                        locality_hints=None) -> List["Dataset"]:
-        """(reference: dataset.py:1537 streaming_split) — per-worker
-        shards consumed via iter_batches."""
-        return self.split(n, equal=equal)
+                        locality_hints=None) -> List:
+        """(reference: dataset.py:1537 streaming_split →
+        StreamSplitDataIterator, stream_split_iterator.py:31): n
+        coordinated DataIterators sharing one block stream via a
+        coordinator actor — each block is consumed by exactly one
+        consumer; picklable, so Train ships one per worker."""
+        from . import streaming
+        bundles = self._plan.execute()
+        return streaming.make_split_iterators(
+            [(b.ref, b.num_rows) for b in bundles], n, equal)
 
     # -- writes ------------------------------------------------------------
     def write_parquet(self, path: str) -> List[str]:

@@ -19,7 +19,11 @@ def _make_source(blocks: List[B.Block]) -> Dataset:
     def source():
         return [_RefBundle(api.put(blk), B.block_length(blk))
                 for blk in blocks]
-    return Dataset(_Plan(source, [], "source"))
+
+    def iter_source():
+        for blk in blocks:
+            yield (api.put(blk), B.block_length(blk))
+    return Dataset(_Plan(source, [], "source", iter_source))
 
 
 def _split_even(n: int, parts: int) -> List[tuple]:
@@ -118,7 +122,13 @@ def _read(paths, fmt: str, suffix: Optional[str]) -> Dataset:
         blocks = api.get(refs)
         return [_RefBundle(r, B.block_length(blk))
                 for r, blk in zip(refs, blocks)]
-    return Dataset(_Plan(source, [], f"read_{fmt}"))
+
+    def iter_source():
+        # Lazy read fan-out: file-read tasks are only submitted as the
+        # streaming window pulls them (rows unknown until read).
+        for p in files:
+            yield (_read_file.remote(p, fmt), -1)
+    return Dataset(_Plan(source, [], f"read_{fmt}", iter_source))
 
 
 def read_parquet(paths, **kwargs) -> Dataset:

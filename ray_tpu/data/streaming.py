@@ -1,0 +1,202 @@
+"""Streaming execution: pipelined bundle flow + split iterators.
+
+Reference parity: python/ray/data/_internal/execution/streaming_executor.py
+(StreamingExecutor :48) and _internal/iterator/stream_split_iterator.py
+(StreamSplitDataIterator :31). The TPU redesign leans on the task
+scheduler itself for pipelining: a chain of per-bundle map stages is
+submitted as a dependency chain of remote calls, so stage N of bundle i
+runs while stage 1 of bundle i+k is still executing — the pull-based
+operator topology of the reference collapses into dataflow on ObjectRefs.
+Backpressure = a cap on submitted-but-unconsumed chains
+(DataContext.max_in_flight_bundles), bounding store footprint the way the
+reference's resource manager + backpressure policies do.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from .. import api
+from . import block as B
+from .context import DataContext
+
+# A streamed bundle: (ObjectRef of block, row count or -1 if not known yet)
+StreamedBundle = Tuple[api.ObjectRef, int]
+
+
+def stream_bundles(
+    source: Iterator[StreamedBundle],
+    submitters: List[Callable[[api.ObjectRef], api.ObjectRef]],
+    window: Optional[int] = None,
+) -> Iterator[StreamedBundle]:
+    """Pump bundles from `source` through a chain of per-bundle stage
+    submitters, keeping at most `window` chains in flight.
+
+    Each submitter takes a block ref and returns the ref of the stage's
+    output — typically one `remote()` call whose argument is the upstream
+    ref, so the scheduler interleaves stages across bundles (no barrier
+    between stages; the reference's streaming topology, executor-less).
+    """
+    ctx = DataContext.get_current()
+    window = window or ctx.max_in_flight_bundles
+    preserve_order = ctx.preserve_order
+    in_flight: collections.deque = collections.deque()
+    exhausted = False
+    while True:
+        while not exhausted and len(in_flight) < window:
+            try:
+                ref, rows = next(source)
+            except StopIteration:
+                exhausted = True
+                break
+            for submit in submitters:
+                ref = submit(ref)
+            # Row count is unknown once a transform ran (rows may change).
+            in_flight.append((ref, rows if not submitters else -1))
+        if not in_flight:
+            return
+        if preserve_order or len(in_flight) == 1:
+            yield in_flight.popleft()
+        else:
+            # Completed-order: yield whichever chain finishes first so a
+            # slow head block can't stall finished successors.
+            ready, _ = api.wait([r for r, _ in in_flight],
+                                num_returns=1, timeout=None)
+            done = ready[0]
+            for i, (r, rows) in enumerate(in_flight):
+                if r is done:
+                    del in_flight[i]
+                    yield (r, rows)
+                    break
+
+
+def iter_blocks(bundles: Iterator[StreamedBundle]) -> Iterator[B.Block]:
+    for ref, _ in bundles:
+        yield api.get(ref)
+
+
+def batches_from_blocks(
+    blocks: Iterator[B.Block],
+    batch_size: Optional[int],
+    batch_format: str = "numpy",
+    drop_last: bool = False,
+) -> Iterator:
+    """Re-chunk a block stream into fixed-size batches (reference:
+    _internal/block_batching)."""
+    leftover: Optional[B.Block] = None
+    for blk in blocks:
+        if leftover is not None:
+            blk = B.block_concat([leftover, blk])
+            leftover = None
+        n = B.block_length(blk)
+        if batch_size is None:
+            if n:
+                yield B.to_batch_format(blk, batch_format)
+            continue
+        pos = 0
+        while n - pos >= batch_size:
+            yield B.to_batch_format(
+                B.block_slice(blk, pos, pos + batch_size), batch_format)
+            pos += batch_size
+        if pos < n:
+            leftover = B.block_slice(blk, pos, n)
+    if leftover is not None and B.block_length(leftover) and not drop_last:
+        yield B.to_batch_format(leftover, batch_format)
+
+
+# ---------------------------------------------------------------------------
+# streaming_split
+# ---------------------------------------------------------------------------
+@api.remote
+class _SplitCoordinator:
+    """Hands blocks out to n consumers exactly once per epoch (reference:
+    the SplitCoordinator actor behind streaming_split,
+    stream_split_iterator.py:31).
+
+    Blocks are pre-assigned at construction — equal=True balances by row
+    count (largest block to the least-loaded consumer, the classic LPT
+    greedy) — so a consumer that starts late or pulls slowly can never be
+    starved by a faster peer, and every epoch replays the same
+    assignment deterministically.
+    """
+
+    def __init__(self, bundles: List[Tuple[object, int]], n: int,
+                 equal: bool):
+        self._n = n
+        self._assignment: List[List] = [[] for _ in range(n)]
+        self._rows_given = [0] * n
+        if equal:
+            order = sorted(bundles, key=lambda b: -b[1])
+            for ref, rows in order:
+                tgt = min(range(n), key=lambda i: self._rows_given[i])
+                self._assignment[tgt].append(ref)
+                self._rows_given[tgt] += rows
+        else:
+            for i, (ref, rows) in enumerate(bundles):
+                self._assignment[i % n].append(ref)
+                self._rows_given[i % n] += rows
+        self._pos = [0] * n
+
+    def next_block(self, consumer: int):
+        """Next block ref for `consumer`, or None at epoch end (the
+        position resets, so the next iteration replays the shard)."""
+        pos = self._pos[consumer]
+        if pos >= len(self._assignment[consumer]):
+            self._pos[consumer] = 0
+            return None
+        self._pos[consumer] = pos + 1
+        return self._assignment[consumer][pos]
+
+    def stats(self):
+        return {"rows_given": list(self._rows_given)}
+
+
+class DataIterator:
+    """Per-consumer shard stream (reference: data/iterator.py DataIterator
+    returned by streaming_split). Picklable — holds only the coordinator
+    actor handle — so Train can ship one into each worker actor."""
+
+    def __init__(self, coordinator, consumer_id: int):
+        self._coord = coordinator
+        self._id = consumer_id
+
+    def _iter_block_refs(self):
+        while True:
+            ref = api.get(self._coord.next_block.remote(self._id))
+            if ref is None:
+                return
+            yield ref
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     prefetch_batches: int = 1) -> Iterator:
+        def blocks():
+            for ref in self._iter_block_refs():
+                yield api.get(ref)
+        return batches_from_blocks(blocks(), batch_size, batch_format,
+                                   drop_last)
+
+    def iter_rows(self) -> Iterator:
+        for batch in self.iter_batches(batch_size=None):
+            yield from B.block_to_rows(B.from_batch_format(batch))
+
+    def iter_torch_batches(self, **kwargs):
+        import torch
+        for batch in self.iter_batches(
+                batch_format="numpy",
+                **{k: v for k, v in kwargs.items()
+                   if k in ("batch_size", "drop_last")}):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    def materialize(self):
+        """Collect this shard into a list of blocks (mostly for tests)."""
+        return list(iter_blocks((r, -1) for r in self._iter_block_refs()))
+
+
+def make_split_iterators(bundles: List[StreamedBundle], n: int,
+                         equal: bool) -> List[DataIterator]:
+    coord = _SplitCoordinator.remote(
+        [(ref, rows) for ref, rows in bundles], n, equal)
+    return [DataIterator(coord, i) for i in range(n)]

@@ -197,6 +197,19 @@ class ProcessorConfig:
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
+@dataclass
+class StageSpec:
+    """A stateful stage to be constructed inside pool actors: the class
+    plus ctor kwargs ship to each actor, so heavy state (tokenizer,
+    model params, jitted decode) is built once per actor instead of being
+    re-pickled per block (reference: stages as Ray Data actor-pool UDFs).
+    """
+
+    cls: type
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+    concurrency: int = 1
+
+
 class Processor:
     """Chains stages over a Dataset (reference: batch/processor.py)."""
 
@@ -206,9 +219,11 @@ class Processor:
 
     def __call__(self, dataset):
         for stage in self.stages:
-            if isinstance(stage, type):
+            if isinstance(stage, StageSpec):
                 dataset = dataset.map_batches(
-                    stage, batch_size=self.batch_size)
+                    stage.cls, batch_size=self.batch_size,
+                    fn_constructor_kwargs=dict(stage.kwargs),
+                    concurrency=stage.concurrency)
             else:
                 dataset = dataset.map_batches(
                     stage, batch_size=self.batch_size)
@@ -217,11 +232,15 @@ class Processor:
 
 def build_processor(config: ProcessorConfig) -> Processor:
     """Standard pipeline: [chat template] -> tokenize -> generate ->
-    detokenize (reference: build_llm_processor)."""
+    detokenize (reference: build_llm_processor). Stateful stages are
+    StageSpecs — constructed per pool actor, not on the driver."""
     stages: List[Any] = []
     if config.use_chat_template:
-        stages.append(ChatTemplateStage(config.model))
-    stages.append(TokenizeStage(config.model))
-    stages.append(GPTInferenceStage(max_new_tokens=config.max_new_tokens))
-    stages.append(DetokenizeStage(config.model))
+        stages.append(StageSpec(ChatTemplateStage,
+                                {"model": config.model}))
+    stages.append(StageSpec(TokenizeStage, {"model": config.model}))
+    stages.append(StageSpec(
+        GPTInferenceStage, {"max_new_tokens": config.max_new_tokens},
+        concurrency=config.concurrency))
+    stages.append(StageSpec(DetokenizeStage, {"model": config.model}))
     return Processor(stages, batch_size=config.batch_size)

@@ -19,9 +19,18 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
     topology: Optional[str] = None        # e.g. "v5e-8" (slice gang hint)
+    # Elastic bounds (train v2): when min_workers is set, restarts size
+    # the gang to what the cluster can schedule in [min, max] instead of
+    # blocking on num_workers (v2 scaling_policy ElasticScalingPolicy).
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
 
     # reference-compat alias
     use_gpu: bool = False
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -17,17 +17,13 @@ from __future__ import annotations
 import os
 import shutil
 import time
-import uuid
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import api
-from ..exceptions import ActorDiedError, RayError, TaskError
 from .backend import BackendConfig, JaxBackendConfig
 from .checkpoint import Checkpoint, CheckpointManager
 from .config import RunConfig, ScalingConfig
-from .session import TrainContext
-from .worker_group import WorkerGroup
 
 
 @dataclass
@@ -137,6 +133,12 @@ class DataParallelTrainer(BaseTrainer):
         return shards
 
     def fit(self) -> Result:
+        """Delegates to the train-v2 TrainController state machine
+        (reference: v2/_internal/execution/controller/controller.py:91) —
+        Fixed or Elastic scaling policy per ScalingConfig, FailurePolicy
+        from FailureConfig, checkpoints through the CheckpointManager."""
+        from .v2 import (ElasticScalingPolicy, FailurePolicy,
+                         FixedScalingPolicy, TrainController)
         if not api.is_initialized():
             api.init(ignore_reinit_error=True)
         name, exp_dir = self._experiment_paths()
@@ -146,84 +148,29 @@ class DataParallelTrainer(BaseTrainer):
             num_to_keep=ckpt_cfg.num_to_keep,
             score_attribute=ckpt_cfg.checkpoint_score_attribute,
             score_order=ckpt_cfg.checkpoint_score_order)
-        max_failures = self.run_config.failure_config.max_failures
-        restore = self.resume_from_checkpoint
-        last_metrics: Dict[str, Any] = {}
-        attempt = 0
-        error: Optional[BaseException] = None
-
-        while True:
-            group = WorkerGroup(self.scaling_config.num_workers,
-                                self.scaling_config.worker_resources())
-            try:
-                uid = uuid.uuid4().hex[:8]
-
-                def make_context(rank: int) -> TrainContext:
-                    return TrainContext(
-                        world_size=self.scaling_config.num_workers,
-                        world_rank=rank, local_rank=rank,
-                        trial_name=name,
-                        experiment_name=f"{name}_{uid}",
-                        storage_path=exp_dir)
-
-                group.setup(make_context, self.backend_config,
-                            restore or manager.latest,
-                            self._split_datasets(group.num_workers))
-                run_refs = group.run(self.train_loop_per_worker,
-                                     self.train_loop_config)
-                last_metrics, error = self._poll_until_done(
-                    group, run_refs, manager, last_metrics)
-            except (ActorDiedError, TaskError, RayError) as e:
-                error = e
-            finally:
-                group.shutdown()
-            if error is None:
-                break
-            attempt += 1
-            if attempt > max_failures:
-                break
-            # Elastic restart from the latest checkpoint (reference:
-            # train v2 failure_handling + controller state machine).
-            restore = manager.latest
-            error = None
-
-        return Result(metrics=last_metrics,
-                      checkpoint=manager.latest, path=exp_dir,
-                      error=error)
-
-    def _poll_until_done(self, group: WorkerGroup, run_refs,
-                         manager: CheckpointManager,
-                         last_metrics: Dict[str, Any]):
-        pending = list(run_refs)
-        error: Optional[BaseException] = None
-        while pending and error is None:
-            ready, pending = api.wait(pending, num_returns=1, timeout=0.2)
-            self._drain_reports(group, manager, last_metrics)
-            for ref in ready:
-                try:
-                    api.get(ref)
-                except BaseException as e:  # noqa: BLE001
-                    error = e
-                    break
-        # final drain
-        try:
-            self._drain_reports(group, manager, last_metrics)
-        except Exception:
-            pass
-        return last_metrics, error
-
-    def _drain_reports(self, group: WorkerGroup,
-                       manager: CheckpointManager,
-                       last_metrics: Dict[str, Any]):
-        all_reports = group.poll_all(timeout=30.0)
-        for rank, reports in enumerate(all_reports):
-            for rep in reports:
-                ckpt = rep.get("checkpoint")
-                if ckpt is not None and rank == 0:
-                    managed = self._adopt_checkpoint(manager, ckpt)
-                    manager.register(managed, rep["metrics"])
-                if rank == 0:
-                    last_metrics.update(rep["metrics"])
+        if self.scaling_config.elastic:
+            scaling_policy = ElasticScalingPolicy(
+                self.scaling_config,
+                min_workers=self.scaling_config.min_workers,
+                max_workers=self.scaling_config.max_workers)
+        else:
+            scaling_policy = FixedScalingPolicy(self.scaling_config)
+        controller = TrainController(
+            train_fn=self.train_loop_per_worker,
+            train_fn_config=self.train_loop_config,
+            scaling_policy=scaling_policy,
+            failure_policy=FailurePolicy(self.run_config.failure_config),
+            backend_config=self.backend_config,
+            checkpoint_manager=manager,
+            experiment_name=name,
+            experiment_dir=exp_dir,
+            resume_from_checkpoint=self.resume_from_checkpoint,
+            dataset_splitter=self._split_datasets,
+            checkpoint_adopter=self._adopt_checkpoint)
+        self._controller = controller  # exposed for tests/introspection
+        metrics, checkpoint, error = controller.run()
+        return Result(metrics=metrics, checkpoint=checkpoint,
+                      path=exp_dir, error=error)
 
     @staticmethod
     def _adopt_checkpoint(manager: CheckpointManager,

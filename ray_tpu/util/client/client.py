@@ -78,6 +78,12 @@ class ClientConnection:
         out = self._request("put", blob=cloudpickle.dumps(value))
         return ClientObjectRef(self, out["ref_id"])
 
+    def _release(self, ref_id: str):
+        try:
+            self._request("release", ref_id=ref_id)
+        except Exception:
+            pass  # interpreter teardown / closed connection
+
     def close(self):
         try:
             self._conn.close()

@@ -11,21 +11,29 @@ class ClientObjectRef:
     def __repr__(self):
         return f"ClientObjectRef({self.ref_id[:16]})"
 
+    def __del__(self):
+        # Unpin the server-side ref so long-lived sessions don't
+        # accumulate every result object (server 'release' op).
+        conn = getattr(self, "_conn", None)
+        if conn is not None:
+            conn._release(self.ref_id)
+
 
 class ClientRemoteFunction:
-    def __init__(self, conn, fn_id: str, name: str):
+    def __init__(self, conn, fn_id: str, name: str, opts=None):
         self._conn = conn
         self._fn_id = fn_id
+        self._opts = opts or {}
         self.__name__ = name
 
     def remote(self, *args, **kwargs) -> ClientObjectRef:
         return self._conn._call("task", fn_id=self._fn_id,
-                                args=args, kwargs=kwargs)
+                                args=args, kwargs=kwargs,
+                                opts=self._opts)
 
     def options(self, **opts) -> "ClientRemoteFunction":
-        f = ClientRemoteFunction(self._conn, self._fn_id, self.__name__)
-        f._opts = opts
-        return f
+        return ClientRemoteFunction(self._conn, self._fn_id,
+                                    self.__name__, opts)
 
 
 class _ClientActorMethod:

@@ -47,6 +47,9 @@ def _handle(session: _Session, op: str, payload: Dict[str, Any]):
         return {"ok": True}
     if op == "task":
         rf = session.fns[payload["fn_id"]]
+        opts = payload.get("opts") or {}
+        if opts:
+            rf = rf.options(**opts)
         args, kwargs = _resolve(session, payload)
         ref = rf.remote(*args, **kwargs)
         session.refs[ref.hex()] = ref
@@ -97,9 +100,21 @@ def _serve_conn(conn):
     try:
         while True:
             try:
-                msg = cloudpickle.loads(conn.recv_bytes())
+                raw = conn.recv_bytes()
             except (EOFError, OSError):
                 break
+            try:
+                msg = cloudpickle.loads(raw)
+            except Exception as e:  # noqa: BLE001 — bad payload: reply,
+                # keep the session alive (don't kill the client's actors)
+                try:
+                    conn.send_bytes(cloudpickle.dumps(
+                        {"__ok__": False,
+                         "error": f"undeserializable request: {e!r}",
+                         "traceback": traceback.format_exc()}))
+                    continue
+                except (EOFError, OSError):
+                    break
             try:
                 result = _handle(session, msg["op"], msg)
                 result["__ok__"] = True

@@ -174,6 +174,44 @@ class TestConsumption:
                 seen.extend(batch["id"].tolist())
         assert sorted(seen) == list(range(64))
 
+    def test_streaming_split_replay_same_assignment(self, ray_start_shared):
+        """A shard re-iterated yields the same rows (epoch replay)."""
+        shards = rd.range(48, override_num_blocks=6).streaming_split(2)
+        first = [row for b in shards[0].iter_batches(batch_size=None)
+                 for row in b["id"].tolist()]
+        again = [row for b in shards[0].iter_batches(batch_size=None)
+                 for row in b["id"].tolist()]
+        assert first == again
+        assert len(first) > 0
+
+    def test_streaming_iter_batches_through_map_chain(self,
+                                                      ray_start_shared):
+        """iter_batches streams through a task-map chain without a full
+        materialize (plan has only streamable stages)."""
+        ds = rd.range(100, override_num_blocks=10) \
+            .map_batches(lambda b: {"id": b["id"] * 2}) \
+            .map_batches(lambda b: {"id": b["id"] + 1})
+        got = []
+        for batch in ds.iter_batches(batch_size=10):
+            got.extend(batch["id"].tolist())
+        assert sorted(got) == sorted(2 * i + 1 for i in range(100))
+
+    def test_streaming_actor_pool_chain(self, ray_start_shared):
+        class Doubler:
+            def __call__(self, b):
+                return {"id": b["id"] * 2}
+
+        ds = rd.range(40, override_num_blocks=4).map_batches(
+            Doubler, concurrency=2)
+        got = sorted(x for b in ds.iter_batches(batch_size=None)
+                     for x in b["id"].tolist())
+        assert got == [2 * i for i in range(40)]
+
+    def test_data_context(self, ray_start_shared):
+        ctx = rd.DataContext.get_current()
+        assert ctx.max_in_flight_bundles >= 4
+        assert ctx is rd.DataContext.get_current()
+
 
 class TestIO:
     def test_parquet_roundtrip(self, ray_start_shared, tmp_path):

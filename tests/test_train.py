@@ -163,6 +163,59 @@ class TestDataParallelTrainer:
         assert result.metrics["ok"] == 1
 
 
+class TestTrainV2Controller:
+    def test_state_machine_transitions(self, ray_start_shared, tmp_path):
+        trainer = DataParallelTrainer(
+            lambda config: train.report({"x": 1}),
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="sm", storage_path=str(tmp_path)))
+        result = trainer.fit()
+        assert result.error is None
+        states = [s for s, _ in trainer._controller.state_log]
+        assert states == ["INITIALIZING", "SCHEDULING", "RUNNING",
+                          "FINISHED"]
+
+    def test_restart_passes_through_restarting(self, ray_start_shared,
+                                               tmp_path):
+        marker = str(tmp_path / "m")
+
+        def loop(config):
+            import os
+            if not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                raise RuntimeError("die once")
+            train.report({"ok": 1})
+
+        trainer = DataParallelTrainer(
+            loop, train_loop_config={"marker": marker},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="rst", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=2)))
+        result = trainer.fit()
+        assert result.error is None
+        states = [s for s, _ in trainer._controller.state_log]
+        assert "RESTARTING" in states
+        assert states[-1] == "FINISHED"
+
+    def test_elastic_sizes_gang_to_cluster(self, ray_start_shared,
+                                           tmp_path):
+        """min_workers set -> gang sized to schedulable CPUs, not the
+        (infeasible) requested num_workers."""
+        trainer = DataParallelTrainer(
+            lambda config: train.report(
+                {"ws": train.get_world_size()}),
+            scaling_config=ScalingConfig(
+                num_workers=64, min_workers=1, max_workers=64,
+                resources_per_worker={"CPU": 1}),
+            run_config=RunConfig(name="el", storage_path=str(tmp_path)))
+        result = trainer.fit()
+        assert result.error is None
+        sizes = trainer._controller.world_sizes
+        assert 1 <= sizes[0] <= 4  # cluster fixture has 4 CPUs
+        assert result.metrics["ws"] == sizes[0]
+
+
 class TestJaxTrainer:
     def test_distributed_jax_training(self, ray_start_shared, tmp_path):
         """2 workers, jax.distributed over CPU: data-parallel psum of a
