@@ -47,13 +47,60 @@ def _gc_stale_sessions(max_age_s: float = 6 * 3600):
     for d in glob.glob("/dev/shm/ray_tpu_session_*") + glob.glob(
             "/tmp/ray_tpu_sessions/session_*"):
         try:
+            # A live session's dir can be legitimately empty (worker
+            # sockets are unlinked right after accept), so emptiness is
+            # not staleness: only the owner pid's death proves a husk.
             age = now - os.path.getmtime(d)
-            # Empty dirs are husks (a late worker re-created the dir
-            # after the driver's shutdown rmtree) — sweep those fast.
-            if age > max_age_s or (age > 120 and not os.listdir(d)):
+            pid, stamped = _session_owner_pid(d)
+            if pid is not None and not _owner_alive(pid, stamped):
+                shutil.rmtree(d, ignore_errors=True)
+            elif age > max_age_s and pid is None:
                 shutil.rmtree(d, ignore_errors=True)
         except OSError:
             pass
+
+
+def _session_owner_pid(session_dir: str):
+    """(pid, pidfile mtime) from the dir's .owner_pid, or (None, 0)."""
+    path = os.path.join(session_dir, ".owner_pid")
+    try:
+        with open(path) as f:
+            return int(f.read().strip()), os.path.getmtime(path)
+    except (OSError, ValueError):
+        return None, 0.0
+
+
+def _owner_alive(pid: int, stamped_at: float) -> bool:
+    """Is `pid` alive AND the same process that stamped the pidfile?
+    A recycled pid shows alive but started after the stamp — compare
+    /proc start time so recycled pids don't immortalize stale dirs."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        pass
+    start = _proc_start_time(pid)
+    if start is not None and stamped_at and start > stamped_at + 5.0:
+        return False  # pid recycled since the session stamped it
+    return True
+
+
+def _proc_start_time(pid: int):
+    """Process start time as a unix timestamp (Linux /proc), else None."""
+    try:
+        with open("/proc/stat") as f:
+            btime = next(int(line.split()[1]) for line in f
+                         if line.startswith("btime "))
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+        # field 22 (1-indexed) after the parenthesized comm, which may
+        # itself contain spaces — split after the last ')'.
+        fields = stat.rsplit(")", 1)[1].split()
+        ticks = int(fields[19])  # fields[0] is state, so 22-3=19
+        return btime + ticks / os.sysconf("SC_CLK_TCK")
+    except Exception:
+        return None
 
 
 class _ActorState:
@@ -90,6 +137,12 @@ class Node:
         os.makedirs(self.session_dir, exist_ok=True)
         self.store = create_store(self.store_dir,
                                  capacity=object_store_memory)
+        for d in (self.session_dir, self.store_dir):
+            try:
+                with open(os.path.join(d, ".owner_pid"), "w") as f:
+                    f.write(str(os.getpid()))
+            except OSError:
+                pass
         self.gcs = gcs_mod.Gcs()
         self.gcs.node_id_hex = self.node_id.hex()
         totals = detect_node_resources(num_cpus, num_tpus, resources)
@@ -878,6 +931,8 @@ class Node:
             self.store.shutdown()
         except Exception:
             pass
+        import shutil
+        shutil.rmtree(self.session_dir, ignore_errors=True)
         from . import state
         if state.get_node() is self:
             state.set_node(None)

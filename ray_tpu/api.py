@@ -99,12 +99,14 @@ class ObjectRef:
 
     def __del__(self):
         if self._owned:
-            rt = state.current_or_none()
-            if rt is not None and hasattr(rt, "decref"):
-                try:
+            try:
+                # `state` / its attrs may already be torn down at
+                # interpreter exit — any failure here is ignorable.
+                rt = state.current_or_none()
+                if rt is not None and hasattr(rt, "decref"):
                     rt.decref(self._id)
-                except Exception:
-                    pass
+            except Exception:
+                pass
 
 
 # ---------------------------------------------------------------------------

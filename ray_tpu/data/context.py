@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
-from typing import Optional
+from typing import ClassVar, Optional
 
 
 @dataclasses.dataclass
@@ -34,8 +34,8 @@ class DataContext:
     # bundles be yielded as they complete.
     preserve_order: bool = True
 
-    _lock = threading.Lock()
-    _current: Optional["DataContext"] = None
+    _lock: ClassVar[threading.Lock] = threading.Lock()
+    _current: ClassVar[Optional["DataContext"]] = None
 
     @classmethod
     def get_current(cls) -> "DataContext":

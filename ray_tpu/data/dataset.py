@@ -367,11 +367,40 @@ class Dataset:
                 pool = _MapBatchesActorPool(
                     fn, compute.pool_size, opts, tuple(fn_constructor_args),
                     fn_constructor_kwargs)
+                # Weakrefs, not refs: holding strong ObjectRefs here
+                # would pin every intermediate block until close() and
+                # defeat the in-flight backpressure cap. Downstream
+                # (stream_bundles' window / the consumer's prefetch)
+                # keeps unconsumed refs alive; once the consumer drops a
+                # ref its task is done and the weakref dies.
+                import weakref
+                submitted: List = []
 
                 def submit(ref):
-                    return pool.submit(ref, batch_size, batch_format,
-                                       tuple(fn_args), fn_kwargs)
-                return submit, pool.shutdown
+                    out = pool.submit(ref, batch_size, batch_format,
+                                      tuple(fn_args), fn_kwargs)
+                    submitted.append(weakref.ref(out))
+                    if len(submitted) > 256:
+                        submitted[:] = [w for w in submitted
+                                        if w() is not None]
+                    return out
+
+                def close():
+                    # Drain before killing: a consumer with prefetch
+                    # depth > 0 still holds unresolved output refs when
+                    # the bundle generator exhausts — killing in-flight
+                    # actors here would fail the stream's tail. (Failed
+                    # refs count as ready, so this can't hang on errors.)
+                    live = [w() for w in submitted]
+                    live = [r for r in live if r is not None]
+                    if live:
+                        try:
+                            api.wait(live, num_returns=len(live),
+                                     timeout=None)
+                        except Exception:
+                            pass
+                    pool.shutdown()
+                return submit, close
         else:
             def stage_fn(bundles: List[_RefBundle]) -> List[_RefBundle]:
                 task = _apply_batches.options(**opts) if opts \
@@ -622,12 +651,16 @@ class Dataset:
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False,
-                     prefetch_batches: int = 1) -> Iterator:
+                     prefetch_batches: Optional[int] = None) -> Iterator:
         """(reference: dataset.py:4092 iter_batches) — streamed: blocks
         are produced by in-flight task chains while earlier batches are
         consumed."""
         from . import streaming
-        blocks = streaming.iter_blocks(self._iter_bundles())
+        from .context import DataContext
+        if prefetch_batches is None:
+            prefetch_batches = DataContext.get_current().prefetch_batches
+        blocks = streaming.iter_blocks(self._iter_bundles(),
+                                       prefetch=prefetch_batches)
         yield from streaming.batches_from_blocks(
             blocks, batch_size, batch_format, drop_last)
 
@@ -679,7 +712,18 @@ class Dataset:
         coordinator actor — each block is consumed by exactly one
         consumer; picklable, so Train ships one per worker."""
         from . import streaming
-        bundles = self._plan.execute()
+        # equal=True must guarantee balanced, non-empty shards even with
+        # fewer (or skewed) blocks than consumers — lockstep data-parallel
+        # trainers hang on uneven per-epoch batch counts. As in
+        # split(n, equal=True), repartition into row-balanced blocks
+        # first (a multiple of n keeps multiple blocks per consumer so
+        # the shard streams rather than arriving as one chunk).
+        ds = self
+        if equal:
+            n_blocks = len(ds._plan.execute())
+            per_consumer = max(1, min(8, n_blocks // n))
+            ds = ds.repartition(n * per_consumer)
+        bundles = ds._plan.execute()
         return streaming.make_split_iterators(
             [(b.ref, b.num_rows) for b in bundles], n, equal)
 

@@ -71,9 +71,20 @@ def stream_bundles(
                     break
 
 
-def iter_blocks(bundles: Iterator[StreamedBundle]) -> Iterator[B.Block]:
-    for ref, _ in bundles:
-        yield api.get(ref)
+def iter_blocks(bundles: Iterator[StreamedBundle],
+                prefetch: int = 0) -> Iterator[B.Block]:
+    """Resolve bundle refs to blocks; with `prefetch` > 0, hold that many
+    upcoming refs before the one being consumed. Pulling ahead from
+    `bundles` advances stream_bundles' in-flight window, so later chains
+    execute (and their results land in the store) while the current block
+    is being consumed — the reference's iter_batches read-ahead."""
+    window: collections.deque = collections.deque()
+    for bundle in bundles:
+        window.append(bundle)
+        if len(window) > prefetch:
+            yield api.get(window.popleft()[0])
+    while window:
+        yield api.get(window.popleft()[0])
 
 
 def batches_from_blocks(
@@ -148,6 +159,12 @@ class _SplitCoordinator:
         self._pos[consumer] = pos + 1
         return self._assignment[consumer][pos]
 
+    def reset(self, consumer: int):
+        """Rewind `consumer` to its shard start (new epoch). Iterators
+        call this when (re)starting so a partially consumed or
+        prefetch-overshot previous epoch can't skip blocks."""
+        self._pos[consumer] = 0
+
     def stats(self):
         return {"rows_given": list(self._rows_given)}
 
@@ -162,6 +179,7 @@ class DataIterator:
         self._id = consumer_id
 
     def _iter_block_refs(self):
+        api.get(self._coord.reset.remote(self._id))
         while True:
             ref = api.get(self._coord.next_block.remote(self._id))
             if ref is None:
@@ -171,11 +189,15 @@ class DataIterator:
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False,
-                     prefetch_batches: int = 1) -> Iterator:
-        def blocks():
-            for ref in self._iter_block_refs():
-                yield api.get(ref)
-        return batches_from_blocks(blocks(), batch_size, batch_format,
+                     prefetch_batches: Optional[int] = None) -> Iterator:
+        # Pull coordinator assignments `prefetch_batches` ahead of
+        # consumption so the next block is in flight during compute.
+        if prefetch_batches is None:
+            prefetch_batches = DataContext.get_current().prefetch_batches
+        blocks = iter_blocks(
+            ((ref, -1) for ref in self._iter_block_refs()),
+            prefetch=prefetch_batches)
+        return batches_from_blocks(blocks, batch_size, batch_format,
                                    drop_last)
 
     def iter_rows(self) -> Iterator:

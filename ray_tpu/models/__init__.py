@@ -12,3 +12,26 @@ from .gpt import (  # noqa: F401
     gpt_param_axes,
     make_train_step,
 )
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_loss,
+    llama_param_axes,
+    make_llama_train_step,
+)
+from .moe import (  # noqa: F401
+    MoEConfig,
+    make_moe_train_step,
+    moe_forward,
+    moe_init,
+    moe_loss,
+    moe_param_axes,
+)
+from .resnet import (  # noqa: F401
+    ResNetConfig,
+    make_predictor,
+    resnet_forward,
+    resnet_init,
+    resnet_param_axes,
+)

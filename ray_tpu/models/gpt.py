@@ -137,16 +137,12 @@ def _block(x, layer, cfg: GPTConfig):
 def gpt_forward(params: Dict, tokens, cfg: GPTConfig):
     """tokens [batch, seq] int32 -> logits [batch, seq, vocab] (fp32)."""
     x = jnp.take(params["embed"], tokens, axis=0)
-    block = _block
+    block = functools.partial(_block, cfg=cfg)
     if cfg.remat:
         block = jax.checkpoint(
-            functools.partial(_block, cfg=cfg),
-            policy=jax.checkpoint_policies.nothing_saveable)
-        for layer in params["layers"]:
-            x = block(x, layer)
-    else:
-        for layer in params["layers"]:
-            x = _block(x, layer, cfg)
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+    for layer in params["layers"]:
+        x = block(x, layer)
     x = rms_norm(x, params["lnf"])
     head = params.get("head")
     if head is None:
@@ -173,46 +169,20 @@ def make_train_step(cfg: GPTConfig, optimizer=None,
     mesh + partition rules, params/opt-state carry NamedShardings and XLA
     inserts the dp gradient psum / tp collectives from the shardings
     (scaling-book recipe — no explicit pmap/DDP wrapper)."""
-    import optax
+    from ._training import make_train_step_for
 
-    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
-
-    def init_state(key):
-        params = gpt_init(key, cfg)
-        if mesh is not None and rules is not None:
-            params = shard_params(params, cfg, mesh, rules)
-        opt_state = optimizer.init(params)
-        return {"params": params, "opt_state": opt_state,
-                "step": jnp.zeros((), dtype=jnp.int32)}
-
-    def train_step(state, batch):
-        loss, grads = jax.value_and_grad(gpt_loss)(
-            state["params"], batch, cfg)
-        updates, new_opt = optimizer.update(
-            grads, state["opt_state"], state["params"])
-        new_params = optax.apply_updates(state["params"], updates)
-        return ({"params": new_params, "opt_state": new_opt,
-                 "step": state["step"] + 1},
-                {"loss": loss})
-
-    donate_argnums = (0,) if donate else ()
-    return init_state, jax.jit(train_step, donate_argnums=donate_argnums)
+    return make_train_step_for(
+        lambda key: gpt_init(key, cfg),
+        lambda params, batch: gpt_loss(params, batch, cfg),
+        axes=gpt_param_axes(cfg), optimizer=optimizer, donate=donate,
+        mesh=mesh, rules=rules)
 
 
 def shard_params(params: Dict, cfg: GPTConfig, mesh, rules):
     """Place a param pytree onto a mesh per the logical-axis rule table."""
-    from jax.sharding import NamedSharding
+    from ._training import place_params
 
-    axes = gpt_param_axes(cfg)
-    leaves, treedef = jax.tree.flatten(params)
-    # Axis tuples are themselves pytrees, so flatten the axes tree only
-    # down to the params tree's structure.
-    axes_leaves = treedef.flatten_up_to(axes)
-    placed = [
-        jax.device_put(p, NamedSharding(mesh, rules.spec(ax)))
-        for p, ax in zip(leaves, axes_leaves)
-    ]
-    return jax.tree.unflatten(treedef, placed)
+    return place_params(params, gpt_param_axes(cfg), mesh, rules)
 
 
 def shard_batch(batch, mesh, axis: str = "dp"):

@@ -72,7 +72,6 @@ class TrainController:
         self._adopt = checkpoint_adopter or (lambda m, c: c)
         self._poll_interval_s = poll_interval_s
 
-        self._state = TrainControllerState.INITIALIZING
         self._state_log: List[Tuple[str, float]] = []
         self._set_state(TrainControllerState.INITIALIZING)
         self._group: Optional[WorkerGroup] = None
@@ -102,16 +101,20 @@ class TrainController:
     # ------------------------------------------------------------------
     def run(self):
         """Run to a terminal state; returns (metrics, checkpoint, error)."""
-        while self._state not in (TrainControllerState.ERRORED,
-                                  TrainControllerState.FINISHED):
-            if self._state in (TrainControllerState.INITIALIZING,
-                               TrainControllerState.RESTARTING):
-                self._set_state(TrainControllerState.SCHEDULING)
-            elif self._state == TrainControllerState.SCHEDULING:
-                self._start_worker_group()
-            elif self._state == TrainControllerState.RUNNING:
-                self._poll_worker_group()
-        self._teardown_group()
+        try:
+            while self._state not in (TrainControllerState.ERRORED,
+                                      TrainControllerState.FINISHED):
+                if self._state in (TrainControllerState.INITIALIZING,
+                                   TrainControllerState.RESTARTING):
+                    self._set_state(TrainControllerState.SCHEDULING)
+                elif self._state == TrainControllerState.SCHEDULING:
+                    self._start_worker_group()
+                elif self._state == TrainControllerState.RUNNING:
+                    self._poll_worker_group()
+        finally:
+            # v1 trainer.fit's `finally: group.shutdown()` guarantee:
+            # no path (including unexpected exceptions) leaks workers.
+            self._teardown_group()
         return self._latest_metrics, self._manager.latest, self._error
 
     # ------------------------------------------------------------------
@@ -140,6 +143,11 @@ class TrainController:
             group.shutdown()
             self._handle_failure(e)
             return
+        except BaseException:
+            # Non-gang errors (e.g. unpicklable train_fn) are not
+            # retryable — don't leak the just-created actors.
+            group.shutdown()
+            raise
         self._group = group
         self._world_sizes.append(decision.num_workers)
         self._set_state(TrainControllerState.RUNNING)

@@ -18,11 +18,21 @@ class ClientConnection:
         self._conn = _MpClient((host, int(port)), family="AF_INET",
                                authkey=AUTHKEY)
         self._lock = threading.Lock()
+        # Refs released by ClientObjectRef.__del__ queue here and piggyback
+        # on the next request: __del__ can fire from cyclic GC *inside*
+        # _request (during cloudpickle) on the same thread, where a
+        # synchronous release would deadlock on the non-reentrant _lock
+        # (the reference routes releases through a background datapath for
+        # the same reason, util/client/dataclient.py).
+        self._pending_releases: list = []
         assert self._request("ping")["ok"]
 
     # -- plumbing ----------------------------------------------------------
     def _request(self, op: str, **payload) -> dict:
         payload["op"] = op
+        if self._pending_releases:
+            drained, self._pending_releases = self._pending_releases, []
+            payload["__releases__"] = drained
         with self._lock:
             self._conn.send_bytes(cloudpickle.dumps(payload))
             result = cloudpickle.loads(self._conn.recv_bytes())
@@ -80,9 +90,9 @@ class ClientConnection:
 
     def _release(self, ref_id: str):
         try:
-            self._request("release", ref_id=ref_id)
+            self._pending_releases.append(ref_id)
         except Exception:
-            pass  # interpreter teardown / closed connection
+            pass  # interpreter teardown
 
     def close(self):
         try:

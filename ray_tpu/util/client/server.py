@@ -116,6 +116,8 @@ def _serve_conn(conn):
                 except (EOFError, OSError):
                     break
             try:
+                for rid in msg.pop("__releases__", ()):
+                    session.refs.pop(rid, None)
                 result = _handle(session, msg["op"], msg)
                 result["__ok__"] = True
             except Exception as e:  # noqa: BLE001
