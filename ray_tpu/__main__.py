@@ -1,0 +1,6 @@
+"""`python -m ray_tpu` CLI entry (reference: the `ray` console script)."""
+import sys
+
+from .scripts.cli import main
+
+sys.exit(main())

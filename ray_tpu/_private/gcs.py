@@ -155,7 +155,11 @@ class ObjectDirectory:
                     e.pending_free = True
                 else:
                     del self._entries[oid]
-                    freed = [oid]
+                    # Subscribers get the location kind so inline objects
+                    # (no shm segment anywhere) skip the worker-release
+                    # broadcast entirely.
+                    freed = [(oid,
+                              e.location[0] if e.location else None)]
                     nested = e.nested_ids
         if freed:
             for cb in self._on_free:
@@ -318,12 +322,26 @@ class Gcs:
         self._task_events: List[dict] = []
         self._task_events_lock = threading.Lock()
         self.max_task_events = 10000
+        # Tracing spans (reference: OpenTelemetry spans buffered per core
+        # worker, flushed to the GCS task-event store; SURVEY.md §5)
+        self._spans: List[dict] = []
+        self.max_spans = 20000
 
     def record_task_event(self, event: dict):
         with self._task_events_lock:
             self._task_events.append(event)
             if len(self._task_events) > self.max_task_events:
                 del self._task_events[: len(self._task_events) // 2]
+
+    def record_spans(self, spans: List[dict]):
+        with self._task_events_lock:
+            self._spans.extend(spans)
+            if len(self._spans) > self.max_spans:
+                del self._spans[: len(self._spans) // 2]
+
+    def spans(self) -> List[dict]:
+        with self._task_events_lock:
+            return list(self._spans)
 
     def task_events(self) -> List[dict]:
         with self._task_events_lock:

@@ -156,10 +156,14 @@ class Node:
             on_worker_message=self._on_worker_message,
             on_worker_death=self._on_worker_death)
         ncpu = int(totals.get("CPU", 4))
+        from .scheduler import NodeRegistry
+        self.node_registry = NodeRegistry(self.node_id.hex(),
+                                          self.resources_mgr)
         self.scheduler = Scheduler(
             self.resources_mgr, self.pool, self._dispatch,
             max_workers=max(ncpu, 4),
-            is_object_ready=self._is_object_ready)
+            is_object_ready=self._is_object_ready,
+            nodes=self.node_registry)
         self._handler_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="handler")
         self._fn_registry: Dict[str, bytes] = {}
@@ -169,6 +173,8 @@ class Node:
         self._actor_dep_waiters: Dict[ObjectID, List[Tuple[_ActorState, list]]] = {}
         self._actor_dep_lock = threading.Lock()
         self._ready_cond = threading.Condition()
+        self._release_buf: List[ObjectID] = []
+        self._release_lock = threading.Lock()
         self.gcs.objects.subscribe_ready(self._on_object_ready)
         self.gcs.objects.subscribe_free(self._on_objects_freed)
         self._shutdown = False
@@ -337,21 +343,40 @@ class Node:
         with self._ready_cond:
             self._ready_cond.notify_all()
 
-    def _on_objects_freed(self, oids: List[ObjectID]):
+    def _on_objects_freed(self, freed: List[Tuple[ObjectID, str]]):
         shm_oids = []
-        for oid in oids:
+        for oid, loc_kind in freed:
+            # Only LOC_SHM objects have a segment to unlink/unmap; inline
+            # values, error blobs, and never-produced pending objects have
+            # no backing anywhere (skipping their broadcast is the
+            # task-throughput hot path — one freed return per task would
+            # otherwise fan out to every worker).
+            if loc_kind != P.LOC_SHM:
+                continue
             self.store.free(oid)
             shm_oids.append(oid)
         if shm_oids:
-            def _broadcast():
-                for h in list(self.pool.workers.values()):
-                    if h.alive:
-                        try:
-                            h.send(P.RELEASE_OBJECTS,
-                                   {"object_ids": shm_oids})
-                        except Exception:
-                            pass
-            self._handler_pool.submit(_broadcast)
+            with self._release_lock:
+                flush = not self._release_buf
+                self._release_buf.extend(shm_oids)
+            if flush:
+                # Coalesce: one broadcast drains everything buffered
+                # since the last one (release storms during dataset
+                # sweeps become a handful of messages per worker).
+                self._handler_pool.submit(self._broadcast_releases)
+
+    def _broadcast_releases(self):
+        time.sleep(0.002)  # let a burst accumulate
+        with self._release_lock:
+            batch, self._release_buf = self._release_buf, []
+        if not batch:
+            return
+        for h in list(self.pool.workers.values()):
+            if h.alive:
+                try:
+                    h.send(P.RELEASE_OBJECTS, {"object_ids": batch})
+                except Exception:
+                    pass
 
     # ------------------------------------------------------------------
     # task submission (owner side)
@@ -411,7 +436,7 @@ class Node:
         if worker is None:
             blob = serialization.dumps(TaskUnschedulableError(
                 f"Task {spec.name} demands {spec.resources}, which exceeds "
-                f"cluster totals {self.resources_mgr.totals}"))
+                f"cluster totals {self.node_registry.aggregate()[0]}"))
             for rid in spec.return_ids:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             self._unpin_task_args(spec)
@@ -442,7 +467,7 @@ class Node:
         spec = handle.running.pop(task_id.binary(), None)
         is_actor_task = payload.get("actor_id") is not None
         if spec is not None and not is_actor_task:
-            self.resources_mgr.release(spec.resources)
+            self.scheduler.release_task_resources(spec)
             self.pool.push_idle(handle)
             self.scheduler.notify_worker_free()
         if spec is None:
@@ -509,7 +534,7 @@ class Node:
         if worker is None:
             blob = serialization.dumps(TaskUnschedulableError(
                 f"Actor {spec.cls_id} demands {spec.resources}, which "
-                f"exceeds cluster totals {self.resources_mgr.totals}"))
+                f"exceeds cluster totals {self.node_registry.aggregate()[0]}"))
             self._fail_actor(st, blob, "infeasible resources")
             self._unpin_task_args(spec)
             return
@@ -654,7 +679,7 @@ class Node:
             self._on_actor_worker_death(aid, running)
             return
         for spec in running.values():
-            self.resources_mgr.release(spec.resources)
+            self.scheduler.release_task_resources(spec)
             self._handle_worker_failure_for_task(spec)
         self.scheduler.notify_worker_free()
 
@@ -682,7 +707,7 @@ class Node:
         entry = self.gcs.actors.get(actor_id)
         if st is None or entry is None:
             return
-        self.resources_mgr.release(st.spec.resources)
+        self.scheduler.release_task_resources(st.spec)
         blob = serialization.dumps(ActorDiedError(
             f"Actor {actor_id.hex()}'s worker process died."))
         for spec in running.values():
@@ -848,6 +873,10 @@ class Node:
                     for e in self.gcs.actors.list()]
         if op == "task_events":
             return self.gcs.task_events()
+        if op == "record_spans":
+            return self.gcs.record_spans(**kwargs)
+        if op == "get_spans":
+            return self.gcs.spans()
         if op == "object_stats":
             return self.gcs.objects.stats()
         if op == "list_objects":
@@ -868,11 +897,7 @@ class Node:
             ] if hasattr(self.pg_manager, "pending_entries") else []
             return {"demands": demands, "placement_groups": pending_pgs}
         if op == "list_nodes":
-            totals, avail = self.resources_mgr.snapshot()
-            return [{"node_id": self.gcs.node_id_hex, "alive": True,
-                     "resources_total": totals,
-                     "resources_available": avail,
-                     "start_time": self.gcs.start_time}]
+            return self.node_registry.snapshot()
         if op == "pg_create":
             e = self.pg_manager.create(
                 kwargs["pg_id_hex"], kwargs["bundles"], kwargs["strategy"],
@@ -909,12 +934,48 @@ class Node:
     # introspection
     # ------------------------------------------------------------------
     def cluster_resources(self) -> Dict[str, float]:
-        totals, _ = self.resources_mgr.snapshot()
+        totals, _ = self.node_registry.aggregate()
         return totals
 
     def available_resources(self) -> Dict[str, float]:
-        _, avail = self.resources_mgr.snapshot()
+        _, avail = self.node_registry.aggregate()
         return avail
+
+    # ------------------------------------------------------------------
+    # virtual nodes (cluster_utils.Cluster; reference:
+    # python/ray/cluster_utils.py:135 — N raylets sharing one GCS)
+    # ------------------------------------------------------------------
+    def add_virtual_node(self, resources: Dict[str, float]) -> str:
+        node_id = NodeID.from_random().hex()
+        self.node_registry.add_node(node_id, resources)
+        self.scheduler.notify_worker_free()
+        return node_id
+
+    def remove_virtual_node(self, node_id_hex: str) -> bool:
+        """Simulate node failure: the node stops granting resources and
+        every worker whose current task was scheduled onto it is killed
+        (task retries / actor restarts then reschedule onto surviving
+        nodes — the reference's RayletKiller chaos semantics,
+        _private/test_utils.py:1618)."""
+        entry = self.node_registry.remove_node(node_id_hex)
+        if entry is None:
+            return False
+        doomed = []
+        for handle in list(self.pool.workers.values()):
+            if handle.dedicated_actor is not None:
+                st = self._actors.get(handle.dedicated_actor)
+                if st is not None and \
+                        self.scheduler.node_of_task(st.spec) == node_id_hex:
+                    doomed.append(handle)
+                continue
+            for spec in list(handle.running.values()):
+                if self.scheduler.node_of_task(spec) == node_id_hex:
+                    doomed.append(handle)
+                    break
+        for handle in doomed:
+            handle.kill()
+        self.scheduler.notify_worker_free()
+        return True
 
     # ------------------------------------------------------------------
     def prestart_workers(self, n: int):

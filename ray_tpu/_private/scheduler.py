@@ -108,6 +108,101 @@ class ResourceManager:
             return dict(self.totals), dict(self.available)
 
 
+class NodeEntry:
+    __slots__ = ("node_id_hex", "rm", "alive", "start_time", "is_head")
+
+    def __init__(self, node_id_hex: str, rm: ResourceManager,
+                 is_head: bool = False):
+        import time
+        self.node_id_hex = node_id_hex
+        self.rm = rm
+        self.alive = True
+        self.start_time = time.time()
+        self.is_head = is_head
+
+
+class NodeRegistry:
+    """Per-node resource pools with node selection (reference: the
+    ClusterResourceManager's per-node view driving the hybrid policy,
+    scheduling/cluster_resource_manager.* + hybrid_scheduling_policy.cc).
+
+    One real head node; `cluster_utils.Cluster.add_node` registers
+    virtual nodes whose workers are real local processes but whose
+    resources are bin-packed per-node, so multi-node scheduling and
+    failover semantics are testable in-process (the reference's
+    cluster_utils.Cluster pattern, SURVEY.md §4)."""
+
+    def __init__(self, head_id_hex: str, head_rm: ResourceManager):
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, NodeEntry] = {}
+        self.head = NodeEntry(head_id_hex, head_rm, is_head=True)
+        self._nodes[head_id_hex] = self.head
+
+    def add_node(self, node_id_hex: str,
+                 resources: Dict[str, float]) -> NodeEntry:
+        entry = NodeEntry(node_id_hex, ResourceManager(dict(resources)))
+        with self._lock:
+            self._nodes[node_id_hex] = entry
+        return entry
+
+    def remove_node(self, node_id_hex: str) -> Optional[NodeEntry]:
+        with self._lock:
+            entry = self._nodes.get(node_id_hex)
+            if entry is None or entry.is_head:
+                return None
+            entry.alive = False
+            return entry
+
+    def entries(self) -> List[NodeEntry]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def acquire(self, demand: Dict[str, float]) -> Optional[str]:
+        """Pick a node and acquire `demand` on it; head-first (the hybrid
+        policy's local-node preference), then first-fit over the rest."""
+        if self.head.rm.try_acquire(demand):
+            return self.head.node_id_hex
+        for entry in self.entries():
+            if entry.is_head or not entry.alive:
+                continue
+            if entry.rm.try_acquire(demand):
+                return entry.node_id_hex
+        return None
+
+    def release(self, node_id_hex: str, demand: Dict[str, float]):
+        with self._lock:
+            entry = self._nodes.get(node_id_hex)
+        if entry is not None and entry.alive:
+            entry.rm.release(demand)
+
+    def feasible(self, demand: Dict[str, float]) -> bool:
+        return any(e.alive and e.rm.feasible(demand)
+                   for e in self.entries())
+
+    def aggregate(self) -> Tuple[Dict[str, float], Dict[str, float]]:
+        totals: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for e in self.entries():
+            if not e.alive:
+                continue
+            t, a = e.rm.snapshot()
+            for k, v in t.items():
+                totals[k] = totals.get(k, 0.0) + v
+            for k, v in a.items():
+                avail[k] = avail.get(k, 0.0) + v
+        return totals, avail
+
+    def snapshot(self) -> List[dict]:
+        rows = []
+        for e in self.entries():
+            t, a = e.rm.snapshot()
+            rows.append({"node_id": e.node_id_hex, "alive": e.alive,
+                         "is_head": e.is_head, "resources_total": t,
+                         "resources_available": a,
+                         "start_time": e.start_time})
+        return rows
+
+
 class WorkerHandle:
     """Driver-side handle to one worker process (reference: the raylet's
     view of a leased worker, worker_pool.h)."""
@@ -131,8 +226,7 @@ class WorkerHandle:
         self.death_handled = False
 
     def send(self, msg_type: str, payload: dict):
-        import cloudpickle
-        data = cloudpickle.dumps((msg_type, payload))
+        data = P.dump_message(msg_type, payload)
         with self.send_lock:
             self.conn.send_bytes(data)
 
@@ -328,8 +422,14 @@ class Scheduler:
     def __init__(self, resources: ResourceManager, pool: WorkerPool,
                  dispatch_fn: Callable[[P.TaskSpec, WorkerHandle], None],
                  max_workers: Optional[int] = None,
-                 is_object_ready: Optional[Callable[[ObjectID], bool]] = None):
+                 is_object_ready: Optional[Callable[[ObjectID], bool]] = None,
+                 nodes: Optional[NodeRegistry] = None):
         self.resources = resources
+        # Per-node view; single-node clusters get a one-entry registry so
+        # the dispatch path is uniform.
+        self.nodes = nodes or NodeRegistry("head", resources)
+        # Which node each in-flight task's resources were acquired on.
+        self._task_node: Dict[bytes, str] = {}
         self.pool = pool
         self._dispatch_fn = dispatch_fn
         self._is_object_ready = is_object_ready or (lambda oid: False)
@@ -452,14 +552,33 @@ class Scheduler:
                     self._ready.append(spec)
                     self._cond.wait(timeout=0.05)
 
+    @staticmethod
+    def _spec_key(spec) -> bytes:
+        return (spec.actor_id.binary() if isinstance(spec, P.ActorSpec)
+                else spec.task_id.binary())
+
+    def release_task_resources(self, spec):
+        """Release a finished/failed task's resources on the node that
+        granted them (runtime calls this instead of touching the head
+        ResourceManager directly)."""
+        node_id = self._task_node.pop(self._spec_key(spec), None)
+        if node_id is not None:
+            self.nodes.release(node_id, spec.resources)
+        else:
+            self.resources.release(spec.resources)
+
+    def node_of_task(self, spec) -> Optional[str]:
+        return self._task_node.get(self._spec_key(spec))
+
     def _try_dispatch(self, spec) -> bool:
         demand = spec.resources
         is_actor_creation = isinstance(spec, P.ActorSpec)
-        if not self.resources.feasible(demand):
+        if not self.nodes.feasible(demand):
             # Infeasible forever: surface as task error via dispatch_fn(None).
             self._dispatch_fn(spec, None)
             return True
-        if not self.resources.try_acquire(demand):
+        node_id = self.nodes.acquire(demand)
+        if node_id is None:
             return False
         env_key = self._env_key_for(spec)
         worker = self.pool.pop_idle(env_key)
@@ -476,8 +595,9 @@ class Scheduler:
             except Exception:
                 worker = None  # boot failure: release + retry later
         if worker is None:
-            self.resources.release(demand)
+            self.nodes.release(node_id, demand)
             return False
+        self._task_node[self._spec_key(spec)] = node_id
         self._dispatch_fn(spec, worker)
         return True
 

@@ -164,7 +164,7 @@ class Worker:
 
     # -- plumbing ----------------------------------------------------------
     def send(self, msg_type: str, payload: dict):
-        data = cloudpickle.dumps((msg_type, payload))
+        data = P.dump_message(msg_type, payload)
         with self._send_lock:
             self.conn.send_bytes(data)
 
@@ -237,6 +237,27 @@ class Worker:
         with self._running_lock:
             self._running[tid] = threading.get_ident()
         _task_ctx.spec = spec
+        trace_token = None
+        exec_span = None
+        if spec.trace_ctx:
+            # Adopt the caller's span context so spans opened by user
+            # code (and nested submissions) join the distributed trace
+            # (reference: context extracted from the task spec,
+            # tracing_helper.py). Tracing failures must never fail the
+            # task itself.
+            try:
+                from ..util import tracing
+                if tracing._flush_fn is None:
+                    tracing._flush_fn = \
+                        lambda spans: self.client.gcs_request(
+                            "record_spans", spans=spans)
+                trace_token = tracing.activate_context(spec.trace_ctx)
+                exec_span = tracing.span(
+                    f"task:{spec.name}", task_id=spec.task_id.hex(),
+                    worker_id=self.config.worker_id.hex())
+                exec_span.__enter__()
+            except Exception:
+                trace_token, exec_span = None, None
         try:
             args = [self.resolve_arg(a) for a in spec.args]
             kwargs = {k: self.resolve_arg(a) for k, a in spec.kwargs.items()}
@@ -266,6 +287,15 @@ class Worker:
                 "task_id": spec.task_id, "results": locs, "error": None,
                 "nested": nested, "actor_id": spec.actor_id})
         except BaseException as e:  # noqa: BLE001 — all errors ship to owner
+            if exec_span is not None:
+                # Close the span WITH the failure so traces show failed
+                # tasks as failed (contextmanager __exit__ re-raising the
+                # same exception returns False, no propagation).
+                try:
+                    exec_span.__exit__(type(e), e, e.__traceback__)
+                except BaseException:
+                    pass
+                exec_span = None
             if isinstance(e, TaskCancelledError):
                 err = e
             else:
@@ -280,6 +310,15 @@ class Worker:
                 "task_id": spec.task_id, "results": None, "error": blob,
                 "actor_id": spec.actor_id})
         finally:
+            if trace_token is not None:
+                from ..util import tracing
+                try:
+                    if exec_span is not None:
+                        exec_span.__exit__(None, None, None)
+                    tracing.deactivate_context(trace_token)
+                    tracing.flush()
+                except Exception:
+                    pass
             _task_ctx.spec = None
             with self._running_lock:
                 self._running.pop(tid, None)

@@ -249,6 +249,21 @@ def _apply_placement(opts: Dict, resources: Dict[str, float]):
 # ---------------------------------------------------------------------------
 # remote functions
 # ---------------------------------------------------------------------------
+_tracing_mod = None
+
+
+def _tracing():
+    """Lazy tracing module handle (zero import cost until first submit)."""
+    global _tracing_mod
+    if _tracing_mod is None:
+        try:
+            from .util import tracing as _t
+            _tracing_mod = _t
+        except Exception:
+            _tracing_mod = False
+    return _tracing_mod or None
+
+
 class RemoteFunction:
     """Reference parity: python/ray/remote_function.py."""
 
@@ -328,7 +343,13 @@ class RemoteFunction:
             scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=_validate_runtime_env(opts.get("runtime_env")))
         refs = [ObjectRef(rid) for rid in return_ids]
-        rt.submit_task(spec)
+        tr = _tracing()
+        if tr is not None and tr.is_enabled():
+            with tr.span(f"submit:{spec.name}", task_id=task_id.hex()):
+                spec.trace_ctx = tr.current_context()
+                rt.submit_task(spec)
+        else:
+            rt.submit_task(spec)
         return refs[0] if num_returns == 1 else refs
 
 
@@ -399,7 +420,13 @@ class ActorHandle:
             actor_id=self._actor_id, method_name=method_name,
             max_retries=0)
         refs = [ObjectRef(rid) for rid in return_ids]
-        rt.submit_actor_task(spec)
+        tr = _tracing()
+        if tr is not None and tr.is_enabled():
+            with tr.span(f"submit:{spec.name}", task_id=task_id.hex()):
+                spec.trace_ctx = tr.current_context()
+                rt.submit_actor_task(spec)
+        else:
+            rt.submit_actor_task(spec)
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):

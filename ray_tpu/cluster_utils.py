@@ -1,0 +1,86 @@
+"""In-process multi-node cluster simulation for tests.
+
+Reference parity: python/ray/cluster_utils.py:135 `Cluster` — N real
+raylet processes sharing one GCS, so distributed scheduling/failover is
+testable on one machine (SURVEY.md §4, load-bearing test mechanism (a)).
+Here nodes are virtual entries in the scheduler's NodeRegistry: each has
+its own resource pool that tasks/actors bin-pack onto, workers are real
+local processes, and `remove_node` kills the victims' workers so
+retries/restarts exercise the same failover paths a dead host would.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import api
+from ._private import state
+
+
+class Node:
+    """Handle to one (virtual) cluster node."""
+
+    def __init__(self, node_id_hex: str, is_head: bool = False):
+        self.node_id = node_id_hex
+        self.is_head = is_head
+
+    def __repr__(self):
+        kind = "head" if self.is_head else "worker"
+        return f"ClusterNode({self.node_id[:8]}, {kind})"
+
+
+class Cluster:
+    """(reference: cluster_utils.Cluster)
+
+    >>> cluster = Cluster(initialize_head=True,
+    ...                   head_node_args={"num_cpus": 2})
+    >>> node = cluster.add_node(num_cpus=4)
+    >>> ... schedule work ...
+    >>> cluster.remove_node(node)   # workers die; tasks fail over
+    >>> cluster.shutdown()
+    """
+
+    def __init__(self, initialize_head: bool = False,
+                 head_node_args: Optional[Dict] = None):
+        self._nodes: List[Node] = []
+        self._owns_runtime = False
+        if initialize_head:
+            api.init(**(head_node_args or {}), ignore_reinit_error=True)
+            self._owns_runtime = True
+        rt = state.current()
+        self.head_node = Node(rt.node_id.hex(), is_head=True)
+        self._nodes.append(self.head_node)
+
+    def add_node(self, *, num_cpus: float = 1, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 **_ignored) -> Node:
+        rt = state.current()
+        res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.update(resources or {})
+        node = Node(rt.add_virtual_node(res))
+        self._nodes.append(node)
+        return node
+
+    def remove_node(self, node: Node, allow_graceful: bool = True) -> bool:
+        if node.is_head:
+            raise ValueError("cannot remove the head node")
+        rt = state.current()
+        ok = rt.remove_virtual_node(node.node_id)
+        if ok:
+            self._nodes.remove(node)
+        return ok
+
+    @property
+    def list_all_nodes(self) -> List[Node]:
+        return list(self._nodes)
+
+    def shutdown(self):
+        for node in [n for n in self._nodes if not n.is_head]:
+            try:
+                self.remove_node(node)
+            except Exception:
+                pass
+        if self._owns_runtime:
+            api.shutdown()

@@ -720,9 +720,21 @@ class Dataset:
         # the shard streams rather than arriving as one chunk).
         ds = self
         if equal:
-            n_blocks = len(ds._plan.execute())
-            per_consumer = max(1, min(8, n_blocks // n))
-            ds = ds.repartition(n * per_consumer)
+            bundles = ds._plan.execute()
+            # Skip the repartition when the coordinator's LPT assignment
+            # of the existing blocks already yields equal shards (e.g.
+            # evenly produced blocks) — rewriting every row through
+            # get/put just to re-balance balanced data doubles
+            # materialization cost.
+            shard_rows = [0] * n
+            for b in sorted(bundles, key=lambda b: -b.num_rows):
+                shard_rows[shard_rows.index(min(shard_rows))] += b.num_rows
+            balanced = (len([b for b in bundles if b.num_rows]) >= n
+                        and min(shard_rows) == max(shard_rows))
+            if not balanced:
+                n_blocks = len(bundles)
+                per_consumer = max(1, min(8, n_blocks // n))
+                ds = ds.repartition(n * per_consumer)
         bundles = ds._plan.execute()
         return streaming.make_split_iterators(
             [(b.ref, b.num_rows) for b in bundles], n, equal)

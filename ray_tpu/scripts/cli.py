@@ -1,0 +1,235 @@
+"""CLI (reference: python/ray/scripts/ — `ray start/status/list/timeline/
+job submit`; SURVEY.md §2.2 process bootstrap row).
+
+The runtime is driver-embedded (head processes collapse into the driver,
+SURVEY.md §3.1 translation), so `start` boots a head that serves remote
+drivers via the client server plus the dashboard. Inspection/job
+commands act on a cluster addressed by `--address host:port` (or
+$RAY_TPU_ADDRESS) through the client server — matching `ray status
+--address`; without an address they act on a fresh local runtime.
+
+Usage: python -m ray_tpu <command> [args]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _backend(args):
+    """Callable (name, *args, **kwargs) -> value, local or remote
+    (ray_tpu.util.client.api_ops.registry names)."""
+    addr = getattr(args, "address", None) or \
+        os.environ.get("RAY_TPU_ADDRESS")
+    if addr:
+        from ray_tpu.util.client import connect
+
+        conn = connect(addr)
+        return conn.api_call
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=getattr(args, "num_cpus", None),
+                 ignore_reinit_error=True)
+    from ray_tpu.util.client.api_ops import registry
+
+    reg = registry()
+    return lambda name, *a, **kw: reg[name](*a, **kw)
+
+
+def cmd_start(args):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=args.num_cpus, ignore_reinit_error=True)
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.util.client import server as client_server
+
+    host, port = client_server.serve(host=args.host, port=args.port)
+    dash_port = start_dashboard(host=args.host,
+                                port=args.dashboard_port)
+    print("ray_tpu head started.")
+    print(f"  client address:  {host}:{port}  "
+          f"(--address for other commands)")
+    print(f"  dashboard:       http://{args.host}:{dash_port}")
+    print(f"  resources:       "
+          f"{json.dumps(ray_tpu.cluster_resources())}", flush=True)
+    # The head lives in this process (client server + dashboard are
+    # daemon threads), so returning would tear it down — block until
+    # interrupted unless the caller embeds start programmatically.
+    if not args.no_block:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+def cmd_status(args):
+    call = _backend(args)
+    total = call("cluster_resources")
+    avail = call("available_resources")
+    print("======== Cluster status ========")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g}/{total[k]:g} available")
+    alive = [n for n in call("list_nodes") if n.get("alive", True)]
+    print(f"  nodes: {len(alive)}")
+    return 0
+
+
+def cmd_list(args):
+    call = _backend(args)
+    name = {
+        "tasks": "list_tasks", "actors": "list_actors",
+        "nodes": "list_nodes", "objects": "list_objects",
+        "workers": "list_workers",
+        "placement-groups": "list_placement_groups",
+    }[args.what]
+    print(json.dumps(call(name, limit=args.limit), indent=2,
+                     default=str))
+    return 0
+
+
+def cmd_summary(args):
+    call = _backend(args)
+    print(json.dumps({
+        "tasks": call("summarize_tasks"),
+        "actors": call("summarize_actors"),
+        "objects": call("summarize_objects"),
+    }, indent=2, default=str))
+    return 0
+
+
+def cmd_timeline(args):
+    call = _backend(args)
+    events = call("timeline")
+    out = args.output or f"timeline_{int(time.time())}.json"
+    with open(out, "w") as f:
+        json.dump(events, f)
+    print(f"wrote Chrome-trace timeline to {out} "
+          f"(open in ui.perfetto.dev)")
+    return 0
+
+
+def cmd_job(args):
+    call = _backend(args)
+    if args.job_cmd == "submit":
+        import shlex
+        entry = args.entrypoint
+        if entry and entry[0] == "--":
+            entry = entry[1:]
+        job_id = call(
+            "job_submit", entrypoint=shlex.join(entry),
+            runtime_env=json.loads(args.runtime_env)
+            if args.runtime_env else None)
+        print(f"submitted: {job_id}")
+        if not args.no_wait:
+            while call("job_status", job_id) in ("PENDING", "RUNNING"):
+                time.sleep(0.5)
+            status = call("job_status", job_id)
+            print(call("job_logs", job_id), end="")
+            print(f"status: {status}")
+            return 0 if status == "SUCCEEDED" else 1
+    elif args.job_cmd == "status":
+        print(call("job_status", args.job_id))
+    elif args.job_cmd == "logs":
+        print(call("job_logs", args.job_id), end="")
+    elif args.job_cmd == "list":
+        print(json.dumps(call("job_list"), indent=2, default=str))
+    elif args.job_cmd == "stop":
+        print("stopped" if call("job_stop", args.job_id)
+              else "not running")
+    return 0
+
+
+def cmd_dashboard(args):
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    from ray_tpu.dashboard import start_dashboard
+
+    port = start_dashboard(host=args.host, port=args.dashboard_port)
+    print(f"dashboard: http://{args.host}:{port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add_address(sp):
+        sp.add_argument("--address", default=None,
+                        help="client-server address of a running head "
+                        "(host:port); default $RAY_TPU_ADDRESS or a "
+                        "local runtime")
+
+    sp = sub.add_parser("start", help="start a head (client server + "
+                        "dashboard) for remote drivers")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=10001)
+    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--no-block", action="store_true",
+                    help="return instead of serving (embedding only; "
+                    "the head dies with this process)")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("status", help="cluster resource status")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("what", choices=["tasks", "actors", "nodes",
+                                     "objects", "workers",
+                                     "placement-groups"])
+    sp.add_argument("--limit", type=int, default=100)
+    add_address(sp)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="task/actor/object summaries")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="export Chrome-trace timeline")
+    sp.add_argument("-o", "--output", default=None)
+    add_address(sp)
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("job", help="job submission")
+    add_address(sp)
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--runtime-env", default=None,
+                   help="JSON runtime env")
+    j.add_argument("--no-wait", action="store_true")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+    jsub.add_parser("list")
+    sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("dashboard", help="serve the dashboard")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,12 +41,13 @@ from .trainer import (
     Result,
     TorchTrainer,
 )
+from .gbdt import LightGBMTrainer, XGBoostTrainer
 
 __all__ = [
     "BackendConfig", "BaseTrainer", "Checkpoint", "CheckpointConfig",
     "CheckpointManager", "DataParallelTrainer", "FailureConfig",
-    "JaxBackendConfig", "JaxTrainer", "Result", "RunConfig",
-    "ScalingConfig", "TorchBackendConfig", "TorchTrainer",
-    "get_checkpoint", "get_context", "get_dataset_shard",
-    "get_world_rank", "get_world_size", "report",
+    "JaxBackendConfig", "JaxTrainer", "LightGBMTrainer", "Result",
+    "RunConfig", "ScalingConfig", "TorchBackendConfig", "TorchTrainer",
+    "XGBoostTrainer", "get_checkpoint", "get_context",
+    "get_dataset_shard", "get_world_rank", "get_world_size", "report",
 ]

@@ -1,0 +1,42 @@
+"""Named API operations callable locally or through the client server.
+
+Reference parity: the Ray Client server proxies `ray.*` and state/job
+API calls for remote drivers (util/client/server/server.py
+RayletServicer); this registry is the whitelist of proxied operations —
+the CLI uses the same names against either a local runtime or a remote
+head (`--address`), so `ray_tpu status` reflects the actual cluster it
+points at.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+
+def registry() -> Dict[str, Callable[..., Any]]:
+    import ray_tpu
+    from ray_tpu.job import JobSubmissionClient
+    from ray_tpu.util import state
+
+    def job_client() -> JobSubmissionClient:
+        return JobSubmissionClient()
+
+    return {
+        "cluster_resources": ray_tpu.cluster_resources,
+        "available_resources": ray_tpu.available_resources,
+        "list_nodes": state.list_nodes,
+        "list_tasks": state.list_tasks,
+        "list_actors": state.list_actors,
+        "list_objects": state.list_objects,
+        "list_workers": state.list_workers,
+        "list_placement_groups": state.list_placement_groups,
+        "summarize_tasks": state.summarize_tasks,
+        "summarize_actors": state.summarize_actors,
+        "summarize_objects": state.summarize_objects,
+        "timeline": lambda: state.timeline(filename=None),
+        "job_submit": lambda **kw: job_client().submit_job(**kw),
+        "job_status": lambda job_id: job_client().get_job_status(job_id),
+        "job_logs": lambda job_id: job_client().get_job_logs(job_id),
+        "job_list": lambda: job_client().list_jobs(),
+        "job_stop": lambda job_id: job_client().stop_job(job_id),
+    }

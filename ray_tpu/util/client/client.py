@@ -88,6 +88,12 @@ class ClientConnection:
         out = self._request("put", blob=cloudpickle.dumps(value))
         return ClientObjectRef(self, out["ref_id"])
 
+    def api_call(self, name: str, *args, **kwargs) -> Any:
+        """Run a whitelisted API op (api_ops.registry) on the head."""
+        out = self._request("api_call", name=name, args=args,
+                            kwargs=kwargs)
+        return cloudpickle.loads(out["value"])
+
     def _release(self, ref_id: str):
         try:
             self._pending_releases.append(ref_id)

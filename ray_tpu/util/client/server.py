@@ -78,6 +78,13 @@ def _handle(session: _Session, op: str, payload: Dict[str, Any]):
     if op == "release":
         session.refs.pop(payload["ref_id"], None)
         return {"ok": True}
+    if op == "api_call":
+        from .api_ops import registry
+        fn = registry().get(payload["name"])
+        if fn is None:
+            raise ValueError(f"unknown api op {payload['name']!r}")
+        value = fn(*payload.get("args", ()), **payload.get("kwargs", {}))
+        return {"value": cloudpickle.dumps(value)}
     raise ValueError(f"unknown op {op}")
 
 

@@ -1,0 +1,192 @@
+"""Distributed tracing: spans + context propagation across tasks/actors.
+
+Reference parity: python/ray/util/tracing/tracing_helper.py — the
+reference injects OpenTelemetry spans around task/actor submission and
+execution and propagates span context *inside task specs*
+(_DictPropagator:165, span decorators :195+). Same design here without a
+hard OpenTelemetry dependency: spans are plain dicts buffered per
+process, shipped to the GCS-equivalent span store (driver: direct;
+workers: piggybacked gcs_request), and exportable as Chrome-trace JSON
+alongside the task timeline. If `opentelemetry` is importable, spans are
+mirrored to the active OTel tracer.
+
+Usage:
+    from ray_tpu.util import tracing
+    tracing.enable()
+    with tracing.span("ingest", source="s3"):
+        ref = f.remote(...)        # submit span + context ride the spec
+    tracing.export_chrome_trace("/tmp/trace.json")
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_enabled = False
+_lock = threading.Lock()
+_buffer: List[dict] = []
+# How worker processes flush: set by worker bootstrap to a gcs_request
+# closure; None on the driver (writes straight into the Gcs).
+_flush_fn = None
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace", default=None)   # (trace_id, span_id) or None
+
+
+def enable() -> None:
+    """Turn on tracing in this process (reference:
+    ray.init(_tracing_startup_hook=...) switch)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    """Tracing is on if enabled process-wide OR a propagated context is
+    active in this task (workers trace exactly the requests whose driver
+    had tracing on, without flipping any process-global state)."""
+    return _enabled or _current.get() is not None
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """Propagatable context dict of the active span (reference:
+    _DictPropagator.inject_current_context)."""
+    cur = _current.get()
+    if cur is None:
+        return None
+    return {"trace_id": cur[0], "parent_span_id": cur[1]}
+
+
+def _record(span: dict) -> None:
+    with _lock:
+        _buffer.append(span)
+        if len(_buffer) >= 128:
+            _flush_locked()
+
+
+def _flush_locked() -> None:
+    global _buffer
+    if not _buffer:
+        return
+    batch, _buffer = _buffer, []
+    try:
+        if _flush_fn is not None:
+            _flush_fn(batch)
+        else:
+            from .._private import state
+            rt = state.current_or_none()
+            if rt is not None:
+                rt.gcs.record_spans(batch)
+            else:
+                _buffer = batch + _buffer  # no runtime yet; retry later
+    except Exception:
+        pass
+
+
+def flush() -> None:
+    with _lock:
+        _flush_locked()
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes: Any):
+    """Record a span; nests under the active span, and downstream
+    task/actor submissions inside it carry the context remotely."""
+    if not is_enabled():
+        yield None
+        return
+    cur = _current.get()
+    trace_id = cur[0] if cur else uuid.uuid4().hex
+    span_id = uuid.uuid4().hex[:16]
+    token = _current.set((trace_id, span_id))
+    start = time.time()
+    error = None
+    try:
+        with _maybe_otel_span(name, attributes):
+            yield span_id
+    except BaseException as e:
+        error = repr(e)
+        raise
+    finally:
+        _current.reset(token)
+        _record({
+            "name": name, "trace_id": trace_id, "span_id": span_id,
+            "parent_span_id": cur[1] if cur else None,
+            "start": start, "end": time.time(),
+            "attributes": attributes or None, "error": error,
+        })
+
+
+def activate_context(ctx: Optional[Dict[str, str]]):
+    """Adopt a propagated context (worker side; reference: extract from
+    the task spec before running the user function). Returns a reset
+    token or None. Deliberately does NOT flip the process-global enable
+    flag: once the context is reset, this worker stops tracing unless
+    the next task carries a context too."""
+    if not ctx:
+        return None
+    return _current.set((ctx["trace_id"], ctx["parent_span_id"]))
+
+
+def deactivate_context(token) -> None:
+    if token is not None:
+        _current.reset(token)
+
+
+@contextlib.contextmanager
+def _maybe_otel_span(name: str, attributes: Dict):
+    """Mirror to OpenTelemetry when available (reference:
+    _OpenTelemetryProxy:34 — tracing works without it installed)."""
+    try:
+        from opentelemetry import trace as otel_trace
+        tracer = otel_trace.get_tracer("ray_tpu")
+    except Exception:
+        yield
+        return
+    with tracer.start_as_current_span(name, attributes={
+            k: str(v) for k, v in (attributes or {}).items()}):
+        yield
+
+
+# ---------------------------------------------------------------------------
+# collection / export (driver side)
+# ---------------------------------------------------------------------------
+def get_spans() -> List[dict]:
+    """All spans flushed to the GCS store plus this process's buffer."""
+    flush()
+    from .._private import state
+    rt = state.current_or_none()
+    stored = rt.gcs.spans() if rt is not None else []
+    return stored
+
+
+def export_chrome_trace(filename: Optional[str] = None) -> List[dict]:
+    """Spans + task timeline as one Chrome-trace JSON (reference:
+    `ray timeline` merged with span events)."""
+    import json
+
+    from . import state as state_api
+
+    events = state_api.timeline()
+    for s in get_spans():
+        events.append({
+            "cat": "span", "name": s["name"], "ph": "X",
+            "ts": s["start"] * 1e6, "dur": (s["end"] - s["start"]) * 1e6,
+            "pid": "spans", "tid": s["trace_id"][:8],
+            "args": {k: v for k, v in s.items()
+                     if k in ("trace_id", "span_id", "parent_span_id",
+                              "attributes", "error")},
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -1,0 +1,8 @@
+"""(reference: python/ray/util/xgboost/__init__.py — removed in Ray 2.0
+in favor of Train's XGBoostTrainer; the parity surface is the same
+redirect.)"""
+
+raise DeprecationWarning(
+    "ray_tpu.util.xgboost mirrors ray.util.xgboost, which was removed as "
+    "of Ray 2.0. Use ray_tpu.train.XGBoostTrainer instead."
+)

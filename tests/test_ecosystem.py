@@ -104,3 +104,66 @@ def test_actor_group():
     assert g.execute_with_rank("whoami") == [0, 1, 2, 3]
     g.shutdown()
     assert len(g) == 0
+
+
+def test_dask_scheduler_protocol():
+    """ray_dask_get executes dask graph dicts without dask installed
+    (reference: util/dask/scheduler.py ray_dask_get)."""
+    from operator import add, mul
+
+    from ray_tpu.util.dask import ray_dask_get
+
+    dsk = {
+        "a": 1,
+        "b": (add, "a", 2),
+        "c": (mul, "b", (add, "b", 1)),
+        "d": [(add, "b", "b"), "c"],
+    }
+    assert ray_dask_get(dsk, "b") == 3
+    assert ray_dask_get(dsk, "c") == 12
+    assert ray_dask_get(dsk, "d") == [6, 12]
+    assert ray_dask_get(dsk, ["b", "c"]) == [3, 12]
+
+
+def test_dask_cycle_detection():
+    from ray_tpu.util.dask import ray_dask_get
+
+    dsk = {"a": (len, "b"), "b": (len, "a")}
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get(dsk, "a")
+
+
+def test_gbdt_trainers_gated():
+    """Without xgboost/lightgbm installed, trainers raise a clear error
+    (reference: Train's optional integrations)."""
+    from ray_tpu.train import LightGBMTrainer, XGBoostTrainer
+
+    for cls, lib in ((XGBoostTrainer, "xgboost"),
+                     (LightGBMTrainer, "lightgbm")):
+        try:
+            __import__(lib)
+            installed = True
+        except ImportError:
+            installed = False
+        if not installed:
+            with pytest.raises(ImportError, match=lib):
+                cls(datasets={})
+
+
+def test_xgboost_util_stub():
+    """(reference: ray.util.xgboost raises DeprecationWarning)"""
+    with pytest.raises(DeprecationWarning):
+        import ray_tpu.util.xgboost  # noqa: F401
+
+
+def test_spark_stub_gated():
+    from ray_tpu.util.spark import setup_ray_cluster
+
+    try:
+        import pyspark  # noqa: F401
+        has_spark = True
+    except ImportError:
+        has_spark = False
+    if not has_spark:
+        with pytest.raises(ImportError, match="pyspark"):
+            setup_ray_cluster()
