@@ -22,6 +22,7 @@ Public surface (reference parity: python/ray/__init__.py):
 from .api import (
     ActorHandle,
     ObjectRef,
+    ObjectRefGenerator,
     available_resources,
     cancel,
     cluster_resources,
@@ -42,7 +43,7 @@ from . import exceptions
 __version__ = "0.1.0"
 
 __all__ = [
-    "ActorHandle", "ObjectRef", "available_resources", "cancel",
+    "ActorHandle", "ObjectRef", "ObjectRefGenerator", "available_resources", "cancel",
     "cluster_resources", "exceptions", "get", "get_actor",
     "get_runtime_context", "init", "is_initialized", "kill", "method",
     "put", "remote", "shutdown", "wait", "__version__",

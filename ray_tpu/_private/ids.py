@@ -114,6 +114,11 @@ def object_id_for_return(task_id: TaskID, index: int) -> ObjectID:
     lineage reconstruction can re-derive them.
     """
     payload = bytearray(task_id.binary())
-    payload[0] ^= (index + 1) & 0xFF
-    payload[1] ^= ((index + 1) >> 8) & 0xFF
+    # 4 index bytes: streaming generators make large indices reachable
+    # (a stream of 2^32 items is the wrap point, vs 2^16 before).
+    n = index + 1
+    payload[0] ^= n & 0xFF
+    payload[1] ^= (n >> 8) & 0xFF
+    payload[2] ^= (n >> 16) & 0xFF
+    payload[3] ^= (n >> 24) & 0xFF
     return ObjectID(bytes(payload))

@@ -32,6 +32,7 @@ REPLY = "reply"                  # response to a worker-originated request
 # Message types: worker -> driver
 REF_COUNT = "ref_count"          # oneway borrow incref/decref from a worker
 TASK_DONE = "task_done"
+GEN_ITEM = "gen_item"            # one yielded item of a streaming generator
 ACTOR_READY = "actor_ready"
 OWNED_PUT = "owned_put"          # worker did put(); driver adopts ownership
 GET_LOCATIONS = "get_locations"  # blocking object-location lookup
@@ -108,6 +109,10 @@ class TaskSpec:
     # Tracing context propagated into the worker (reference: span context
     # inside task specs, util/tracing/tracing_helper.py _DictPropagator:165).
     trace_ctx: Optional[dict] = None
+    # num_returns="streaming": the task is a generator; items stream back
+    # one GEN_ITEM message each (reference: streaming generator execution,
+    # _raylet.pyx:1348 + core_worker TaskManager dynamic returns).
+    streaming: bool = False
 
 
 @dataclass

@@ -175,6 +175,10 @@ class Node:
         self._ready_cond = threading.Condition()
         self._release_buf: List[ObjectID] = []
         self._release_lock = threading.Lock()
+        # Streaming generator tasks: task binary -> stream state
+        self._gen_lock = threading.Lock()
+        self._gen_cond = threading.Condition(self._gen_lock)
+        self._gen_streams: Dict[bytes, dict] = {}
         self.gcs.objects.subscribe_ready(self._on_object_ready)
         self.gcs.objects.subscribe_free(self._on_objects_freed)
         self._shutdown = False
@@ -462,6 +466,114 @@ class Node:
             worker.running.pop(spec.task_id.binary(), None)
             self._handle_worker_failure_for_task(spec)
 
+    def _on_gen_item(self, handle: WorkerHandle, payload: dict):
+        """One streamed item landed (reference: TaskManager handling of
+        dynamically created return objects)."""
+        from .ids import object_id_for_return
+
+        task_id: TaskID = payload["task_id"]
+        oid = object_id_for_return(task_id, payload["index"])
+        loc = payload["loc"]
+        size = loc[1] if loc[0] == P.LOC_SHM else len(loc[1])
+        if loc[0] == P.LOC_SHM:
+            self.store.adopt(oid, size)
+        # Lineage: the producing spec (from the worker's running table)
+        # makes items cancellable/recoverable like normal returns.
+        spec = handle.running.get(task_id.binary())
+        self.gcs.objects.register_ready(
+            oid, loc, size, lineage=spec,
+            nested_ids=payload.get("nested") or [])
+        with self._gen_lock:
+            st = self._gen_stream_state(task_id)
+            st["count"] = max(st["count"], payload["index"] + 1)
+            abandoned = st.get("abandoned", False)
+            self._gen_cond.notify_all()
+        if abandoned:
+            self.gcs.objects.decref(oid)
+
+    def _gen_stream_state(self, task_id: TaskID) -> dict:
+        """Callers hold self._gen_lock."""
+        return self._gen_streams.setdefault(
+            task_id.binary(), {"count": 0, "finished": False,
+                               "error": None, "callbacks": []})
+
+    def gen_wait(self, task_id: TaskID, index: int,
+                 timeout: Optional[float] = None):
+        """Block until item `index` of a streaming task exists or the
+        stream ends. Returns (available: bool, finished_count or None,
+        error_blob or None)."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._gen_lock:
+            while True:
+                st = self._gen_streams.get(task_id.binary())
+                if st is not None:
+                    # Items yielded before a failure stay readable; the
+                    # error surfaces only once the consumer passes them
+                    # (reference: generator items are normal objects,
+                    # the exception lands at the failure point).
+                    if index < st["count"]:
+                        return True, None, None
+                    if st["error"] is not None:
+                        return False, st["count"], st["error"]
+                    if st["finished"]:
+                        return False, st["count"], None
+                remaining = None if deadline is None \
+                    else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"Timed out waiting for streamed item {index} of "
+                        f"task {task_id.hex()}")
+                self._gen_cond.wait(timeout=remaining)
+
+    def _finish_gen_stream(self, task_id: TaskID, count: Optional[int],
+                           error: Optional[bytes]):
+        with self._gen_lock:
+            st = self._gen_stream_state(task_id)
+            if count is not None:
+                st["count"] = max(st["count"], count)
+            st["finished"] = True
+            if error is not None:
+                st["error"] = error
+            callbacks, st["callbacks"] = list(st.get("callbacks", ())), []
+            if st.get("abandoned"):
+                self._gen_streams.pop(task_id.binary(), None)
+            self._gen_cond.notify_all()
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def gen_add_done_callback(self, task_id: TaskID, cb) -> None:
+        """Invoke `cb()` when the stream finishes (now if already done)."""
+        with self._gen_lock:
+            st = self._gen_stream_state(task_id)
+            if not st["finished"]:
+                st["callbacks"].append(cb)
+                return
+        cb()
+
+    def gen_release(self, task_id: TaskID, consumed: int) -> None:
+        """Consumer dropped its ObjectRefGenerator: free unconsumed items
+        (registered but never wrapped in an ObjectRef, so no other decref
+        will ever come) and drop the stream state. A still-running stream
+        is marked abandoned so later items are freed on arrival."""
+        from .ids import object_id_for_return
+
+        with self._gen_lock:
+            st = self._gen_streams.get(task_id.binary())
+            if st is None:
+                return
+            count = st["count"]
+            if st["finished"]:
+                self._gen_streams.pop(task_id.binary(), None)
+            else:
+                st["abandoned"] = True
+        for i in range(consumed, count):
+            oid = object_id_for_return(task_id, i)
+            if self.gcs.objects.entry(oid) is not None:
+                self.gcs.objects.decref(oid)
+
     def _on_task_done(self, handle: WorkerHandle, payload: dict):
         task_id: TaskID = payload["task_id"]
         spec = handle.running.pop(task_id.binary(), None)
@@ -477,6 +589,19 @@ class Node:
             if st is not None:
                 st.in_flight.discard(task_id.binary())
         error = payload.get("error")
+        if spec.streaming:
+            if error is not None and spec.retry_exceptions and \
+                    self._retry_budget(spec):
+                self._resubmit(spec)
+                return
+            self._unpin_task_args(spec)
+            self._finish_gen_stream(task_id, payload.get("streamed"),
+                                    error)
+            self.gcs.record_task_event({
+                "task_id": task_id.hex(), "name": spec.name,
+                "state": "FAILED" if error is not None else "FINISHED",
+                "ts": time.time()})
+            return
         if error is not None:
             if spec.retry_exceptions and self._retry_budget(spec):
                 self._resubmit(spec)
@@ -572,6 +697,8 @@ class Node:
             pending = list(st.queue)
             st.queue.clear()
         for item in pending:
+            if item[0].streaming:
+                self._finish_gen_stream(item[0].task_id, None, error_blob)
             for rid in item[0].return_ids:
                 self.gcs.objects.register_ready(
                     rid, (P.LOC_ERROR, error_blob))
@@ -588,6 +715,8 @@ class Node:
             blob = entry.creation_error or serialization.dumps(
                 ActorDiedError(f"Actor {spec.actor_id.hex()} is dead "
                                f"({entry.death_cause})"))
+            if spec.streaming:
+                self._finish_gen_stream(spec.task_id, None, blob)
             for rid in spec.return_ids:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             return
@@ -687,6 +816,8 @@ class Node:
         if spec.task_id.binary() in self._cancel_requested:
             blob = serialization.dumps(
                 TaskCancelledError(spec.task_id.hex()))
+            if spec.streaming:
+                self._finish_gen_stream(spec.task_id, None, blob)
             for rid in spec.return_ids:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             self._unpin_task_args(spec)
@@ -697,6 +828,8 @@ class Node:
             blob = serialization.dumps(WorkerCrashedError(
                 f"The worker running task {spec.name} died "
                 f"(retries exhausted)."))
+            if spec.streaming:
+                self._finish_gen_stream(spec.task_id, None, blob)
             for rid in spec.return_ids:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             self._unpin_task_args(spec)
@@ -711,6 +844,8 @@ class Node:
         blob = serialization.dumps(ActorDiedError(
             f"Actor {actor_id.hex()}'s worker process died."))
         for spec in running.values():
+            if spec.streaming:
+                self._finish_gen_stream(spec.task_id, None, blob)
             for rid in spec.return_ids:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             self._unpin_task_args(spec)
@@ -781,6 +916,8 @@ class Node:
                 self.gcs.objects.decref(payload["object_id"])
         elif msg_type == P.TASK_DONE:
             self._on_task_done(handle, payload)
+        elif msg_type == P.GEN_ITEM:
+            self._on_gen_item(handle, payload)
         elif msg_type == P.ACTOR_READY:
             self._on_actor_ready(handle, payload)
         elif msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS, P.GCS_REQUEST):

@@ -232,6 +232,31 @@ class Worker:
                 locs.append((P.LOC_SHM, size))
         return locs, nested_per_return
 
+    def _stream_generator(self, spec: P.TaskSpec, gen) -> int:
+        """Ship each yielded item as its own object, one GEN_ITEM message
+        per item (reference: streaming generator execution,
+        _raylet.pyx:1348 — dynamic return objects created as the
+        generator runs, not buffered until completion)."""
+        from .ids import object_id_for_return
+
+        if not inspect.isgenerator(gen) and not hasattr(gen, "__next__"):
+            gen = iter([gen] if gen is not None else [])
+        index = 0
+        for item in gen:
+            oid = object_id_for_return(spec.task_id, index)
+            with serialization.collect_object_refs() as nested:
+                sobj = serialization.serialize(item)
+            if sobj.total_size <= INLINE_THRESHOLD:
+                loc = (P.LOC_INLINE, sobj.to_bytes())
+            else:
+                size = self.store.put_serialized(oid, sobj)
+                loc = (P.LOC_SHM, size)
+            self.send(P.GEN_ITEM, {
+                "task_id": spec.task_id, "index": index, "loc": loc,
+                "nested": list(nested), "actor_id": spec.actor_id})
+            index += 1
+        return index
+
     def _execute(self, spec: P.TaskSpec):
         tid = spec.task_id.binary()
         with self._running_lock:
@@ -282,10 +307,17 @@ class Worker:
                 result = fn(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     result = asyncio.run(result)
-            locs, nested = self._package_returns(spec, result)
-            self.send(P.TASK_DONE, {
-                "task_id": spec.task_id, "results": locs, "error": None,
-                "nested": nested, "actor_id": spec.actor_id})
+            if spec.streaming:
+                n_items = self._stream_generator(spec, result)
+                self.send(P.TASK_DONE, {
+                    "task_id": spec.task_id, "results": [], "error": None,
+                    "streamed": n_items, "actor_id": spec.actor_id})
+            else:
+                locs, nested = self._package_returns(spec, result)
+                self.send(P.TASK_DONE, {
+                    "task_id": spec.task_id, "results": locs,
+                    "error": None, "nested": nested,
+                    "actor_id": spec.actor_id})
         except BaseException as e:  # noqa: BLE001 — all errors ship to owner
             if exec_span is not None:
                 # Close the span WITH the failure so traces show failed

@@ -249,6 +249,56 @@ def _apply_placement(opts: Dict, resources: Dict[str, float]):
 # ---------------------------------------------------------------------------
 # remote functions
 # ---------------------------------------------------------------------------
+class ObjectRefGenerator:
+    """Iterator over a streaming generator task's yielded items
+    (reference: ObjectRefGenerator / DynamicObjectRefGenerator —
+    streaming generator execution, _raylet.pyx:1348). Each __next__
+    blocks until the next item lands and yields its ObjectRef; raises
+    StopIteration when the task's generator is exhausted."""
+
+    def __init__(self, task_id: TaskID):
+        self._task_id = task_id
+        self._index = 0
+        self._released = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        return self.next_ready()
+
+    def next_ready(self, timeout: Optional[float] = None) -> "ObjectRef":
+        """Like __next__ but with a timeout (raises GetTimeoutError)."""
+        rt = state.current()
+        available, count, error = rt.gen_wait(self._task_id, self._index,
+                                              timeout=timeout)
+        if available:
+            oid = object_id_for_return(self._task_id, self._index)
+            self._index += 1
+            return ObjectRef(oid)
+        if error is not None:
+            raise serialization.loads(error)
+        raise StopIteration
+
+    def add_done_callback(self, cb) -> None:
+        """cb() fires when the producing task's stream finishes."""
+        state.current().gen_add_done_callback(self._task_id, cb)
+
+    def __del__(self):
+        if self._released:
+            return
+        self._released = True
+        try:
+            rt = state.current_or_none()
+            if rt is not None and hasattr(rt, "gen_release"):
+                rt.gen_release(self._task_id, self._index)
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()})"
+
+
 _tracing_mod = None
 
 
@@ -324,7 +374,8 @@ class RemoteFunction:
             init(ignore_reinit_error=True)
         rt = state.current()
         opts = self._opts
-        num_returns = int(opts.get("num_returns", 1))
+        streaming = opts.get("num_returns") == "streaming"
+        num_returns = 0 if streaming else int(opts.get("num_returns", 1))
         task_id = TaskID.from_random()
         return_ids = [object_id_for_return(task_id, i)
                       for i in range(num_returns)]
@@ -335,7 +386,7 @@ class RemoteFunction:
             task_id=task_id, fn_id=self._fn_id, fn_blob=self._get_blob(),
             args=s_args, kwargs=s_kwargs, return_ids=return_ids,
             num_returns=num_returns, name=opts.get("name", self.__name__),
-            resources=resources,
+            resources=resources, streaming=streaming,
             max_retries=int(opts.get("max_retries", 3)),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             placement_group_id=pg_id,
@@ -350,6 +401,8 @@ class RemoteFunction:
                 rt.submit_task(spec)
         else:
             rt.submit_task(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id)
         return refs[0] if num_returns == 1 else refs
 
 
@@ -406,8 +459,9 @@ class ActorHandle:
                            opts: Dict):
         rt = state.current()
         meta = self._method_meta.get(method_name, {})
-        num_returns = int(opts.get("num_returns",
-                                   meta.get("num_returns", 1)))
+        nr_opt = opts.get("num_returns", meta.get("num_returns", 1))
+        streaming = nr_opt == "streaming"
+        num_returns = 0 if streaming else int(nr_opt)
         task_id = TaskID.from_random()
         return_ids = [object_id_for_return(task_id, i)
                       for i in range(num_returns)]
@@ -418,7 +472,7 @@ class ActorHandle:
             return_ids=return_ids, num_returns=num_returns,
             name=f"{self._cls_id.split(':')[0]}.{method_name}",
             actor_id=self._actor_id, method_name=method_name,
-            max_retries=0)
+            max_retries=0, streaming=streaming)
         refs = [ObjectRef(rid) for rid in return_ids]
         tr = _tracing()
         if tr is not None and tr.is_enabled():
@@ -427,6 +481,8 @@ class ActorHandle:
                 rt.submit_actor_task(spec)
         else:
             rt.submit_actor_task(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id)
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):

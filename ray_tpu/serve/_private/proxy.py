@@ -108,10 +108,31 @@ def _make_handler(proxy_state: _ProxyState):
                        "body": body}
             try:
                 handle = proxy_state.handle_for(deployment, app)
-                result = handle.remote(request).result(timeout_s=60.0)
-                self._respond(200, result)
+                rg = handle.options(stream=True).remote(request)
+                if not rg.is_stream(timeout_s=60.0):
+                    return self._respond(200,
+                                         rg.single_result(timeout_s=60.0))
             except Exception as e:
-                self._respond(500, {"error": str(e)})
+                return self._respond(500, {"error": str(e)})
+            # Chunked transfer: one chunk per generator item (reference:
+            # streaming responses through the proxy, proxy.py over ASGI).
+            # Headers are already on the wire once streaming starts, so a
+            # mid-stream failure can only truncate the chunked body (no
+            # terminating 0-chunk) — never emit a second status line.
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                for item in rg:
+                    chunk = item if isinstance(item, bytes) else (
+                        item if isinstance(item, str)
+                        else json.dumps(item)).encode()
+                    self.wfile.write(
+                        f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:
+                self.close_connection = True
 
         do_GET = do_POST = do_PUT = do_DELETE = _serve
 

@@ -65,6 +65,36 @@ class Replica:
         finally:
             self._ongoing -= 1
 
+    def _resolve_target(self, method_name: str):
+        if inspect.isfunction(self._callable) or inspect.ismethod(
+                self._callable) or not hasattr(self._callable,
+                                               method_name):
+            return self._callable  # function deployment
+        return getattr(self._callable, method_name)
+
+    def handle_request_streaming(self, method_name: str, args: tuple,
+                                 kwargs: dict):
+        """Generator variant of handle_request (reference: streaming
+        responses through the proxy, serve/_private/replica.py
+        call_user_generator). First yielded item is a marker dict so the
+        consumer knows whether the user returned a stream or one value;
+        user generators then stream item by item over GEN_ITEM messages.
+        """
+        self._ongoing += 1
+        try:
+            target = self._resolve_target(method_name)
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            if inspect.isgenerator(result):
+                yield {"__stream__": True}
+                yield from result
+            else:
+                yield {"__stream__": False}
+                yield result
+        finally:
+            self._ongoing -= 1
+
     async def get_queue_len(self) -> int:
         """Power-of-two probe (reference: replica scheduler queue-length
         probes, pow_2_scheduler.py:52)."""

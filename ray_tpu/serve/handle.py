@@ -36,6 +36,42 @@ class DeploymentResponse:
         return self._ref.__await__()
 
 
+class DeploymentResponseGenerator:
+    """Streamed result of handle.options(stream=True).remote()
+    (reference: handle.py DeploymentResponseGenerator). Iterates the
+    user generator's items as values; the leading replica marker dict is
+    consumed internally — `is_stream` tells whether the user callable
+    actually returned a generator (False: `single_result()` holds its
+    one return value)."""
+
+    def __init__(self, ref_gen):
+        self._gen = ref_gen
+        self._marker: Optional[dict] = None
+
+    def _read_marker(self, timeout_s: Optional[float] = None) -> dict:
+        if self._marker is None:
+            self._marker = ray_tpu.get(
+                self._gen.next_ready(timeout=timeout_s))
+        return self._marker
+
+    def is_stream(self, timeout_s: Optional[float] = None) -> bool:
+        """Did the user callable return a generator? (The proxy uses
+        this to pick chunked vs plain responses.)"""
+        return bool(self._read_marker(timeout_s).get("__stream__"))
+
+    def single_result(self, timeout_s: Optional[float] = None) -> Any:
+        """The one value of a non-stream response."""
+        self._read_marker(timeout_s)
+        return ray_tpu.get(self._gen.next_ready(timeout=timeout_s))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        self._read_marker()
+        return ray_tpu.get(next(self._gen))
+
+
 class _Router:
     """Pow-2 replica scheduler over the current replica set."""
 
@@ -75,7 +111,7 @@ class _Router:
         return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       timeout_s: float = 30.0):
+                       timeout_s: float = 30.0, stream: bool = False):
         if not self._ready.wait(timeout=timeout_s):
             raise TimeoutError(
                 f"No replicas of '{self._deployment}' became available "
@@ -84,6 +120,19 @@ class _Router:
             idx = self._pick()
             replica = self._replicas[idx]
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        if stream:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(method_name, args, kwargs)
+
+            def _stream_done():
+                with self._lock:
+                    if idx in self._inflight and self._inflight[idx] > 0:
+                        self._inflight[idx] -= 1
+            try:
+                gen.add_done_callback(_stream_done)
+            except Exception:
+                _stream_done()
+            return gen
         ref = replica.handle_request.remote(method_name, args, kwargs)
 
         def _done(_):
@@ -108,17 +157,19 @@ class DeploymentHandle:
     """
 
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method = method_name
+        self._stream = stream
         self._router: Optional[_Router] = None
         self._lock = threading.Lock()
 
     # -- pickling ----------------------------------------------------------
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self.app_name, self._method))
+                (self.deployment_name, self.app_name, self._method,
+                 self._stream))
 
     # -- routing -----------------------------------------------------------
     def _get_router(self) -> _Router:
@@ -128,9 +179,11 @@ class DeploymentHandle:
                 self._router = _Router(self.deployment_name, get_controller())
             return self._router
 
-    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
+    def options(self, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self.app_name,
-                             method_name or self._method)
+                             method_name or self._method,
+                             self._stream if stream is None else stream)
         h._router = self._router
         return h
 
@@ -139,13 +192,16 @@ class DeploymentHandle:
             raise AttributeError(name)
         return self.options(method_name=name)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
                      else a for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
                       else v) for k, v in kwargs.items()}
-        ref = self._get_router().assign_request(self._method, args, kwargs)
-        return DeploymentResponse(ref)
+        out = self._get_router().assign_request(
+            self._method, args, kwargs, stream=self._stream)
+        if self._stream:
+            return DeploymentResponseGenerator(out)
+        return DeploymentResponse(out)
 
     def shutdown(self):
         with self._lock:

@@ -1,0 +1,172 @@
+"""Streaming generator tests (reference strategy:
+python/ray/tests/test_streaming_generator*.py — num_returns="streaming"
+tasks/actor methods, incremental consumption, mid-stream errors)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+class TestTaskStreaming:
+    def test_basic(self, ray_start_shared):
+        @ray_tpu.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i * 10
+
+        vals = [ray_tpu.get(r) for r in gen.remote(5)]
+        assert vals == [0, 10, 20, 30, 40]
+
+    def test_incremental_delivery(self, ray_start_shared):
+        @ray_tpu.remote
+        def warm():
+            return 1
+
+        ray_tpu.get(warm.remote())
+
+        @ray_tpu.remote(num_returns="streaming")
+        def slow():
+            for i in range(3):
+                yield i
+                time.sleep(0.5)
+
+        g = slow.remote()
+        t0 = time.time()
+        first = ray_tpu.get(g.next_ready(timeout=10))
+        t_first = time.time() - t0
+        assert first == 0
+        assert [ray_tpu.get(r) for r in g] == [1, 2]
+        t_total = time.time() - t0
+        # First item arrived while the generator was still sleeping
+        # through items 2 and 3 (i.e. clearly before stream end).
+        assert t_first < t_total - 0.4, (t_first, t_total)
+
+    def test_error_mid_stream(self, ray_start_shared):
+        @ray_tpu.remote(num_returns="streaming", max_retries=0)
+        def bad():
+            yield 1
+            raise ValueError("boom")
+
+        g = bad.remote()
+        # Pre-failure items stay readable; the error lands after them.
+        assert ray_tpu.get(next(g)) == 1
+        from ray_tpu.exceptions import TaskError
+
+        with pytest.raises(TaskError, match="boom"):
+            for r in g:
+                ray_tpu.get(r)
+
+    def test_large_items_via_shm(self, ray_start_shared):
+        @ray_tpu.remote(num_returns="streaming")
+        def big():
+            for i in range(3):
+                yield np.full((300_000,), i, dtype=np.float64)
+
+        total = sum(float(ray_tpu.get(r).sum()) for r in big.remote())
+        assert total == 300_000 * 3.0
+
+    def test_empty_stream(self, ray_start_shared):
+        @ray_tpu.remote(num_returns="streaming")
+        def empty():
+            return
+            yield  # pragma: no cover
+
+        assert list(empty.remote()) == []
+
+
+class TestActorStreaming:
+    def test_method_stream(self, ray_start_shared):
+        @ray_tpu.remote
+        class A:
+            def stream(self, n):
+                for i in range(n):
+                    yield f"c{i}"
+
+        a = A.remote()
+        g = a.stream.options(num_returns="streaming").remote(3)
+        assert [ray_tpu.get(r) for r in g] == ["c0", "c1", "c2"]
+
+    def test_actor_death_ends_stream(self, ray_start_shared):
+        @ray_tpu.remote
+        class S:
+            def stream(self):
+                for i in range(1000):
+                    yield i
+                    time.sleep(0.2)
+
+        a = S.remote()
+        g = a.stream.options(num_returns="streaming").remote()
+        assert ray_tpu.get(g.next_ready(timeout=30)) == 0
+        ray_tpu.kill(a)
+        from ray_tpu.exceptions import ActorDiedError
+
+        # A dead producer must surface promptly — never hang the consumer.
+        with pytest.raises((ActorDiedError, StopIteration)):
+            for _ in range(1000):
+                ray_tpu.get(g.next_ready(timeout=15))
+
+    def test_abandoned_stream_cleanup(self, ray_start_shared):
+        from ray_tpu._private import state
+
+        rt = state.current()
+
+        @ray_tpu.remote(num_returns="streaming")
+        def gen():
+            for i in range(10):
+                yield i
+
+        g = gen.remote()
+        ray_tpu.get(next(g))
+        tid = g._task_id
+        del g
+        import gc
+
+        gc.collect()
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                tid.binary() in rt._gen_streams:
+            time.sleep(0.2)
+        assert tid.binary() not in rt._gen_streams
+
+
+class TestServeStreaming:
+    def test_handle_and_proxy_stream(self, ray_start_shared):
+        import json
+        import urllib.request
+
+        from ray_tpu import serve
+
+        serve.start()
+
+        @serve.deployment
+        class Chat:
+            def __call__(self, request):
+                body = request.get("body") or {}
+                if body.get("stream"):
+                    return self.tokens(body.get("n", 3))
+                return {"text": "hello"}
+
+            def tokens(self, n):
+                for i in range(n):
+                    yield f"t{i} "
+
+        serve.run(Chat.bind())
+        addr = serve.proxy_address()
+        try:
+            r = urllib.request.urlopen(
+                f"{addr}/", data=json.dumps({}).encode(), timeout=30)
+            assert json.loads(r.read()) == {"text": "hello"}
+            req = urllib.request.Request(
+                f"{addr}/",
+                data=json.dumps({"stream": True, "n": 4}).encode())
+            r = urllib.request.urlopen(req, timeout=30)
+            assert r.read() == b"t0 t1 t2 t3 "
+            h = serve.get_app_handle()
+            out = list(h.options(method_name="tokens",
+                                 stream=True).remote(2))
+            assert out == ["t0 ", "t1 "]
+        finally:
+            serve.shutdown()
