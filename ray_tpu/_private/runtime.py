@@ -565,14 +565,32 @@ class Node:
             if st is None:
                 return
             count = st["count"]
-            if st["finished"]:
+            finished = st["finished"]
+            if finished:
                 self._gen_streams.pop(task_id.binary(), None)
             else:
                 st["abandoned"] = True
+        if not finished:
+            # Nobody will ever consume this stream: cancel the producer
+            # (an unbounded generator would otherwise run forever — e.g.
+            # a token stream whose HTTP client disconnected).
+            self._cancel_running_task(task_id)
         for i in range(consumed, count):
             oid = object_id_for_return(task_id, i)
             if self.gcs.objects.entry(oid) is not None:
                 self.gcs.objects.decref(oid)
+
+    def _cancel_running_task(self, task_id: TaskID) -> None:
+        self._cancel_requested.add(task_id.binary())
+        if self.scheduler.try_cancel(task_id):
+            return
+        for h in list(self.pool.workers.values()):
+            if task_id.binary() in h.running:
+                try:
+                    h.send(P.CANCEL_TASK, {"task_id": task_id})
+                except Exception:
+                    pass
+                return
 
     def _on_task_done(self, handle: WorkerHandle, payload: dict):
         task_id: TaskID = payload["task_id"]
@@ -590,10 +608,9 @@ class Node:
                 st.in_flight.discard(task_id.binary())
         error = payload.get("error")
         if spec.streaming:
-            if error is not None and spec.retry_exceptions and \
-                    self._retry_budget(spec):
-                self._resubmit(spec)
-                return
+            # Streaming tasks never retry: items already consumed can't
+            # be replayed coherently, so a failure terminates the stream
+            # with its error instead of re-running the generator.
             self._unpin_task_args(spec)
             self._finish_gen_stream(task_id, payload.get("streamed"),
                                     error)
@@ -822,12 +839,15 @@ class Node:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             self._unpin_task_args(spec)
             return
-        if self._retry_budget(spec):
+        # Streaming tasks are not retryable (consumed items can't be
+        # replayed coherently) — their worker death ends the stream.
+        if not spec.streaming and self._retry_budget(spec):
             self._resubmit(spec)
         else:
+            reason = "streams are not retryable" if spec.streaming \
+                else "retries exhausted"
             blob = serialization.dumps(WorkerCrashedError(
-                f"The worker running task {spec.name} died "
-                f"(retries exhausted)."))
+                f"The worker running task {spec.name} died ({reason})."))
             if spec.streaming:
                 self._finish_gen_stream(spec.task_id, None, blob)
             for rid in spec.return_ids:

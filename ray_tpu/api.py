@@ -375,6 +375,12 @@ class RemoteFunction:
         rt = state.current()
         opts = self._opts
         streaming = opts.get("num_returns") == "streaming"
+        if streaming and not hasattr(rt, "gen_wait"):
+            # GEN_ITEM messages route to the owner (driver); a worker
+            # could submit but never consume the stream.
+            raise ValueError(
+                'num_returns="streaming" is only supported from the '
+                "driver process in this build")
         num_returns = 0 if streaming else int(opts.get("num_returns", 1))
         task_id = TaskID.from_random()
         return_ids = [object_id_for_return(task_id, i)
@@ -461,6 +467,10 @@ class ActorHandle:
         meta = self._method_meta.get(method_name, {})
         nr_opt = opts.get("num_returns", meta.get("num_returns", 1))
         streaming = nr_opt == "streaming"
+        if streaming and not hasattr(rt, "gen_wait"):
+            raise ValueError(
+                'num_returns="streaming" is only supported from the '
+                "driver process in this build")
         num_returns = 0 if streaming else int(nr_opt)
         task_id = TaskID.from_random()
         return_ids = [object_id_for_return(task_id, i)

@@ -3,16 +3,21 @@
 Reference parity: rllib/ (SURVEY §2.5) — Algorithm/AlgorithmConfig,
 EnvRunnerGroup of sampling actors, a JAX Learner whose update is
 mesh-data-parallel (ICI gradient psum compiled by XLA instead of NCCL
-DDP), RLModule model abstraction, PPO + DQN algorithm families.
+DDP), RLModule model abstraction; PPO, DQN, SAC (continuous
+control), and IMPALA/APPO (V-trace off-policy correction) families.
 """
 from .algorithms.algorithm import Algorithm, AlgorithmConfig
 from .algorithms.dqn import DQN, DQNConfig
+from .algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig, vtrace
 from .algorithms.ppo import PPO, PPOConfig
+from .algorithms.sac import SAC, SACConfig
 from .core.learner import JaxLearner
-from .core.rl_module import DQNModule, PPOModule, RLModule
+from .core.rl_module import DQNModule, PPOModule, RLModule, SACModule
 from .env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from .utils.replay_buffers import ReplayBuffer
 
-__all__ = ["Algorithm", "AlgorithmConfig", "DQN", "DQNConfig", "DQNModule",
-           "EnvRunnerGroup", "JaxLearner", "PPO", "PPOConfig", "PPOModule",
-           "RLModule", "ReplayBuffer", "SingleAgentEnvRunner"]
+__all__ = ["APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "DQN",
+           "DQNConfig", "DQNModule", "EnvRunnerGroup", "IMPALA",
+           "IMPALAConfig", "JaxLearner", "PPO", "PPOConfig", "PPOModule",
+           "RLModule", "ReplayBuffer", "SAC", "SACConfig", "SACModule",
+           "SingleAgentEnvRunner", "vtrace"]

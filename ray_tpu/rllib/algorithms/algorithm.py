@@ -70,10 +70,16 @@ class AlgorithmConfig:
 
 
 def _env_dims(env_spec, env_config) -> tuple:
+    """(obs_dim, action_dim) — action_dim is `n` for discrete spaces,
+    the action vector length for continuous (Box) spaces."""
     from ..env.env_runner import _make_env
     env = _make_env(env_spec, env_config or {})
     obs_dim = int(np.prod(env.observation_space.shape))
-    num_actions = int(env.action_space.n)
+    space = env.action_space
+    if hasattr(space, "n"):
+        num_actions = int(space.n)
+    else:
+        num_actions = int(np.prod(space.shape))
     env.close()
     return obs_dim, num_actions
 
@@ -92,7 +98,8 @@ class Algorithm:
         self.env_runner_group = EnvRunnerGroup(
             config.env_spec, config.env_config, self.module,
             num_env_runners=config.num_env_runners, seed=config.seed)
-        self.env_runner_group.sync_weights(self.learner.get_weights())
+        if self.learner is not None:
+            self.env_runner_group.sync_weights(self.learner.get_weights())
 
     # subclass hooks
     def _build_module(self, obs_dim: int, num_actions: int):
@@ -100,6 +107,16 @@ class Algorithm:
 
     def _build_learner(self):
         raise NotImplementedError
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def _get_algo_state(self) -> Dict[str, Any]:
+        """Extra state beyond the learner's (subclass hook)."""
+        return {}
+
+    def _set_algo_state(self, state: Dict[str, Any]) -> None:
+        pass
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
@@ -128,7 +145,10 @@ class Algorithm:
         Algorithm.evaluate)."""
         from ..env.env_runner import _make_env
         env = _make_env(self.config.env_spec, self.config.env_config)
-        params = self.learner.get_weights()
+        from ..env.env_runner import unsquash_action
+
+        params = self.get_weights()
+        discrete = getattr(self.module, "discrete", True)
         returns = []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=10_000 + ep)
@@ -136,7 +156,9 @@ class Algorithm:
             while not done:
                 a = self.module.forward_inference(
                     params, np.asarray(obs, np.float32)[None])
-                obs, rew, term, trunc, _ = env.step(int(a[0]))
+                act = int(a[0]) if discrete else unsquash_action(
+                    np.asarray(a[0], np.float32), env.action_space)
+                obs, rew, term, trunc, _ = env.step(act)
                 total += float(rew)
                 done = term or trunc
             returns.append(total)
@@ -147,18 +169,22 @@ class Algorithm:
     def save(self, checkpoint_dir: str) -> str:
         os.makedirs(checkpoint_dir, exist_ok=True)
         with open(os.path.join(checkpoint_dir, "algorithm.pkl"), "wb") as f:
-            pickle.dump({"learner_state": self.learner.get_state(),
+            pickle.dump({"learner_state": self.learner.get_state()
+                         if self.learner is not None else None,
                          "iteration": self.iteration,
-                         "total_steps": self._total_steps}, f)
+                         "total_steps": self._total_steps,
+                         **self._get_algo_state()}, f)
         return checkpoint_dir
 
     def restore(self, checkpoint_dir: str):
         with open(os.path.join(checkpoint_dir, "algorithm.pkl"), "rb") as f:
             st = pickle.load(f)
-        self.learner.set_state(st["learner_state"])
+        if self.learner is not None and st.get("learner_state") is not None:
+            self.learner.set_state(st["learner_state"])
+        self._set_algo_state(st)
         self.iteration = st["iteration"]
         self._total_steps = st["total_steps"]
-        self.env_runner_group.sync_weights(self.learner.get_weights())
+        self.env_runner_group.sync_weights(self.get_weights())
 
     def stop(self):
         self.env_runner_group.stop()

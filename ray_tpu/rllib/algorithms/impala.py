@@ -1,0 +1,179 @@
+"""IMPALA — importance-weighted actor-learner with V-trace.
+
+Reference parity: rllib/algorithms/impala/ (impala.py, vtrace) and appo/
+(APPO = IMPALA + PPO-style clipping). The reference's async actor-learner
+queues collapse here into the standard EnvRunnerGroup fan-out: runners
+sample with a (possibly stale) behavior policy while the learner updates —
+V-trace corrects exactly that staleness, so the decoupling the reference
+gets from its aggregator/learner threads is preserved without them.
+"""
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.learner import JaxLearner
+from ..core.rl_module import PPOModule
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
+           terminateds, gamma: float, clip_rho: float = 1.0,
+           clip_c: float = 1.0):
+    """V-trace targets and policy-gradient advantages (IMPALA eq. 1-2).
+
+    All inputs [T] (time-major single trajectory fragment); returns
+    (vs [T], pg_advantages [T]). jax-traceable (lax.scan over reversed
+    time).
+    """
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_bar = jnp.minimum(rho, clip_rho)
+    c_bar = jnp.minimum(rho, clip_c)
+    nonterm = 1.0 - terminateds.astype(jnp.float32)
+    values_next = jnp.concatenate(
+        [values[1:], jnp.asarray([bootstrap_value])])
+    # Terminal steps bootstrap from 0, and corrections stop at episode
+    # boundaries.
+    values_next = values_next * nonterm
+    deltas = rho_bar * (rewards + gamma * values_next - values)
+
+    def scan_fn(acc, t):
+        delta, c, nt = t
+        acc = delta + gamma * nt * c * acc
+        return acc, acc
+
+    _, dv = jax.lax.scan(scan_fn, jnp.zeros(()),
+                         (deltas, c_bar, nonterm), reverse=True)
+    vs = values + dv
+    vs_next = jnp.concatenate([vs[1:], jnp.asarray([bootstrap_value])])
+    vs_next = vs_next * nonterm
+    pg_adv = rho_bar * (rewards + gamma * vs_next - values)
+    return vs, pg_adv
+
+
+def make_impala_loss(gamma: float, vf_coeff: float = 0.5,
+                     entropy_coeff: float = 0.01,
+                     clip_rho: float = 1.0, clip_c: float = 1.0):
+    def impala_loss(params, module, batch):
+        logits, values = module.apply(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None].astype(jnp.int32),
+            axis=-1)[:, 0]
+        vs, pg_adv = vtrace(
+            batch["action_logp"], jax.lax.stop_gradient(target_logp),
+            batch["rewards"], jax.lax.stop_gradient(values),
+            batch["bootstrap_value"], batch["terminateds"], gamma,
+            clip_rho, clip_c)
+        policy_loss = -jnp.mean(
+            target_logp * jax.lax.stop_gradient(pg_adv))
+        vf_loss = 0.5 * jnp.mean(
+            (values - jax.lax.stop_gradient(vs)) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_vtrace_adv": jnp.mean(pg_adv)}
+
+    return impala_loss
+
+
+class IMPALA(Algorithm):
+    def __init__(self, config):
+        super().__init__(config)
+        # Jitted once; a per-step lambda would retrace every iteration.
+        self._value_fn = jax.jit(
+            lambda p, o: self.module.apply(p, o)[1])
+
+    def _build_module(self, obs_dim, num_actions):
+        return PPOModule(obs_dim, num_actions, self.config.hidden)
+
+    def _build_learner(self):
+        cfg = self.config
+        return JaxLearner(
+            self.module,
+            make_impala_loss(
+                cfg.gamma,
+                vf_coeff=float(cfg.extra.get("vf_loss_coeff", 0.5)),
+                entropy_coeff=float(cfg.extra.get("entropy_coeff", 0.01)),
+                clip_rho=float(cfg.extra.get("vtrace_clip_rho", 1.0)),
+                clip_c=float(cfg.extra.get("vtrace_clip_c", 1.0))),
+            lr=cfg.lr, seed=cfg.seed)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        # Sample with the CURRENT weights as behavior policy, then run
+        # several updates on the same data — V-trace corrects the
+        # policy lag of the later epochs (the async-queue staleness of
+        # the reference, reproduced synchronously).
+        frags = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        stats: Dict = {}
+        value_fn = self._value_fn
+        for frag in frags:
+            self._total_steps += len(frag["rewards"])
+        for _ in range(int(cfg.extra.get("num_epochs", 2))):
+            for frag in frags:
+                last_next = jnp.asarray(
+                    frag["next_obs"][-1], jnp.float32)[None]
+                bootstrap = float(value_fn(
+                    self.learner.get_weights(), last_next)[0]) \
+                    if not frag["terminateds"][-1] else 0.0
+                batch = dict(frag)
+                batch["bootstrap_value"] = np.float32(bootstrap)
+                stats.update(self.learner.update(batch))
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+        return stats
+
+
+class IMPALAConfig(AlgorithmConfig):
+    ALGO_CLS = IMPALA
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.rollout_fragment_length = 256
+
+
+class APPO(IMPALA):
+    """APPO = IMPALA machinery + PPO-clip surrogate on the v-trace
+    advantages (reference: rllib/algorithms/appo/)."""
+
+    def _build_learner(self):
+        cfg = self.config
+        clip = float(cfg.extra.get("clip_param", 0.2))
+
+        def appo_loss(params, module, batch):
+            logits, values = module.apply(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            vs, pg_adv = vtrace(
+                batch["action_logp"],
+                jax.lax.stop_gradient(target_logp),
+                batch["rewards"], jax.lax.stop_gradient(values),
+                batch["bootstrap_value"], batch["terminateds"],
+                cfg.gamma)
+            ratio = jnp.exp(target_logp - batch["action_logp"])
+            adv = jax.lax.stop_gradient(pg_adv)
+            surrogate = jnp.minimum(
+                ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            policy_loss = -jnp.mean(surrogate)
+            vf_loss = 0.5 * jnp.mean(
+                (values - jax.lax.stop_gradient(vs)) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = policy_loss + \
+                float(cfg.extra.get("vf_loss_coeff", 0.5)) * vf_loss - \
+                float(cfg.extra.get("entropy_coeff", 0.01)) * entropy
+            return total, {"policy_loss": policy_loss,
+                           "vf_loss": vf_loss, "entropy": entropy}
+
+        return JaxLearner(self.module, appo_loss, lr=cfg.lr,
+                          seed=cfg.seed)
+
+
+class APPOConfig(IMPALAConfig):
+    ALGO_CLS = APPO

@@ -1,0 +1,153 @@
+"""SAC — Soft Actor-Critic for continuous control.
+
+Reference parity: rllib/algorithms/sac/ (sac.py, sac_learner,
+default SAC RLModule) — squashed-Gaussian actor, clipped twin-Q
+critics, entropy-regularized targets with auto-tuned temperature, and
+polyak-averaged target critics. All update math is one jitted step.
+"""
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.rl_module import SACModule
+from ..utils.replay_buffers import ReplayBuffer
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def make_sac_update(module: SACModule, gamma: float, lr: float,
+                    tau: float, target_entropy: float):
+    """One jitted SAC step over state = {params, target_q, log_alpha,
+    opt_state}; returns (state, metrics). Critic, actor, and temperature
+    losses combine with stop_gradients isolating each objective
+    (reference: sac_torch_learner compute_loss_for_module)."""
+    optimizer = optax.adam(lr)
+
+    def loss_fn(params, target_q, log_alpha, batch, key):
+        alpha = jnp.exp(log_alpha)
+        k1, k2 = jax.random.split(key)
+        # -- critic loss: entropy-regularized TD target from target nets
+        next_a, next_logp = module.sample_action(
+            params, batch["next_obs"], k1)
+        tq1, tq2 = module.q_net.apply({"params": target_q},
+                                      batch["next_obs"], next_a)
+        min_tq = jnp.minimum(tq1, tq2) - \
+            jax.lax.stop_gradient(alpha) * next_logp
+        nonterm = 1.0 - batch["terminateds"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(
+            batch["rewards"] + gamma * nonterm * min_tq)
+        q1, q2 = module.apply_q(params, batch["obs"], batch["actions"])
+        q_loss = jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+        # -- actor loss: maximize entropy-regularized Q via reparam
+        a, logp = module.sample_action(params, batch["obs"], k2)
+        pq1, pq2 = module.apply_q(
+            jax.lax.stop_gradient(params), batch["obs"], a)
+        actor_loss = jnp.mean(
+            jax.lax.stop_gradient(alpha) * logp - jnp.minimum(pq1, pq2))
+        # -- temperature loss: drive entropy toward target_entropy
+        alpha_loss = -jnp.mean(
+            log_alpha * jax.lax.stop_gradient(logp + target_entropy))
+        total = q_loss + actor_loss + alpha_loss
+        return total, {"q_loss": q_loss, "actor_loss": actor_loss,
+                       "alpha": alpha, "entropy": -jnp.mean(logp)}
+
+    def init_state(seed: int = 0):
+        params = module.init_params(seed)
+        return {
+            "params": params,
+            "target_q": jax.tree.map(lambda x: x, params["q"]),
+            "log_alpha": jnp.zeros((), jnp.float32),
+            "opt_state": optimizer.init(
+                {"params": params, "log_alpha": jnp.zeros(())}),
+        }
+
+    @jax.jit
+    def update(state, batch, key):
+        def wrapped(trainables):
+            return loss_fn(trainables["params"], state["target_q"],
+                           trainables["log_alpha"], batch, key)
+
+        trainables = {"params": state["params"],
+                      "log_alpha": state["log_alpha"]}
+        (_, metrics), grads = jax.value_and_grad(
+            wrapped, has_aux=True)(trainables)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], trainables)
+        trainables = optax.apply_updates(trainables, updates)
+        target_q = jax.tree.map(
+            lambda t, o: (1 - tau) * t + tau * o,
+            state["target_q"], trainables["params"]["q"])
+        return ({"params": trainables["params"], "target_q": target_q,
+                 "log_alpha": trainables["log_alpha"],
+                 "opt_state": opt_state}, metrics)
+
+    return init_state, update
+
+
+class SAC(Algorithm):
+    def __init__(self, config):
+        super().__init__(config)
+        cfg = config
+        self.buffer = ReplayBuffer(
+            int(cfg.extra.get("buffer_capacity", 100_000)), seed=cfg.seed)
+        target_entropy = float(
+            cfg.extra.get("target_entropy", -self.module.num_actions))
+        self._init_state, self._update = make_sac_update(
+            self.module, cfg.gamma, cfg.lr,
+            float(cfg.extra.get("tau", 0.005)), target_entropy)
+        self._state = self._init_state(cfg.seed)
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self.env_runner_group.sync_weights(self._state["params"])
+
+    def _build_module(self, obs_dim, num_actions):
+        return SACModule(obs_dim, num_actions, self.config.hidden)
+
+    def _build_learner(self):
+        return None  # SAC owns its jitted update (twin nets + alpha)
+
+    # Algorithm base expects learner-backed weights; override the points
+    # that touch it.
+    def get_weights(self):
+        return self._state["params"]
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        for frag in self.env_runner_group.sample(
+                cfg.rollout_fragment_length):
+            self.buffer.add_batch(frag)
+            self._total_steps += len(frag["rewards"])
+        stats: Dict = {}
+        warmup = int(cfg.extra.get("learning_starts", 1000))
+        if len(self.buffer) >= max(warmup, cfg.train_batch_size):
+            for _ in range(int(cfg.extra.get("updates_per_iter", 16))):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                batch = {k: jnp.asarray(v) for k, v in batch.items()
+                         if k in ("obs", "actions", "rewards",
+                                  "terminateds", "next_obs")}
+                self._key, sub = jax.random.split(self._key)
+                self._state, metrics = self._update(
+                    self._state, batch, sub)
+            stats.update({k: float(v) for k, v in metrics.items()})
+        self.env_runner_group.sync_weights(self._state["params"])
+        return stats
+
+    # -- checkpointing (Algorithm's learner-based paths bypassed) ----------
+    def _get_algo_state(self):
+        return {"sac_state": jax.tree.map(np.asarray, self._state)}
+
+    def _set_algo_state(self, state):
+        if "sac_state" in state:
+            self._state = jax.tree.map(jnp.asarray, state["sac_state"])
+            self.env_runner_group.sync_weights(self._state["params"])
+
+
+class SACConfig(AlgorithmConfig):
+    ALGO_CLS = SAC
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.rollout_fragment_length = 100

@@ -43,25 +43,32 @@ class JaxLearner:
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss, aux
 
-        if self._mesh is None:
-            return jax.jit(step)
-        from jax.sharding import NamedSharding, PartitionSpec as Ps
-        mesh = self._mesh
-        repl = NamedSharding(mesh, Ps())
-        data = NamedSharding(mesh, Ps("dp"))
-        # Params replicated, batch sharded on the dp axis: XLA emits the
-        # gradient all-reduce (the NCCL allreduce of torch_learner.py,
-        # compiled into the program instead of called by the framework).
-        return jax.jit(step, in_shardings=(repl, repl, data),
-                       out_shardings=(repl, repl, repl, repl))
+        # Params replicated, batch sharded on the dp axis (see
+        # _device_batch): XLA emits the gradient all-reduce (the NCCL
+        # allreduce of torch_learner.py, compiled into the program
+        # instead of called by the framework). Shardings ride on the
+        # operands, so one jit serves both mesh and single-device runs.
+        return jax.jit(step)
 
     def _device_batch(self, batch: Dict[str, np.ndarray]):
-        n = len(next(iter(batch.values())))
-        if self._mesh is not None:
-            d = self._mesh.devices.size
-            m = (n // d) * d   # drop ragged tail so shards are equal
-            batch = {k: v[:m] for k, v in batch.items()}
-        return {k: jnp.asarray(v) for k, v in batch.items()}
+        if self._mesh is None:
+            return {k: jnp.asarray(v) for k, v in batch.items()}
+        from jax.sharding import NamedSharding, PartitionSpec as Ps
+        repl = NamedSharding(self._mesh, Ps())
+        data = NamedSharding(self._mesh, Ps("dp"))
+        d = self._mesh.devices.size
+        lead = max((getattr(v, "shape", ())or (0,))[0]
+                   if getattr(v, "ndim", 0) else 0
+                   for v in batch.values())
+        m = (lead // d) * d   # drop ragged tail so shards are equal
+        out = {}
+        for k, v in batch.items():
+            if getattr(v, "ndim", 0) == 0:
+                # Scalars (e.g. bootstrap values) replicate.
+                out[k] = jax.device_put(jnp.asarray(v), repl)
+            else:
+                out[k] = jax.device_put(jnp.asarray(v[:m]), data)
+        return out
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         db = self._device_batch(batch)

@@ -50,8 +50,37 @@ class QNet(nn.Module):
         return nn.Dense(self.num_actions)(z)
 
 
+class GaussianActorNet(nn.Module):
+    """Squashed-Gaussian policy head (SAC-style): mean + log_std."""
+    action_dim: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        z = MLPEncoder(self.hidden)(obs)
+        mean = nn.Dense(self.action_dim)(z)
+        log_std = jnp.clip(nn.Dense(self.action_dim)(z), -10.0, 2.0)
+        return mean, log_std
+
+
+class TwinQNet(nn.Module):
+    """Two independent Q(s, a) critics (clipped double-Q, SAC/TD3)."""
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        q1 = jnp.squeeze(nn.Dense(1)(MLPEncoder(self.hidden)(x)), -1)
+        q2 = jnp.squeeze(nn.Dense(1)(MLPEncoder(self.hidden)(x)), -1)
+        return q1, q2
+
+
 class RLModule:
     """Reference: rl_module.py:260. Stateless apply + explicit params."""
+
+    # Discrete action space by default; continuous modules (SAC) set
+    # False so env runners pass float action vectors to env.step.
+    discrete = True
 
     def __init__(self, obs_dim: int, num_actions: int,
                  hidden: Sequence[int] = (64, 64)):
@@ -102,6 +131,66 @@ class PPOModule(RLModule):
         actions = np.array([rng.choice(self.num_actions, p=pi) for pi in p])
         logp = np.log(p[np.arange(len(actions)), actions] + 1e-12)
         return actions, {"vf_preds": value, "action_logp": logp}
+
+
+class SACModule(RLModule):
+    """Squashed-Gaussian actor + twin critics (reference:
+    rllib/algorithms/sac default module). Params pytree:
+    {"actor": ..., "q": ...}; actions squashed to [-1, 1] via tanh
+    (callers scale to the env's action bounds)."""
+
+    discrete = False
+
+    def _build_net(self):
+        return GaussianActorNet(self.num_actions, self.hidden)
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        super().__init__(obs_dim, num_actions, hidden)
+        self.q_net = TwinQNet(self.hidden)
+
+    def init_params(self, seed: int = 0):
+        ka, kq = jax.random.split(jax.random.PRNGKey(seed))
+        dummy_obs = jnp.zeros((1, self.obs_dim), jnp.float32)
+        dummy_act = jnp.zeros((1, self.num_actions), jnp.float32)
+        return {
+            "actor": self.net.init(ka, dummy_obs)["params"],
+            "q": self.q_net.init(kq, dummy_obs, dummy_act)["params"],
+        }
+
+    def apply_actor(self, params, obs):
+        return self.net.apply({"params": params["actor"]}, obs)
+
+    def apply_q(self, params, obs, action):
+        return self.q_net.apply({"params": params["q"]}, obs, action)
+
+    def apply(self, params, obs):
+        return self.apply_actor(params, obs)
+
+    def sample_action(self, params, obs, key):
+        """Reparameterized squashed-Gaussian sample -> (action, logp)."""
+        mean, log_std = self.apply_actor(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        action = jnp.tanh(pre)
+        # log prob with tanh change-of-variables (SAC appendix C)
+        logp = jnp.sum(
+            -0.5 * (eps ** 2) - log_std - 0.5 * jnp.log(2 * jnp.pi)
+            - jnp.log(1 - action ** 2 + 1e-6), axis=-1)
+        return action, logp
+
+    def forward_inference(self, params, obs):
+        mean, _ = self.apply_actor(params, jnp.asarray(obs))
+        return np.asarray(jnp.tanh(mean))
+
+    def forward_exploration(self, params, obs, rng, **kw):
+        key = jax.random.PRNGKey(int(rng.integers(0, 2 ** 31)))
+        action, _ = self.sample_action(params, jnp.asarray(obs), key)
+        return np.asarray(action), {}
+
+    def __reduce__(self):
+        return (type(self), (self.obs_dim, self.num_actions, self.hidden))
 
 
 class DQNModule(RLModule):

@@ -23,6 +23,18 @@ def _make_env(env_spec: Union[str, Callable], env_config: Dict):
     return gym.make(env_spec, **env_config)
 
 
+def unsquash_action(action: np.ndarray, space) -> np.ndarray:
+    """Rescale a tanh-squashed [-1, 1] action to `space`'s Box bounds
+    (reference: connector action unsquashing, rllib/connectors).
+    Unbounded/discrete spaces pass through unchanged."""
+    low = getattr(space, "low", None)
+    if low is None or not np.all(np.isfinite(low)):
+        return action
+    high = np.asarray(space.high, np.float32)
+    low = np.asarray(low, np.float32)
+    return low + (action + 1.0) / 2.0 * (high - low)
+
+
 class SingleAgentEnvRunner:
     """Reference: single_agent_env_runner.py:65."""
 
@@ -57,8 +69,14 @@ class SingleAgentEnvRunner:
             else:
                 action, info = self.module.forward_inference(
                     self.params, obs_b), {}
-            a = int(action[0])
-            nxt, rew, term, trunc, _ = self.env.step(a)
+            if getattr(self.module, "discrete", True):
+                a = env_a = int(action[0])
+            else:
+                # The BATCH keeps the squashed action (what the critic
+                # sees); the env gets the unsquashed one.
+                a = np.asarray(action[0], np.float32)
+                env_a = unsquash_action(a, self.env.action_space)
+            nxt, rew, term, trunc, _ = self.env.step(env_a)
             cols["obs"].append(np.asarray(self._obs, np.float32))
             cols["actions"].append(a)
             cols["rewards"].append(float(rew))
