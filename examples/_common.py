@@ -1,0 +1,19 @@
+"""Shared example plumbing."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def respect_jax_platform_env():
+    """Pin jax to $JAX_PLATFORMS when set to cpu — images whose
+    sitecustomize force-registers a TPU plugin override the env var, so
+    the pin must go through jax.config before backend init."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass

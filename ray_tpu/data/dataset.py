@@ -87,13 +87,18 @@ def _apply_rows(blk: B.Block, fn, kind) -> B.Block:
     return B.block_from_rows(rows_out)
 
 
-@api.remote
+@api.remote(num_cpus=0)
 def _concat_blocks(*blks: B.Block) -> B.Block:
+    # num_cpus=0 for the same reason as _slice_block below: repartition
+    # must stay schedulable under a fully-reserved cluster.
     return B.block_concat(list(blks))
 
 
-@api.remote
+@api.remote(num_cpus=0)
 def _slice_block(blk: B.Block, start: int, end: int) -> B.Block:
+    """num_cpus=0: slicing is a metadata-sized copy, and repartition
+    must stay schedulable even when long-lived actors (a train gang)
+    hold every CPU — otherwise splits starve on small clusters."""
     return B.block_slice(blk, start, end)
 
 

@@ -25,7 +25,8 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ... import api
-from ...exceptions import ActorDiedError, RayError, TaskError
+from ...exceptions import (ActorDiedError, RayError, TaskError,
+                           TaskUnschedulableError)
 from ..checkpoint import Checkpoint, CheckpointManager
 from ..session import TrainContext
 from ..worker_group import WorkerGroup
@@ -121,6 +122,28 @@ class TrainController:
     def _start_worker_group(self):
         decision: ResizeDecision = \
             self._scaling_policy.make_decision_for_new_group()
+        # Fail fast on a gang the cluster can never hold (reference:
+        # infeasible-demand surfacing; without this the setup just
+        # times out with no diagnosis). Straight to ERRORED: retrying an
+        # infeasible fixed-size gang can never succeed, and routing it
+        # through the failure policy would hot-spin under max_failures=-1.
+        totals = api.cluster_resources()
+        demand = {k: v * decision.num_workers
+                  for k, v in decision.resources_per_worker.items()}
+        infeasible = {k: v for k, v in demand.items()
+                      if v > totals.get(k, 0.0) + 1e-9}
+        if infeasible:
+            self._error = TaskUnschedulableError(
+                f"Worker group of {decision.num_workers} needs "
+                f"{demand}, exceeding cluster totals "
+                f"{ {k: totals.get(k, 0.0) for k in demand} }. Reduce "
+                f"num_workers/resources_per_worker or add nodes.")
+            self._set_state(TrainControllerState.ERRORED)
+            return
+        # Materialize dataset shards BEFORE the gang reserves its
+        # resources: split/repartition tasks need cluster CPU, and on a
+        # small cluster a fully-reserved gang starves them forever.
+        dataset_shards = self._split_datasets(decision.num_workers)
         group = WorkerGroup(decision.num_workers,
                             decision.resources_per_worker)
         uid = uuid.uuid4().hex[:8]
@@ -136,7 +159,7 @@ class TrainController:
         try:
             group.setup(make_context, self._backend_config,
                         self._restore or self._manager.latest,
-                        self._split_datasets(decision.num_workers))
+                        dataset_shards)
             self._run_refs = group.run(self._train_fn,
                                        self._train_fn_config)
         except (ActorDiedError, TaskError, RayError, TimeoutError) as e:
