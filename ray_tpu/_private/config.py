@@ -1,0 +1,97 @@
+"""Runtime configuration (reference: the RAY_CONFIG flag system —
+src/ray/common/ray_config_def.h, 221 `RAY_CONFIG(type, name, default)`
+entries overridable via `RAY_<name>` env vars, mirrored to Python
+through includes/ray_config.pxi; SURVEY.md §5 config tiers).
+
+Every entry is overridable via `RAY_TPU_<NAME>` (upper-cased) in the
+environment of the process that starts the runtime. Booleans accept
+0/1/true/false. Access through the singleton:
+
+    from ray_tpu._private.config import ray_config
+    ray_config.inline_object_max_bytes
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+
+def _coerce(value: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    return type(default)(value)
+
+
+class RayConfig:
+    """Typed, env-overridable runtime knobs (one instance per process).
+
+    Defaults here are the single source of truth for magic numbers the
+    runtime used to hard-code.
+    """
+
+    _DEFAULTS: Dict[str, Any] = {
+        # objects below this size ride inline in control messages
+        # (reference: max_direct_call_object_size)
+        "inline_object_max_bytes": 100 * 1024,
+        # object store capacity as a fraction of /dev/shm when not set
+        # explicitly (reference: object_store_memory default 30%)
+        "object_store_memory_fraction": 0.5,
+        # worker boot: seconds to wait for the process to connect
+        "worker_register_timeout_s": 60.0,
+        # task event log cap (reference: task_events_max_num... family)
+        "max_task_events": 10_000,
+        # tracing span store cap
+        "max_spans": 20_000,
+        # default task max_retries (reference: task_retry defaults)
+        "default_task_max_retries": 3,
+        # freed-object release broadcast coalescing window
+        "release_broadcast_delay_s": 0.002,
+        # session dir GC age threshold
+        "session_gc_max_age_s": 6 * 3600.0,
+        # client server default port
+        "client_server_port": 10001,
+        # dashboard default port (reference: 8265)
+        "dashboard_port": 8265,
+        # usage/telemetry opt-out (reference: RAY_USAGE_STATS_ENABLED)
+        "usage_stats_enabled": False,
+    }
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[str, Any] = {}
+        for name, default in self._DEFAULTS.items():
+            var = f"RAY_TPU_{name.upper()}"
+            env = os.environ.get(var)
+            if env is not None:
+                try:
+                    self._values[name] = _coerce(env, default)
+                    continue
+                except (ValueError, TypeError):
+                    import warnings
+                    warnings.warn(
+                        f"Ignoring malformed {var}={env!r} (expected "
+                        f"{type(default).__name__}); using default "
+                        f"{default!r}.", stacklevel=2)
+            self._values[name] = default
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(f"no config entry {name!r}") from None
+
+    def set(self, name: str, value: Any) -> None:
+        """Programmatic override (tests)."""
+        with self._lock:
+            if name not in self._DEFAULTS:
+                raise KeyError(f"unknown config entry {name!r}")
+            self._values[name] = value
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._values)
+
+
+ray_config = RayConfig()

@@ -319,13 +319,14 @@ class Gcs:
         self.start_time = time.time()
         self.node_id_hex = None  # filled by Node
         # Task event log for state API / timeline (reference: GcsTaskManager)
+        from .config import ray_config
         self._task_events: List[dict] = []
         self._task_events_lock = threading.Lock()
-        self.max_task_events = 10000
+        self.max_task_events = int(ray_config.max_task_events)
         # Tracing spans (reference: OpenTelemetry spans buffered per core
         # worker, flushed to the GCS task-event store; SURVEY.md §5)
         self._spans: List[dict] = []
-        self.max_spans = 20000
+        self.max_spans = int(ray_config.max_spans)
 
     def record_task_event(self, event: dict):
         with self._task_events_lock:

@@ -26,18 +26,29 @@ from ..exceptions import ObjectStoreFullError
 from . import serialization
 from .ids import ObjectID
 
+from .config import ray_config
+
 # Objects at or below this size are kept inline in the owner's memory store
 # and shipped inside control messages, like the reference's in-memory store
 # for inlined small returns (core_worker/store_provider/memory_store).
-INLINE_THRESHOLD = 100 * 1024
+# Overridable via RAY_TPU_INLINE_OBJECT_MAX_BYTES or, at runtime,
+# ray_config.set("inline_object_max_bytes", ...) — call sites read
+# through inline_threshold() so programmatic overrides take effect.
+INLINE_THRESHOLD = int(ray_config.inline_object_max_bytes)
+
+
+def inline_threshold() -> int:
+    return int(ray_config.inline_object_max_bytes)
 
 
 def _default_capacity() -> int:
-    """Default store capacity: 30% of /dev/shm (reference defaults plasma to
-    30% of system memory, ray_config_def.h object_store_memory)."""
+    """Default store capacity: a fraction of /dev/shm (reference defaults
+    plasma to 30% of system memory, ray_config_def.h object_store_memory;
+    RAY_TPU_OBJECT_STORE_MEMORY_FRACTION overrides)."""
     try:
         st = os.statvfs("/dev/shm")
-        return int(st.f_bsize * st.f_bavail * 0.5)
+        return int(st.f_bsize * st.f_bavail
+                   * float(ray_config.object_store_memory_fraction))
     except OSError:
         return 2 << 30
 

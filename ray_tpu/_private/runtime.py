@@ -32,17 +32,20 @@ from . import gcs as gcs_mod
 from . import protocol as P
 from . import serialization
 from .ids import ActorID, NodeID, ObjectID, TaskID
-from .object_store import INLINE_THRESHOLD, ObjectStore, create_store
+from .object_store import ObjectStore, create_store, inline_threshold
 from .resources import detect_node_resources
 from .scheduler import ResourceManager, Scheduler, WorkerHandle, WorkerPool
 
 
-def _gc_stale_sessions(max_age_s: float = 6 * 3600):
+def _gc_stale_sessions(max_age_s: Optional[float] = None):
     """Sweep shm/session dirs left by crashed runs (reference: ray's session
     dir GC in _private/utils.py). Only removes dirs older than `max_age_s`
     so concurrent live sessions are untouched."""
     import glob
     import shutil
+    if max_age_s is None:
+        from .config import ray_config
+        max_age_s = float(ray_config.session_gc_max_age_s)
     now = time.time()
     for d in glob.glob("/dev/shm/ray_tpu_session_*") + glob.glob(
             "/tmp/ray_tpu_sessions/session_*"):
@@ -190,7 +193,7 @@ class Node:
     def put(self, value: Any) -> ObjectID:
         oid = ObjectID.from_random()
         sobj = serialization.serialize(value)
-        if sobj.total_size <= INLINE_THRESHOLD:
+        if sobj.total_size <= inline_threshold():
             self.gcs.objects.register_ready(
                 oid, (P.LOC_INLINE, sobj.to_bytes()), sobj.total_size)
         else:
@@ -370,7 +373,8 @@ class Node:
                 self._handler_pool.submit(self._broadcast_releases)
 
     def _broadcast_releases(self):
-        time.sleep(0.002)  # let a burst accumulate
+        from .config import ray_config
+        time.sleep(float(ray_config.release_broadcast_delay_s))
         with self._release_lock:
             batch, self._release_buf = self._release_buf, []
         if not batch:

@@ -309,7 +309,9 @@ class WorkerPool:
         import time as _time
         listener._listener._socket.settimeout(0.5)
         conn = None
-        deadline = _time.monotonic() + 60.0
+        from .config import ray_config
+        boot_timeout = float(ray_config.worker_register_timeout_s)
+        deadline = _time.monotonic() + boot_timeout
         while conn is None:
             try:
                 conn = listener.accept()
@@ -323,7 +325,8 @@ class WorkerPool:
                     proc.terminate()
                     listener.close()
                     raise RuntimeError(
-                        "worker process failed to connect within 60s")
+                        f"worker process failed to connect within "
+                        f"{boot_timeout:g}s")
         listener.close()
         try:
             os.unlink(address)

@@ -1,0 +1,62 @@
+"""Usage/telemetry stub (reference: _private/usage/usage_lib.py — opt-out
+usage reporting; SURVEY.md §2.2).
+
+This build collects the same shape of usage record but NEVER transmits
+it (zero-egress environments are the norm for TPU pods); the record is
+written into the session's local KV for operators who want it, and the
+`usage_stats_enabled` config (default False, i.e. reporting off)
+preserves the reference's opt-out surface.
+"""
+
+from __future__ import annotations
+
+import json
+import platform
+import time
+from typing import Any, Dict
+
+from .config import ray_config
+
+_KV_NS = "usage_stats"
+
+
+def usage_stats_enabled() -> bool:
+    return bool(ray_config.usage_stats_enabled)
+
+
+def build_usage_record() -> Dict[str, Any]:
+    from .. import __version__
+
+    record = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "version": __version__,
+        "python_version": platform.python_version(),
+        "os": platform.system().lower(),
+        "collected_at": time.time(),
+    }
+    try:
+        from . import state
+
+        rt = state.current_or_none()
+        if rt is not None:
+            record["total_resources"] = rt.cluster_resources()
+    except Exception:
+        pass
+    return record
+
+
+def record_usage() -> Dict[str, Any]:
+    """Store the record locally (never transmitted)."""
+    record = build_usage_record()
+    try:
+        from . import state
+
+        rt = state.current_or_none()
+        if rt is not None:
+            rt.gcs_request("kv_put", key="latest",
+                           value=json.dumps(record).encode(),
+                           namespace=_KV_NS)
+    except Exception:
+        pass
+    return record

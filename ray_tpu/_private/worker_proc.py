@@ -39,7 +39,7 @@ from ..exceptions import TaskCancelledError, TaskError
 from . import protocol as P
 from . import serialization
 from .ids import ActorID, ObjectID, TaskID
-from .object_store import INLINE_THRESHOLD, ObjectStore, create_store
+from .object_store import ObjectStore, create_store, inline_threshold
 
 
 # Per-thread currently-executing task spec (reference: the worker's
@@ -86,7 +86,7 @@ class WorkerClient:
         oid = ObjectID.from_random()
         with serialization.collect_object_refs() as nested:
             sobj = serialization.serialize(value)
-        if sobj.total_size <= INLINE_THRESHOLD:
+        if sobj.total_size <= inline_threshold():
             self._request(P.OWNED_PUT, {"object_id": oid,
                                         "inline": sobj.to_bytes(),
                                         "nested": list(nested)})
@@ -225,7 +225,7 @@ class Worker:
             with serialization.collect_object_refs() as nested:
                 sobj = serialization.serialize(value)
             nested_per_return.append(list(nested))
-            if sobj.total_size <= INLINE_THRESHOLD:
+            if sobj.total_size <= inline_threshold():
                 locs.append((P.LOC_INLINE, sobj.to_bytes()))
             else:
                 size = self.store.put_serialized(oid, sobj)
@@ -246,7 +246,7 @@ class Worker:
             oid = object_id_for_return(spec.task_id, index)
             with serialization.collect_object_refs() as nested:
                 sobj = serialization.serialize(item)
-            if sobj.total_size <= INLINE_THRESHOLD:
+            if sobj.total_size <= inline_threshold():
                 loc = (P.LOC_INLINE, sobj.to_bytes())
             else:
                 size = self.store.put_serialized(oid, sobj)

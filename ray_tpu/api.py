@@ -299,6 +299,11 @@ class ObjectRefGenerator:
         return f"ObjectRefGenerator({self._task_id.hex()})"
 
 
+def _config():
+    from ._private.config import ray_config
+    return ray_config
+
+
 _tracing_mod = None
 
 
@@ -393,7 +398,8 @@ class RemoteFunction:
             args=s_args, kwargs=s_kwargs, return_ids=return_ids,
             num_returns=num_returns, name=opts.get("name", self.__name__),
             resources=resources, streaming=streaming,
-            max_retries=int(opts.get("max_retries", 3)),
+            max_retries=int(opts.get(
+                "max_retries", _config().default_task_max_retries)),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_index,

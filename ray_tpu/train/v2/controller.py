@@ -133,17 +133,27 @@ class TrainController:
         infeasible = {k: v for k, v in demand.items()
                       if v > totals.get(k, 0.0) + 1e-9}
         if infeasible:
-            self._error = TaskUnschedulableError(
+            # Routed through the failure policy: an autoscaler may grow
+            # totals, and elastic recovery may be mid-rejoin — with
+            # retries enabled this becomes a paced wait for capacity
+            # (the sleep prevents a hot spin under max_failures=-1);
+            # with the default max_failures=0 it surfaces immediately.
+            time.sleep(max(self._poll_interval_s, 1.0))
+            self._handle_failure(TaskUnschedulableError(
                 f"Worker group of {decision.num_workers} needs "
-                f"{demand}, exceeding cluster totals "
+                f"{demand}, exceeding current cluster totals "
                 f"{ {k: totals.get(k, 0.0) for k in demand} }. Reduce "
-                f"num_workers/resources_per_worker or add nodes.")
-            self._set_state(TrainControllerState.ERRORED)
+                f"num_workers/resources_per_worker or add nodes."))
             return
         # Materialize dataset shards BEFORE the gang reserves its
         # resources: split/repartition tasks need cluster CPU, and on a
         # small cluster a fully-reserved gang starves them forever.
-        dataset_shards = self._split_datasets(decision.num_workers)
+        # Split failures are gang failures: route through the policy.
+        try:
+            dataset_shards = self._split_datasets(decision.num_workers)
+        except (ActorDiedError, TaskError, RayError, TimeoutError) as e:
+            self._handle_failure(e)
+            return
         group = WorkerGroup(decision.num_workers,
                             decision.resources_per_worker)
         uid = uuid.uuid4().hex[:8]

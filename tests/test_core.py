@@ -399,3 +399,48 @@ class TestRuntimeContext:
         ctx = ray_tpu.get_runtime_context()
         assert ctx.is_initialized
         assert len(ctx.get_node_id()) == 32
+
+
+class TestRayConfig:
+    """Config/flag system (reference: RAY_CONFIG env-overridable entries,
+    src/ray/common/ray_config_def.h; SURVEY.md §5)."""
+
+    def test_defaults_and_override(self):
+        import os
+        import subprocess
+        import sys
+
+        from ray_tpu._private.config import ray_config
+
+        if "RAY_TPU_INLINE_OBJECT_MAX_BYTES" not in os.environ:
+            assert ray_config.inline_object_max_bytes == 100 * 1024
+        assert ray_config.default_task_max_retries >= 0
+        # env override takes effect at process start
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from ray_tpu._private.config import ray_config;"
+             "print(ray_config.inline_object_max_bytes)"],
+            env={**os.environ,
+                 "RAY_TPU_INLINE_OBJECT_MAX_BYTES": "4096"},
+            capture_output=True, text=True, cwd=repo_root)
+        assert out.stdout.strip() == "4096", out.stderr
+
+    def test_unknown_entry_raises(self):
+        import pytest
+
+        from ray_tpu._private.config import ray_config
+
+        with pytest.raises(AttributeError):
+            ray_config.nonexistent_flag
+        with pytest.raises(KeyError):
+            ray_config.set("nonexistent_flag", 1)
+
+    def test_usage_stub(self):
+        from ray_tpu._private import usage
+
+        assert usage.usage_stats_enabled() is False  # opt-out default
+        record = usage.build_usage_record()
+        assert record["source"] == "ray_tpu"
+        assert "version" in record
