@@ -28,16 +28,14 @@ from .ids import ObjectID
 
 from .config import ray_config
 
-# Objects at or below this size are kept inline in the owner's memory store
-# and shipped inside control messages, like the reference's in-memory store
-# for inlined small returns (core_worker/store_provider/memory_store).
-# Overridable via RAY_TPU_INLINE_OBJECT_MAX_BYTES or, at runtime,
-# ray_config.set("inline_object_max_bytes", ...) — call sites read
-# through inline_threshold() so programmatic overrides take effect.
-INLINE_THRESHOLD = int(ray_config.inline_object_max_bytes)
-
-
 def inline_threshold() -> int:
+    """Objects at or below this size are kept inline in the owner's
+    memory store and shipped inside control messages, like the
+    reference's in-memory store for inlined small returns
+    (core_worker/store_provider/memory_store). Overridable via
+    RAY_TPU_INLINE_OBJECT_MAX_BYTES or ray_config.set(
+    "inline_object_max_bytes", ...) — read per call so runtime
+    overrides take effect."""
     return int(ray_config.inline_object_max_bytes)
 
 

@@ -47,8 +47,12 @@ def build_usage_record() -> Dict[str, Any]:
 
 
 def record_usage() -> Dict[str, Any]:
-    """Store the record locally (never transmitted)."""
+    """Store the record locally (never transmitted). The opt-out flag
+    gates persistence: disabled (the default) builds but does not
+    store."""
     record = build_usage_record()
+    if not usage_stats_enabled():
+        return record
     try:
         from . import state
 

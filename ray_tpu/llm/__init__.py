@@ -12,7 +12,9 @@ with power-of-two padding so XLA compiles a few bucket shapes
 from .batch import (ChatTemplateStage, DetokenizeStage, GPTInferenceStage,
                     HttpRequestStage, Processor, ProcessorConfig,
                     TokenizeStage, build_processor)
+from .serving import ByteTokenizer, LLMEngine, build_llm_app
 
-__all__ = ["ChatTemplateStage", "DetokenizeStage", "GPTInferenceStage",
-           "HttpRequestStage", "Processor", "ProcessorConfig",
-           "TokenizeStage", "build_processor"]
+__all__ = ["ByteTokenizer", "ChatTemplateStage", "DetokenizeStage",
+           "GPTInferenceStage", "HttpRequestStage", "LLMEngine",
+           "Processor", "ProcessorConfig", "TokenizeStage",
+           "build_llm_app", "build_processor"]

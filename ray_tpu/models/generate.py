@@ -1,0 +1,175 @@
+"""Autoregressive generation with a KV cache (GPT family).
+
+Parity role: the reference serves LLMs by hosting external engines
+(vLLM etc.) on its actors; here the decode path is native — a
+fixed-shape KV cache (static shapes: one XLA compile for prefill per
+prompt bucket, one for the single-token decode step), rotary offsets per
+position, fp32 logits. The serving layer (llm.serving) drives these
+jitted steps and streams tokens through Serve.
+
+Cache layout: per layer {"k"|"v": [batch, heads, max_len, head_dim]}.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import DEFAULT_MASK_VALUE
+from ..ops.layers import rms_norm, rope
+from .gpt import GPTConfig
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> List[Dict]:
+    h, hd = cfg.n_heads, cfg.head_dim
+    return [
+        {"k": jnp.zeros((batch, h, max_len, hd), cfg.dtype),
+         "v": jnp.zeros((batch, h, max_len, hd), cfg.dtype)}
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def _cached_block(x, layer, cache_layer, start_pos, cfg: GPTConfig):
+    """One transformer block reading/writing the KV cache.
+
+    x: [b, L, d] at absolute positions [start_pos, start_pos + L).
+    Returns (x_out, new_cache_layer).
+    """
+    b, L, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    max_len = cache_layer["k"].shape[-2]
+
+    y = rms_norm(x, layer["ln1"])
+    qkv = jnp.einsum("bsd,de->bse", y, layer["wqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, L, h, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, L, h, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, L, h, hd).transpose(0, 2, 1, 3)
+    # Rotary embeddings at absolute positions. rope() derives offset
+    # angles statically, so shift by slicing a statically-longer table:
+    # here we compute angles dynamically for the window instead.
+    q = _rope_at(q, start_pos)
+    k = _rope_at(k, start_pos)
+
+    k_cache = jax.lax.dynamic_update_slice(
+        cache_layer["k"], k.astype(cache_layer["k"].dtype),
+        (0, 0, start_pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        cache_layer["v"], v.astype(cache_layer["v"].dtype),
+        (0, 0, start_pos, 0))
+
+    scale = hd ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    q_pos = start_pos + jax.lax.broadcasted_iota(
+        jnp.int32, (L, max_len), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (L, max_len), 1)
+    s = jnp.where((k_pos <= q_pos)[None, None], s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_cache.dtype),
+                      v_cache)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, L, d)
+    x = x + jnp.einsum("bsd,de->bse", attn, layer["wo"])
+    y = rms_norm(x, layer["ln2"])
+    hidden = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, layer["w1"]))
+    x = x + jnp.einsum("bsf,fd->bsd", hidden, layer["w2"])
+    return x, {"k": k_cache, "v": v_cache}
+
+
+def _rope_at(x, start_pos, base: float = 10000.0):
+    """Rotary embedding for [b, h, L, hd] at absolute offset start_pos
+    (traced-value-safe, unlike ops.layers.rope's static offset)."""
+    b, h, L, hd = x.shape
+    pos = start_pos + jnp.arange(L, dtype=jnp.float32)
+    inv_freq = 1.0 / (base ** (
+        jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = pos[:, None] * inv_freq[None, :]
+    cos = jnp.cos(angles)[None, None]
+    sin = jnp.sin(angles)[None, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def cached_forward(params: Dict, tokens, cache: List[Dict],
+                   start_pos, cfg: GPTConfig
+                   ) -> Tuple[jnp.ndarray, List[Dict]]:
+    """Forward over `tokens` [b, L] at absolute offset start_pos using
+    (and updating) the cache. Returns (logits [b, L, vocab] fp32,
+    new_cache)."""
+    x = jnp.take(params["embed"], tokens, axis=0)
+    new_cache = []
+    for layer, cache_layer in zip(params["layers"], cache):
+        x, cl = _cached_block(x, layer, cache_layer, start_pos, cfg)
+        new_cache.append(cl)
+    x = rms_norm(x, params["lnf"])
+    head = params.get("head")
+    if head is None:
+        head = params["embed"].T
+    return (jnp.einsum("bsd,dv->bsv", x, head).astype(jnp.float32),
+            new_cache)
+
+
+def make_generate_fns(cfg: GPTConfig, max_len: int):
+    """(prefill, decode_step) jitted with donated caches.
+
+    prefill(params, tokens[b, Lp], cache) -> (last_logits[b, vocab], cache)
+    decode_step(params, token[b], pos, cache) -> (logits[b, vocab], cache)
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def prefill(params, tokens, cache):
+        logits, cache = cached_forward(params, tokens, cache, 0, cfg)
+        return logits[:, -1, :], cache
+
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def decode_step(params, token, pos, cache):
+        logits, cache = cached_forward(
+            params, token[:, None], cache, pos, cfg)
+        return logits[:, 0, :], cache
+
+    return prefill, decode_step
+
+
+def sample_token(logits, key, temperature: float = 0.0):
+    """Greedy (temperature 0) or temperature sampling; [b, vocab] -> [b]."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(key, logits / temperature, axis=-1)
+
+
+def generate(params: Dict, cfg: GPTConfig, prompt,
+             max_new_tokens: int = 32, temperature: float = 0.0,
+             max_len: Optional[int] = None, seed: int = 0,
+             stop_token: Optional[int] = None):
+    """Generator yielding one [batch] token array per step (so callers —
+    e.g. a Serve replica — can stream them)."""
+    prompt = jnp.asarray(prompt)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    b, lp = prompt.shape
+    total = max_len or min(cfg.max_seq_len, lp + max_new_tokens)
+    if not lp + max_new_tokens <= total <= cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({lp}) + max_new_tokens ({max_new_tokens}) must fit "
+            f"in max_len ({total}) <= cfg.max_seq_len "
+            f"({cfg.max_seq_len})")
+    prefill, decode_step = make_generate_fns(cfg, total)
+    cache = init_cache(cfg, b, total)
+    logits, cache = prefill(params, prompt, cache)
+    key = jax.random.PRNGKey(seed)
+    pos = lp
+    for i in range(max_new_tokens):
+        key, sub = jax.random.split(key)
+        token = sample_token(logits, sub, temperature)
+        yield token
+        if stop_token is not None and bool(
+                jnp.all(token == stop_token)):
+            return
+        if i + 1 < max_new_tokens:  # last sample needs no next logits
+            logits, cache = decode_step(params, token, pos, cache)
+            pos += 1

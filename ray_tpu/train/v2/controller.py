@@ -122,11 +122,9 @@ class TrainController:
     def _start_worker_group(self):
         decision: ResizeDecision = \
             self._scaling_policy.make_decision_for_new_group()
-        # Fail fast on a gang the cluster can never hold (reference:
+        # Surface a gang the cluster can't currently hold (reference:
         # infeasible-demand surfacing; without this the setup just
-        # times out with no diagnosis). Straight to ERRORED: retrying an
-        # infeasible fixed-size gang can never succeed, and routing it
-        # through the failure policy would hot-spin under max_failures=-1.
+        # times out with no diagnosis).
         totals = api.cluster_resources()
         demand = {k: v * decision.num_workers
                   for k, v in decision.resources_per_worker.items()}
@@ -134,16 +132,17 @@ class TrainController:
                       if v > totals.get(k, 0.0) + 1e-9}
         if infeasible:
             # Routed through the failure policy: an autoscaler may grow
-            # totals, and elastic recovery may be mid-rejoin — with
-            # retries enabled this becomes a paced wait for capacity
-            # (the sleep prevents a hot spin under max_failures=-1);
-            # with the default max_failures=0 it surfaces immediately.
-            time.sleep(max(self._poll_interval_s, 1.0))
+            # totals, and elastic recovery may be mid-rejoin. With the
+            # default max_failures=0 it surfaces immediately; when the
+            # policy opts to RETRY, pace the loop so an unbounded retry
+            # budget waits for capacity instead of hot-spinning.
             self._handle_failure(TaskUnschedulableError(
                 f"Worker group of {decision.num_workers} needs "
                 f"{demand}, exceeding current cluster totals "
                 f"{ {k: totals.get(k, 0.0) for k in demand} }. Reduce "
                 f"num_workers/resources_per_worker or add nodes."))
+            if self._state == TrainControllerState.RESTARTING:
+                time.sleep(max(self._poll_interval_s, 1.0))
             return
         # Materialize dataset shards BEFORE the gang reserves its
         # resources: split/repartition tasks need cluster CPU, and on a
