@@ -104,9 +104,15 @@ def build_llm_app(cfg=None, params=None, *, num_replicas: int = 1,
 
         def __call__(self, request):
             body = request.get("body") or {}
-            prompt = body.get("prompt", "")
-            max_tokens = int(body.get("max_tokens", 32))
-            temperature = float(body.get("temperature", 0.0))
+            prompt = str(body.get("prompt", ""))
+            try:
+                max_tokens = max(1, min(int(body.get("max_tokens", 32)),
+                                        self.engine.cfg.max_seq_len))
+                temperature = max(0.0,
+                                  float(body.get("temperature", 0.0)))
+            except (TypeError, ValueError):
+                return {"error": "max_tokens must be an int and "
+                        "temperature a float"}
             if body.get("stream"):
                 return self.engine.stream(prompt, max_tokens, temperature)
             return {"text": self.engine.complete(

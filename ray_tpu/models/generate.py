@@ -48,11 +48,11 @@ def _cached_block(x, layer, cache_layer, start_pos, cfg: GPTConfig):
     q = q.reshape(b, L, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, L, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, L, h, hd).transpose(0, 2, 1, 3)
-    # Rotary embeddings at absolute positions. rope() derives offset
-    # angles statically, so shift by slicing a statically-longer table:
-    # here we compute angles dynamically for the window instead.
-    q = _rope_at(q, start_pos)
-    k = _rope_at(k, start_pos)
+    # Rotary embeddings at absolute (possibly traced) positions —
+    # the same rope() the training forward uses.
+    positions = start_pos + jnp.arange(L)
+    q = rope(q, positions=positions)
+    k = rope(k, positions=positions)
 
     k_cache = jax.lax.dynamic_update_slice(
         cache_layer["k"], k.astype(cache_layer["k"].dtype),
@@ -79,22 +79,6 @@ def _cached_block(x, layer, cache_layer, start_pos, cfg: GPTConfig):
     return x, {"k": k_cache, "v": v_cache}
 
 
-def _rope_at(x, start_pos, base: float = 10000.0):
-    """Rotary embedding for [b, h, L, hd] at absolute offset start_pos
-    (traced-value-safe, unlike ops.layers.rope's static offset)."""
-    b, h, L, hd = x.shape
-    pos = start_pos + jnp.arange(L, dtype=jnp.float32)
-    inv_freq = 1.0 / (base ** (
-        jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
-    angles = pos[:, None] * inv_freq[None, :]
-    cos = jnp.cos(angles)[None, None]
-    sin = jnp.sin(angles)[None, None]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate(
-        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
-
-
 def cached_forward(params: Dict, tokens, cache: List[Dict],
                    start_pos, cfg: GPTConfig
                    ) -> Tuple[jnp.ndarray, List[Dict]]:
@@ -114,8 +98,12 @@ def cached_forward(params: Dict, tokens, cache: List[Dict],
             new_cache)
 
 
+@functools.lru_cache(maxsize=8)
 def make_generate_fns(cfg: GPTConfig, max_len: int):
-    """(prefill, decode_step) jitted with donated caches.
+    """(prefill, decode_step) jitted with donated caches, cached per
+    (cfg, max_len) so repeated serving requests reuse the XLA compiles
+    (the lru key is why max_len is a parameter — caches passed in must
+    have this length).
 
     prefill(params, tokens[b, Lp], cache) -> (last_logits[b, vocab], cache)
     decode_step(params, token[b], pos, cache) -> (logits[b, vocab], cache)
@@ -135,6 +123,15 @@ def make_generate_fns(cfg: GPTConfig, max_len: int):
     return prefill, decode_step
 
 
+def _bucket_len(n: int, cap: int) -> int:
+    """Round up to a power of two (min 64), capped — a handful of cache
+    lengths instead of one compile per prompt length."""
+    b = 64
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
 def sample_token(logits, key, temperature: float = 0.0):
     """Greedy (temperature 0) or temperature sampling; [b, vocab] -> [b]."""
     if temperature <= 0.0:
@@ -152,7 +149,7 @@ def generate(params: Dict, cfg: GPTConfig, prompt,
     if prompt.ndim == 1:
         prompt = prompt[None]
     b, lp = prompt.shape
-    total = max_len or min(cfg.max_seq_len, lp + max_new_tokens)
+    total = max_len or _bucket_len(lp + max_new_tokens, cfg.max_seq_len)
     if not lp + max_new_tokens <= total <= cfg.max_seq_len:
         raise ValueError(
             f"prompt ({lp}) + max_new_tokens ({max_new_tokens}) must fit "

@@ -157,12 +157,12 @@ class TestServeStreaming:
         addr = serve.proxy_address()
         try:
             r = urllib.request.urlopen(
-                f"{addr}/", data=json.dumps({}).encode(), timeout=30)
+                f"{addr}/", data=json.dumps({}).encode(), timeout=120)
             assert json.loads(r.read()) == {"text": "hello"}
             req = urllib.request.Request(
                 f"{addr}/",
                 data=json.dumps({"stream": True, "n": 4}).encode())
-            r = urllib.request.urlopen(req, timeout=30)
+            r = urllib.request.urlopen(req, timeout=120)
             assert r.read() == b"t0 t1 t2 t3 "
             h = serve.get_app_handle()
             out = list(h.options(method_name="tokens",
