@@ -7,6 +7,7 @@ DDP), RLModule model abstraction; PPO, DQN, SAC (continuous
 control), and IMPALA/APPO (V-trace off-policy correction) families.
 """
 from .algorithms.algorithm import Algorithm, AlgorithmConfig
+from .algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from .algorithms.dqn import DQN, DQNConfig
 from .algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig, vtrace
 from .algorithms.ppo import PPO, PPOConfig
@@ -14,10 +15,15 @@ from .algorithms.sac import SAC, SACConfig
 from .core.learner import JaxLearner
 from .core.rl_module import DQNModule, PPOModule, RLModule, SACModule
 from .env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from .offline import (DatasetReader, ImportanceSamplingEstimator,
+                      SampleWriter)
 from .utils.replay_buffers import ReplayBuffer
 
-__all__ = ["APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "DQN",
+__all__ = ["APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "BC",
+           "BCConfig", "DQN",
            "DQNConfig", "DQNModule", "EnvRunnerGroup", "IMPALA",
            "IMPALAConfig", "JaxLearner", "PPO", "PPOConfig", "PPOModule",
-           "RLModule", "ReplayBuffer", "SAC", "SACConfig", "SACModule",
+           "MARWIL", "MARWILConfig", "RLModule", "ReplayBuffer", "SAC",
+           "SACConfig", "SACModule",
+           "DatasetReader", "ImportanceSamplingEstimator", "SampleWriter",
            "SingleAgentEnvRunner", "vtrace"]

@@ -95,11 +95,18 @@ class Algorithm:
         obs_dim, num_actions = _env_dims(config.env_spec, config.env_config)
         self.module = self._build_module(obs_dim, num_actions)
         self.learner = self._build_learner()
-        self.env_runner_group = EnvRunnerGroup(
-            config.env_spec, config.env_config, self.module,
-            num_env_runners=config.num_env_runners, seed=config.seed)
-        if self.learner is not None:
-            self.env_runner_group.sync_weights(self.learner.get_weights())
+        if config.num_env_runners > 0:
+            self.env_runner_group = EnvRunnerGroup(
+                config.env_spec, config.env_config, self.module,
+                num_env_runners=config.num_env_runners, seed=config.seed)
+            if self.learner is not None:
+                self.env_runner_group.sync_weights(
+                    self.learner.get_weights())
+        else:
+            # Offline algorithms (BC/MARWIL) train from datasets; no
+            # sampling actors (reference: offline algos run without
+            # rollout workers).
+            self.env_runner_group = None
 
     # subclass hooks
     def _build_module(self, obs_dim: int, num_actions: int):
@@ -126,7 +133,8 @@ class Algorithm:
         t0 = time.perf_counter()
         result = self.training_step()
         self.iteration += 1
-        metrics = self.env_runner_group.collect_metrics()
+        metrics = self.env_runner_group.collect_metrics() \
+            if self.env_runner_group is not None else []
         self._episode_returns.extend(
             m["episode_return"] for m in metrics)
         recent = self._episode_returns[-100:]
@@ -184,10 +192,12 @@ class Algorithm:
         self._set_algo_state(st)
         self.iteration = st["iteration"]
         self._total_steps = st["total_steps"]
-        self.env_runner_group.sync_weights(self.get_weights())
+        if self.env_runner_group is not None:
+            self.env_runner_group.sync_weights(self.get_weights())
 
     def stop(self):
-        self.env_runner_group.stop()
+        if self.env_runner_group is not None:
+            self.env_runner_group.stop()
 
     # Tune integration: Algorithm is usable as a trainable
     # (reference: Algorithm IS a Trainable).

@@ -1,0 +1,113 @@
+"""BC / MARWIL — offline policy learning from experience datasets.
+
+Reference parity: rllib/algorithms/bc/ (behavior cloning) and marwil/
+(advantage-weighted BC — MARWIL's beta=0 reduces to BC, the same
+relationship the reference implements). Training consumes an offline
+DatasetReader instead of env runners; evaluation rolls out the learned
+policy on the configured env.
+"""
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.learner import JaxLearner
+from ..core.rl_module import PPOModule
+from ..offline import DatasetReader
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def make_marwil_loss(beta: float, vf_coeff: float = 1.0):
+    """Advantage-weighted imitation (MARWIL eq. 4); beta=0 -> plain BC.
+
+    Expects batch columns obs / actions / value_targets (monte-carlo
+    returns; ignored when beta == 0).
+    """
+
+    def marwil_loss(params, module, batch):
+        logits, values = module.apply(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None].astype(jnp.int32),
+            axis=-1)[:, 0]
+        if beta > 0:
+            adv = batch["value_targets"] - values
+            weight = jnp.exp(jnp.clip(
+                beta * jax.lax.stop_gradient(adv), -10.0, 10.0))
+            policy_loss = -jnp.mean(weight * logp)
+            vf_loss = jnp.mean(adv ** 2)
+        else:
+            policy_loss = -jnp.mean(logp)
+            vf_loss = jnp.zeros(())
+        total = policy_loss + vf_coeff * vf_loss
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "logp_mean": jnp.mean(logp)}
+
+    return marwil_loss
+
+
+class MARWIL(Algorithm):
+    """Offline algorithm: no env runners (num_env_runners=0);
+    `offline_data` (a Dataset or DatasetReader) supplies training
+    batches. Monte-Carlo value targets are computed ONCE by the reader
+    over episode-ordered rows — never on shuffled minibatches."""
+
+    _beta = 1.0
+
+    def __init__(self, config):
+        reader = config.extra.get("offline_data")
+        if reader is None:
+            raise ValueError(
+                f"{type(self).__name__} needs .training("
+                f"offline_data=<Dataset|DatasetReader>)")
+        beta = float(config.extra.get("beta", self._beta))
+        if not isinstance(reader, DatasetReader):
+            reader = DatasetReader(
+                reader, batch_size=config.train_batch_size,
+                seed=config.seed,
+                compute_returns=config.gamma if beta > 0 else None)
+        self.reader = reader
+        super().__init__(config)
+
+    def _build_module(self, obs_dim, num_actions):
+        return PPOModule(obs_dim, num_actions, self.config.hidden)
+
+    def _build_learner(self):
+        cfg = self.config
+        beta = float(cfg.extra.get("beta", self._beta))
+        return JaxLearner(
+            self.module,
+            make_marwil_loss(beta, float(cfg.extra.get("vf_coeff", 1.0))),
+            lr=cfg.lr, seed=cfg.seed)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        stats: Dict = {}
+        n = 0
+        for batch in self.reader.iter_batches(
+                epochs=int(cfg.extra.get("epochs_per_iter", 1))):
+            stats.update(self.learner.update(batch))
+            n += len(batch["actions"])
+        self._total_steps += n
+        if self.env_runner_group is not None:
+            self.env_runner_group.sync_weights(self.learner.get_weights())
+        return stats
+
+
+class BC(MARWIL):
+    """Plain behavior cloning (reference: rllib/algorithms/bc)."""
+
+    _beta = 0.0
+
+
+class MARWILConfig(AlgorithmConfig):
+    ALGO_CLS = MARWIL
+
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 0
+        self.train_batch_size = 256
+
+
+class BCConfig(MARWILConfig):
+    ALGO_CLS = BC

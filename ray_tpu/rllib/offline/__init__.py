@@ -1,0 +1,150 @@
+"""Offline RL: experience IO + off-policy estimation.
+
+Reference parity: rllib/offline/ — dataset_writer.py/dataset_reader.py
+(experiences as Ray Data datasets / JSON-parquet files), io_context.py,
+and is_estimator.py (importance-sampling off-policy evaluation). Here
+experiences are ray_tpu.data Datasets of transition rows, written from
+env-runner sample fragments and read back as shuffled training batches.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+__all__ = ["SampleWriter", "DatasetReader",
+           "ImportanceSamplingEstimator", "rows_from_fragments"]
+
+_COLUMNS = ("obs", "actions", "rewards", "terminateds", "truncateds",
+            "next_obs", "action_logp")
+
+
+def rows_from_fragments(fragments: List[Dict[str, np.ndarray]]
+                        ) -> List[Dict]:
+    """Columnar sample fragments -> per-transition rows."""
+    rows = []
+    for frag in fragments:
+        n = len(frag["rewards"])
+        keys = [k for k in _COLUMNS if k in frag]
+        for i in range(n):
+            rows.append({k: frag[k][i] for k in keys})
+    return rows
+
+
+class SampleWriter:
+    """Accumulate rollout fragments; materialize as a Dataset or parquet
+    (reference: dataset_writer.py)."""
+
+    def __init__(self):
+        self._fragments: List[Dict[str, np.ndarray]] = []
+
+    def write(self, fragment: Dict[str, np.ndarray]) -> None:
+        self._fragments.append(fragment)
+
+    def __len__(self) -> int:
+        return sum(len(f["rewards"]) for f in self._fragments)
+
+    def to_dataset(self):
+        import ray_tpu.data as rd
+
+        return rd.from_items(rows_from_fragments(self._fragments))
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self.to_dataset().write_parquet(path)
+
+
+class DatasetReader:
+    """Shuffled minibatches from an experience Dataset (reference:
+    dataset_reader.py get_dataset_and_shards + batch iteration).
+
+    `compute_returns=gamma` adds a `value_targets` column of per-episode
+    Monte-Carlo returns BEFORE shuffling — returns are a property of the
+    episode-ordered data, so they must be computed here, never on
+    shuffled minibatches. A trailing episode cut off by the end of the
+    dataset is treated as ending there (documented bias: its targets
+    omit the unrecorded future)."""
+
+    def __init__(self, dataset, batch_size: int = 256, seed: int = 0,
+                 compute_returns: Optional[float] = None):
+        self._rows = [r for r in dataset.iter_rows()]
+        self._batch_size = batch_size
+        self._rng = np.random.default_rng(seed)
+        if compute_returns is not None and self._rows:
+            self._add_value_targets(float(compute_returns))
+
+    def _add_value_targets(self, gamma: float) -> None:
+        acc = 0.0
+        for row in reversed(self._rows):
+            done = bool(row.get("terminateds")) or \
+                bool(row.get("truncateds"))
+            if done:
+                acc = 0.0
+            acc = float(row["rewards"]) + gamma * acc
+            row["value_targets"] = np.float32(acc)
+
+    @classmethod
+    def from_parquet(cls, path, **kwargs) -> "DatasetReader":
+        import ray_tpu.data as rd
+
+        return cls(rd.read_parquet(path), **kwargs)
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def iter_batches(self, epochs: int = 1) -> Iterator[Dict[str,
+                                                             np.ndarray]]:
+        idx = np.arange(len(self._rows))
+        bs = min(self._batch_size, len(idx))
+        if bs == 0:
+            return
+        for _ in range(epochs):
+            self._rng.shuffle(idx)
+            # A dataset smaller than batch_size still yields one batch.
+            for s in range(0, max(len(idx) - bs + 1, 1), bs):
+                chunk = [self._rows[i] for i in idx[s:s + bs]]
+                yield {k: np.asarray([r[k] for r in chunk])
+                       for k in chunk[0]}
+
+
+class ImportanceSamplingEstimator:
+    """Off-policy evaluation via per-episode importance weighting
+    (reference: offline/estimators is_estimator.py — OPE of a target
+    policy's return from behavior-policy data)."""
+
+    def __init__(self, gamma: float = 0.99, clip_weight: float = 20.0):
+        self.gamma = gamma
+        self.clip = clip_weight
+
+    def estimate(self, fragments: List[Dict[str, np.ndarray]],
+                 target_logp_fn) -> Dict[str, float]:
+        """fragments must carry `action_logp` (behavior);
+        target_logp_fn(obs, actions) -> target policy log-probs."""
+        returns = []
+        for frag in fragments:
+            t_logp = np.asarray(
+                target_logp_fn(frag["obs"], frag["actions"]))
+            b_logp = np.asarray(frag["action_logp"])
+            done = np.logical_or(frag["terminateds"],
+                                 frag.get("truncateds",
+                                          np.zeros_like(
+                                              frag["terminateds"])))
+            # Complete episodes plus (uniformly) the trailing partial
+            # one, if any — the same rule whether or not earlier
+            # episodes completed in this fragment.
+            ends = list(np.nonzero(done)[0] + 1)
+            if not ends or ends[-1] < len(b_logp):
+                ends.append(len(b_logp))
+            start = 0
+            for end in ends:
+                w = float(np.exp(np.clip(
+                    np.sum(t_logp[start:end] - b_logp[start:end]),
+                    -np.log(self.clip), np.log(self.clip))))
+                disc = self.gamma ** np.arange(end - start)
+                returns.append(
+                    w * float(np.sum(frag["rewards"][start:end] * disc)))
+                start = end
+        if not returns:
+            return {"v_target": float("nan"), "episodes": 0}
+        return {"v_target": float(np.mean(returns)),
+                "episodes": len(returns)}
