@@ -66,6 +66,11 @@ class MARWIL(Algorithm):
                 reader, batch_size=config.train_batch_size,
                 seed=config.seed,
                 compute_returns=config.gamma if beta > 0 else None)
+        elif beta > 0 and reader._rows and \
+                "value_targets" not in reader._rows[0]:
+            # User-built reader without returns: compute them here (over
+            # episode order) rather than KeyError deep in the jitted loss.
+            reader._add_value_targets(config.gamma)
         self.reader = reader
         super().__init__(config)
 

@@ -22,13 +22,23 @@ _COLUMNS = ("obs", "actions", "rewards", "terminateds", "truncateds",
 
 def rows_from_fragments(fragments: List[Dict[str, np.ndarray]]
                         ) -> List[Dict]:
-    """Columnar sample fragments -> per-transition rows."""
+    """Columnar sample fragments -> per-transition rows.
+
+    A fragment's final row is marked truncated if the episode didn't
+    end there: the recorded trajectory stops at the fragment boundary,
+    and with multiple runners the next row belongs to an unrelated
+    episode — return computations must not bleed across it (the
+    reference's SampleBatch marks fragment cuts the same way)."""
     rows = []
     for frag in fragments:
         n = len(frag["rewards"])
         keys = [k for k in _COLUMNS if k in frag]
         for i in range(n):
-            rows.append({k: frag[k][i] for k in keys})
+            row = {k: frag[k][i] for k in keys}
+            if i == n - 1 and not (bool(row.get("terminateds"))
+                                   or bool(row.get("truncateds"))):
+                row["truncateds"] = np.bool_(True)
+            rows.append(row)
     return rows
 
 

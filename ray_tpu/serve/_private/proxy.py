@@ -24,6 +24,7 @@ class _ProxyState:
         self._routes: Dict[str, tuple] = {}
         self._handles: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self._last_refresh = 0.0
         self._long_poll = LongPollClient(
             controller, {"routes": self._update_routes})
         import ray_tpu
@@ -38,7 +39,33 @@ class _ProxyState:
             self._routes = dict(routes or {})
 
     def match(self, path: str) -> Optional[tuple]:
-        """Longest-prefix route match (reference: proxy.py route matching)."""
+        """Longest-prefix route match (reference: proxy.py route matching).
+        A miss refreshes the table synchronously once before giving up —
+        a request can legally arrive before the long-poll delivers a
+        just-deployed app's routes."""
+        target = self._match_locked(path)
+        if target is not None:
+            return target
+        # Throttled: unmatched-path floods must not turn every 404 into
+        # a controller RPC (one refresh per second serves the
+        # just-deployed-app race without the amplification).
+        import time as _time
+
+        import ray_tpu
+        with self._lock:
+            now = _time.monotonic()
+            if now - self._last_refresh < 1.0:
+                return None
+            self._last_refresh = now
+        try:
+            self._update_routes(
+                ray_tpu.get(self._controller.get_route_table.remote(),
+                            timeout=10))
+        except Exception:
+            return None
+        return self._match_locked(path)
+
+    def _match_locked(self, path: str) -> Optional[tuple]:
         with self._lock:
             best = None
             for prefix, target in self._routes.items():
