@@ -56,6 +56,21 @@ class RayConfig:
         "dashboard_port": 8265,
         # usage/telemetry opt-out (reference: RAY_USAGE_STATS_ENABLED)
         "usage_stats_enabled": False,
+        # -- object spilling (reference: object_spilling_config,
+        #    LocalObjectManager) -----------------------------------------
+        "object_spilling_enabled": True,
+        # objects below this size stay in shm (reference default 100 MiB;
+        # small here so capacity-bounded test stores can spill anything)
+        "min_spilling_size": 0,
+        # -- memory monitor / OOM killer (reference: memory_monitor.h:52,
+        #    memory_usage_threshold, worker_killing_policy.h:34) ---------
+        "memory_usage_threshold": 0.95,
+        "memory_monitor_refresh_ms": 250,
+        # retriable_lifo (kill newest retriable first) | group_by_owner
+        "worker_killing_policy": "retriable_lifo",
+        # sqlite file for durable GCS KV ("" = in-memory only; reference:
+        # Redis-backed GCS fault tolerance, store_client/redis_store_client)
+        "gcs_storage_path": "",
     }
 
     def __init__(self):

@@ -287,6 +287,63 @@ class KvStore:
                     k.startswith(prefix)]
 
 
+class SqliteKvStore(KvStore):
+    """Durable KV (reference: the Redis store client,
+    gcs/store_client/redis_store_client.cc, which gives the GCS head-node
+    fault tolerance; SURVEY.md §7 swaps Redis for "a simpler raft/sqlite
+    persistence"). Same interface as KvStore; every mutation lands in a
+    WAL-mode sqlite file, so a restarted head (`init(...)` with the same
+    `RAY_TPU_GCS_STORAGE_PATH`) recovers detached state — notably the
+    internal KV that Serve-style controllers checkpoint into."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        import sqlite3
+        os_dir = path and __import__("os").path.dirname(path)
+        if os_dir:
+            __import__("os").makedirs(os_dir, exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv ("
+            " ns TEXT NOT NULL, key TEXT NOT NULL, value BLOB NOT NULL,"
+            " PRIMARY KEY (ns, key))")
+        self._conn.commit()
+        for ns, key, value in self._conn.execute(
+                "SELECT ns, key, value FROM kv"):
+            self._data.setdefault(ns, {})[key] = bytes(value)
+
+    def put(self, key: str, value: bytes, namespace: str = "default",
+            overwrite: bool = True) -> bool:
+        with self._lock:
+            ns = self._data.setdefault(namespace, {})
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (ns, key, value) VALUES (?,?,?)",
+                (namespace, key, value))
+            self._conn.commit()
+            return True
+
+    def delete(self, key: str, namespace: str = "default") -> bool:
+        with self._lock:
+            hit = self._data.get(namespace, {}).pop(key, None) is not None
+            if hit:
+                self._conn.execute(
+                    "DELETE FROM kv WHERE ns = ? AND key = ?",
+                    (namespace, key))
+                self._conn.commit()
+            return hit
+
+    def close(self):
+        with self._lock:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+
+
 class Pubsub:
     """Minimal pubsub for cluster events (reference: src/ray/pubsub/)."""
 
@@ -311,10 +368,13 @@ class Pubsub:
 class Gcs:
     """The aggregate metadata service handle."""
 
-    def __init__(self):
+    def __init__(self, persist_path: Optional[str] = None):
         self.objects = ObjectDirectory()
         self.actors = ActorDirectory()
-        self.kv = KvStore()
+        if persist_path is None:
+            from .config import ray_config
+            persist_path = str(ray_config.gcs_storage_path)
+        self.kv = SqliteKvStore(persist_path) if persist_path else KvStore()
         self.pubsub = Pubsub()
         self.start_time = time.time()
         self.node_id_hex = None  # filled by Node

@@ -1,0 +1,146 @@
+"""Host memory monitor and OOM worker-killing policy.
+
+Reference: the raylet's `MemoryMonitor` (src/ray/common/memory_monitor.h:52)
+samples system+cgroup memory on a timer and, above
+`memory_usage_threshold`, invokes a `WorkerKillingPolicy`
+(src/ray/raylet/worker_killing_policy.h:34) — retriable-first ordering, with
+a group-by-owner variant — so the node sheds load instead of letting the
+kernel OOM-killer take out the raylet or the driver.
+
+TPU-native differences: there is no raylet process — the monitor runs as a
+daemon thread inside the driver runtime. Before killing anything it first
+asks the shm object store to spill to disk (shm pages are RAM, so spilling
+IS memory relief), then falls back to killing one worker per tick; killed
+tasks retry through the normal failure path (`max_retries` budget), which is
+exactly the reference's contract (killed-by-OOM counts against retries
+unless retriable).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from .config import ray_config
+
+
+def system_memory_fraction() -> float:
+    """Fraction of host memory in use, the cgroup-aware way the reference
+    computes it (memory_monitor.cc reads cgroup limits first, then
+    /proc/meminfo). Returns 0.0 when nothing is readable."""
+    # cgroup v2: a container's true ceiling is memory.max, not MemTotal.
+    try:
+        with open("/sys/fs/cgroup/memory.max") as f:
+            limit_s = f.read().strip()
+        if limit_s != "max":
+            limit = int(limit_s)
+            with open("/sys/fs/cgroup/memory.current") as f:
+                current = int(f.read().strip())
+            # Subtract reclaimable page cache (the reference computes
+            # working set = current - inactive_file, memory_monitor.cc) —
+            # otherwise spill-file IO itself reads as pressure and the
+            # monitor kills workers spuriously.
+            try:
+                with open("/sys/fs/cgroup/memory.stat") as f:
+                    for line in f:
+                        if line.startswith("inactive_file "):
+                            current -= int(line.split()[1])
+                            break
+            except (OSError, ValueError):
+                pass
+            if limit > 0:
+                return max(0, current) / limit
+    except (OSError, ValueError):
+        pass
+    try:
+        total = avail = None
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1])
+                if total is not None and avail is not None:
+                    break
+        if total:
+            return 1.0 - (avail or 0) / total
+    except (OSError, ValueError):
+        pass
+    return 0.0
+
+
+# (worker_handle, is_retriable, last_dispatch_ts, owner_key)
+Candidate = Tuple[object, bool, float, str]
+
+
+def pick_victim(candidates: List[Candidate],
+                policy: Optional[str] = None):
+    """Choose which worker to kill under memory pressure.
+
+    `retriable_lifo` (reference: RetriableFIFOWorkerKillingPolicy,
+    worker_killing_policy.cc): prefer workers whose work can be retried,
+    and among those the most recently dispatched — newest work has the
+    least sunk cost. `group_by_owner`
+    (worker_killing_policy_group_by_owner.cc): group candidates by owner,
+    shrink the largest group first (keeps at least one worker per owner
+    making progress), newest-first within the group.
+    Returns the chosen worker handle or None.
+    """
+    if not candidates:
+        return None
+    policy = policy or str(ray_config.worker_killing_policy)
+    if policy == "group_by_owner":
+        groups = {}
+        for c in candidates:
+            groups.setdefault(c[3], []).append(c)
+        # Largest group, but never its last member unless every group has
+        # only one (then fall back to retriable-lifo across all).
+        group = max(groups.values(), key=len)
+        pool = group if len(group) > 1 else candidates
+        return max(pool, key=lambda c: (c[1], c[2]))[0]
+    return max(candidates, key=lambda c: (c[1], c[2]))[0]
+
+
+class MemoryMonitor:
+    """Daemon thread: sample memory, spill first, then kill one worker per
+    tick while above threshold."""
+
+    def __init__(self,
+                 on_pressure: Callable[[float], None],
+                 sampler: Callable[[], float] = system_memory_fraction,
+                 threshold: Optional[float] = None,
+                 refresh_ms: Optional[float] = None):
+        self._on_pressure = on_pressure
+        self._sampler = sampler
+        self._threshold = (float(ray_config.memory_usage_threshold)
+                           if threshold is None else threshold)
+        self._refresh_s = ((float(ray_config.memory_monitor_refresh_ms)
+                            if refresh_ms is None else refresh_ms) / 1000.0)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_fraction = 0.0
+
+    def start(self):
+        if self._refresh_s <= 0:
+            return  # disabled (reference: refresh interval 0 disables)
+        self._thread = threading.Thread(
+            target=self._run, name="memory_monitor", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._refresh_s):
+            try:
+                frac = self._sampler()
+                self.last_fraction = frac
+                if frac >= self._threshold:
+                    self._on_pressure(frac)
+            except Exception:
+                pass  # monitoring must never take the runtime down
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -52,13 +52,18 @@ def _default_capacity() -> int:
 
 
 class _Segment:
-    __slots__ = ("path", "mm", "size", "file_exists")
+    __slots__ = ("path", "mm", "size", "file_exists", "sealed",
+                 "counted", "last_access")
 
-    def __init__(self, path: str, mm: mmap.mmap, size: int):
+    def __init__(self, path: str, mm: mmap.mmap, size: int,
+                 sealed: bool = False, counted: bool = True):
         self.path = path
         self.mm = mm
         self.size = size
         self.file_exists = True
+        self.sealed = sealed          # writer done; safe to spill
+        self.counted = counted        # participates in capacity accounting
+        self.last_access = 0          # LRU clock tick for spill ordering
 
 
 class ObjectStore:
@@ -77,10 +82,22 @@ class ObjectStore:
         self._used = 0
         self._graveyard = []  # mmaps with live exported buffers
         self._lock = threading.RLock()
+        # Spilling (reference: LocalObjectManager spill/restore,
+        # raylet/local_object_manager.cc): sealed objects move from shm to
+        # a disk directory derived from the store dir — deterministic, so
+        # any process of the session can restore without coordination.
+        self._spill_dir = session_dir.rstrip("/") + "_spill"
+        self._spilled_bytes = 0
+        self._spilled_count = 0
+        self._restored_count = 0
+        self._access_clock = 0
 
     # -- paths -------------------------------------------------------------
     def _path(self, object_id: ObjectID) -> str:
         return os.path.join(self._dir, object_id.hex())
+
+    def _spill_path(self, object_id: ObjectID) -> str:
+        return os.path.join(self._spill_dir, object_id.hex())
 
     @property
     def used_bytes(self) -> int:
@@ -97,9 +114,12 @@ class ObjectStore:
             if self._used + size > self._capacity:
                 self._collect_graveyard()
                 if self._used + size > self._capacity:
+                    self._spill_locked(self._used + size - self._capacity)
+                if self._used + size > self._capacity:
                     raise ObjectStoreFullError(
                         f"Object of {size} bytes does not fit: "
-                        f"{self._used}/{self._capacity} bytes used."
+                        f"{self._used}/{self._capacity} bytes used "
+                        f"({self._spilled_bytes} spilled)."
                     )
             path = self._path(object_id)
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
@@ -120,42 +140,148 @@ class ObjectStore:
             sobj.write_into(view)
         finally:
             view.release()
+        self.seal(object_id)
         return size
+
+    def seal(self, object_id: ObjectID):
+        """Writer done: the object becomes immutable and spillable
+        (plasma's seal, object_store.cc)."""
+        with self._lock:
+            seg = self._segments.get(object_id)
+            if seg is not None:
+                seg.sealed = True
 
     def put(self, object_id: ObjectID, value: Any) -> int:
         return self.put_serialized(object_id, serialization.serialize(value))
 
+    # -- spill path --------------------------------------------------------
+    def _spill_locked(self, need_bytes: int) -> int:
+        """Move LRU sealed objects from shm to disk until `need_bytes` are
+        reclaimed (reference: LocalObjectManager::SpillObjects; eviction
+        order per eviction_policy.cc LRU). Copy-then-rename-then-unlink so
+        concurrent readers in other processes always find either the shm
+        file or a complete spill file. Returns bytes reclaimed."""
+        from .config import ray_config
+        if not bool(ray_config.object_spilling_enabled):
+            return 0
+        candidates = [
+            (seg.last_access, oid, seg)
+            for oid, seg in self._segments.items()
+            if seg.sealed and seg.counted and seg.file_exists
+            and seg.size >= int(ray_config.min_spilling_size)
+        ]
+        candidates.sort(key=lambda t: t[0])
+        reclaimed = 0
+        os.makedirs(self._spill_dir, exist_ok=True)
+        for _, oid, seg in candidates:
+            if reclaimed >= need_bytes:
+                break
+            dst = self._spill_path(oid)
+            tmp = dst + ".tmp"
+            try:
+                import shutil
+                shutil.copyfile(seg.path, tmp)
+                os.rename(tmp, dst)
+                os.unlink(seg.path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                continue
+            seg.file_exists = False
+            self._segments.pop(oid, None)
+            self._used -= seg.size
+            self._spilled_bytes += seg.size
+            self._spilled_count += 1
+            reclaimed += seg.size
+            if seg.mm is not None:
+                try:
+                    seg.mm.close()
+                except BufferError:
+                    self._graveyard.append(seg.mm)
+        return reclaimed
+
+    def spill_objects(self, target_bytes: int) -> int:
+        """Spill until shm usage is at or below `target_bytes` (called by
+        the memory monitor under host memory pressure — /dev/shm pages
+        count as RAM). Returns bytes reclaimed."""
+        with self._lock:
+            if self._used <= target_bytes:
+                return 0
+            return self._spill_locked(self._used - target_bytes)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"used_bytes": self._used, "capacity": self._capacity,
+                    "spilled_bytes": self._spilled_bytes,
+                    "spilled_count": self._spilled_count,
+                    "restored_count": self._restored_count,
+                    "num_objects": len(self._segments)}
+
     # -- read path ---------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
-            return object_id in self._segments or os.path.exists(self._path(object_id))
+            return (object_id in self._segments
+                    or os.path.exists(self._path(object_id))
+                    or os.path.exists(self._spill_path(object_id)))
 
     def _open(self, object_id: ObjectID) -> _Segment:
         with self._lock:
+            self._access_clock += 1
             seg = self._segments.get(object_id)
-            if seg is None or seg.mm is None:
+            if seg is not None and seg.mm is not None:
+                seg.last_access = self._access_clock
+                return seg
+            counted = seg is not None  # adopted placeholder keeps accounting
+            from_spill = False
+            try:
                 path = self._path(object_id)
                 size = os.path.getsize(path)
                 fd = os.open(path, os.O_RDWR)
-                try:
-                    mm = mmap.mmap(fd, size)
-                finally:
-                    os.close(fd)
-                if seg is None:
-                    # Readers do not own capacity accounting; only creators do.
-                    seg = _Segment(path, mm, size)
-                    self._segments[object_id] = seg
-                else:  # adopted placeholder: attach the mapping
-                    seg.mm = mm
+            except OSError:
+                # Spilled (by this or another process — possibly between
+                # our getsize and open): restore from disk. The mapping
+                # reads straight off the page cache; the object is NOT
+                # re-admitted to shm accounting.
+                path = self._spill_path(object_id)
+                size = os.path.getsize(path)
+                fd = os.open(path, os.O_RDWR)
+                from_spill = True
+            try:
+                mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            if seg is None:
+                # Readers do not own capacity accounting; only creators do.
+                seg = _Segment(path, mm, size, sealed=True, counted=False)
+                self._segments[object_id] = seg
+            else:  # adopted placeholder: attach the mapping
+                seg.mm = mm
+                seg.path = path
+            if from_spill:
+                if counted and seg.counted:
+                    # The shm copy is gone; stop counting it.
+                    self._used -= seg.size
+                seg.counted = False
+                self._restored_count += 1
+            seg.last_access = self._access_clock
             return seg
+
+    def _open_view(self, object_id: ObjectID) -> memoryview:
+        """Open + export a view atomically: the view must be created
+        under the lock, so a concurrent spill's mm.close() hits
+        BufferError (→ graveyard) instead of invalidating a mapping a
+        reader is about to touch."""
+        with self._lock:
+            return memoryview(self._open(object_id).mm)
 
     def get(self, object_id: ObjectID) -> Any:
         """Deserialize an object, zero-copy for array buffers."""
-        seg = self._open(object_id)
-        return serialization.deserialize(memoryview(seg.mm))
+        return serialization.deserialize(self._open_view(object_id))
 
     def get_raw(self, object_id: ObjectID) -> memoryview:
-        return memoryview(self._open(object_id).mm)
+        return self._open_view(object_id)
 
     def adopt(self, object_id: ObjectID, size: int):
         """Owner-side accounting for a segment created by another process."""
@@ -164,26 +290,24 @@ class ObjectStore:
                 self._used += size
                 # Lazily opened on first get; record a placeholder w/ size.
                 path = self._path(object_id)
-                seg = _Segment(path, None, size)  # type: ignore[arg-type]
+                seg = _Segment(path, None, size,  # type: ignore[arg-type]
+                               sealed=True)
                 self._segments[object_id] = seg
 
     # -- free path ---------------------------------------------------------
     def free(self, object_id: ObjectID):
         with self._lock:
             seg = self._segments.pop(object_id, None)
+            for p in (self._path(object_id), self._spill_path(object_id)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
             if seg is None:
-                try:
-                    os.unlink(self._path(object_id))
-                except OSError:
-                    pass
                 return
-            if seg.file_exists:
-                try:
-                    os.unlink(seg.path)
-                except OSError:
-                    pass
-                seg.file_exists = False
-            self._used -= seg.size
+            seg.file_exists = False
+            if seg.counted:
+                self._used -= seg.size
             if seg.mm is not None:
                 try:
                     seg.mm.close()
@@ -220,6 +344,7 @@ class ObjectStore:
             # Files written by workers that never reported back (crashes)
             # are not in _segments; sweep the whole session dir.
             shutil.rmtree(self._dir, ignore_errors=True)
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
 
 
 class ArenaObjectStore:
@@ -307,6 +432,15 @@ class ArenaObjectStore:
 
     def release(self, object_id: ObjectID):
         pass  # reads copy; nothing stays pinned
+
+    def spill_objects(self, target_bytes: int) -> int:
+        return 0  # arena backend relies on its own LRU eviction
+
+    def stats(self) -> Dict[str, int]:
+        return {"used_bytes": self._store.used_bytes(),
+                "capacity": self._store.capacity(),
+                "spilled_bytes": 0, "spilled_count": 0,
+                "restored_count": 0, "num_objects": 0}
 
     def shutdown(self):
         self._store.close(unlink=self._owner)

@@ -184,8 +184,50 @@ class Node:
         self._gen_streams: Dict[bytes, dict] = {}
         self.gcs.objects.subscribe_ready(self._on_object_ready)
         self.gcs.objects.subscribe_free(self._on_objects_freed)
+        # OOM defense (reference: MemoryMonitor memory_monitor.h:52 +
+        # WorkerKillingPolicy worker_killing_policy.h:34): spill shm first,
+        # then shed one worker per tick above the usage threshold.
+        from .memory_monitor import MemoryMonitor
+        self.memory_monitor = MemoryMonitor(self._on_memory_pressure)
+        self.memory_monitor.start()
         self._shutdown = False
         atexit.register(self.shutdown)
+
+    def _on_memory_pressure(self, fraction: float):
+        """One relief action per monitor tick: spill if anything is
+        spillable, otherwise kill the policy-chosen worker (its in-flight
+        tasks fail through the normal worker-death path and retry on their
+        `max_retries` budget)."""
+        spill = getattr(self.store, "spill_objects", None)
+        if spill is not None:
+            used = getattr(self.store, "used_bytes", 0)
+            target = used // 2 if isinstance(used, int) else 0
+            if spill(target) > 0:
+                return
+        from .memory_monitor import pick_victim
+        candidates = []
+        for h in list(self.pool.workers.values()):
+            if not h.alive or not (h.running or h.dedicated_actor):
+                continue
+            if h.dedicated_actor is not None:
+                st = self._actors.get(h.dedicated_actor)
+                retriable = bool(st and st.spec.max_restarts != 0)
+                owner = f"actor:{h.dedicated_actor.hex()}"
+            else:
+                specs = list(h.running.values())
+                retriable = bool(specs) and all(
+                    self._retries_used.get(s.task_id.binary(), 0)
+                    < s.max_retries for s in specs)
+                owner = specs[0].fn_id if specs else "idle"
+            candidates.append(
+                (h, retriable, getattr(h, "last_dispatch_ts", 0.0), owner))
+        victim = pick_victim(candidates)
+        if victim is not None:
+            self.gcs.record_task_event({
+                "task_id": "", "name": "oom_killer",
+                "state": f"KILLED_WORKER:{victim.worker_id.hex()}",
+                "ts": time.time()})
+            victim.kill()
 
     # ------------------------------------------------------------------
     # object plane (owner side)
@@ -460,6 +502,7 @@ class Node:
                        "fn_blob": self._fn_registry.get(spec.fn_id)})
             worker.fn_cache.add(spec.fn_id)
         worker.running[spec.task_id.binary()] = spec
+        worker.last_dispatch_ts = time.time()
         self.gcs.record_task_event({
             "task_id": spec.task_id.hex(), "name": spec.name,
             "state": "RUNNING", "worker_id": worker.worker_id.hex(),
@@ -1147,12 +1190,19 @@ class Node:
             return
         self._shutdown = True
         try:
+            self.memory_monitor.stop()
+        except Exception:
+            pass
+        try:
             self.pg_manager.shutdown()
             self.scheduler.stop()
             self.pool.shutdown()
             self.store.shutdown()
         except Exception:
             pass
+        close_kv = getattr(self.gcs.kv, "close", None)
+        if close_kv is not None:
+            close_kv()
         import shutil
         shutil.rmtree(self.session_dir, ignore_errors=True)
         from . import state

@@ -221,6 +221,7 @@ class WorkerHandle:
         self.fn_cache: Set[str] = set()
         self.chip_ids: List[int] = []  # TPU chips pinned to this worker
         self.alive = True
+        self.last_dispatch_ts = 0.0  # OOM-killer victim ordering
         # Set once the death callback has run (or been suppressed during
         # pool shutdown) so it fires exactly once.
         self.death_handled = False

@@ -10,11 +10,15 @@ from .algorithms.algorithm import Algorithm, AlgorithmConfig
 from .algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from .algorithms.dqn import DQN, DQNConfig
 from .algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig, vtrace
+from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig
 from .algorithms.ppo import PPO, PPOConfig
 from .algorithms.sac import SAC, SACConfig
 from .core.learner import JaxLearner
-from .core.rl_module import DQNModule, PPOModule, RLModule, SACModule
+from .core.rl_module import (DQNModule, MultiRLModule, PPOModule, RLModule,
+                             SACModule)
 from .env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from .env.multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
+                              MultiAgentEnvRunnerGroup)
 from .offline import (DatasetReader, ImportanceSamplingEstimator,
                       SampleWriter)
 from .utils.replay_buffers import ReplayBuffer

@@ -31,6 +31,9 @@ class AlgorithmConfig:
         self.hidden: tuple = (64, 64)
         self.seed: int = 0
         self.extra: Dict[str, Any] = {}
+        # multi-agent (reference: AlgorithmConfig.multi_agent)
+        self.policies: Optional[Dict[str, Any]] = None
+        self.policy_mapping_fn: Optional[Callable] = None
 
     def environment(self, env=None, *, env_config: Optional[Dict] = None):
         if env is not None:
@@ -63,6 +66,19 @@ class AlgorithmConfig:
     def debugging(self, *, seed: Optional[int] = None):
         if seed is not None:
             self.seed = seed
+        return self
+
+    def multi_agent(self, *, policies: Optional[Dict[str, Any]] = None,
+                    policy_mapping_fn: Optional[Callable] = None):
+        """Reference: AlgorithmConfig.multi_agent(policies=...,
+        policy_mapping_fn=...). `policies` maps module_id → None (infer
+        spaces from the env's first mapped agent) or (obs_dim,
+        num_actions). The mapping fn takes an agent id and returns a
+        module id."""
+        if policies is not None:
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def build(self) -> "Algorithm":

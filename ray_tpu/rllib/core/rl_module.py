@@ -209,3 +209,33 @@ class DQNModule(RLModule):
         explore = rng.integers(0, self.num_actions, size=greedy.shape)
         mask = rng.random(greedy.shape) < epsilon
         return np.where(mask, explore, greedy), {}
+
+
+class MultiRLModule:
+    """Container of named RLModules (reference:
+    rllib/core/rl_module/multi_rl_module.py MultiRLModule — dict of
+    module_id → RLModule sharing the Checkpointable surface). Params are a
+    dict pytree keyed the same way, so a single learner-state blob
+    round-trips all policies."""
+
+    def __init__(self, modules: Dict[str, RLModule]):
+        self.modules = dict(modules)
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self.modules[module_id]
+
+    def __contains__(self, module_id: str) -> bool:
+        return module_id in self.modules
+
+    def keys(self):
+        return self.modules.keys()
+
+    def items(self):
+        return self.modules.items()
+
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        return {mid: m.init_params(seed + i)
+                for i, (mid, m) in enumerate(sorted(self.modules.items()))}
+
+    def __reduce__(self):
+        return (type(self), (self.modules,))
