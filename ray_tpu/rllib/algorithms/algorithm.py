@@ -34,6 +34,10 @@ class AlgorithmConfig:
         # multi-agent (reference: AlgorithmConfig.multi_agent)
         self.policies: Optional[Dict[str, Any]] = None
         self.policy_mapping_fn: Optional[Callable] = None
+        # connector factories (reference: AlgorithmConfig connectors)
+        self.env_to_module_connector: Optional[Callable] = None
+        self.module_to_env_connector: Optional[Callable] = None
+        self.learner_connector: Optional[Callable] = None
 
     def environment(self, env=None, *, env_config: Optional[Dict] = None):
         if env is not None:
@@ -43,11 +47,21 @@ class AlgorithmConfig:
         return self
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None):
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector: Optional[Callable] = None,
+                    module_to_env_connector: Optional[Callable] = None):
+        """`*_connector` args are zero-arg factories returning a
+        ConnectorV2/pipeline (reference: AlgorithmConfig.env_runners
+        connector factories) — factories, so each runner actor gets its
+        own stateful copy."""
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def training(self, *, lr=None, gamma=None, train_batch_size=None,
@@ -60,6 +74,8 @@ class AlgorithmConfig:
             self.train_batch_size = train_batch_size
         if model is not None and "hidden" in model:
             self.hidden = tuple(model["hidden"])
+        if "learner_connector" in kwargs:
+            self.learner_connector = kwargs.pop("learner_connector")
         self.extra.update(kwargs)
         return self
 
@@ -111,10 +127,21 @@ class Algorithm:
         obs_dim, num_actions = _env_dims(config.env_spec, config.env_config)
         self.module = self._build_module(obs_dim, num_actions)
         self.learner = self._build_learner()
+        from ..connectors import default_env_to_module, default_module_to_env
+        # Driver-side connector copies for evaluate(); runner actors get
+        # their own (pickled) stateful copies, so running stats of e.g.
+        # NormalizeObservations are per-runner, as in the reference.
+        self._e2m = (config.env_to_module_connector()
+                     if config.env_to_module_connector
+                     else default_env_to_module())
+        self._m2e = (config.module_to_env_connector()
+                     if config.module_to_env_connector
+                     else default_module_to_env())
         if config.num_env_runners > 0:
             self.env_runner_group = EnvRunnerGroup(
                 config.env_spec, config.env_config, self.module,
-                num_env_runners=config.num_env_runners, seed=config.seed)
+                num_env_runners=config.num_env_runners, seed=config.seed,
+                env_to_module=self._e2m, module_to_env=self._m2e)
             if self.learner is not None:
                 self.env_runner_group.sync_weights(
                     self.learner.get_weights())
@@ -169,7 +196,6 @@ class Algorithm:
         Algorithm.evaluate)."""
         from ..env.env_runner import _make_env
         env = _make_env(self.config.env_spec, self.config.env_config)
-        from ..env.env_runner import unsquash_action
 
         params = self.get_weights()
         discrete = getattr(self.module, "discrete", True)
@@ -178,10 +204,18 @@ class Algorithm:
             obs, _ = env.reset(seed=10_000 + ep)
             done, total = False, 0.0
             while not done:
-                a = self.module.forward_inference(
-                    params, np.asarray(obs, np.float32)[None])
-                act = int(a[0]) if discrete else unsquash_action(
-                    np.asarray(a[0], np.float32), env.action_space)
+                # Same obs/action pipelines the module trained with.
+                obs_b = self._e2m(
+                    {"obs": np.asarray(obs, np.float32)[None]},
+                    module=self.module, update=False)["obs"]
+                a = self.module.forward_inference(params, obs_b)
+                out = self._m2e({"actions": a},
+                                action_space=env.action_space,
+                                module=self.module)
+                env_actions = out.get("env_actions", out["actions"])
+                act = (int(np.asarray(env_actions[0]).item())
+                       if discrete
+                       else np.asarray(env_actions[0], np.float32))
                 obs, rew, term, trunc, _ = env.step(act)
                 total += float(rew)
                 done = term or trunc

@@ -32,7 +32,8 @@ def _infer_policy_dims(env_spec, env_config, policies: Dict[str, Any],
     missing = [mid for mid in policies if mid not in resolved]
     if not missing:
         return resolved
-    env = env_spec(env_config or {}) if callable(env_spec) else env_spec
+    constructed = callable(env_spec)
+    env = env_spec(env_config or {}) if constructed else env_spec
     try:
         for agent_id in env.possible_agents:
             mid = map_fn(agent_id)
@@ -49,7 +50,8 @@ def _infer_policy_dims(env_spec, env_config, policies: Dict[str, Any],
                 f"No agent maps to policies {missing}; give explicit "
                 f"(obs_dim, num_actions) specs for them.")
     finally:
-        env.close()
+        if constructed:  # never close a user-provided instance
+            env.close()
     return resolved
 
 
@@ -62,6 +64,13 @@ class MultiAgentPPO(Algorithm):
             raise ValueError(
                 "MultiAgentPPO needs config.multi_agent(policies=..., "
                 "policy_mapping_fn=...)")
+        if (config.env_to_module_connector
+                or config.module_to_env_connector
+                or config.learner_connector):
+            raise ValueError(
+                "Connector pipelines are not supported by MultiAgentPPO "
+                "yet; transform observations/actions inside the env or "
+                "module instead.")
         self.config = config
         self.iteration = 0
         self._total_steps = 0

@@ -92,8 +92,10 @@ class PPO(Algorithm):
             clip=float(ex.get("clip_param", 0.2)),
             vf_coeff=float(ex.get("vf_loss_coeff", 0.5)),
             entropy_coeff=float(ex.get("entropy_coeff", 0.01)))
+        conn = (self.config.learner_connector()
+                if self.config.learner_connector else None)
         return JaxLearner(self.module, loss, lr=self.config.lr,
-                          seed=self.config.seed)
+                          seed=self.config.seed, connector=conn)
 
     def training_step(self) -> Dict:
         cfg = self.config

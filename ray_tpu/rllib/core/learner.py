@@ -21,9 +21,13 @@ class JaxLearner:
 
     def __init__(self, module, loss_fn: Callable,
                  lr: float = 3e-4, max_grad_norm: float = 0.5,
-                 seed: int = 0, use_mesh: bool = True):
+                 seed: int = 0, use_mesh: bool = True,
+                 connector: Optional[Callable] = None):
         self.module = module
         self.loss_fn = loss_fn
+        # Learner connector: numpy batch transform applied before the
+        # jitted update (reference: rllib/connectors/learner/).
+        self.connector = connector
         self.params = module.init_params(seed)
         self.tx = optax.chain(
             optax.clip_by_global_norm(max_grad_norm),
@@ -71,6 +75,8 @@ class JaxLearner:
         return out
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self.connector is not None:
+            batch = self.connector(dict(batch), module=self.module)
         db = self._device_batch(batch)
         self.params, self.opt_state, loss, aux = self._update(
             self.params, self.opt_state, db)

@@ -36,13 +36,20 @@ def unsquash_action(action: np.ndarray, space) -> np.ndarray:
 
 
 class SingleAgentEnvRunner:
-    """Reference: single_agent_env_runner.py:65."""
+    """Reference: single_agent_env_runner.py:65. Optional connector
+    pipelines customize the obs→module and module→env paths
+    (reference: AlgorithmConfig.env_to_module_connector /
+    module_to_env_connector; rllib/connectors/)."""
 
-    def __init__(self, env_spec, env_config: Dict, module, seed: int = 0):
+    def __init__(self, env_spec, env_config: Dict, module, seed: int = 0,
+                 env_to_module=None, module_to_env=None):
+        from ..connectors import default_env_to_module, default_module_to_env
         self.env = _make_env(env_spec, env_config or {})
         self.module = module
         self.params = None
         self.rng = np.random.default_rng(seed)
+        self._env_to_module = env_to_module or default_env_to_module()
+        self._module_to_env = module_to_env or default_module_to_env()
         self._obs, _ = self.env.reset(seed=seed)
         self._episode_return = 0.0
         self._episode_len = 0
@@ -62,27 +69,39 @@ class SingleAgentEnvRunner:
                                   "truncateds", "next_obs")}
         extras: Dict[str, List] = {}
         for _ in range(num_steps):
-            obs_b = np.asarray(self._obs, np.float32)[None]
+            raw_obs = np.asarray(self._obs, np.float32)[None]
+            obs_b = self._env_to_module(
+                {"obs": raw_obs}, module=self.module)["obs"]
             if explore:
                 action, info = self.module.forward_exploration(
                     self.params, obs_b, self.rng, **explore_kw)
             else:
                 action, info = self.module.forward_inference(
                     self.params, obs_b), {}
+            # The BATCH keeps the module's action (what the critic sees);
+            # the env gets the connector-transformed one (default
+            # pipeline: unsquash into Box bounds, no-op for discrete).
+            out = self._module_to_env(
+                {"actions": action}, action_space=self.env.action_space,
+                module=self.module)
+            env_actions = out.get("env_actions", out["actions"])
             if getattr(self.module, "discrete", True):
-                a = env_a = int(action[0])
+                a = int(action[0])
+                env_a = int(np.asarray(env_actions[0]).item()) \
+                    if np.ndim(env_actions[0]) == 0 else env_actions[0]
             else:
-                # The BATCH keeps the squashed action (what the critic
-                # sees); the env gets the unsquashed one.
                 a = np.asarray(action[0], np.float32)
-                env_a = unsquash_action(a, self.env.action_space)
+                env_a = np.asarray(env_actions[0], np.float32)
             nxt, rew, term, trunc, _ = self.env.step(env_a)
-            cols["obs"].append(np.asarray(self._obs, np.float32))
+            nxt_b = self._env_to_module(
+                {"obs": np.asarray(nxt, np.float32)[None]},
+                module=self.module, update=False)["obs"]
+            cols["obs"].append(obs_b[0])
             cols["actions"].append(a)
             cols["rewards"].append(float(rew))
             cols["terminateds"].append(bool(term))
             cols["truncateds"].append(bool(trunc))
-            cols["next_obs"].append(np.asarray(nxt, np.float32))
+            cols["next_obs"].append(nxt_b[0])
             for k, v in info.items():
                 extras.setdefault(k, []).append(np.asarray(v[0]))
             self._episode_return += float(rew)
@@ -113,12 +132,14 @@ class EnvRunnerGroup:
     """Reference: env_runner_group.py — N runner actors + fan-out."""
 
     def __init__(self, env_spec, env_config: Dict, module,
-                 num_env_runners: int = 2, seed: int = 0):
+                 num_env_runners: int = 2, seed: int = 0,
+                 env_to_module=None, module_to_env=None):
         if not ray_tpu.is_initialized():
             ray_tpu.init(ignore_reinit_error=True)
         Runner = ray_tpu.remote(SingleAgentEnvRunner)
         self._runners = [
-            Runner.remote(env_spec, env_config, module, seed + i)
+            Runner.remote(env_spec, env_config, module, seed + i,
+                          env_to_module, module_to_env)
             for i in range(max(1, num_env_runners))]
         ray_tpu.get([r.ping.remote() for r in self._runners])
 

@@ -18,7 +18,9 @@ boundary is jax.distributed + mesh sharding instead of torch DDP.
     ).fit()
 """
 
-from .backend import BackendConfig, JaxBackendConfig, TorchBackendConfig
+from .backend import (BackendConfig, HorovodBackendConfig,
+                      JaxBackendConfig, TensorflowBackendConfig,
+                      TorchBackendConfig)
 from .checkpoint import Checkpoint, CheckpointManager
 from .config import (
     CheckpointConfig,
@@ -37,8 +39,10 @@ from .session import (
 from .trainer import (
     BaseTrainer,
     DataParallelTrainer,
+    HorovodTrainer,
     JaxTrainer,
     Result,
+    TensorflowTrainer,
     TorchTrainer,
 )
 from .gbdt import LightGBMTrainer, XGBoostTrainer
@@ -46,8 +50,10 @@ from .gbdt import LightGBMTrainer, XGBoostTrainer
 __all__ = [
     "BackendConfig", "BaseTrainer", "Checkpoint", "CheckpointConfig",
     "CheckpointManager", "DataParallelTrainer", "FailureConfig",
-    "JaxBackendConfig", "JaxTrainer", "LightGBMTrainer", "Result",
-    "RunConfig", "ScalingConfig", "TorchBackendConfig", "TorchTrainer",
-    "XGBoostTrainer", "get_checkpoint", "get_context",
-    "get_dataset_shard", "get_world_rank", "get_world_size", "report",
+    "HorovodBackendConfig", "HorovodTrainer", "JaxBackendConfig",
+    "JaxTrainer", "LightGBMTrainer", "Result", "RunConfig",
+    "ScalingConfig", "TensorflowBackendConfig", "TensorflowTrainer",
+    "TorchBackendConfig", "TorchTrainer", "XGBoostTrainer",
+    "get_checkpoint", "get_context", "get_dataset_shard",
+    "get_world_rank", "get_world_size", "report",
 ]

@@ -90,3 +90,78 @@ class TorchBackendConfig(BackendConfig):
             init_method=f"tcp://{host}:{port}",
             world_size=context.world_size,
             rank=context.world_rank)
+
+
+@dataclass
+class TensorflowBackendConfig(BackendConfig):
+    """Writes TF_CONFIG across the worker group (reference:
+    train/tensorflow/config.py:24-37 _setup_tensorflow_environment →
+    MultiWorkerMirroredStrategy). Each worker publishes host:port via the
+    GCS KV, waits for the full roster, and exports the standard TF_CONFIG
+    JSON; tf.distribute picks it up from there."""
+
+    timeout_s: float = 60.0
+
+    def backend_name(self) -> str:
+        return "tensorflow"
+
+    def on_start(self, context) -> None:
+        if context.world_size <= 1:
+            return
+        import json
+        import os
+        import time
+
+        from ..util.collective.collective_group.xla_collective_group import (
+            _free_port,
+            _kv_get,
+            _kv_put,
+        )
+        # context.experiment_name embeds a fresh per-attempt uid
+        # (controller.py make_context), so restarted groups never read a
+        # previous attempt's roster keys.
+        group = f"tf/{context.experiment_name}"
+        addr = f"127.0.0.1:{_free_port()}"
+        _kv_put(f"{group}/addr/{context.world_rank}", addr.encode())
+        roster = [None] * context.world_size
+        deadline = time.monotonic() + self.timeout_s
+        while time.monotonic() < deadline:
+            for r in range(context.world_size):
+                if roster[r] is None:
+                    raw = _kv_get(f"{group}/addr/{r}")
+                    if raw:
+                        roster[r] = raw.decode()
+            if all(roster):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(
+                f"TF_CONFIG roster incomplete after {self.timeout_s}s: "
+                f"{roster}")
+        os.environ["TF_CONFIG"] = json.dumps({
+            "cluster": {"worker": roster},
+            "task": {"type": "worker", "index": context.world_rank},
+        })
+
+
+@dataclass
+class HorovodBackendConfig(BackendConfig):
+    """Reference: train/horovod/config.py HorovodConfig. Horovod is a
+    torch/TF allreduce runtime not present in this image (and redundant on
+    TPU, where XLA emits the collectives); the config gates with guidance
+    rather than silently no-op."""
+
+    def backend_name(self) -> str:
+        return "horovod"
+
+    def on_start(self, context) -> None:
+        try:
+            import horovod  # noqa: F401
+        except ImportError:
+            raise ImportError(
+                "horovod is not installed in this environment. On TPU use "
+                "JaxBackendConfig (XLA emits the allreduce) or "
+                "TorchBackendConfig (gloo) for host-side torch code."
+            ) from None
+        import horovod.torch as hvd
+        hvd.init()

@@ -198,5 +198,46 @@ class JaxTrainer(DataParallelTrainer):
                          **kwargs)
 
 
-# Reference-compat alias: TorchTrainer users port by renaming.
-TorchTrainer = JaxTrainer
+class TorchTrainer(DataParallelTrainer):
+    """Reference: train/torch/torch_trainer.py TorchTrainer. Runs the
+    user loop with a torch.distributed gloo group across the workers
+    (torch/config.py:156 on_start); on this framework torch stays a
+    host-side library — device math belongs to JaxTrainer's mesh path."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 torch_config: Optional["TorchBackendConfig"] = None,
+                 **kwargs):
+        from .backend import TorchBackendConfig
+        kwargs.pop("backend_config", None)
+        super().__init__(train_loop_per_worker,
+                         backend_config=torch_config or TorchBackendConfig(),
+                         **kwargs)
+
+
+class TensorflowTrainer(DataParallelTrainer):
+    """Reference: train/tensorflow/tensorflow_trainer.py. The backend
+    writes TF_CONFIG (tensorflow/config.py:24-37) so the user loop can
+    build a MultiWorkerMirroredStrategy."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 tensorflow_config=None, **kwargs):
+        from .backend import TensorflowBackendConfig
+        kwargs.pop("backend_config", None)
+        super().__init__(
+            train_loop_per_worker,
+            backend_config=tensorflow_config or TensorflowBackendConfig(),
+            **kwargs)
+
+
+class HorovodTrainer(DataParallelTrainer):
+    """Reference: train/horovod/horovod_trainer.py (gated: horovod is not
+    in this image; see HorovodBackendConfig)."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 horovod_config=None, **kwargs):
+        from .backend import HorovodBackendConfig
+        kwargs.pop("backend_config", None)
+        super().__init__(
+            train_loop_per_worker,
+            backend_config=horovod_config or HorovodBackendConfig(),
+            **kwargs)

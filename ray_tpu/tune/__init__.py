@@ -27,6 +27,19 @@ from .search import (
     sample_from,
     uniform,
 )
+from .searchers import (
+    AxSearch,
+    ConcurrencyLimiter,
+    HEBOSearch,
+    HyperOptSearch,
+    NevergradSearch,
+    OptunaSearch,
+    RandomSearch,
+    Searcher,
+    TPESearcher,
+    TuneBOHB,
+    ZOOptSearch,
+)
 from .session import get_checkpoint, get_trial_dir, get_trial_id, report
 from .trainable import Trainable, with_parameters, with_resources
 from .tuner import TuneConfig, Tuner

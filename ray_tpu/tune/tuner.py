@@ -44,6 +44,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[sched_mod.TrialScheduler] = None
+    # Sequential search algorithm (reference: TuneConfig.search_alg →
+    # tune/search/ Searcher); None = BasicVariantGenerator up front.
+    search_alg: Optional[Any] = None
     time_budget_s: Optional[float] = None
     seed: Optional[int] = None
 
@@ -168,6 +171,8 @@ class _TuneController:
         os.makedirs(self._exp_dir, exist_ok=True)
         self._stop_conditions = dict(getattr(run_config, "stop", None) or {})
         self._trials: List[_Trial] = []
+        self._searcher = None
+        self._suggest_budget = 0
         if restored_state is not None:
             for ts in restored_state["trials"]:
                 tr = _Trial(trial_id=ts["trial_id"], config=ts["config"],
@@ -181,6 +186,13 @@ class _TuneController:
                     tr.state = PENDING
                     tr.restore_from = tr.checkpoint_path
                 self._trials.append(tr)
+        elif tune_config.search_alg is not None:
+            # Lazy suggestion loop: trials materialize as the searcher
+            # proposes them (reference: TuneController + SearchGenerator).
+            self._searcher = tune_config.search_alg
+            self._searcher.set_search_properties(
+                tune_config.metric, tune_config.mode, param_space)
+            self._suggest_budget = tune_config.num_samples
         else:
             gen = BasicVariantGenerator(param_space, tune_config.num_samples,
                                         tune_config.seed)
@@ -251,6 +263,9 @@ class _TuneController:
                 t.actor = None
                 t.run_ref = None
         self._scheduler.on_trial_complete(t.trial_id)
+        if self._searcher is not None and t.state in (TERMINATED, ERRORED):
+            self._searcher.on_trial_complete(
+                t.trial_id, t.last_result, error=(t.state == ERRORED))
         self._save_state()
 
     def _drain_reports(self, t: _Trial):
@@ -308,6 +323,20 @@ class _TuneController:
         while True:
             running = [t for t in self._trials if t.state == RUNNING]
             pending = [t for t in self._trials if t.state == PENDING]
+            # Searcher-driven mode: materialize new trials on demand
+            # until the sample budget is spent (a ConcurrencyLimiter may
+            # return None to backpressure; retry after completions).
+            while (self._searcher is not None and self._suggest_budget > 0
+                   and len(running) + len(pending) < max_conc):
+                trial_id = f"trial_{uuid.uuid4().hex[:8]}"
+                cfg = self._searcher.suggest(trial_id)
+                if cfg is None:
+                    break
+                tr = _Trial(trial_id=trial_id, config=cfg)
+                tr.dir = os.path.join(self._exp_dir, tr.trial_id)
+                self._trials.append(tr)
+                pending.append(tr)
+                self._suggest_budget -= 1
             if not running and not pending:
                 break
             budget_spent = (self._tc.time_budget_s is not None and

@@ -246,6 +246,11 @@ def delete(workflow_id: str):
     shutil.rmtree(path, ignore_errors=True)
 
 
+from .events import (EventListener, FileEventListener, HTTPEventProvider,
+                     TimerListener, deliver_event, wait_for_event)
+
 __all__ = ["CANCELED", "FAILED", "RESUMABLE", "RUNNING", "SUCCESSFUL",
-           "cancel", "delete", "get_output", "get_status", "init",
-           "list_all", "resume", "run", "run_async"]
+           "EventListener", "FileEventListener", "HTTPEventProvider",
+           "TimerListener", "cancel", "delete", "deliver_event",
+           "get_output", "get_status", "init", "list_all", "resume",
+           "run", "run_async", "wait_for_event"]

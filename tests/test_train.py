@@ -247,3 +247,45 @@ class TestJaxTrainer:
         ).fit()
         assert result.error is None, result.error
         assert result.metrics["sum0"] == 3.0  # 1 + 2
+
+
+class TestFrameworkBackends:
+    """Reference: per-framework Backend.on_start hooks
+    (torch/config.py:156, tensorflow/config.py:24-37, horovod)."""
+
+    def test_torch_trainer_gloo_allreduce(self, ray_start_shared):
+        from ray_tpu import train
+        from ray_tpu.train import ScalingConfig, TorchTrainer
+
+        def loop(config):
+            import torch
+            import torch.distributed as dist
+            t = torch.ones(2) * (train.get_world_rank() + 1)
+            dist.all_reduce(t)  # 1+2 = 3 across 2 workers
+            train.report({"sum0": float(t[0])})
+
+        result = TorchTrainer(
+            loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+        assert result.metrics["sum0"] == 3.0
+
+    def test_tensorflow_trainer_writes_tf_config(self, ray_start_shared):
+        from ray_tpu import train
+        from ray_tpu.train import ScalingConfig, TensorflowTrainer
+
+        def loop(config):
+            import json
+            import os
+            cfg = json.loads(os.environ["TF_CONFIG"])
+            assert len(cfg["cluster"]["worker"]) == 2
+            train.report({"index": cfg["task"]["index"]})
+
+        result = TensorflowTrainer(
+            loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+        assert result.metrics["index"] in (0, 1)
+
+    def test_horovod_trainer_gated(self, ray_start_shared):
+        from ray_tpu.train import HorovodTrainer, ScalingConfig
+        result = HorovodTrainer(
+            lambda config: None,
+            scaling_config=ScalingConfig(num_workers=2)).fit()
+        assert result.error is not None and "horovod" in str(result.error)

@@ -107,3 +107,72 @@ def test_delete():
     workflow.delete("wf_del")
     assert workflow.get_status("wf_del") is None
     assert "wf_del" not in dict(workflow.list_all())
+
+
+class TestWorkflowEvents:
+    """Reference: workflow/event_listener.py + http_event_provider.py.
+    Uses the module _cluster fixture's runtime; each test points workflow
+    storage at its own tmp dir."""
+
+    def test_wait_for_event_delivered(self, tmp_path):
+        import threading
+
+        workflow.init(str(tmp_path / "wf"))
+
+        @ray_tpu.remote
+        def combine(payload, y):
+            return (payload, y)
+
+        node = combine.bind(
+            workflow.wait_for_event(workflow.FileEventListener,
+                                    "evt-1", timeout_s=20), 7)
+        threading.Timer(
+            0.5, lambda: workflow.deliver_event("evt-1", {"n": 41})
+        ).start()
+        out = workflow.run(node, workflow_id="wf_evt")
+        assert out == ({"n": 41}, 7)
+        # Durability: resume returns the checkpointed payload without
+        # waiting again (the event file could be long gone).
+        assert workflow.resume("wf_evt") == ({"n": 41}, 7)
+
+    def test_event_timeout(self, tmp_path):
+        workflow.init(str(tmp_path / "wf2"))
+        node = workflow.wait_for_event(workflow.FileEventListener,
+                                       "never", timeout_s=0.3,
+                                       poll_interval_s=0.05)
+        with pytest.raises(Exception):
+            workflow.run(node, workflow_id="wf_timeout", max_retries=0)
+        assert workflow.get_status("wf_timeout") == workflow.FAILED
+
+    def test_http_event_provider(self, tmp_path):
+        import json as _json
+        import threading
+        import urllib.request
+
+        workflow.init(str(tmp_path / "wf3"))
+        provider = workflow.HTTPEventProvider().start()
+        try:
+            def _post():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{provider.port}/event/http-evt",
+                    data=_json.dumps({"ok": True}).encode(),
+                    headers={"Content-Type": "application/json"})
+                assert _json.loads(urllib.request.urlopen(req).read())[
+                    "status"] == "ok"
+
+            threading.Timer(0.5, _post).start()
+            node = workflow.wait_for_event(workflow.FileEventListener,
+                                           "http-evt", timeout_s=20)
+            out = workflow.run(node, workflow_id="wf_http")
+            assert out == {"ok": True}
+        finally:
+            provider.stop()
+
+    def test_timer_listener(self, tmp_path):
+        import time as _t
+
+        workflow.init(str(tmp_path / "wf4"))
+        t0 = _t.time()
+        node = workflow.wait_for_event(workflow.TimerListener, 0.3)
+        out = workflow.run(node, workflow_id="wf_timer")
+        assert out >= t0 + 0.3
