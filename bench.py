@@ -72,6 +72,8 @@ def bench_core(extras):
     put_get_rate = n / (time.perf_counter() - t0)
 
     big = np.zeros((1 << 28,), dtype=np.uint8)  # 256 MB
+    ref = ray_tpu.put(big)  # warmup: fault in source pages, prime tmpfs
+    del ref
     t0 = time.perf_counter()
     iters = 4
     for _ in range(iters):

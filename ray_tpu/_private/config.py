@@ -71,6 +71,10 @@ class RayConfig:
         # sqlite file for durable GCS KV ("" = in-memory only; reference:
         # Redis-backed GCS fault tolerance, store_client/redis_store_client)
         "gcs_storage_path": "",
+        # CPU-pool workers boot python -S (skip sitecustomize's eager
+        # jax/TPU-plugin import, ~5s per process). Disable if user code
+        # depends on site customizations inside CPU workers.
+        "worker_lean_boot": True,
     }
 
     def __init__(self):

@@ -108,8 +108,10 @@ class ObjectStore:
         return self._capacity
 
     # -- write path --------------------------------------------------------
-    def create(self, object_id: ObjectID, size: int) -> memoryview:
-        """Allocate a segment and return a writable view (then `seal`)."""
+    def _reserve(self, object_id: ObjectID, size: int) -> int:
+        """Capacity-check (evict graveyard, spill LRU), create the shm
+        file, and register an unsealed segment. Returns the open fd;
+        callers write then seal (or _abort_reserve on failure)."""
         with self._lock:
             if self._used + size > self._capacity:
                 self._collect_graveyard()
@@ -123,23 +125,56 @@ class ObjectStore:
                     )
             path = self._path(object_id)
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
-            try:
-                os.ftruncate(fd, size)
-                mm = mmap.mmap(fd, size)
-            finally:
-                os.close(fd)
-            self._segments[object_id] = _Segment(path, mm, size)
+            # mm attaches lazily on first read (_open handles mm=None).
+            self._segments[object_id] = _Segment(
+                path, None, size)  # type: ignore[arg-type]
             self._used += size
-            return memoryview(mm)
+            return fd
+
+    def _abort_reserve(self, object_id: ObjectID):
+        """Roll back a failed write: no partial file may remain, or a
+        reader would mmap truncated data as if sealed."""
+        with self._lock:
+            seg = self._segments.pop(object_id, None)
+            if seg is not None:
+                self._used -= seg.size
+            try:
+                os.unlink(self._path(object_id))
+            except OSError:
+                pass
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        """Allocate a segment and return a writable view (then `seal`)."""
+        fd = self._reserve(object_id, size)
+        try:
+            os.ftruncate(fd, size)
+            mm = mmap.mmap(fd, size)
+        except BaseException:
+            os.close(fd)
+            self._abort_reserve(object_id)
+            raise
+        os.close(fd)
+        with self._lock:
+            seg = self._segments.get(object_id)
+            if seg is not None:
+                seg.mm = mm
+        return memoryview(mm)
 
     def put_serialized(self, object_id: ObjectID,
                        sobj: serialization.SerializedObject) -> int:
+        """Write path: plain write(2) into the shm file (no mmap — a
+        store-side mapping would fault a page per 4 KiB; see
+        SerializedObject.write_to_fd). Readers mmap lazily on first get.
+        """
         size = sobj.total_size
-        view = self.create(object_id, size)
+        fd = self._reserve(object_id, size)
         try:
-            sobj.write_into(view)
-        finally:
-            view.release()
+            sobj.write_to_fd(fd)
+        except BaseException:
+            os.close(fd)
+            self._abort_reserve(object_id)
+            raise
+        os.close(fd)
         self.seal(object_id)
         return size
 

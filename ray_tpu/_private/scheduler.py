@@ -260,6 +260,29 @@ class WorkerPool:
             collections.deque)
         self.workers: Dict[WorkerID, WorkerHandle] = {}
 
+    def _lean_boot_safe(self) -> bool:
+        """-S skips .pth processing; editable installs (pip's
+        __editable__*.pth import finders) would silently vanish from
+        workers, so their presence disables lean boot (cached)."""
+        cached = getattr(self, "_lean_boot_safe_cached", None)
+        if cached is None:
+            import glob
+            import site
+            cached = True
+            try:
+                dirs = list(site.getsitepackages())
+                user = site.getusersitepackages()
+                if user:
+                    dirs.append(user)
+                for d in dirs:
+                    if glob.glob(os.path.join(d, "__editable__*.pth")):
+                        cached = False
+                        break
+            except Exception:
+                cached = False
+            self._lean_boot_safe_cached = cached
+        return cached
+
     def start_worker(self, env_key: str = "",
                      extra_env: Optional[Dict[str, str]] = None
                      ) -> WorkerHandle:
@@ -300,9 +323,22 @@ class WorkerPool:
             [repo_root] + driver_paths
             + ([proc_env["PYTHONPATH"]] if proc_env.get("PYTHONPATH")
                else []))
+        argv = [sys.executable, "-m", "ray_tpu._private.worker_proc"]
+        from .config import ray_config
+        if (bool(ray_config.worker_lean_boot)
+                and self._lean_boot_safe()
+                and env.get("JAX_PLATFORMS") == "cpu"
+                and "TPU_VISIBLE_CHIPS" not in env):
+            # CPU-pool workers boot with -S: this environment's
+            # sitecustomize imports jax + a TPU plugin (~5 s of CPU per
+            # process — measured), which a cpu-pinned worker never needs.
+            # PYTHONPATH below already carries site-packages via the
+            # driver's sys.path, so imports resolve identically. TPU
+            # workers (chips assigned / JAX_PLATFORMS overridden) keep
+            # the full site so the TPU backend plugin registers.
+            argv.insert(1, "-S")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_proc"],
-            env=proc_env, cwd=os.getcwd(),
+            argv, env=proc_env, cwd=os.getcwd(),
             start_new_session=False)
         # accept() with a poll loop: a worker that dies on boot (bad env,
         # OOM kill) must not hang the dispatch thread forever.

@@ -196,6 +196,15 @@ class Algorithm:
         Algorithm.evaluate)."""
         from ..env.env_runner import _make_env
         env = _make_env(self.config.env_spec, self.config.env_config)
+        # Stateful connector pieces (running obs stats) accumulate in the
+        # runner actors; sync them onto the driver copy so evaluation
+        # normalizes with the stats the policy trained under.
+        if self.env_runner_group is not None \
+                and hasattr(self._e2m, "set_state"):
+            try:
+                self._e2m.set_state(self.env_runner_group.connector_state())
+            except Exception:
+                pass
 
         params = self.get_weights()
         discrete = getattr(self.module, "discrete", True)

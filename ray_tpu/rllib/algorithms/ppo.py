@@ -92,14 +92,21 @@ class PPO(Algorithm):
             clip=float(ex.get("clip_param", 0.2)),
             vf_coeff=float(ex.get("vf_loss_coeff", 0.5)),
             entropy_coeff=float(ex.get("entropy_coeff", 0.01)))
-        conn = (self.config.learner_connector()
-                if self.config.learner_connector else None)
+        # PPO applies the learner connector to fragments BEFORE GAE
+        # (training_step) — clipping rewards after advantages are
+        # computed would be a silent no-op, since the loss reads only
+        # advantages/value_targets.
+        self._learner_conn = (self.config.learner_connector()
+                              if self.config.learner_connector else None)
         return JaxLearner(self.module, loss, lr=self.config.lr,
-                          seed=self.config.seed, connector=conn)
+                          seed=self.config.seed)
 
     def training_step(self) -> Dict:
         cfg = self.config
         frags = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        if self._learner_conn is not None:
+            frags = [self._learner_conn(dict(b), module=self.module)
+                     for b in frags]
         params = self.learner.get_weights()
 
         def _gae(b):

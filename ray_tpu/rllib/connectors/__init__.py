@@ -77,6 +77,23 @@ class ConnectorPipelineV2(ConnectorV2):
     def __len__(self):
         return len(self.connectors)
 
+    # -- state (reference: ConnectorV2 get_state/set_state for
+    #    checkpointing and runner→driver sync) ----------------------------
+    def get_state(self) -> Dict[str, Any]:
+        out = {}
+        for i, c in enumerate(self.connectors):
+            getter = getattr(c, "get_state", None)
+            if getter is not None:
+                out[f"{i}:{c.name}"] = getter()
+        return out
+
+    def set_state(self, state: Dict[str, Any]):
+        for i, c in enumerate(self.connectors):
+            setter = getattr(c, "set_state", None)
+            key = f"{i}:{c.name}"
+            if setter is not None and key in state:
+                setter(state[key])
+
 
 class Lambda(ConnectorV2):
     """Wrap a plain function (must be picklable for remote runners)."""

@@ -124,6 +124,12 @@ class SingleAgentEnvRunner:
         out, self._completed = self._completed, []
         return out
 
+    def get_connector_state(self):
+        """Stateful connector pieces' state (e.g. NormalizeObservations
+        running stats) for driver-side sync before evaluate()."""
+        getter = getattr(self._env_to_module, "get_state", None)
+        return getter() if getter is not None else {}
+
     def ping(self) -> bool:
         return True
 
@@ -161,6 +167,11 @@ class EnvRunnerGroup:
                               for r in self._runners]):
             out.extend(m)
         return out
+
+    def connector_state(self):
+        """Runner 0's env_to_module connector state (reference: the
+        driver merging runner connector states before eval)."""
+        return ray_tpu.get(self._runners[0].get_connector_state.remote())
 
     def stop(self):
         for r in self._runners:

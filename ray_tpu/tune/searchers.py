@@ -123,12 +123,17 @@ def _from_unit(domain: Domain, u: float) -> Any:
         return math.exp(lo + u * (hi - lo))
     if isinstance(domain, QUniform):
         v = domain.lower + u * (domain.upper - domain.lower)
-        return round(v / domain.q) * domain.q
+        # q-rounding can land outside [lower, upper] — clamp like
+        # Domain.sample() does.
+        return min(domain.upper,
+                   max(domain.lower, round(v / domain.q) * domain.q))
     if isinstance(domain, Uniform):
         return domain.lower + u * (domain.upper - domain.lower)
     if isinstance(domain, QRandInt):
         v = domain.lower + u * max(1, domain.upper - domain.lower)
-        return int(round(v / domain.q) * domain.q)
+        return int(min(domain.upper,
+                       max(domain.lower,
+                           round(v / domain.q) * domain.q)))
     if isinstance(domain, RandInt):
         return int(min(domain.upper - 1,
                        domain.lower + u * (domain.upper - domain.lower)))

@@ -138,3 +138,42 @@ class TestDiscreteModuleToEnv:
         batch = runner.sample(5)
         assert len(seen) == 5
         assert batch["actions"].dtype.kind in "iu"
+
+
+class TestConnectorStateSync:
+    def test_pipeline_state_roundtrip(self):
+        p = ConnectorPipelineV2([FlattenObservations(),
+                                 NormalizeObservations()])
+        p({"obs": np.random.default_rng(0).normal(3, 2, (50, 4))})
+        st = p.get_state()
+        q = ConnectorPipelineV2([FlattenObservations(),
+                                 NormalizeObservations()])
+        q.set_state(st)
+        x = np.ones((1, 4))
+        np.testing.assert_allclose(
+            p({"obs": x}, update=False)["obs"],
+            q({"obs": x}, update=False)["obs"])
+
+    def test_evaluate_uses_runner_stats(self, shutdown_only):
+        """Regression: evaluate() must sync runner-side NormalizeObs
+        stats instead of normalizing with empty driver stats."""
+        import ray_tpu
+        from ray_tpu.rllib import PPOConfig
+        ray_tpu.init(num_cpus=2)
+        config = (PPOConfig()
+                  .environment("CartPole-v1")
+                  .env_runners(
+                      num_env_runners=1, rollout_fragment_length=64,
+                      env_to_module_connector=lambda: ConnectorPipelineV2(
+                          [FlattenObservations(),
+                           NormalizeObservations()]))
+                  .training(lr=1e-3, minibatch_size=32, num_epochs=1)
+                  .debugging(seed=0))
+        algo = config.build()
+        algo.train()
+        ev = algo.evaluate(num_episodes=2)
+        # Driver connector must have adopted non-empty runner stats.
+        norm = algo._e2m.connectors[1]
+        assert norm.count > 0
+        assert np.isfinite(ev["evaluation_return_mean"])
+        algo.stop()
