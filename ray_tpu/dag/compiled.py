@@ -43,43 +43,55 @@ class CompiledDAGRef:
 _CONST, _CHAN = 0, 1
 
 
-def _run_actor_loop(instance, method_name: str, arg_plan, kwarg_plan,
-                    channels: Dict[str, Channel], out_chan: Channel):
-    """Persistent per-actor execution loop; runs as one long actor task
+def _run_actor_loop(instance, stages):
+    """Persistent per-ACTOR execution loop; runs as one long actor task
     (reference: the compiled-DAG worker loop in compiled_dag_node.py
-    _execute_until)."""
-    method = getattr(instance, method_name)
+    _execute_until executes the actor's full schedule each iteration).
+    `stages` holds this actor's nodes in topo order:
+    (method_name, arg_plan, kwarg_plan, channels, out_chan). One loop per
+    actor — not per node — so multi-method DAGs need no actor
+    concurrency and intra-actor edges resolve within one iteration."""
+    bound = [(getattr(instance, m), ap, kp, chans, out)
+             for (m, ap, kp, chans, out) in stages]
     try:
         while True:
-            try:
-                values = {cid: ch.read() for cid, ch in channels.items()}
-            except ChannelClosedError:
+            stop = False
+            for method, arg_plan, kwarg_plan, channels, out_chan in bound:
+                try:
+                    values = {cid: ch.read()
+                              for cid, ch in channels.items()}
+                except ChannelClosedError:
+                    stop = True
+                    break
+
+                def _resolve(kind, payload):
+                    if kind == _CONST:
+                        return payload
+                    cid, key = payload
+                    v = values[cid]
+                    return v if key is None else v[key]
+
+                args = [_resolve(k, p) for k, p in arg_plan]
+                kwargs = {k: _resolve(kind, p)
+                          for k, (kind, p) in kwarg_plan.items()}
+                upstream_err = next(
+                    (v for v in list(args) + list(kwargs.values())
+                     if isinstance(v, _WrappedError)), None)
+                if upstream_err is not None:
+                    out = upstream_err  # forward, don't recompute
+                else:
+                    try:
+                        out = method(*args, **kwargs)
+                    except Exception as e:  # ship downstream, keep looping
+                        out = _WrappedError(e)
+                out_chan.write(out)
+            if stop:
                 break
-            args = []
-            for kind, payload in arg_plan:
-                if kind == _CONST:
-                    args.append(payload)
-                else:
-                    cid, key = payload
-                    v = values[cid]
-                    args.append(v if key is None else v[key])
-            kwargs = {}
-            for k, (kind, payload) in kwarg_plan.items():
-                if kind == _CONST:
-                    kwargs[k] = payload
-                else:
-                    cid, key = payload
-                    v = values[cid]
-                    kwargs[k] = v if key is None else v[key]
-            try:
-                out = method(*args, **kwargs)
-            except Exception as e:  # ship the error downstream, keep looping
-                out = _WrappedError(e)
-            out_chan.write(out)
     finally:
-        out_chan.close_writer()
-        for ch in channels.values():
-            ch.detach()
+        for _, _, _, channels, out_chan in bound:
+            out_chan.close_writer()
+            for ch in channels.values():
+                ch.detach()
     return "adag-loop-done"
 
 
@@ -171,8 +183,14 @@ class CompiledDAG:
                     kwarg_plan[k] = (_CHAN, (str(c[0]), c[1]))
             if uses_input:
                 input_consumers.append(n)
-            for u in ups:
-                node_consumers.setdefault(id(u), []).append(n)
+            # Dedupe: a node binding the same upstream in two argument
+            # positions still holds ONE reader slot — counting it twice
+            # would inflate num_readers past the attached handles and
+            # deadlock the producer's second write.
+            for uid in {id(u): u for u in ups}:
+                consumers = node_consumers.setdefault(uid, [])
+                if n not in consumers:
+                    consumers.append(n)
             plans[id(n)] = (arg_plan, kwarg_plan)
 
         if not input_consumers:
@@ -203,23 +221,36 @@ class CompiledDAG:
             for i, cnode in enumerate(consumers):
                 consumer_idx[(pid, id(cnode))] = i
 
-        self._loop_refs = []
+        # One combined loop PER ACTOR (reference: each actor executes its
+        # whole schedule per iteration) — `loops` is topo-ordered, so each
+        # actor's stage list is too.
+        by_actor: Dict[bytes, Tuple[Any, List[ClassMethodNode]]] = {}
         for n in loops:
-            arg_plan, kwarg_plan = plans[id(n)]
-            chans: Dict[str, Channel] = {}
-            if id(n) in input_idx:
-                chans["input"] = self._input_chan.with_reader_index(
-                    input_idx[id(n)])
-            for pid in {id(u) for u in n._upstream()
-                        if isinstance(u, ClassMethodNode)}:
-                chans[str(pid)] = out_chans[pid].with_reader_index(
-                    consumer_idx[(pid, id(n))])
-            ref = n._actor._actor_method_call(
-                "__adag_exec_loop__",
-                (n._method_name, arg_plan, kwarg_plan, chans,
-                 out_chans[id(n)]),
-                {}, {})
+            key = n._actor._id.binary()
+            by_actor.setdefault(key, (n._actor, []))[1].append(n)
+        self._loop_refs = []
+        for actor, nodes in by_actor.values():
+            stages = []
+            for n in nodes:
+                arg_plan, kwarg_plan = plans[id(n)]
+                chans: Dict[str, Channel] = {}
+                if id(n) in input_idx:
+                    chans["input"] = self._input_chan.with_reader_index(
+                        input_idx[id(n)])
+                for pid in {id(u) for u in n._upstream()
+                            if isinstance(u, ClassMethodNode)}:
+                    chans[str(pid)] = out_chans[pid].with_reader_index(
+                        consumer_idx[(pid, id(n))])
+                stages.append((n._method_name, arg_plan, kwarg_plan,
+                               chans, out_chans[id(n)]))
+            ref = actor._actor_method_call(
+                "__adag_exec_loop__", (stages,), {}, {})
             self._loop_refs.append(ref)
+        # Framework-created helper actors (e.g. experimental.collective
+        # reducers) are killed at teardown; user actors never are.
+        self._owned_actors = [n._owned_actor for n in loops
+                              if getattr(n, "_owned_actor", None)
+                              is not None]
 
     # -- execution ---------------------------------------------------------
     def execute(self, *args, **kwargs) -> CompiledDAGRef:
@@ -271,6 +302,11 @@ class CompiledDAG:
                     pass
             for ch in self._channels:
                 ch.destroy()
+            for a in getattr(self, "_owned_actors", []):
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
 
     def __del__(self):
         try:

@@ -1,0 +1,2 @@
+"""ray_tpu.experimental — compiled-DAG collectives and other previews
+(reference: python/ray/experimental/)."""

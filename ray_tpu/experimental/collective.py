@@ -1,0 +1,88 @@
+"""Collective ops on compiled DAGs.
+
+Reference parity: python/ray/experimental/collective/allreduce.py — bind
+an allreduce across same-shaped outputs of several actor nodes inside a
+DAG; the reference lowers to NCCL p2p channels
+(torch_tensor_nccl_channel.py). TPU-native split: DEVICE tensors should
+never cross actors mid-graph at all — use mesh collectives inside the
+jitted step (ray_tpu.util.collective's XLA backend / shard_map). This
+module covers the HOST-tensor case the reference also serves: the
+reduction lowers to a hidden reducer actor wired into the compiled
+graph's shm channels (reduce + multi-reader broadcast == allreduce).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.dag import DAGNode
+from ray_tpu.util.collective.types import ReduceOp
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda ts: sum(ts[1:], start=ts[0]),
+    ReduceOp.PRODUCT: lambda ts: np.prod(np.stack(ts), axis=0),
+    ReduceOp.MIN: lambda ts: np.min(np.stack(ts), axis=0),
+    ReduceOp.MAX: lambda ts: np.max(np.stack(ts), axis=0),
+}
+
+
+@ray_tpu.remote(num_cpus=0)
+class _CollectiveReducer:
+    """Hidden actor performing the reduction stage (the compiled graph
+    wires its input/output channels like any other node)."""
+
+    def __init__(self, op: int):
+        self._op = ReduceOp(op)
+
+    def reduce(self, *tensors):
+        if not tensors:
+            raise ValueError("allreduce needs at least one input")
+        shapes = {np.asarray(t).shape for t in tensors}
+        if len(shapes) > 1:
+            raise ValueError(
+                f"allreduce inputs must share one shape, got {shapes}")
+        ts = [np.asarray(t) for t in tensors]
+        return _REDUCERS[self._op](ts)
+
+    def gather(self, *tensors):
+        return list(tensors)
+
+
+class _AllReduceBinder:
+    """`allreduce.bind(nodes)` surface (reference: allreduce.bind)."""
+
+    def bind(self, nodes: Sequence[DAGNode],
+             op: ReduceOp = ReduceOp.SUM) -> List[DAGNode]:
+        """Returns one DAG node per input node, each carrying the reduced
+        value (all participants read the same broadcast channel)."""
+        nodes = list(nodes)
+        if not nodes:
+            raise ValueError("allreduce.bind needs a non-empty node list")
+        reducer = _CollectiveReducer.remote(int(op))
+        red = reducer.reduce.bind(*nodes)
+        # Framework-owned: CompiledDAG.teardown() kills it (user actors
+        # are never touched).
+        red._owned_actor = reducer
+        # One logical value; every consumer (one per participant) becomes
+        # a reader of the reducer's broadcast channel at compile time.
+        return [red for _ in nodes]
+
+
+class _AllGatherBinder:
+    def bind(self, nodes: Sequence[DAGNode]) -> List[DAGNode]:
+        nodes = list(nodes)
+        if not nodes:
+            raise ValueError("allgather.bind needs a non-empty node list")
+        reducer = _CollectiveReducer.remote(int(ReduceOp.SUM))
+        gathered = reducer.gather.bind(*nodes)
+        gathered._owned_actor = reducer
+        return [gathered for _ in nodes]
+
+
+allreduce = _AllReduceBinder()
+allgather = _AllGatherBinder()
+
+__all__ = ["allgather", "allreduce", "ReduceOp"]

@@ -197,14 +197,18 @@ class Algorithm:
         from ..env.env_runner import _make_env
         env = _make_env(self.config.env_spec, self.config.env_config)
         # Stateful connector pieces (running obs stats) accumulate in the
-        # runner actors; sync them onto the driver copy so evaluation
+        # runner actors; merge them onto the driver copy so evaluation
         # normalizes with the stats the policy trained under.
         if self.env_runner_group is not None \
-                and hasattr(self._e2m, "set_state"):
+                and hasattr(self._e2m, "merge_and_set_states"):
             try:
-                self._e2m.set_state(self.env_runner_group.connector_state())
-            except Exception:
-                pass
+                self._e2m.merge_and_set_states(
+                    self.env_runner_group.connector_states())
+            except Exception as e:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "evaluate(): connector state sync from runners "
+                    "failed (%s); evaluating with driver-local stats.", e)
 
         params = self.get_weights()
         discrete = getattr(self.module, "discrete", True)

@@ -94,6 +94,25 @@ class ConnectorPipelineV2(ConnectorV2):
             if setter is not None and key in state:
                 setter(state[key])
 
+    def merge_and_set_states(self, states: List[Dict[str, Any]]):
+        """Adopt the merged state of N runner copies: connectors exposing
+        `merge_states` merge properly (e.g. NormalizeObservations'
+        Welford merge); others take the first runner's state."""
+        states = [s for s in states if s]
+        if not states:
+            return
+        for i, c in enumerate(self.connectors):
+            setter = getattr(c, "set_state", None)
+            if setter is None:
+                continue
+            key = f"{i}:{c.name}"
+            per_runner = [s[key] for s in states if key in s]
+            if not per_runner:
+                continue
+            merger = getattr(c, "merge_states", None)
+            setter(merger(per_runner) if merger is not None
+                   else per_runner[0])
+
 
 class Lambda(ConnectorV2):
     """Wrap a plain function (must be picklable for remote runners)."""
@@ -159,6 +178,28 @@ class NormalizeObservations(ConnectorV2):
         self.count = state["count"]
         self.mean = state["mean"]
         self.m2 = state["m2"]
+
+    @staticmethod
+    def merge_states(states: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Chan's parallel Welford merge across runners (reference: the
+        driver merging per-runner filter stats)."""
+        merged = {"count": 0, "mean": None, "m2": None}
+        for st in states:
+            if not st or st.get("count", 0) == 0:
+                continue
+            if merged["count"] == 0:
+                merged = {"count": st["count"],
+                          "mean": np.array(st["mean"], np.float64),
+                          "m2": np.array(st["m2"], np.float64)}
+                continue
+            na, nb = merged["count"], st["count"]
+            delta = np.asarray(st["mean"]) - merged["mean"]
+            n = na + nb
+            merged["mean"] = merged["mean"] + delta * (nb / n)
+            merged["m2"] = (merged["m2"] + np.asarray(st["m2"])
+                            + delta * delta * (na * nb / n))
+            merged["count"] = n
+        return merged
 
 
 # -- module_to_env pieces --------------------------------------------------

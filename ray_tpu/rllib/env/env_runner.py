@@ -168,10 +168,11 @@ class EnvRunnerGroup:
             out.extend(m)
         return out
 
-    def connector_state(self):
-        """Runner 0's env_to_module connector state (reference: the
-        driver merging runner connector states before eval)."""
-        return ray_tpu.get(self._runners[0].get_connector_state.remote())
+    def connector_states(self):
+        """Every runner's env_to_module connector state, for the driver
+        to merge (reference: driver-side filter-stat merging)."""
+        return ray_tpu.get([r.get_connector_state.remote()
+                            for r in self._runners])
 
     def stop(self):
         for r in self._runners:

@@ -145,6 +145,64 @@ def cmd_job(args):
     return 0
 
 
+def cmd_serve(args):
+    """`serve deploy/run/build/status/shutdown` (reference:
+    serve/scripts.py CLI over schema.py configs). deploy/status/shutdown
+    target a RUNNING head via --address / $RAY_TPU_ADDRESS (the app must
+    outlive this process); `serve run` hosts the app in-process and
+    blocks."""
+    from ray_tpu.serve import schema as serve_schema
+
+    def _load_config(target):
+        if target.endswith((".yaml", ".yml")):
+            return serve_schema.ServeDeploySchema.from_yaml(target)
+        return serve_schema.ServeDeploySchema.from_dict(
+            {"applications": [{"import_path": target}]})
+
+    if args.serve_cmd == "deploy":
+        addr = getattr(args, "address", None) or \
+            os.environ.get("RAY_TPU_ADDRESS")
+        if not addr:
+            print("serve deploy needs a running head (--address or "
+                  "$RAY_TPU_ADDRESS); to host the app from this "
+                  "process, use `serve run`.", file=sys.stderr)
+            return 1
+        call = _backend(args)
+        names = call("serve_deploy", _load_config(args.target).to_dict())
+        print(f"deployed on {addr}: {', '.join(names)}")
+    elif args.serve_cmd == "run":
+        import ray_tpu
+        from ray_tpu import serve
+        ray_tpu.init(ignore_reinit_error=True)
+        names = serve_schema.deploy_config(_load_config(args.target))
+        print(f"deployed: {', '.join(names)}  ({serve.proxy_address()})")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            serve.shutdown()
+    elif args.serve_cmd == "build":
+        import yaml
+        app = serve_schema.import_attr(args.target)
+        cfg = serve_schema.build_config(
+            app, import_path=args.target,
+            route_prefix=getattr(args, "route_prefix", "/"))
+        out = yaml.safe_dump(cfg, sort_keys=False)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(out)
+            print(f"wrote {args.output}")
+        else:
+            print(out)
+    elif args.serve_cmd == "status":
+        print(json.dumps(_backend(args)("serve_status"), indent=2,
+                         default=str))
+    elif args.serve_cmd == "shutdown":
+        _backend(args)("serve_shutdown")
+        print("serve shut down")
+    return 0
+
+
 def cmd_dashboard(args):
     import ray_tpu
 
@@ -223,6 +281,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--dashboard-port", type=int, default=8265)
     sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("serve", help="deploy/inspect Serve applications")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    for name, hlp in (("deploy",
+                       "deploy a YAML config/import_path on a running "
+                       "head (--address)"),
+                      ("run", "deploy in-process and block "
+                              "(ctrl-c tears down)")):
+        s = ssub.add_parser(name, help=hlp)
+        s.add_argument("target",
+                       help="config.yaml or module.path:app import path")
+        add_address(s)
+    s = ssub.add_parser("build",
+                        help="emit a YAML config for a bound app")
+    s.add_argument("target", help="module.path:app import path")
+    s.add_argument("-o", "--output", default=None)
+    s.add_argument("--route-prefix", default="/")
+    for name in ("status", "shutdown"):
+        s = ssub.add_parser(name)
+        add_address(s)
+    sp.set_defaults(fn=cmd_serve)
     return p
 
 

@@ -1,0 +1,245 @@
+"""Declarative Serve config: build, validate, and deploy from YAML/dicts.
+
+Reference parity: python/ray/serve/schema.py (ServeDeploySchema /
+ServeApplicationSchema / DeploymentSchema — the pydantic models behind
+`serve build` and `serve deploy config.yaml`) and serve/scripts.py (the
+CLI that round-trips them). Here the schemas are validating dataclasses:
+same YAML shape, no pydantic dependency.
+
+    applications:
+      - name: default
+        import_path: my_module:app
+        route_prefix: /
+        deployments:
+          - name: Model
+            num_replicas: 2
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def _expect(cond: bool, msg: str):
+    if not cond:
+        raise SchemaError(msg)
+
+
+@dataclass
+class DeploymentSchema:
+    """Per-deployment overrides (reference: schema.py DeploymentSchema)."""
+
+    name: str
+    num_replicas: Optional[int] = None
+    max_ongoing_requests: Optional[int] = None
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    user_config: Optional[Dict[str, Any]] = None
+    ray_actor_options: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DeploymentSchema":
+        _expect(isinstance(d, dict), "deployment entry must be a mapping")
+        _expect("name" in d, "deployment entry needs a `name`")
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(d) - known
+        _expect(not unknown,
+                f"unknown deployment fields {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        if d.get("num_replicas") is not None:
+            _expect(int(d["num_replicas"]) >= 0,
+                    "num_replicas must be >= 0")
+        return cls(**d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+
+@dataclass
+class ServeApplicationSchema:
+    """One application (reference: schema.py ServeApplicationSchema)."""
+
+    import_path: str
+    name: str = "default"
+    route_prefix: Optional[str] = "/"
+    args: Dict[str, Any] = field(default_factory=dict)
+    runtime_env: Optional[Dict[str, Any]] = None
+    deployments: List[DeploymentSchema] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServeApplicationSchema":
+        _expect(isinstance(d, dict), "application entry must be a mapping")
+        _expect("import_path" in d,
+                "application entry needs an `import_path` "
+                "(format: module.sub:attribute)")
+        path = d["import_path"]
+        _expect(isinstance(path, str) and ":" in path,
+                f"import_path {path!r} must look like 'module:attribute'")
+        rp = d.get("route_prefix", "/")
+        if rp is not None:
+            _expect(str(rp).startswith("/"),
+                    f"route_prefix {rp!r} must start with '/'")
+        deps = [DeploymentSchema.from_dict(x)
+                for x in d.get("deployments", [])]
+        return cls(import_path=path, name=d.get("name", "default"),
+                   route_prefix=rp, args=d.get("args", {}) or {},
+                   runtime_env=d.get("runtime_env"), deployments=deps)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name,
+                               "import_path": self.import_path,
+                               "route_prefix": self.route_prefix}
+        if self.args:
+            out["args"] = self.args
+        if self.runtime_env:
+            out["runtime_env"] = self.runtime_env
+        if self.deployments:
+            out["deployments"] = [x.to_dict() for x in self.deployments]
+        return out
+
+
+@dataclass
+class ServeDeploySchema:
+    """Top-level config (reference: schema.py ServeDeploySchema)."""
+
+    applications: List[ServeApplicationSchema]
+    http_options: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServeDeploySchema":
+        _expect(isinstance(d, dict), "serve config must be a mapping")
+        apps = d.get("applications")
+        _expect(isinstance(apps, list) and apps,
+                "serve config needs a non-empty `applications` list")
+        parsed = [ServeApplicationSchema.from_dict(a) for a in apps]
+        names = [a.name for a in parsed]
+        _expect(len(set(names)) == len(names),
+                f"duplicate application names: {names}")
+        prefixes = [a.route_prefix for a in parsed
+                    if a.route_prefix is not None]
+        _expect(len(set(prefixes)) == len(prefixes),
+                f"duplicate route prefixes: {prefixes}")
+        return cls(applications=parsed,
+                   http_options=d.get("http_options"))
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ServeDeploySchema":
+        import yaml
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "applications": [a.to_dict() for a in self.applications]}
+        if self.http_options:
+            out["http_options"] = self.http_options
+        return out
+
+
+def import_attr(import_path: str):
+    """'module.sub:attr' → the attribute (reference:
+    ray._private.utils.import_attr, used by serve deploy)."""
+    module_path, _, attr = import_path.partition(":")
+    mod = importlib.import_module(module_path)
+    obj = mod
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def build_app(schema: ServeApplicationSchema):
+    """Materialize one application: import it, apply per-deployment
+    overrides (reference: serve/_private/api.py build_app)."""
+    from . import Application
+    target = import_attr(schema.import_path)
+    app = target(**schema.args) if callable(target) \
+        and not isinstance(target, Application) else target
+    _expect(isinstance(app, Application),
+            f"{schema.import_path} must resolve to a bound Serve "
+            f"Application (call .bind()), got {type(app).__name__}")
+    if schema.deployments:
+        from . import _collect_deployments
+        found: Dict[str, Any] = {}
+        _collect_deployments(app, found)
+        overrides = {d.name: d for d in schema.deployments}
+        unknown = set(overrides) - set(found)
+        _expect(not unknown,
+                f"config overrides unknown deployments {sorted(unknown)} "
+                f"(app has {sorted(found)})")
+        for name, sub_app in found.items():
+            ov = overrides.get(name)
+            if ov is None:
+                continue
+            dep = sub_app.deployment
+            opts: Dict[str, Any] = {}
+            if ov.num_replicas is not None:
+                opts["num_replicas"] = ov.num_replicas
+            if ov.max_ongoing_requests is not None:
+                opts["max_ongoing_requests"] = ov.max_ongoing_requests
+            if ov.autoscaling_config is not None:
+                from .config import AutoscalingConfig
+                opts["autoscaling_config"] = AutoscalingConfig(
+                    **ov.autoscaling_config)
+            if ov.ray_actor_options is not None:
+                opts["ray_actor_options"] = ov.ray_actor_options
+            if ov.user_config is not None:
+                opts["user_config"] = ov.user_config
+            if opts:
+                sub_app.deployment = dep.options(**opts)
+    if schema.runtime_env:
+        import warnings
+        warnings.warn(
+            f"application {schema.name!r}: runtime_env in serve configs "
+            "is not applied by this build — replicas inherit the "
+            "cluster's environment. Set the env before `ray_tpu start`.",
+            stacklevel=2)
+    return app
+
+
+def deploy_config(schema: ServeDeploySchema) -> List[str]:
+    """Deploy every application in the config (reference: `serve deploy`
+    handled by the controller's deploy_apps). Returns deployed names."""
+    from . import HTTPOptions, run
+    http = None
+    if schema.http_options:
+        http = HTTPOptions(**schema.http_options)
+    names = []
+    for app_schema in schema.applications:
+        app = build_app(app_schema)
+        run(app, name=app_schema.name,
+            route_prefix=app_schema.route_prefix,
+            http_options=http)
+        names.append(app_schema.name)
+    return names
+
+
+def build_config(app, name: str = "default", import_path: str = "",
+                 route_prefix: str = "/") -> Dict[str, Any]:
+    """Emit the YAML-able config for a bound application (reference:
+    `serve build`). Pass the deploy-time `route_prefix` so a
+    build→deploy round trip preserves it."""
+    from . import _collect_deployments
+    found: Dict[str, Any] = {}
+    _collect_deployments(app, found)
+    deployments = []
+    for dep_name, sub_app in sorted(found.items()):
+        cfg = sub_app.deployment.config
+        entry = {
+            "name": dep_name,
+            "num_replicas": cfg.num_replicas,
+            "max_ongoing_requests": cfg.max_ongoing_requests,
+        }
+        if getattr(cfg, "user_config", None) is not None:
+            entry["user_config"] = cfg.user_config
+        deployments.append(entry)
+    return {"applications": [{
+        "name": name,
+        "import_path": import_path or "module:app  # EDIT ME",
+        "route_prefix": route_prefix,
+        "deployments": deployments,
+    }]}

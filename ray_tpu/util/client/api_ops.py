@@ -39,4 +39,26 @@ def registry() -> Dict[str, Callable[..., Any]]:
         "job_logs": lambda job_id: job_client().get_job_logs(job_id),
         "job_list": lambda: job_client().list_jobs(),
         "job_stop": lambda job_id: job_client().stop_job(job_id),
+        # Serve control plane (reference: serve CLI → controller REST):
+        # deploy runs ON the head, so apps outlive the CLI process.
+        "serve_deploy": _serve_deploy,
+        "serve_status": _serve_status,
+        "serve_shutdown": _serve_shutdown,
     }
+
+
+def _serve_deploy(config: dict):
+    from ray_tpu.serve import schema as serve_schema
+    return serve_schema.deploy_config(
+        serve_schema.ServeDeploySchema.from_dict(config))
+
+
+def _serve_status():
+    from ray_tpu import serve
+    return serve.status()
+
+
+def _serve_shutdown():
+    from ray_tpu import serve
+    serve.shutdown()
+    return True

@@ -1,0 +1,12 @@
+"""Importable Serve app for schema/CLI tests (the reference keeps such
+fixtures importable by path for `serve deploy` tests)."""
+from ray_tpu import serve
+
+
+@serve.deployment
+class EchoDeployment:
+    def __call__(self, x):
+        return f"echo:{x}"
+
+
+app = EchoDeployment.bind()
