@@ -111,7 +111,25 @@ class CompiledDAG:
         self._read_seq = 0
         self._torn_down = False
         self._channels: List[Channel] = []
-        self._build()
+        try:
+            self._build()
+        except BaseException:
+            # A failed compile must not orphan framework-owned helper
+            # actors (experimental.collective reducers) or channels.
+            import ray_tpu
+            for n in root._topo():
+                owned = getattr(n, "_owned_actor", None)
+                if owned is not None:
+                    try:
+                        ray_tpu.kill(owned)
+                    except Exception:
+                        pass
+            for ch in self._channels:
+                try:
+                    ch.destroy()
+                except Exception:
+                    pass
+            raise
 
     # -- compilation -------------------------------------------------------
     def _build(self):

@@ -121,9 +121,12 @@ def _partition_block(blk: B.Block, n: int, mode, key, boundaries, seed):
         assign = np.array(
             [zlib.crc32(repr(v).encode()) % n for v in vals.tolist()],
             dtype=np.int64)
-    return tuple(
+    parts = tuple(
         B.block_take_indices(blk, np.nonzero(assign == i)[0])
         for i in range(n))
+    # n == 1 runs with num_returns=1: the single block IS the return
+    # value (a 1-tuple would arrive intact and crash the reducer).
+    return parts[0] if n == 1 else parts
 
 
 @api.remote
@@ -166,6 +169,12 @@ def _aggregate_block(blk: B.Block, key: str, aggs) -> Dict:
                 row[name] = vals.min()
             elif op == "max":
                 row[name] = vals.max()
+            elif op == "std":
+                # Exact: groupby shuffles by key, so a group never spans
+                # partitions.
+                row[name] = float(np.std(
+                    np.asarray(vals, np.float64), ddof=1)) \
+                    if len(idx) > 1 else 0.0
         out[kval] = row
     return out
 
@@ -182,9 +191,48 @@ def _write_block(blk: B.Block, path: str, fmt: str, index: int) -> str:
     elif fmt == "csv":
         import pyarrow.csv as pacsv
         pacsv.write_csv(table, fname)
+    elif fmt == "json":
+        import json
+        with open(fname, "w") as f:
+            for row in B.block_to_rows(blk):
+                f.write(json.dumps(
+                    {k: (v.item() if hasattr(v, "item") else v)
+                     for k, v in row.items()}) + "\n")
     else:
         raise ValueError(fmt)
     return fname
+
+
+@api.remote
+def _zip_blocks(left: B.Block, right: B.Block) -> B.Block:
+    """Column-wise merge of two equal-length blocks (reference:
+    dataset.py zip semantics: duplicate column names from the right side
+    get an `_1` suffix)."""
+    nl, nr = B.block_length(left), B.block_length(right)
+    if nl != nr:
+        raise ValueError(f"zip block length mismatch: {nl} vs {nr}")
+    out = dict(left)
+    for k, v in right.items():
+        out[f"{k}_1" if k in out else k] = v
+    return out
+
+
+@api.remote
+def _block_moments(blk: B.Block, on: str):
+    col = np.asarray(blk[on], np.float64)
+    return (len(col), float(col.sum()), float((col * col).sum()))
+
+
+@api.remote
+def _block_minmax(blk: B.Block, on: str):
+    col = np.asarray(blk[on])
+    return (col.min(), col.max())
+
+
+@api.remote
+def _block_unique(blk: B.Block, on: str):
+    return [v.item() if hasattr(v, "item") else v
+            for v in np.unique(np.asarray(blk[on]))]
 
 
 class _MapBatchesActorPool:
@@ -615,9 +663,98 @@ class Dataset:
     def take_all(self) -> List[Dict]:
         return self.take(10 ** 18)
 
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: str = "numpy"):
+        """First `batch_size` rows as one batch (reference: dataset.py
+        take_batch)."""
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format):
+            return batch
+        return {}
+
     def show(self, n: int = 20):
         for row in self.take(n):
             print(row)
+
+    # -- global aggregates (reference: dataset.py sum/mean/std/min/max
+    #    over AggregateFn) -------------------------------------------------
+    def sum(self, on: str) -> float:
+        mom = api.get([_block_moments.remote(b.ref, on)
+                       for b in self._plan.execute() if b.num_rows])
+        return float(sum(s for _, s, _ in mom))
+
+    def mean(self, on: str) -> float:
+        mom = api.get([_block_moments.remote(b.ref, on)
+                       for b in self._plan.execute() if b.num_rows])
+        n = sum(c for c, _, _ in mom)
+        return float(sum(s for _, s, _ in mom) / n) if n else float("nan")
+
+    def std(self, on: str, ddof: int = 1) -> float:
+        """Distributed two-pass-free std via per-block moment sums."""
+        mom = api.get([_block_moments.remote(b.ref, on)
+                       for b in self._plan.execute() if b.num_rows])
+        n = sum(c for c, _, _ in mom)
+        if n <= ddof:
+            return float("nan")
+        s = sum(s for _, s, _ in mom)
+        ss = sum(q for _, _, q in mom)
+        var = (ss - s * s / n) / (n - ddof)
+        return float(np.sqrt(max(0.0, var)))
+
+    def min(self, on: str) -> float:
+        mm = api.get([_block_minmax.remote(b.ref, on)
+                      for b in self._plan.execute() if b.num_rows])
+        return float(min(lo for lo, _ in mm))
+
+    def max(self, on: str) -> float:
+        mm = api.get([_block_minmax.remote(b.ref, on)
+                      for b in self._plan.execute() if b.num_rows])
+        return float(max(hi for _, hi in mm))
+
+    def unique(self, column: str) -> List:
+        """Per-block remote dedupe, driver-side merge (reference:
+        dataset.py unique)."""
+        parts = api.get([_block_unique.remote(b.ref, column)
+                         for b in self._plan.execute() if b.num_rows])
+        seen = set()
+        for p in parts:
+            seen.update(p)
+        return sorted(seen)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise combine of two same-length datasets (reference:
+        dataset.py zip; right-side duplicate columns get `_1`)."""
+        left_plan, right_plan = self._plan, other._plan
+
+        def source():
+            lbs = left_plan.execute()
+            rbs = right_plan.execute()
+            ln = sum(b.num_rows for b in lbs)
+            rn = sum(b.num_rows for b in rbs)
+            if ln != rn:
+                raise ValueError(
+                    f"zip requires equal row counts, got {ln} vs {rn}")
+            # Align right blocks to left block boundaries by slicing.
+            out = []
+            ri, roff = 0, 0
+            for lb in lbs:
+                need = lb.num_rows
+                pieces = []
+                while need > 0:
+                    rb = rbs[ri]
+                    take = min(need, rb.num_rows - roff)
+                    pieces.append(
+                        _slice_block.remote(rb.ref, roff, roff + take))
+                    roff += take
+                    need -= take
+                    if roff == rb.num_rows:
+                        ri, roff = ri + 1, 0
+                right_ref = (pieces[0] if len(pieces) == 1
+                             else _concat_blocks.remote(*pieces))
+                out.append(_RefBundle(
+                    _zip_blocks.remote(lb.ref, right_ref), lb.num_rows))
+            return out
+        return Dataset(_Plan(source, [], "zip"))
 
     def _iter_bundles(self):
         """Streaming bundle iterator. If every stage is streamable
@@ -709,6 +846,51 @@ class Dataset:
                 functools.partial(lambda s: s, shard), [], "split")))
         return out
 
+    def split_at_indices(self, indices: Sequence[int]) -> List["Dataset"]:
+        """Row-index split points → len(indices)+1 datasets (reference:
+        dataset.py split_at_indices)."""
+        indices = list(indices)
+        if any(i < 0 for i in indices) or indices != sorted(indices):
+            raise ValueError("indices must be non-negative and sorted")
+        bundles = self._plan.execute()
+        total = sum(b.num_rows for b in bundles)
+        bounds = [0] + [min(i, total) for i in indices] + [total]
+        shards: List[List[_RefBundle]] = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            pieces: List[_RefBundle] = []
+            pos = 0
+            for b in bundles:
+                b_lo, b_hi = pos, pos + b.num_rows
+                s, e = max(lo, b_lo), min(hi, b_hi)
+                if s < e:
+                    if s == b_lo and e == b_hi:
+                        pieces.append(b)
+                    else:
+                        ref = _slice_block.remote(
+                            b.ref, s - b_lo, e - b_lo)
+                        pieces.append(_RefBundle(ref, e - s))
+                pos = b_hi
+            shards.append(pieces)
+        return [Dataset(_Plan(functools.partial(lambda s: s, shard),
+                              [], "split_at_indices"))
+                for shard in shards]
+
+    def train_test_split(self, test_size: Union[int, float], *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> List["Dataset"]:
+        """(reference: dataset.py train_test_split)"""
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        total = ds.count()
+        n_test = (int(total * test_size) if isinstance(test_size, float)
+                  else int(test_size))
+        if not 0 < n_test < total:
+            raise ValueError(
+                f"test_size {test_size} must leave non-empty splits of "
+                f"{total} rows")
+        train, test = ds.split_at_indices([total - n_test])
+        return [train, test]
+
     def streaming_split(self, n: int, *, equal: bool = True,
                         locality_hints=None) -> List:
         """(reference: dataset.py:1537 streaming_split →
@@ -749,6 +931,12 @@ class Dataset:
         bundles = self._plan.execute()
         return api.get([
             _write_block.remote(b.ref, path, "parquet", i)
+            for i, b in enumerate(bundles) if b.num_rows])
+
+    def write_json(self, path: str) -> List[str]:
+        bundles = self._plan.execute()
+        return api.get([
+            _write_block.remote(b.ref, path, "json", i)
             for i, b in enumerate(bundles) if b.num_rows])
 
     def write_csv(self, path: str) -> List[str]:
@@ -805,6 +993,9 @@ class GroupedData:
 
     def max(self, on: str) -> Dataset:
         return self._aggregate({f"max({on})": (on, "max")})
+
+    def std(self, on: str) -> Dataset:
+        return self._aggregate({f"std({on})": (on, "std")})
 
     def map_groups(self, fn: Callable) -> Dataset:
         ds = self._ds._shuffle_like("groupby", key=self._key,

@@ -199,11 +199,14 @@ class Algorithm:
         # Stateful connector pieces (running obs stats) accumulate in the
         # runner actors; merge them onto the driver copy so evaluation
         # normalizes with the stats the policy trained under.
-        if self.env_runner_group is not None \
-                and hasattr(self._e2m, "merge_and_set_states"):
+        if self.env_runner_group is not None:
             try:
-                self._e2m.merge_and_set_states(
-                    self.env_runner_group.connector_states())
+                states = self.env_runner_group.connector_states()
+                if hasattr(self._e2m, "merge_and_set_states"):
+                    self._e2m.merge_and_set_states(states)
+                elif hasattr(self._e2m, "set_state") and states:
+                    # Bare (non-pipeline) connector: adopt runner 0.
+                    self._e2m.set_state(states[0])
             except Exception as e:
                 import logging
                 logging.getLogger(__name__).warning(

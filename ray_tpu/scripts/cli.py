@@ -171,6 +171,17 @@ def cmd_serve(args):
         names = call("serve_deploy", _load_config(args.target).to_dict())
         print(f"deployed on {addr}: {', '.join(names)}")
     elif args.serve_cmd == "run":
+        if getattr(args, "address", None) or \
+                os.environ.get("RAY_TPU_ADDRESS"):
+            # Remote target: the head hosts the app (no need to block);
+            # identical to `serve deploy`.
+            call = _backend(args)
+            names = call("serve_deploy",
+                         _load_config(args.target).to_dict())
+            print(f"deployed remotely: {', '.join(names)} (app lives on "
+                  f"the head; `serve shutdown --address ...` tears it "
+                  f"down)")
+            return 0
         import ray_tpu
         from ray_tpu import serve
         ray_tpu.init(ignore_reinit_error=True)

@@ -155,6 +155,8 @@ def import_attr(import_path: str):
 def build_app(schema: ServeApplicationSchema):
     """Materialize one application: import it, apply per-deployment
     overrides (reference: serve/_private/api.py build_app)."""
+    import copy
+
     from . import Application
     target = import_attr(schema.import_path)
     app = target(**schema.args) if callable(target) \
@@ -162,6 +164,10 @@ def build_app(schema: ServeApplicationSchema):
     _expect(isinstance(app, Application),
             f"{schema.import_path} must resolve to a bound Serve "
             f"Application (call .bind()), got {type(app).__name__}")
+    # Never mutate the module-level (sys.modules-cached) Application:
+    # overrides applied in place would leak into every later deploy of
+    # the same import_path in this process.
+    app = copy.deepcopy(app)
     if schema.deployments:
         from . import _collect_deployments
         found: Dict[str, Any] = {}

@@ -259,3 +259,106 @@ class TestTrainIntegration:
         ).fit()
         assert result.error is None, result.error
         assert result.metrics["total"] > 0
+
+
+class TestDatasetParityOps:
+    """zip/unique/std/split_at_indices/train_test_split/take_batch/
+    write_json (reference: dataset.py same-named APIs)."""
+
+    def test_global_aggregates(self, ray_start_shared):
+        import numpy as np
+
+        from ray_tpu import data
+        vals = np.arange(100, dtype=np.float64)
+        ds = data.from_numpy(vals, column="x").repartition(7)
+        assert ds.sum("x") == vals.sum()
+        assert ds.mean("x") == pytest.approx(vals.mean())
+        assert ds.std("x") == pytest.approx(np.std(vals, ddof=1))
+        assert ds.min("x") == 0.0 and ds.max("x") == 99.0
+
+    def test_unique(self, ray_start_shared):
+        from ray_tpu import data
+        ds = data.from_items([{"c": v} for v in
+                              [3, 1, 2, 3, 1, 2, 2]]).repartition(3)
+        assert ds.unique("c") == [1, 2, 3]
+
+    def test_zip(self, ray_start_shared):
+        import numpy as np
+
+        from ray_tpu import data
+        left = data.from_numpy(np.arange(10), column="a").repartition(3)
+        right = data.from_numpy(np.arange(10) * 2,
+                                column="b").repartition(4)
+        out = left.zip(right).take_all()
+        assert [r["b"] for r in out] == [r["a"] * 2 for r in out]
+
+    def test_zip_duplicate_columns_suffixed(self, ray_start_shared):
+        import numpy as np
+
+        from ray_tpu import data
+        a = data.from_numpy(np.arange(5), column="x")
+        b = data.from_numpy(np.arange(5) + 100, column="x")
+        rows = a.zip(b).take_all()
+        assert rows[0]["x"] == 0 and rows[0]["x_1"] == 100
+
+    def test_zip_length_mismatch(self, ray_start_shared):
+        import numpy as np
+
+        from ray_tpu import data
+        a = data.from_numpy(np.arange(5), column="x")
+        b = data.from_numpy(np.arange(6), column="y")
+        with pytest.raises(Exception, match="equal row counts"):
+            a.zip(b).take_all()
+
+    def test_split_at_indices(self, ray_start_shared):
+        import numpy as np
+
+        from ray_tpu import data
+        ds = data.from_numpy(np.arange(20), column="x").repartition(6)
+        parts = ds.split_at_indices([5, 12])
+        assert [p.count() for p in parts] == [5, 7, 8]
+        assert [r["x"] for r in parts[1].take_all()] == list(range(5, 12))
+
+    def test_train_test_split(self, ray_start_shared):
+        import numpy as np
+
+        from ray_tpu import data
+        ds = data.from_numpy(np.arange(50), column="x")
+        train, test = ds.train_test_split(0.2)
+        assert train.count() == 40 and test.count() == 10
+        tr, te = ds.train_test_split(7, shuffle=True, seed=3)
+        assert te.count() == 7
+        all_vals = sorted(r["x"] for r in tr.take_all()) + \
+            sorted(r["x"] for r in te.take_all())
+        assert sorted(all_vals) == list(range(50))
+
+    def test_take_batch(self, ray_start_shared):
+        import numpy as np
+
+        from ray_tpu import data
+        ds = data.from_numpy(np.arange(30), column="x")
+        batch = ds.take_batch(8)
+        assert len(batch["x"]) == 8
+
+    def test_groupby_std(self, ray_start_shared):
+        import numpy as np
+
+        from ray_tpu import data
+        rows = ([{"g": 0, "v": float(v)} for v in (1, 2, 3, 4)]
+                + [{"g": 1, "v": 10.0}])
+        out = data.from_items(rows).groupby("g").std("v").take_all()
+        by_g = {r["g"]: r["std(v)"] for r in out}
+        assert by_g[0] == pytest.approx(np.std([1, 2, 3, 4], ddof=1))
+        assert by_g[1] == 0.0
+
+    def test_write_json(self, ray_start_shared, tmp_path):
+        import json
+
+        from ray_tpu import data
+        ds = data.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+        files = ds.write_json(str(tmp_path / "out"))
+        rows = []
+        for f in files:
+            rows += [json.loads(line) for line in open(f)]
+        assert sorted(rows, key=lambda r: r["a"]) == [
+            {"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
