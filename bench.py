@@ -109,7 +109,9 @@ def bench_tpu(extras):
                         n_layers=8, d_ff=2048, max_seq_len=1024)
         init_state, train_step = make_train_step(cfg)
         state = init_state(jax.random.PRNGKey(0))
-        B, S = 8, 1024
+        # B=8 starves the MXU (measured ~12M tok/s vs ~68M at B=32 on
+        # one chip); 32 keeps headroom vs HBM under tunnel sharing.
+        B, S = 32, 1024
         tokens = np.random.randint(0, cfg.vocab_size, (B, S),
                                    dtype=np.int32)
         batch = (jnp.asarray(tokens), jnp.asarray(np.roll(tokens, -1, 1)))

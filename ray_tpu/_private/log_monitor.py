@@ -1,0 +1,92 @@
+"""Worker log capture + driver streaming.
+
+Reference parity: worker stdout/stderr land in per-worker files under the
+session dir (the reference's `session_latest/logs/worker-*.out|err`), and
+a driver-side monitor tails them, prefixing each line with the producing
+worker (reference: _private/log_monitor.py tails & publishes to the
+driver via GCS pubsub; here the driver tails directly — one host, no
+pubsub hop). `ray_tpu.init(log_to_driver=False)` keeps the files but
+silences the echo.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, Optional, TextIO
+
+
+class LogMonitor:
+    """Tail every `*.out`/`*.err` under the session logs dir and echo new
+    lines to the driver's stdout/stderr with a worker prefix."""
+
+    def __init__(self, logs_dir: str, poll_interval_s: float = 0.15,
+                 out: Optional[TextIO] = None,
+                 err: Optional[TextIO] = None):
+        self.logs_dir = logs_dir
+        self.poll_interval_s = poll_interval_s
+        self._offsets: Dict[str, int] = {}
+        self._out = out or sys.stdout
+        self._err = err or sys.stderr
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+
+    def start(self):
+        self._started = True
+        self._thread = threading.Thread(
+            target=self._run, name="log_monitor", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                pass  # the monitor must never take the driver down
+
+    def poll_once(self):
+        if not os.path.isdir(self.logs_dir):
+            return
+        for fname in sorted(os.listdir(self.logs_dir)):
+            if not (fname.endswith(".out") or fname.endswith(".err")):
+                continue
+            path = os.path.join(self.logs_dir, fname)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(path, 0)
+            if size <= offset:
+                continue
+            try:
+                with open(path, "r", errors="replace") as f:
+                    f.seek(offset)
+                    chunk = f.read(size - offset)
+            except OSError:
+                continue
+            # Only whole lines; the tail re-reads partial writes later.
+            end = chunk.rfind("\n")
+            if end < 0:
+                continue
+            self._offsets[path] = offset + len(
+                chunk[:end + 1].encode("utf-8", "replace"))
+            worker = fname.rsplit(".", 1)[0]
+            stream = self._err if fname.endswith(".err") else self._out
+            for line in chunk[:end + 1].splitlines():
+                print(f"({worker}) {line}", file=stream)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        # Final drain so fast-exiting workers' output is not lost — but
+        # ONLY if streaming was on (log_to_driver=False must stay silent
+        # through shutdown too).
+        if self._started:
+            try:
+                self.poll_once()
+            except Exception:
+                pass

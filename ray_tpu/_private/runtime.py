@@ -190,6 +190,11 @@ class Node:
         from .memory_monitor import MemoryMonitor
         self.memory_monitor = MemoryMonitor(self._on_memory_pressure)
         self.memory_monitor.start()
+        # Worker log tailing (reference: log_monitor.py); started by
+        # api.init when log_to_driver=True.
+        from .log_monitor import LogMonitor
+        self.log_monitor = LogMonitor(
+            os.path.join(self.session_dir, "logs"))
         self._shutdown = False
         atexit.register(self.shutdown)
 
@@ -1191,6 +1196,10 @@ class Node:
         self._shutdown = True
         try:
             self.memory_monitor.stop()
+        except Exception:
+            pass
+        try:
+            self.log_monitor.stop()
         except Exception:
             pass
         try:

@@ -337,9 +337,22 @@ class WorkerPool:
             # workers (chips assigned / JAX_PLATFORMS overridden) keep
             # the full site so the TPU backend plugin registers.
             argv.insert(1, "-S")
-        proc = subprocess.Popen(
-            argv, env=proc_env, cwd=os.getcwd(),
-            start_new_session=False)
+        # Worker stdout/stderr go to per-worker session log files
+        # (reference: session_latest/logs/worker-*.out|err); the driver's
+        # LogMonitor tails them for log_to_driver streaming.
+        logs_dir = os.path.join(self._session_dir, "logs")
+        os.makedirs(logs_dir, exist_ok=True)
+        stem = os.path.join(logs_dir, f"worker-{worker_id.hex()[:12]}")
+        out_f = open(stem + ".out", "ab", buffering=0)
+        err_f = open(stem + ".err", "ab", buffering=0)
+        try:
+            proc = subprocess.Popen(
+                argv, env=proc_env, cwd=os.getcwd(),
+                stdout=out_f, stderr=err_f,
+                start_new_session=False)
+        finally:
+            out_f.close()
+            err_f.close()
         # accept() with a poll loop: a worker that dies on boot (bad env,
         # OOM kill) must not hang the dispatch thread forever.
         import socket as _socket

@@ -136,6 +136,8 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
                     resources=resources, namespace=namespace,
                     object_store_memory=object_store_memory)
         state.set_node(node)
+        if log_to_driver:
+            node.log_monitor.start()
         if prestart_workers is None:
             prestart_workers = min(int(node.cluster_resources().get("CPU", 4)),
                                    8)

@@ -102,7 +102,49 @@ def _routes() -> Dict[str, Any]:
         "/api/summary/tasks": state_api.summarize_tasks,
         "/api/timeline": state_api.timeline,
         "/api/jobs": jobs,
+        # reference dashboard modules: healthz, reporter (node stats),
+        # serve, log — collapsed to JSON routes.
+        "/api/healthz": lambda: {"status": "ok"},
+        "/api/object_store": _object_store_stats,
+        "/api/memory": _memory_stats,
+        "/api/serve": _serve_status,
+        "/api/logs": _log_files,
     }
+
+
+def _object_store_stats():
+    from .._private import state as _state
+    store = _state.current().store
+    stats = getattr(store, "stats", None)
+    return stats() if stats else {}
+
+
+def _memory_stats():
+    from .._private import state as _state
+    from .._private.memory_monitor import system_memory_fraction
+    node = _state.current()
+    mon = getattr(node, "memory_monitor", None)
+    return {"system_memory_fraction": system_memory_fraction(),
+            "last_sampled_fraction": getattr(mon, "last_fraction", None)}
+
+
+def _serve_status():
+    try:
+        from .. import serve
+        return serve.status()
+    except Exception:
+        return {}
+
+
+def _log_files():
+    import os
+
+    from .._private import state as _state
+    logs_dir = os.path.join(_state.current().session_dir, "logs")
+    if not os.path.isdir(logs_dir):
+        return []
+    return [{"file": f, "bytes": os.path.getsize(
+        os.path.join(logs_dir, f))} for f in sorted(os.listdir(logs_dir))]
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:

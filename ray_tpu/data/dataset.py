@@ -219,8 +219,12 @@ def _zip_blocks(left: B.Block, right: B.Block) -> B.Block:
 
 @api.remote
 def _block_moments(blk: B.Block, on: str):
+    """(count, mean, M2) per block — Welford form, so the driver-side
+    Chan merge is numerically stable even when |mean| >> std (the naive
+    sum-of-squares formula catastrophically cancels there)."""
     col = np.asarray(blk[on], np.float64)
-    return (len(col), float(col.sum()), float((col * col).sum()))
+    mean = float(col.mean())
+    return (len(col), mean, float(((col - mean) ** 2).sum()))
 
 
 @api.remote
@@ -666,11 +670,11 @@ class Dataset:
     def take_batch(self, batch_size: int = 20,
                    batch_format: str = "numpy"):
         """First `batch_size` rows as one batch (reference: dataset.py
-        take_batch)."""
+        take_batch — raises on an empty dataset)."""
         for batch in self.iter_batches(batch_size=batch_size,
                                        batch_format=batch_format):
             return batch
-        return {}
+        raise ValueError("Dataset is empty")
 
     def show(self, n: int = 20):
         for row in self.take(n):
@@ -678,38 +682,46 @@ class Dataset:
 
     # -- global aggregates (reference: dataset.py sum/mean/std/min/max
     #    over AggregateFn) -------------------------------------------------
-    def sum(self, on: str) -> float:
+    def _merged_moments(self, on: str):
+        """Chan's parallel merge of per-block (count, mean, M2)."""
         mom = api.get([_block_moments.remote(b.ref, on)
                        for b in self._plan.execute() if b.num_rows])
-        return float(sum(s for _, s, _ in mom))
+        n, mean, m2 = 0, 0.0, 0.0
+        for nb, mb, m2b in mom:
+            if nb == 0:
+                continue
+            delta = mb - mean
+            tot = n + nb
+            mean += delta * (nb / tot)
+            m2 += m2b + delta * delta * (n * nb / tot)
+            n = tot
+        return n, mean, m2
+
+    def _minmax(self, on: str):
+        return api.get([_block_minmax.remote(b.ref, on)
+                        for b in self._plan.execute() if b.num_rows])
+
+    def sum(self, on: str) -> float:
+        n, mean, _ = self._merged_moments(on)
+        return float(n * mean)
 
     def mean(self, on: str) -> float:
-        mom = api.get([_block_moments.remote(b.ref, on)
-                       for b in self._plan.execute() if b.num_rows])
-        n = sum(c for c, _, _ in mom)
-        return float(sum(s for _, s, _ in mom) / n) if n else float("nan")
+        n, mean, _ = self._merged_moments(on)
+        return float(mean) if n else float("nan")
 
     def std(self, on: str, ddof: int = 1) -> float:
-        """Distributed two-pass-free std via per-block moment sums."""
-        mom = api.get([_block_moments.remote(b.ref, on)
-                       for b in self._plan.execute() if b.num_rows])
-        n = sum(c for c, _, _ in mom)
+        """Distributed std via per-block Welford moments + Chan merge
+        (numerically stable for |mean| >> std)."""
+        n, _, m2 = self._merged_moments(on)
         if n <= ddof:
             return float("nan")
-        s = sum(s for _, s, _ in mom)
-        ss = sum(q for _, _, q in mom)
-        var = (ss - s * s / n) / (n - ddof)
-        return float(np.sqrt(max(0.0, var)))
+        return float(np.sqrt(m2 / (n - ddof)))
 
     def min(self, on: str) -> float:
-        mm = api.get([_block_minmax.remote(b.ref, on)
-                      for b in self._plan.execute() if b.num_rows])
-        return float(min(lo for lo, _ in mm))
+        return float(min(lo for lo, _ in self._minmax(on)))
 
     def max(self, on: str) -> float:
-        mm = api.get([_block_minmax.remote(b.ref, on)
-                      for b in self._plan.execute() if b.num_rows])
-        return float(max(hi for _, hi in mm))
+        return float(max(hi for _, hi in self._minmax(on)))
 
     def unique(self, column: str) -> List:
         """Per-block remote dedupe, driver-side merge (reference:

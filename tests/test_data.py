@@ -362,3 +362,21 @@ class TestDatasetParityOps:
             rows += [json.loads(line) for line in open(f)]
         assert sorted(rows, key=lambda r: r["a"]) == [
             {"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+
+    def test_std_numerically_stable(self, ray_start_shared):
+        """Regression: naive sum-of-squares cancelled to 0.0 for
+        |mean| >> std."""
+        import numpy as np
+
+        from ray_tpu import data
+        vals = 1e8 + np.array([0.0, 1.0] * 50)
+        ds = data.from_numpy(vals, column="x").repartition(4)
+        assert ds.std("x") == pytest.approx(np.std(vals, ddof=1),
+                                            rel=1e-6)
+        assert ds.mean("x") == pytest.approx(vals.mean())
+
+    def test_take_batch_empty_raises(self, ray_start_shared):
+        from ray_tpu import data
+        ds = data.from_items([{"x": 1}]).filter(lambda r: False)
+        with pytest.raises(ValueError, match="empty"):
+            ds.take_batch(4)

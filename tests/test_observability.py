@@ -112,3 +112,80 @@ def test_metrics_http_endpoint():
             f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
         body = r.read().decode()
     assert "scrape_me 42.0" in body
+
+
+class TestLogMonitor:
+    """Reference: per-worker session log files + log_monitor.py tailing
+    to the driver."""
+
+    def test_worker_output_lands_in_session_logs(self):
+        import os
+        import time
+
+        import ray_tpu
+
+        @ray_tpu.remote
+        def speak():
+            print("log-monitor-proof")
+            return 1
+
+        assert ray_tpu.get(speak.remote()) == 1
+        from ray_tpu._private.state import get_node
+        logs_dir = os.path.join(get_node().session_dir, "logs")
+        deadline = time.monotonic() + 5
+        found = False
+        while time.monotonic() < deadline and not found:
+            for f in os.listdir(logs_dir):
+                if f.endswith(".out"):
+                    data = open(os.path.join(logs_dir, f)).read()
+                    if "log-monitor-proof" in data:
+                        found = True
+            time.sleep(0.05)
+        assert found
+
+    def test_monitor_prefixes_lines(self, capsys, tmp_path):
+        import os
+
+        from ray_tpu._private.log_monitor import LogMonitor
+        d = tmp_path / "logs"
+        d.mkdir()
+        (d / "worker-abc.out").write_text("line one\nline two\n")
+        (d / "worker-abc.err").write_text("oops\n")
+        mon = LogMonitor(str(d))
+        mon.poll_once()
+        captured = capsys.readouterr()
+        assert "(worker-abc) line one" in captured.out
+        assert "(worker-abc) line two" in captured.out
+        assert "(worker-abc) oops" in captured.err
+        # incremental tail: only NEW lines on the next poll
+        with open(d / "worker-abc.out", "a") as f:
+            f.write("line three\n")
+        mon.poll_once()
+        captured = capsys.readouterr()
+        assert "line three" in captured.out
+        assert "line one" not in captured.out
+
+
+def test_dashboard_new_routes():
+    """healthz/object_store/memory/logs routes (reference dashboard
+    modules healthz, reporter, log)."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    port = start_dashboard(port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return _json.loads(r.read())
+
+        assert get("/api/healthz")["status"] == "ok"
+        st = get("/api/object_store")
+        assert "used_bytes" in st and "spilled_bytes" in st
+        mem = get("/api/memory")
+        assert 0 <= mem["system_memory_fraction"] <= 1
+        assert isinstance(get("/api/logs"), list)
+        assert isinstance(get("/api/serve"), dict)
+    finally:
+        stop_dashboard()
