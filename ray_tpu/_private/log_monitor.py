@@ -46,7 +46,11 @@ class LogMonitor:
             except Exception:
                 pass  # the monitor must never take the driver down
 
-    def poll_once(self):
+    def poll_once(self, final: bool = False):
+        """Tail in BINARY mode with raw byte offsets (text-mode seek with
+        computed offsets drifts on any non-UTF-8 byte). `final=True`
+        (stop-time drain) also flushes a trailing newline-less line — a
+        killed worker's last diagnostic must not vanish."""
         if not os.path.isdir(self.logs_dir):
             return
         for fname in sorted(os.listdir(self.logs_dir)):
@@ -61,20 +65,20 @@ class LogMonitor:
             if size <= offset:
                 continue
             try:
-                with open(path, "r", errors="replace") as f:
+                with open(path, "rb") as f:
                     f.seek(offset)
                     chunk = f.read(size - offset)
             except OSError:
                 continue
             # Only whole lines; the tail re-reads partial writes later.
-            end = chunk.rfind("\n")
-            if end < 0:
+            end = chunk.rfind(b"\n")
+            if end < 0 and not final:
                 continue
-            self._offsets[path] = offset + len(
-                chunk[:end + 1].encode("utf-8", "replace"))
+            emit = chunk if final else chunk[:end + 1]
+            self._offsets[path] = offset + len(emit)
             worker = fname.rsplit(".", 1)[0]
             stream = self._err if fname.endswith(".err") else self._out
-            for line in chunk[:end + 1].splitlines():
+            for line in emit.decode("utf-8", "replace").splitlines():
                 print(f"({worker}) {line}", file=stream)
 
     def stop(self):
@@ -87,6 +91,6 @@ class LogMonitor:
         # through shutdown too).
         if self._started:
             try:
-                self.poll_once()
+                self.poll_once(final=True)
             except Exception:
                 pass

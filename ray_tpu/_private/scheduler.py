@@ -313,6 +313,10 @@ class WorkerPool:
         proc_env.update(env)
         proc_env["RAY_TPU_WORKER_SOCKET"] = address
         proc_env["RAY_TPU_WORKER_AUTHKEY"] = self._authkey.hex()
+        # stdout/stderr land in log FILES (below): without this, CPython
+        # block-buffers (~8 KiB) and log_to_driver streaming stalls
+        # until worker exit.
+        proc_env["PYTHONUNBUFFERED"] = "1"
         # Workers inherit the driver's import paths (reference: workers
         # receive the driver's sys.path via the job config / runtime env)
         # so by-reference pickles of driver-module functions resolve.

@@ -1,0 +1,186 @@
+"""GCP TPU slice provider: queued resources over gcloud.
+
+Reference parity: the cloud NodeProvider plugins
+(autoscaler/_private/gcp/node_provider.py) reshaped for TPU reality
+(SURVEY §7 Phase 6 "demand-driven slice provisioning (GKE/
+queued-resources provider)"): capacity arrives as whole pod slices via
+the TPU *queued-resources* API — you enqueue a request for e.g. a
+v4-16 slice and poll until GCP grants it — not as single VMs.
+
+All cloud interaction goes through `gcloud compute tpus queued-resources
+...` via an injectable `runner` callable (argv list -> stdout string),
+so the provisioning logic is fully testable with a fake runner and the
+class degrades with a clear error when gcloud is absent (this image has
+no cloud access).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from .node_provider import (NodeProvider, STATUS_PENDING, STATUS_RUNNING,
+                            STATUS_TERMINATED, TAG_NODE_TYPE)
+
+# queued-resource states (GCP API) -> provider statuses
+_STATE_MAP = {
+    "ACCEPTED": STATUS_PENDING,
+    "PROVISIONING": STATUS_PENDING,
+    "WAITING_FOR_RESOURCES": STATUS_PENDING,
+    "CREATING": STATUS_PENDING,
+    "ACTIVE": STATUS_RUNNING,
+    "SUSPENDED": STATUS_TERMINATED,
+    "FAILED": STATUS_TERMINATED,
+    "DELETING": STATUS_TERMINATED,
+}
+
+
+def _default_runner(argv: List[str]) -> str:
+    import subprocess
+    if shutil.which(argv[0]) is None:
+        raise RuntimeError(
+            f"{argv[0]} is not installed; GcpTpuQueuedResourceProvider "
+            "needs the gcloud CLI (or pass a custom runner=).")
+    return subprocess.run(argv, capture_output=True, text=True,
+                          check=True).stdout
+
+
+class GcpTpuQueuedResourceProvider(NodeProvider):
+    """Whole-slice provisioning through TPU queued resources.
+
+    provider_config keys: project, zone, accelerator_type (e.g.
+    "v4-16"), runtime_version, plus optional reserved/spot flags.
+    One "node" == one queued resource == one pod slice (atomic, as the
+    autoscaler's slice-aware scheduler expects).
+    """
+
+    def __init__(self, provider_config: Dict[str, Any],
+                 cluster_name: str = "default",
+                 runner: Optional[Callable[[List[str]], str]] = None):
+        super().__init__(provider_config, cluster_name)
+        self._run = runner or _default_runner
+        self.project = provider_config.get("project", "")
+        self.zone = provider_config.get("zone", "")
+        self.runtime_version = provider_config.get(
+            "runtime_version", "tpu-ubuntu2204-base")
+        # local tag cache: the queued-resource API has no tag store
+        self._tags: Dict[str, Dict[str, str]] = {}
+
+    # -- helpers -----------------------------------------------------------
+    def _base(self) -> List[str]:
+        argv = ["gcloud", "compute", "tpus", "queued-resources"]
+        return argv
+
+    def _common_flags(self) -> List[str]:
+        out = ["--format=json"]
+        if self.project:
+            out.append(f"--project={self.project}")
+        if self.zone:
+            out.append(f"--zone={self.zone}")
+        return out
+
+    def _list(self) -> List[Dict[str, Any]]:
+        raw = self._run(self._base() + ["list"] + self._common_flags())
+        rows = json.loads(raw or "[]")
+        prefix = f"{self.cluster_name}-"
+        return [r for r in rows
+                if r.get("name", "").rsplit("/", 1)[-1]
+                .startswith(prefix)]
+
+    @staticmethod
+    def _short_name(resource: Dict[str, Any]) -> str:
+        return resource.get("name", "").rsplit("/", 1)[-1]
+
+    @staticmethod
+    def _status(resource: Dict[str, Any]) -> str:
+        state = (resource.get("state", {}) or {}).get("state", "")
+        return _STATE_MAP.get(state, STATUS_PENDING)
+
+    # -- NodeProvider surface ----------------------------------------------
+    def non_terminated_nodes(self, tag_filters=None) -> List[str]:
+        out = []
+        for r in self._list():
+            if self._status(r) == STATUS_TERMINATED:
+                continue
+            name = self._short_name(r)
+            tags = self._tags.get(name, {})
+            if all(tags.get(k) == v
+                   for k, v in (tag_filters or {}).items()):
+                out.append(name)
+        return out
+
+    def is_running(self, node_id: str) -> bool:
+        for r in self._list():
+            if self._short_name(r) == node_id:
+                return self._status(r) == STATUS_RUNNING
+        return False
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        return dict(self._tags.get(node_id, {}))
+
+    def internal_ip(self, node_id: str) -> Optional[str]:
+        for r in self._list():
+            if self._short_name(r) == node_id:
+                nodes = (r.get("tpu", {}) or {}).get("nodeSpec", [])
+                for spec in nodes:
+                    eps = (spec.get("node", {}) or {}).get(
+                        "networkEndpoints", [])
+                    if eps:
+                        return eps[0].get("ipAddress")
+        return None
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> List[str]:
+        accel = (node_config or {}).get(
+            "accelerator_type",
+            self.provider_config.get("accelerator_type", "v4-8"))
+        created = []
+        for _ in range(count):
+            name = f"{self.cluster_name}-{uuid.uuid4().hex[:8]}"
+            argv = self._base() + [
+                "create", name,
+                f"--node-id={name}-node",
+                f"--accelerator-type={accel}",
+                f"--runtime-version={self.runtime_version}",
+            ] + self._common_flags()[1:]  # no --format on create
+            if (node_config or {}).get("spot") or \
+                    self.provider_config.get("spot"):
+                argv.append("--spot")
+            if (node_config or {}).get("reserved") or \
+                    self.provider_config.get("reserved"):
+                argv.append("--reserved")
+            self._run(argv)
+            self._tags[name] = dict(tags)
+            created.append(name)
+        return created
+
+    def terminate_node(self, node_id: str):
+        self._run(self._base()
+                  + ["delete", node_id, "--quiet", "--force"]
+                  + self._common_flags()[1:])
+        self._tags.pop(node_id, None)
+
+
+PROVIDERS = {
+    "fake_multinode": "ray_tpu.autoscaler.node_provider."
+                      "FakeMultiNodeProvider",
+    "gcp_tpu_queued_resources":
+        "ray_tpu.autoscaler.gcp_tpu_provider."
+        "GcpTpuQueuedResourceProvider",
+}
+
+
+def make_provider(kind: str, provider_config: Dict[str, Any],
+                  cluster_name: str = "default", **kw) -> NodeProvider:
+    """Provider registry lookup (reference: autoscaler/_private/
+    providers.py _get_node_provider)."""
+    import importlib
+    path = PROVIDERS.get(kind)
+    if path is None:
+        raise ValueError(
+            f"unknown provider {kind!r}; known: {sorted(PROVIDERS)}")
+    mod, _, cls = path.rpartition(".")
+    provider_cls = getattr(importlib.import_module(mod), cls)
+    return provider_cls(provider_config, cluster_name, **kw)

@@ -218,13 +218,15 @@ def _zip_blocks(left: B.Block, right: B.Block) -> B.Block:
 
 
 @api.remote
-def _block_moments(blk: B.Block, on: str):
+def _block_moments(blk: B.Block, on: str, want_m2: bool = True):
     """(count, mean, M2) per block — Welford form, so the driver-side
     Chan merge is numerically stable even when |mean| >> std (the naive
-    sum-of-squares formula catastrophically cancels there)."""
+    sum-of-squares formula catastrophically cancels there). sum/mean
+    callers skip the M2 pass (want_m2=False)."""
     col = np.asarray(blk[on], np.float64)
     mean = float(col.mean())
-    return (len(col), mean, float(((col - mean) ** 2).sum()))
+    m2 = float(((col - mean) ** 2).sum()) if want_m2 else 0.0
+    return (len(col), mean, m2)
 
 
 @api.remote
@@ -682,9 +684,9 @@ class Dataset:
 
     # -- global aggregates (reference: dataset.py sum/mean/std/min/max
     #    over AggregateFn) -------------------------------------------------
-    def _merged_moments(self, on: str):
+    def _merged_moments(self, on: str, want_m2: bool = True):
         """Chan's parallel merge of per-block (count, mean, M2)."""
-        mom = api.get([_block_moments.remote(b.ref, on)
+        mom = api.get([_block_moments.remote(b.ref, on, want_m2)
                        for b in self._plan.execute() if b.num_rows])
         n, mean, m2 = 0, 0.0, 0.0
         for nb, mb, m2b in mom:
@@ -702,11 +704,11 @@ class Dataset:
                         for b in self._plan.execute() if b.num_rows])
 
     def sum(self, on: str) -> float:
-        n, mean, _ = self._merged_moments(on)
+        n, mean, _ = self._merged_moments(on, want_m2=False)
         return float(n * mean)
 
     def mean(self, on: str) -> float:
-        n, mean, _ = self._merged_moments(on)
+        n, mean, _ = self._merged_moments(on, want_m2=False)
         return float(mean) if n else float("nan")
 
     def std(self, on: str, ddof: int = 1) -> float:

@@ -1,0 +1,116 @@
+"""Device profiling utilities — the TPU-native analogue of the
+reference's GPU profiling hooks (nsight runtime-env plugin,
+_private/runtime_env/nsight.py, and per-function hooks in
+_private/profiling.py).
+
+On TPU the profiler of record is jax.profiler: traces capture XLA
+execution, HBM usage, and ICI communication, viewable in TensorBoard or
+Perfetto. These helpers wrap it with the framework's session layout and
+compose with remote tasks (each worker process can trace its own device
+work).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+def default_logdir() -> str:
+    """Session-scoped trace dir (driver) or a /tmp fallback."""
+    from .._private import state
+    rt = state.current_or_none()
+    base = getattr(rt, "session_dir", None) if rt is not None else None
+    if base is None:
+        base = "/tmp/ray_tpu_profiles"
+    return os.path.join(base, "profiles")
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str] = None, *, host_tracer_level: int = 2,
+          create_perfetto_link: bool = False):
+    """Context manager: capture a jax.profiler trace of the enclosed
+    device work (reference: the nsight plugin wraps a worker in `nsys
+    profile`; here the XLA profiler wraps a region).
+
+        with profiling.trace("/tmp/tb"):
+            state, _ = train_step(state, batch)
+            jax.block_until_ready(state)
+    """
+    import jax
+    logdir = logdir or default_logdir()
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile(fn: Optional[Callable] = None, *,
+            logdir: Optional[str] = None):
+    """Decorator variant of `trace` for remote task/actor methods:
+
+        @ray_tpu.remote(num_tpus=1)
+        @profiling.profile
+        def step(batch): ...
+    """
+    def deco(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            with trace(logdir):
+                return f(*args, **kwargs)
+        return wrapper
+    return deco(fn) if fn is not None else deco
+
+
+def device_memory_stats(device_index: int = 0) -> Dict[str, Any]:
+    """Per-device HBM stats (reference: the dashboard's GPU memory
+    reporter; TPU runtimes expose bytes_in_use/peak via
+    Device.memory_stats)."""
+    import jax
+    devs = jax.local_devices()
+    if not devs or device_index >= len(devs):
+        return {}
+    stats = devs[device_index].memory_stats() or {}
+    return dict(stats)
+
+
+def annotate(name: str):
+    """Named profiler span (reference: _private/profiling.profile):
+    shows up as a labeled region in the trace viewer.
+
+        with profiling.annotate("tokenize"): ...
+    """
+    import jax
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Timer:
+    """Lightweight wall-clock section timer for host-side code paths
+    (reference: _private/profiling.py chrome-event helpers); records into
+    the GCS span store so `ray_tpu timeline` includes it."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_s: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed_s = time.perf_counter() - self._t0
+        from .._private import state
+        rt = state.current_or_none()
+        gcs = getattr(rt, "gcs", None)
+        if gcs is not None:
+            gcs.record_spans([{
+                "name": self.name, "cat": "profiling",
+                "ts": (self._t0) * 1e6, "dur": self.elapsed_s * 1e6,
+                "pid": os.getpid(), "tid": 0, "ph": "X"}])
+        return False

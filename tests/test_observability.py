@@ -189,3 +189,27 @@ def test_dashboard_new_routes():
         assert isinstance(get("/api/serve"), dict)
     finally:
         stop_dashboard()
+
+
+def test_log_monitor_final_drain_and_binary_offsets(capsys, tmp_path):
+    """stop-time drain emits trailing newline-less lines; non-UTF-8
+    bytes don't corrupt tail offsets."""
+    import os
+
+    from ray_tpu._private.log_monitor import LogMonitor
+    d = tmp_path / "logs"
+    d.mkdir()
+    with open(d / "worker-x.err", "wb") as f:
+        f.write(b"caf\xe9 path\n")       # latin-1 byte mid-stream
+    mon = LogMonitor(str(d))
+    mon._started = True
+    mon.poll_once()
+    first = capsys.readouterr().err
+    assert "caf" in first
+    with open(d / "worker-x.err", "ab") as f:
+        f.write(b"next line\n")
+        f.write(b"fatal: chip lockup")   # no trailing newline
+    mon.poll_once()
+    assert "next line" in capsys.readouterr().err  # offset not drifted
+    mon.stop()
+    assert "fatal: chip lockup" in capsys.readouterr().err
