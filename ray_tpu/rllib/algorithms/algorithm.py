@@ -199,19 +199,7 @@ class Algorithm:
         # Stateful connector pieces (running obs stats) accumulate in the
         # runner actors; merge them onto the driver copy so evaluation
         # normalizes with the stats the policy trained under.
-        if self.env_runner_group is not None:
-            try:
-                states = self.env_runner_group.connector_states()
-                if hasattr(self._e2m, "merge_and_set_states"):
-                    self._e2m.merge_and_set_states(states)
-                elif hasattr(self._e2m, "set_state") and states:
-                    # Bare (non-pipeline) connector: adopt runner 0.
-                    self._e2m.set_state(states[0])
-            except Exception as e:
-                import logging
-                logging.getLogger(__name__).warning(
-                    "evaluate(): connector state sync from runners "
-                    "failed (%s); evaluating with driver-local stats.", e)
+        self._sync_connector_states()
 
         params = self.get_weights()
         discrete = getattr(self.module, "discrete", True)
@@ -260,6 +248,72 @@ class Algorithm:
         self._total_steps = st["total_steps"]
         if self.env_runner_group is not None:
             self.env_runner_group.sync_weights(self.get_weights())
+
+    def _sync_connector_states(self):
+        """Merge runner-side stateful connector stats (running obs
+        normalization etc.) onto the driver copies."""
+        if self.env_runner_group is None:
+            return
+        try:
+            states = self.env_runner_group.connector_states()
+            if hasattr(self._e2m, "merge_and_set_states"):
+                self._e2m.merge_and_set_states(states)
+            elif hasattr(self._e2m, "set_state") and states:
+                self._e2m.set_state(states[0])
+        except Exception as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "connector state sync from runners failed (%s); using "
+                "driver-local stats.", e)
+
+    def _cached_action_space(self):
+        if not hasattr(self, "_action_space_cache"):
+            from ..env.env_runner import _make_env
+            env = _make_env(self.config.env_spec, self.config.env_config)
+            self._action_space_cache = env.action_space
+            env.close()
+        return self._action_space_cache
+
+    def compute_single_action(self, observation, explore: bool = False):
+        """Single-observation inference through the SAME connector
+        pipelines training used (reference:
+        Algorithm.compute_single_action)."""
+        self._sync_connector_states()
+        obs_b = self._e2m(
+            {"obs": np.asarray(observation, np.float32)[None]},
+            module=self.module, update=False)["obs"]
+        # Device-resident params: a full device->host weights copy per
+        # action would dominate the call.
+        params = (self.learner.params if self.learner is not None
+                  else self.get_weights())
+        if explore:
+            rng = np.random.default_rng()
+            action, _ = self.module.forward_exploration(
+                params, obs_b, rng)
+        else:
+            action = self.module.forward_inference(params, obs_b)
+        out = self._m2e({"actions": action},
+                        action_space=self._cached_action_space(),
+                        module=self.module)
+        env_actions = out.get("env_actions", out["actions"])
+        if getattr(self.module, "discrete", True):
+            return int(np.asarray(env_actions[0]).item())
+        return np.asarray(env_actions[0], np.float32)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str,
+                        config: "AlgorithmConfig") -> "Algorithm":
+        """Build + restore in one step (reference:
+        Algorithm.from_checkpoint)."""
+        algo = config.build()
+        if cls is not Algorithm and not isinstance(algo, cls):
+            raise TypeError(
+                f"{cls.__name__}.from_checkpoint got a config building "
+                f"{type(algo).__name__}; call "
+                f"{type(algo).__name__}.from_checkpoint (or pass the "
+                f"matching config).")
+        algo.restore(checkpoint_dir)
+        return algo
 
     def stop(self):
         if self.env_runner_group is not None:

@@ -175,6 +175,12 @@ class MultiAgentPPO(Algorithm):
         return {"evaluation_return_mean": float(np.mean(returns)),
                 "evaluation_return_max": float(np.max(returns))}
 
+    def compute_single_action(self, observation, explore: bool = False):
+        raise NotImplementedError(
+            "MultiAgentPPO has one module per policy; run inference "
+            "directly: algo.module[policy_id].forward_inference("
+            "algo.get_weights()[policy_id], obs[None])")
+
     def _get_algo_state(self) -> Dict[str, Any]:
         return {"ma_learner_states": {
             mid: ln.get_state() for mid, ln in self.learners.items()}}

@@ -248,3 +248,41 @@ def test_importance_sampling_estimator():
     out = est.estimate([frag], lambda obs, a: np.full(len(a), -0.5))
     assert out["episodes"] == 2
     assert abs(out["v_target"] - 2.0) < 1e-6
+
+
+class TestAlgorithmHelpers:
+    """compute_single_action / from_checkpoint (reference:
+    rllib/algorithms/algorithm.py same-named APIs)."""
+
+    def test_compute_single_action_and_from_checkpoint(self, tmp_path):
+        import numpy as np
+
+        from ray_tpu.rllib import PPOConfig
+        config = (PPOConfig()
+                  .environment("CartPole-v1")
+                  .env_runners(num_env_runners=1,
+                               rollout_fragment_length=32)
+                  .training(minibatch_size=16, num_epochs=1)
+                  .debugging(seed=0))
+        algo = config.build()
+        algo.train()
+        obs = np.zeros(4, np.float32)
+        a = algo.compute_single_action(obs)
+        assert a in (0, 1)
+        a2 = algo.compute_single_action(obs, explore=True)
+        assert a2 in (0, 1)
+        path = algo.save(str(tmp_path / "ck"))
+        w = algo.get_weights()
+        algo.stop()
+
+        from ray_tpu.rllib import PPO
+        algo2 = PPO.from_checkpoint(path, config)
+        import jax
+        a_flat = np.concatenate([np.ravel(x)
+                                 for x in jax.tree_util.tree_leaves(w)])
+        b_flat = np.concatenate([np.ravel(x) for x in
+                                 jax.tree_util.tree_leaves(
+                                     algo2.get_weights())])
+        np.testing.assert_allclose(a_flat, b_flat)
+        assert algo2.compute_single_action(obs) in (0, 1)
+        algo2.stop()
