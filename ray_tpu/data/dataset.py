@@ -820,13 +820,24 @@ class Dataset:
         yield from streaming.batches_from_blocks(
             blocks, batch_size, batch_format, drop_last)
 
+    def _iter_framework_batches(self, convert, **kwargs):
+        """Shared torch/tf batch iteration: numpy batches through
+        iter_batches (ALL its kwargs forwarded — unknown keys raise)
+        converted per framework."""
+        kwargs.pop("batch_format", None)  # conversion fixes the format
+        for batch in self.iter_batches(batch_format="numpy", **kwargs):
+            yield {k: convert(v) for k, v in batch.items()}
+
     def iter_torch_batches(self, **kwargs):
-        for batch in self.iter_batches(
-                batch_format="numpy",
-                **{k: v for k, v in kwargs.items()
-                   if k in ("batch_size", "drop_last")}):
-            import torch
-            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+        """(reference: dataset.py iter_torch_batches)"""
+        import torch
+        return self._iter_framework_batches(torch.as_tensor, **kwargs)
+
+    def iter_tf_batches(self, **kwargs):
+        """(reference: dataset.py iter_tf_batches)"""
+        import tensorflow as tf
+        return self._iter_framework_batches(tf.convert_to_tensor,
+                                            **kwargs)
 
     def to_pandas(self):
         import pandas as pd

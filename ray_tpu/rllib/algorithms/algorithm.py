@@ -202,24 +202,13 @@ class Algorithm:
         self._sync_connector_states()
 
         params = self.get_weights()
-        discrete = getattr(self.module, "discrete", True)
         returns = []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=10_000 + ep)
             done, total = False, 0.0
             while not done:
                 # Same obs/action pipelines the module trained with.
-                obs_b = self._e2m(
-                    {"obs": np.asarray(obs, np.float32)[None]},
-                    module=self.module, update=False)["obs"]
-                a = self.module.forward_inference(params, obs_b)
-                out = self._m2e({"actions": a},
-                                action_space=env.action_space,
-                                module=self.module)
-                env_actions = out.get("env_actions", out["actions"])
-                act = (int(np.asarray(env_actions[0]).item())
-                       if discrete
-                       else np.asarray(env_actions[0], np.float32))
+                act = self._infer_action(obs, params, env.action_space)
                 obs, rew, term, trunc, _ = env.step(act)
                 total += float(rew)
                 done = term or trunc
@@ -274,31 +263,44 @@ class Algorithm:
             env.close()
         return self._action_space_cache
 
-    def compute_single_action(self, observation, explore: bool = False):
-        """Single-observation inference through the SAME connector
-        pipelines training used (reference:
-        Algorithm.compute_single_action)."""
-        self._sync_connector_states()
+    def _infer_action(self, observation, params, action_space,
+                      explore: bool = False):
+        """One observation through e2m -> forward -> m2e (shared by
+        evaluate() and compute_single_action)."""
         obs_b = self._e2m(
             {"obs": np.asarray(observation, np.float32)[None]},
             module=self.module, update=False)["obs"]
-        # Device-resident params: a full device->host weights copy per
-        # action would dominate the call.
-        params = (self.learner.params if self.learner is not None
-                  else self.get_weights())
         if explore:
-            rng = np.random.default_rng()
+            if not hasattr(self, "_explore_rng"):
+                self._explore_rng = np.random.default_rng(
+                    self.config.seed)
             action, _ = self.module.forward_exploration(
-                params, obs_b, rng)
+                params, obs_b, self._explore_rng)
         else:
             action = self.module.forward_inference(params, obs_b)
-        out = self._m2e({"actions": action},
-                        action_space=self._cached_action_space(),
+        out = self._m2e({"actions": action}, action_space=action_space,
                         module=self.module)
         env_actions = out.get("env_actions", out["actions"])
         if getattr(self.module, "discrete", True):
             return int(np.asarray(env_actions[0]).item())
         return np.asarray(env_actions[0], np.float32)
+
+    def compute_single_action(self, observation, explore: bool = False):
+        """Single-observation inference through the SAME connector
+        pipelines training used (reference:
+        Algorithm.compute_single_action)."""
+        # Runner connector stats change only when training steps run:
+        # sync once per iteration, not per action (the fan-out to the
+        # runner actors would dominate a rollout loop).
+        if getattr(self, "_conn_synced_iter", None) != self.iteration:
+            self._sync_connector_states()
+            self._conn_synced_iter = self.iteration
+        # Device-resident params: a full device->host weights copy per
+        # action would dominate the call.
+        params = (self.learner.params if self.learner is not None
+                  else self.get_weights())
+        return self._infer_action(observation, params,
+                                  self._cached_action_space(), explore)
 
     @classmethod
     def from_checkpoint(cls, checkpoint_dir: str,
@@ -306,13 +308,17 @@ class Algorithm:
         """Build + restore in one step (reference:
         Algorithm.from_checkpoint)."""
         algo = config.build()
-        if cls is not Algorithm and not isinstance(algo, cls):
-            raise TypeError(
-                f"{cls.__name__}.from_checkpoint got a config building "
-                f"{type(algo).__name__}; call "
-                f"{type(algo).__name__}.from_checkpoint (or pass the "
-                f"matching config).")
-        algo.restore(checkpoint_dir)
+        try:
+            if cls is not Algorithm and not isinstance(algo, cls):
+                raise TypeError(
+                    f"{cls.__name__}.from_checkpoint got a config "
+                    f"building {type(algo).__name__}; call "
+                    f"{type(algo).__name__}.from_checkpoint (or pass "
+                    f"the matching config).")
+            algo.restore(checkpoint_dir)
+        except BaseException:
+            algo.stop()  # never leak the just-built runner actors
+            raise
         return algo
 
     def stop(self):

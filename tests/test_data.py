@@ -380,3 +380,14 @@ class TestDatasetParityOps:
         ds = data.from_items([{"x": 1}]).filter(lambda r: False)
         with pytest.raises(ValueError, match="empty"):
             ds.take_batch(4)
+
+    def test_iter_tf_batches(self, ray_start_shared):
+        import numpy as np
+
+        from ray_tpu import data
+        ds = data.from_numpy(np.arange(10, dtype=np.float32), column="x")
+        batches = list(ds.iter_tf_batches(batch_size=4))
+        assert len(batches) == 3
+        import tensorflow as tf
+        assert isinstance(batches[0]["x"], tf.Tensor)
+        assert batches[0]["x"].shape[0] == 4
