@@ -134,6 +134,9 @@ class ActorSpec:
     runtime_env: Optional[dict] = None
     lifetime: Optional[str] = None   # None | "detached"
     method_meta: Dict[str, Any] = field(default_factory=dict)
+    # name -> max_concurrency for that group (reference:
+    # ConcurrencyGroupManager, transport/concurrency_group_manager.cc)
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
     trace_ctx: Optional[dict] = None
 
 

@@ -63,15 +63,20 @@ def _to_host(obj: Any) -> Any:
 
     The host object store holds CPU bytes; device tensors move over ICI/DCN via
     XLA collectives, not through this store (SURVEY.md §2.1 translation note).
-    """
-    try:
-        import jax
-        import numpy as np
 
-        if isinstance(obj, jax.Array):
-            return np.asarray(obj)
-    except Exception:
-        pass
+    Only consult jax if it is ALREADY imported: a value cannot be a jax
+    array otherwise, and `import jax` costs ~2 s — it was the entire
+    first-call latency of fresh actors (workers boot lean without jax).
+    """
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            import numpy as np
+            if isinstance(obj, jax.Array):
+                return np.asarray(obj)
+        except Exception:
+            pass
     return obj
 
 

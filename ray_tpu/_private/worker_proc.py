@@ -158,6 +158,7 @@ class Worker:
         self._actor_instance = None
         self._actor_spec: Optional[P.ActorSpec] = None
         self._actor_executor: Optional[ThreadPoolExecutor] = None
+        self._cg_executors: Dict[str, ThreadPoolExecutor] = {}
         self._actor_loop: Optional[asyncio.AbstractEventLoop] = None
         self._actor_loop_lock = threading.Lock()
         self._shutdown = threading.Event()
@@ -388,12 +389,30 @@ class Worker:
             n = max(1, spec.max_concurrency)
             self._actor_executor = ThreadPoolExecutor(
                 max_workers=n, thread_name_prefix="actor")
+            # Concurrency groups (reference: ConcurrencyGroupManager,
+            # transport/concurrency_group_manager.cc): each named group
+            # gets its own executor with its own cap; methods tagged
+            # @method(concurrency_group=...) route there, everything
+            # else shares the default executor above.
+            self._cg_executors = {
+                name: ThreadPoolExecutor(
+                    max_workers=max(1, int(cap)),
+                    thread_name_prefix=f"actor-cg-{name}")
+                for name, cap in spec.concurrency_groups.items()}
             self.send(P.ACTOR_READY, {"actor_id": spec.actor_id, "error": None})
         except BaseException as e:  # noqa: BLE001
             err = TaskError(e, task_repr=f"{spec.cls_id}.__init__",
                             remote_tb=traceback.format_exc())
             self.send(P.ACTOR_READY, {"actor_id": spec.actor_id,
                                       "error": serialization.dumps(err)})
+
+    def _executor_for(self, spec: P.TaskSpec) -> ThreadPoolExecutor:
+        """Route an actor task to its method's concurrency-group
+        executor (default executor when untagged/unknown)."""
+        meta = (self._actor_spec.method_meta or {}).get(
+            spec.method_name or "", {})
+        group = meta.get("concurrency_group")
+        return self._cg_executors.get(group, self._actor_executor)
 
     # -- cancellation ------------------------------------------------------
     def _cancel(self, task_id: TaskID):
@@ -418,7 +437,7 @@ class Worker:
             if msg_type == P.EXEC_TASK:
                 spec: P.TaskSpec = payload["spec"]
                 if spec.actor_id is not None and self._actor_executor is not None:
-                    self._actor_executor.submit(self._execute, spec)
+                    self._executor_for(spec).submit(self._execute, spec)
                 else:
                     self._task_pool.submit(self._execute, spec)
             elif msg_type == P.REPLY:

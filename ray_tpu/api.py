@@ -590,6 +590,20 @@ class ActorClass:
             max_concurrency = 1000 if is_async else 1
         _actor_pg_id, _actor_bundle_index, _actor_resources = \
             _apply_placement(opts, _build_resources(opts, default_num_cpus=0))
+        concurrency_groups = {
+            str(k): int(v) for k, v in
+            (opts.get("concurrency_groups") or {}).items()}
+        # A method tagged with an undeclared group would silently fall
+        # back to the default executor (reference raises here too).
+        for mname, meta in self._method_meta.items():
+            group = meta.get("concurrency_group")
+            if group is not None and group not in concurrency_groups:
+                raise ValueError(
+                    f"Method {mname!r} uses concurrency_group {group!r}, "
+                    f"but the actor declares only "
+                    f"{sorted(concurrency_groups) or 'none'} (pass "
+                    f"concurrency_groups={{{group!r}: N}} to "
+                    f"@ray_tpu.remote).")
         spec = P.ActorSpec(
             actor_id=actor_id, cls_id=self._cls_id, cls_blob=self._blob,
             args=s_args, kwargs=s_kwargs, name=opts.get("name"),
@@ -606,7 +620,8 @@ class ActorClass:
             scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=_validate_runtime_env(opts.get("runtime_env")),
             lifetime=opts.get("lifetime"),
-            method_meta=self._method_meta)
+            method_meta=self._method_meta,
+            concurrency_groups=concurrency_groups)
         rt.create_actor(spec)
         return ActorHandle(actor_id, self._cls_id, self._method_meta)
 
