@@ -89,8 +89,42 @@ def bench_core(extras):
         del ref
     put_gbps = iters * big.nbytes / (time.perf_counter() - t0) / 1e9
 
+    # compiled DAG round trip (reference microbench: compiled DAG vs
+    # task-per-call; dag/compiled_dag_node.py)
+    @ray_tpu.remote
+    class _Echo:
+        def step(self, x):
+            return x
+
+    from ray_tpu.dag import InputNode
+    e = _Echo.remote()
+    with InputNode() as inp:
+        dag = e.step.bind(inp)
+    compiled = dag.experimental_compile()
+    compiled.execute(0).get()
+    n = 2000
+    t0 = time.perf_counter()
+    for i in range(n):
+        compiled.execute(i).get()
+    adag_rate = n / (time.perf_counter() - t0)
+    compiled.teardown()
+
+    # placement group create+remove (reference: 749/s committed)
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pg = placement_group([{"CPU": 1}])
+        ray_tpu.get(pg.ready())
+        remove_placement_group(pg)
+    pg_rate = n / (time.perf_counter() - t0)
+
     ray_tpu.shutdown()
     extras.update({
+        "compiled_dag_calls_per_s": round(adag_rate, 1),
+        "pg_create_remove_per_s": round(pg_rate, 1),
+        "baseline_pg_create_remove_per_s": 749.0,
         "tasks_async_per_s": round(async_rate, 1),
         "actor_calls_sync_per_s": round(actor_sync, 1),
         "actor_calls_async_per_s": round(actor_async, 1),

@@ -154,7 +154,12 @@ class Channel:
             time.sleep(delay)
             delay = min(delay * 2, 1e-3)
         self._shm.buf[self._payload_off:self._payload_off + len(blob)] = blob
-        _HEADER.pack_into(self._shm.buf, 0, version + 1, len(blob))
+        # Publish length BEFORE version as separate aligned 8-byte
+        # stores: packing both in one 16-byte memcpy lets a reader catch
+        # the new version with the stale/zero length (observed as a torn
+        # read under load). The version store is the release barrier.
+        struct.pack_into("<Q", self._shm.buf, 8, len(blob))
+        struct.pack_into("<Q", self._shm.buf, 0, version + 1)
 
     def read(self, timeout: Optional[float] = None):
         """Block for the next value after the last one this reader saw."""
@@ -163,6 +168,14 @@ class Channel:
         while True:
             version, length = _HEADER.unpack_from(self._shm.buf, 0)
             if version > self._seen:
+                # Seqlock stability check: re-read until two consecutive
+                # header samples agree, so a torn observation (new
+                # version paired with a stale length — possible on
+                # weakly-ordered hardware where the writer's two stores
+                # reorder) resolves before we trust `length`.
+                v2, l2 = _HEADER.unpack_from(self._shm.buf, 0)
+                if (v2, l2) != (version, length):
+                    continue
                 break
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("channel read timed out")
