@@ -29,6 +29,7 @@ from .api import (
     get,
     get_actor,
     get_runtime_context,
+    get_tpu_ids,
     init,
     is_initialized,
     kill,
@@ -45,6 +46,6 @@ __version__ = "0.1.0"
 __all__ = [
     "ActorHandle", "ObjectRef", "ObjectRefGenerator", "available_resources", "cancel",
     "cluster_resources", "exceptions", "get", "get_actor",
-    "get_runtime_context", "init", "is_initialized", "kill", "method",
+    "get_runtime_context", "get_tpu_ids", "init", "is_initialized", "kill", "method",
     "put", "remote", "shutdown", "wait", "__version__",
 ]

@@ -65,6 +65,10 @@ class LocalRuntime:
         return value
 
     def _run(self, fn, spec: P.TaskSpec):
+        # Same task context as cluster mode, so get_task_id() etc.
+        # behave identically under local_mode=True.
+        from .worker_proc import _task_ctx_var
+        token = _task_ctx_var.set(spec)
         try:
             args = [self._resolve(a) for a in spec.args]
             kwargs = {k: self._resolve(a) for k, a in spec.kwargs.items()}
@@ -76,6 +80,8 @@ class LocalRuntime:
             err = TaskError(e, task_repr=spec.name)
             for rid in spec.return_ids:
                 self._objects[rid] = ("err", err)
+        finally:
+            _task_ctx_var.reset(token)
 
     def submit_task(self, spec: P.TaskSpec):
         fn = self._fns.get(spec.fn_id)

@@ -303,6 +303,10 @@ class WorkerPool:
         # sitecustomize force-registers a TPU backend in every interpreter.
         env.setdefault("JAX_PLATFORMS", "cpu")
         env.setdefault("PALLAS_AXON_POOL_IPS", "")
+        # Never inherit the DRIVER's chip visibility: a cpu-pool worker
+        # with no chips assigned must not report the driver's
+        # TPU_VISIBLE_CHIPS through get_tpu_ids().
+        env.setdefault("TPU_VISIBLE_CHIPS", "")
         if extra_env:
             env.update(extra_env)
         address = os.path.join(self._session_dir,
@@ -332,7 +336,7 @@ class WorkerPool:
         if (bool(ray_config.worker_lean_boot)
                 and self._lean_boot_safe()
                 and env.get("JAX_PLATFORMS") == "cpu"
-                and "TPU_VISIBLE_CHIPS" not in env):
+                and not env.get("TPU_VISIBLE_CHIPS")):
             # CPU-pool workers boot with -S: this environment's
             # sitecustomize imports jax + a TPU plugin (~5 s of CPU per
             # process — measured), which a cpu-pinned worker never needs.

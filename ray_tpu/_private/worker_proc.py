@@ -42,13 +42,20 @@ from .ids import ActorID, ObjectID, TaskID
 from .object_store import ObjectStore, create_store, inline_threshold
 
 
-# Per-thread currently-executing task spec (reference: the worker's
-# runtime context / current task in _private/worker.py + runtime_context.py).
-_task_ctx = threading.local()
+# Currently-executing task spec (reference: the worker's runtime
+# context / current task in _private/worker.py + runtime_context.py).
+# A ContextVar, not a threading.local: async actor methods run on the
+# actor's event-loop thread, and run_coroutine_threadsafe propagates the
+# submitting thread's context into the Task — so coroutines see their
+# own spec even when many interleave on one loop.
+import contextvars
+
+_task_ctx_var: contextvars.ContextVar[Optional[P.TaskSpec]] = \
+    contextvars.ContextVar("ray_tpu_current_task", default=None)
 
 
 def current_task_spec() -> Optional[P.TaskSpec]:
-    return getattr(_task_ctx, "spec", None)
+    return _task_ctx_var.get()
 
 
 class WorkerClient:
@@ -262,7 +269,7 @@ class Worker:
         tid = spec.task_id.binary()
         with self._running_lock:
             self._running[tid] = threading.get_ident()
-        _task_ctx.spec = spec
+        ctx_token = _task_ctx_var.set(spec)
         trace_token = None
         exec_span = None
         if spec.trace_ctx:
@@ -352,7 +359,7 @@ class Worker:
                     tracing.flush()
                 except Exception:
                     pass
-            _task_ctx.spec = None
+            _task_ctx_var.reset(ctx_token)
             with self._running_lock:
                 self._running.pop(tid, None)
 

@@ -746,6 +746,56 @@ class RuntimeContext:
             return st._worker.config.worker_id.hex()
         return "driver"
 
+    @staticmethod
+    def _current_spec():
+        from ._private.worker_proc import current_task_spec
+        return current_task_spec()
+
+    def get_task_id(self) -> Optional[str]:
+        """Id of the currently executing task (None on the driver)."""
+        spec = self._current_spec()
+        return spec.task_id.hex() if spec is not None else None
+
+    def get_actor_id(self) -> Optional[str]:
+        """Id of the current actor (None outside actor methods)."""
+        spec = self._current_spec()
+        if spec is not None and spec.actor_id is not None:
+            return spec.actor_id.hex()
+        return None
+
+    def get_assigned_resources(self) -> Dict[str, float]:
+        """Resources of the currently executing task; inside actor
+        methods, the ACTOR's assigned resources (reference:
+        runtime_context.get_assigned_resources)."""
+        spec = self._current_spec()
+        if spec is None:
+            return {}
+        if spec.actor_id is not None:
+            # Actor-method specs carry no resources (the actor holds
+            # them for its lifetime); report the actor's.
+            from ._private import state as st
+            aspec = getattr(st._worker, "_actor_spec", None) \
+                if st._worker is not None else None
+            if aspec is not None:
+                return dict(aspec.resources)
+        return dict(spec.resources)
+
+    def get_accelerator_ids(self) -> Dict[str, List[str]]:
+        """Visible accelerator chip ids (reference:
+        runtime_context.get_accelerator_ids; ray.get_gpu_ids analogue —
+        here the TPU chips pinned via TPU_VISIBLE_CHIPS)."""
+        import os
+        chips = os.environ.get("TPU_VISIBLE_CHIPS", "")
+        return {"TPU": [c for c in chips.split(",") if c != ""]}
+
 
 def get_runtime_context() -> RuntimeContext:
     return RuntimeContext()
+
+
+def get_tpu_ids() -> List[int]:
+    """Chip ids assigned to this worker (reference: ray.get_gpu_ids —
+    the TPU equivalent reads the isolation env the scheduler set,
+    resources.py get_visible_chips_env)."""
+    return [int(c) for c in
+            get_runtime_context().get_accelerator_ids()["TPU"]]

@@ -504,3 +504,65 @@ class TestConcurrencyGroups:
 
         with pytest.raises(ValueError, match="'oi'"):
             Bad.remote()
+
+
+class TestRuntimeContext:
+    """Reference: runtime_context.py (task/actor ids, assigned
+    resources, accelerator ids / ray.get_gpu_ids)."""
+
+    def test_task_context_fields(self, ray_start_shared):
+        @ray_tpu.remote(num_cpus=1)
+        def inspect_ctx():
+            ctx = ray_tpu.get_runtime_context()
+            return {
+                "task_id": ctx.get_task_id(),
+                "actor_id": ctx.get_actor_id(),
+                "resources": ctx.get_assigned_resources(),
+                "tpus": ray_tpu.get_tpu_ids(),
+            }
+
+        out = ray_tpu.get(inspect_ctx.remote())
+        assert out["task_id"] is not None and len(out["task_id"]) == 32
+        assert out["actor_id"] is None
+        assert out["resources"].get("CPU") == 1
+        assert out["tpus"] == []  # cpu-pool worker holds no chips
+
+    def test_actor_context(self, ray_start_shared):
+        @ray_tpu.remote
+        class A:
+            def who(self):
+                ctx = ray_tpu.get_runtime_context()
+                return ctx.get_actor_id(), ctx.get_task_id()
+
+        a = A.remote()
+        actor_id, task_id = ray_tpu.get(a.who.remote())
+        assert actor_id is not None and task_id is not None
+
+    def test_driver_context(self, ray_start_shared):
+        ctx = ray_tpu.get_runtime_context()
+        assert ctx.get_task_id() is None
+        assert ctx.get_actor_id() is None
+        assert ctx.is_initialized
+
+    def test_async_actor_context(self, ray_start_shared):
+        """Regression: contextvars (not thread-locals) so async actor
+        methods on the event-loop thread see their own task spec."""
+        @ray_tpu.remote
+        class Async:
+            async def who(self):
+                ctx = ray_tpu.get_runtime_context()
+                return ctx.get_actor_id(), ctx.get_task_id()
+
+        a = Async.remote()
+        actor_id, task_id = ray_tpu.get(a.who.remote())
+        assert actor_id is not None and task_id is not None
+
+    def test_actor_assigned_resources(self, ray_start_shared):
+        @ray_tpu.remote(num_cpus=1)
+        class R:
+            def res(self):
+                return ray_tpu.get_runtime_context()\
+                    .get_assigned_resources()
+
+        out = ray_tpu.get(R.remote().res.remote())
+        assert out.get("CPU") == 1
