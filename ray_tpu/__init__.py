@@ -34,9 +34,11 @@ from .api import (
     is_initialized,
     kill,
     method,
+    nodes,
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from . import exceptions
@@ -46,6 +48,6 @@ __version__ = "0.1.0"
 __all__ = [
     "ActorHandle", "ObjectRef", "ObjectRefGenerator", "available_resources", "cancel",
     "cluster_resources", "exceptions", "get", "get_actor",
-    "get_runtime_context", "get_tpu_ids", "init", "is_initialized", "kill", "method",
+    "get_runtime_context", "get_tpu_ids", "init", "is_initialized", "kill", "method", "nodes", "timeline",
     "put", "remote", "shutdown", "wait", "__version__",
 ]

@@ -135,6 +135,14 @@ class LocalRuntime:
         if op == "list_actors":
             return [{"actor_id": a.hex(), "state": "ALIVE"}
                     for a in self._actors]
+        if op == "list_nodes":
+            return [{"node_id": "local", "alive": True, "is_head": True,
+                     "resources_total": dict(self._resources),
+                     "resources_available": dict(self._resources)}]
+        # Iterating list-shaped ops must not crash in local mode
+        # (timeline/task_events/list_* have nothing to report here).
+        if op.startswith("list_") or op in ("task_events", "kv_keys"):
+            return []
         return None
 
     def shutdown(self):

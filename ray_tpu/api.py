@@ -27,6 +27,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "get", "put",
     "wait", "kill", "cancel", "get_actor", "ObjectRef", "ActorHandle",
     "cluster_resources", "available_resources", "get_runtime_context",
+    "get_tpu_ids", "nodes", "timeline",
 ]
 
 
@@ -776,6 +777,9 @@ class RuntimeContext:
             from ._private import state as st
             aspec = getattr(st._worker, "_actor_spec", None) \
                 if st._worker is not None else None
+            if aspec is None:  # local_mode: specs live on the runtime
+                rt = st.current_or_none()
+                aspec = getattr(rt, "_actor_specs", {}).get(spec.actor_id)
             if aspec is not None:
                 return dict(aspec.resources)
         return dict(spec.resources)
@@ -791,6 +795,18 @@ class RuntimeContext:
 
 def get_runtime_context() -> RuntimeContext:
     return RuntimeContext()
+
+
+def nodes() -> List[Dict[str, Any]]:
+    """Cluster node table (reference: ray.nodes())."""
+    from .util import state as state_api
+    return state_api.list_nodes()
+
+
+def timeline(filename: Optional[str] = None):
+    """Chrome-trace task timeline (reference: ray.timeline())."""
+    from .util import state as state_api
+    return state_api.timeline(filename=filename)
 
 
 def get_tpu_ids() -> List[int]:

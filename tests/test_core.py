@@ -566,3 +566,12 @@ class TestRuntimeContext:
 
         out = ray_tpu.get(R.remote().res.remote())
         assert out.get("CPU") == 1
+
+    def test_nodes_and_timeline_api(self, ray_start_shared, tmp_path):
+        ns = ray_tpu.nodes()
+        assert ns and "node_id" in ns[0]
+        ray_tpu.get(ray_tpu.remote(lambda: 1).remote())
+        out = str(tmp_path / "tl.json")
+        ray_tpu.timeline(out)
+        import json
+        assert isinstance(json.load(open(out)), list)
