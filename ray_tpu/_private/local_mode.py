@@ -143,9 +143,8 @@ class LocalRuntime:
         # (timeline/task_events/list_* have nothing to report here).
         if op.startswith("list_") or op in ("task_events", "kv_keys"):
             return []
-        # Dict-shaped tables (placement groups, object stats) likewise.
-        if op in ("pg_table", "object_stats") or op.endswith("_table") \
-                or op.startswith("summarize_"):
+        # Dict-shaped tables likewise (the only such ops today).
+        if op in ("pg_table", "object_stats"):
             return {}
         return None
 

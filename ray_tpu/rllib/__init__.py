@@ -8,6 +8,7 @@ control), and IMPALA/APPO (V-trace off-policy correction) families.
 """
 from .algorithms.algorithm import Algorithm, AlgorithmConfig
 from .algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
+from .algorithms.cql import CQL, CQLConfig
 from .algorithms.dqn import DQN, DQNConfig
 from .algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig, vtrace
 from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from ..core.learner import JaxLearner
 from ..core.rl_module import PPOModule
-from ..offline import DatasetReader
+from ..offline import DatasetReader, resolve_offline_reader
 from .algorithm import Algorithm, AlgorithmConfig
 
 
@@ -55,18 +55,11 @@ class MARWIL(Algorithm):
     _beta = 1.0
 
     def __init__(self, config):
-        reader = config.extra.get("offline_data")
-        if reader is None:
-            raise ValueError(
-                f"{type(self).__name__} needs .training("
-                f"offline_data=<Dataset|DatasetReader>)")
         beta = float(config.extra.get("beta", self._beta))
-        if not isinstance(reader, DatasetReader):
-            reader = DatasetReader(
-                reader, batch_size=config.train_batch_size,
-                seed=config.seed,
-                compute_returns=config.gamma if beta > 0 else None)
-        elif beta > 0 and reader._rows and \
+        reader = resolve_offline_reader(
+            config, type(self).__name__,
+            compute_returns=config.gamma if beta > 0 else None)
+        if beta > 0 and reader._rows and \
                 "value_targets" not in reader._rows[0]:
             # User-built reader without returns: compute them here (over
             # episode order) rather than KeyError deep in the jitted loss.

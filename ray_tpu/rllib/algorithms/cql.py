@@ -1,0 +1,124 @@
+"""CQL — Conservative Q-Learning for offline continuous control.
+
+Reference parity: rllib/algorithms/cql/ (CQL extends SAC with the
+conservative penalty of Kumar et al. 2020 and trains from offline
+experience instead of a live replay stream). The update is SAC's
+twin-critic/entropy machinery (sac.py make_sac_update) with the CQL(H)
+regularizer plugged in as the critic penalty:
+
+    L_cql = alpha_cql * E_s[ logsumexp_a Q(s, a) - Q(s, a_data) ]
+
+The logsumexp is approximated over uniform-random and current-policy
+actions (no importance-density correction — documented approximation) —
+pushing Q down on out-of-distribution actions and up on dataset actions,
+which keeps offline-learned policies from exploiting Q-function
+extrapolation errors.
+"""
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rl_module import SACModule
+from ..offline import resolve_offline_reader
+from .algorithm import Algorithm, AlgorithmConfig
+from .sac import make_sac_update
+
+
+def make_cql_penalty(module: SACModule, cql_alpha: float,
+                     n_cql_actions: int = 8):
+    """critic_penalty_fn for make_sac_update implementing CQL(H)."""
+
+    def penalty(params, batch, q1, q2, key):
+        obs = batch["obs"]
+        B = obs.shape[0]
+        A = module.num_actions
+        k_rand, k_pol = jax.random.split(key)
+
+        def q_on(actions_bna):  # [B, N, A] -> (q1, q2) each [B, N]
+            flat = actions_bna.reshape(B * n_cql_actions, A)
+            obs_rep = jnp.repeat(obs, n_cql_actions, axis=0)
+            f1, f2 = module.apply_q(params, obs_rep, flat)
+            return (f1.reshape(B, n_cql_actions),
+                    f2.reshape(B, n_cql_actions))
+
+        rand_a = jax.random.uniform(
+            k_rand, (B, n_cql_actions, A), minval=-1.0, maxval=1.0)
+        pol_a, _ = module.sample_action(
+            params, jnp.repeat(obs, n_cql_actions, axis=0), k_pol)
+        pol_a = jax.lax.stop_gradient(pol_a).reshape(
+            B, n_cql_actions, A)
+        r1, r2 = q_on(rand_a)
+        p1, p2 = q_on(pol_a)
+        cat1 = jnp.concatenate([r1, p1], axis=1)
+        cat2 = jnp.concatenate([r2, p2], axis=1)
+        cql = (jnp.mean(jax.nn.logsumexp(cat1, axis=1) - q1)
+               + jnp.mean(jax.nn.logsumexp(cat2, axis=1) - q2))
+        return cql_alpha * cql, {"cql_penalty": cql}
+
+    return penalty
+
+
+class CQL(Algorithm):
+    """Offline: trains from `.training(offline_data=...)` (rows with
+    obs/actions/rewards/terminateds/next_obs, continuous actions in
+    [-1, 1]); no env runners."""
+
+    def __init__(self, config):
+        self.reader = resolve_offline_reader(config, "CQL")
+        super().__init__(config)
+        cfg = config
+        target_entropy = float(
+            cfg.extra.get("target_entropy", -self.module.num_actions))
+        self._init_state, self._update = make_sac_update(
+            self.module, cfg.gamma, cfg.lr,
+            float(cfg.extra.get("tau", 0.005)), target_entropy,
+            critic_penalty_fn=make_cql_penalty(
+                self.module,
+                float(cfg.extra.get("cql_alpha", 1.0)),
+                int(cfg.extra.get("n_cql_actions", 8))))
+        self._state = self._init_state(cfg.seed)
+        self._key = jax.random.PRNGKey(cfg.seed)
+
+    def _build_module(self, obs_dim, num_actions):
+        return SACModule(obs_dim, num_actions, self.config.hidden)
+
+    def _build_learner(self):
+        return None  # CQL owns its jitted update (twin nets + alpha)
+
+    def get_weights(self):
+        return self._state["params"]
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        stats: Dict = {}
+        n = 0
+        for batch in self.reader.iter_batches(
+                epochs=int(cfg.extra.get("epochs_per_iter", 1))):
+            jb = {k: jnp.asarray(v) for k, v in batch.items()
+                  if k in ("obs", "actions", "rewards", "terminateds",
+                           "next_obs")}
+            self._key, sub = jax.random.split(self._key)
+            self._state, metrics = self._update(self._state, jb, sub)
+            stats = {k: float(v) for k, v in metrics.items()}
+            n += len(batch["rewards"])
+        self._total_steps += n
+        return stats
+
+    def _get_algo_state(self):
+        return {"cql_state": jax.tree.map(np.asarray, self._state)}
+
+    def _set_algo_state(self, state):
+        if "cql_state" in state:
+            self._state = jax.tree.map(jnp.asarray, state["cql_state"])
+
+
+class CQLConfig(AlgorithmConfig):
+    ALGO_CLS = CQL
+
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 0
+        self.lr = 3e-4
+        self.train_batch_size = 256

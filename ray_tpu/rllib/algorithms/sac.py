@@ -18,16 +18,22 @@ from .algorithm import Algorithm, AlgorithmConfig
 
 
 def make_sac_update(module: SACModule, gamma: float, lr: float,
-                    tau: float, target_entropy: float):
+                    tau: float, target_entropy: float,
+                    critic_penalty_fn=None):
     """One jitted SAC step over state = {params, target_q, log_alpha,
     opt_state}; returns (state, metrics). Critic, actor, and temperature
     losses combine with stop_gradients isolating each objective
-    (reference: sac_torch_learner compute_loss_for_module)."""
+    (reference: sac_torch_learner compute_loss_for_module).
+
+    `critic_penalty_fn(params, batch, q1, q2, key) -> (penalty, aux)`
+    optionally regularizes the critic loss — the extension point CQL
+    uses for its conservative term (cql.py), keeping one copy of the
+    SAC machinery."""
     optimizer = optax.adam(lr)
 
     def loss_fn(params, target_q, log_alpha, batch, key):
         alpha = jnp.exp(log_alpha)
-        k1, k2 = jax.random.split(key)
+        k1, k2, kp = jax.random.split(key, 3)
         # -- critic loss: entropy-regularized TD target from target nets
         next_a, next_logp = module.sample_action(
             params, batch["next_obs"], k1)
@@ -39,7 +45,13 @@ def make_sac_update(module: SACModule, gamma: float, lr: float,
         target = jax.lax.stop_gradient(
             batch["rewards"] + gamma * nonterm * min_tq)
         q1, q2 = module.apply_q(params, batch["obs"], batch["actions"])
-        q_loss = jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+        bellman = jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+        extra_metrics = {}
+        q_loss = bellman
+        if critic_penalty_fn is not None:
+            penalty, aux = critic_penalty_fn(params, batch, q1, q2, kp)
+            q_loss = bellman + penalty
+            extra_metrics.update(aux)
         # -- actor loss: maximize entropy-regularized Q via reparam
         a, logp = module.sample_action(params, batch["obs"], k2)
         pq1, pq2 = module.apply_q(
@@ -50,8 +62,9 @@ def make_sac_update(module: SACModule, gamma: float, lr: float,
         alpha_loss = -jnp.mean(
             log_alpha * jax.lax.stop_gradient(logp + target_entropy))
         total = q_loss + actor_loss + alpha_loss
-        return total, {"q_loss": q_loss, "actor_loss": actor_loss,
-                       "alpha": alpha, "entropy": -jnp.mean(logp)}
+        return total, {"q_loss": bellman, "actor_loss": actor_loss,
+                       "alpha": alpha, "entropy": -jnp.mean(logp),
+                       **extra_metrics}
 
     def init_state(seed: int = 0):
         params = module.init_params(seed)

@@ -158,3 +158,21 @@ class ImportanceSamplingEstimator:
             return {"v_target": float("nan"), "episodes": 0}
         return {"v_target": float(np.mean(returns)),
                 "episodes": len(returns)}
+
+
+def resolve_offline_reader(config, algo_name: str,
+                           compute_returns=None) -> "DatasetReader":
+    """Shared `.training(offline_data=...)` resolution for offline
+    algorithms (BC/MARWIL/CQL): accept a Dataset or a ready
+    DatasetReader, error clearly when absent."""
+    reader = config.extra.get("offline_data")
+    if reader is None:
+        raise ValueError(
+            f"{algo_name} needs .training(offline_data="
+            f"<Dataset|DatasetReader>)")
+    if not isinstance(reader, DatasetReader):
+        reader = DatasetReader(reader,
+                               batch_size=config.train_batch_size,
+                               seed=config.seed,
+                               compute_returns=compute_returns)
+    return reader
