@@ -216,12 +216,27 @@ def delete(name: str):
     ray_tpu.get(get_controller().delete_application.remote(name))
 
 
+def start_grpc(host: str = "127.0.0.1", port: int = 0):
+    """Start the gRPC ingress next to the HTTP proxy (reference:
+    serve.start(grpc_options=...) → gRPC proxy). Returns the proxy;
+    `proxy.port` is the bound port. See
+    `_private/grpc_proxy.GrpcServeClient` for the matching client."""
+    start()  # ensure controller up
+    from ._private.grpc_proxy import start_grpc_proxy
+    return start_grpc_proxy(host, port)
+
+
 def shutdown():
-    """Tear down all applications, the controller, and the proxy."""
+    """Tear down all applications, the controller, and the proxies."""
     global _proxy
     if _proxy is not None:
         _proxy.stop()
         _proxy = None
+    try:
+        from ._private.grpc_proxy import stop_grpc_proxy
+        stop_grpc_proxy()
+    except Exception:
+        pass
     try:
         from ._private.controller import CONTROLLER_NAME
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
@@ -239,6 +254,6 @@ __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "batch",
     "delete", "deployment", "get_app_handle", "get_deployment_handle",
-    "pad_batch_to_bucket", "proxy_address", "run", "shutdown", "start",
+    "pad_batch_to_bucket", "proxy_address", "run", "shutdown", "start", "start_grpc",
     "status",
 ]

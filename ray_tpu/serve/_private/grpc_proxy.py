@@ -1,0 +1,187 @@
+"""Serve gRPC ingress.
+
+Reference parity: the reference runs a gRPC proxy next to the HTTP proxy
+(serve/_private/proxy.py gRPCProxy; user protos registered via
+grpc_options). This build serves a GENERIC unary interface instead of
+user-compiled protobuf servicers: requests address
+`/<app_name>/<method_name>` with a pickled `{"args": [...], "kwargs":
+{...}}` payload and receive the pickled return value — the same
+deployment-handle routing path as HTTP, minus protoc codegen. Use
+`GrpcServeClient` for the matching client side.
+
+SECURITY: the payload is pickle — deserializing attacker bytes is code
+execution. The proxy therefore binds loopback only unless the caller
+passes `allow_remote=True` and owns the network boundary (the reference
+gRPC proxy has the same trust model: protobuf there, but handlers run
+arbitrary user code either way).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+_HANDLE_TTL_S = 5.0    # re-resolve app handles (delete/redeploy safety)
+_MISS_TTL_S = 1.0      # negative cache: throttle route-miss controller RPCs
+
+
+class GRPCProxy:
+    """Generic unary-unary gRPC front (reference: proxy.py gRPCProxy)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 16, request_timeout_s: float = 30.0,
+                 allow_remote: bool = False):
+        if not allow_remote and host not in ("127.0.0.1", "localhost",
+                                             "::1"):
+            raise ValueError(
+                f"GRPCProxy binds loopback only (got host={host!r}): the "
+                "wire format is pickle, so exposing it beyond localhost "
+                "is remote code execution for anyone who can reach the "
+                "port. Pass allow_remote=True only behind a trusted "
+                "network boundary.")
+        import grpc
+        from concurrent import futures
+        self._timeout_s = request_timeout_s
+        # app/method -> (handle, expires_at); misses -> (None, expires_at)
+        self._handles: dict = {}
+        self._lock = threading.Lock()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers,
+                                       thread_name_prefix="serve-grpc"),
+            handlers=(self._make_handler(),))
+        self.host = host
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+
+    class _RouteMiss(Exception):
+        pass
+
+    def _handle_for(self, app: str, method: str):
+        """TTL-cached handle resolution: handles go stale on
+        delete/redeploy, and a route-miss must not hammer the
+        controller (the HTTP proxy throttles its refresh the same
+        way)."""
+        key = (app, method)
+        now = time.monotonic()
+        with self._lock:
+            entry = self._handles.get(key)
+        if entry is not None and entry[1] > now:
+            if entry[0] is None:
+                raise GRPCProxy._RouteMiss(app)
+            return entry[0]
+        from .. import get_app_handle
+        try:
+            h = get_app_handle(app)
+        except ValueError:
+            with self._lock:
+                self._handles[key] = (None, now + _MISS_TTL_S)
+            raise GRPCProxy._RouteMiss(app) from None
+        if method != "__call__":
+            h = h.options(method_name=method)
+        with self._lock:
+            old = self._handles.get(key)
+            self._handles[key] = (h, now + _HANDLE_TTL_S)
+        if old is not None and old[0] is not None and old[0] is not h:
+            _shutdown_handle(old[0])
+        return h
+
+    def _make_handler(self):
+        import grpc
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, details):
+                path = details.method  # "/<app>/<method>"
+
+                def unary(request: bytes, context):
+                    app, _, method = path.strip("/").partition("/")
+                    try:
+                        payload = pickle.loads(request) if request else {}
+                        handle = proxy._handle_for(app, method or
+                                                   "__call__")
+                        resp = handle.remote(
+                            *payload.get("args", ()),
+                            **payload.get("kwargs", {}))
+                        value = resp.result(timeout_s=proxy._timeout_s)
+                        return pickle.dumps(value)
+                    except GRPCProxy._RouteMiss:
+                        context.abort(grpc.StatusCode.NOT_FOUND,
+                                      f"no application named {app!r}")
+                    except Exception as e:  # noqa: BLE001 — map to status
+                        context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=None,   # raw bytes in
+                    response_serializer=None)    # raw bytes out
+
+        return _Generic()
+
+    def stop(self):
+        self._server.stop(grace=1.0)
+        with self._lock:
+            handles, self._handles = self._handles, {}
+        for h, _ in handles.values():
+            if h is not None:
+                _shutdown_handle(h)
+
+
+def _shutdown_handle(handle):
+    """Stop a handle's router/long-poll thread (leak-free teardown)."""
+    try:
+        handle.shutdown()
+    except Exception:
+        pass
+
+
+class GrpcServeClient:
+    """Client for the generic proxy: call(app, *args, method=..., **kw).
+    (reference: users generate protobuf stubs; this pairs with the
+    generic ingress above.)"""
+
+    def __init__(self, address: str, timeout_s: float = 30.0):
+        import grpc
+        self._channel = grpc.insecure_channel(address)
+        self._timeout_s = timeout_s
+
+    def call(self, app: str, *args, method: str = "__call__",
+             **kwargs) -> Any:
+        fn = self._channel.unary_unary(
+            f"/{app}/{method}",
+            request_serializer=None, response_deserializer=None)
+        payload = pickle.dumps({"args": args, "kwargs": kwargs})
+        return pickle.loads(fn(payload, timeout=self._timeout_s))
+
+    def close(self):
+        self._channel.close()
+
+
+_grpc_proxy: Optional[GRPCProxy] = None
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0,
+                     **kwargs) -> GRPCProxy:
+    """Start (or return) the process-wide gRPC proxy next to the HTTP
+    one (reference: serve.start(grpc_options=...)). Re-calling with a
+    conflicting address errors instead of silently returning the old
+    binding."""
+    global _grpc_proxy
+    if _grpc_proxy is not None:
+        if (host not in ("127.0.0.1", _grpc_proxy.host)
+                or (port not in (0, _grpc_proxy.port))):
+            raise RuntimeError(
+                f"gRPC proxy already running on {_grpc_proxy.host}:"
+                f"{_grpc_proxy.port}; call serve.shutdown() before "
+                f"rebinding to {host}:{port}.")
+        return _grpc_proxy
+    _grpc_proxy = GRPCProxy(host, port, **kwargs)
+    return _grpc_proxy
+
+
+def stop_grpc_proxy():
+    global _grpc_proxy
+    if _grpc_proxy is not None:
+        _grpc_proxy.stop()
+        _grpc_proxy = None

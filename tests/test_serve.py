@@ -252,3 +252,105 @@ class TestServeSchema:
         assert built["applications"][0]["deployments"][0][
             "name"] == "EchoDeployment"
         serve.delete("yamlapp")
+
+
+class TestGrpcProxy:
+    """Reference: the serve gRPC proxy alongside HTTP (proxy.py
+    gRPCProxy); here a generic unary ingress + client."""
+
+    def test_grpc_roundtrip_and_methods(self):
+        from ray_tpu.serve._private.grpc_proxy import GrpcServeClient
+
+        @serve.deployment
+        class Calc:
+            def __call__(self, x):
+                return x * 2
+
+            def add(self, a, b):
+                return a + b
+
+        serve.run(Calc.bind(), name="calc", route_prefix="/calc")
+        proxy = serve.start_grpc(port=0)
+        client = GrpcServeClient(f"127.0.0.1:{proxy.port}")
+        try:
+            assert client.call("calc", 21) == 42
+            assert client.call("calc", 3, 4, method="add") == 7
+            # concurrent calls through the pooled handler
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(8) as ex:
+                outs = list(ex.map(lambda i: client.call("calc", i),
+                                   range(16)))
+            assert outs == [i * 2 for i in range(16)]
+        finally:
+            client.close()
+            serve.delete("calc")
+
+    def test_grpc_unknown_app_not_found(self):
+        import grpc
+
+        from ray_tpu.serve._private.grpc_proxy import GrpcServeClient
+        proxy = serve.start_grpc(port=0)
+        client = GrpcServeClient(f"127.0.0.1:{proxy.port}",
+                                 timeout_s=10)
+        try:
+            with pytest.raises(grpc.RpcError) as e:
+                client.call("nonexistent-app", 1)
+            assert e.value.code() == grpc.StatusCode.NOT_FOUND
+            # negative cache: an immediate retry is also NOT_FOUND and
+            # does not re-query the controller within the TTL
+            with pytest.raises(grpc.RpcError) as e2:
+                client.call("nonexistent-app", 1)
+            assert e2.value.code() == grpc.StatusCode.NOT_FOUND
+        finally:
+            client.close()
+
+    def test_grpc_loopback_only_by_default(self):
+        from ray_tpu.serve._private.grpc_proxy import GRPCProxy
+        with pytest.raises(ValueError, match="loopback"):
+            GRPCProxy(host="0.0.0.0")
+
+    def test_grpc_redeploy_not_stale(self):
+        """Regression: handle cache must expire so delete/redeploy
+        routes to the new app within the TTL."""
+        from ray_tpu.serve._private import grpc_proxy as gp
+        from ray_tpu.serve._private.grpc_proxy import GrpcServeClient
+
+        @serve.deployment
+        class V1:
+            def __call__(self, x):
+                return f"v1:{x}"
+
+        @serve.deployment
+        class V2:
+            def __call__(self, x):
+                return f"v2:{x}"
+
+        serve.run(V1.bind(), name="redeploy", route_prefix="/rd")
+        proxy = serve.start_grpc(port=0)
+        # Short client timeout: the first post-redeploy call may hit the
+        # dying V1 replica; retries must fit the poll window.
+        client = GrpcServeClient(f"127.0.0.1:{proxy.port}", timeout_s=3)
+        try:
+            assert client.call("redeploy", 1) == "v1:1"
+            serve.delete("redeploy")
+            serve.run(V2.bind(), name="redeploy", route_prefix="/rd")
+            old_ttl = gp._HANDLE_TTL_S
+            gp._HANDLE_TTL_S = 0.0  # expire immediately for the test
+            try:
+                import time as _t
+                deadline = _t.monotonic() + 10
+                out = None
+                while _t.monotonic() < deadline:
+                    try:
+                        out = client.call("redeploy", 2)
+                        if out == "v2:2":
+                            break
+                    except Exception:
+                        pass
+                    _t.sleep(0.2)
+                assert out == "v2:2"
+            finally:
+                gp._HANDLE_TTL_S = old_ttl
+        finally:
+            client.close()
+            serve.delete("redeploy")
