@@ -71,6 +71,18 @@ class RayConfig:
         # sqlite file for durable GCS KV ("" = in-memory only; reference:
         # Redis-backed GCS fault tolerance, store_client/redis_store_client)
         "gcs_storage_path": "",
+        # -- multi-host control plane (reference: GCS server bind address
+        # + raylet heartbeats, gcs_health_check_manager.h) ---------------
+        # Bind host for the head's daemon listener + transfer server.
+        # 127.0.0.1 = single machine; 0.0.0.0 to accept remote hosts.
+        "node_host": "127.0.0.1",
+        # Fixed head control port (0 = ephemeral).
+        "head_port": 0,
+        # Daemon heartbeat interval (liveness + load report).
+        "node_heartbeat_s": 2.0,
+        # Pull admission control: concurrent cross-node object pulls
+        # (reference: pull_manager.h in-flight bytes cap).
+        "pull_max_concurrent": 4,
         # CPU-pool workers boot python -S (skip sitecustomize's eager
         # jax/TPU-plugin import, ~5s per process). Disable if user code
         # depends on site customizations inside CPU workers.

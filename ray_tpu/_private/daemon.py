@@ -1,0 +1,429 @@
+"""Per-host node daemon: worker pool + local object store + transfer.
+
+The raylet-equivalent (reference: src/ray/raylet/main.cc — per-node daemon
+owning a worker pool, a plasma store, and an object manager, registering
+with the GCS over gRPC). The head remains the single scheduler (the
+collapsed design), so the reference's worker-lease protocol
+(node_manager.cc:1868 HandleRequestWorkerLease) becomes: head sends
+START_WORKER / relays task frames via TO_WORKER; the daemon owns process
+lifecycles, TPU-chip pinning, the node-local shm store, and pull-based
+object localization (object_manager/pull_manager.h:53).
+
+Run on each host of the cluster:
+
+    python -m ray_tpu._private.daemon --address HEAD_HOST:PORT \
+        [--num-cpus N] [--num-tpus N] [--resources '{"custom": 1}']
+
+with the cluster token in RAY_TPU_CLUSTER_TOKEN_HEX (or --token-hex).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from . import protocol as P
+from .ids import NodeID, WorkerID
+from .netcomm import PullManager, TransferServer, store_paths_factory
+from .object_store import create_store
+from .resources import detect_node_resources
+from .scheduler import WorkerHandle, WorkerPool
+
+
+class NodeDaemon:
+    def __init__(self, address: Tuple[str, int], token: bytes,
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: Optional[int] = None):
+        self.node_id = NodeID.from_random()
+        self.node_hex = self.node_id.hex()
+        session_name = f"node_{int(time.time())}_{uuid.uuid4().hex[:8]}"
+        self.session_dir = os.path.join("/tmp/ray_tpu_sessions", session_name)
+        self.store_dir = os.path.join("/dev/shm", f"ray_tpu_{session_name}")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.store = create_store(self.store_dir,
+                                  capacity=object_store_memory)
+        for d in (self.session_dir, self.store_dir):
+            try:
+                with open(os.path.join(d, ".owner_pid"), "w") as f:
+                    f.write(str(os.getpid()))
+            except OSError:
+                pass
+        self.totals = detect_node_resources(num_cpus, num_tpus, resources)
+        self.pool = WorkerPool(
+            self.session_dir, self.store_dir,
+            on_worker_message=self._on_worker_message,
+            on_worker_death=self._on_worker_death,
+            node_id_hex=self.node_hex)
+        from .config import ray_config
+        self.transfer = TransferServer(
+            store_paths_factory(self.store), token,
+            host=str(ray_config.node_host))
+        self.pull_mgr = PullManager(
+            self.store, token,
+            max_concurrent=int(ray_config.pull_max_concurrent))
+        self._free_chips: List[int] = list(
+            range(int(self.totals.get("TPU", 0))))
+        self._pool_workers = 0
+        ncpu = int(self.totals.get("CPU", 4))
+        self._max_pool_workers = max(ncpu, 4)
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._exec = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="daemon")
+        self._req_lock = threading.Lock()
+        self._req_counter = 0
+        self._pending: Dict[int, Future] = {}
+        self._transfer_addrs: Dict[str, Tuple[str, int]] = {}
+        self._stopped = threading.Event()
+
+        from multiprocessing.connection import Client
+        self.conn = Client(tuple(address), family="AF_INET", authkey=token)
+        self.head_host = address[0]
+        self._send(P.REGISTER_NODE, {
+            "node_id_hex": self.node_hex,
+            "resources": dict(self.totals),
+            "transfer_port": self.transfer.port,
+            "hostname": os.uname().nodename,
+            "pid": os.getpid(),
+        })
+        msg_type, payload = self._recv()
+        if msg_type != P.NODE_ACK:
+            raise RuntimeError(f"head rejected registration: {msg_type}")
+        self.head_node_hex = payload["head_node_id_hex"]
+        head_tport = payload.get("head_transfer_port")
+        if head_tport:
+            self._transfer_addrs[self.head_node_hex] = (
+                self.head_host, head_tport)
+        self._heartbeat_interval = float(ray_config.node_heartbeat_s)
+        threading.Thread(target=self._heartbeat_loop, daemon=True,
+                         name="heartbeat").start()
+
+    # -- head link -----------------------------------------------------
+    def _send(self, msg_type: str, payload: dict):
+        data = P.dump_message(msg_type, payload)
+        with self._send_lock:
+            self.conn.send_bytes(data)
+
+    def _recv(self):
+        import cloudpickle
+        return cloudpickle.loads(self.conn.recv_bytes())
+
+    def _request(self, op: str, **kwargs):
+        """Blocking metadata request to the head (NODE_REQUEST)."""
+        with self._req_lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+        fut: Future = Future()
+        self._pending[req_id] = fut
+        try:
+            self._send(P.NODE_REQUEST, {"req_id": req_id, "op": op,
+                                        "kwargs": kwargs})
+            result = fut.result(timeout=60.0)
+        finally:
+            self._pending.pop(req_id, None)
+        if isinstance(result, dict) and result.get("__error__") is not None:
+            raise result["__error__"]
+        return result
+
+    def _fail_pending(self, error: BaseException):
+        pending, self._pending = dict(self._pending), {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_result({"__error__": error})
+
+    def _heartbeat_loop(self):
+        while not self._stopped.wait(self._heartbeat_interval):
+            try:
+                self._send(P.NODE_PING, {
+                    "ts": time.time(),
+                    "store_used": getattr(self.store, "used_bytes", 0),
+                    "num_workers": len(self.pool.workers)})
+            except Exception:
+                return
+
+    # -- main loop -----------------------------------------------------
+    def run(self):
+        try:
+            while not self._stopped.is_set():
+                try:
+                    msg_type, payload = self._recv()
+                except (EOFError, OSError):
+                    # Head gone: the node dies with the cluster. Unblock
+                    # any threads waiting on head replies first.
+                    self._fail_pending(
+                        ConnectionError("head connection lost"))
+                    break
+                self._route(msg_type, payload)
+        finally:
+            self.shutdown()
+
+    def _route(self, msg_type: str, payload: dict):
+        if msg_type == P.TO_WORKER:
+            handle = self.pool.workers.get(WorkerID(payload["worker"]))
+            if handle is not None and handle.alive:
+                try:
+                    with handle.send_lock:
+                        handle.conn.send_bytes(payload["frame"])
+                except Exception:
+                    pass
+        elif msg_type == P.START_WORKER:
+            self._exec.submit(self._start_worker, payload)
+        elif msg_type == P.KILL_WORKER:
+            handle = self.pool.workers.get(WorkerID(payload["worker"]))
+            if handle is not None:
+                handle.kill()
+        elif msg_type == P.WORKER_DEDICATED:
+            # An idle pooled worker became a dedicated actor process: it
+            # no longer counts against the pool cap (mirrors the head
+            # scheduler's conversion accounting).
+            handle = self.pool.workers.get(WorkerID(payload["worker"]))
+            if handle is not None:
+                with self._lock:
+                    if getattr(handle, "counted_in_pool", False):
+                        self._pool_workers -= 1
+                        handle.counted_in_pool = False
+                handle.dedicated_actor = payload.get("actor_id")
+        elif msg_type == P.RELEASE_OBJECTS:
+            oids = payload["object_ids"]
+            for oid in oids:
+                self.store.free(oid)
+            frame = P.dump_message(P.RELEASE_OBJECTS,
+                                   {"object_ids": oids})
+            for handle in list(self.pool.workers.values()):
+                if handle.alive:
+                    try:
+                        with handle.send_lock:
+                            handle.conn.send_bytes(frame)
+                    except Exception:
+                        pass
+        elif msg_type == P.NODE_REPLY:
+            fut = self._pending.pop(payload["req_id"], None)
+            if fut is not None:
+                fut.set_result(payload.get("result"))
+        elif msg_type == P.SHUTDOWN_NODE:
+            self._stopped.set()
+
+    # -- worker lifecycle ----------------------------------------------
+    def _start_worker(self, payload: dict):
+        req_id = payload["req_id"]
+        env_key: str = payload["env_key"]
+        dedicated: bool = payload.get("dedicated", False)
+        counted = False
+        chip_ids: List[int] = []
+        try:
+            if not dedicated and env_key == "":
+                with self._lock:
+                    if self._pool_workers >= self._max_pool_workers:
+                        raise RuntimeError("worker pool at capacity")
+                    self._pool_workers += 1
+                    counted = True
+            extra_env: Dict[str, str] = {}
+            nchips = int(payload.get("nchips", 0))
+            if nchips > 0:
+                with self._lock:
+                    if len(self._free_chips) >= nchips:
+                        chip_ids = [self._free_chips.pop()
+                                    for _ in range(nchips)]
+                if not chip_ids:
+                    # Idle TPU workers hold chips; retire them so their
+                    # death returns the chips, then let the head's
+                    # dispatch retry (same recovery as the head pool's
+                    # _reclaim_idle_tpu_workers).
+                    self._reclaim_idle_tpu_workers()
+                    raise RuntimeError(
+                        f"node has no {nchips} free TPU chips "
+                        f"(reclaiming idle TPU workers)")
+                from .resources import tpu_worker_extra_env
+                extra_env = tpu_worker_extra_env(chip_ids)
+            spec_re = payload.get("runtime_env")
+            if spec_re:
+                from . import runtime_env as re_mod
+                extra_env.update(re_mod.worker_extra_env(spec_re))
+            handle = self.pool.start_worker(env_key, extra_env)
+            handle.chip_ids = chip_ids
+            handle.counted_in_pool = counted
+            self._send(P.NODE_REPLY, {
+                "req_id": req_id,
+                "result": {"worker_id": handle.worker_id.binary()}})
+        except BaseException as e:  # noqa: BLE001
+            with self._lock:
+                if counted:
+                    self._pool_workers -= 1
+                if chip_ids:
+                    self._free_chips.extend(chip_ids)
+            self._send(P.NODE_REPLY, {
+                "req_id": req_id, "result": {"__error__": e}})
+
+    def _reclaim_idle_tpu_workers(self):
+        for key in list(self.pool._idle.keys()):
+            if not key.startswith("tpu:"):
+                continue
+            while True:
+                h = self.pool.pop_idle(key)
+                if h is None:
+                    break
+                try:
+                    h.send(P.SHUTDOWN, {})
+                except Exception:
+                    h.kill()
+
+    def _on_worker_death(self, handle: WorkerHandle):
+        self.pool.remove(handle)
+        with self._lock:
+            if getattr(handle, "counted_in_pool", False):
+                self._pool_workers -= 1
+                handle.counted_in_pool = False
+            if handle.chip_ids:
+                self._free_chips.extend(handle.chip_ids)
+                handle.chip_ids = []
+        try:
+            self._send(P.WORKER_DIED,
+                       {"worker": handle.worker_id.binary()})
+        except Exception:
+            pass
+
+    # -- worker messages -----------------------------------------------
+    def _on_worker_message(self, handle: WorkerHandle, msg_type: str,
+                           payload: dict):
+        if msg_type == P.PULL_OBJECT:
+            self._exec.submit(self._handle_pull, handle, payload)
+            return
+        # Tag node-local shm locations with this node's id so the head
+        # registers WHERE the object lives (ownership-based object
+        # directory, ownership_based_object_directory.h) and skips its
+        # local-store adoption.
+        if msg_type == P.TASK_DONE and payload.get("results"):
+            payload = dict(payload)
+            oids = payload.get("return_oids") or [None] * len(
+                payload["results"])
+            payload["results"] = [self._tag_loc(loc, oid) for loc, oid
+                                  in zip(payload["results"], oids)]
+        elif msg_type == P.GEN_ITEM:
+            from .ids import object_id_for_return
+            payload = dict(payload)
+            payload["loc"] = self._tag_loc(
+                payload["loc"],
+                object_id_for_return(payload["task_id"], payload["index"]))
+        elif msg_type == P.OWNED_PUT and "size" in payload:
+            payload = dict(payload)
+            payload["node"] = self.node_hex
+            self.store.adopt(payload["object_id"], payload["size"])
+        try:
+            self._send(P.FROM_WORKER, {
+                "worker": handle.worker_id.binary(),
+                "frame": P.dump_message(msg_type, payload)})
+        except Exception:
+            pass
+
+    def _tag_loc(self, loc, oid=None):
+        if loc and loc[0] == P.LOC_SHM:
+            if oid is not None:
+                # Node-local capacity accounting for the worker-created
+                # segment (the head only adopts segments on its own node).
+                self.store.adopt(oid, loc[1])
+            return (P.LOC_SHM, loc[1], self.node_hex)
+        return loc
+
+    def _handle_pull(self, handle: WorkerHandle, payload: dict):
+        req_id = payload["req_id"]
+        try:
+            self.localize(payload["object_id"], payload["node"])
+            result = True
+        except BaseException as e:  # noqa: BLE001
+            result = {"__error__": e}
+        try:
+            handle.send(P.REPLY, {"req_id": req_id, "result": result})
+        except Exception:
+            pass
+
+    def localize(self, object_id, source_node_hex: str):
+        """Pull `object_id` into the node-local store from wherever the
+        directory says it lives (reference: raylet DependencyManager +
+        PullManager fetch)."""
+        if self.store.contains(object_id):
+            return
+        addr = self._transfer_addrs.get(source_node_hex)
+        if addr is None:
+            addr = self._request("transfer_addr", node_hex=source_node_hex)
+            if addr is None:
+                from ..exceptions import ObjectLostError
+                raise ObjectLostError(
+                    object_id.hex(),
+                    f"source node {source_node_hex[:8]} is gone")
+            addr = tuple(addr)
+            self._transfer_addrs[source_node_hex] = addr
+        self.pull_mgr.pull(object_id, addr[0], addr[1])
+
+    def shutdown(self):
+        if getattr(self, "_shutdown_done", False):
+            return
+        self._shutdown_done = True
+        self._stopped.set()
+        try:
+            self.pool.shutdown()
+        except Exception:
+            pass
+        try:
+            self.transfer.stop()
+            self.pull_mgr.shutdown()
+        except Exception:
+            pass
+        try:
+            self.store.shutdown()
+        except Exception:
+            pass
+        import shutil
+        shutil.rmtree(self.session_dir, ignore_errors=True)
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
+def _main():
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description="ray_tpu node daemon")
+    parser.add_argument("--address", required=True,
+                        help="head control address host:port")
+    parser.add_argument("--token-hex", default=None)
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--resources", default=None,
+                        help="JSON dict of custom resources")
+    args = parser.parse_args()
+    token_hex = args.token_hex or os.environ.get(
+        "RAY_TPU_CLUSTER_TOKEN_HEX")
+    if not token_hex:
+        raise SystemExit("cluster token required: --token-hex or "
+                         "RAY_TPU_CLUSTER_TOKEN_HEX")
+    host, _, port = args.address.rpartition(":")
+    daemon = NodeDaemon(
+        (host, int(port)), bytes.fromhex(token_hex),
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        resources=json.loads(args.resources) if args.resources else None)
+
+    # SIGTERM (cluster_utils remove_node / operator stop) must run the
+    # shutdown path so session/store dirs are cleaned — but must NOT
+    # interrupt a shutdown already in progress (it would abort the
+    # rmtree half way).
+    import signal
+    import sys as _sys
+
+    def _on_term(*_):
+        if not daemon._stopped.is_set():
+            _sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    daemon.run()
+
+
+if __name__ == "__main__":
+    _main()

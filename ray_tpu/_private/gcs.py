@@ -114,7 +114,36 @@ class ObjectDirectory:
             if e is not None:
                 e.state = LOST
                 e.location = None
-                e.event.clear()
+                # Signal (not clear): blocked getters must wake, observe
+                # LOST, and trigger lineage reconstruction (reference:
+                # ObjectRecoveryManager kicks on fetch of a lost object).
+                # Recovery's register_pending() re-clears the event.
+                e.event.set()
+
+    def mark_node_lost(self, node_id_hex: str,
+                       relocate: Optional[Callable] = None
+                       ) -> List[ObjectID]:
+        """All primary copies on a dead node become LOST (reference: the
+        object directory dropping locations when a node dies; recovery
+        then resubmits producing tasks). `relocate(oid, size)` may return
+        a replacement location (e.g. a copy already pulled to the head)
+        to keep the entry READY. Returns the ids actually lost."""
+        lost: List[ObjectID] = []
+        with self._lock:
+            for oid, e in self._entries.items():
+                loc = e.location
+                if (e.state == READY and loc is not None
+                        and loc[0] == P.LOC_SHM and len(loc) > 2
+                        and loc[2] == node_id_hex):
+                    new_loc = relocate(oid, e.size) if relocate else None
+                    if new_loc is not None:
+                        e.location = new_loc
+                        continue
+                    e.state = LOST
+                    e.location = None
+                    e.event.set()
+                    lost.append(oid)
+        return lost
 
     def entry(self, oid: ObjectID) -> Optional[ObjectEntry]:
         with self._lock:

@@ -1,0 +1,285 @@
+"""Cross-node object transfer: authenticated chunked pulls over TCP.
+
+TPU-native analogue of the reference's ObjectManager data plane
+(src/ray/object_manager/object_manager.h:117 chunked push/pull over gRPC,
+pull_manager.h:53 admission control). The store is file-per-object shm
+(object_store.py), so the server streams the object's backing file with
+``os.sendfile`` (zero userspace copies) and the puller receives straight
+into the destination store's mmap — the chunking/buffer-pool machinery the
+reference needs (object_buffer_pool.h) collapses into kernel pagecache.
+
+Auth: HMAC-SHA256 challenge/response keyed on the per-cluster token (the
+same token daemons use to join the control plane), so an open port does
+not serve objects to strangers.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+import socket
+import struct
+import threading
+from typing import Callable, List, Optional, Tuple
+
+_MAGIC = b"RTX1"
+_NOT_FOUND = 0xFFFFFFFFFFFFFFFF
+_CHUNK = 8 << 20  # advisory sendfile window
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = conn.recv_into(view[got:], n - got)
+        if r == 0:
+            raise EOFError("peer closed during transfer")
+        got += r
+    return bytes(buf)
+
+
+class TransferServer:
+    """Serves this node's objects to peers (one thread per connection;
+    reference: ObjectManager server side + PushManager chunking)."""
+
+    def __init__(self, paths_for: Callable[[bytes], List[str]],
+                 authkey: bytes, host: str = "0.0.0.0", port: int = 0):
+        self._paths_for = paths_for
+        self._authkey = authkey
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stopped = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="transfer-accept")
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            nonce = os.urandom(32)
+            conn.sendall(_MAGIC + nonce)
+            digest = _recv_exact(conn, 32)
+            expect = hmac.new(self._authkey, nonce, "sha256").digest()
+            if not hmac.compare_digest(digest, expect):
+                return
+            # Connection reuse: serve requests until the peer hangs up.
+            while True:
+                try:
+                    oid = _recv_exact(conn, 16)
+                except EOFError:
+                    return
+                self._serve_one(conn, oid)
+        except (OSError, EOFError):
+            pass  # peer dropped mid-request/mid-send
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_one(self, conn: socket.socket, oid: bytes):
+        fd = None
+        for path in self._paths_for(oid):
+            try:
+                fd = os.open(path, os.O_RDONLY)
+                break
+            except OSError:
+                continue
+        if fd is None:
+            conn.sendall(struct.pack(">Q", _NOT_FOUND))
+            return
+        try:
+            size = os.fstat(fd).st_size
+            conn.sendall(struct.pack(">Q", size))
+            offset = 0
+            while offset < size:
+                sent = os.sendfile(conn.fileno(), fd, offset,
+                                   min(_CHUNK, size - offset))
+                if sent == 0:
+                    raise EOFError("peer closed mid-send")
+                offset += sent
+        finally:
+            os.close(fd)
+
+    def stop(self):
+        self._stopped = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _PeerConn:
+    """One authenticated, reusable connection to a peer's TransferServer."""
+
+    def __init__(self, host: str, port: int, authkey: bytes):
+        self.sock = socket.create_connection((host, port), timeout=30.0)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        hdr = _recv_exact(self.sock, 36)
+        if hdr[:4] != _MAGIC:
+            raise ConnectionError("bad transfer-server magic")
+        self.sock.sendall(hmac.new(authkey, hdr[4:], "sha256").digest())
+        self.lock = threading.Lock()
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PullManager:
+    """Client side: dedupe + admission-controlled pulls into a local store
+    (reference: PullManager, pull_manager.h:53 — bounded in-flight bytes,
+    one pull per object no matter how many requesters)."""
+
+    def __init__(self, store, authkey: bytes, max_concurrent: int = 4):
+        self._store = store
+        self._authkey = authkey
+        self._sem = threading.Semaphore(max_concurrent)
+        self._lock = threading.Lock()
+        self._inflight: dict = {}   # oid bytes -> (event, [error])
+        self._conns: dict = {}      # (host, port) -> _PeerConn
+
+    def pull(self, object_id, host: str, port: int) -> None:
+        """Ensure `object_id` is in the local store, pulling from
+        (host, port) if needed. Concurrent callers for the same object
+        share one transfer."""
+        if self._store.contains(object_id):
+            return
+        key = object_id.binary()
+        with self._lock:
+            entry = self._inflight.get(key)
+            if entry is None:
+                entry = (threading.Event(), [None])
+                self._inflight[key] = entry
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            entry[0].wait()
+            if entry[1][0] is not None:
+                raise entry[1][0]
+            return
+        try:
+            with self._sem:
+                if not self._store.contains(object_id):
+                    self._pull_once(object_id, host, port)
+        except BaseException as e:  # noqa: BLE001 — propagate to waiters
+            entry[1][0] = e
+            raise
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+            entry[0].set()
+
+    def _conn_for(self, host: str, port: int) -> _PeerConn:
+        with self._lock:
+            conn = self._conns.get((host, port))
+        if conn is None:
+            conn = _PeerConn(host, port, self._authkey)
+            with self._lock:
+                old = self._conns.get((host, port))
+                if old is not None:
+                    conn.close()
+                    conn = old
+                else:
+                    self._conns[(host, port)] = conn
+        return conn
+
+    def _drop_conn(self, host: str, port: int, conn: "_PeerConn"):
+        with self._lock:
+            if self._conns.get((host, port)) is conn:
+                self._conns.pop((host, port), None)
+        conn.close()
+
+    def _pull_once(self, object_id, host: str, port: int) -> None:
+        from ..exceptions import ObjectLostError
+        conn = self._conn_for(host, port)
+        with conn.lock:
+            try:
+                self._recv_object(conn.sock, object_id)
+            except (OSError, EOFError, ConnectionError):
+                # Stale pooled connection: retry once on a fresh one.
+                self._drop_conn(host, port, conn)
+                fresh = self._conn_for(host, port)
+                with fresh.lock:
+                    try:
+                        self._recv_object(fresh.sock, object_id)
+                    except ObjectLostError:
+                        raise  # clean protocol state, conn reusable
+                    except BaseException:
+                        self._drop_conn(host, port, fresh)
+                        raise
+            except ObjectLostError:
+                raise  # NOT_FOUND: no payload followed, conn stays clean
+            except BaseException:
+                # Any other failure (store full, abort mid-payload) may
+                # leave unread payload bytes queued — reusing the
+                # connection would desync the protocol into silent
+                # corruption. Drop it.
+                self._drop_conn(host, port, conn)
+                raise
+
+    def _recv_object(self, sock: socket.socket, object_id) -> None:
+        from ..exceptions import ObjectLostError
+        sock.sendall(object_id.binary())
+        (size,) = struct.unpack(">Q", _recv_exact(sock, 8))
+        if size == _NOT_FOUND:
+            raise ObjectLostError(
+                object_id.hex(), "object not present on source node")
+        view = self._store.create(object_id, size)
+        try:
+            got = 0
+            while got < size:
+                r = sock.recv_into(view[got:], min(_CHUNK, size - got))
+                if r == 0:
+                    raise EOFError("source closed mid-transfer")
+                got += r
+        except BaseException:
+            view.release()
+            abort = getattr(self._store, "_abort_reserve", None)
+            if abort is not None:
+                abort(object_id)
+            raise
+        view.release()
+        self._store.seal(object_id)
+
+    def shutdown(self):
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.close()
+
+
+def store_paths_factory(store) -> Callable[[bytes], List[str]]:
+    """Candidate file paths (shm, then spill) for an object id in a
+    file-per-object store."""
+    from .ids import ObjectID
+
+    def paths_for(oid_bytes: bytes) -> List[str]:
+        oid = ObjectID(oid_bytes)
+        out = []
+        path = getattr(store, "_path", None)
+        spill = getattr(store, "_spill_path", None)
+        if path is not None:
+            out.append(path(oid))
+        if spill is not None:
+            out.append(spill(oid))
+        return out
+
+    return paths_for

@@ -1,0 +1,336 @@
+"""Head-side multi-host control plane: daemon registry + worker proxies.
+
+The GCS-server face of the cluster (reference: gcs/gcs_server/
+gcs_server_main.cc:47 — the service raylets register with;
+gcs_node_manager.cc node membership; gcs_health_check_manager.h:45
+liveness). The head keeps one authenticated TCP connection per node
+daemon; workers on remote nodes appear to the runtime as
+``RemoteWorkerProxy`` objects that quack exactly like local
+``WorkerHandle``s, so task dispatch, actor restart, retry, and death
+handling reuse the single-host code paths unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+import collections
+
+from . import protocol as P
+from .ids import WorkerID
+
+
+class RemoteWorkerProxy:
+    """Head-side stand-in for a worker process on another node
+    (reference: the GCS/driver's view of a leased remote worker)."""
+
+    is_remote = True
+
+    def __init__(self, daemon: "DaemonHandle", worker_id: WorkerID,
+                 env_key: str):
+        self.daemon = daemon
+        self.worker_id = worker_id
+        self.env_key = env_key
+        self.env: Dict[str, str] = {}
+        self.proc = None
+        self.send_lock = threading.Lock()  # unused; kept for handle parity
+        self.dedicated_actor = None
+        self.running: Dict[bytes, P.TaskSpec] = {}
+        self.fn_cache: set = set()
+        self.chip_ids: List[int] = []
+        self.alive = True
+        self.last_dispatch_ts = 0.0
+        self.death_handled = False
+        self.node_id_hex = daemon.node_id_hex
+
+    def send(self, msg_type: str, payload: dict):
+        self.daemon.send(P.TO_WORKER, {
+            "worker": self.worker_id.binary(),
+            "frame": P.dump_message(msg_type, payload)})
+
+    def kill(self):
+        self.alive = False
+        try:
+            self.daemon.send(P.KILL_WORKER,
+                             {"worker": self.worker_id.binary()})
+        except Exception:
+            pass
+
+
+class DaemonHandle:
+    """One registered node daemon: connection, worker proxies, idle pool
+    (the head's view of a raylet; reference: GcsNodeManager node entry +
+    the per-node RayletClient)."""
+
+    def __init__(self, conn, node_id_hex: str, resources: Dict[str, float],
+                 transfer_addr: Tuple[str, int], hostname: str, pid: int):
+        self.conn = conn
+        self.node_id_hex = node_id_hex
+        self.resources = resources
+        self.transfer_addr = transfer_addr
+        self.hostname = hostname
+        self.pid = pid
+        self.alive = True
+        self.last_ping = time.time()
+        self.load: dict = {}
+        self._send_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self.proxies: Dict[bytes, RemoteWorkerProxy] = {}
+        self._idle: Dict[str, Deque[RemoteWorkerProxy]] = \
+            collections.defaultdict(collections.deque)
+        self._req_lock = threading.Lock()
+        self._req_counter = 0
+        self._pending: Dict[int, Future] = {}
+        # Workers whose WORKER_DIED arrived before start_worker() could
+        # register the proxy (boot-crash race).
+        self.dead_workers: set = set()
+
+    # -- link ----------------------------------------------------------
+    def send(self, msg_type: str, payload: dict):
+        data = P.dump_message(msg_type, payload)
+        with self._send_lock:
+            self.conn.send_bytes(data)
+
+    def request(self, msg_type: str, payload: dict, timeout: float = 120.0):
+        with self._req_lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+        fut: Future = Future()
+        self._pending[req_id] = fut
+        payload = dict(payload)
+        payload["req_id"] = req_id
+        self.send(msg_type, payload)
+        result = fut.result(timeout=timeout)
+        if isinstance(result, dict) and result.get("__error__") is not None:
+            raise result["__error__"]
+        return result
+
+    def resolve_reply(self, payload: dict):
+        fut = self._pending.pop(payload["req_id"], None)
+        if fut is not None:
+            fut.set_result(payload.get("result"))
+
+    def fail_pending(self, error: BaseException):
+        with self._req_lock:
+            pending, self._pending = dict(self._pending), {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_result({"__error__": error})
+
+    # -- worker pool face (mirrors WorkerPool pop/push/remove) ---------
+    def pop_idle(self, env_key: str = "") -> Optional[RemoteWorkerProxy]:
+        with self._lock:
+            dq = self._idle.get(env_key)
+            while dq:
+                h = dq.popleft()
+                if h.alive:
+                    return h
+            return None
+
+    def push_idle(self, handle: RemoteWorkerProxy):
+        if not handle.alive or handle.dedicated_actor is not None \
+                or not self.alive:
+            return
+        with self._lock:
+            self._idle[handle.env_key].append(handle)
+
+    def remove(self, handle: RemoteWorkerProxy):
+        with self._lock:
+            self.proxies.pop(handle.worker_id.binary(), None)
+            dq = self._idle.get(handle.env_key)
+            if dq:
+                try:
+                    dq.remove(handle)
+                except ValueError:
+                    pass
+
+    def start_worker(self, env_key: str, spec,
+                     dedicated: bool = False) -> RemoteWorkerProxy:
+        """Synchronous remote worker start (the lease-grant round trip,
+        node_manager.cc:1868)."""
+        from .placement import tpu_chips_in_demand
+        nchips = 0
+        if env_key.startswith("tpu:"):
+            nchips = tpu_chips_in_demand(spec.resources) or 1
+        reply = self.request(P.START_WORKER, {
+            "env_key": env_key, "dedicated": dedicated, "nchips": nchips,
+            "runtime_env": getattr(spec, "runtime_env", None)})
+        wid = WorkerID(reply["worker_id"])
+        proxy = RemoteWorkerProxy(self, wid, env_key)
+        with self._lock:
+            self.proxies[wid.binary()] = proxy
+            if wid.binary() in self.dead_workers:
+                self.dead_workers.discard(wid.binary())
+                self.proxies.pop(wid.binary(), None)
+                raise RuntimeError("remote worker died during startup")
+        return proxy
+
+
+class HeadServer:
+    """Accepts daemon registrations over TCP and pumps their messages
+    into the runtime (reference: the GCS gRPC server face)."""
+
+    def __init__(self, node, token: bytes, host: str = "127.0.0.1",
+                 port: int = 0):
+        from multiprocessing.connection import Listener
+        self._node = node
+        self._listener = Listener((host, port), family="AF_INET",
+                                  authkey=token)
+        self.address: Tuple[str, int] = self._listener.address
+        self.daemons: Dict[str, DaemonHandle] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="head-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError, Exception):
+                if self._stopped:
+                    return
+                continue
+            threading.Thread(target=self._serve_daemon, args=(conn,),
+                             daemon=True, name="daemon-conn").start()
+
+    def _serve_daemon(self, conn):
+        import cloudpickle
+        handle: Optional[DaemonHandle] = None
+        try:
+            msg_type, payload = cloudpickle.loads(conn.recv_bytes())
+            if msg_type != P.REGISTER_NODE:
+                conn.close()
+                return
+            peer_host = "127.0.0.1"
+            try:
+                # multiprocessing.Connection doesn't expose the peer; the
+                # daemon's reachable host comes from the socket (fromfd
+                # dups the fd, so closing it leaves the connection alone).
+                import socket as _s
+                s = _s.fromfd(conn.fileno(), _s.AF_INET, _s.SOCK_STREAM)
+                peer_host = s.getpeername()[0]
+                s.close()
+            except Exception:
+                pass
+            handle = DaemonHandle(
+                conn, payload["node_id_hex"], payload["resources"],
+                (peer_host, payload["transfer_port"]),
+                payload.get("hostname", ""), payload.get("pid", 0))
+            # ACK strictly FIRST: registration wakes the scheduler, which
+            # may dispatch START_WORKER to this daemon immediately — the
+            # daemon's handshake must not see that before the ack.
+            handle.send(P.NODE_ACK, {
+                "head_node_id_hex": self._node.node_id.hex(),
+                "head_transfer_port": self._node.transfer_port})
+            self._node._on_daemon_registered(handle)
+            with self._lock:
+                self.daemons[handle.node_id_hex] = handle
+            while True:
+                data = conn.recv_bytes()
+                msg_type, payload = cloudpickle.loads(data)
+                self._route(handle, msg_type, payload)
+        except (EOFError, OSError):
+            pass
+        except Exception:
+            pass
+        finally:
+            if handle is not None:
+                handle.alive = False
+                handle.fail_pending(
+                    ConnectionError(f"node {handle.node_id_hex[:8]} "
+                                    f"disconnected"))
+                with self._lock:
+                    self.daemons.pop(handle.node_id_hex, None)
+                if not self._stopped:
+                    self._node._on_daemon_lost(handle)
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _route(self, handle: DaemonHandle, msg_type: str, payload: dict):
+        import cloudpickle
+        if msg_type == P.FROM_WORKER:
+            proxy = handle.proxies.get(payload["worker"])
+            if proxy is None:
+                return
+            inner_type, inner_payload = cloudpickle.loads(payload["frame"])
+            self._node._on_worker_message(proxy, inner_type, inner_payload)
+        elif msg_type == P.WORKER_DIED:
+            proxy = handle.proxies.get(payload["worker"])
+            if proxy is None:
+                with handle._lock:
+                    handle.dead_workers.add(payload["worker"])
+                return
+            handle.remove(proxy)
+            if not proxy.death_handled:
+                proxy.death_handled = True
+                proxy.alive = False
+                self._node._on_worker_death(proxy)
+        elif msg_type == P.NODE_PING:
+            handle.last_ping = time.time()
+            handle.load = {k: payload.get(k)
+                           for k in ("store_used", "num_workers")}
+        elif msg_type == P.NODE_REPLY:
+            handle.resolve_reply(payload)
+        elif msg_type == P.NODE_REQUEST:
+            self._node._handler_pool.submit(
+                self._handle_node_request, handle, payload)
+
+    def _handle_node_request(self, handle: DaemonHandle, payload: dict):
+        req_id = payload["req_id"]
+        try:
+            op = payload["op"]
+            kwargs = payload.get("kwargs") or {}
+            if op == "transfer_addr":
+                result = self._node.transfer_addr_of(kwargs["node_hex"])
+            else:
+                result = self._node._gcs_op(op, kwargs)
+        except BaseException as e:  # noqa: BLE001
+            result = {"__error__": e}
+        try:
+            handle.send(P.NODE_REPLY, {"req_id": req_id, "result": result})
+        except Exception:
+            pass
+
+    def broadcast(self, msg_type: str, payload: dict):
+        with self._lock:
+            daemons = list(self.daemons.values())
+        for d in daemons:
+            if d.alive:
+                try:
+                    d.send(msg_type, payload)
+                except Exception:
+                    pass
+
+    def all_proxies(self) -> List[RemoteWorkerProxy]:
+        with self._lock:
+            daemons = list(self.daemons.values())
+        out: List[RemoteWorkerProxy] = []
+        for d in daemons:
+            out.extend(d.proxies.values())
+        return out
+
+    def stop(self):
+        self._stopped = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        with self._lock:
+            daemons = list(self.daemons.values())
+            self.daemons.clear()
+        for d in daemons:
+            try:
+                d.send(P.SHUTDOWN_NODE, {})
+            except Exception:
+                pass
+            try:
+                d.conn.close()
+            except Exception:
+                pass

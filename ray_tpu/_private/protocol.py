@@ -43,6 +43,26 @@ CREATE_ACTOR_REQ = "create_actor_req"  # nested actor creation
 GET_ACTOR = "get_actor"          # named actor lookup
 KILL_ACTOR = "kill_actor"
 GCS_REQUEST = "gcs_request"      # generic metadata op (KV, named actors, ...)
+PULL_OBJECT = "pull_object"      # worker asks its node to localize an object
+
+# ---------------------------------------------------------------------------
+# Message types: per-host daemon <-> head control service (TCP). The daemon
+# is the raylet-equivalent (reference: raylet/node_manager.cc registering
+# with the GCS, gcs/gcs_server/gcs_node_manager.cc; worker lease protocol
+# node_manager.cc:1868 HandleRequestWorkerLease collapses to START_WORKER +
+# TO_WORKER dispatch because the head is the single scheduler).
+REGISTER_NODE = "register_node"  # daemon -> head: join the cluster
+NODE_ACK = "node_ack"            # head -> daemon: registration accepted
+NODE_PING = "node_ping"          # daemon -> head: heartbeat + load report
+NODE_REQUEST = "node_request"    # daemon -> head: blocking metadata op
+NODE_REPLY = "node_reply"        # either direction: response to a request
+START_WORKER = "start_worker"    # head -> daemon: start a worker process
+TO_WORKER = "to_worker"          # head -> daemon: relay frame to a worker
+FROM_WORKER = "from_worker"      # daemon -> head: relay frame from a worker
+KILL_WORKER = "kill_worker"      # head -> daemon: terminate a worker
+WORKER_DEDICATED = "worker_dedicated"  # head -> daemon: pooled worker became an actor
+WORKER_DIED = "worker_died"      # daemon -> head: a worker process exited
+SHUTDOWN_NODE = "shutdown_node"  # head -> daemon: drain and exit
 
 # Object location kinds
 LOC_INLINE = "inline"            # bytes travel in the message
@@ -149,3 +169,8 @@ class WorkerConfig:
     resources: Dict[str, float]
     env: Dict[str, str] = field(default_factory=dict)
     log_dir: Optional[str] = None
+    # Which node this worker lives on: LOC_SHM locations tagged with a
+    # different node must be pulled via PULL_OBJECT before local reads
+    # (reference: the raylet-mediated plasma fetch). None/"" == the node
+    # of the process that spawned us.
+    node_id_hex: Optional[str] = None

@@ -144,3 +144,22 @@ def detect_node_resources(num_cpus: Optional[int] = None,
     if resources:
         out.update(resources)
     return out
+
+
+def tpu_worker_extra_env(chip_ids) -> Dict[str, str]:
+    """Full environment for a worker pinned to specific TPU chips —
+    shared by the head scheduler and node daemons so chip-pinning policy
+    lives in one place (reference: tpu.py:170-193 accelerator isolation).
+
+    Beyond the visible-chips vars: JAX_PLATFORMS passthrough (a driver
+    pinned to cpu must not force cpu onto a TPU worker) and the
+    PALLAS_AXON_POOL_IPS plumbing for images whose sitecustomize
+    registers the TPU plugin through it.
+    """
+    env = TPUAcceleratorManager.get_visible_chips_env(chip_ids)
+    parent_platform = os.environ.get("JAX_PLATFORMS", "")
+    if parent_platform and parent_platform != "cpu":
+        env["JAX_PLATFORMS"] = parent_platform
+    env["PALLAS_AXON_POOL_IPS"] = os.environ.get(
+        "PALLAS_AXON_POOL_IPS", "")
+    return env

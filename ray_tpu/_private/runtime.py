@@ -47,8 +47,10 @@ def _gc_stale_sessions(max_age_s: Optional[float] = None):
         from .config import ray_config
         max_age_s = float(ray_config.session_gc_max_age_s)
     now = time.time()
-    for d in glob.glob("/dev/shm/ray_tpu_session_*") + glob.glob(
-            "/tmp/ray_tpu_sessions/session_*"):
+    # ray_tpu_session_* = head stores; ray_tpu_node_* = daemon stores
+    # (daemon.py) — both carry .owner_pid stamps.
+    for d in glob.glob("/dev/shm/ray_tpu_*") + glob.glob(
+            "/tmp/ray_tpu_sessions/*"):
         try:
             # A live session's dir can be legitimately empty (worker
             # sockets are unlinked right after accept), so emptiness is
@@ -157,7 +159,8 @@ class Node:
         self.pool = WorkerPool(
             self.session_dir, self.store_dir,
             on_worker_message=self._on_worker_message,
-            on_worker_death=self._on_worker_death)
+            on_worker_death=self._on_worker_death,
+            node_id_hex=self.node_id.hex())
         ncpu = int(totals.get("CPU", 4))
         from .scheduler import NodeRegistry
         self.node_registry = NodeRegistry(self.node_id.hex(),
@@ -195,6 +198,29 @@ class Node:
         from .log_monitor import LogMonitor
         self.log_monitor = LogMonitor(
             os.path.join(self.session_dir, "logs"))
+        # -- multi-host control plane (reference: the GCS gRPC server the
+        # raylets register with, gcs_server_main.cc:47 + the object
+        # manager data plane, object_manager.h:117). The head listens for
+        # per-host daemons (daemon.py) over authenticated TCP and serves
+        # its local objects to peers via a chunked transfer server.
+        from .config import ray_config
+        from .netcomm import PullManager, TransferServer, \
+            store_paths_factory
+        from .node_service import HeadServer
+        token_hex = os.environ.get("RAY_TPU_CLUSTER_TOKEN_HEX", "")
+        self.cluster_token = (bytes.fromhex(token_hex) if token_hex
+                              else os.urandom(16))
+        self.transfer_server = TransferServer(
+            store_paths_factory(self.store), self.cluster_token,
+            host=str(ray_config.node_host))
+        self.transfer_port = self.transfer_server.port
+        self.pull_mgr = PullManager(
+            self.store, self.cluster_token,
+            max_concurrent=int(ray_config.pull_max_concurrent))
+        self.head_server = HeadServer(
+            self, self.cluster_token,
+            host=str(ray_config.node_host),
+            port=int(ray_config.head_port))
         self._shutdown = False
         atexit.register(self.shutdown)
 
@@ -245,8 +271,17 @@ class Node:
                 oid, (P.LOC_INLINE, sobj.to_bytes()), sobj.total_size)
         else:
             size = self.store.put_serialized(oid, sobj)
-            self.gcs.objects.register_ready(oid, (P.LOC_SHM, size), size)
+            self.gcs.objects.register_ready(
+                oid, (P.LOC_SHM, size, self.node_id.hex()), size)
         return oid
+
+    def _tag_local_loc(self, loc):
+        """Normalize an untagged shm location to carry this node's id —
+        the object directory always records WHERE a shm object lives so
+        workers on other nodes know to pull it."""
+        if loc and loc[0] == P.LOC_SHM and len(loc) < 3:
+            return (P.LOC_SHM, loc[1], self.node_id.hex())
+        return loc
 
     def placement_group_ready_ref(self, pg_id_hex: str) -> ObjectID:
         """An ObjectID that resolves to True once the PG's bundles are
@@ -294,6 +329,8 @@ class Node:
         if kind == P.LOC_INLINE:
             value = serialization.deserialize(location[1])
         elif kind == P.LOC_SHM:
+            if len(location) > 2 and location[2] != self.node_id.hex():
+                self._ensure_local(oid, location[2])
             value = self.store.get(oid)
         elif kind == P.LOC_ERROR:
             raise serialization.deserialize(location[1])
@@ -302,6 +339,75 @@ class Node:
         if isinstance(value, TaskError):
             raise value
         return value
+
+    # ------------------------------------------------------------------
+    # multi-host: daemon lifecycle + cross-node object movement
+    # ------------------------------------------------------------------
+    @property
+    def cluster_address(self) -> str:
+        host, port = self.head_server.address
+        return f"{host}:{port}"
+
+    def transfer_addr_of(self, node_hex: str):
+        """(host, port) of a node's transfer server, or None if gone."""
+        if node_hex == self.node_id.hex():
+            return ("127.0.0.1", self.transfer_port)
+        handle = self.head_server.daemons.get(node_hex)
+        if handle is None or not handle.alive:
+            return None
+        return handle.transfer_addr
+
+    def _ensure_local(self, oid: ObjectID, node_hex: str):
+        """Pull a remote object's bytes into the head-local store
+        (reference: PullManager fetch on ray.get of a remote object)."""
+        if self.store.contains(oid):
+            return
+        addr = self.transfer_addr_of(node_hex)
+        if addr is None:
+            raise ObjectLostError(
+                oid.hex(), f"source node {node_hex[:8]} is gone")
+        self.pull_mgr.pull(oid, addr[0], addr[1])
+
+    def _on_daemon_registered(self, handle):
+        self.node_registry.add_node(handle.node_id_hex, handle.resources,
+                                    daemon=handle)
+        self.gcs.pubsub.publish("node", {
+            "event": "registered", "node_id": handle.node_id_hex,
+            "hostname": handle.hostname, "resources": handle.resources})
+        self.scheduler.notify_worker_free()
+
+    def _on_daemon_lost(self, handle):
+        """A node daemon disconnected/died: fail its workers through the
+        standard death paths and mark its primary object copies LOST so
+        getters trigger lineage reconstruction (reference: node failure
+        handling in GcsNodeManager + ObjectRecoveryManager)."""
+        self.node_registry.remove_node(handle.node_id_hex)
+        self.gcs.pubsub.publish("node", {
+            "event": "dead", "node_id": handle.node_id_hex})
+        # Mark objects lost BEFORE failing workers: retries submitted by
+        # the death path must see dead-node deps as unresolved (and
+        # recover them), not dispatch against locations that are gone.
+        # Copies already pulled into the head store stay READY,
+        # re-pointed at the head.
+        head_hex = self.node_id.hex()
+        self.gcs.objects.mark_node_lost(
+            handle.node_id_hex,
+            relocate=lambda oid, size:
+                (P.LOC_SHM, size, head_hex)
+                if self.store.contains(oid) else None)
+        for proxy in list(handle.proxies.values()):
+            if not proxy.death_handled:
+                proxy.death_handled = True
+                proxy.alive = False
+                self._on_worker_death(proxy)
+        with self._ready_cond:
+            self._ready_cond.notify_all()
+        self.scheduler.notify_worker_free()
+
+    def _all_worker_handles(self):
+        handles = list(self.pool.workers.values())
+        handles.extend(self.head_server.all_proxies())
+        return handles
 
     def _ensure_ready(self, oid: ObjectID,
                       timeout: Optional[float]) -> gcs_mod.ObjectEntry:
@@ -325,9 +431,23 @@ class Node:
             return entry
         raise ObjectLostError(oid.hex(), "reconstruction attempts exhausted")
 
-    def _resubmit_for_recovery(self, spec: P.TaskSpec):
+    def _resubmit_for_recovery(self, spec: P.TaskSpec, _depth: int = 0):
+        # Already being recovered (all returns pending): don't double-run.
+        entries = [self.gcs.objects.entry(rid) for rid in spec.return_ids]
+        if entries and all(e is not None and e.state == gcs_mod.PENDING
+                           for e in entries):
+            return
         for rid in spec.return_ids:
             self.gcs.objects.register_pending(rid, spec)
+        # Recursively recover LOST arguments first (reference:
+        # ObjectRecoveryManager walks the lineage of missing deps).
+        if _depth < 16:
+            for a in list(spec.args) + list(spec.kwargs.values()):
+                if a.kind == "ref":
+                    e = self.gcs.objects.entry(a.object_id)
+                    if (e is not None and e.state == gcs_mod.LOST
+                            and e.lineage is not None):
+                        self._resubmit_for_recovery(e.lineage, _depth + 1)
         unresolved = self._unresolved_deps(spec)
         self.scheduler.submit(spec, unresolved)
 
@@ -364,9 +484,20 @@ class Node:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._ready_cond:
             while True:
-                ready = [oid for oid in object_ids
-                         if (e := self.gcs.objects.entry(oid)) is not None
-                         and e.event.is_set()]
+                ready = []
+                for oid in object_ids:
+                    e = self.gcs.objects.entry(oid)
+                    if e is None or not e.event.is_set():
+                        continue
+                    if e.state == gcs_mod.LOST:
+                        # Not fetchable: kick lineage reconstruction
+                        # (idempotent) and report not-ready until it
+                        # lands; no lineage -> "ready" (get raises
+                        # ObjectLostError immediately).
+                        if e.lineage is not None:
+                            self._resubmit_for_recovery(e.lineage)
+                            continue
+                    ready.append(oid)
                 if len(ready) >= num_returns:
                     ready = ready[:num_returns]
                     break
@@ -382,7 +513,8 @@ class Node:
 
     def _is_object_ready(self, oid: ObjectID) -> bool:
         e = self.gcs.objects.entry(oid)
-        return e is not None and e.event.is_set()
+        return (e is not None and e.event.is_set()
+                and e.state != gcs_mod.LOST)
 
     def incref(self, oid: ObjectID):
         self.gcs.objects.incref(oid)
@@ -432,6 +564,10 @@ class Node:
                     h.send(P.RELEASE_OBJECTS, {"object_ids": batch})
                 except Exception:
                     pass
+        # Remote nodes free their local copies (and relay to their
+        # workers) — the daemon handles P.RELEASE_OBJECTS itself.
+        self.head_server.broadcast(P.RELEASE_OBJECTS,
+                                   {"object_ids": batch})
 
     # ------------------------------------------------------------------
     # task submission (owner side)
@@ -463,7 +599,8 @@ class Node:
         for a in args:
             if a.kind == "ref":
                 e = self.gcs.objects.entry(a.object_id)
-                if e is None or not e.event.is_set():
+                if (e is None or e.state == gcs_mod.LOST
+                        or not e.event.is_set()):
                     unresolved.add(a.object_id)
         return unresolved
 
@@ -527,13 +664,13 @@ class Node:
         oid = object_id_for_return(task_id, payload["index"])
         loc = payload["loc"]
         size = loc[1] if loc[0] == P.LOC_SHM else len(loc[1])
-        if loc[0] == P.LOC_SHM:
+        if loc[0] == P.LOC_SHM and self._loc_is_local(loc):
             self.store.adopt(oid, size)
         # Lineage: the producing spec (from the worker's running table)
         # makes items cancellable/recoverable like normal returns.
         spec = handle.running.get(task_id.binary())
         self.gcs.objects.register_ready(
-            oid, loc, size, lineage=spec,
+            oid, self._tag_local_loc(loc), size, lineage=spec,
             nested_ids=payload.get("nested") or [])
         with self._gen_lock:
             st = self._gen_stream_state(task_id)
@@ -636,7 +773,7 @@ class Node:
         self._cancel_requested.add(task_id.binary())
         if self.scheduler.try_cancel(task_id):
             return
-        for h in list(self.pool.workers.values()):
+        for h in self._all_worker_handles():
             if task_id.binary() in h.running:
                 try:
                     h.send(P.CANCEL_TASK, {"task_id": task_id})
@@ -644,13 +781,24 @@ class Node:
                     pass
                 return
 
+    def _loc_is_local(self, loc) -> bool:
+        return len(loc) < 3 or loc[2] == self.node_id.hex()
+
+    def _push_idle(self, handle):
+        """Return a worker to ITS node's idle pool (remote workers belong
+        to their daemon, not the head pool)."""
+        if getattr(handle, "is_remote", False):
+            handle.daemon.push_idle(handle)
+        else:
+            self.pool.push_idle(handle)
+
     def _on_task_done(self, handle: WorkerHandle, payload: dict):
         task_id: TaskID = payload["task_id"]
         spec = handle.running.pop(task_id.binary(), None)
         is_actor_task = payload.get("actor_id") is not None
         if spec is not None and not is_actor_task:
             self.scheduler.release_task_resources(spec)
-            self.pool.push_idle(handle)
+            self._push_idle(handle)
             self.scheduler.notify_worker_free()
         if spec is None:
             return
@@ -685,14 +833,11 @@ class Node:
             for rid, loc, nested in zip(spec.return_ids,
                                         payload["results"], nested_lists):
                 size = loc[1] if loc[0] == P.LOC_SHM else len(loc[1])
-                if loc[0] == P.LOC_SHM:
+                if loc[0] == P.LOC_SHM and self._loc_is_local(loc):
                     self.store.adopt(rid, size)
-                    self.gcs.objects.register_ready(
-                        rid, (P.LOC_SHM, size), size, lineage=spec,
-                        nested_ids=nested)
-                else:
-                    self.gcs.objects.register_ready(
-                        rid, loc, size, lineage=spec, nested_ids=nested)
+                self.gcs.objects.register_ready(
+                    rid, self._tag_local_loc(loc), size, lineage=spec,
+                    nested_ids=nested)
         self.gcs.record_task_event({
             "task_id": task_id.hex(), "name": spec.name,
             "state": "FAILED" if error is not None else "FINISHED",
@@ -708,6 +853,15 @@ class Node:
     def _resubmit(self, spec: P.TaskSpec):
         for rid in spec.return_ids:
             self.gcs.objects.register_pending(rid, spec)
+        # Arguments lost with a dead node must be reconstructed, or the
+        # retry parks in the scheduler's waiting queue forever (only
+        # register_ready fires notify_object_ready).
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a.kind == "ref":
+                e = self.gcs.objects.entry(a.object_id)
+                if (e is not None and e.state == gcs_mod.LOST
+                        and e.lineage is not None):
+                    self._resubmit_for_recovery(e.lineage)
         self.scheduler.submit(spec, self._unresolved_deps(spec))
 
     # ------------------------------------------------------------------
@@ -957,7 +1111,7 @@ class Node:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             self._unpin_task_args(spec)
             return
-        for h in list(self.pool.workers.values()):
+        for h in self._all_worker_handles():
             if task_id.binary() in h.running:
                 if force:
                     h.kill()
@@ -992,9 +1146,11 @@ class Node:
             self._on_gen_item(handle, payload)
         elif msg_type == P.ACTOR_READY:
             self._on_actor_ready(handle, payload)
-        elif msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS, P.GCS_REQUEST):
-            # GCS requests may block (placement-group waits), so they run on
-            # the handler pool, never the per-worker recv thread.
+        elif msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS, P.GCS_REQUEST,
+                          P.PULL_OBJECT):
+            # GCS requests may block (placement-group waits, cross-node
+            # pulls), so they run on the handler pool, never the
+            # per-worker recv thread.
             self._handler_pool.submit(
                 self._handle_blocking_request, handle, msg_type, payload)
         else:
@@ -1008,6 +1164,9 @@ class Node:
                 locs = self.get_locations(payload["object_ids"],
                                           payload.get("timeout"))
                 self._reply(handle, req_id, locs)
+            elif msg_type == P.PULL_OBJECT:
+                self._ensure_local(payload["object_id"], payload["node"])
+                self._reply(handle, req_id, True)
             elif msg_type == P.GCS_REQUEST:
                 result = self._gcs_op(payload["op"], payload["kwargs"])
                 self._reply(handle, req_id, result)
@@ -1032,9 +1191,14 @@ class Node:
                         len(payload["inline"]), nested_ids=nested)
                 else:
                     size = payload["size"]
-                    self.store.adopt(oid, size)
+                    node = payload.get("node")
+                    if node and node != self.node_id.hex():
+                        loc = (P.LOC_SHM, size, node)
+                    else:
+                        self.store.adopt(oid, size)
+                        loc = (P.LOC_SHM, size, self.node_id.hex())
                     self.gcs.objects.register_ready(
-                        oid, (P.LOC_SHM, size), size, nested_ids=nested)
+                        oid, loc, size, nested_ids=nested)
                 self._reply(handle, req_id, True)
             elif msg_type == P.SUBMIT_TASK:
                 self.submit_task(payload["spec"])
@@ -1200,6 +1364,12 @@ class Node:
             pass
         try:
             self.log_monitor.stop()
+        except Exception:
+            pass
+        try:
+            self.head_server.stop()
+            self.transfer_server.stop()
+            self.pull_mgr.shutdown()
         except Exception:
             pass
         try:

@@ -109,16 +109,20 @@ class ResourceManager:
 
 
 class NodeEntry:
-    __slots__ = ("node_id_hex", "rm", "alive", "start_time", "is_head")
+    __slots__ = ("node_id_hex", "rm", "alive", "start_time", "is_head",
+                 "daemon")
 
     def __init__(self, node_id_hex: str, rm: ResourceManager,
-                 is_head: bool = False):
+                 is_head: bool = False, daemon=None):
         import time
         self.node_id_hex = node_id_hex
         self.rm = rm
         self.alive = True
         self.start_time = time.time()
         self.is_head = is_head
+        # Real per-host daemon backing this node (node_service.DaemonHandle);
+        # None for the head and for virtual test nodes.
+        self.daemon = daemon
 
 
 class NodeRegistry:
@@ -138,12 +142,17 @@ class NodeRegistry:
         self.head = NodeEntry(head_id_hex, head_rm, is_head=True)
         self._nodes[head_id_hex] = self.head
 
-    def add_node(self, node_id_hex: str,
-                 resources: Dict[str, float]) -> NodeEntry:
-        entry = NodeEntry(node_id_hex, ResourceManager(dict(resources)))
+    def add_node(self, node_id_hex: str, resources: Dict[str, float],
+                 daemon=None) -> NodeEntry:
+        entry = NodeEntry(node_id_hex, ResourceManager(dict(resources)),
+                          daemon=daemon)
         with self._lock:
             self._nodes[node_id_hex] = entry
         return entry
+
+    def get(self, node_id_hex: str) -> Optional[NodeEntry]:
+        with self._lock:
+            return self._nodes.get(node_id_hex)
 
     def remove_node(self, node_id_hex: str) -> Optional[NodeEntry]:
         with self._lock:
@@ -248,12 +257,14 @@ class WorkerPool:
 
     def __init__(self, session_dir: str, store_dir: str,
                  on_worker_message: Callable, on_worker_death: Callable,
-                 worker_env: Optional[Dict[str, str]] = None):
+                 worker_env: Optional[Dict[str, str]] = None,
+                 node_id_hex: Optional[str] = None):
         self._session_dir = session_dir
         self._store_dir = store_dir
         self._on_message = on_worker_message
         self._on_death = on_worker_death
         self._base_env = worker_env or {}
+        self._node_id_hex = node_id_hex
         self._authkey = os.urandom(16)
         self._lock = threading.Lock()
         self._idle: Dict[str, Deque[WorkerHandle]] = collections.defaultdict(
@@ -392,7 +403,8 @@ class WorkerPool:
             pass
         config = P.WorkerConfig(
             worker_id=worker_id, session_dir=self._session_dir,
-            store_dir=self._store_dir, resources={}, env=env)
+            store_dir=self._store_dir, resources={}, env=env,
+            node_id_hex=self._node_id_hex)
         conn.send_bytes(cloudpickle.dumps(config))
         handle = WorkerHandle(worker_id, proc, conn, env_key, env)
         t = threading.Thread(target=self._recv_loop, args=(handle,),
@@ -642,6 +654,35 @@ class Scheduler:
         if node_id is None:
             return False
         env_key = self._env_key_for(spec)
+        entry = self.nodes.get(node_id)
+        if entry is not None and entry.daemon is not None:
+            # Remote dispatch: the node's daemon owns the worker pool
+            # (reference: lease granted by the remote raylet,
+            # node_manager.cc:1868).
+            worker = entry.daemon.pop_idle(env_key)
+            if (worker is not None and is_actor_creation
+                    and env_key == ""):
+                # Conversion: the daemon stops counting this worker
+                # against its pool cap (local path does the same with
+                # _started_workers below).
+                try:
+                    entry.daemon.send(P.WORKER_DEDICATED, {
+                        "worker": worker.worker_id.binary(),
+                        "actor_id": spec.actor_id.binary()})
+                except Exception:
+                    pass
+            if worker is None:
+                try:
+                    worker = entry.daemon.start_worker(
+                        env_key, spec, dedicated=is_actor_creation)
+                except Exception:
+                    worker = None
+            if worker is None:
+                self.nodes.release(node_id, demand)
+                return False
+            self._task_node[self._spec_key(spec)] = node_id
+            self._dispatch_fn(spec, worker)
+            return True
         worker = self.pool.pop_idle(env_key)
         if worker is not None and is_actor_creation and env_key == "":
             # An idle pooled worker becomes a dedicated actor process; it no
@@ -664,12 +705,13 @@ class Scheduler:
 
     def on_worker_removed(self, handle: WorkerHandle):
         """A worker died; open a cap slot / return its chips."""
-        with self._lock:
-            if handle.dedicated_actor is None and handle.env_key == "":
-                self._started_workers -= 1
-            if handle.chip_ids:
-                self._free_chips.extend(handle.chip_ids)
-                handle.chip_ids = []
+        if not getattr(handle, "is_remote", False):
+            with self._lock:
+                if handle.dedicated_actor is None and handle.env_key == "":
+                    self._started_workers -= 1
+                if handle.chip_ids:
+                    self._free_chips.extend(handle.chip_ids)
+                    handle.chip_ids = []
         self.notify_worker_free()
 
     def _maybe_start_worker(self, env_key: str, spec,
@@ -704,18 +746,8 @@ class Scheduler:
                 # retrying once their death returns the chips.
                 self._reclaim_idle_tpu_workers()
                 return None
-            from .resources import TPUAcceleratorManager
-            extra_env = TPUAcceleratorManager.get_visible_chips_env(chip_ids)
-            # JAX_PLATFORMS="" (auto-detect) unless the parent names a
-            # non-cpu platform plugin the worker must reuse; a driver pinned
-            # to cpu must NOT push cpu onto a TPU-assigned worker.
-            parent_platform = os.environ.get("JAX_PLATFORMS", "")
-            if parent_platform and parent_platform != "cpu":
-                extra_env["JAX_PLATFORMS"] = parent_platform
-            # Images whose sitecustomize registers the TPU plugin key on
-            # this var; TPU workers need the real value, cpu workers get "".
-            extra_env["PALLAS_AXON_POOL_IPS"] = os.environ.get(
-                "PALLAS_AXON_POOL_IPS", "")
+            from .resources import tpu_worker_extra_env
+            extra_env = tpu_worker_extra_env(chip_ids)
         spec_re = getattr(spec, "runtime_env", None)
         if spec_re:
             from . import runtime_env as re_mod

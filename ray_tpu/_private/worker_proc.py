@@ -195,6 +195,14 @@ class Worker:
         if kind == P.LOC_INLINE:
             value = serialization.deserialize(loc[1])
         elif kind == P.LOC_SHM:
+            if (len(loc) > 2 and loc[2]
+                    and loc[2] != (self.config.node_id_hex or loc[2])
+                    and not self.store.contains(oid)):
+                # Object lives on another node: ask our node (daemon or
+                # head) to localize it before the shm read (reference:
+                # raylet-mediated plasma fetch via PullManager).
+                self.client._request(P.PULL_OBJECT,
+                                     {"object_id": oid, "node": loc[2]})
             value = self.store.get(oid)
         elif kind == P.LOC_ERROR:
             raise serialization.deserialize(loc[1])
@@ -325,7 +333,10 @@ class Worker:
                 self.send(P.TASK_DONE, {
                     "task_id": spec.task_id, "results": locs,
                     "error": None, "nested": nested,
-                    "actor_id": spec.actor_id})
+                    "actor_id": spec.actor_id,
+                    # Node daemons need the ids to account shm segments
+                    # their workers created (head adopts via the spec).
+                    "return_oids": list(spec.return_ids)})
         except BaseException as e:  # noqa: BLE001 — all errors ship to owner
             if exec_span is not None:
                 # Close the span WITH the failure so traces show failed

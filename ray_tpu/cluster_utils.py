@@ -1,16 +1,26 @@
-"""In-process multi-node cluster simulation for tests.
+"""Multi-node cluster utility for tests and single-machine clusters.
 
 Reference parity: python/ray/cluster_utils.py:135 `Cluster` — N real
 raylet processes sharing one GCS, so distributed scheduling/failover is
 testable on one machine (SURVEY.md §4, load-bearing test mechanism (a)).
-Here nodes are virtual entries in the scheduler's NodeRegistry: each has
-its own resource pool that tasks/actors bin-pack onto, workers are real
-local processes, and `remove_node` kills the victims' workers so
-retries/restarts exercise the same failover paths a dead host would.
+
+Two node kinds:
+  * virtual (default): entries in the scheduler's NodeRegistry — own
+    resource pool, workers are local processes, `remove_node` kills the
+    victims' workers so failover paths run without extra processes.
+  * daemon (``add_node(daemon=True)`` or RAY_TPU_CLUSTER_DAEMONS=1):
+    a REAL per-host daemon subprocess (_private/daemon.py) joining the
+    head over TCP — own worker pool, own shm object store, cross-node
+    object transfer; killing it exercises true node-failure handling
+    (the reference's N-real-raylets pattern).
 """
 
 from __future__ import annotations
 
+import os
+import subprocess
+import sys
+import time
 from typing import Dict, List, Optional
 
 from . import api
@@ -18,14 +28,21 @@ from ._private import state
 
 
 class Node:
-    """Handle to one (virtual) cluster node."""
+    """Handle to one cluster node."""
 
-    def __init__(self, node_id_hex: str, is_head: bool = False):
+    def __init__(self, node_id_hex: str, is_head: bool = False,
+                 proc: Optional[subprocess.Popen] = None):
         self.node_id = node_id_hex
         self.is_head = is_head
+        self.proc = proc  # daemon subprocess (None for virtual/head)
+
+    @property
+    def is_daemon(self) -> bool:
+        return self.proc is not None
 
     def __repr__(self):
-        kind = "head" if self.is_head else "worker"
+        kind = ("head" if self.is_head
+                else "daemon" if self.is_daemon else "worker")
         return f"ClusterNode({self.node_id[:8]}, {kind})"
 
 
@@ -53,21 +70,86 @@ class Cluster:
 
     def add_node(self, *, num_cpus: float = 1, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
+                 daemon: Optional[bool] = None, wait: bool = True,
                  **_ignored) -> Node:
         rt = state.current()
-        res = {"CPU": float(num_cpus)}
-        if num_tpus:
-            res["TPU"] = float(num_tpus)
-        res.update(resources or {})
-        node = Node(rt.add_virtual_node(res))
+        if daemon is None:
+            daemon = os.environ.get("RAY_TPU_CLUSTER_DAEMONS") == "1"
+        if daemon:
+            node = self._spawn_daemon(rt, num_cpus, num_tpus,
+                                      resources, wait)
+        else:
+            res = {"CPU": float(num_cpus)}
+            if num_tpus:
+                res["TPU"] = float(num_tpus)
+            res.update(resources or {})
+            node = Node(rt.add_virtual_node(res))
         self._nodes.append(node)
         return node
+
+    def _spawn_daemon(self, rt, num_cpus, num_tpus, resources,
+                      wait: bool) -> Node:
+        import json
+        host, port = rt.head_server.address
+        env = dict(os.environ)
+        env["RAY_TPU_CLUSTER_TOKEN_HEX"] = rt.cluster_token.hex()
+        argv = [sys.executable, "-m", "ray_tpu._private.daemon",
+                "--address", f"{host}:{port}",
+                "--num-cpus", str(num_cpus)]
+        if num_tpus:
+            argv += ["--num-tpus", str(num_tpus)]
+        if resources:
+            argv += ["--resources", json.dumps(resources)]
+        before = set(rt.head_server.daemons)
+        proc = subprocess.Popen(argv, env=env)
+        deadline = time.monotonic() + 60.0
+        node_id = None
+        while time.monotonic() < deadline:
+            new = set(rt.head_server.daemons) - before
+            if new:
+                node_id = new.pop()
+                break
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"node daemon exited with code {proc.returncode} "
+                    f"before registering")
+            time.sleep(0.05)
+        if node_id is None:
+            proc.terminate()
+            raise RuntimeError("node daemon failed to register in 60s")
+        return Node(node_id, proc=proc)
 
     def remove_node(self, node: Node, allow_graceful: bool = True) -> bool:
         if node.is_head:
             raise ValueError("cannot remove the head node")
         rt = state.current()
-        ok = rt.remove_virtual_node(node.node_id)
+        if node.is_daemon:
+            # Kill the daemon process; the head notices the connection
+            # drop and runs node-failure handling (worker death, object
+            # loss, actor restart) — the RayletKiller chaos semantics.
+            if allow_graceful:
+                handle = rt.head_server.daemons.get(node.node_id)
+                if handle is not None:
+                    from ._private import protocol as P
+                    try:
+                        handle.send(P.SHUTDOWN_NODE, {})
+                        node.proc.wait(timeout=5)
+                    except Exception:
+                        pass
+            try:
+                if node.proc.poll() is None:
+                    node.proc.terminate()
+                    node.proc.wait(timeout=10)
+            except Exception:
+                node.proc.kill()
+            # Wait for the head to process the disconnect.
+            deadline = time.monotonic() + 10.0
+            while (node.node_id in rt.head_server.daemons
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            ok = True
+        else:
+            ok = rt.remove_virtual_node(node.node_id)
         if ok:
             self._nodes.remove(node)
         return ok

@@ -43,6 +43,44 @@ def _backend(args):
 def cmd_start(args):
     import ray_tpu
 
+    if getattr(args, "address", None):
+        # Worker-node mode (reference: `ray start --address=head:port`
+        # launching a raylet that joins the cluster): run a node daemon
+        # in the foreground until the head goes away.
+        import os
+
+        from ray_tpu._private.daemon import NodeDaemon
+
+        token_hex = (args.token_hex
+                     or os.environ.get("RAY_TPU_CLUSTER_TOKEN_HEX"))
+        if not token_hex:
+            print("error: joining a cluster requires --token-hex or "
+                  "RAY_TPU_CLUSTER_TOKEN_HEX (printed by the head)")
+            return 1
+        host, _, port = args.address.rpartition(":")
+        if (host not in ("127.0.0.1", "localhost")
+                and "RAY_TPU_NODE_HOST" not in os.environ):
+            # Joining a remote head: this node's transfer server must be
+            # reachable from the other hosts, not loopback-only.
+            from ray_tpu._private.config import ray_config
+            ray_config.set("node_host", "0.0.0.0")
+        daemon = NodeDaemon(
+            (host, int(port)), bytes.fromhex(token_hex),
+            num_cpus=args.num_cpus,
+            resources=json.loads(args.resources) if args.resources
+            else None)
+        print(f"ray_tpu node daemon joined head at {args.address} "
+              f"(node {daemon.node_hex[:12]}, resources "
+              f"{json.dumps(daemon.totals)})", flush=True)
+        daemon.run()
+        return 0
+
+    if args.host not in ("127.0.0.1", "localhost"):
+        # The daemon listener + transfer server must be reachable from
+        # worker hosts (ray_config was already constructed at import, so
+        # set programmatically rather than via env).
+        from ray_tpu._private.config import ray_config
+        ray_config.set("node_host", args.host)
     ray_tpu.init(num_cpus=args.num_cpus, ignore_reinit_error=True)
     from ray_tpu.dashboard import start_dashboard
     from ray_tpu.util.client import server as client_server
@@ -50,9 +88,15 @@ def cmd_start(args):
     host, port = client_server.serve(host=args.host, port=args.port)
     dash_port = start_dashboard(host=args.host,
                                 port=args.dashboard_port)
+    from ray_tpu._private import state as _state
+    rt = _state.current()
     print("ray_tpu head started.")
     print(f"  client address:  {host}:{port}  "
           f"(--address for other commands)")
+    print(f"  cluster address: {rt.cluster_address}  "
+          f"(ray_tpu start --address ... on worker hosts)")
+    print(f"  cluster token:   {rt.cluster_token.hex()}  "
+          f"(--token-hex on worker hosts)")
     print(f"  dashboard:       http://{args.host}:{dash_port}")
     print(f"  resources:       "
           f"{json.dumps(ray_tpu.cluster_resources())}", flush=True)
@@ -243,11 +287,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "local runtime")
 
     sp = sub.add_parser("start", help="start a head (client server + "
-                        "dashboard) for remote drivers")
+                        "dashboard) for remote drivers, or join a "
+                        "cluster as a node daemon with --address")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=10001)
     sp.add_argument("--dashboard-port", type=int, default=8265)
     sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--address", default=None,
+                    help="head cluster address (host:port) to join as a "
+                    "worker node; TPU chips on this host autodetect")
+    sp.add_argument("--token-hex", default=None,
+                    help="cluster token printed by the head")
+    sp.add_argument("--resources", default=None,
+                    help="JSON dict of custom resources for this node")
     sp.add_argument("--no-block", action="store_true",
                     help="return instead of serving (embedding only; "
                     "the head dies with this process)")

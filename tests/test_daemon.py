@@ -1,0 +1,185 @@
+"""Multi-host control plane: per-host daemons, cross-node object
+transfer, and node-failure handling.
+
+Reference strategy: python/ray/tests with ray_start_cluster — N real
+raylet processes sharing one GCS (cluster_utils.py:135), killed
+mid-workload to exercise failover (test_chaos.py RayletKiller,
+_private/test_utils.py:1618). Here each `add_node(daemon=True)` is a
+REAL subprocess with its own worker pool + shm store, joined over TCP.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def daemon_cluster():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    a = cluster.add_node(num_cpus=2, resources={"A": 4}, daemon=True)
+    b = cluster.add_node(num_cpus=2, resources={"B": 4}, daemon=True)
+    yield cluster, a, b
+    try:
+        cluster.shutdown()
+    except Exception:
+        pass  # a destructive test later in the module tore it down
+
+
+def test_remote_dispatch(daemon_cluster):
+    @ray.remote(resources={"A": 1})
+    def pid():
+        import os
+        return os.getpid()
+
+    import os
+    pids = ray.get([pid.remote() for _ in range(4)])
+    assert all(p != os.getpid() for p in pids)
+
+
+def test_driver_put_consumed_on_daemon(daemon_cluster):
+    data = ray.put(np.ones(200_000))
+
+    @ray.remote(resources={"A": 1})
+    def consume(a):
+        return float(a.sum())
+
+    assert ray.get(consume.remote(data)) == 200_000.0
+
+
+def test_daemon_to_daemon_transfer(daemon_cluster):
+    @ray.remote(resources={"A": 1})
+    def produce():
+        return np.arange(300_000, dtype=np.float32)
+
+    @ray.remote(resources={"B": 1})
+    def total(a):
+        return float(a.sum())
+
+    ref = produce.remote()
+    expected = float(np.arange(300_000, dtype=np.float32).sum())
+    assert ray.get(total.remote(ref)) == expected
+
+
+def test_daemon_result_pulled_to_driver(daemon_cluster):
+    @ray.remote(resources={"B": 1})
+    def produce():
+        return np.full(250_000, 3.0)
+
+    arr = ray.get(produce.remote())
+    assert arr.shape == (250_000,) and arr[0] == 3.0
+
+
+def test_actor_on_daemon(daemon_cluster):
+    @ray.remote(resources={"A": 1})
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray.get([c.inc.remote() for _ in range(3)]) == [1, 2, 3]
+    ray.kill(c)
+
+
+def test_nested_submission_from_daemon(daemon_cluster):
+    @ray.remote(resources={"B": 1})
+    def outer():
+        @ray.remote
+        def inner():
+            return "inner-ok"
+
+        return ray.get(inner.remote())
+
+    assert ray.get(outer.remote()) == "inner-ok"
+
+
+def test_streaming_generator_on_daemon(daemon_cluster):
+    @ray.remote(resources={"A": 1}, num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray.get(r) for r in gen.remote(4)]
+    assert out == [0, 1, 4, 9]
+
+
+def test_cluster_resources_include_daemon(daemon_cluster):
+    totals = ray.cluster_resources()
+    assert totals.get("A", 0) >= 4 and totals.get("B", 0) >= 4
+
+
+# -- destructive tests (tear down the shared runtime); keep them LAST ----
+
+def test_daemon_kill_task_retry():
+    """Killing a node daemon fails its in-flight tasks through the worker
+    death path; retries land on surviving nodes (reference:
+    test_chaos.py semantics)."""
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    # Virtual fallback node carries the same resource, so retries have
+    # somewhere to go once the daemon dies.
+    victim = cluster.add_node(num_cpus=2, resources={"R": 2}, daemon=True)
+    cluster.add_node(num_cpus=2, resources={"R": 2})
+    try:
+        @ray.remote(resources={"R": 1}, max_retries=2)
+        def slow():
+            import os
+            import time
+            time.sleep(2.0)
+            return os.getpid()
+
+        ref = slow.remote()
+        time.sleep(0.7)  # ensure it is running on the daemon
+        victim.proc.kill()
+        assert isinstance(ray.get(ref, timeout=60), int)
+    finally:
+        cluster.shutdown()
+
+
+def test_daemon_kill_actor_restart():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    victim = cluster.add_node(num_cpus=2, resources={"R": 2}, daemon=True)
+    cluster.add_node(num_cpus=2, resources={"R": 2})
+    try:
+        @ray.remote(resources={"R": 1}, max_restarts=1, max_task_retries=1)
+        class Sticky:
+            def where(self):
+                import os
+                return os.getpid()
+
+        a = Sticky.remote()
+        first = ray.get(a.where.remote(), timeout=60)
+        victim.proc.kill()
+        time.sleep(1.0)
+        second = ray.get(a.where.remote(), timeout=60)
+        assert second != first
+    finally:
+        cluster.shutdown()
+
+
+def test_object_recovery_after_node_loss():
+    """Objects whose primary copy lived on a dead node are reconstructed
+    from lineage on the next get (reference: ObjectRecoveryManager,
+    object_recovery_manager.h:38)."""
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    victim = cluster.add_node(num_cpus=2, resources={"R": 2}, daemon=True)
+    cluster.add_node(num_cpus=2, resources={"R": 2})
+    try:
+        @ray.remote(resources={"R": 1}, max_retries=2)
+        def produce():
+            return np.full(200_000, 9.0)
+
+        ref = produce.remote()
+        ray.wait([ref], timeout=60)
+        victim.proc.kill()
+        time.sleep(1.0)
+        arr = ray.get(ref, timeout=60)
+        assert arr[0] == 9.0 and arr.shape == (200_000,)
+    finally:
+        cluster.shutdown()
