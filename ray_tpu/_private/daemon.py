@@ -405,6 +405,12 @@ def _main():
         raise SystemExit("cluster token required: --token-hex or "
                          "RAY_TPU_CLUSTER_TOKEN_HEX")
     host, _, port = args.address.rpartition(":")
+    if (host not in ("127.0.0.1", "localhost")
+            and "RAY_TPU_NODE_HOST" not in os.environ):
+        # Remote head: this node's transfer server must be reachable
+        # from the other hosts, not loopback-only (mirrors cli.py).
+        from .config import ray_config
+        ray_config.set("node_host", "0.0.0.0")
     daemon = NodeDaemon(
         (host, int(port)), bytes.fromhex(token_hex),
         num_cpus=args.num_cpus, num_tpus=args.num_tpus,

@@ -12,6 +12,7 @@ handling reuse the single-host code paths unchanged.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -102,8 +103,11 @@ class DaemonHandle:
         self._pending[req_id] = fut
         payload = dict(payload)
         payload["req_id"] = req_id
-        self.send(msg_type, payload)
-        result = fut.result(timeout=timeout)
+        try:
+            self.send(msg_type, payload)
+            result = fut.result(timeout=timeout)
+        finally:
+            self._pending.pop(req_id, None)
         if isinstance(result, dict) and result.get("__error__") is not None:
             raise result["__error__"]
         return result
@@ -175,11 +179,17 @@ class HeadServer:
 
     def __init__(self, node, token: bytes, host: str = "127.0.0.1",
                  port: int = 0):
-        from multiprocessing.connection import Listener
+        import socket as _socket
         self._node = node
-        self._listener = Listener((host, port), family="AF_INET",
-                                  authkey=token)
-        self.address: Tuple[str, int] = self._listener.address
+        self._token = token
+        # Raw socket accept + per-connection handshake thread: a client
+        # that connects and sends nothing must not wedge the accept loop
+        # (Listener.accept runs the auth challenge inline, unbounded).
+        self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        self._sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.address: Tuple[str, int] = self._sock.getsockname()
         self.daemons: Dict[str, DaemonHandle] = {}
         self._lock = threading.Lock()
         self._stopped = False
@@ -190,22 +200,59 @@ class HeadServer:
     def _accept_loop(self):
         while not self._stopped:
             try:
-                conn = self._listener.accept()
-            except (OSError, EOFError, Exception):
+                sock, _addr = self._sock.accept()
+            except OSError:
                 if self._stopped:
                     return
                 continue
-            threading.Thread(target=self._serve_daemon, args=(conn,),
+            threading.Thread(target=self._serve_daemon, args=(sock,),
                              daemon=True, name="daemon-conn").start()
 
-    def _serve_daemon(self, conn):
+    def _handshake(self, sock):
+        """multiprocessing-compatible auth with a deadline, then wrap the
+        fd in a Connection (the daemon side uses plain Client())."""
+        import socket as _socket
+        import struct as _struct
+        from multiprocessing.connection import (Connection,
+                                                answer_challenge,
+                                                deliver_challenge)
+        # SO_RCVTIMEO bounds the raw reads Connection does during auth.
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVTIMEO,
+                        _struct.pack("ll", 10, 0))
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        conn = Connection(sock.detach())
+        deliver_challenge(conn, self._token)
+        answer_challenge(conn, self._token)
+        return conn
+
+    def _serve_daemon(self, sock):
         import cloudpickle
         handle: Optional[DaemonHandle] = None
+        conn = None
         try:
+            try:
+                conn = self._handshake(sock)
+            except Exception:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
             msg_type, payload = cloudpickle.loads(conn.recv_bytes())
             if msg_type != P.REGISTER_NODE:
                 conn.close()
                 return
+            # Registration done: drop the handshake read deadline — the
+            # daemon link is long-lived and legitimately idle.
+            try:
+                import socket as _socket
+                import struct as _struct
+                s = _socket.socket(fileno=os.dup(conn.fileno()))
+                s.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVTIMEO,
+                             _struct.pack("ll", 0, 0))
+                s.close()
+            except OSError:
+                pass
             peer_host = "127.0.0.1"
             try:
                 # multiprocessing.Connection doesn't expose the peer; the
@@ -319,7 +366,7 @@ class HeadServer:
     def stop(self):
         self._stopped = True
         try:
-            self._listener.close()
+            self._sock.close()
         except Exception:
             pass
         with self._lock:

@@ -174,6 +174,7 @@ class Node:
             max_workers=32, thread_name_prefix="handler")
         self._fn_registry: Dict[str, bytes] = {}
         self._retries_used: Dict[bytes, int] = {}
+        self._recovery_lock = threading.Lock()
         self._cancel_requested: Set[bytes] = set()
         self._actors: Dict[ActorID, _ActorState] = {}
         self._actor_dep_waiters: Dict[ObjectID, List[Tuple[_ActorState, list]]] = {}
@@ -432,13 +433,17 @@ class Node:
         raise ObjectLostError(oid.hex(), "reconstruction attempts exhausted")
 
     def _resubmit_for_recovery(self, spec: P.TaskSpec, _depth: int = 0):
-        # Already being recovered (all returns pending): don't double-run.
-        entries = [self.gcs.objects.entry(rid) for rid in spec.return_ids]
-        if entries and all(e is not None and e.state == gcs_mod.PENDING
-                           for e in entries):
-            return
-        for rid in spec.return_ids:
-            self.gcs.objects.register_pending(rid, spec)
+        # Guard + register atomically: concurrent getters woken by the
+        # same node loss must not double-submit the producing task.
+        with self._recovery_lock:
+            entries = [self.gcs.objects.entry(rid)
+                       for rid in spec.return_ids]
+            if entries and all(e is not None
+                               and e.state == gcs_mod.PENDING
+                               for e in entries):
+                return
+            for rid in spec.return_ids:
+                self.gcs.objects.register_pending(rid, spec)
         # Recursively recover LOST arguments first (reference:
         # ObjectRecoveryManager walks the lineage of missing deps).
         if _depth < 16:
@@ -634,15 +639,19 @@ class Node:
             self._unpin_task_args(spec)
             return
         self._resolve_arg_locations(spec)
-        send_spec = spec
+        # Blob handling without rebuilding the dataclass (hot path):
+        # swap the field around the pickle — each spec is dispatched by
+        # exactly one thread at a time (retries are sequential).
+        blob_swap = False
         if spec.fn_id in worker.fn_cache:
-            send_spec = P.TaskSpec(**{**spec.__dict__, "fn_blob": None})
+            if spec.fn_blob is not None:
+                saved_blob, spec.fn_blob, blob_swap = spec.fn_blob, None, True
         else:
             if spec.fn_blob is None:
-                send_spec = P.TaskSpec(
-                    **{**spec.__dict__,
-                       "fn_blob": self._fn_registry.get(spec.fn_id)})
+                saved_blob, blob_swap = None, True
+                spec.fn_blob = self._fn_registry.get(spec.fn_id)
             worker.fn_cache.add(spec.fn_id)
+        send_spec = spec
         worker.running[spec.task_id.binary()] = spec
         worker.last_dispatch_ts = time.time()
         self.gcs.record_task_event({
@@ -653,7 +662,17 @@ class Node:
             worker.send(P.EXEC_TASK, {"spec": send_spec})
         except Exception:
             worker.running.pop(spec.task_id.binary(), None)
+            if blob_swap:
+                spec.fn_blob = saved_blob
+                blob_swap = False
+            # Release the acquisition made for THIS dispatch before the
+            # retry re-acquires (the worker-death path can't: the spec
+            # was already popped from worker.running).
+            self.scheduler.release_task_resources(spec)
             self._handle_worker_failure_for_task(spec)
+        finally:
+            if blob_swap:
+                spec.fn_blob = saved_blob
 
     def _on_gen_item(self, handle: WorkerHandle, payload: dict):
         """One streamed item landed (reference: TaskManager handling of
@@ -799,6 +818,9 @@ class Node:
         if spec is not None and not is_actor_task:
             self.scheduler.release_task_resources(spec)
             self._push_idle(handle)
+            # Keep the pipeline full without a dispatch-thread hop; the
+            # notify still runs so the loop re-checks remaining slack.
+            self.scheduler.dispatch_after_completion()
             self.scheduler.notify_worker_free()
         if spec is None:
             return

@@ -251,6 +251,83 @@ class WorkerHandle:
             pass
 
 
+class _RecvMux:
+    """One epoll thread multiplexing every worker connection (replaces a
+    recv thread per worker). On a busy many-core box per-worker threads
+    all wake on the GIL when replies land; a single mux drains them
+    sequentially with no thread-pile-up — the asio io_service pattern of
+    the reference's C++ runtime (common/asio/instrumented_io_context.h).
+    """
+
+    def __init__(self):
+        import selectors
+        self._sel = selectors.DefaultSelector()
+        self._lock = threading.Lock()
+        # Self-pipe to interrupt select() for (un)registration.
+        self._rd, self._wr = os.pipe()
+        os.set_blocking(self._rd, False)
+        self._sel.register(self._rd, selectors.EVENT_READ, None)
+        self._pending_add: list = []
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="recv-mux")
+        self._thread.start()
+
+    def register(self, handle: "WorkerHandle",
+                 on_message: Callable, on_eof: Callable):
+        with self._lock:
+            self._pending_add.append((handle, on_message, on_eof))
+        self._wake()
+
+    def _wake(self):
+        try:
+            os.write(self._wr, b"x")
+        except OSError:
+            pass
+
+    def _loop(self):
+        import cloudpickle
+        import selectors
+        while not self._stopped:
+            with self._lock:
+                adds, self._pending_add = self._pending_add, []
+            for handle, on_message, on_eof in adds:
+                try:
+                    self._sel.register(
+                        handle.conn.fileno(), selectors.EVENT_READ,
+                        (handle, on_message, on_eof))
+                except (OSError, ValueError):
+                    on_eof(handle)
+            for key, _ in self._sel.select(timeout=1.0):
+                if key.data is None:
+                    try:
+                        while os.read(self._rd, 4096):
+                            pass
+                    except OSError:
+                        pass
+                    continue
+                handle, on_message, on_eof = key.data
+                try:
+                    data = handle.conn.recv_bytes()
+                except (EOFError, OSError):
+                    try:
+                        self._sel.unregister(key.fd)
+                    except (KeyError, ValueError):
+                        pass
+                    on_eof(handle)
+                    continue
+                try:
+                    msg_type, payload = cloudpickle.loads(data)
+                    on_message(handle, msg_type, payload)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+
+    def stop(self):
+        self._stopped = True
+        self._wake()
+
+
 class WorkerPool:
     """Spawns and pools worker processes (reference: WorkerPool,
     src/ray/raylet/worker_pool.cc:447 StartWorkerProcess / :1355 PopWorker)."""
@@ -267,6 +344,7 @@ class WorkerPool:
         self._node_id_hex = node_id_hex
         self._authkey = os.urandom(16)
         self._lock = threading.Lock()
+        self._mux = _RecvMux()
         self._idle: Dict[str, Deque[WorkerHandle]] = collections.defaultdict(
             collections.deque)
         self.workers: Dict[WorkerID, WorkerHandle] = {}
@@ -407,23 +485,12 @@ class WorkerPool:
             node_id_hex=self._node_id_hex)
         conn.send_bytes(cloudpickle.dumps(config))
         handle = WorkerHandle(worker_id, proc, conn, env_key, env)
-        t = threading.Thread(target=self._recv_loop, args=(handle,),
-                             daemon=True, name=f"recv-{worker_id.hex()[:8]}")
-        handle.recv_thread = t
         with self._lock:
             self.workers[worker_id] = handle
-        t.start()
+        self._mux.register(handle, self._on_message, self._handle_eof)
         return handle
 
-    def _recv_loop(self, handle: WorkerHandle):
-        import cloudpickle
-        while True:
-            try:
-                data = handle.conn.recv_bytes()
-            except (EOFError, OSError):
-                break
-            msg_type, payload = cloudpickle.loads(data)
-            self._on_message(handle, msg_type, payload)
+    def _handle_eof(self, handle: WorkerHandle):
         if not handle.death_handled:
             handle.death_handled = True
             handle.alive = False
@@ -474,6 +541,7 @@ class WorkerPool:
                 pass
             if h.proc.poll() is None:
                 h.kill()
+        self._mux.stop()
 
 
 class PendingTask:
@@ -525,6 +593,17 @@ class Scheduler:
 
     # -- submission --------------------------------------------------------
     def submit(self, spec: P.TaskSpec, unresolved: Set[ObjectID]):
+        if not unresolved and not isinstance(spec, P.ActorSpec):
+            # Fast path: dispatch inline on the submitter's thread when
+            # resources and an idle worker are immediately available —
+            # skips the dispatch-thread hop (cond wake + context switch),
+            # which dominates small-task latency. Queue-empty check keeps
+            # rough FIFO fairness; worker starts / infeasibility fall
+            # through to the dispatch loop.
+            with self._cond:
+                queue_empty = not self._ready
+            if queue_empty and self._try_dispatch_fast(spec):
+                return
         with self._cond:
             if unresolved:
                 pt = PendingTask(spec, set(unresolved))
@@ -564,6 +643,48 @@ class Scheduler:
     def notify_worker_free(self):
         with self._cond:
             self._cond.notify()
+
+    def _try_dispatch_fast(self, spec) -> bool:
+        """Dispatch without starting workers: resources + an idle worker
+        or nothing. Runs on submitter/recv threads (the reference's
+        direct-dispatch when a lease is already held)."""
+        demand = spec.resources
+        node_id = self.nodes.acquire(demand)
+        if node_id is None:
+            return False
+        env_key = self._env_key_for(spec)
+        entry = self.nodes.get(node_id)
+        if entry is not None and entry.daemon is not None:
+            worker = entry.daemon.pop_idle(env_key)
+        else:
+            worker = self.pool.pop_idle(env_key)
+        if worker is None:
+            self.nodes.release(node_id, demand)
+            return False
+        self._task_node[self._spec_key(spec)] = node_id
+        self._dispatch_fn(spec, worker)
+        return True
+
+    def dispatch_after_completion(self) -> bool:
+        """Completion-driven dispatch: a finished task freed resources +
+        an idle worker; hand the next queued task straight out on the
+        recv thread instead of waking the dispatch loop. Returns True if
+        a task was dispatched."""
+        with self._cond:
+            if not self._ready:
+                return False
+            spec = self._ready.popleft()
+        tid = getattr(spec, "task_id", None)
+        if tid is not None and tid.binary() in self._cancelled:
+            self._cancelled.discard(tid.binary())
+            return False
+        if isinstance(spec, P.ActorSpec) or not self._try_dispatch_fast(
+                spec):
+            with self._cond:
+                self._ready.appendleft(spec)
+                self._cond.notify()
+            return False
+        return True
 
     def try_cancel(self, task_id: TaskID) -> bool:
         """Remove a queued task; returns True if it had not been dispatched."""

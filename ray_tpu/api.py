@@ -209,6 +209,16 @@ def _build_resources(opts: Dict, default_num_cpus: float = 1) -> Dict[str, float
     return res
 
 
+def _ambient_pg_spec():
+    """The current task's spec if it might carry a capturable placement
+    group into child tasks, else None (fast-path gate for remote())."""
+    from ._private import worker_proc
+    cur = worker_proc.current_task_spec()
+    if cur is not None and cur.placement_group_id:
+        return cur
+    return None
+
+
 def _apply_placement(opts: Dict, resources: Dict[str, float]):
     """Resolve placement-group options into the formatted-resource demand
     rewrite (reference: ray_option_utils + BundleSpecification resource
@@ -333,7 +343,28 @@ class RemoteFunction:
                        f"{uuid.uuid4().hex[:16]}")
         self._blob: Optional[bytes] = None
         self._blob_lock = threading.Lock()
+        self._precompute()
         functools.update_wrapper(self, fn)
+
+    def _precompute(self):
+        """Per-call invariants hoisted out of remote() — the submit path
+        is the reference's microbenchmark hot loop (ray_perf.py:174-189)
+        and options don't change between calls."""
+        opts = self._opts
+        self._streaming = opts.get("num_returns") == "streaming"
+        self._num_returns = 0 if self._streaming else int(
+            opts.get("num_returns", 1))
+        self._resources = _build_resources(opts)
+        self._max_retries = opts.get("max_retries")
+        self._retry_exceptions = bool(opts.get("retry_exceptions", False))
+        self._runtime_env = _validate_runtime_env(opts.get("runtime_env"))
+        self._name = opts.get("name", getattr(self._fn, "__name__", "f"))
+        # Placement resolution is per-call only when a PG/strategy is in
+        # play (explicitly, or potentially inherited from an ambient
+        # captured group inside a worker).
+        self._static_placement = (
+            opts.get("scheduling_strategy") is None
+            and opts.get("placement_group") is None)
 
     def _get_blob(self) -> bytes:
         if self._blob is None:
@@ -355,6 +386,7 @@ class RemoteFunction:
         rf._fn_id = self._fn_id
         rf._blob = self._blob
         rf._blob_lock = self._blob_lock
+        rf._precompute()
         functools.update_wrapper(rf, self._fn)
         return rf
 
@@ -382,32 +414,36 @@ class RemoteFunction:
             init(ignore_reinit_error=True)
         rt = state.current()
         opts = self._opts
-        streaming = opts.get("num_returns") == "streaming"
+        streaming = self._streaming
         if streaming and not hasattr(rt, "gen_wait"):
             # GEN_ITEM messages route to the owner (driver); a worker
             # could submit but never consume the stream.
             raise ValueError(
                 'num_returns="streaming" is only supported from the '
                 "driver process in this build")
-        num_returns = 0 if streaming else int(opts.get("num_returns", 1))
+        num_returns = self._num_returns
         task_id = TaskID.from_random()
         return_ids = [object_id_for_return(task_id, i)
                       for i in range(num_returns)]
         s_args, s_kwargs = _make_args(args, kwargs)
-        pg_id, bundle_index, resources = _apply_placement(
-            opts, _build_resources(opts))
+        if self._static_placement and _ambient_pg_spec() is None:
+            pg_id, bundle_index, resources = None, -1, self._resources
+        else:
+            pg_id, bundle_index, resources = _apply_placement(
+                opts, dict(self._resources))
         spec = P.TaskSpec(
             task_id=task_id, fn_id=self._fn_id, fn_blob=self._get_blob(),
             args=s_args, kwargs=s_kwargs, return_ids=return_ids,
-            num_returns=num_returns, name=opts.get("name", self.__name__),
+            num_returns=num_returns, name=self._name,
             resources=resources, streaming=streaming,
-            max_retries=int(opts.get(
-                "max_retries", _config().default_task_max_retries)),
-            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            max_retries=int(self._max_retries
+                            if self._max_retries is not None
+                            else _config().default_task_max_retries),
+            retry_exceptions=self._retry_exceptions,
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_index,
             scheduling_strategy=opts.get("scheduling_strategy"),
-            runtime_env=_validate_runtime_env(opts.get("runtime_env")))
+            runtime_env=self._runtime_env)
         refs = [ObjectRef(rid) for rid in return_ids]
         tr = _tracing()
         if tr is not None and tr.is_enabled():

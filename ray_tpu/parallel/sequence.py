@@ -52,6 +52,16 @@ def ring_attention(q, k, v, axis_name: str = "sp",
 
     q/k/v: local sequence shards [B, H, S_local, D]; global sequence is the
     concatenation over the axis in rank order. Returns [B, H, S_local, D].
+
+    Overlap: the ring is unrolled (the axis size is static), and each
+    step's ``ppermute`` for the NEXT K/V block is emitted BEFORE the
+    current block's attention compute — the transfer has no data
+    dependence on the block math, so XLA's latency-hiding scheduler runs
+    the collective-permute-start/done pair concurrently with the einsums
+    (double buffering; the last step sends nothing). Memory: each block
+    step is rematerialized (``jax.checkpoint``), so the backward
+    recomputes per-block probabilities instead of storing [Sq, Sk]
+    matrices per step.
     """
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
@@ -68,32 +78,36 @@ def ring_attention(q, k, v, axis_name: str = "sp",
     # originated at rank (rank - t) mod n.
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(t, carry):
-        kt, vt, acc, m, l = carry
-        src = (rank - t) % n
+    @functools.partial(jax.checkpoint, static_argnums=())
+    def block_step(t_src_is_self, t_src_is_left, q, kt, vt, acc, m, l):
         # src < rank: fully visible. src == rank: causal. src > rank: none.
-        mask = jnp.where(src < rank, full_mask,
-                         jnp.where(src == rank, tri_mask, zero_mask))
+        mask = jnp.where(t_src_is_left, full_mask,
+                         jnp.where(t_src_is_self, tri_mask, zero_mask))
         out_b, m_b, l_b = _block_attn(q, kt, vt, scale, mask)
-        # online softmax merge
         m_new = jnp.maximum(m, m_b)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(m_b - m_new)
         acc = acc * alpha[..., None] + out_b * beta[..., None]
         l = l * alpha + l_b * beta
-        kt = lax.ppermute(kt, axis_name, perm)
-        vt = lax.ppermute(vt, axis_name, perm)
-        return kt, vt, acc, m_new, l
+        return acc, m_new, l
 
     b, h, _, d = q.shape
-    acc0 = jnp.zeros((b, h, s_local, d), dtype=jnp.float32)
-    m0 = jnp.full((b, h, s_local), -jnp.inf, dtype=jnp.float32)
-    l0 = jnp.zeros((b, h, s_local), dtype=jnp.float32)
-    # Inside shard_map, loop carries must carry the device-varying type
-    # from the start (the body mixes them with per-shard data).
-    acc0, m0, l0 = _pvary((acc0, m0, l0), axis_name)
-    _, _, acc, m, l = lax.fori_loop(
-        0, n, body, (k, v, acc0, m0, l0))
+    acc = jnp.zeros((b, h, s_local, d), dtype=jnp.float32)
+    m = jnp.full((b, h, s_local), -jnp.inf, dtype=jnp.float32)
+    l = jnp.zeros((b, h, s_local), dtype=jnp.float32)
+    acc, m, l = _pvary((acc, m, l), axis_name)
+    kt, vt = k, v
+    for t in range(n):
+        if t + 1 < n:
+            # Next hop FIRST: independent of this block's compute, so the
+            # scheduler overlaps the ICI transfer with the einsums below.
+            kt_next = lax.ppermute(kt, axis_name, perm)
+            vt_next = lax.ppermute(vt, axis_name, perm)
+        src = (rank - t) % n
+        acc, m, l = block_step(src == rank, src < rank,
+                               q, kt, vt, acc, m, l)
+        if t + 1 < n:
+            kt, vt = kt_next, vt_next
     l = jnp.maximum(l, 1e-30)
     return (acc / l[..., None]).astype(q.dtype)
 
