@@ -661,15 +661,18 @@ class Node:
         try:
             worker.send(P.EXEC_TASK, {"spec": send_spec})
         except Exception:
-            worker.running.pop(spec.task_id.binary(), None)
+            # The atomic pop decides which failure path owns this spec:
+            # the worker-death handler may race us here (send fails
+            # BECAUSE the worker died), and exactly one of us must
+            # release + resubmit.
+            owned = worker.running.pop(spec.task_id.binary(),
+                                       None) is not None
             if blob_swap:
                 spec.fn_blob = saved_blob
                 blob_swap = False
-            # Release the acquisition made for THIS dispatch before the
-            # retry re-acquires (the worker-death path can't: the spec
-            # was already popped from worker.running).
-            self.scheduler.release_task_resources(spec)
-            self._handle_worker_failure_for_task(spec)
+            if owned:
+                self.scheduler.release_task_resources(spec)
+                self._handle_worker_failure_for_task(spec)
         finally:
             if blob_swap:
                 spec.fn_blob = saved_blob
@@ -1047,8 +1050,16 @@ class Node:
         self.pool.remove(handle)
         self.scheduler.on_worker_removed(handle)
         aid = handle.dedicated_actor
-        running = dict(handle.running)
-        handle.running.clear()
+        # Drain via atomic popitem: a concurrent send-failure branch in
+        # _dispatch also pops, and each spec must be owned by exactly
+        # one failure path.
+        running: Dict[bytes, P.TaskSpec] = {}
+        while True:
+            try:
+                k, v = handle.running.popitem()
+            except KeyError:
+                break
+            running[k] = v
         if aid is not None:
             self._on_actor_worker_death(aid, running)
             return
@@ -1278,12 +1289,23 @@ class Node:
             return self.gcs.objects.list_entries(
                 limit=kwargs.get("limit", 1000))
         if op == "list_workers":
-            return [{"worker_id": wid.hex(),
+            rows = [{"worker_id": wid.hex(),
                      "pid": h.proc.pid if h.proc else None,
+                     "node_id": self.node_id.hex(),
                      "dedicated_actor": (h.dedicated_actor.hex()
                                          if h.dedicated_actor else None),
                      "running_tasks": len(h.running)}
                     for wid, h in self.pool.workers.items()]
+            # Workers on daemon nodes (their absence here broke the
+            # elastic shutdown wait for multi-node gangs).
+            for p in self.head_server.all_proxies():
+                rows.append({
+                    "worker_id": p.worker_id.hex(), "pid": None,
+                    "node_id": p.node_id_hex,
+                    "dedicated_actor": (p.dedicated_actor.hex()
+                                        if p.dedicated_actor else None),
+                    "running_tasks": len(p.running)})
+            return rows
         if op == "resource_demands":
             demands = self.scheduler.pending_demands()
             pending_pgs = [

@@ -241,14 +241,55 @@ class WorkerHandle:
             self.conn.send_bytes(data)
 
     def kill(self):
-        """Terminate the process. The recv loop's EOF fires the death
-        callback, which fails in-flight tasks and releases resources — so
-        `alive` is cleared (no new work) but death handling still runs."""
+        """Force-kill the process (SIGKILL — jax.distributed installs a
+        SIGTERM-catching preemption notifier, so terminate() would leave
+        a collective worker alive and computing). Graceful shutdown is
+        the SHUTDOWN message, not this. The recv mux's EOF fires the
+        death callback, which fails in-flight tasks and releases
+        resources — so `alive` is cleared (no new work) but death
+        handling still runs."""
         self.alive = False
         try:
-            self.proc.terminate()
+            self.proc.kill()
         except Exception:
             pass
+
+
+class _ConnState:
+    """Per-connection incremental frame reassembly for the recv mux."""
+
+    __slots__ = ("handle", "on_message", "on_eof", "sock", "buf")
+
+    def __init__(self, handle, on_message, on_eof, sock):
+        self.handle = handle
+        self.on_message = on_message
+        self.on_eof = on_eof
+        self.sock = sock
+        self.buf = bytearray()
+
+    def frames(self):
+        """Parse complete multiprocessing.Connection frames out of the
+        buffer (4-byte '!i' length; -1 escapes to an 8-byte '!Q')."""
+        import struct
+        buf = self.buf
+        while True:
+            if len(buf) < 4:
+                return
+            (n,) = struct.unpack_from("!i", buf, 0)
+            if n == -1:
+                if len(buf) < 12:
+                    return
+                (n64,) = struct.unpack_from("!Q", buf, 4)
+                if len(buf) < 12 + n64:
+                    return
+                frame = bytes(buf[12:12 + n64])
+                del buf[:12 + n64]
+            else:
+                if len(buf) < 4 + n:
+                    return
+                frame = bytes(buf[4:4 + n])
+                del buf[:4 + n]
+            yield frame
 
 
 class _RecvMux:
@@ -257,6 +298,11 @@ class _RecvMux:
     all wake on the GIL when replies land; a single mux drains them
     sequentially with no thread-pile-up — the asio io_service pattern of
     the reference's C++ runtime (common/asio/instrumented_io_context.h).
+
+    Reads are per-call nonblocking (MSG_DONTWAIT on a dup'd fd, so the
+    writer side of the same socket stays blocking) with incremental
+    frame reassembly: one frozen worker mid-frame can NOT wedge message
+    handling or death detection for the others.
     """
 
     def __init__(self):
@@ -285,7 +331,20 @@ class _RecvMux:
         except OSError:
             pass
 
+    def _close_conn(self, fd: int, state: _ConnState):
+        try:
+            self._sel.unregister(fd)
+        except (KeyError, ValueError):
+            pass
+        try:
+            state.sock.close()
+        except OSError:
+            pass
+        state.on_eof(state.handle)
+
     def _loop(self):
+        import socket as _socket
+
         import cloudpickle
         import selectors
         while not self._stopped:
@@ -293,9 +352,10 @@ class _RecvMux:
                 adds, self._pending_add = self._pending_add, []
             for handle, on_message, on_eof in adds:
                 try:
-                    self._sel.register(
-                        handle.conn.fileno(), selectors.EVENT_READ,
-                        (handle, on_message, on_eof))
+                    fd = handle.conn.fileno()
+                    sock = _socket.socket(fileno=os.dup(fd))
+                    state = _ConnState(handle, on_message, on_eof, sock)
+                    self._sel.register(fd, selectors.EVENT_READ, state)
                 except (OSError, ValueError):
                     on_eof(handle)
             for key, _ in self._sel.select(timeout=1.0):
@@ -306,22 +366,32 @@ class _RecvMux:
                     except OSError:
                         pass
                     continue
-                handle, on_message, on_eof = key.data
-                try:
-                    data = handle.conn.recv_bytes()
-                except (EOFError, OSError):
+                state: _ConnState = key.data
+                eof = False
+                while True:
                     try:
-                        self._sel.unregister(key.fd)
-                    except (KeyError, ValueError):
-                        pass
-                    on_eof(handle)
-                    continue
-                try:
-                    msg_type, payload = cloudpickle.loads(data)
-                    on_message(handle, msg_type, payload)
-                except Exception:
-                    import traceback
-                    traceback.print_exc()
+                        chunk = state.sock.recv(1 << 20,
+                                                _socket.MSG_DONTWAIT)
+                    except (BlockingIOError, InterruptedError):
+                        break
+                    except OSError:
+                        eof = True
+                        break
+                    if not chunk:
+                        eof = True
+                        break
+                    state.buf.extend(chunk)
+                    if len(chunk) < (1 << 20):
+                        break
+                for frame in state.frames():
+                    try:
+                        msg_type, payload = cloudpickle.loads(frame)
+                        state.on_message(state.handle, msg_type, payload)
+                    except Exception:
+                        import traceback
+                        traceback.print_exc()
+                if eof:
+                    self._close_conn(key.fd, state)
 
     def stop(self):
         self._stopped = True
@@ -753,13 +823,12 @@ class Scheduler:
 
     def release_task_resources(self, spec):
         """Release a finished/failed task's resources on the node that
-        granted them (runtime calls this instead of touching the head
-        ResourceManager directly)."""
+        granted them. Idempotent: the _task_node pop is the arbiter, so
+        concurrent failure paths (send-failure branch vs worker-death
+        handler) can both call this without double-releasing."""
         node_id = self._task_node.pop(self._spec_key(spec), None)
         if node_id is not None:
             self.nodes.release(node_id, spec.resources)
-        else:
-            self.resources.release(spec.resources)
 
     def node_of_task(self, spec) -> Optional[str]:
         return self._task_node.get(self._spec_key(spec))

@@ -84,9 +84,14 @@ def _pick_block(seq_len: int) -> int:
 # Forward kernel: grid (bh, q_blocks, k_blocks); K innermost so fp32
 # accumulators ride VMEM scratch across the K sweep.
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_scr, m_scr, l_scr, *, sm_scale: float, causal: bool,
-                block_q: int, block_k: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale: float,
+                causal: bool, block_q: int, block_k: int,
+                save_lse: bool):
+    if save_lse:
+        lse_ref, acc_scr, m_scr, l_scr = rest
+    else:
+        lse_ref = None
+        acc_scr, m_scr, l_scr = rest
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -140,13 +145,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # degenerate inputs): avoid 0/0.
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
-        lse = m_scr[...] + jnp.log(l_safe)          # (block_q,)
-        lse_ref[0] = jax.lax.broadcast_in_dim(
-            lse, (block_q, 128), (0,))
+        if save_lse:
+            lse = m_scr[...] + jnp.log(l_safe)      # (block_q,)
+            lse_ref[0] = jax.lax.broadcast_in_dim(
+                lse, (block_q, 128), (0,))
 
 
 def _flash_forward(q, k, v, causal: bool, sm_scale: float,
-                   block_q: int, block_k: int):
+                   block_q: int, block_k: int, save_lse: bool = True):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -162,7 +168,7 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float,
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, save_lse=save_lse)
     if causal:
         # Upper-triangle K blocks are never used: clamp their index to
         # the diagonal so Mosaic sees an unchanged block and skips the
@@ -174,7 +180,23 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float,
     else:
         def kv_index(b, i, j):
             return (b, j, 0)
-    out, lse = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
+    if save_lse:
+        # lse is lane-replicated to 128 so its block satisfies the TPU
+        # (8, 128) tile rule (the layout jax's own TPU flash kernel uses
+        # for its residuals). Inference-only forwards skip it entirely —
+        # pallas outputs are opaque to XLA DCE, so an unused lse would
+        # still cost seq*128*4 bytes of HBM writes per (batch, head).
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM))
+        out_shape.append(
+            jax.ShapeDtypeStruct((bh, seq_len, 128), jnp.float32))
+    result = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -185,19 +207,8 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float,
             pl.BlockSpec((1, block_k, head_dim), kv_index,
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            # lse is lane-replicated to 128 so its block satisfies the
-            # TPU (8, 128) tile rule (the layout jax's own TPU flash
-            # kernel uses for its residuals).
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(qf.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_len, 128), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, head_dim), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -207,10 +218,11 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(qf, kf, vf)
+    out = result[0].reshape(batch, heads, seq_len, head_dim)
     # lse stays lane-replicated (bh, seq, 128): the backward feeds it
     # straight back to the kernels, avoiding a slice + rebroadcast HBM
     # round trip per training step.
-    return out.reshape(batch, heads, seq_len, head_dim), lse
+    return out, (result[1] if save_lse else None)
 
 
 # ---------------------------------------------------------------------------
@@ -432,7 +444,9 @@ def flash_attention(q, k, v, causal: bool = True,
     the saved logsumexp (flash-2), so both inference AND training scale
     to long sequences (SURVEY.md hard-part #5).
     """
-    out, _ = _flash_attention_fwd_impl(q, k, v, causal, sm_scale)
+    # Primal-only call (no differentiation): skip the lse residual.
+    out, _ = _flash_attention_fwd_impl(q, k, v, causal, sm_scale,
+                                       save_lse=False)
     return out
 
 
@@ -441,13 +455,15 @@ def _scale_of(q, sm_scale):
         q.shape[-1])
 
 
-def _flash_attention_fwd_impl(q, k, v, causal, sm_scale):
+def _flash_attention_fwd_impl(q, k, v, causal, sm_scale,
+                              save_lse=True):
     scale = _scale_of(q, sm_scale)
     seq_len = q.shape[-2]
     if _kernel_ok(seq_len):
         block = _pick_block(seq_len)
         out, lse = _flash_forward(q, k, v, causal, scale,
-                                  block_q=block, block_k=block)
+                                  block_q=block, block_k=block,
+                                  save_lse=save_lse)
         return out, (out, lse)
     return mha_reference(q, k, v, causal, scale), (None, None)
 

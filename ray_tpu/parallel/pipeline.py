@@ -1,4 +1,4 @@
-"""Pipeline parallelism over the `pp` mesh axis.
+"""Pipeline parallelism over the `pp` mesh axis — forward AND training.
 
 Net-new vs the reference (SURVEY.md §2.4: PP "Not in-tree", built by users
 from ADAG multi-actor pipelines): here a pipeline is a compiled SPMD
@@ -6,6 +6,16 @@ program — stage parameters are sharded over `pp`, microbatches flow
 stage-to-stage via `lax.ppermute`, and the whole GPipe schedule is a
 `lax.scan` inside one jit (the XLA analogue of a CompiledDAG of actors,
 dag/compiled_dag_node.py:767, with ICI hops instead of NCCL p2p channels).
+
+Backward: the schedule is differentiable end to end, and reverse-mode AD
+of the scan IS the backward pipeline — the transpose of each forward
+``ppermute`` hop is the reverse hop, so gradients flow last-stage ->
+first-stage in reverse tick order (a GPipe backward schedule), with the
+scan's saved carries as the per-tick activation stash. Grads of the
+stacked stage parameters come back sharded over `pp` exactly like the
+parameters themselves. `make_pipelined_train_fn` packages this as a
+(loss, grads) step; tests verify grads match a single-device sequential
+model bit-for-bit (tests/test_parallel.py).
 """
 
 from __future__ import annotations
@@ -75,11 +85,11 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     return lax.psum(contrib, axis_name)
 
 
-def make_pipelined_fn(mesh, stage_fn: Callable, n_microbatches: int,
-                      axis_name: str = "pp",
-                      params_spec=None, x_spec=None):
-    """shard_map + jit wrapper: stage_params stacked on axis 0 (one slice
-    per stage, sharded over `pp`); x global [n_micro * mb_size, ...]."""
+def _pipeline_forward(mesh, stage_fn: Callable, n_microbatches: int,
+                      axis_name: str, params_spec, x_spec):
+    """Shared shard_map builder: stage_params stacked on axis 0 (one
+    slice per stage, sharded over `axis_name`); x global
+    [n_micro * mb_size, ...]."""
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -90,11 +100,38 @@ def make_pipelined_fn(mesh, stage_fn: Callable, n_microbatches: int,
         # stage_params arrive with a leading stage axis of length 1.
         own = jax.tree.map(lambda p: p[0], stage_params)
         xm = x.reshape((n_microbatches, -1) + x.shape[1:])
-        out = pipeline_apply(
-            lambda pr, a: stage_fn(pr, a), own, xm, axis_name)
+        out = pipeline_apply(stage_fn, own, xm, axis_name)
         return out.reshape((-1,) + out.shape[2:])
 
-    fn = shard_map(local_fn, mesh=mesh,
-                   in_specs=(params_spec, x_spec),
-                   out_specs=x_spec)
-    return jax.jit(fn)
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(params_spec, x_spec),
+                     out_specs=x_spec)
+
+
+def make_pipelined_fn(mesh, stage_fn: Callable, n_microbatches: int,
+                      axis_name: str = "pp",
+                      params_spec=None, x_spec=None):
+    """jit'd GPipe forward (see _pipeline_forward)."""
+    return jax.jit(_pipeline_forward(mesh, stage_fn, n_microbatches,
+                                     axis_name, params_spec, x_spec))
+
+
+def make_pipelined_train_fn(mesh, stage_fn: Callable, loss_fn: Callable,
+                            n_microbatches: int, axis_name: str = "pp",
+                            params_spec=None, x_spec=None):
+    """Training step over a GPipe pipeline: returns a jitted
+    ``step(stage_params, x, y) -> (loss, grads)`` where `stage_params`
+    are stacked on axis 0 (one slice per stage, sharded over `axis_name`)
+    and `grads` come back with the same sharding.
+
+    loss_fn(outputs, y) -> scalar, applied to the full pipeline output
+    (all microbatches re-concatenated). The backward runs as the
+    reverse-tick pipeline (see module docstring).
+    """
+    apply = _pipeline_forward(mesh, stage_fn, n_microbatches,
+                              axis_name, params_spec, x_spec)
+
+    def loss_of(stage_params, x, y):
+        return loss_fn(apply(stage_params, x), y)
+
+    return jax.jit(jax.value_and_grad(loss_of))

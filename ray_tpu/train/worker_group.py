@@ -106,9 +106,30 @@ class WorkerGroup:
         return api.get([w.poll.remote() for w in self.workers],
                        timeout=timeout)
 
-    def shutdown(self):
+    def shutdown(self, wait_released_s: float = 5.0):
         for w in self.workers:
             try:
                 api.kill(w)
             except Exception:
                 pass
+        # Worker deaths release gang resources ASYNCHRONOUSLY (the recv
+        # mux processes each process EOF); an elastic restart that sizes
+        # the next gang before the releases land would under-size it.
+        # Wait until the gang's dedicated worker processes are gone from
+        # the worker table (their death handler releases the resources).
+        import time
+
+        from .._private import state as _state
+        mine = {w._actor_id.hex() for w in self.workers}
+        deadline = time.monotonic() + wait_released_s
+        while time.monotonic() < deadline:
+            try:
+                rows = _state.current().gcs_request("list_workers")
+            except Exception:
+                return
+            if not any(r.get("dedicated_actor") in mine for r in rows):
+                # Row removal precedes the release by a few statements in
+                # the same death handler; give it a beat.
+                time.sleep(0.1)
+                return
+            time.sleep(0.05)

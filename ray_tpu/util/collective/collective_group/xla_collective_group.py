@@ -211,28 +211,139 @@ class XLAGroup(BaseGroup):
         out = fn(garr)
         return np.asarray(out.addressable_shards[0].data)
 
+    @staticmethod
+    def _tree_steps(n: int):
+        steps = []
+        step = 1
+        while step < n:
+            steps.append(step)
+            step *= 2
+        return steps
+
+    def _shard_map_op(self, key, body):
+        """jit(shard_map(body)) over the world mesh, P('world')->P('world')."""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def build():
+            fn = shard_map(body, mesh=self._mesh,
+                           in_specs=P("world"), out_specs=P("world"))
+            return jax.jit(fn)
+
+        return self._jit(key, build)
+
     def reduce(self, tensor, opts: ReduceOptions = ReduceOptions()):
-        """Reduce to root (reference collective.py:311); other ranks get
-        the reduced value too (XLA all-reduce; harmless superset)."""
-        return self.allreduce(
-            tensor, AllReduceOptions(reduceOp=opts.reduceOp))
+        """Reduce to root (reference collective.py:311): binomial
+        tree-fold via ``ppermute`` — each round halves the holders,
+        payloads flow TOWARD root, every byte crosses each link once
+        (O(bytes) per link, log2(world) rounds; HLO: collective-permutes
+        only, no all-reduce — verified in tests). Root returns the
+        reduced tensor; other ranks return their input unchanged."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if self._world_size == 1:
+            return np.asarray(tensor)
+        n = self._world_size
+        root = opts.root_rank
+        op = opts.reduceOp
+        combine = {ReduceOp.SUM: jnp.add, ReduceOp.PRODUCT: jnp.multiply,
+                   ReduceOp.MIN: jnp.minimum, ReduceOp.MAX: jnp.maximum}[op]
+
+        def body(t):
+            my_dist = (lax.axis_index("world") - root) % n
+            for step in reversed(self._tree_steps(n)):
+                perm = [((root + d) % n, (root + d - step) % n)
+                        for d in range(step, min(2 * step, n))]
+                recv = lax.ppermute(t, "world", perm)
+                use = jnp.logical_and(my_dist < step, my_dist + step < n)
+                t = jnp.where(use, combine(t, recv), t)
+            return t
+
+        garr = self._global_from_local(tensor)
+        key = ("reduce", op, root, garr.shape, str(garr.dtype))
+        out = self._shard_map_op(key, body)(garr)
+        if self._rank == root:
+            return np.asarray(out.addressable_shards[0].data)[0]
+        return np.asarray(tensor)
 
     def broadcast(self, tensor, opts: BroadcastOptions = BroadcastOptions()):
-        """src_rank's tensor to all (reference collective.py:373)."""
+        """src_rank's tensor to all (reference collective.py:373):
+        binomial-tree broadcast via ``ppermute`` — holders double each
+        round, each receiving rank's payload crosses its link exactly
+        once (log2(world) rounds; HLO: collective-permutes only, no
+        all-reduce — the round-1 masked-allreduce paid reduce+broadcast).
+        """
         import jax.numpy as jnp
-        x = jnp.asarray(tensor)
-        mask = 1.0 if self._rank == opts.src_rank else 0.0
-        contrib = np.asarray(x) * mask
-        return self.allreduce(contrib)
+        from jax import lax
+
+        if self._world_size == 1:
+            return np.asarray(tensor)
+        n = self._world_size
+        src = opts.src_rank
+
+        def body(t):
+            my_dist = (lax.axis_index("world") - src) % n
+            for step in self._tree_steps(n):
+                perm = [((src + i) % n, (src + i + step) % n)
+                        for i in range(step) if i + step < n]
+                recv = lax.ppermute(t, "world", perm)
+                use = jnp.logical_and(my_dist >= step, my_dist < 2 * step)
+                t = jnp.where(use, recv, t)
+            return t
+
+        garr = self._global_from_local(tensor)
+        key = ("broadcast", src, garr.shape, str(garr.dtype))
+        out = self._shard_map_op(key, body)(garr)
+        return np.asarray(out.addressable_shards[0].data)[0]
 
     def barrier(self, opts: BarrierOptions = BarrierOptions()):
         self.allreduce(np.zeros((1,), dtype=np.float32))
 
+    # -- p2p ---------------------------------------------------------------
+    def _p2p(self, x: np.ndarray, src: int, dst: int):
+        """Point-to-point transfer via ``lax.ppermute`` over a two-device
+        mesh: only src and dst enter the program, and the traffic is ONE
+        payload over one link (O(bytes) — replaces the round-1
+        gang-allgather placeholder, which moved world*bytes). Reference:
+        the NCCL send/recv pair (nccl_collective_group.py p2p)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        if src == dst:
+            return np.asarray(x)
+        xj = jnp.asarray(x)
+        src_dev = self._devices[src]
+        dst_dev = self._devices[dst]
+        pair = Mesh(np.array([src_dev, dst_dev]), ("pair",))
+        sharding = NamedSharding(pair, P("pair"))
+        local = jax.device_put(
+            xj[None] if self._rank == src else jnp.zeros_like(xj)[None],
+            src_dev if self._rank == src else dst_dev)
+        garr = jax.make_array_from_single_device_arrays(
+            (2,) + xj.shape, sharding, [local])
+        key = ("p2p", src, dst, xj.shape, str(xj.dtype))
+
+        def build():
+            fn = shard_map(
+                lambda t: lax.ppermute(t, "pair", [(0, 1)]),
+                mesh=pair, in_specs=P("pair"), out_specs=P("pair"))
+            return jax.jit(fn)
+
+        out = self._jit(key, build)(garr)
+        if self._rank == dst:
+            # Local shard is (1, *shape) — the pair-axis block.
+            return np.asarray(out.addressable_shards[0].data)[0]
+        return None
+
     def send(self, tensor, opts: SendOptions):
-        """P2P send (reference collective.py:531). Implemented as a gang op:
-        all ranks enter, dst reads the gathered slice — correct though not
-        minimal-traffic; a ppermute fast path lands with the pipeline layer."""
-        self.allgather(np.asarray(tensor))
+        """P2P send (reference collective.py:531). Only src and dst enter
+        (pairwise program); traffic is one payload over one link."""
+        self._p2p(np.asarray(tensor), self._rank, opts.dst_rank)
         return None
 
     def recv(self, shape_dtype_or_tensor, opts: RecvOptions):
@@ -242,8 +353,7 @@ class XLAGroup(BaseGroup):
             template = _np.zeros(shape, dtype=dtype)
         else:
             template = _np.asarray(shape_dtype_or_tensor)
-        gathered = self.allgather(template)
-        return gathered[opts.src_rank]
+        return self._p2p(template, opts.src_rank, self._rank)
 
     def destroy_group(self):
         self._jit_cache.clear()
