@@ -47,6 +47,8 @@ class ObjectEntry:
     nested_ids: List[ObjectID] = field(default_factory=list)
     pending_free: bool = False
     event: threading.Event = field(default_factory=threading.Event)
+    # One-shot ready callbacks (async awaiters); fired outside the lock.
+    callbacks: List[Callable[[], None]] = field(default_factory=list)
 
 
 @dataclass
@@ -103,12 +105,30 @@ class ObjectDirectory:
                 e.nested_ids.extend(nested_ids)
             e.event.set()
             pending_free = e.pending_free
+            waiters, e.callbacks = e.callbacks, []
         for cb in self._on_ready:
             cb(oid)
+        for cb in waiters:
+            try:
+                cb()
+            except Exception:
+                pass
         if pending_free:
             self.decref(oid, 0)  # re-run free logic
 
+    def add_ready_callback(self, oid: ObjectID, cb: Callable[[], None]):
+        """Invoke `cb()` once the object is ready (immediately if it
+        already is / no longer exists) — the async-await hook: awaiters
+        register a loop wakeup instead of parking a thread in get()."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None and not e.event.is_set():
+                e.callbacks.append(cb)
+                return
+        cb()
+
     def mark_lost(self, oid: ObjectID):
+        waiters = []
         with self._lock:
             e = self._entries.get(oid)
             if e is not None:
@@ -119,6 +139,12 @@ class ObjectDirectory:
                 # ObjectRecoveryManager kicks on fetch of a lost object).
                 # Recovery's register_pending() re-clears the event.
                 e.event.set()
+                waiters, e.callbacks = e.callbacks, []
+        for cb in waiters:
+            try:
+                cb()
+            except Exception:
+                pass
 
     def mark_node_lost(self, node_id_hex: str,
                        relocate: Optional[Callable] = None
@@ -129,6 +155,7 @@ class ObjectDirectory:
         a replacement location (e.g. a copy already pulled to the head)
         to keep the entry READY. Returns the ids actually lost."""
         lost: List[ObjectID] = []
+        waiters: List[Callable] = []
         with self._lock:
             for oid, e in self._entries.items():
                 loc = e.location
@@ -142,7 +169,16 @@ class ObjectDirectory:
                     e.state = LOST
                     e.location = None
                     e.event.set()
+                    # Async awaiters must wake too (they observe LOST via
+                    # the get() in their resolution path).
+                    ws, e.callbacks = e.callbacks, []
+                    waiters.extend(ws)
                     lost.append(oid)
+        for cb in waiters:
+            try:
+                cb()
+            except Exception:
+                pass
         return lost
 
     def entry(self, oid: ObjectID) -> Optional[ObjectEntry]:

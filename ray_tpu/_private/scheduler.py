@@ -205,10 +205,20 @@ class NodeRegistry:
         rows = []
         for e in self.entries():
             t, a = e.rm.snapshot()
-            rows.append({"node_id": e.node_id_hex, "alive": e.alive,
-                         "is_head": e.is_head, "resources_total": t,
-                         "resources_available": a,
-                         "start_time": e.start_time})
+            row = {"node_id": e.node_id_hex, "alive": e.alive,
+                   "is_head": e.is_head, "resources_total": t,
+                   "resources_available": a,
+                   "start_time": e.start_time}
+            if e.daemon is not None:
+                # Syncer-lite (reference: ray_syncer.h resource-view
+                # gossip): the daemon's heartbeat carries its local load;
+                # the head is the single scheduler, so this is the
+                # observability face, not a second source of truth.
+                row["hostname"] = e.daemon.hostname
+                row["last_heartbeat"] = e.daemon.last_ping
+                row.update({f"load_{k}": v
+                            for k, v in (e.daemon.load or {}).items()})
+            rows.append(row)
         return rows
 
 

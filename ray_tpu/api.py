@@ -67,9 +67,25 @@ class ObjectRef:
         return self._id
 
     def future(self):
-        """Return a concurrent.futures.Future resolving to the value."""
+        """Return a concurrent.futures.Future resolving to the value.
+
+        Driver: resolved via an object-directory ready callback (no
+        parked thread per in-flight future — Serve holds thousands).
+        Worker/client contexts fall back to a waiter thread."""
         from concurrent.futures import Future
         fut: Future = Future()
+
+        rt = state.get_node()
+        objects = getattr(getattr(rt, "gcs", None), "objects", None)
+        if objects is not None:
+            def _on_ready():
+                try:
+                    fut.set_result(get(self))
+                except BaseException as e:  # noqa: BLE001
+                    fut.set_exception(e)
+
+            objects.add_ready_callback(self._id, _on_ready)
+            return fut
 
         def _resolve():
             try:
@@ -83,7 +99,37 @@ class ObjectRef:
     def __await__(self):
         import asyncio
         loop = asyncio.get_event_loop()
-        return loop.run_in_executor(None, lambda: get(self)).__await__()
+        rt = state.get_node()
+        add_cb = getattr(getattr(rt, "gcs", None), "objects", None)
+        if rt is None or add_cb is None:
+            # Worker/client context: readiness lives across the pipe.
+            return loop.run_in_executor(
+                None, lambda: get(self)).__await__()
+
+        # Driver: register a ready callback instead of parking an
+        # executor thread per in-flight await (async Serve proxies hold
+        # thousands of these).
+        fut = loop.create_future()
+
+        def _on_ready():
+            def _finish():
+                if not fut.cancelled():
+                    fut.set_result(None)
+            try:
+                loop.call_soon_threadsafe(_finish)
+            except RuntimeError:
+                pass  # loop closed
+
+        add_cb.add_ready_callback(self._id, _on_ready)
+
+        def _gen():
+            yield from fut.__await__()
+            # Ready: the get below is non-blocking for local objects
+            # (remote pulls still block briefly; they ride the caller's
+            # loop slice).
+            return get(self)
+
+        return _gen()
 
     def __hash__(self):
         return hash(self._id)

@@ -15,9 +15,16 @@ strategies over jax.sharding + shard_map:
 * sequence.py   — sequence/context parallelism (ring attention driver).
 """
 
-from .mesh import MeshConfig, best_mesh_shape, make_mesh  # noqa: F401
+from .mesh import (  # noqa: F401
+    MeshConfig,
+    best_mesh_shape,
+    make_mesh,
+    make_multislice_mesh,
+    slice_count,
+)
 from .partition import (  # noqa: F401
     PartitionRules,
+    dcn_rules,
     fsdp_rules,
     logical_to_mesh_axes,
     tp_rules,

@@ -68,6 +68,18 @@ def fsdp_rules() -> PartitionRules:
     ])
 
 
+def dcn_rules(base: PartitionRules = None) -> PartitionRules:
+    """Multi-slice data parallelism: the batch shards over BOTH the DCN
+    slice axis and the in-slice dp axis, so XLA reduces gradients
+    hierarchically — ring all-reduce over ICI within each slice, then
+    one cross-slice all-reduce over DCN per step (the only traffic that
+    crosses the slow links; scaling-book multi-slice recipe). Use with
+    ``make_multislice_mesh``."""
+    return (base or tp_rules()).with_overrides([
+        ("batch", ("dp_dcn", "dp")),
+    ])
+
+
 def logical_to_mesh_axes(param_logical: Dict[str, Sequence[Optional[str]]],
                          rules: PartitionRules):
     """Map a pytree-of-logical-axes dict to a dict of PartitionSpecs."""

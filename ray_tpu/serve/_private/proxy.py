@@ -1,18 +1,19 @@
-"""HTTP proxy: routes requests to application ingress deployments.
+"""HTTP proxy: async event-loop server routing to ingress deployments.
 
-Reference: python/ray/serve/_private/proxy.py:752 (HTTPProxy),
-proxy_request (:418) — per-node proxy matching routes by longest prefix
-and forwarding to a DeploymentHandle; the route table is pushed from the
-controller over long-poll.
+Reference: python/ray/serve/_private/proxy.py:752 (HTTPProxy) — an ASGI
+event-loop proxy, NOT a thread-per-request server; proxy_request (:418)
+matches routes by longest prefix and forwards to a DeploymentHandle; the
+route table is pushed from the controller over long-poll.
 
-Implementation: a ThreadingHTTPServer in the driver process (stdlib-only;
-the image bakes no ASGI server). Each request thread blocks on the
-handle's DeploymentResponse, which is fine — the proxy is control-plane;
-replica compute is where TPU time goes.
-"""
+Implementation: aiohttp web server on a dedicated event loop.
+Request handling is fully async — the handle's DeploymentResponse is
+awaited (ObjectRef.__await__), so thousands of in-flight requests cost
+coroutines, not threads, and slow replicas exert natural backpressure on
+the loop instead of unbounded thread growth (the round-1
+ThreadingHTTPServer weakness)."""
+import asyncio
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from .long_poll import LongPollClient
@@ -91,96 +92,164 @@ class _ProxyState:
         self._long_poll.stop()
 
 
-def _make_handler(proxy_state: _ProxyState):
-    class Handler(BaseHTTPRequestHandler):
-        def log_message(self, *a):  # silence per-request stderr spam
-            pass
-
-        def _respond(self, code: int, body, content_type="application/json"):
-            if isinstance(body, (dict, list)):
-                payload = json.dumps(body).encode()
-            elif isinstance(body, str):
-                payload = body.encode()
-                content_type = "text/plain"
-            elif isinstance(body, bytes):
-                payload = body
-                content_type = "application/octet-stream"
-            else:
-                payload = json.dumps({"result": repr(body)}).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", content_type)
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-
-        def _serve(self):
-            if self.path == "/-/healthz":
-                return self._respond(200, "success")
-            if self.path == "/-/routes":
-                with proxy_state._lock:
-                    return self._respond(
-                        200, {p: t[0] for p, t in
-                              proxy_state._routes.items()})
-            target = proxy_state.match(self.path.split("?")[0])
-            if target is None:
-                return self._respond(404, {"error": "no route"})
-            app, deployment = target
-            length = int(self.headers.get("Content-Length") or 0)
-            raw = self.rfile.read(length) if length else b""
-            try:
-                body = json.loads(raw) if raw else None
-            except Exception:
-                body = raw.decode(errors="replace")
-            request = {"path": self.path, "method": self.command,
-                       "body": body}
-            try:
-                handle = proxy_state.handle_for(deployment, app)
-                rg = handle.options(stream=True).remote(request)
-                if not rg.is_stream(timeout_s=60.0):
-                    return self._respond(200,
-                                         rg.single_result(timeout_s=60.0))
-            except Exception as e:
-                return self._respond(500, {"error": str(e)})
-            # Chunked transfer: one chunk per generator item (reference:
-            # streaming responses through the proxy, proxy.py over ASGI).
-            # Headers are already on the wire once streaming starts, so a
-            # mid-stream failure can only truncate the chunked body (no
-            # terminating 0-chunk) — never emit a second status line.
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
-            try:
-                for item in rg:
-                    chunk = item if isinstance(item, bytes) else (
-                        item if isinstance(item, str)
-                        else json.dumps(item)).encode()
-                    self.wfile.write(
-                        f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
-                self.wfile.write(b"0\r\n\r\n")
-            except Exception:
-                self.close_connection = True
-
-        do_GET = do_POST = do_PUT = do_DELETE = _serve
-
-    return Handler
+def _encode_body(body):
+    if isinstance(body, (dict, list)):
+        return json.dumps(body).encode(), "application/json"
+    if isinstance(body, str):
+        return body.encode(), "text/plain"
+    if isinstance(body, bytes):
+        return body, "application/octet-stream"
+    return json.dumps({"result": repr(body)}).encode(), "application/json"
 
 
 class HTTPProxy:
-    """Proxy server lifecycle (reference: proxy.py HTTPProxy)."""
+    """Async proxy server lifecycle (reference: proxy.py HTTPProxy)."""
 
     def __init__(self, controller, host: str = "127.0.0.1",
                  port: int = 8000):
         self._state = _ProxyState(controller)
-        self._server = ThreadingHTTPServer(
-            (host, port), _make_handler(self._state))
-        self.host, self.port = self._server.server_address[:2]
+        self._modes: Dict[str, str] = {}  # deployment -> unary | stream
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._start_error = None
+        self.host, self.port = host, port
+        self._runner = None
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
+            target=self._run, args=(host, port), daemon=True,
             name="serve-http-proxy")
         self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("serve proxy failed to start in 30s")
+        if self._start_error is not None:
+            raise self._start_error
+
+    # -- server thread -------------------------------------------------
+    def _run(self, host: str, port: int):
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._start(host, port))
+        except BaseException as e:  # surface bind errors to __init__
+            self._start_error = e
+            self._started.set()
+            self._loop.close()
+            return
+        self._loop.run_forever()
+        self._loop.run_until_complete(self._loop.shutdown_asyncgens())
+        self._loop.close()
+
+    async def _start(self, host: str, port: int):
+        from aiohttp import web
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        for s in self._runner.sites:
+            sock = s._server.sockets[0]
+            self.host, self.port = sock.getsockname()[:2]
+            break
+        self._started.set()
+
+    async def _handle(self, request):
+        from aiohttp import web
+        path = request.path
+        if path == "/-/healthz":
+            return web.Response(text="success")
+        if path == "/-/routes":
+            with self._state._lock:
+                return web.json_response(
+                    {p: t[0] for p, t in self._state._routes.items()})
+        target = self._state.match(path)
+        if target is None:
+            return web.json_response({"error": "no route"}, status=404)
+        app_name, deployment = target
+        raw = await request.read()
+        try:
+            body = json.loads(raw) if raw else None
+        except Exception:
+            body = raw.decode(errors="replace")
+        req = {"path": request.path_qs, "method": request.method,
+               "body": body}
+        handle = self._state.handle_for(deployment, app_name)
+        loop = asyncio.get_running_loop()
+        # Unary fast path: one plain actor call instead of the streaming
+        # generator machinery (3 messages + 2 result waits). The replica
+        # raises StreamingResponseRequired when the handler actually
+        # streams; the verdict is cached per deployment.
+        mode_key = (app_name, deployment)
+        mode = self._modes.get(mode_key, "unary")
+        if mode == "unary":
+            try:
+                # assign_request can block (replica ready-wait, queue
+                # probes) — keep it off the event loop; the response
+                # await itself is callback-based.
+                resp = await loop.run_in_executor(
+                    None, lambda: handle.remote(req))
+                result = await resp
+                payload, ctype = _encode_body(result)
+                return web.Response(body=payload, content_type=ctype)
+            except Exception as e:
+                if "StreamingResponseRequired" not in repr(e):
+                    return web.json_response({"error": str(e)},
+                                             status=500)
+                self._modes[mode_key] = "stream"
+        try:
+            rg = await loop.run_in_executor(
+                None, lambda: handle.options(stream=True).remote(req))
+            # is_stream blocks on the first generator item; keep the
+            # event loop free.
+            is_stream = await loop.run_in_executor(
+                None, lambda: rg.is_stream(timeout_s=60.0))
+            if not is_stream:
+                result = await loop.run_in_executor(
+                    None, lambda: rg.single_result(timeout_s=60.0))
+                payload, ctype = _encode_body(result)
+                return web.Response(body=payload, content_type=ctype)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+        # Streaming: one chunk per generator item (reference: streaming
+        # responses through the proxy over ASGI).
+        resp = web.StreamResponse()
+        resp.content_type = "text/plain"
+        await resp.prepare(request)
+        it = iter(rg)
+
+        def _next():
+            try:
+                return next(it)
+            except StopIteration:
+                return _SENTINEL
+        try:
+            while True:
+                item = await asyncio.get_running_loop().run_in_executor(
+                    None, _next)
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, bytes):
+                    chunk = item
+                elif isinstance(item, str):
+                    chunk = item.encode()
+                else:
+                    chunk = json.dumps(item).encode()
+                await resp.write(chunk)
+        except Exception:
+            pass  # mid-stream failure: truncate, never a second status
+        await resp.write_eof()
+        return resp
 
     def stop(self):
         self._state.stop()
-        self._server.shutdown()
-        self._server.server_close()
+        if self._runner is not None:
+            async def _cleanup():
+                await self._runner.cleanup()
+            fut = asyncio.run_coroutine_threadsafe(_cleanup(), self._loop)
+            try:
+                fut.result(timeout=10)
+            except Exception:
+                pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+
+_SENTINEL = object()

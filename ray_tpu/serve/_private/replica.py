@@ -16,6 +16,11 @@ from typing import Any, Dict, Optional
 import ray_tpu
 
 
+class StreamingResponseRequired(Exception):
+    """The handler returned a generator on the unary call path; the
+    caller must retry via handle_request_streaming."""
+
+
 class Replica:
     """User-code host (reference: replica.py UserCallableWrapper)."""
 
@@ -58,10 +63,22 @@ class Replica:
                 target = self._callable  # function deployment
             else:
                 target = getattr(self._callable, method_name)
+            if inspect.isgeneratorfunction(target) or \
+                    inspect.isasyncgenfunction(target):
+                # Statically streaming: refuse BEFORE executing so the
+                # streaming retry doesn't double-run side effects.
+                raise StreamingResponseRequired(self._deployment_name)
             if inspect.iscoroutinefunction(target):
-                return await target(*args, **kwargs)
-            return await asyncio.get_event_loop().run_in_executor(
-                None, lambda: target(*args, **kwargs))
+                result = await target(*args, **kwargs)
+            else:
+                result = await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: target(*args, **kwargs))
+            if inspect.isgenerator(result) or inspect.isasyncgen(result):
+                # Caller used the non-streaming path on a streaming
+                # handler; tell it to retry via handle_request_streaming
+                # (the proxy caches the verdict per deployment).
+                raise StreamingResponseRequired(self._deployment_name)
+            return result
         finally:
             self._ongoing -= 1
 

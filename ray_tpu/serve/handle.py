@@ -98,17 +98,66 @@ class _Router:
             self._replicas = list(replicas)
             self._inflight = {i: self._inflight.get(i, 0)
                               for i in range(len(self._replicas))}
+            self._qlen_base = {}
+            self._qlen_ts = {}
         if self._replicas:
             self._ready.set()
         else:
             self._ready.clear()
 
-    def _pick(self) -> int:
+    _PROBE_TTL_S = 0.1
+
+    def _replica_score(self, idx: int, now: float) -> float:
+        """Replica load = last probed queue length + requests THIS router
+        sent since the probe (reference: pow_2_scheduler.py:52 replica
+        queue-length probes with caching). The probe sees ALL routers'
+        traffic, which router-local inflight counts alone cannot."""
+        base = getattr(self, "_qlen_base", {}).get(idx)
+        if base is None:
+            return float(self._inflight.get(idx, 0))
+        return base + self._inflight.get(idx, 0)
+
+    def _maybe_probe(self, candidates: List[int]):
+        """Refresh stale queue-length probes for the sampled candidates
+        (outside the lock; one RPC pair at most every _PROBE_TTL_S)."""
+        import time as _time
+        now = _time.monotonic()
+        with self._lock:
+            stale = [i for i in candidates
+                     if now - getattr(self, "_qlen_ts", {}).get(i, 0.0)
+                     > self._PROBE_TTL_S]
+            reps = {i: self._replicas[i] for i in stale
+                    if i < len(self._replicas)}
+            for i in stale:
+                # Mark probed first: concurrent requests must not stampede
+                # the same replica with probe RPCs while ours is in flight.
+                self._qlen_ts.setdefault(i, 0.0)
+                self._qlen_ts[i] = now
+        if not reps:
+            return
+        refs = {i: r.get_queue_len.remote() for i, r in reps.items()}
+        for i, ref in refs.items():
+            try:
+                qlen = ray_tpu.get(ref, timeout=2.0)
+            except Exception:
+                continue  # unreachable replica: fall back to local count
+            with self._lock:
+                if i in self._inflight:
+                    # Probe reflects work in flight cluster-wide NOW;
+                    # future local sends add on top.
+                    self._qlen_base = getattr(self, "_qlen_base", {})
+                    self._qlen_base[i] = float(qlen) - self._inflight.get(
+                        i, 0)
+
+    def _pick(self, candidates: Optional[List[int]] = None) -> int:
+        import time as _time
         n = len(self._replicas)
         if n == 1:
             return 0
-        a, b = random.sample(range(n), 2)
-        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+        a, b = candidates or random.sample(range(n), 2)
+        now = _time.monotonic()
+        return a if self._replica_score(a, now) <= \
+            self._replica_score(b, now) else b
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
                        timeout_s: float = 30.0, stream: bool = False):
@@ -117,7 +166,15 @@ class _Router:
                 f"No replicas of '{self._deployment}' became available "
                 f"within {timeout_s}s")
         with self._lock:
-            idx = self._pick()
+            n = len(self._replicas)
+        candidates = random.sample(range(n), 2) if n > 1 else None
+        if candidates is not None:
+            self._maybe_probe(candidates)
+        with self._lock:
+            if candidates is not None and any(
+                    i >= len(self._replicas) for i in candidates):
+                candidates = None  # replica set changed under us
+            idx = self._pick(candidates)
             replica = self._replicas[idx]
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
         if stream:

@@ -222,3 +222,57 @@ class TestPipelineTraining:
             seq_losses.append(float(ls))
         assert pipe_losses[-1] < pipe_losses[0] * 0.5
         np.testing.assert_allclose(pipe_losses, seq_losses, rtol=1e-4)
+
+
+class TestMultiSlice:
+    """DCN / multi-slice mesh: slices emulated as contiguous CPU device
+    groups (SURVEY §4 CPU-mirror); batch shards over (dp_dcn, dp) so the
+    gradient reduction is hierarchical (ICI within slice, DCN across)."""
+
+    def test_multislice_train_step_matches_single_mesh(self):
+        import dataclasses
+
+        from ray_tpu.models import GPTConfig, make_train_step
+        from ray_tpu.models.gpt import shard_batch
+        from ray_tpu.parallel import (
+            MeshConfig,
+            dcn_rules,
+            make_mesh,
+            make_multislice_mesh,
+            tp_rules,
+        )
+
+        cfg = dataclasses.replace(GPTConfig.tiny(), remat=False)
+        tokens = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (8, 32), dtype=np.int32)
+        batch_np = (tokens, np.roll(tokens, -1, axis=1))
+
+        # 2 emulated slices x (dp=2, tp=2)
+        ms_mesh = make_multislice_mesh(
+            MeshConfig(dp=2, tp=2), devices=jax.devices()[:8],
+            num_slices=2)
+        assert ms_mesh.shape["dp_dcn"] == 2
+        init_ms, step_ms = make_train_step(cfg, mesh=ms_mesh,
+                                           rules=dcn_rules())
+        state_ms = init_ms(jax.random.PRNGKey(0))
+        batch_ms = shard_batch(
+            tuple(jnp.asarray(x) for x in batch_np), ms_mesh,
+            axis=("dp_dcn", "dp"))
+        state_ms, m_ms = step_ms(state_ms, batch_ms)
+
+        # same model on a flat single-slice mesh
+        flat = make_mesh(MeshConfig(dp=4, tp=2),
+                         devices=jax.devices()[:8])
+        init_f, step_f = make_train_step(cfg, mesh=flat,
+                                         rules=tp_rules())
+        state_f = init_f(jax.random.PRNGKey(0))
+        batch_f = shard_batch(
+            tuple(jnp.asarray(x) for x in batch_np), flat)
+        state_f, m_f = step_f(state_f, batch_f)
+
+        np.testing.assert_allclose(float(m_ms["loss"]),
+                                   float(m_f["loss"]), rtol=1e-5)
+
+    def test_slice_count_cpu_is_one(self):
+        from ray_tpu.parallel import slice_count
+        assert slice_count() == 1
