@@ -141,6 +141,8 @@ class NativeStore:
         return b
 
     def put(self, object_id, data) -> int:
+        if not self._h:
+            raise RuntimeError("store closed")
         data = bytes(data) if not isinstance(data, (bytes, bytearray,
                                                     memoryview)) else data
         buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
@@ -154,6 +156,8 @@ class NativeStore:
 
     def create(self, object_id, size: int) -> memoryview:
         """Two-phase create: returns a writable view; call seal() after."""
+        if not self._h:
+            raise RuntimeError("store closed")
         off = self._lib.rt_store_create_obj(self._h, self._key(object_id),
                                             size)
         if off == -2:
@@ -163,12 +167,16 @@ class NativeStore:
         return self._view[off:off + size]
 
     def seal(self, object_id):
+        if not self._h:
+            return
         if self._lib.rt_store_seal(self._h, self._key(object_id)) != 0:
             raise KeyError("seal: object not in CREATED state")
 
     def get(self, object_id) -> memoryview:
         """Zero-copy read view; pins the object (call release() when
         done, plasma client semantics)."""
+        if not self._h:
+            raise KeyError("store closed")
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         rc = self._lib.rt_store_get(self._h, self._key(object_id),
@@ -178,29 +186,37 @@ class NativeStore:
         return self._view[off.value:off.value + size.value]
 
     def contains(self, object_id) -> bool:
+        if not self._h:
+            return False
         return bool(self._lib.rt_store_contains(self._h,
                                                 self._key(object_id)))
 
     def release(self, object_id):
+        # Pins can outlive an explicit close() (live zero-copy views at
+        # shutdown); a released handle must be a no-op, not a segfault.
+        if not self._h:
+            return
         self._lib.rt_store_release(self._h, self._key(object_id))
 
     def delete(self, object_id):
+        if not self._h:
+            return
         rc = self._lib.rt_store_delete(self._h, self._key(object_id))
         if rc == -2:
             raise RuntimeError("object pinned by a reader")
 
     # -- stats -------------------------------------------------------------
     def used_bytes(self) -> int:
-        return self._lib.rt_store_used(self._h)
+        return self._lib.rt_store_used(self._h) if self._h else 0
 
     def capacity(self) -> int:
-        return self._lib.rt_store_capacity(self._h)
+        return self._lib.rt_store_capacity(self._h) if self._h else 0
 
     def num_objects(self) -> int:
-        return self._lib.rt_store_num_objects(self._h)
+        return self._lib.rt_store_num_objects(self._h) if self._h else 0
 
     def evictions(self) -> int:
-        return self._lib.rt_store_evictions(self._h)
+        return self._lib.rt_store_evictions(self._h) if self._h else 0
 
     def close(self, unlink: bool = False):
         if self._h:

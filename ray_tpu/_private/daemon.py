@@ -61,9 +61,10 @@ class NodeDaemon:
             on_worker_death=self._on_worker_death,
             node_id_hex=self.node_hex)
         from .config import ray_config
+        paths_for, view_for = store_paths_factory(self.store)
         self.transfer = TransferServer(
-            store_paths_factory(self.store), token,
-            host=str(ray_config.node_host))
+            paths_for, token, host=str(ray_config.node_host),
+            view_for=view_for)
         self.pull_mgr = PullManager(
             self.store, token,
             max_concurrent=int(ray_config.pull_max_concurrent))

@@ -44,8 +44,12 @@ class TransferServer:
     reference: ObjectManager server side + PushManager chunking)."""
 
     def __init__(self, paths_for: Callable[[bytes], List[str]],
-                 authkey: bytes, host: str = "0.0.0.0", port: int = 0):
+                 authkey: bytes, host: str = "0.0.0.0", port: int = 0,
+                 view_for: Optional[Callable] = None):
         self._paths_for = paths_for
+        # Arena-backed stores have no per-object file: view_for returns
+        # a pinned zero-copy memoryview instead (released after send).
+        self._view_for = view_for
         self._authkey = authkey
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -99,7 +103,15 @@ class TransferServer:
             except OSError:
                 continue
         if fd is None:
-            conn.sendall(struct.pack(">Q", _NOT_FOUND))
+            view = self._view_for(oid) if self._view_for else None
+            if view is None:
+                conn.sendall(struct.pack(">Q", _NOT_FOUND))
+                return
+            try:
+                conn.sendall(struct.pack(">Q", len(view)))
+                conn.sendall(view)
+            finally:
+                view.release()
             return
         try:
             size = os.fstat(fd).st_size
@@ -266,20 +278,27 @@ class PullManager:
             c.close()
 
 
-def store_paths_factory(store) -> Callable[[bytes], List[str]]:
-    """Candidate file paths (shm, then spill) for an object id in a
-    file-per-object store."""
+def store_paths_factory(store):
+    """(paths_for, view_for) serving hooks for either store backend:
+    file-per-object stores serve via sendfile (shm file, then spill
+    file); the arena store serves a pinned zero-copy view (spill files
+    still go through the file path)."""
     from .ids import ObjectID
 
-    def paths_for(oid_bytes: bytes) -> List[str]:
-        oid = ObjectID(oid_bytes)
-        out = []
-        path = getattr(store, "_path", None)
-        spill = getattr(store, "_spill_path", None)
-        if path is not None:
-            out.append(path(oid))
-        if spill is not None:
-            out.append(spill(oid))
-        return out
+    file_path = getattr(store, "_path", None)
+    if callable(file_path):
+        def paths_for(oid_bytes: bytes) -> List[str]:
+            oid = ObjectID(oid_bytes)
+            return [store._path(oid), store._spill_path(oid)]
+        return paths_for, None
 
-    return paths_for
+    def spill_paths_for(oid_bytes: bytes) -> List[str]:
+        return [store._spill_path(ObjectID(oid_bytes))]
+
+    def view_for(oid_bytes: bytes):
+        try:
+            return store._pinned_view(ObjectID(oid_bytes))
+        except KeyError:
+            return None
+
+    return spill_paths_for, view_for

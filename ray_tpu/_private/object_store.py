@@ -382,18 +382,59 @@ class ObjectStore:
             shutil.rmtree(self._spill_dir, ignore_errors=True)
 
 
+class _ArenaPin:
+    """Owns one reader pin on an arena object (plasma client-pin
+    semantics): buffers deserialized zero-copy from the arena keep this
+    object alive through the memoryview chain, and the pin releases when
+    the last view is garbage-collected — only then may the slot be
+    deleted/recycled (PEP 688 buffer protocol)."""
+
+    __slots__ = ("_native", "_key", "_view", "_released")
+
+    def __init__(self, native, key: bytes, view):
+        self._native = native
+        self._key = key
+        self._view = view
+        self._released = False
+
+    def __buffer__(self, flags):
+        return memoryview(self._view)
+
+    def __release_buffer__(self, view):
+        pass
+
+    def __del__(self):
+        if not self._released:
+            self._released = True
+            try:
+                self._view.release()
+                self._native.release(self._key)
+            except Exception:
+                pass
+
+
 class ArenaObjectStore:
-    """Native-arena backend (opt-in: RAY_TPU_NATIVE_STORE=1).
+    """Native-arena backend (the DEFAULT store when the C++ lib builds).
 
     Backed by the C++ plasma-equivalent (_native/src/store.cpp): one
     shared mmap arena + process-shared allocator instead of a file per
-    object — one mmap syscall total instead of one per object, which is
-    the many-small-objects win. Tradeoff: reads COPY out of the arena
-    (the file-per-object store reads zero-copy and relies on the OS
-    keeping unlinked pages alive; arena space is recycled, so aliasing
-    views into it would be unsafe). Owner refcounting pins every object
-    until free(), so the arena's LRU eviction never reclaims a tracked
-    object out from under the GCS.
+    object. Puts memcpy into already-faulted pages — measured 6.0 GB/s
+    vs 2.1 GB/s for fresh-tmpfs-file writes on the same host (page
+    allocation, not copying, dominates the file store's put path; the
+    raw single-core memcpy ceiling is 7.9 GB/s, so the reference's
+    18.5 GB/s single-client figure — measured on a 64-vCPU host — is
+    not reachable on this hardware class; see ROUND2_NOTES).
+
+    Reads are ZERO-COPY with pin-until-release: deserialized arrays
+    alias the arena through an _ArenaPin buffer owner, and the reader
+    pin drops when the last view dies — so recycling a slot can never
+    invalidate live views (the round-1 wrapper copied instead).
+
+    Spill/restore (reference: LocalObjectManager): the OWNER process
+    spills LRU sealed objects to a disk directory when the arena fills,
+    and any process restores by falling back to the deterministic spill
+    path — same contract as the file store, so the memory monitor and
+    OOM tests work unchanged.
     """
 
     def __init__(self, session_dir: str, capacity: Optional[int] = None):
@@ -401,6 +442,7 @@ class ArenaObjectStore:
         os.makedirs(session_dir, exist_ok=True)
         self._path = os.path.join(session_dir, "arena.shm")
         self._capacity = capacity or _default_capacity()
+        self._spill_dir = session_dir.rstrip("/") + "_spill"
         try:
             self._store = _native.NativeStore(
                 self._path, self._capacity, create=True)
@@ -408,83 +450,267 @@ class ArenaObjectStore:
         except (RuntimeError, FileExistsError):
             self._store = _native.NativeStore(self._path, create=False)
             self._owner = False
+        self._lock = threading.RLock()
+        # Owner-side metadata for spill candidacy (the native header has
+        # no enumeration API): oid -> size, plus an LRU clock.
+        self._meta: Dict[ObjectID, int] = {}
+        self._access: Dict[ObjectID, int] = {}
+        self._clock = 0
+        self._pending_delete: list = []
+        self._spilled_bytes = 0
+        self._spilled_count = 0
+        self._restored_count = 0
 
+    # -- paths ------------------------------------------------------------
+    def _spill_path(self, object_id: ObjectID) -> str:
+        return os.path.join(self._spill_dir, object_id.hex())
+
+    @property
     def used_bytes(self) -> int:
         return self._store.used_bytes()
 
+    @property
     def capacity(self) -> int:
         return self._store.capacity()
+
+    # -- write path -------------------------------------------------------
+    def _track(self, object_id: ObjectID, size: int):
+        with self._lock:
+            self._clock += 1
+            self._meta[object_id] = size
+            self._access[object_id] = self._clock
+
+    def create(self, object_id: ObjectID, size: int):
+        """Writable view for a two-phase write (seal after); used by the
+        puller and put_serialized."""
+        self._collect_pending()
+        try:
+            view = self._store.create(object_id, size)
+        except MemoryError:
+            with self._lock:
+                self._spill_locked(size)
+            try:
+                view = self._store.create(object_id, size)
+            except MemoryError as e:
+                raise ObjectStoreFullError(
+                    f"Object of {size} bytes does not fit: "
+                    f"{self.used_bytes}/{self.capacity} arena bytes used "
+                    f"({self._spilled_bytes} spilled).") from e
+        self._track(object_id, size)
+        return view
+
+    def seal(self, object_id: ObjectID):
+        self._store.seal(object_id)
+
+    def _abort_reserve(self, object_id: ObjectID):
+        with self._lock:
+            self._meta.pop(object_id, None)
+            self._access.pop(object_id, None)
+        try:
+            self._store.release(object_id)
+            self._store.delete(object_id)
+        except Exception:
+            pass
 
     def put_serialized(self, object_id: ObjectID,
                        sobj: serialization.SerializedObject) -> int:
         size = sobj.total_size
-        try:
-            view = self._store.create(object_id, size)
-        except MemoryError as e:
-            raise ObjectStoreFullError(str(e)) from e
+        view = self.create(object_id, size)
         try:
             sobj.write_into(view)
-        finally:
+        except BaseException:
             view.release()
-        self._store.seal(object_id)
-        # creator pin retained: owner-driven free() is the only reclaim
+            self._abort_reserve(object_id)
+            raise
+        view.release()
+        self.seal(object_id)
+        # creator pin retained: owner-driven free()/spill is the reclaim
         return size
 
     def put(self, object_id: ObjectID, value: Any) -> int:
         return self.put_serialized(object_id, serialization.serialize(value))
 
+    # -- spill path -------------------------------------------------------
+    def _spill_locked(self, need_bytes: int) -> int:
+        """Copy LRU sealed objects out to disk and delete them from the
+        arena until `need_bytes` are reclaimable (callers hold _lock).
+        Objects pinned by live reader views are skipped."""
+        from .config import ray_config
+        if not bool(ray_config.object_spilling_enabled):
+            return 0
+        candidates = sorted(
+            ((self._access.get(oid, 0), oid, size)
+             for oid, size in self._meta.items()
+             if size >= int(ray_config.min_spilling_size)),
+            key=lambda t: t[0])
+        os.makedirs(self._spill_dir, exist_ok=True)
+        reclaimed = 0
+        for _, oid, size in candidates:
+            if reclaimed >= need_bytes:
+                break
+            try:
+                view = self._store.get(oid)  # takes a pin
+            except KeyError:
+                # Created-but-unsealed (a writer is mid two-phase put):
+                # not spillable NOW, but must stay tracked.
+                continue
+            dst = self._spill_path(oid)
+            tmp = dst + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(view)
+                os.rename(tmp, dst)
+            except OSError:
+                view.release()
+                self._store.release(oid)
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                continue
+            view.release()
+            self._store.release(oid)   # our read pin
+            self._store.release(oid)   # the creator pin
+            try:
+                self._store.delete(oid)
+            except RuntimeError:
+                # Reader still pinning: keep it resident, drop the copy.
+                try:
+                    os.unlink(dst)
+                except OSError:
+                    pass
+                # re-take the creator pin we dropped
+                try:
+                    v = self._store.get(oid)
+                    v.release()
+                except KeyError:
+                    pass
+                continue
+            self._meta.pop(oid, None)
+            self._access.pop(oid, None)
+            self._spilled_bytes += size
+            self._spilled_count += 1
+            reclaimed += size
+        return reclaimed
+
+    def spill_objects(self, target_bytes: int) -> int:
+        with self._lock:
+            used = self.used_bytes
+            if used <= target_bytes:
+                return 0
+            return self._spill_locked(used - target_bytes)
+
+    # -- read path --------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
-        return self._store.contains(object_id)
+        return (self._store.contains(object_id)
+                or os.path.exists(self._spill_path(object_id)))
+
+    def _pinned_view(self, object_id: ObjectID):
+        view = self._store.get(object_id)  # pins
+        pin = _ArenaPin(self._store, _native_key(object_id), view)
+        with self._lock:
+            self._clock += 1
+            if object_id in self._access:
+                self._access[object_id] = self._clock
+        return memoryview(pin)
 
     def get(self, object_id: ObjectID) -> Any:
-        view = self._store.get(object_id)
         try:
-            data = bytes(view)  # copy: arena pages are recycled on free
-        finally:
-            view.release()
-            self._store.release(object_id)
-        return serialization.deserialize(memoryview(data))
+            view = self._pinned_view(object_id)
+        except KeyError:
+            # Not arena-resident: spilled (or gone — surfaces as OSError)
+            view = self._restore_view(object_id)
+        return serialization.deserialize(view)
 
-    def get_raw(self, object_id: ObjectID) -> memoryview:
-        view = self._store.get(object_id)
+    def get_raw(self, object_id: ObjectID):
         try:
-            data = bytes(view)
+            return self._pinned_view(object_id)
+        except KeyError:
+            return self._restore_view(object_id)
+
+    def _restore_view(self, object_id: ObjectID):
+        """Read a spilled object from disk (page-cache mmap; not
+        re-admitted to the arena)."""
+        import mmap as _mmap
+        path = self._spill_path(object_id)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            mm = _mmap.mmap(fd, os.path.getsize(path))
         finally:
-            view.release()
-            self._store.release(object_id)
-        return memoryview(data)
+            os.close(fd)
+        with self._lock:
+            self._restored_count += 1
+        return memoryview(mm)
 
     def adopt(self, object_id: ObjectID, size: int):
-        # Accounting lives in the shared arena header; nothing to adopt.
-        pass
+        """Owner-side tracking for a segment a worker created (arena
+        accounting is shared; this records spill candidacy)."""
+        self._track(object_id, size)
 
+    # -- free path --------------------------------------------------------
     def free(self, object_id: ObjectID):
+        with self._lock:
+            self._meta.pop(object_id, None)
+            self._access.pop(object_id, None)
+        try:
+            os.unlink(self._spill_path(object_id))
+        except OSError:
+            pass
         try:
             self._store.release(object_id)  # drop creator pin
             self._store.delete(object_id)
-        except (KeyError, RuntimeError):
+        except KeyError:
             pass
+        except RuntimeError:
+            # Live reader views pin the slot; retry on later activity.
+            with self._lock:
+                self._pending_delete.append(object_id)
+
+    def _collect_pending(self):
+        with self._lock:
+            pending, self._pending_delete = self._pending_delete, []
+        for oid in pending:
+            try:
+                self._store.delete(oid)
+            except KeyError:
+                pass
+            except RuntimeError:
+                with self._lock:
+                    self._pending_delete.append(oid)
 
     def release(self, object_id: ObjectID):
-        pass  # reads copy; nothing stays pinned
-
-    def spill_objects(self, target_bytes: int) -> int:
-        return 0  # arena backend relies on its own LRU eviction
+        pass  # reader pins are view-lifetime (_ArenaPin)
 
     def stats(self) -> Dict[str, int]:
-        return {"used_bytes": self._store.used_bytes(),
-                "capacity": self._store.capacity(),
-                "spilled_bytes": 0, "spilled_count": 0,
-                "restored_count": 0, "num_objects": 0}
+        with self._lock:
+            return {"used_bytes": self.used_bytes,
+                    "capacity": self.capacity,
+                    "spilled_bytes": self._spilled_bytes,
+                    "spilled_count": self._spilled_count,
+                    "restored_count": self._restored_count,
+                    "num_objects": self._store.num_objects()}
 
     def shutdown(self):
+        import shutil
         self._store.close(unlink=self._owner)
+        if self._owner:
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+            shutil.rmtree(os.path.dirname(self._path),
+                          ignore_errors=True)
+
+
+def _native_key(object_id: ObjectID) -> bytes:
+    return object_id.binary()
 
 
 def create_store(session_dir: str, capacity: Optional[int] = None):
-    """Pick the store backend (native arena when RAY_TPU_NATIVE_STORE=1
-    and the C++ lib builds; file-per-object otherwise)."""
-    if os.environ.get("RAY_TPU_NATIVE_STORE") == "1":
+    """Pick the store backend: the native C++ arena by DEFAULT (2x put
+    bandwidth — page reuse instead of per-put tmpfs page allocation),
+    falling back to the file-per-object store where the native lib can't
+    build. RAY_TPU_FILE_STORE=1 forces the fallback."""
+    import sys
+    if (os.environ.get("RAY_TPU_FILE_STORE") != "1"
+            and sys.version_info >= (3, 12)):  # _ArenaPin needs PEP 688
         try:
             from .. import _native
             if _native.available():

@@ -211,9 +211,10 @@ class Node:
         token_hex = os.environ.get("RAY_TPU_CLUSTER_TOKEN_HEX", "")
         self.cluster_token = (bytes.fromhex(token_hex) if token_hex
                               else os.urandom(16))
+        paths_for, view_for = store_paths_factory(self.store)
         self.transfer_server = TransferServer(
-            store_paths_factory(self.store), self.cluster_token,
-            host=str(ray_config.node_host))
+            paths_for, self.cluster_token,
+            host=str(ray_config.node_host), view_for=view_for)
         self.transfer_port = self.transfer_server.port
         self.pull_mgr = PullManager(
             self.store, self.cluster_token,

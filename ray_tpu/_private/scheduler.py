@@ -554,7 +554,12 @@ class WorkerPool:
                     raise RuntimeError(
                         f"worker process failed to connect within "
                         f"{boot_timeout:g}s")
-        listener.close()
+        try:
+            # A concurrent shutdown may have swept the session dir; the
+            # unlink inside close() must not kill a prestart thread.
+            listener.close()
+        except OSError:
+            pass
         try:
             os.unlink(address)
         except OSError:
@@ -972,7 +977,10 @@ class Scheduler:
     def prestart(self, n: int):
         """Warm the pool (reference: worker_pool.cc prestart)."""
         def _start():
-            h = self.pool.start_worker("")
+            try:
+                h = self.pool.start_worker("")
+            except Exception:
+                return  # shutdown raced the prestart
             self.pool.push_idle(h)
             self.notify_worker_free()
         with self._lock:

@@ -22,6 +22,18 @@ from ._private.ids import ActorID, ObjectID, TaskID, object_id_for_return
 from .exceptions import TaskError
 
 _init_lock = threading.Lock()
+_future_pool = None
+
+
+def _future_resolver():
+    """Shared small pool that materializes future() values off the
+    runtime's dispatch threads."""
+    global _future_pool
+    if _future_pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _future_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="ref-future")
+    return _future_pool
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "get", "put",
@@ -78,11 +90,17 @@ class ObjectRef:
         rt = state.get_node()
         objects = getattr(getattr(rt, "gcs", None), "objects", None)
         if objects is not None:
-            def _on_ready():
+            def _resolve_now():
                 try:
                     fut.set_result(get(self))
                 except BaseException as e:  # noqa: BLE001
                     fut.set_exception(e)
+
+            def _on_ready():
+                # NEVER deserialize on the runtime's completion-dispatch
+                # thread (the ready callback fires there): hand the get
+                # to the resolver pool.
+                _future_resolver().submit(_resolve_now)
 
             objects.add_ready_callback(self._id, _on_ready)
             return fut

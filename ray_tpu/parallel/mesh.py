@@ -141,13 +141,18 @@ def make_multislice_mesh(config: Optional[MeshConfig] = None,
     ici_shape_map = config.resolve(per_slice)
     names = (dcn_axis,) + tuple(AXIS_ORDER)
     ici_shape = tuple(ici_shape_map.get(a, 1) for a in AXIS_ORDER)
-    try:
+    real_slices = all(hasattr(d, "slice_index") for d in devices)
+    if real_slices:
+        # Real multi-slice hardware: slice-aware ordering is mandatory —
+        # a shape error here must SURFACE (a silent contiguous reshape
+        # would cut dp_dcn groups across physical slices and route
+        # in-slice collectives over DCN).
         from jax.experimental import mesh_utils
         dev_array = mesh_utils.create_hybrid_device_mesh(
             ici_shape, (n_slices,) + (1,) * len(AXIS_ORDER),
             devices=devices)
-    except Exception:
-        # CPU/test fallback: contiguous groups act as slices.
+    else:
+        # CPU/test backend: contiguous groups act as slices.
         dev_array = np.array(devices).reshape((n_slices,) + ici_shape)
     return Mesh(dev_array, names)
 

@@ -190,7 +190,8 @@ class HTTPProxy:
                 payload, ctype = _encode_body(result)
                 return web.Response(body=payload, content_type=ctype)
             except Exception as e:
-                if "StreamingResponseRequired" not in repr(e):
+                # TaskError carries the remote class name in its message.
+                if "StreamingResponseRequired" not in f"{e!r}{e}":
                     return web.json_response({"error": str(e)},
                                              status=500)
                 self._modes[mode_key] = "stream"

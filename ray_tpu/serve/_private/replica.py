@@ -74,9 +74,15 @@ class Replica:
                 result = await asyncio.get_event_loop().run_in_executor(
                     None, lambda: target(*args, **kwargs))
             if inspect.isgenerator(result) or inspect.isasyncgen(result):
-                # Caller used the non-streaming path on a streaming
-                # handler; tell it to retry via handle_request_streaming
-                # (the proxy caches the verdict per deployment).
+                # Caller used the non-streaming path on a handler that
+                # DYNAMICALLY returned a generator; tell it to retry via
+                # handle_request_streaming (the proxy caches the verdict
+                # per deployment). KNOWN LIMITATION: the handler body has
+                # already run once here, so side effects execute twice
+                # for this one transition request — same as the
+                # reference's requirement that streaming handlers be
+                # declared, minus the declaration. Statically detectable
+                # generators are refused before execution above.
                 raise StreamingResponseRequired(self._deployment_name)
             return result
         finally:

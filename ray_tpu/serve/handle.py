@@ -136,11 +136,11 @@ class _Router:
         if not reps:
             return
         refs = {i: r.get_queue_len.remote() for i, r in reps.items()}
-        for i, ref in refs.items():
-            try:
-                qlen = ray_tpu.get(ref, timeout=2.0)
-            except Exception:
-                continue  # unreachable replica: fall back to local count
+        try:
+            qlens = ray_tpu.get(list(refs.values()), timeout=2.0)
+        except Exception:
+            return  # unreachable replica(s): fall back to local counts
+        for i, qlen in zip(refs, qlens):
             with self._lock:
                 if i in self._inflight:
                     # Probe reflects work in flight cluster-wide NOW;

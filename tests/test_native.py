@@ -143,12 +143,12 @@ def test_transfer_between_arenas(tmp_path):
 
 
 def test_cluster_with_native_store(tmp_path):
-    """Full runtime on the arena backend: tasks, large objects, actors
-    (the e2e check that the backend honors the store contract)."""
+    """Full runtime on the arena backend — the DEFAULT store since r2:
+    tasks, large objects, actors (the e2e check that the backend honors
+    the store contract). RAY_TPU_FILE_STORE=1 forces the fallback."""
     import subprocess
     code = """
 import os
-os.environ["RAY_TPU_NATIVE_STORE"] = "1"
 os.environ["JAX_PLATFORMS"] = "cpu"
 import numpy as np
 import ray_tpu
@@ -179,3 +179,52 @@ print("native-cluster-ok")
     out = subprocess.run([sys.executable, "-c", code],
                          capture_output=True, text=True, timeout=180)
     assert "native-cluster-ok" in out.stdout, out.stderr[-3000:]
+
+
+def test_arena_zero_copy_pinned_reads(tmp_path):
+    """Reads alias the arena (no copy) and pin the slot until the last
+    view dies — recycling can't invalidate live arrays (VERDICT r1 #10:
+    'make reads pin-until-release instead of copy')."""
+    import numpy as np
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ArenaObjectStore
+
+    store = ArenaObjectStore(str(tmp_path / "arena"), capacity=64 << 20)
+    try:
+        oid = ObjectID.from_random()
+        src = np.arange(1_000_000, dtype=np.float64)
+        store.put(oid, src)
+        out = store.get(oid)
+        assert out[-1] == 999_999.0
+        # Zero-copy: the array's buffer lives inside the arena mapping.
+        assert not out.flags["OWNDATA"]
+        # Pin: free() while a view is live must not invalidate it.
+        store.free(oid)
+        assert float(out.sum()) == float(src.sum())
+    finally:
+        del out
+        store.shutdown()
+
+
+def test_arena_spill_and_restore(tmp_path):
+    """Arena overflow spills LRU objects to disk and restores them on
+    read (same contract as the file store; reference:
+    LocalObjectManager spill/restore)."""
+    import numpy as np
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ArenaObjectStore
+
+    store = ArenaObjectStore(str(tmp_path / "arena"), capacity=2 << 20)
+    try:
+        oids = [ObjectID.from_random() for _ in range(4)]
+        for oid in oids:
+            store.put(oid, np.zeros(300 * 1024, dtype=np.uint8))
+        st = store.stats()
+        assert st["spilled_count"] >= 1, st
+        for oid in oids:
+            assert store.get(oid).nbytes == 300 * 1024
+        assert store.stats()["restored_count"] >= 1
+    finally:
+        store.shutdown()
