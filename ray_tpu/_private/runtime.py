@@ -899,8 +899,83 @@ class Node:
         self._actors[spec.actor_id] = st
         self._pin_task_args(spec)
         unresolved = self._unresolved_deps(spec)
+        if spec.lifetime == "detached":
+            self._persist_detached(spec)
         self.scheduler.submit(spec, unresolved)
         return entry
+
+    # ------------------------------------------------------------------
+    # detached-actor persistence (reference: GCS fault tolerance —
+    # gcs_client_reconnection_test.cc; actor table persisted so a
+    # restarted GCS re-schedules actors whose processes are gone. Here
+    # the head restart respawns detached actors from their persisted
+    # specs; in-memory actor state follows the same
+    # restart-after-node-failure semantics as the reference.)
+    # ------------------------------------------------------------------
+    _DETACHED_NS = "_detached_actors"
+
+    def _kv_durable(self) -> bool:
+        return isinstance(self.gcs.kv, gcs_mod.SqliteKvStore)
+
+    def _persist_detached(self, spec: P.ActorSpec):
+        if not self._kv_durable():
+            return
+        # ObjectRef arguments reference objects of THIS session — they
+        # cannot resolve after a head restart, so such specs are not
+        # recoverable (the respawn would park forever on dead deps).
+        has_refs = any(
+            a.kind == "ref"
+            for a in list(spec.args) + list(spec.kwargs.values()))
+        if has_refs:
+            import warnings
+            warnings.warn(
+                f"Detached actor {spec.name or spec.actor_id.hex()} takes "
+                f"ObjectRef arguments; it will NOT be respawned after a "
+                f"head restart (refs don't survive the session).",
+                stacklevel=3)
+            return
+        import cloudpickle
+        try:
+            self.gcs.kv.put(spec.actor_id.hex(), cloudpickle.dumps(spec),
+                            namespace=self._DETACHED_NS)
+        except Exception:
+            pass
+
+    def _unpersist_detached(self, actor_id: ActorID):
+        if not self._kv_durable():
+            return
+        try:
+            self.gcs.kv.delete(actor_id.hex(),
+                               namespace=self._DETACHED_NS)
+        except Exception:
+            pass
+
+    def recover_detached_actors(self) -> int:
+        """Respawn detached actors persisted by a previous head with the
+        same RAY_TPU_GCS_STORAGE_PATH (called by api.init AFTER the
+        runtime is registered as current, so actor creation can resolve
+        argument refs). Returns the number respawned."""
+        if not self._kv_durable():
+            return 0
+        import cloudpickle
+        count = 0
+        for key in self.gcs.kv.keys(namespace=self._DETACHED_NS):
+            raw = self.gcs.kv.get(key, namespace=self._DETACHED_NS)
+            if not raw:
+                continue
+            try:
+                spec: P.ActorSpec = cloudpickle.loads(raw)
+                if self.gcs.actors.get(spec.actor_id) is not None:
+                    continue  # already alive in this session
+                self.create_actor(spec)
+                count += 1
+            except Exception:
+                import traceback
+                print(f"[ray_tpu] failed to respawn detached actor "
+                      f"{key}:\n{traceback.format_exc()}",
+                      flush=True)
+                continue
+        return count
 
     def _dispatch_actor_creation(self, spec: P.ActorSpec,
                                  worker: Optional[WorkerHandle]):
@@ -941,6 +1016,8 @@ class Node:
     def _fail_actor(self, st: _ActorState, error_blob: bytes, cause: str):
         self.gcs.actors.set_dead(st.spec.actor_id, cause,
                                  creation_error=error_blob)
+        if st.spec.lifetime == "detached":
+            self._unpersist_detached(st.spec.actor_id)
         with st.lock:
             st.dead = True
             pending = list(st.queue)

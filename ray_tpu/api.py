@@ -201,6 +201,15 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
                     resources=resources, namespace=namespace,
                     object_store_memory=object_store_memory)
         state.set_node(node)
+        # Detached actors persisted by a previous head (same durable GCS
+        # path) respawn now — after the runtime is current, so creation
+        # machinery works (no-op without RAY_TPU_GCS_STORAGE_PATH).
+        try:
+            node.recover_detached_actors()
+        except Exception:
+            import traceback
+            print("[ray_tpu] detached-actor recovery failed:\n"
+                  + traceback.format_exc(), flush=True)
         if log_to_driver:
             node.log_monitor.start()
         if prestart_workers is None:

@@ -109,10 +109,71 @@ class Channel:
                 _session_chan_dir(), f"chan_{uuid.uuid4().hex}")
             self._shm = _MapFile(name, self._payload_off + buffer_size,
                                  create=True)
+            # Event FIFOs: version bumps / acks WAKE the other side
+            # instead of it spin-sleeping (the round-1 backoff cost up to
+            # 1 ms latency per hop on idle channels). FIFOs (not
+            # eventfds) because channels attach from other processes by
+            # PATH. Data still rides the shm seqlock; FIFOs are hints.
+            try:
+                for i in range(self.num_readers):
+                    os.mkfifo(f"{name}.w{i}")
+                os.mkfifo(f"{name}.ack")
+            except OSError:
+                pass
         else:
             self._shm = _MapFile(name)
         self.name = name
         self._seen = 0
+        self._wake_rd = None    # reader: read end of its wake fifo
+        self._wake_wr = {}      # writer: write ends of reader wake fifos
+        self._ack_rd = None     # writer: read end of the ack fifo
+        self._ack_wr = None     # reader: write end of the ack fifo
+
+    # -- event-fifo plumbing (all best-effort; fall back to polling) -----
+    @staticmethod
+    def _open_nb(path: str, flags: int):
+        try:
+            return os.open(path, flags | os.O_NONBLOCK)
+        except OSError:
+            return None
+
+    def _signal(self, fd_holder, path: str, write_flags=os.O_WRONLY):
+        fd = fd_holder[0] if fd_holder[0] is not None else self._open_nb(
+            path, write_flags)
+        if fd is None:
+            return None
+        fd_holder[0] = fd
+        try:
+            os.write(fd, b"x")
+        except BlockingIOError:
+            pass  # pipe full: wakeups already pending
+        except OSError:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            fd_holder[0] = None
+        return fd_holder[0]
+
+    @staticmethod
+    def _wait_fd(fd, timeout: float) -> bool:
+        """Wait for a wakeup byte. Returns False when the fd hit EOF
+        (every writer closed its end) — callers must stop selecting on
+        it, or the persistent-EOF readability would busy-spin a core."""
+        import select
+        try:
+            r, _, _ = select.select([fd], [], [], timeout)
+            if r:
+                try:
+                    if os.read(fd, 4096) == b"":
+                        return False  # EOF: no writers remain
+                except BlockingIOError:
+                    pass
+                except OSError:
+                    return False
+        except (OSError, ValueError):
+            time.sleep(min(timeout, 1e-3))
+        return True
 
     # -- handle passing ----------------------------------------------------
     def __reduce__(self):
@@ -147,12 +208,22 @@ class Channel:
                 f"({cap}B); recreate the DAG with a larger buffer_size")
         version = self._version()
         deadline = None if timeout is None else time.monotonic() + timeout
-        delay = 1e-5
+        if self._ack_rd is None:
+            self._ack_rd = self._open_nb(f"{self.name}.ack", os.O_RDONLY)
         while any(self._ack_of(i) < version for i in range(self.num_readers)):
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("channel readers stalled")
-            time.sleep(delay)
-            delay = min(delay * 2, 1e-3)
+            wait = 0.05 if deadline is None else max(
+                1e-4, min(0.05, deadline - time.monotonic()))
+            if self._ack_rd is not None:
+                if not self._wait_fd(self._ack_rd, wait):
+                    try:
+                        os.close(self._ack_rd)
+                    except OSError:
+                        pass
+                    self._ack_rd = None
+            else:
+                time.sleep(min(wait, 1e-3))
         self._shm.buf[self._payload_off:self._payload_off + len(blob)] = blob
         # Publish length BEFORE version as separate aligned 8-byte
         # stores: packing both in one 16-byte memcpy lets a reader catch
@@ -160,11 +231,17 @@ class Channel:
         # read under load). The version store is the release barrier.
         struct.pack_into("<Q", self._shm.buf, 8, len(blob))
         struct.pack_into("<Q", self._shm.buf, 0, version + 1)
+        # Wake every reader blocked on its fifo.
+        for i in range(self.num_readers):
+            holder = self._wake_wr.setdefault(i, [None])
+            self._signal(holder, f"{self.name}.w{i}")
 
     def read(self, timeout: Optional[float] = None):
         """Block for the next value after the last one this reader saw."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        delay = 1e-5
+        if self._wake_rd is None:
+            self._wake_rd = self._open_nb(
+                f"{self.name}.w{self.reader_index}", os.O_RDONLY)
         while True:
             version, length = _HEADER.unpack_from(self._shm.buf, 0)
             if version > self._seen:
@@ -179,14 +256,27 @@ class Channel:
                 break
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("channel read timed out")
-            time.sleep(delay)
-            delay = min(delay * 2, 1e-3)
+            wait = 0.05 if deadline is None else max(
+                1e-4, min(0.05, deadline - time.monotonic()))
+            if self._wake_rd is not None:
+                if not self._wait_fd(self._wake_rd, wait):
+                    try:
+                        os.close(self._wake_rd)
+                    except OSError:
+                        pass
+                    self._wake_rd = None
+            else:
+                time.sleep(min(wait, 1e-3))
         value = serialization.loads(
             bytes(self._shm.buf[self._payload_off:
                                 self._payload_off + length]))
         self._seen = version
         struct.pack_into("<Q", self._shm.buf,
                          self._acks_off + 8 * self.reader_index, version)
+        # Wake a writer blocked on acks.
+        if self._ack_wr is None:
+            self._ack_wr = [None]
+        self._signal(self._ack_wr, f"{self.name}.ack")
         if value is _CLOSE or (isinstance(value, _CloseSentinel)):
             raise ChannelClosedError()
         return value
@@ -198,11 +288,37 @@ class Channel:
         except Exception:
             pass
 
+    def _close_fds(self):
+        fds = [self._wake_rd, self._ack_rd]
+        fds += [h[0] for h in self._wake_wr.values()]
+        if self._ack_wr:
+            fds.append(self._ack_wr[0])
+        for fd in fds:
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        self._wake_rd = self._ack_rd = None
+        self._wake_wr = {}
+        self._ack_wr = None
+
     def destroy(self):
+        self._close_fds()
         self._shm.close()
         self._shm.unlink()
+        for i in range(self.num_readers):
+            try:
+                os.unlink(f"{self.name}.w{i}")
+            except OSError:
+                pass
+        try:
+            os.unlink(f"{self.name}.ack")
+        except OSError:
+            pass
 
     def detach(self):
+        self._close_fds()
         self._shm.close()
 
 

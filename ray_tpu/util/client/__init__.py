@@ -9,10 +9,15 @@ and holds ClientObjectRef/ClientActorHandle ids. Device data never
 crosses this link — only host args/results (the reference has the same
 property: the client is control-plane).
 
+Auth: the channel is pickle-based, so connections authenticate with the
+per-cluster random token (printed by `ray_tpu start`, or
+`state.current().cluster_token.hex()` in the head process).
+
 Server:  from ray_tpu.util.client import server
          server.serve("127.0.0.1", 20001)          # in-cluster process
 Client:  import ray_tpu.util.client as client
-         conn = client.connect("127.0.0.1:20001")
+         conn = client.connect("127.0.0.1:20001", token="<token hex>")
+         # (or set RAY_TPU_CLUSTER_TOKEN_HEX and omit token=)
          ref = conn.remote(fn).remote(args)
          conn.get(ref)
 """

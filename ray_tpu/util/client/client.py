@@ -9,14 +9,32 @@ import cloudpickle
 
 from .common import (ClientActorClass, ClientActorHandle, ClientObjectRef,
                      ClientRemoteFunction)
-from .server import AUTHKEY
+
+
+def _resolve_token(token) -> bytes:
+    if token is not None:
+        return bytes.fromhex(token) if isinstance(token, str) else token
+    import os
+    env = os.environ.get("RAY_TPU_CLUSTER_TOKEN_HEX")
+    if env:
+        return bytes.fromhex(env)
+    # Same-process fallback: a driver that also hosts the cluster.
+    from ..._private import state
+    rt = state.get_node()
+    t = getattr(rt, "cluster_token", None)
+    if t is not None:
+        return t
+    raise RuntimeError(
+        "connecting to a ray_tpu cluster requires its token: pass "
+        "token=..., or set RAY_TPU_CLUSTER_TOKEN_HEX (printed by "
+        "`ray_tpu start`)")
 
 
 class ClientConnection:
-    def __init__(self, address: str):
+    def __init__(self, address: str, token=None):
         host, port = address.rsplit(":", 1)
         self._conn = _MpClient((host, int(port)), family="AF_INET",
-                               authkey=AUTHKEY)
+                               authkey=_resolve_token(token))
         self._lock = threading.Lock()
         # Refs released by ClientObjectRef.__del__ queue here and piggyback
         # on the next request: __del__ can fire from cyclic GC *inside*
@@ -107,6 +125,9 @@ class ClientConnection:
             pass
 
 
-def connect(address: str) -> ClientConnection:
-    """Reference: ray.init("ray://host:port") client-mode entry."""
-    return ClientConnection(address)
+def connect(address: str, token=None) -> ClientConnection:
+    """Reference: ray.init("ray://host:port") client-mode entry.
+    `token`: the cluster token hex (or bytes) printed by `ray_tpu
+    start`; defaults to RAY_TPU_CLUSTER_TOKEN_HEX or the in-process
+    cluster's token."""
+    return ClientConnection(address, token=token)

@@ -125,13 +125,15 @@ def test_client_server_roundtrip():
 def test_client_from_separate_process():
     """The real thing: a different PROCESS drives the cluster through
     the client server."""
+    from ray_tpu._private import state
     from ray_tpu.util import client as client_mod
     host, port = client_mod.server.serve("127.0.0.1", 0)
+    token_hex = state.current().cluster_token.hex()
     code = f"""
 import sys
 sys.path.insert(0, {repr(sys.path[0])})
 from ray_tpu.util import client
-conn = client.connect("{host}:{port}")
+conn = client.connect("{host}:{port}", token="{token_hex}")
 rf = conn.remote(lambda x: x ** 2)
 print("result:", conn.get(rf.remote(9)))
 conn.close()

@@ -246,3 +246,52 @@ class TestGcsPersistence:
             g.kv.close()
         finally:
             ray_config.set("gcs_storage_path", "")
+
+
+class TestDetachedActorRecovery:
+    """GCS fault-tolerance step (reference: GCS restart with Redis
+    persistence, gcs_client_reconnection_test.cc): detached actors
+    persisted in the durable KV respawn when a new head starts with the
+    same storage path — the same restart-after-failure semantics the
+    reference applies to actors whose processes died with a node."""
+
+    def test_detached_actor_respawns_after_head_restart(self, tmp_path):
+        import subprocess
+        import sys
+        path = str(tmp_path / "gcs.sqlite")
+        code1 = f"""
+import os
+os.environ["RAY_TPU_GCS_STORAGE_PATH"] = {path!r}
+os.environ["JAX_PLATFORMS"] = "cpu"
+import ray_tpu
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote
+class Registry:
+    def __init__(self, tag):
+        self.tag = tag
+    def tag_of(self):
+        return self.tag
+
+a = Registry.options(name="reg", lifetime="detached").remote("v1")
+assert ray_tpu.get(a.tag_of.remote()) == "v1"
+ray_tpu.shutdown()
+print("phase1 ok")
+"""
+        code2 = f"""
+import os
+os.environ["RAY_TPU_GCS_STORAGE_PATH"] = {path!r}
+os.environ["JAX_PLATFORMS"] = "cpu"
+import ray_tpu
+ray_tpu.init(num_cpus=2)
+a = ray_tpu.get_actor("reg")
+assert ray_tpu.get(a.tag_of.remote()) == "v1"
+ray_tpu.kill(a)
+ray_tpu.shutdown()
+print("phase2 ok")
+"""
+        for code, marker in ((code1, "phase1 ok"), (code2, "phase2 ok")):
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=180)
+            assert marker in out.stdout, out.stderr[-2000:]
