@@ -83,6 +83,10 @@ class RayConfig:
         # Pull admission control: concurrent cross-node object pulls
         # (reference: pull_manager.h in-flight bytes cap).
         "pull_max_concurrent": 4,
+        # Infeasible tasks fail fast by default; an active autoscaler
+        # raises this so demand can park while capacity is launched
+        # (reference: infeasible queue + autoscaler demand satisfaction).
+        "infeasible_task_grace_s": 0.0,
         # CPU-pool workers boot python -S (skip sitecustomize's eager
         # jax/TPU-plugin import, ~5s per process). Disable if user code
         # depends on site customizations inside CPU workers.

@@ -924,7 +924,7 @@ class Node:
         # cannot resolve after a head restart, so such specs are not
         # recoverable (the respawn would park forever on dead deps).
         has_refs = any(
-            a.kind == "ref"
+            a.kind == "ref" or a.nested_ids
             for a in list(spec.args) + list(spec.kwargs.values()))
         if has_refs:
             import warnings

@@ -667,6 +667,7 @@ class Scheduler:
         self._cond = threading.Condition(self._lock)
         self._ready: Deque[P.TaskSpec] = collections.deque()
         self._waiting: Dict[ObjectID, List[PendingTask]] = {}
+        self._infeasible_since: Dict[bytes, float] = {}
         self._cancelled: Set[bytes] = set()
         ncpu = os.cpu_count() or 4
         self._max_workers = max_workers or max(ncpu, 4)
@@ -774,6 +775,7 @@ class Scheduler:
     def try_cancel(self, task_id: TaskID) -> bool:
         """Remove a queued task; returns True if it had not been dispatched."""
         with self._cond:
+            self._infeasible_since.pop(task_id.binary(), None)
             for i, spec in enumerate(self._ready):
                 if spec.task_id == task_id:
                     del self._ready[i]
@@ -852,9 +854,24 @@ class Scheduler:
         demand = spec.resources
         is_actor_creation = isinstance(spec, P.ActorSpec)
         if not self.nodes.feasible(demand):
-            # Infeasible forever: surface as task error via dispatch_fn(None).
+            # Infeasible NOW. With an active autoscaler the demand is its
+            # upscale signal, so the task parks for the grace window
+            # (reference: the infeasible queue feeding
+            # resource_demand_scheduler); without one (grace 0, the
+            # default) fail fast via dispatch_fn(None).
+            from .config import ray_config
+            grace = float(ray_config.infeasible_task_grace_s)
+            key = self._spec_key(spec)
+            if grace > 0:
+                import time as _time
+                first = self._infeasible_since.setdefault(
+                    key, _time.monotonic())
+                if _time.monotonic() - first < grace:
+                    return False  # requeue; autoscaler may add capacity
+            self._infeasible_since.pop(key, None)
             self._dispatch_fn(spec, None)
             return True
+        self._infeasible_since.pop(self._spec_key(spec), None)
         node_id = self.nodes.acquire(demand)
         if node_id is None:
             return False

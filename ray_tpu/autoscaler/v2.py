@@ -1,0 +1,380 @@
+"""Autoscaler v2: GCS-driven instance manager over REAL node daemons.
+
+Reference parity: python/ray/autoscaler/v2/ — the v2 redesign where the
+autoscaler is a reconciler around an InstanceManager with an explicit
+per-instance lifecycle (instance_manager/), reading resource demand
+straight from the GCS (autoscaler.proto) instead of scraping logs, and
+where "a node" is a first-class instance record moving through
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING -> RAY_STOPPING
+                                                        -> TERMINATED
+
+Here an instance IS a per-host node daemon (_private/daemon.py):
+scale-up launches a real daemon process that registers with the head
+over TCP and adds schedulable capacity; scale-down drains and stops it.
+`DaemonInstanceProvider` runs daemons as local subprocesses (the
+fake-multinode pattern with REAL raylet-equivalents — SURVEY §4
+mechanism (a)); cloud deployments swap the provider to launch VMs whose
+startup command is `ray_tpu start --address ... --token-hex ...`.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .config import ClusterConfig, NodeTypeConfig
+from .resource_demand_scheduler import get_nodes_to_launch
+
+# Instance lifecycle (reference: autoscaler/v2/instance_manager/
+# instance_storage.py statuses; trimmed to the states a daemon-backed
+# instance actually passes through).
+_grace_lock = threading.Lock()
+_grace_holders = 0
+_grace_saved = None
+
+
+def _grace_acquire():
+    """Park infeasible demand while ANY autoscaler is live (refcounted;
+    restored when the last one releases — a constructor side effect
+    would leak the override on abandoned managers)."""
+    global _grace_holders, _grace_saved
+    from .._private.config import ray_config
+    with _grace_lock:
+        if _grace_holders == 0:
+            _grace_saved = float(ray_config.infeasible_task_grace_s)
+            ray_config.set("infeasible_task_grace_s", 3600.0)
+        _grace_holders += 1
+
+
+def _grace_release():
+    global _grace_holders, _grace_saved
+    from .._private.config import ray_config
+    with _grace_lock:
+        if _grace_holders == 0:
+            return
+        _grace_holders -= 1
+        if _grace_holders == 0 and _grace_saved is not None:
+            ray_config.set("infeasible_task_grace_s", _grace_saved)
+
+
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+RAY_STOPPING = "RAY_STOPPING"
+TERMINATED = "TERMINATED"
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    instance_type: str
+    status: str = QUEUED
+    node_id_hex: Optional[str] = None
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+    handle: Optional[object] = None  # provider-private
+
+    def transition(self, status: str):
+        self.status = status
+        self.updated_at = time.time()
+
+
+class InstanceProvider:
+    """Allocates/terminates the machines behind instances (reference:
+    v2 instance_manager/cloud_providers/)."""
+
+    def allocate(self, instance: Instance, node_type_config: Dict) -> None:
+        """Start the machine; fill instance.handle. Must be async-fast."""
+        raise NotImplementedError
+
+    def running_node_id(self, instance: Instance) -> Optional[str]:
+        """Node id once the daemon registered with the head, else None."""
+        raise NotImplementedError
+
+    def terminate(self, instance: Instance) -> None:
+        raise NotImplementedError
+
+
+class DaemonInstanceProvider(InstanceProvider):
+    """Instances are real daemon subprocesses on this machine."""
+
+    def __init__(self):
+        from .._private import state
+        self._rt = state.current()
+
+    def allocate(self, instance: Instance, node_type_config: Dict) -> None:
+        import json
+        import os
+        host, port = self._rt.head_server.address
+        env = dict(os.environ)
+        env["RAY_TPU_CLUSTER_TOKEN_HEX"] = self._rt.cluster_token.hex()
+        resources = dict(node_type_config.get("resources", {}))
+        num_cpus = resources.pop("CPU", 1)
+        num_tpus = resources.pop("TPU", 0)
+        argv = [sys.executable, "-m", "ray_tpu._private.daemon",
+                "--address", f"{host}:{port}",
+                "--num-cpus", str(num_cpus)]
+        if num_tpus:
+            argv += ["--num-tpus", str(num_tpus)]
+        # Tag the node with its instance id so registration is matchable.
+        resources[f"_instance:{instance.instance_id}"] = 1.0
+        argv += ["--resources", json.dumps(resources)]
+        proc = subprocess.Popen(argv, env=env)
+        instance.handle = {"proc": proc}
+
+    def running_node_id(self, instance: Instance) -> Optional[str]:
+        tag = f"_instance:{instance.instance_id}"
+        for node_hex, handle in self._rt.head_server.daemons.items():
+            if tag in (handle.resources or {}):
+                return node_hex
+        proc = (instance.handle or {}).get("proc")
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"daemon instance exited with {proc.returncode} before "
+                f"registering")
+        return None
+
+    def terminate(self, instance: Instance) -> None:
+        handle = self._rt.head_server.daemons.get(
+            instance.node_id_hex or "")
+        asked = False
+        if handle is not None:
+            try:
+                from .._private import protocol as P
+                handle.send(P.SHUTDOWN_NODE, {})
+                asked = True
+            except Exception:
+                pass
+        proc = (instance.handle or {}).get("proc")
+        if proc is None:
+            return
+        try:
+            if asked:
+                proc.wait(timeout=5)
+        except Exception:
+            pass
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                proc.kill()
+
+
+class InstanceManager:
+    """The v2 reconciler: demand (from the GCS view) -> target instance
+    set -> per-instance state machine (reference: v2/autoscaler.py +
+    instance_manager/instance_manager.py)."""
+
+    def __init__(self, node_types: Dict[str, Dict],
+                 provider: Optional[InstanceProvider] = None,
+                 max_workers: int = 8,
+                 idle_timeout_s: float = 60.0):
+        from .._private import state
+        self._rt = state.current()
+        self.node_types = node_types
+        self._config = ClusterConfig(
+            node_types={
+                name: NodeTypeConfig(
+                    name=name, resources=dict(nt.get("resources", {})),
+                    min_workers=int(nt.get("min_workers", 0)),
+                    max_workers=int(nt.get("max_workers", max_workers)))
+                for name, nt in node_types.items()},
+            max_workers=max_workers, idle_timeout_s=idle_timeout_s)
+        self.provider = provider or DaemonInstanceProvider()
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.instances: Dict[str, Instance] = {}
+        self._lock = threading.Lock()
+        # Shared cell, NOT self, so the finalizer holds no strong ref to
+        # the manager (it would never be collected otherwise). Abandoned
+        # managers (no shutdown()) still release the grace override.
+        # Acquired at construction: demand submitted before the first
+        # reconcile must already park instead of failing fast.
+        self._grace_cell = [True]
+        _grace_acquire()
+        import weakref
+        self._finalizer = weakref.finalize(self, _maybe_release,
+                                           self._grace_cell)
+
+    # -- demand view (reference: GCS autoscaler state, autoscaler.proto) --
+    def _cluster_demand(self):
+        try:
+            view = self._rt.gcs_request("resource_demands")
+        except Exception:
+            return [], []
+        demands = list(view.get("demands", []))
+        bundles = []
+        for pg in view.get("placement_groups", []):
+            bundles.extend(pg.get("bundles", []))
+        return demands, bundles
+
+    def _live_instances(self) -> List[Instance]:
+        return [i for i in self.instances.values()
+                if i.status != TERMINATED]
+
+    def _counts_by_type(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for inst in self._live_instances():
+            counts[inst.instance_type] = counts.get(
+                inst.instance_type, 0) + 1
+        return counts
+
+    # -- one reconcile pass -------------------------------------------
+    def reconcile(self) -> Dict[str, int]:
+        """One update: launch for unmet demand, progress lifecycles,
+        terminate idle. Returns {status: count} after the pass."""
+        with self._lock:
+            self._progress_lifecycles()
+            demands, bundles = self._cluster_demand()
+            if demands or bundles:
+                to_launch = get_nodes_to_launch(
+                    demands, bundles, self._counts_by_type(),
+                    self._config)
+                for node_type, count in to_launch.items():
+                    for _ in range(count):
+                        self._queue_instance(node_type)
+                self._launch_queued()
+            # Scale-down runs EVERY pass: standing unsatisfiable demand
+            # must not pin idle nodes (the busy check protects nodes
+            # actually holding work, and satisfiable parked demand would
+            # have been dispatched onto an idle node already).
+            self._terminate_idle()
+            return self.status_counts()
+
+    def _queue_instance(self, node_type: str):
+        inst = Instance(instance_id=uuid.uuid4().hex[:12],
+                        instance_type=node_type)
+        self.instances[inst.instance_id] = inst
+
+    def _launch_queued(self):
+        for inst in self._live_instances():
+            if inst.status == QUEUED:
+                inst.transition(REQUESTED)
+                try:
+                    self.provider.allocate(
+                        inst, self.node_types[inst.instance_type])
+                    inst.transition(ALLOCATED)
+                except Exception:
+                    inst.transition(TERMINATED)
+
+    def _progress_lifecycles(self):
+        for inst in self._live_instances():
+            if inst.status == ALLOCATED:
+                try:
+                    node_hex = self.provider.running_node_id(inst)
+                except Exception:
+                    inst.transition(TERMINATED)
+                    continue
+                if node_hex is not None:
+                    inst.node_id_hex = node_hex
+                    inst.transition(RAY_RUNNING)
+            elif inst.status == RAY_RUNNING:
+                # Instance whose daemon died externally: reconcile out.
+                if inst.node_id_hex not in self._rt.head_server.daemons:
+                    inst.transition(TERMINATED)
+
+    def _node_busy(self, node_id_hex: str) -> bool:
+        entry = self._rt.node_registry.get(node_id_hex)
+        if entry is None:
+            return False
+        totals, avail = entry.rm.snapshot()
+        return any(avail.get(k, 0.0) + 1e-9 < v
+                   for k, v in totals.items())
+
+    def _terminate_idle(self):
+        now = time.time()
+        for inst in self._live_instances():
+            if inst.status != RAY_RUNNING:
+                continue
+            if self._node_busy(inst.node_id_hex):
+                inst.updated_at = now
+                continue
+            if now - inst.updated_at < self.idle_timeout_s:
+                continue
+            inst.transition(RAY_STOPPING)
+            try:
+                self.provider.terminate(inst)
+            finally:
+                inst.transition(TERMINATED)
+
+    def status_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for inst in self.instances.values():
+            counts[inst.status] = counts.get(inst.status, 0) + 1
+        return counts
+
+    def wait_for_running(self, n: int, timeout: float = 120.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.reconcile()
+            running = sum(1 for i in self.instances.values()
+                          if i.status == RAY_RUNNING)
+            if running >= n:
+                return True
+            time.sleep(0.2)
+        return False
+
+    def shutdown(self):
+        if self._grace_cell[0]:
+            self._grace_cell[0] = False
+            _grace_release()
+        with self._lock:
+            for inst in self._live_instances():
+                if inst.status in (ALLOCATED, RAY_RUNNING, RAY_STOPPING):
+                    try:
+                        self.provider.terminate(inst)
+                    except Exception:
+                        pass
+                inst.transition(TERMINATED)
+
+
+def _maybe_release(cell):
+    try:
+        if cell[0]:
+            cell[0] = False
+            _grace_release()
+    except Exception:
+        pass
+
+
+class AutoscalerV2:
+    """Background reconciler (reference: v2/autoscaler.py driven from the
+    monitor process)."""
+
+    def __init__(self, node_types: Dict[str, Dict],
+                 provider: Optional[InstanceProvider] = None,
+                 max_workers: int = 8, idle_timeout_s: float = 60.0,
+                 interval_s: float = 2.0):
+        self.manager = InstanceManager(
+            node_types, provider=provider, max_workers=max_workers,
+            idle_timeout_s=idle_timeout_s)
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler-v2")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.manager.reconcile()
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.manager.shutdown()

@@ -209,7 +209,10 @@ class Channel:
         version = self._version()
         deadline = None if timeout is None else time.monotonic() + timeout
         if self._ack_rd is None:
-            self._ack_rd = self._open_nb(f"{self.name}.ack", os.O_RDONLY)
+            # O_RDWR (Linux semantics): holding our own write end means
+            # the fifo never reports writer-gone EOF, and peers' O_WRONLY
+            # opens can't fail ENXIO before our first wait.
+            self._ack_rd = self._open_nb(f"{self.name}.ack", os.O_RDWR)
         while any(self._ack_of(i) < version for i in range(self.num_readers)):
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("channel readers stalled")
@@ -241,7 +244,7 @@ class Channel:
         deadline = None if timeout is None else time.monotonic() + timeout
         if self._wake_rd is None:
             self._wake_rd = self._open_nb(
-                f"{self.name}.w{self.reader_index}", os.O_RDONLY)
+                f"{self.name}.w{self.reader_index}", os.O_RDWR)
         while True:
             version, length = _HEADER.unpack_from(self._shm.buf, 0)
             if version > self._seen:
