@@ -227,43 +227,52 @@ class InstanceManager:
                 inst.instance_type, 0) + 1
         return counts
 
+    ALLOCATE_TIMEOUT_S = 180.0
+
     # -- one reconcile pass -------------------------------------------
     def reconcile(self) -> Dict[str, int]:
-        """One update: launch for unmet demand, progress lifecycles,
-        terminate idle. Returns {status: count} after the pass."""
+        """One update: launch for unmet demand (and min_workers floors),
+        progress lifecycles, terminate idle. Provider calls (process
+        spawn/terminate, potentially seconds each) run OUTSIDE the lock
+        so launch decisions never serialize behind slow drains."""
         with self._lock:
             self._progress_lifecycles()
             demands, bundles = self._cluster_demand()
-            if demands or bundles:
-                to_launch = get_nodes_to_launch(
-                    demands, bundles, self._counts_by_type(),
-                    self._config)
-                for node_type, count in to_launch.items():
-                    for _ in range(count):
-                        self._queue_instance(node_type)
-                self._launch_queued()
+            # get_nodes_to_launch is called EVERY pass (with possibly
+            # empty demand): it is also what maintains min_workers
+            # floors after terminations.
+            to_launch = get_nodes_to_launch(
+                demands, bundles, self._counts_by_type(), self._config)
+            for node_type, count in to_launch.items():
+                for _ in range(count):
+                    self._queue_instance(node_type)
+            launches = []
+            for inst in self._live_instances():
+                if inst.status == QUEUED:
+                    inst.transition(REQUESTED)
+                    launches.append(inst)
             # Scale-down runs EVERY pass: standing unsatisfiable demand
-            # must not pin idle nodes (the busy check protects nodes
-            # actually holding work, and satisfiable parked demand would
-            # have been dispatched onto an idle node already).
-            self._terminate_idle()
-            return self.status_counts()
+            # must not pin idle nodes; the busy check protects nodes
+            # holding work, min_workers floors are re-launched above.
+            drains = self._pick_idle_for_termination()
+        for inst in launches:
+            try:
+                self.provider.allocate(
+                    inst, self.node_types[inst.instance_type])
+                inst.transition(ALLOCATED)
+            except Exception:
+                inst.transition(TERMINATED)
+        for inst in drains:
+            try:
+                self.provider.terminate(inst)
+            finally:
+                inst.transition(TERMINATED)
+        return self.status_counts()
 
     def _queue_instance(self, node_type: str):
         inst = Instance(instance_id=uuid.uuid4().hex[:12],
                         instance_type=node_type)
         self.instances[inst.instance_id] = inst
-
-    def _launch_queued(self):
-        for inst in self._live_instances():
-            if inst.status == QUEUED:
-                inst.transition(REQUESTED)
-                try:
-                    self.provider.allocate(
-                        inst, self.node_types[inst.instance_type])
-                    inst.transition(ALLOCATED)
-                except Exception:
-                    inst.transition(TERMINATED)
 
     def _progress_lifecycles(self):
         for inst in self._live_instances():
@@ -276,6 +285,16 @@ class InstanceManager:
                 if node_hex is not None:
                     inst.node_id_hex = node_hex
                     inst.transition(RAY_RUNNING)
+                elif (time.time() - inst.created_at
+                      > self.ALLOCATE_TIMEOUT_S):
+                    # Machine up but never registered (bad address,
+                    # network): stop counting it toward capacity so a
+                    # replacement can launch.
+                    try:
+                        self.provider.terminate(inst)
+                    except Exception:
+                        pass
+                    inst.transition(TERMINATED)
             elif inst.status == RAY_RUNNING:
                 # Instance whose daemon died externally: reconcile out.
                 if inst.node_id_hex not in self._rt.head_server.daemons:
@@ -289,8 +308,16 @@ class InstanceManager:
         return any(avail.get(k, 0.0) + 1e-9 < v
                    for k, v in totals.items())
 
-    def _terminate_idle(self):
+    def _pick_idle_for_termination(self) -> List[Instance]:
+        """Select idle instances to drain (callers hold the lock; the
+        provider calls happen outside it). Never drains below a type's
+        min_workers floor."""
         now = time.time()
+        running_by_type: Dict[str, int] = {}
+        for inst in self._live_instances():
+            if inst.status == RAY_RUNNING:
+                running_by_type[inst.instance_type] =                     running_by_type.get(inst.instance_type, 0) + 1
+        picked: List[Instance] = []
         for inst in self._live_instances():
             if inst.status != RAY_RUNNING:
                 continue
@@ -299,11 +326,14 @@ class InstanceManager:
                 continue
             if now - inst.updated_at < self.idle_timeout_s:
                 continue
+            nt = self._config.node_types.get(inst.instance_type)
+            floor = nt.min_workers if nt else 0
+            if running_by_type.get(inst.instance_type, 0) <= floor:
+                continue
+            running_by_type[inst.instance_type] -= 1
             inst.transition(RAY_STOPPING)
-            try:
-                self.provider.terminate(inst)
-            finally:
-                inst.transition(TERMINATED)
+            picked.append(inst)
+        return picked
 
     def status_counts(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}
@@ -367,11 +397,13 @@ class AutoscalerV2:
         return self
 
     def _loop(self):
+        import logging
+        log = logging.getLogger(__name__)
         while not self._stop.wait(self._interval):
             try:
                 self.manager.reconcile()
             except Exception:
-                pass
+                log.exception("autoscaler reconcile failed")
 
     def stop(self):
         self._stop.set()

@@ -10,6 +10,7 @@ from .algorithms.algorithm import Algorithm, AlgorithmConfig
 from .algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from .algorithms.cql import CQL, CQLConfig
 from .algorithms.dqn import DQN, DQNConfig
+from .algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from .algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig, vtrace
 from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig
 from .algorithms.ppo import PPO, PPOConfig
@@ -25,6 +26,7 @@ from .offline import (DatasetReader, ImportanceSamplingEstimator,
 from .utils.replay_buffers import ReplayBuffer
 
 __all__ = ["APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "BC",
+           "DreamerV3", "DreamerV3Config",
            "BCConfig", "DQN",
            "DQNConfig", "DQNModule", "EnvRunnerGroup", "IMPALA",
            "IMPALAConfig", "JaxLearner", "PPO", "PPOConfig", "PPOModule",

@@ -204,6 +204,11 @@ class Algorithm:
         params = self.get_weights()
         returns = []
         for ep in range(num_episodes):
+            # Recurrent modules (DreamerV3) reset rollout state between
+            # episodes on the driver too.
+            hook = getattr(self.module, "on_episode_end", None)
+            if hook is not None:
+                hook()
             obs, _ = env.reset(seed=10_000 + ep)
             done, total = False, 0.0
             while not done:

@@ -1,0 +1,489 @@
+"""DreamerV3 — model-based RL via latent imagination (compact, TPU-native).
+
+Reference parity: rllib/algorithms/dreamerv3/ (the last algorithm family
+of the reference's in-tree set). This is a faithful-but-compact jax
+implementation of the DreamerV3 recipe for vector observations and
+discrete actions:
+
+  * RSSM world model: GRU deterministic state + categorical stochastic
+    latents (straight-through gradients), prior/posterior heads.
+  * Decoder/reward heads in SYMLOG space; Bernoulli continue head.
+  * KL balancing with free bits (dyn 0.5 / rep 0.1 as in the paper).
+  * Actor-critic trained entirely on IMAGINED rollouts from posterior
+    states: lambda-returns, reinforce actor gradient with critic
+    baseline + entropy bonus, EMA return normalizer.
+
+Everything — sequence posterior scan, imagination scan, all three
+optimizers — is one jitted update; on TPU the scans stay on-device and
+the MXU sees batched GRU/MLP matmuls. Omissions vs the full reference
+implementation (documented, not hidden): CNN encoder (vector obs only),
+two-hot critic targets (symlog MSE instead), and the EMA critic
+regularizer.
+"""
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+# ---------------------------------------------------------------------------
+# parameter init / primitive nets (plain pytrees; the house style for
+# self-contained algorithm modules)
+# ---------------------------------------------------------------------------
+def _dense(key, n_in, n_out):
+    k1, _ = jax.random.split(key)
+    scale = jnp.sqrt(2.0 / n_in)
+    return {"w": jax.random.normal(k1, (n_in, n_out)) * scale,
+            "b": jnp.zeros((n_out,))}
+
+
+def _mlp(key, sizes):
+    keys = jax.random.split(key, len(sizes) - 1)
+    return [_dense(k, sizes[i], sizes[i + 1]) for i, k in enumerate(keys)]
+
+
+def _apply_mlp(layers, x, final_act=None):
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jax.nn.silu(x)
+        elif final_act is not None:
+            x = final_act(x)
+    return x
+
+
+def _gru_init(key, n_in, n_h):
+    k1, k2 = jax.random.split(key)
+    return {"wi": _dense(k1, n_in, 3 * n_h), "wh": _dense(k2, n_h, 3 * n_h)}
+
+
+def _gru(p, x, h):
+    gates_x = x @ p["wi"]["w"] + p["wi"]["b"]
+    gates_h = h @ p["wh"]["w"] + p["wh"]["b"]
+    xr, xz, xn = jnp.split(gates_x, 3, axis=-1)
+    hr, hz, hn = jnp.split(gates_h, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+class DreamerModule:
+    """World model + actor + critic parameter factory and pure apply fns.
+
+    Latent: deter `h` (n_deter) + stochastic `z` of `n_cat` categorical
+    distributions with `n_classes` classes each (flattened one-hots).
+    """
+
+    discrete = True
+
+    def __init__(self, obs_dim: int, num_actions: int, n_deter=256,
+                 n_cat=8, n_classes=8, hidden=256):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.n_deter = n_deter
+        self.n_cat = n_cat
+        self.n_classes = n_classes
+        self.n_stoch = n_cat * n_classes
+        self.hidden = hidden
+        # Acting state (per env-runner process; reset via the runner's
+        # on_episode_end hook).
+        self._h = None
+        self._z = None
+
+    # -- params ---------------------------------------------------------
+    def init_params(self, seed: int = 0) -> Dict:
+        k = jax.random.split(jax.random.PRNGKey(seed), 8)
+        feat = self.n_deter + self.n_stoch
+        return {
+            "embed": _mlp(k[0], [self.obs_dim, self.hidden, self.hidden]),
+            "gru": _gru_init(k[1], self.n_stoch + self.num_actions,
+                             self.n_deter),
+            "prior": _mlp(k[2], [self.n_deter, self.hidden, self.n_stoch]),
+            "post": _mlp(k[3], [self.n_deter + self.hidden, self.hidden,
+                                self.n_stoch]),
+            "decoder": _mlp(k[4], [feat, self.hidden, self.obs_dim]),
+            "reward": _mlp(k[5], [feat, self.hidden, 1]),
+            "cont": _mlp(k[6], [feat, self.hidden, 1]),
+            "actor": _mlp(k[7], [feat, self.hidden, self.num_actions]),
+            "critic": _mlp(jax.random.fold_in(k[7], 1),
+                           [feat, self.hidden, 1]),
+        }
+
+    # -- latent machinery ------------------------------------------------
+    def _sample_cat(self, logits, key):
+        """Straight-through one-hot sample over n_cat categoricals
+        (paper: unimix 1% uniform for exploration-stable gradients)."""
+        shape = logits.shape[:-1] + (self.n_cat, self.n_classes)
+        lg = logits.reshape(shape)
+        probs = 0.99 * jax.nn.softmax(lg, -1) + 0.01 / self.n_classes
+        idx = jax.random.categorical(key, jnp.log(probs), axis=-1)
+        one_hot = jax.nn.one_hot(idx, self.n_classes)
+        st = one_hot + probs - jax.lax.stop_gradient(probs)
+        return st.reshape(logits.shape), jnp.log(probs)
+
+    def obs_step(self, params, h, z_prev, a_prev, obs_emb, key):
+        """One posterior step: (h', z', prior_logits, post_logits)."""
+        x = jnp.concatenate([z_prev, a_prev], -1)
+        h = _gru(params["gru"], x, h)
+        prior = _apply_mlp(params["prior"], h)
+        post = _apply_mlp(params["post"],
+                          jnp.concatenate([h, obs_emb], -1))
+        z, _ = self._sample_cat(post, key)
+        return h, z, prior, post
+
+    def img_step(self, params, h, z, a, key):
+        """One prior (imagination) step."""
+        x = jnp.concatenate([z, a], -1)
+        h = _gru(params["gru"], x, h)
+        prior = _apply_mlp(params["prior"], h)
+        z2, _ = self._sample_cat(prior, key)
+        return h, z2
+
+    def feat(self, h, z):
+        return jnp.concatenate([h, z], -1)
+
+    # -- acting (runner-side, numpy in/out) ------------------------------
+    def _act(self, params, obs, rng, greedy: bool):
+        B = obs.shape[0]
+        if self._h is None or self._h.shape[0] != B:
+            self._h = jnp.zeros((B, self.n_deter))
+            self._z = jnp.zeros((B, self.n_stoch))
+            self._a = jnp.zeros((B, self.num_actions))
+        key = jax.random.PRNGKey(int(rng.integers(0, 2**31 - 1)))
+        emb = _apply_mlp(params["embed"], symlog(jnp.asarray(obs)))
+        h, z, _, _ = self.obs_step(params, self._h, self._z, self._a,
+                                   emb, key)
+        logits = _apply_mlp(params["actor"], self.feat(h, z))
+        if greedy:
+            a = jnp.argmax(logits, -1)
+        else:
+            a = jax.random.categorical(jax.random.fold_in(key, 1), logits)
+        self._h, self._z = h, z
+        self._a = jax.nn.one_hot(a, self.num_actions)
+        return np.asarray(a)
+
+    def forward_inference(self, params, obs):
+        return self._act(params, obs, np.random.default_rng(0), True)
+
+    def forward_exploration(self, params, obs, rng, **kw):
+        return self._act(params, obs, rng, False), {}
+
+    def on_episode_end(self):
+        self._h = self._z = None
+
+    def get_initial_state(self):
+        return {}
+
+
+def make_dreamer_update(module: DreamerModule, *, horizon=15,
+                        gamma=0.997, lam=0.95, wm_lr=4e-4, ac_lr=1e-4,
+                        free_bits=1.0, entropy_coef=3e-3):
+    """Build (init_state, jitted update) for one DreamerV3 train step on
+    a [B, L, ...] sequence batch."""
+    wm_keys = ("embed", "gru", "prior", "post", "decoder", "reward",
+               "cont")
+    wm_opt = optax.adam(wm_lr)
+    actor_opt = optax.adam(ac_lr)
+    critic_opt = optax.adam(ac_lr)
+
+    def split(params):
+        wm = {k: params[k] for k in wm_keys}
+        return wm, params["actor"], params["critic"]
+
+    def kl_cat(lhs_logits, rhs_logits):
+        """KL(lhs || rhs) over the factorized categoricals, summed."""
+        shape = lhs_logits.shape[:-1] + (module.n_cat, module.n_classes)
+        lp = jax.nn.log_softmax(lhs_logits.reshape(shape), -1)
+        rp = jax.nn.log_softmax(rhs_logits.reshape(shape), -1)
+        return jnp.sum(jnp.exp(lp) * (lp - rp), axis=(-1, -2))
+
+    def world_model_loss(wm, batch, key):
+        obs = symlog(batch["obs"])                      # [B, L, D]
+        B, L, _ = obs.shape
+        emb = _apply_mlp(wm["embed"], obs)
+        actions = jax.nn.one_hot(batch["actions"], module.num_actions)
+        a_prev = jnp.concatenate(
+            [jnp.zeros_like(actions[:, :1]), actions[:, :-1]], 1)
+        keys = jax.random.split(key, L)
+
+        first = batch["is_first"].astype(jnp.float32)  # [B, L]
+
+        def step(carry, t):
+            h, z = carry
+            # Timeline break: reset the latent (paper is_first masking).
+            keep = (1.0 - first[:, t])[:, None]
+            h = h * keep
+            z = z * keep
+            a = a_prev[:, t] * keep
+            h, z, prior, post = module.obs_step(
+                wm, h, z, a, emb[:, t], keys[t])
+            return (h, z), (h, z, prior, post)
+
+        h0 = jnp.zeros((B, module.n_deter))
+        z0 = jnp.zeros((B, module.n_stoch))
+        (_, _), (hs, zs, priors, posts) = jax.lax.scan(
+            step, (h0, z0), jnp.arange(L))
+        hs = jnp.moveaxis(hs, 0, 1)                     # [B, L, ...]
+        zs = jnp.moveaxis(zs, 0, 1)
+        priors = jnp.moveaxis(priors, 0, 1)
+        posts = jnp.moveaxis(posts, 0, 1)
+        feat = module.feat(hs, zs)
+        recon = _apply_mlp(wm["decoder"], feat)
+        rew_hat = _apply_mlp(wm["reward"], feat)[..., 0]
+        cont_hat = _apply_mlp(wm["cont"], feat)[..., 0]
+        recon_loss = jnp.mean(jnp.sum((recon - obs) ** 2, -1))
+        reward_loss = jnp.mean(
+            (rew_hat - symlog(batch["rewards"])) ** 2)
+        cont = 1.0 - batch["terminateds"].astype(jnp.float32)
+        cont_loss = jnp.mean(optax.sigmoid_binary_cross_entropy(
+            cont_hat, cont))
+        # KL balancing (paper: dyn 0.5 toward the posterior, rep 0.1
+        # toward the prior) with free bits.
+        dyn = kl_cat(jax.lax.stop_gradient(posts), priors)
+        rep = kl_cat(posts, jax.lax.stop_gradient(priors))
+        kl = 0.5 * jnp.mean(jnp.maximum(dyn, free_bits)) + \
+            0.1 * jnp.mean(jnp.maximum(rep, free_bits))
+        loss = recon_loss + reward_loss + cont_loss + kl
+        metrics = {"wm_recon": recon_loss, "wm_reward": reward_loss,
+                   "wm_cont": cont_loss, "wm_kl": jnp.mean(dyn)}
+        return loss, (hs, zs, metrics)
+
+    def imagine(wm, actor, hs, zs, key):
+        """Roll the prior forward `horizon` steps from every posterior
+        state, acting with the CURRENT actor."""
+        start_h = jax.lax.stop_gradient(hs.reshape(-1, module.n_deter))
+        start_z = jax.lax.stop_gradient(zs.reshape(-1, module.n_stoch))
+        keys = jax.random.split(key, horizon)
+
+        def step(carry, k):
+            h, z = carry
+            logits = _apply_mlp(actor, module.feat(h, z))
+            a = jax.random.categorical(k, logits)
+            a1 = jax.nn.one_hot(a, module.num_actions)
+            h2, z2 = module.img_step(wm, h, z, a1, jax.random.fold_in(
+                k, 1))
+            return (h2, z2), (module.feat(h, z), a, logits)
+
+        (_, _), (feats, acts, logits) = jax.lax.scan(
+            step, (start_h, start_z), keys)
+        return feats, acts, logits                      # [H, N, ...]
+
+    def lambda_returns(rewards, conts, values):
+        """TD(lambda) over the imagined trajectory (paper eq. 7)."""
+        def step(nxt, t):
+            ret = rewards[t] + gamma * conts[t] * (
+                (1 - lam) * values[t + 1] + lam * nxt)
+            return ret, ret
+
+        _, rets = jax.lax.scan(step, values[-1],
+                               jnp.arange(horizon - 1, -1, -1))
+        return rets[::-1]
+
+    def ac_loss(actor, critic, wm, hs, zs, key, ret_scale):
+        feats, acts, logits = imagine(wm, actor, hs, zs, key)
+        rew = symexp(_apply_mlp(wm["reward"], feats)[..., 0])
+        cont = jax.nn.sigmoid(_apply_mlp(wm["cont"], feats)[..., 0])
+        values = symexp(
+            _apply_mlp(critic, feats)[..., 0])          # [H, N]
+        rets = lambda_returns(rew, cont, values)        # [H, N]
+        # Return normalizer (paper: scale by the 5th-95th percentile
+        # range, EMA'd outside).
+        norm = jnp.maximum(1.0, ret_scale)
+        adv = jax.lax.stop_gradient((rets - values) / norm)
+        logp = jax.nn.log_softmax(logits, -1)
+        taken = jnp.take_along_axis(logp, acts[..., None], -1)[..., 0]
+        entropy = -jnp.sum(jnp.exp(logp) * logp, -1)
+        weight = jnp.cumprod(
+            jnp.concatenate([jnp.ones_like(cont[:1]), cont[:-1]], 0),
+            0)
+        weight = jax.lax.stop_gradient(weight)
+        actor_loss = -jnp.mean(
+            weight * (taken * adv + entropy_coef * entropy))
+        critic_pred = _apply_mlp(critic, jax.lax.stop_gradient(
+            feats))[..., 0]
+        critic_loss = jnp.mean(
+            weight * (critic_pred - jax.lax.stop_gradient(
+                symlog(rets))) ** 2)
+        stats = {"actor_loss": actor_loss, "critic_loss": critic_loss,
+                 "imag_return": jnp.mean(rets),
+                 "actor_entropy": jnp.mean(entropy),
+                 "ret_raw": jnp.percentile(rets, 95)
+                 - jnp.percentile(rets, 5)}
+        return actor_loss + critic_loss, stats
+
+    def init_state(seed: int = 0):
+        params = module.init_params(seed)
+        wm, actor, critic = split(params)
+        return {"params": params,
+                "wm_opt": wm_opt.init(wm),
+                "actor_opt": actor_opt.init(actor),
+                "critic_opt": critic_opt.init(critic),
+                "ret_scale": jnp.ones(()),
+                "step": jnp.zeros((), jnp.int32)}
+
+    @jax.jit
+    def update(state, batch, key):
+        params = state["params"]
+        wm, actor, critic = split(params)
+        k1, k2 = jax.random.split(key)
+        (wm_l, (hs, zs, wm_m)), wm_g = jax.value_and_grad(
+            world_model_loss, has_aux=True)(wm, batch, k1)
+        wm_up, wm_opt_state = wm_opt.update(wm_g, state["wm_opt"], wm)
+        wm_new = optax.apply_updates(wm, wm_up)
+
+        def actor_critic_loss(ac):
+            return ac_loss(ac["actor"], ac["critic"], wm_new, hs, zs,
+                           k2, state["ret_scale"])
+
+        (ac_l, ac_m), ac_g = jax.value_and_grad(
+            actor_critic_loss, has_aux=True)(
+                {"actor": actor, "critic": critic})
+        a_up, actor_opt_state = actor_opt.update(
+            ac_g["actor"], state["actor_opt"], actor)
+        c_up, critic_opt_state = critic_opt.update(
+            ac_g["critic"], state["critic_opt"], critic)
+        new_params = dict(wm_new)
+        new_params["actor"] = optax.apply_updates(actor, a_up)
+        new_params["critic"] = optax.apply_updates(critic, c_up)
+        ret_scale = 0.99 * state["ret_scale"] + 0.01 * ac_m["ret_raw"]
+        metrics = {"wm_loss": wm_l, **wm_m, **ac_m}
+        return ({"params": new_params, "wm_opt": wm_opt_state,
+                 "actor_opt": actor_opt_state,
+                 "critic_opt": critic_opt_state,
+                 "ret_scale": ret_scale, "step": state["step"] + 1},
+                metrics)
+
+    return init_state, update
+
+
+class SequenceReplayBuffer:
+    """Stores contiguous fragments; samples [B, L] subsequences
+    (reference: dreamerv3's episode replay)."""
+
+    def __init__(self, capacity_steps: int = 100_000, seed: int = 0):
+        self.capacity = capacity_steps
+        self._cols: Dict[str, np.ndarray] = {}
+        self._size = 0
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return self._size
+
+    def add_fragment(self, batch: Dict[str, np.ndarray]):
+        n = len(batch["rewards"])
+        if not self._cols:
+            for k in ("obs", "actions", "rewards", "terminateds"):
+                v = np.asarray(batch[k])
+                self._cols[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                         v.dtype)
+            # is_first marks timeline breaks: fragment starts (each
+            # fragment may come from a different env runner) and the
+            # step after a terminal. The world model RESETS its latent
+            # there (the paper's is_first masking), so spliced
+            # subsequences never fabricate cross-episode dynamics.
+            self._cols["is_first"] = np.zeros((self.capacity,), bool)
+        prev_done = True
+        for i in range(n):
+            for k in ("obs", "actions", "rewards", "terminateds"):
+                self._cols[k][self._next] = batch[k][i]
+            self._cols["is_first"][self._next] = prev_done or (i == 0)
+            prev_done = bool(batch["terminateds"][i])
+            self._next = (self._next + 1) % self.capacity
+            self._size = min(self._size + 1, self.capacity)
+
+    def sample_sequences(self, batch_size: int, length: int):
+        # Offsets from the OLDEST element so sequences follow time order
+        # even when the ring has wrapped (index wrap != time break).
+        oldest = self._next % self.capacity if self._size ==             self.capacity else 0
+        offs = self._rng.integers(0, self._size - length,
+                                  size=batch_size)
+        idx = (oldest + offs[:, None]
+               + np.arange(length)[None, :]) % self.capacity
+        return {k: v[idx] for k, v in self._cols.items()}
+
+
+class DreamerV3(Algorithm):
+    def __init__(self, config):
+        super().__init__(config)
+        self.buffer = SequenceReplayBuffer(
+            int(config.extra.get("buffer_capacity", 100_000)),
+            seed=config.seed)
+        self._init_state, self._update = make_dreamer_update(
+            self.module,
+            horizon=int(config.extra.get("horizon", 15)),
+            gamma=config.gamma,
+            wm_lr=float(config.extra.get("wm_lr", 4e-4)),
+            ac_lr=float(config.extra.get("ac_lr", 1e-4)))
+        self._state = self._init_state(config.seed)
+        self._key = jax.random.PRNGKey(config.seed)
+        # No JaxLearner (three custom optimizers): the base __init__
+        # couldn't seed the runners with weights — do it now.
+        if self.env_runner_group is not None:
+            self.env_runner_group.sync_weights(self._state["params"])
+
+    def _build_module(self, obs_dim, num_actions):
+        ex = self.config.extra
+        return DreamerModule(
+            obs_dim, num_actions,
+            n_deter=int(ex.get("n_deter", 256)),
+            n_cat=int(ex.get("n_cat", 8)),
+            n_classes=int(ex.get("n_classes", 8)),
+            hidden=self.config.hidden[0] if self.config.hidden else 256)
+
+    def _build_learner(self):
+        return None  # custom three-optimizer update below
+
+    def get_weights(self):
+        return self._state["params"]
+
+    def _get_algo_state(self):
+        return {"dreamer_state": jax.device_get(self._state)}
+
+    def _set_algo_state(self, st):
+        if "dreamer_state" in st:
+            self._state = jax.tree.map(jnp.asarray,
+                                       st["dreamer_state"])
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        seq_len = int(cfg.extra.get("seq_len", 16))
+        for frag in self.env_runner_group.sample(
+                cfg.rollout_fragment_length):
+            self.buffer.add_fragment(frag)
+            self._total_steps += len(frag["rewards"])
+        stats: Dict = {}
+        warmup = int(cfg.extra.get("learning_starts", 1000))
+        if len(self.buffer) >= max(warmup, seq_len * 2):
+            for _ in range(int(cfg.extra.get("updates_per_iter", 4))):
+                batch = self.buffer.sample_sequences(
+                    int(cfg.extra.get("batch_sequences", 8)), seq_len)
+                jb = {k: jnp.asarray(v) for k, v in batch.items()}
+                self._key, sub = jax.random.split(self._key)
+                self._state, m = self._update(self._state, jb, sub)
+            stats.update({k: float(v) for k, v in m.items()})
+        self.env_runner_group.sync_weights(self._state["params"])
+        return stats
+
+
+class DreamerV3Config(AlgorithmConfig):
+    ALGO_CLS = DreamerV3
+
+    def __init__(self):
+        super().__init__()
+        self.gamma = 0.997
+        self.rollout_fragment_length = 64
+        self.train_batch_size = 128

@@ -113,6 +113,12 @@ class SingleAgentEnvRunner:
                 self._episode_return = 0.0
                 self._episode_len = 0
                 self._obs, _ = self.env.reset()
+                # Recurrent modules (DreamerV3's RSSM acting state)
+                # reset their rollout state at episode boundaries
+                # (reference: RLModule state-reset via connectors).
+                hook = getattr(self.module, "on_episode_end", None)
+                if hook is not None:
+                    hook()
             else:
                 self._obs = nxt
         batch = {k: np.asarray(v) for k, v in cols.items()}
