@@ -632,9 +632,13 @@ class Node:
             self._dispatch_actor_creation(spec, worker)
             return
         if worker is None:
-            blob = serialization.dumps(TaskUnschedulableError(
-                f"Task {spec.name} demands {spec.resources}, which exceeds "
-                f"cluster totals {self.node_registry.aggregate()[0]}"))
+            env_err = getattr(spec, "_env_error", None)
+            err = env_err if env_err is not None else \
+                TaskUnschedulableError(
+                    f"Task {spec.name} demands {spec.resources}, which "
+                    f"exceeds cluster totals "
+                    f"{self.node_registry.aggregate()[0]}")
+            blob = serialization.dumps(err)
             for rid in spec.return_ids:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             self._unpin_task_args(spec)
@@ -981,10 +985,15 @@ class Node:
                                  worker: Optional[WorkerHandle]):
         st = self._actors[spec.actor_id]
         if worker is None:
-            blob = serialization.dumps(TaskUnschedulableError(
-                f"Actor {spec.cls_id} demands {spec.resources}, which "
-                f"exceeds cluster totals {self.node_registry.aggregate()[0]}"))
-            self._fail_actor(st, blob, "infeasible resources")
+            env_err = getattr(spec, "_env_error", None)
+            err = env_err if env_err is not None else \
+                TaskUnschedulableError(
+                    f"Actor {spec.cls_id} demands {spec.resources}, "
+                    f"which exceeds cluster totals "
+                    f"{self.node_registry.aggregate()[0]}")
+            blob = serialization.dumps(err)
+            self._fail_actor(st, blob, "infeasible resources"
+                             if env_err is None else "env setup failed")
             self._unpin_task_args(spec)
             return
         worker.dedicated_actor = spec.actor_id

@@ -11,8 +11,16 @@ Supported fields:
   env_vars     {str: str}    set in the worker's process environment
   working_dir  str (path)    worker chdirs here and prepends to sys.path
   py_modules   [str (path)]  prepended to sys.path
+  pip          [str]         requirements installed into a per-env venv
+                             (cached by env hash); workers of that env
+                             run the venv's python. OFFLINE by default
+                             (pip --no-index with --find-links for any
+                             local wheel/sdist paths in the list) since
+                             this image has no egress; set
+                             RAY_TPU_PIP_OFFLINE=0 where PyPI is
+                             reachable. Reference: runtime_env/pip.py.
 Gated (raise at validation, like the reference when the backing tool is
-absent): pip, conda, container — this image forbids installs (no egress).
+absent): conda, container.
 """
 import hashlib
 import json
@@ -20,8 +28,29 @@ import os
 from typing import Any, Dict, Optional
 
 ENV_VAR = "RAY_TPU_RUNTIME_ENV"
-_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
-_GATED = {"pip", "conda", "container", "uv"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "uv"}
+_GATED = {"conda", "container"}
+
+
+class RuntimeEnvSetupError(RuntimeError):
+    """Env materialization failed (bad requirement, install error) —
+    surfaces as the TASK's error, never an infinite dispatch retry."""
+
+
+def _envs_root() -> str:
+    """Per-uid 0700 cache root: a world-predictable shared path would
+    let another local user pre-plant a venv whose python our workers
+    exec."""
+    root = f"/tmp/ray_tpu_envs_{os.getuid()}"
+    os.makedirs(root, mode=0o700, exist_ok=True)
+    st = os.stat(root)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o077):
+        raise RuntimeEnvSetupError(
+            f"{root} has unsafe ownership/permissions")
+    return root
+
+
+_failed_envs: Dict[str, str] = {}
 
 
 def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -33,12 +62,25 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     for key in runtime_env:
         if key in _GATED:
             raise ValueError(
-                f"runtime_env field '{key}' requires package installation, "
-                "which this environment gates off (no egress); vendor the "
-                "code via working_dir/py_modules instead")
+                f"runtime_env field '{key}' requires containerized "
+                "tooling this environment gates off; use pip/"
+                "working_dir/py_modules instead")
         if key not in _SUPPORTED:
             raise ValueError(f"Unknown runtime_env field '{key}' "
                              f"(supported: {sorted(_SUPPORTED)})")
+    reqs = runtime_env.get("pip") or runtime_env.get("uv")
+    if reqs is not None:
+        if not (isinstance(reqs, list)
+                and all(isinstance(r, str) for r in reqs)):
+            raise TypeError("runtime_env pip must be a list of "
+                            "requirement strings / local wheel paths")
+        # Warm the venv in the background so the scheduler's dispatch
+        # thread usually finds it ready (the reference's async env
+        # agent, collapsed to a builder thread).
+        import threading
+        threading.Thread(target=lambda: _try_build(list(reqs)),
+                         daemon=True,
+                         name="pip-env-warm").start()
     ev = runtime_env.get("env_vars", {})
     if not all(isinstance(k, str) and isinstance(v, str)
                for k, v in ev.items()):
@@ -63,14 +105,82 @@ def env_hash(runtime_env: Optional[Dict[str, Any]]) -> str:
 
 def worker_extra_env(runtime_env: Optional[Dict[str, Any]]
                      ) -> Dict[str, str]:
-    """Environment to inject at worker-process start."""
+    """Environment to inject at worker-process start. For pip envs this
+    MATERIALIZES the venv (cached by env hash, file-locked) and points
+    the worker pool at its python via RAY_TPU_PYTHON."""
     if not runtime_env:
         return {}
     extra = dict(runtime_env.get("env_vars", {}))
     payload = {k: v for k, v in runtime_env.items() if k != "env_vars"}
     if payload:
         extra[ENV_VAR] = json.dumps(payload)
+    reqs = runtime_env.get("pip") or runtime_env.get("uv")
+    if reqs:
+        extra["RAY_TPU_PYTHON"] = ensure_pip_env(list(reqs))
     return extra
+
+
+def _try_build(requirements: list):
+    try:
+        ensure_pip_env(requirements)
+    except Exception:
+        pass  # memoized; surfaces as the task's error at dispatch
+
+
+def ensure_pip_env(requirements: list) -> str:
+    """Create (or reuse) the venv for `requirements`; returns its python.
+
+    Reference: runtime_env/pip.py — a venv per requirements-hash with
+    URI caching; concurrent creators serialize on a file lock. The venv
+    inherits site-packages (jax/numpy stay importable) and installs the
+    requirements on top. Offline by default: local wheel/sdist paths in
+    the list become --find-links sources and pip runs --no-index.
+    """
+    import fcntl
+    import subprocess
+    import sys
+
+    key = hashlib.sha1(json.dumps(sorted(requirements)).encode()
+                       ).hexdigest()[:12]
+    if key in _failed_envs:
+        raise RuntimeEnvSetupError(_failed_envs[key])
+    root = _envs_root()
+    env_dir = os.path.join(root, key)
+    python = os.path.join(env_dir, "bin", "python")
+    if os.path.exists(os.path.join(env_dir, ".ready")):
+        return python
+    lock_path = os.path.join(root, f"{key}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(os.path.join(env_dir, ".ready")):
+            return python
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages",
+             env_dir],
+            check=True, capture_output=True, text=True, timeout=300)
+        offline = os.environ.get("RAY_TPU_PIP_OFFLINE", "1") == "1"
+        find_links = sorted({os.path.dirname(os.path.abspath(r))
+                             for r in requirements
+                             if os.path.exists(r)})
+        cmd = [python, "-m", "pip", "install", "-q",
+               "--no-build-isolation"]
+        if offline:
+            cmd.append("--no-index")
+        for d in find_links:
+            cmd += ["--find-links", d]
+        cmd += requirements
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            import shutil
+            shutil.rmtree(env_dir, ignore_errors=True)
+            msg = (f"runtime_env pip install failed for "
+                   f"{requirements}:\n{proc.stderr[-2000:]}")
+            _failed_envs[key] = msg  # retries fail fast, not rebuild
+            raise RuntimeEnvSetupError(msg)
+        with open(os.path.join(env_dir, ".ready"), "w") as f:
+            f.write(json.dumps(requirements))
+    return python
 
 
 def apply_in_worker():

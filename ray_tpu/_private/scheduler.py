@@ -500,12 +500,18 @@ class WorkerPool:
             [repo_root] + driver_paths
             + ([proc_env["PYTHONPATH"]] if proc_env.get("PYTHONPATH")
                else []))
-        argv = [sys.executable, "-m", "ray_tpu._private.worker_proc"]
+        # pip runtime envs run the worker under THEIR venv python
+        # (reference: the runtime env agent's per-env interpreter).
+        py = env.get("RAY_TPU_PYTHON") or sys.executable
+        argv = [py, "-m", "ray_tpu._private.worker_proc"]
         from .config import ray_config
         if (bool(ray_config.worker_lean_boot)
                 and self._lean_boot_safe()
                 and env.get("JAX_PLATFORMS") == "cpu"
-                and not env.get("TPU_VISIBLE_CHIPS")):
+                and not env.get("TPU_VISIBLE_CHIPS")
+                and not env.get("RAY_TPU_PYTHON")):
+            # (pip-env workers skip -S: the venv's site-packages IS the
+            # point of the environment.)
             # CPU-pool workers boot with -S: this environment's
             # sitecustomize imports jax + a TPU plugin (~5 s of CPU per
             # process — measured), which a cpu-pinned worker never needs.
@@ -916,7 +922,16 @@ class Scheduler:
             try:
                 worker = self._maybe_start_worker(
                     env_key, spec, dedicated=is_actor_creation)
-            except Exception:
+            except Exception as e:
+                from .runtime_env import RuntimeEnvSetupError
+                if isinstance(e, RuntimeEnvSetupError):
+                    # Env materialization failures are the TASK's error
+                    # (reference: RuntimeEnvSetupError on the ref), not
+                    # an infinite requeue.
+                    self.nodes.release(node_id, demand)
+                    spec._env_error = e
+                    self._dispatch_fn(spec, None)
+                    return True
                 worker = None  # boot failure: release + retry later
         if worker is None:
             self.nodes.release(node_id, demand)
@@ -973,7 +988,13 @@ class Scheduler:
         spec_re = getattr(spec, "runtime_env", None)
         if spec_re:
             from . import runtime_env as re_mod
-            extra_env.update(re_mod.worker_extra_env(spec_re))
+            try:
+                extra_env.update(re_mod.worker_extra_env(spec_re))
+            except BaseException:
+                if chip_ids:
+                    with self._lock:
+                        self._free_chips.extend(chip_ids)
+                raise
         handle = self.pool.start_worker(env_key, extra_env)
         handle.chip_ids = chip_ids
         return handle

@@ -168,7 +168,7 @@ while True:
 """ % sys.path[0]
     head = subprocess.Popen([sys.executable, "-c", head_code],
                             stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True)
+                            stderr=subprocess.DEVNULL, text=True)
 
     def run_driver(body: str, marker: str, addr: str, token: str):
         code = f"""
@@ -198,10 +198,13 @@ conn.close()
         line = banner.get("line", "")
         if not line.startswith("ADDR"):
             head.kill()
-            raise AssertionError(
-                f"head never started: {line!r}\n"
-                f"{head.stderr.read()[-2000:]}")
+            raise AssertionError(f"head never started: {line!r}")
         _, addr, _, token = line.split()
+        # Drain further head stdout so log streaming can't fill the
+        # 64 KB pipe and block the head mid-test.
+        import threading as _threading
+        _threading.Thread(target=lambda: head.stdout.read(),
+                          daemon=True).start()
 
         # Driver 1: create a stateful actor, bump it, EXIT.
         run_driver("""
