@@ -38,9 +38,11 @@ from .scheduler import ResourceManager, Scheduler, WorkerHandle, WorkerPool
 
 
 def _gc_stale_sessions(max_age_s: Optional[float] = None):
-    """Sweep shm/session dirs left by crashed runs (reference: ray's session
-    dir GC in _private/utils.py). Only removes dirs older than `max_age_s`
-    so concurrent live sessions are untouched."""
+    """Sweep shm/session dirs left by crashed runs (reference: ray's
+    session dir GC in _private/utils.py). Dirs whose stamped owner pid
+    is dead go immediately; ownerless dirs keep a grace period —
+    `max_age_s` when they hold content, one minute when they are
+    logs-only husks."""
     import glob
     import shutil
     if max_age_s is None:
@@ -59,8 +61,19 @@ def _gc_stale_sessions(max_age_s: Optional[float] = None):
             pid, stamped = _session_owner_pid(d)
             if pid is not None and not _owner_alive(pid, stamped):
                 shutil.rmtree(d, ignore_errors=True)
-            elif age > max_age_s and pid is None:
-                shutil.rmtree(d, ignore_errors=True)
+            elif pid is None:
+                # No .owner_pid. Content decides: a dir holding nothing
+                # but logs/ is a husk (a prestart thread recreating
+                # logs/ after shutdown's rmtree) and goes after a
+                # minute; anything with real content keeps the full
+                # max_age_s grace in case the stamp write failed on a
+                # LIVE session (Node.__init__ swallows that OSError).
+                try:
+                    contentful = bool(set(os.listdir(d)) - {"logs"})
+                except OSError:
+                    contentful = True
+                if age > (max_age_s if contentful else 60.0):
+                    shutil.rmtree(d, ignore_errors=True)
         except OSError:
             pass
 
