@@ -15,7 +15,8 @@ import threading
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = [os.path.join(_DIR, "src", f) for f in ("store.cpp", "transfer.cpp")]
+_SRC = [os.path.join(_DIR, "src", f)
+        for f in ("store.cpp", "transfer.cpp", "dispatch.cpp")]
 _SO = os.path.join(_DIR, "libray_tpu.so")
 _lock = threading.Lock()
 _lib = None
@@ -90,8 +91,73 @@ def _load():
         lib.rt_transfer_pull.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,
             ctypes.c_char_p]
+        lib.disp_create.restype = ctypes.c_void_p
+        lib.disp_create.argtypes = []
+        lib.disp_recv_batch.restype = ctypes.c_int64
+        lib.disp_recv_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_uint64, ctypes.c_int]
+        lib.disp_stop.restype = None
+        lib.disp_stop.argtypes = [ctypes.c_void_p]
+        lib.disp_destroy.restype = None
+        lib.disp_destroy.argtypes = [ctypes.c_void_p]
+        # Quick dispatch entry points go through PyDLL: they only
+        # memcpy + enqueue + (maybe) one eventfd write, so releasing
+        # the GIL around them costs more (a handoff/context-switch
+        # opportunity per call) than it buys.
+        qlib = ctypes.PyDLL(_SO)
+        qlib.disp_add.restype = ctypes.c_int
+        qlib.disp_add.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_uint64]
+        qlib.disp_remove.restype = ctypes.c_int
+        qlib.disp_remove.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        qlib.disp_send.restype = ctypes.c_int
+        qlib.disp_send.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_char_p, ctypes.c_uint64]
+        lib._qlib = qlib
         _lib = lib
         return _lib
+
+
+EOF_LEN = 0xFFFFFFFFFFFFFFFF
+
+
+class NativeDispatcher:
+    """Thin handle to the C++ dispatch core (dispatch.cpp): an epoll IO
+    thread owning worker sockets. Sends enqueue without syscalls on the
+    caller; receives drain in batches with one GIL entry per batch."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_build_error}")
+        self._lib = lib
+        self._send = lib._qlib.disp_send
+        self._h = lib.disp_create()
+        if not self._h:
+            raise RuntimeError("disp_create failed")
+
+    def add(self, fd: int, token: int) -> bool:
+        return self._lib._qlib.disp_add(self._h, fd, token) == 0
+
+    def remove(self, token: int) -> None:
+        self._lib._qlib.disp_remove(self._h, token)
+
+    def send(self, token: int, data: bytes) -> bool:
+        return self._send(self._h, token, data, len(data)) == 0
+
+    def recv_batch(self, buf, cap: int, timeout_ms: int) -> int:
+        """Fills `buf` (a ctypes char array) with framed records; see
+        dispatch.cpp disp_recv_batch. Blocks GIL-free in C++."""
+        return int(self._lib.disp_recv_batch(self._h, buf, cap, timeout_ms))
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.disp_stop(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.disp_destroy(self._h)
+            self._h = None
 
 
 def available() -> bool:

@@ -39,7 +39,9 @@ class NodeDaemon:
                  num_cpus: Optional[float] = None,
                  num_tpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
-                 object_store_memory: Optional[int] = None):
+                 object_store_memory: Optional[int] = None,
+                 labels: Optional[Dict[str, str]] = None):
+        self.labels = dict(labels or {})
         self.node_id = NodeID.from_random()
         self.node_hex = self.node_id.hex()
         session_name = f"node_{int(time.time())}_{uuid.uuid4().hex[:8]}"
@@ -92,6 +94,7 @@ class NodeDaemon:
             "transfer_port": self.transfer.port,
             "hostname": os.uname().nodename,
             "pid": os.getpid(),
+            "labels": self.labels,
         })
         msg_type, payload = self._recv()
         if msg_type != P.NODE_ACK:
@@ -399,6 +402,9 @@ def _main():
     parser.add_argument("--num-tpus", type=float, default=None)
     parser.add_argument("--resources", default=None,
                         help="JSON dict of custom resources")
+    parser.add_argument("--labels", default=None,
+                        help="JSON dict of node labels (reference: "
+                             "`ray start --labels`)")
     args = parser.parse_args()
     token_hex = args.token_hex or os.environ.get(
         "RAY_TPU_CLUSTER_TOKEN_HEX")
@@ -415,7 +421,8 @@ def _main():
     daemon = NodeDaemon(
         (host, int(port)), bytes.fromhex(token_hex),
         num_cpus=args.num_cpus, num_tpus=args.num_tpus,
-        resources=json.loads(args.resources) if args.resources else None)
+        resources=json.loads(args.resources) if args.resources else None,
+        labels=json.loads(args.labels) if args.labels else None)
 
     # SIGTERM (cluster_utils remove_node / operator stop) must run the
     # shutdown path so session/store dirs are cleaned — but must NOT

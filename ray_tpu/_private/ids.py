@@ -9,10 +9,36 @@ multi-job GCS sharing, which this framework does not need.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 
 _ID_SIZE = 16
+
+# Fresh ids are (counter XOR r1) little-endian ++ 8 random bytes: one
+# urandom read per process instead of one syscall per id (the reference
+# computes task/object ids from parent id + index for the same reason —
+# id.h TaskID::ForNormalTask). Layout matters: the counter rides the
+# LOW-ORDER FIRST bytes so every `hex()[:N]` truncation (worker socket
+# paths, log stems, display ids) stays unique per id — a static prefix
+# there once made concurrent worker starts collide on one socket path.
+# Cross-process uniqueness comes from the 8 random tail bytes (+ the
+# random XOR mask); both are regenerated after fork so a forked child
+# can never mint ids colliding with its parent's.
+_mask = int.from_bytes(os.urandom(8), "little")
+_tail = os.urandom(8)
+_counter = itertools.count(1)  # next() is atomic under the GIL
+
+
+def _reseed_after_fork():
+    global _mask, _tail, _counter
+    _mask = int.from_bytes(os.urandom(8), "little")
+    _tail = os.urandom(8)
+    _counter = itertools.count(1)
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reseed_after_fork)
 
 
 class BaseID:
@@ -30,7 +56,8 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(_ID_SIZE))
+        return cls(((next(_counter) ^ _mask) & 0xFFFFFFFFFFFFFFFF)
+                   .to_bytes(8, "little") + _tail)
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -116,9 +143,12 @@ def object_id_for_return(task_id: TaskID, index: int) -> ObjectID:
     payload = bytearray(task_id.binary())
     # 4 index bytes: streaming generators make large indices reachable
     # (a stream of 2^32 items is the wrap point, vs 2^16 before).
+    # XOR into the RANDOM-TAIL half (bytes 8..11), never the counter
+    # half: counters are sequential, so task N's return-1 id XORed at
+    # byte 0 would exactly equal fresh id N^1 of the same process.
     n = index + 1
-    payload[0] ^= n & 0xFF
-    payload[1] ^= (n >> 8) & 0xFF
-    payload[2] ^= (n >> 16) & 0xFF
-    payload[3] ^= (n >> 24) & 0xFF
+    payload[8] ^= n & 0xFF
+    payload[9] ^= (n >> 8) & 0xFF
+    payload[10] ^= (n >> 16) & 0xFF
+    payload[11] ^= (n >> 24) & 0xFF
     return ObjectID(bytes(payload))

@@ -67,13 +67,15 @@ class DaemonHandle:
     the per-node RayletClient)."""
 
     def __init__(self, conn, node_id_hex: str, resources: Dict[str, float],
-                 transfer_addr: Tuple[str, int], hostname: str, pid: int):
+                 transfer_addr: Tuple[str, int], hostname: str, pid: int,
+                 labels: Optional[Dict[str, str]] = None):
         self.conn = conn
         self.node_id_hex = node_id_hex
         self.resources = resources
         self.transfer_addr = transfer_addr
         self.hostname = hostname
         self.pid = pid
+        self.labels = dict(labels or {})
         self.alive = True
         self.last_ping = time.time()
         self.load: dict = {}
@@ -267,7 +269,8 @@ class HeadServer:
             handle = DaemonHandle(
                 conn, payload["node_id_hex"], payload["resources"],
                 (peer_host, payload["transfer_port"]),
-                payload.get("hostname", ""), payload.get("pid", 0))
+                payload.get("hostname", ""), payload.get("pid", 0),
+                labels=payload.get("labels"))
             # ACK strictly FIRST: registration wakes the scheduler, which
             # may dispatch START_WORKER to this daemon immediately — the
             # daemon's handshake must not see that before the ack.

@@ -385,7 +385,8 @@ class Node:
 
     def _on_daemon_registered(self, handle):
         self.node_registry.add_node(handle.node_id_hex, handle.resources,
-                                    daemon=handle)
+                                    daemon=handle,
+                                    labels=getattr(handle, "labels", None))
         self.gcs.pubsub.publish("node", {
             "event": "registered", "node_id": handle.node_id_hex,
             "hostname": handle.hostname, "resources": handle.resources})
@@ -887,6 +888,10 @@ class Node:
             "ts": time.time()})
 
     def _retry_budget(self, spec: P.TaskSpec) -> bool:
+        if spec.max_retries < 0:
+            # -1: retry forever (reference: max_retries=-1 /
+            # max_task_retries=-1 documented infinite-retry semantics).
+            return True
         used = self._retries_used.get(spec.task_id.binary(), 0)
         if used >= spec.max_retries:
             return False
@@ -905,6 +910,22 @@ class Node:
                 if (e is not None and e.state == gcs_mod.LOST
                         and e.lineage is not None):
                     self._resubmit_for_recovery(e.lineage)
+        if spec.actor_id is not None and not isinstance(spec, P.ActorSpec):
+            # Actor-task retry goes back onto ITS actor's ordered queue,
+            # not the cluster scheduler (args stay pinned from the
+            # original submission).
+            st = self._actors.get(spec.actor_id)
+            if st is None or st.dead:
+                blob = serialization.dumps(ActorDiedError(
+                    f"Actor {spec.actor_id.hex()} died before task "
+                    f"{spec.task_id.hex()} could be retried"))
+                for rid in spec.return_ids:
+                    self.gcs.objects.register_ready(
+                        rid, (P.LOC_ERROR, blob))
+                self._unpin_task_args(spec)
+                return
+            self._enqueue_actor_task(st, spec)
+            return
         self.scheduler.submit(spec, self._unresolved_deps(spec))
 
     # ------------------------------------------------------------------
@@ -1068,11 +1089,29 @@ class Node:
             for rid in spec.return_ids:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             return
+        if spec.max_retries == -2:
+            # Per-call budget unset: inherit the actor's max_task_retries
+            # (reference: actor method retries default to the actor
+            # option, core_worker task retry path). -1 = infinite; an
+            # explicit per-call 0 disables retries.
+            spec.max_retries = int(
+                getattr(st.spec, "max_task_retries", 0) or 0)
         self._pin_task_args(spec)
+        self._enqueue_actor_task(st, spec)
+
+    def _enqueue_actor_task(self, st: "_ActorState", spec: P.TaskSpec,
+                            front: bool = False):
+        """Queue an (already-pinned) actor task and flush when its deps
+        resolve — shared by first submission and retries. `front` puts
+        retried in-flight tasks BEFORE already-queued ones so the
+        restarted actor preserves per-actor submission order."""
         unresolved = self._unresolved_deps(spec)
         item = [spec, unresolved]
         with st.lock:
-            st.queue.append(item)
+            if front:
+                st.queue.appendleft(item)
+            else:
+                st.queue.append(item)
         if unresolved:
             with self._actor_dep_lock:
                 for oid in unresolved:
@@ -1202,17 +1241,30 @@ class Node:
         self.scheduler.release_task_resources(st.spec)
         blob = serialization.dumps(ActorDiedError(
             f"Actor {actor_id.hex()}'s worker process died."))
+        with st.lock:
+            already_dead = st.dead
+        will_restart = (not already_dead
+                        and entry.restarts_used < st.spec.max_restarts)
+        # In-flight tasks with retry budget survive a restart: they
+        # re-queue on the actor and run after the creation replay
+        # (reference: max_task_retries — TaskManager resubmits actor
+        # tasks once the GcsActorManager restart completes). Streaming
+        # tasks never retry (consumed items can't be replayed).
+        retry_specs = []
         for spec in running.values():
+            if (will_restart and not spec.streaming
+                    and spec.task_id.binary() not in self._cancel_requested
+                    and self._retry_budget(spec)):
+                retry_specs.append(spec)
+                continue
             if spec.streaming:
                 self._finish_gen_stream(spec.task_id, None, blob)
             for rid in spec.return_ids:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             self._unpin_task_args(spec)
-        with st.lock:
-            already_dead = st.dead
         if already_dead:
             return
-        if entry.restarts_used < st.spec.max_restarts:
+        if will_restart:
             # Elastic restart: replay the creation spec on a fresh worker
             # (reference: GcsActorManager restart path; state transitions in
             # gcs.proto ActorTableData).
@@ -1220,6 +1272,15 @@ class Node:
             with st.lock:
                 st.ready = False
                 st.worker = None
+                st.in_flight.clear()
+            # appendleft in reverse so retried in-flight tasks land at
+            # the queue FRONT in their collected order, ahead of tasks
+            # submitted after them (per-actor order; with
+            # max_concurrency=1 there is at most one).
+            for spec in reversed(retry_specs):
+                for rid in spec.return_ids:
+                    self.gcs.objects.register_pending(rid, spec)
+                self._enqueue_actor_task(st, spec, front=True)
             # Re-pin creation args for the replayed creation (they were
             # unpinned when the first creation completed).
             self._pin_task_args(st.spec)
@@ -1462,9 +1523,10 @@ class Node:
     # virtual nodes (cluster_utils.Cluster; reference:
     # python/ray/cluster_utils.py:135 — N raylets sharing one GCS)
     # ------------------------------------------------------------------
-    def add_virtual_node(self, resources: Dict[str, float]) -> str:
+    def add_virtual_node(self, resources: Dict[str, float],
+                         labels: Optional[Dict[str, str]] = None) -> str:
         node_id = NodeID.from_random().hex()
-        self.node_registry.add_node(node_id, resources)
+        self.node_registry.add_node(node_id, resources, labels=labels)
         self.scheduler.notify_worker_free()
         return node_id
 

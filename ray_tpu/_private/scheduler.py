@@ -110,10 +110,11 @@ class ResourceManager:
 
 class NodeEntry:
     __slots__ = ("node_id_hex", "rm", "alive", "start_time", "is_head",
-                 "daemon")
+                 "daemon", "labels")
 
     def __init__(self, node_id_hex: str, rm: ResourceManager,
-                 is_head: bool = False, daemon=None):
+                 is_head: bool = False, daemon=None,
+                 labels: Optional[Dict[str, str]] = None):
         import time
         self.node_id_hex = node_id_hex
         self.rm = rm
@@ -123,6 +124,42 @@ class NodeEntry:
         # Real per-host daemon backing this node (node_service.DaemonHandle);
         # None for the head and for virtual test nodes.
         self.daemon = daemon
+        # Node labels for NodeLabelSchedulingStrategy (reference:
+        # node labels on the NodeInfo table, scheduling/policy/
+        # node_label_scheduling_policy.cc). The implicit
+        # "ray.io/node_id" label always resolves.
+        self.labels = dict(labels or {})
+        self.labels.setdefault("ray.io/node_id", node_id_hex)
+
+
+from ..util.scheduling_strategies import (DoesNotExist, Exists, In,
+                                          NodeAffinitySchedulingStrategy,
+                                          NodeLabelSchedulingStrategy,
+                                          NotIn)
+
+
+def _labels_match(node_labels: Dict[str, str], expr: dict) -> bool:
+    """Evaluate a label expression dict {key: In/NotIn/Exists/
+    DoesNotExist or plain value} against a node's labels (reference:
+    label match operators in scheduling_strategies.py / node label
+    scheduling policy)."""
+    for key, op in (expr or {}).items():
+        val = node_labels.get(key)
+        if isinstance(op, In):
+            if val not in op.values:
+                return False
+        elif isinstance(op, NotIn):
+            if val in op.values:
+                return False
+        elif isinstance(op, Exists):
+            if val is None:
+                return False
+        elif isinstance(op, DoesNotExist):
+            if val is not None:
+                return False
+        elif val != op:  # plain value == In(value)
+            return False
+    return True
 
 
 class NodeRegistry:
@@ -136,16 +173,20 @@ class NodeRegistry:
     failover semantics are testable in-process (the reference's
     cluster_utils.Cluster pattern, SURVEY.md §4)."""
 
-    def __init__(self, head_id_hex: str, head_rm: ResourceManager):
+    def __init__(self, head_id_hex: str, head_rm: ResourceManager,
+                 head_labels: Optional[Dict[str, str]] = None):
         self._lock = threading.Lock()
         self._nodes: Dict[str, NodeEntry] = {}
-        self.head = NodeEntry(head_id_hex, head_rm, is_head=True)
+        self.head = NodeEntry(head_id_hex, head_rm, is_head=True,
+                              labels=head_labels)
         self._nodes[head_id_hex] = self.head
+        self._spread_rr = 0  # SPREAD round-robin cursor
 
     def add_node(self, node_id_hex: str, resources: Dict[str, float],
-                 daemon=None) -> NodeEntry:
+                 daemon=None,
+                 labels: Optional[Dict[str, str]] = None) -> NodeEntry:
         entry = NodeEntry(node_id_hex, ResourceManager(dict(resources)),
-                          daemon=daemon)
+                          daemon=daemon, labels=labels)
         with self._lock:
             self._nodes[node_id_hex] = entry
         return entry
@@ -166,16 +207,97 @@ class NodeRegistry:
         with self._lock:
             return list(self._nodes.values())
 
-    def acquire(self, demand: Dict[str, float]) -> Optional[str]:
-        """Pick a node and acquire `demand` on it; head-first (the hybrid
-        policy's local-node preference), then first-fit over the rest."""
-        if self.head.rm.try_acquire(demand):
-            return self.head.node_id_hex
-        for entry in self.entries():
-            if entry.is_head or not entry.alive:
-                continue
+    def acquire(self, demand: Dict[str, float],
+                strategy=None) -> Optional[str]:
+        """Pick a node and acquire `demand` on it, honoring the task's
+        scheduling strategy (reference: scheduling/policy/*.cc —
+        hybrid [default], spread, node_affinity, node_label policies).
+        Default: head-first (the hybrid policy's local-node
+        preference), then first-fit over the rest."""
+        for entry in self._candidates(strategy):
             if entry.rm.try_acquire(demand):
                 return entry.node_id_hex
+        return None
+
+    def _candidates(self, strategy) -> List[NodeEntry]:
+        """Ordered candidate nodes for a strategy. Unplaceable-by-
+        strategy (dead affinity target, unmatchable hard labels) yields
+        an empty list — strategy_unschedulable() tells permanent from
+        transient."""
+        if strategy is None:  # the hot default: hybrid head-first
+            rest = [e for e in self.entries()
+                    if e.alive and not e.is_head]
+            return [self.head] + rest
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            with self._lock:
+                target = self._nodes.get(strategy.node_id)
+            if target is not None and target.alive:
+                if strategy.soft or strategy._spill_on_unavailable:
+                    rest = [e for e in self.entries()
+                            if e.alive and e is not target]
+                    return [target] + rest
+                return [target]
+            if strategy.soft:
+                return [e for e in self.entries() if e.alive]
+            return []
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            alive = [e for e in self.entries() if e.alive]
+            hard = [e for e in alive
+                    if _labels_match(e.labels, strategy.hard)]
+            if not strategy.soft:
+                return hard
+            preferred = [e for e in hard
+                         if _labels_match(e.labels, strategy.soft)]
+            return preferred + [e for e in hard if e not in preferred]
+        if strategy == "SPREAD":
+            # Round-robin over alive nodes (reference:
+            # spread_scheduling_policy.cc — least-recently-used node
+            # first, head not preferred). The cursor advances on
+            # SUCCESSFUL dispatch only (note_spread_grant) — a grant
+            # that fails for lack of a worker must not burn the node's
+            # turn, or fast-path/slow-path aliasing can starve a node.
+            alive = [e for e in self.entries() if e.alive]
+            if not alive:
+                return []
+            start = self._spread_rr % len(alive)
+            return alive[start:] + alive[:start]
+        # DEFAULT / None / placement-group strategies: hybrid policy.
+        rest = [e for e in self.entries()
+                if e.alive and not e.is_head]
+        return [self.head] + rest
+
+    def note_spread_grant(self, node_id_hex: str):
+        """A SPREAD task was dispatched onto `node_id_hex`: rotate the
+        round-robin cursor past it."""
+        alive = [e for e in self.entries() if e.alive]
+        for i, e in enumerate(alive):
+            if e.node_id_hex == node_id_hex:
+                with self._lock:
+                    self._spread_rr = i + 1
+                return
+
+    def strategy_unschedulable(self, strategy) -> Optional[str]:
+        """A reason string when the strategy can NEVER be satisfied
+        (fail fast, skipping the autoscaler grace window): hard
+        affinity to a dead/unknown node, or hard labels no node
+        matches. Transient shortages return None (requeue)."""
+        if strategy is None or isinstance(strategy, str):
+            return None
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            if strategy.soft:
+                return None
+            with self._lock:
+                target = self._nodes.get(strategy.node_id)
+            if target is None or not target.alive:
+                return (f"NodeAffinitySchedulingStrategy: node "
+                        f"{strategy.node_id[:16]} is "
+                        f"{'dead' if target is not None else 'unknown'} "
+                        f"and soft=False")
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            if not any(_labels_match(e.labels, strategy.hard)
+                       for e in self.entries() if e.alive):
+                return ("NodeLabelSchedulingStrategy: no alive node "
+                        f"matches hard labels {strategy.hard!r}")
         return None
 
     def release(self, node_id_hex: str, demand: Dict[str, float]):
@@ -234,6 +356,10 @@ class WorkerHandle:
         self.env_key = env_key
         self.env = env
         self.send_lock = threading.Lock()
+        # Set (under send_lock) when a _NativeMux adopts this conn: sends
+        # then enqueue into the C++ core instead of write(2)-ing inline.
+        self.native_mux = None
+        self.native_token = 0
         self.recv_thread: Optional[threading.Thread] = None
         self.dedicated_actor = None   # ActorID when pinned to an actor
         self.running: Dict[bytes, P.TaskSpec] = {}  # in-flight tasks
@@ -248,6 +374,13 @@ class WorkerHandle:
     def send(self, msg_type: str, payload: dict):
         data = P.dump_message(msg_type, payload)
         with self.send_lock:
+            # Native path: enqueue into the C++ IO thread (no syscall on
+            # this thread). A False return means the conn is gone from
+            # the core; fall through so conn.send_bytes raises the same
+            # BrokenPipeError the failure paths expect.
+            mux = self.native_mux
+            if mux is not None and mux.send_framed(self.native_token, data):
+                return
             self.conn.send_bytes(data)
 
     def kill(self):
@@ -408,6 +541,130 @@ class _RecvMux:
         self._wake()
 
 
+class _NativeMux:
+    """Recv mux backed by the C++ dispatch core (_native/src/dispatch.cpp):
+    socket IO, frame reassembly, and send queues all live on a native
+    epoll thread with no GIL involvement; this pump thread drains
+    completed frames in batches (one GIL entry amortized over the whole
+    batch) and runs the same per-message handlers as _RecvMux.
+
+    Reference analogue: the raylet's asio io_service owning the worker
+    RPC sockets (common/asio/instrumented_io_context.h) with the Python
+    layer only seeing parsed, batched completions."""
+
+    def __init__(self):
+        import ctypes
+
+        from .. import _native
+        self._ctypes = ctypes
+        self._core = _native.NativeDispatcher()
+        self._eof_len = _native.EOF_LEN
+        self._lock = threading.Lock()
+        self._states: Dict[int, tuple] = {}  # token -> (handle, on_msg, on_eof)
+        self._next_token = 0
+        self._stopped = False
+        self._cap = 8 << 20
+        self._buf = ctypes.create_string_buffer(self._cap)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="native-recv-pump")
+        self._thread.start()
+
+    def register(self, handle: "WorkerHandle",
+                 on_message: Callable, on_eof: Callable):
+        with self._lock:
+            self._next_token += 1
+            token = self._next_token
+            self._states[token] = (handle, on_message, on_eof)
+        try:
+            ok = self._core.add(handle.conn.fileno(), token)
+        except (OSError, ValueError):
+            ok = False
+        if not ok:
+            with self._lock:
+                self._states.pop(token, None)
+            on_eof(handle)
+            return
+        # Flip sends to the native queue. Taking send_lock serializes
+        # against any in-flight conn.send_bytes, so frames never
+        # interleave across the two paths.
+        with handle.send_lock:
+            handle.native_token = token
+            handle.native_mux = self
+
+    def send_framed(self, token: int, data: bytes) -> bool:
+        return self._core.send(token, data)
+
+    def _loop(self):
+        import struct
+
+        import cloudpickle
+        mv = memoryview(self._buf)
+        while not self._stopped:
+            n = self._core.recv_batch(self._buf, self._cap, 1000)
+            if n == 0:
+                continue
+            if n < 0:
+                # One frame larger than the buffer: grow and retry.
+                self._cap = max(-n, self._cap * 2)
+                self._buf = self._ctypes.create_string_buffer(self._cap)
+                mv = memoryview(self._buf)
+                continue
+            pos = 0
+            while pos < n:
+                token, ln = struct.unpack_from("=QQ", mv, pos)
+                with self._lock:
+                    state = self._states.get(token)
+                if ln == self._eof_len:
+                    pos += 16
+                    self._core.remove(token)
+                    if state is not None:
+                        handle = state[0]
+                        with handle.send_lock:
+                            handle.native_mux = None
+                        with self._lock:
+                            self._states.pop(token, None)
+                        state[2](handle)
+                    continue
+                frame = mv[pos + 16:pos + 16 + ln]
+                pos += 16 + ln
+                if state is None:
+                    continue
+                try:
+                    msg_type, payload = cloudpickle.loads(frame)
+                    state[1](state[0], msg_type, payload)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+
+    def stop(self):
+        self._stopped = True
+        # Detach every handle first: a late send() must fall back to
+        # conn.send_bytes, not enqueue into a core being torn down.
+        with self._lock:
+            states = list(self._states.values())
+            self._states.clear()
+        for handle, _on_msg, _on_eof in states:
+            with handle.send_lock:
+                handle.native_mux = None
+        self._core.stop()
+        self._thread.join(timeout=2.0)
+        if not self._thread.is_alive():
+            self._core.destroy()
+        # else: pump is stuck in a slow handler — leak the core rather
+        # than free memory a live thread still dereferences.
+
+
+def _make_recv_mux():
+    """Native dispatch core when buildable (RAY_TPU_NATIVE_DISPATCH=0
+    forces the pure-Python epoll mux)."""
+    if os.environ.get("RAY_TPU_NATIVE_DISPATCH", "1") != "0":
+        try:
+            return _NativeMux()
+        except Exception:
+            pass
+    return _RecvMux()
+
+
 class WorkerPool:
     """Spawns and pools worker processes (reference: WorkerPool,
     src/ray/raylet/worker_pool.cc:447 StartWorkerProcess / :1355 PopWorker)."""
@@ -424,7 +681,7 @@ class WorkerPool:
         self._node_id_hex = node_id_hex
         self._authkey = os.urandom(16)
         self._lock = threading.Lock()
-        self._mux = _RecvMux()
+        self._mux = _make_recv_mux()
         self._idle: Dict[str, Deque[WorkerHandle]] = collections.defaultdict(
             collections.deque)
         self.workers: Dict[WorkerID, WorkerHandle] = {}
@@ -733,6 +990,12 @@ class Scheduler:
             self._cond.notify()
 
     def notify_worker_free(self):
+        # Cheap no-op when nothing is parked: waking the dispatch thread
+        # per completion just to find an empty queue is a GIL convoy on
+        # a many-core box (each wake is a futex + context switch racing
+        # the completion pump for the GIL).
+        if not self._ready and not self._waiting:
+            return
         with self._cond:
             self._cond.notify()
 
@@ -740,8 +1003,14 @@ class Scheduler:
         """Dispatch without starting workers: resources + an idle worker
         or nothing. Runs on submitter/recv threads (the reference's
         direct-dispatch when a lease is already held)."""
+        strategy = getattr(spec, "scheduling_strategy", None)
+        if strategy == "SPREAD":
+            # SPREAD placement goes through the dispatch loop: the
+            # round-robin cursor only advances on successful dispatch,
+            # and this path can't start workers on the chosen node.
+            return False
         demand = spec.resources
-        node_id = self.nodes.acquire(demand)
+        node_id = self.nodes.acquire(demand, strategy)
         if node_id is None:
             return False
         env_key = self._env_key_for(spec)
@@ -859,6 +1128,19 @@ class Scheduler:
     def _try_dispatch(self, spec) -> bool:
         demand = spec.resources
         is_actor_creation = isinstance(spec, P.ActorSpec)
+        strategy = getattr(spec, "scheduling_strategy", None)
+        reason = self.nodes.strategy_unschedulable(strategy)
+        if reason is not None:
+            # Permanently unplaceable BY STRATEGY (dead affinity target,
+            # unmatchable hard labels): fail fast — no autoscaler grace,
+            # a dead node id never comes back (reference:
+            # node_affinity_scheduling_policy.cc fails the lease when
+            # the target node is gone).
+            from ..exceptions import TaskUnschedulableError
+            spec._env_error = TaskUnschedulableError(
+                f"Task {spec.name}: {reason}")
+            self._dispatch_fn(spec, None)
+            return True
         if not self.nodes.feasible(demand):
             # Infeasible NOW. With an active autoscaler the demand is its
             # upscale signal, so the task parks for the grace window
@@ -878,8 +1160,16 @@ class Scheduler:
             self._dispatch_fn(spec, None)
             return True
         self._infeasible_since.pop(self._spec_key(spec), None)
-        node_id = self.nodes.acquire(demand)
+        node_id = self.nodes.acquire(demand, strategy)
         if node_id is None:
+            if getattr(strategy, "_fail_on_unavailable", False):
+                from ..exceptions import TaskUnschedulableError
+                spec._env_error = TaskUnschedulableError(
+                    f"Task {spec.name}: affinity target node "
+                    f"{strategy.node_id[:16]} cannot grant {demand} "
+                    f"now and _fail_on_unavailable=True")
+                self._dispatch_fn(spec, None)
+                return True
             return False
         env_key = self._env_key_for(spec)
         entry = self.nodes.get(node_id)
@@ -909,6 +1199,8 @@ class Scheduler:
                 self.nodes.release(node_id, demand)
                 return False
             self._task_node[self._spec_key(spec)] = node_id
+            if strategy == "SPREAD":
+                self.nodes.note_spread_grant(node_id)
             self._dispatch_fn(spec, worker)
             return True
         worker = self.pool.pop_idle(env_key)
@@ -937,6 +1229,8 @@ class Scheduler:
             self.nodes.release(node_id, demand)
             return False
         self._task_node[self._spec_key(spec)] = node_id
+        if strategy == "SPREAD":
+            self.nodes.note_spread_grant(node_id)
         self._dispatch_fn(spec, worker)
         return True
 
@@ -1018,7 +1312,12 @@ class Scheduler:
             try:
                 h = self.pool.start_worker("")
             except Exception:
-                return  # shutdown raced the prestart
+                # Shutdown raced the prestart, or the start failed:
+                # release the cap slot reserved below, or the phantom
+                # count starves _maybe_start_worker forever.
+                with self._lock:
+                    self._started_workers -= 1
+                return
             self.pool.push_idle(h)
             self.notify_worker_free()
         with self._lock:

@@ -292,6 +292,28 @@ def _ambient_pg_spec():
     return None
 
 
+def _validate_scheduling_strategy(strategy):
+    """Reject unknown strategies at decoration/.options() time — a
+    placement constraint that would be silently ignored is worse than
+    an error (reference: ray_option_utils.py _validate_scheduling
+    strategy check)."""
+    from .util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy,
+        PlacementGroupSchedulingStrategy)
+
+    if strategy is None or isinstance(
+            strategy, (PlacementGroupSchedulingStrategy,
+                       NodeAffinitySchedulingStrategy,
+                       NodeLabelSchedulingStrategy)):
+        return strategy
+    if strategy in ("DEFAULT", "SPREAD"):
+        return strategy
+    raise ValueError(
+        f"Invalid scheduling_strategy {strategy!r}: expected one of "
+        f"\"DEFAULT\", \"SPREAD\", PlacementGroupSchedulingStrategy, "
+        f"NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy")
+
+
 def _apply_placement(opts: Dict, resources: Dict[str, float]):
     """Resolve placement-group options into the formatted-resource demand
     rewrite (reference: ray_option_utils + BundleSpecification resource
@@ -431,6 +453,7 @@ class RemoteFunction:
         self._max_retries = opts.get("max_retries")
         self._retry_exceptions = bool(opts.get("retry_exceptions", False))
         self._runtime_env = _validate_runtime_env(opts.get("runtime_env"))
+        _validate_scheduling_strategy(opts.get("scheduling_strategy"))
         self._name = opts.get("name", getattr(self._fn, "__name__", "f"))
         # Placement resolution is per-call only when a PG/strategy is in
         # play (explicitly, or potentially inherited from an ambient
@@ -600,7 +623,14 @@ class ActorHandle:
             return_ids=return_ids, num_returns=num_returns,
             name=f"{self._cls_id.split(':')[0]}.{method_name}",
             actor_id=self._actor_id, method_name=method_name,
-            max_retries=0, streaming=streaming)
+            # Per-call retry budget; unset (-2 sentinel) falls back to
+            # the actor's max_task_retries at submit time; -1 retries
+            # forever; an explicit 0 DISABLES retries (reference:
+            # actor.py method max_task_retries semantics).
+            max_retries=(-2 if opts.get("max_task_retries") is None
+                         else int(opts["max_task_retries"])),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            streaming=streaming)
         refs = [ObjectRef(rid) for rid in return_ids]
         tr = _tracing()
         if tr is not None and tr.is_enabled():
@@ -727,7 +757,8 @@ class ActorClass:
             resources=_actor_resources,
             placement_group_id=_actor_pg_id,
             placement_group_bundle_index=_actor_bundle_index,
-            scheduling_strategy=opts.get("scheduling_strategy"),
+            scheduling_strategy=_validate_scheduling_strategy(
+                opts.get("scheduling_strategy")),
             runtime_env=_validate_runtime_env(opts.get("runtime_env")),
             lifetime=opts.get("lifetime"),
             method_meta=self._method_meta,
@@ -840,6 +871,13 @@ class RuntimeContext:
         node = state.get_node()
         if node is not None:
             return node.node_id.hex()
+        from ._private import state as st
+        if st._worker is not None:
+            # Workers know their host node from the boot config
+            # (reference: the core worker's NodeID from the raylet).
+            nid = getattr(st._worker.config, "node_id_hex", None)
+            if nid:
+                return nid
         rt = state.current_or_none()
         if rt is not None and hasattr(rt, "gcs_request"):
             return "worker-node"

@@ -70,6 +70,7 @@ class Cluster:
 
     def add_node(self, *, num_cpus: float = 1, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
                  daemon: Optional[bool] = None, wait: bool = True,
                  **_ignored) -> Node:
         rt = state.current()
@@ -77,17 +78,17 @@ class Cluster:
             daemon = os.environ.get("RAY_TPU_CLUSTER_DAEMONS") == "1"
         if daemon:
             node = self._spawn_daemon(rt, num_cpus, num_tpus,
-                                      resources, wait)
+                                      resources, labels, wait)
         else:
             res = {"CPU": float(num_cpus)}
             if num_tpus:
                 res["TPU"] = float(num_tpus)
             res.update(resources or {})
-            node = Node(rt.add_virtual_node(res))
+            node = Node(rt.add_virtual_node(res, labels=labels))
         self._nodes.append(node)
         return node
 
-    def _spawn_daemon(self, rt, num_cpus, num_tpus, resources,
+    def _spawn_daemon(self, rt, num_cpus, num_tpus, resources, labels,
                       wait: bool) -> Node:
         import json
         host, port = rt.head_server.address
@@ -100,6 +101,8 @@ class Cluster:
             argv += ["--num-tpus", str(num_tpus)]
         if resources:
             argv += ["--resources", json.dumps(resources)]
+        if labels:
+            argv += ["--labels", json.dumps(labels)]
         before = set(rt.head_server.daemons)
         proc = subprocess.Popen(argv, env=env)
         deadline = time.monotonic() + 60.0

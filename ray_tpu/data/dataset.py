@@ -274,23 +274,31 @@ class _MapBatchesActorPool:
 
         import cloudpickle
         blob = cloudpickle.dumps((fn_cls, ctor_args, ctor_kwargs))
+        # Pool actors self-heal (reference: ActorPoolMapOperator
+        # restarts failed workers and re-runs their in-flight bundles,
+        # actor_pool_map_operator.py:34,446): worker death replays the
+        # constructor and retries in-flight applies; transient
+        # exceptions (e.g. a compile-service hiccup) retry via
+        # retry_exceptions below. User opts can override.
+        opts = {"max_restarts": 3, "max_task_retries": 2, **opts}
         self.actors = [
             _BatchMapper.options(**opts).remote(blob)
             for _ in range(pool_size)
         ]
+        self._call_opts = {"retry_exceptions": True, "max_task_retries": 2}
 
     def submit(self, blk_ref, batch_size, batch_format, fn_args,
                fn_kwargs):
         actor = self.actors[self._rr % len(self.actors)]
         self._rr += 1
-        return actor.apply.remote(blk_ref, batch_size, batch_format,
-                                  fn_args, fn_kwargs)
+        return actor.apply.options(**self._call_opts).remote(
+            blk_ref, batch_size, batch_format, fn_args, fn_kwargs)
 
     def map(self, bundles, batch_size, batch_format, fn_args, fn_kwargs):
         from ..util.actor_pool import ActorPool
         pool = ActorPool(self.actors)
         results = list(pool.map(
-            lambda a, blk_ref: a.apply.remote(
+            lambda a, blk_ref: a.apply.options(**self._call_opts).remote(
                 blk_ref, batch_size, batch_format, fn_args, fn_kwargs),
             [b.ref for b in bundles]))
         out = []

@@ -90,7 +90,7 @@ def _pipeline_forward(mesh, stage_fn: Callable, n_microbatches: int,
     """Shared shard_map builder: stage_params stacked on axis 0 (one
     slice per stage, sharded over `axis_name`); x global
     [n_micro * mb_size, ...]."""
-    from jax.experimental.shard_map import shard_map
+    from ray_tpu.parallel.ops import shard_map
     from jax.sharding import PartitionSpec as P
 
     params_spec = params_spec if params_spec is not None else P(axis_name)

@@ -117,7 +117,7 @@ def sequence_parallel_attention(mesh, q, k, v, axis_name: str = "sp",
     """shard_map wrapper: q/k/v are global [B, H, S, D] arrays (sharded or
     not); the sequence axis is split over `axis_name` and ring attention
     runs on the shards."""
-    from jax.experimental.shard_map import shard_map
+    from ray_tpu.parallel.ops import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, None, axis_name, None)

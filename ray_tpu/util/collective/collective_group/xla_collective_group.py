@@ -223,7 +223,7 @@ class XLAGroup(BaseGroup):
     def _shard_map_op(self, key, body):
         """jit(shard_map(body)) over the world mesh, P('world')->P('world')."""
         import jax
-        from jax.experimental.shard_map import shard_map
+        from ray_tpu.parallel.ops import shard_map
         from jax.sharding import PartitionSpec as P
 
         def build():
@@ -311,7 +311,7 @@ class XLAGroup(BaseGroup):
         import jax
         import jax.numpy as jnp
         from jax import lax
-        from jax.experimental.shard_map import shard_map
+        from ray_tpu.parallel.ops import shard_map
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         if src == dst:

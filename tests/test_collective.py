@@ -159,7 +159,7 @@ class TestCollectiveHLOShapes:
     def test_p2p_is_collective_permute(self):
         import jax
         from jax import lax
-        from jax.experimental.shard_map import shard_map
+        from ray_tpu.parallel.ops import shard_map
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         import jax.numpy as jnp
 
@@ -185,7 +185,7 @@ class TestCollectiveHLOShapes:
         import jax
         import jax.numpy as jnp
         from jax import lax
-        from jax.experimental.shard_map import shard_map
+        from ray_tpu.parallel.ops import shard_map
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         devs = jax.devices()[:4]
