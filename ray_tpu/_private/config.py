@@ -91,6 +91,15 @@ class RayConfig:
         # jax/TPU-plugin import, ~5s per process). Disable if user code
         # depends on site customizations inside CPU workers.
         "worker_lean_boot": True,
+        # -- head fault tolerance (reference: GCS server restart +
+        # gcs_client_reconnection_test.cc) -------------------------------
+        # Node-daemon reconnect attempts after losing the head (0 = die
+        # with the cluster — the in-process test-cluster default;
+        # `ray_tpu start --address` join mode raises it so production
+        # nodes survive a head restart).
+        "head_reconnect_attempts": 0,
+        # Initial reconnect backoff; doubles per attempt, capped at 5s.
+        "head_reconnect_backoff_s": 0.5,
     }
 
     def __init__(self):

@@ -27,6 +27,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from . import protocol as P
+from .config import ray_config
 from .ids import NodeID, WorkerID
 from .netcomm import PullManager, TransferServer, store_paths_factory
 from .object_store import create_store
@@ -85,10 +86,21 @@ class NodeDaemon:
         self._transfer_addrs: Dict[str, Tuple[str, int]] = {}
         self._stopped = threading.Event()
 
-        from multiprocessing.connection import Client
-        self.conn = Client(tuple(address), family="AF_INET", authkey=token)
+        self._address = tuple(address)
+        self._token = token
         self.head_host = address[0]
-        self._send(P.REGISTER_NODE, {
+        self._heartbeat_interval = float(ray_config.node_heartbeat_s)
+        self._connect_head()
+
+    def _connect_head(self):
+        """(Re)establish the head link and register this node
+        (reference: the raylet registering with the GCS server,
+        gcs_server_main.cc:47; on reconnection the node re-registers
+        like a fresh join — gcs_client_reconnection_test.cc)."""
+        from multiprocessing.connection import Client
+        conn = Client(self._address, family="AF_INET",
+                      authkey=self._token)
+        register = P.dump_message(P.REGISTER_NODE, {
             "node_id_hex": self.node_hex,
             "resources": dict(self.totals),
             "transfer_port": self.transfer.port,
@@ -96,6 +108,13 @@ class NodeDaemon:
             "pid": os.getpid(),
             "labels": self.labels,
         })
+        # Swap + register under the send lock: the long-lived heartbeat
+        # thread must not slip a NODE_PING onto the fresh connection
+        # before REGISTER_NODE (the head closes conns whose first
+        # message isn't a registration, node_service.py _serve_daemon).
+        with self._send_lock:
+            self.conn = conn
+            conn.send_bytes(register)
         msg_type, payload = self._recv()
         if msg_type != P.NODE_ACK:
             raise RuntimeError(f"head rejected registration: {msg_type}")
@@ -104,9 +123,58 @@ class NodeDaemon:
         if head_tport:
             self._transfer_addrs[self.head_node_hex] = (
                 self.head_host, head_tport)
-        self._heartbeat_interval = float(ray_config.node_heartbeat_s)
-        threading.Thread(target=self._heartbeat_loop, daemon=True,
-                         name="heartbeat").start()
+        # One heartbeat thread across reconnects: the loop survives send
+        # failures and just picks up the fresh self.conn.
+        hb = getattr(self, "_hb_thread", None)
+        if hb is None or not hb.is_alive():
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True, name="heartbeat")
+            self._hb_thread.start()
+
+    def _reset_for_reconnect(self):
+        """Head restarted: its view of our workers/tasks is gone. Kill
+        the pool (in-flight work is unowned now), return chips, and
+        start clean — the reconnect registers the node fresh.
+
+        death_handled is set FIRST: the recv-mux EOF callbacks for these
+        kills fire asynchronously and would otherwise re-release chips /
+        re-decrement the pool counter on top of the wholesale reset
+        below (duplicate chip ids -> two workers pinned to one chip)."""
+        for handle in list(self.pool.workers.values()):
+            handle.death_handled = True
+            handle.chip_ids = []
+            handle.counted_in_pool = False
+            try:
+                handle.kill()
+            except Exception:
+                pass
+            self.pool.remove(handle)
+        with self._lock:
+            self._pool_workers = 0
+            self._free_chips = list(range(int(self.totals.get("TPU", 0))))
+
+    def _reconnect_with_backoff(self) -> bool:
+        """Try to rejoin the head, doubling backoff per attempt (capped
+        5s). Returns True once reconnected, False when attempts are
+        exhausted (or reconnect is disabled)."""
+        attempts = int(ray_config.head_reconnect_attempts)
+        delay = float(ray_config.head_reconnect_backoff_s)
+        for i in range(attempts):
+            if self._stopped.wait(min(delay, 5.0)):
+                return False
+            delay *= 2
+            try:
+                self._connect_head()
+                print(f"[ray_tpu daemon {self.node_hex[:8]}] rejoined "
+                      f"head at {self._address} (attempt {i + 1})",
+                      flush=True)
+                return True
+            except Exception:
+                try:
+                    self.conn.close()
+                except Exception:
+                    pass
+        return False
 
     # -- head link -----------------------------------------------------
     def _send(self, msg_type: str, payload: dict):
@@ -149,6 +217,10 @@ class NodeDaemon:
                     "store_used": getattr(self.store, "used_bytes", 0),
                     "num_workers": len(self.pool.workers)})
             except Exception:
+                if int(ray_config.head_reconnect_attempts) > 0:
+                    # Reconnect mode: the run() loop owns rejoining;
+                    # keep ticking so pings resume on the fresh conn.
+                    continue
                 return
 
     # -- main loop -----------------------------------------------------
@@ -158,10 +230,16 @@ class NodeDaemon:
                 try:
                     msg_type, payload = self._recv()
                 except (EOFError, OSError):
-                    # Head gone: the node dies with the cluster. Unblock
-                    # any threads waiting on head replies first.
+                    # Head gone. Unblock threads waiting on head replies,
+                    # then either rejoin a restarted head (standalone
+                    # join mode, head_reconnect_attempts > 0) or die with
+                    # the cluster (the in-process test-cluster default).
                     self._fail_pending(
                         ConnectionError("head connection lost"))
+                    if int(ray_config.head_reconnect_attempts) > 0:
+                        self._reset_for_reconnect()
+                        if self._reconnect_with_backoff():
+                            continue
                     break
                 self._route(msg_type, payload)
         finally:

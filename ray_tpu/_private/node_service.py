@@ -294,10 +294,24 @@ class HeadServer:
                 handle.fail_pending(
                     ConnectionError(f"node {handle.node_id_hex[:8]} "
                                     f"disconnected"))
+                # A reconnecting daemon re-registers the SAME node id on
+                # a fresh connection; this stale connection's cleanup
+                # must not evict the new registration (reference: GCS
+                # node re-registration vs. old-channel teardown race).
                 with self._lock:
-                    self.daemons.pop(handle.node_id_hex, None)
+                    current = self.daemons.get(handle.node_id_hex)
+                    superseded = current is not None and current is not handle
+                    if not superseded:
+                        self.daemons.pop(handle.node_id_hex, None)
                 if not self._stopped:
-                    self._node._on_daemon_lost(handle)
+                    if superseded:
+                        # The node re-registered on a fresh connection;
+                        # keep it alive but fail THIS connection's
+                        # worker proxies (their processes are gone and
+                        # can never report WORKER_DIED).
+                        self._node._fail_daemon_worker_proxies(handle)
+                    else:
+                        self._node._on_daemon_lost(handle)
             try:
                 conn.close()
             except Exception:

@@ -222,8 +222,17 @@ class Node:
             store_paths_factory
         from .node_service import HeadServer
         token_hex = os.environ.get("RAY_TPU_CLUSTER_TOKEN_HEX", "")
-        self.cluster_token = (bytes.fromhex(token_hex) if token_hex
-                              else os.urandom(16))
+        if token_hex:
+            self.cluster_token = bytes.fromhex(token_hex)
+        else:
+            # Durable-storage heads keep their token across restarts so
+            # daemons and clients re-authenticate after a head crash
+            # (reference: GCS FT — the restarted gcs_server serves the
+            # same cluster identity from Redis).
+            stored = self.gcs.kv.get("cluster_token", namespace="__head__")
+            self.cluster_token = stored or os.urandom(16)
+        self.gcs.kv.put("cluster_token", self.cluster_token,
+                        namespace="__head__")
         paths_for, view_for = store_paths_factory(self.store)
         self.transfer_server = TransferServer(
             paths_for, self.cluster_token,
@@ -411,6 +420,16 @@ class Node:
             relocate=lambda oid, size:
                 (P.LOC_SHM, size, head_hex)
                 if self.store.contains(oid) else None)
+        self._fail_daemon_worker_proxies(handle)
+
+    def _fail_daemon_worker_proxies(self, handle):
+        """Fail every worker proxy of a daemon connection through the
+        standard death paths. Also used alone when a reconnecting
+        daemon SUPERSEDES its old connection: the node stays alive (no
+        object loss, no registry removal), but the old connection's
+        workers were killed daemon-side and can never deliver
+        WORKER_DIED — without this, drivers blocked on their tasks wait
+        forever."""
         for proxy in list(handle.proxies.values()):
             if not proxy.death_handled:
                 proxy.death_handled = True

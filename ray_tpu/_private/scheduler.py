@@ -337,6 +337,10 @@ class NodeRegistry:
                 # the head is the single scheduler, so this is the
                 # observability face, not a second source of truth.
                 row["hostname"] = e.daemon.hostname
+                # The node's reachable IP as seen by the head (the
+                # registration socket's peer) — what multi-host clients
+                # must dial, NOT a 0.0.0.0 bind address.
+                row["host"] = e.daemon.transfer_addr[0]
                 row["last_heartbeat"] = e.daemon.last_ping
                 row.update({f"load_{k}": v
                             for k, v in (e.daemon.load or {}).items()})

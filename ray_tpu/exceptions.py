@@ -128,5 +128,14 @@ class PlacementGroupSchedulingError(RayError):
     """Placement group bundles could not be reserved."""
 
 
+class HeadConnectionError(RayError):
+    """The connection to the cluster head was lost mid-call (head
+    crashed or restarted). In-flight operations raise this; the client
+    reconnects with backoff, so SUBSEQUENT calls proceed against the
+    restarted head (reference: GCS client reconnection,
+    gcs_client_reconnection_test.cc — in-flight RPCs fail, the channel
+    re-establishes)."""
+
+
 class CrossSystemError(RayError):
     """Error raised by a subsystem (train/data/tune/serve) controller."""

@@ -58,12 +58,17 @@ def cmd_start(args):
                   "RAY_TPU_CLUSTER_TOKEN_HEX (printed by the head)")
             return 1
         host, _, port = args.address.rpartition(":")
+        from ray_tpu._private.config import ray_config
         if (host not in ("127.0.0.1", "localhost")
                 and "RAY_TPU_NODE_HOST" not in os.environ):
             # Joining a remote head: this node's transfer server must be
             # reachable from the other hosts, not loopback-only.
-            from ray_tpu._private.config import ray_config
             ray_config.set("node_host", "0.0.0.0")
+        if "RAY_TPU_HEAD_RECONNECT_ATTEMPTS" not in os.environ:
+            # Production join mode: nodes survive a head restart by
+            # rejoining with backoff (reference: raylets reconnect to a
+            # restarted GCS, gcs_client_reconnection_test.cc).
+            ray_config.set("head_reconnect_attempts", 120)
         daemon = NodeDaemon(
             (host, int(port)), bytes.fromhex(token_hex),
             num_cpus=args.num_cpus,

@@ -167,6 +167,15 @@ def start(http_options: Optional[HTTPOptions] = None, *,
         from ._private.proxy import HTTPProxy
         opts = http_options or HTTPOptions(port=0)
         _proxy = HTTPProxy(controller, opts.host, opts.port)
+        # Multi-host data plane: the controller keeps one proxy actor on
+        # every non-head node (reference: proxy_state.py EveryNode
+        # location default); the in-driver proxy above covers the head.
+        try:
+            ray_tpu.get(controller.configure_proxies.remote(
+                opts.host if opts.host != "127.0.0.1" else "0.0.0.0",
+                opts.port), timeout=30)
+        except Exception:
+            pass
     return controller
 
 
@@ -250,10 +259,31 @@ def proxy_address() -> Optional[str]:
     return f"http://{_proxy.host}:{_proxy.port}" if _proxy else None
 
 
+def proxy_addresses() -> Dict[str, str]:
+    """Every node's ingress URL: the driver proxy plus the controller's
+    per-node proxy actors (reference: proxy locations in serve.status)."""
+    out: Dict[str, str] = {}
+    if _proxy is not None:
+        out["_driver"] = f"http://{_proxy.host}:{_proxy.port}"
+    try:
+        from ._private.controller import get_controller
+        table = ray_tpu.get(
+            get_controller().get_proxy_table.remote(), timeout=10)
+        for node_hex, (host, port) in table.items():
+            # The controller already resolved 0.0.0.0 binds to the
+            # node's registered peer IP; loopback remains only for
+            # single-machine clusters, where it IS the right address.
+            shown = "127.0.0.1" if host in ("0.0.0.0", "::") else host
+            out[node_hex] = f"http://{shown}:{port}"
+    except Exception:
+        pass
+    return out
+
+
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "batch",
     "delete", "deployment", "get_app_handle", "get_deployment_handle",
-    "pad_batch_to_bucket", "proxy_address", "run", "shutdown", "start", "start_grpc",
+    "pad_batch_to_bucket", "proxy_address", "proxy_addresses", "run", "shutdown", "start", "start_grpc",
     "status",
 ]

@@ -38,6 +38,11 @@ class ServeController:
         self._routes: Dict[str, tuple] = {}             # prefix -> (app, dep)
         self._long_poll = LongPollHost()
         self._shutdown = False
+        # Per-node proxy reconciliation (reference: proxy_state.py
+        # ProxyStateManager): node_hex -> (actor_handle, (host, port)).
+        self._proxies: Dict[str, tuple] = {}
+        self._proxy_config: Optional[Dict[str, Any]] = None
+        self._proxy_errors: Dict[str, str] = {}
         # The reconcile task is started lazily from the first async method:
         # __init__ runs on the worker's main thread, while async actor
         # methods run on the dedicated actor event loop (worker_proc.py
@@ -108,6 +113,12 @@ class ServeController:
             await self._stop_deployment(name)
         self._deployments.clear()
         self._apps.clear()
+        for node_hex, (handle, _addr) in list(self._proxies.items()):
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+        self._proxies.clear()
         return True
 
     async def listen_for_change(self, snapshot_ids: Dict[str, int],
@@ -223,6 +234,111 @@ class ServeController:
             else:
                 st.last_upscale_ok_t = st.last_downscale_ok_t = 0.0
 
+    # -- per-node proxies --------------------------------------------------
+    async def configure_proxies(self, host: str = "0.0.0.0",
+                                port: int = 0) -> bool:
+        """Enable per-node ingress: the reconcile loop keeps one
+        ProxyReplica actor on every alive non-head node (the driver's
+        in-process proxy covers the head). Reference: proxy_state.py
+        ProxyStateManager.update()."""
+        self._ensure_loop_task()
+        self._proxy_config = {"host": host, "port": port}
+        await self._reconcile_proxies()
+        return True
+
+    async def get_proxy_table(self) -> Dict[str, tuple]:
+        """node_hex -> (host, port) for every live node proxy."""
+        self._ensure_loop_task()
+        return {n: addr for n, (_h, addr) in self._proxies.items()
+                if addr is not None}
+
+    async def _reconcile_proxies(self):
+        if self._proxy_config is None:
+            return
+        import traceback
+
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        from ray_tpu.util.state import list_nodes
+        loop = asyncio.get_event_loop()
+        try:
+            nodes = await loop.run_in_executor(None, list_nodes)
+        except Exception:
+            self._proxy_errors["_list_nodes"] = traceback.format_exc()
+            return
+        rows = [n for n in nodes
+                if n.get("alive", True) and not n.get("is_head")]
+        alive = {n["node_id"] for n in rows}
+        # The head records each daemon's reachable peer IP at
+        # registration; a proxy bound to 0.0.0.0 must be advertised at
+        # THAT address, not its bind address.
+        node_host = {n["node_id"]: n.get("host") for n in rows}
+        # Drop proxies on dead nodes; health-check the rest.
+        for node_hex in list(self._proxies):
+            handle, _addr = self._proxies[node_hex]
+            if node_hex not in alive:
+                self._proxies.pop(node_hex, None)
+                try:
+                    ray_tpu.kill(handle)
+                except Exception:
+                    pass
+                continue
+        # Health: a proxy whose server thread died serves
+        # connection-refused; replace it (reference: proxy_state.py
+        # proxy health states).
+        for node_hex, (handle, _addr) in list(self._proxies.items()):
+            try:
+                ok = await asyncio.wait_for(handle.check_health.remote(),
+                                            timeout=15)
+            except Exception:
+                ok = False
+            if not ok:
+                self._proxies.pop(node_hex, None)
+                try:
+                    ray_tpu.kill(handle)
+                except Exception:
+                    pass
+        for node_hex in alive:
+            if node_hex in self._proxies:
+                continue
+            from .proxy import ProxyReplica
+            name = f"SERVE_PROXY::{node_hex[:12]}"
+            handle = None
+            try:
+                # Adopt a live orphan first (e.g. a prior reconcile that
+                # timed out after the actor booted) — the name is
+                # unique, so re-creating would fail forever.
+                try:
+                    handle = ray_tpu.get_actor(name)
+                except Exception:
+                    handle = ray_tpu.remote(ProxyReplica).options(
+                        name=name,
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            node_id=node_hex, soft=False),
+                    ).remote(self._proxy_config["host"],
+                             self._proxy_config["port"])
+                addr_ref = handle.address.remote()
+                _node, h, p = await asyncio.wait_for(addr_ref, timeout=60)
+                if h in ("0.0.0.0", "::") and node_host.get(node_hex):
+                    h = node_host[node_hex]
+                self._proxies[node_hex] = (handle, (h, p))
+                self._proxy_errors.pop(node_hex, None)
+            except Exception:
+                # Node racing away / worker boot failure: kill the
+                # half-created actor (a live orphan would hold the name
+                # and wedge every future attempt), keep the last error
+                # observable, retry next tick.
+                if handle is not None:
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:
+                        pass
+                self._proxy_errors[node_hex] = traceback.format_exc()
+                continue
+
+    async def proxy_errors(self) -> Dict[str, str]:
+        return dict(self._proxy_errors)
+
     async def _reconcile_loop(self):
         tick = 0
         while not self._shutdown:
@@ -230,6 +346,8 @@ class ServeController:
                 await self._reconcile_once()
                 if tick % 4 == 1:
                     await self._health_and_autoscale()
+                if tick % 8 == 2:
+                    await self._reconcile_proxies()
             except Exception:
                 pass
             tick += 1

@@ -254,3 +254,28 @@ class HTTPProxy:
 
 
 _SENTINEL = object()
+
+
+class ProxyReplica:
+    """Actor wrapper hosting one HTTPProxy on ITS node — the controller
+    schedules one per cluster node with hard NodeAffinity, giving every
+    node a local ingress (reference: serve/_private/proxy_state.py
+    ProxyStateManager — one proxy actor per node, reconciled by the
+    controller; proxy.py:752)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        import ray_tpu
+        from .controller import CONTROLLER_NAME
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        self._proxy = HTTPProxy(controller, host, port)
+        self._node = ray_tpu.get_runtime_context().get_node_id()
+
+    def address(self):
+        """(node_id_hex, host, port) once the server is listening."""
+        return (self._node, self._proxy.host, self._proxy.port)
+
+    def check_health(self) -> bool:
+        return self._thread_alive()
+
+    def _thread_alive(self) -> bool:
+        return self._proxy._thread.is_alive()

@@ -31,10 +31,16 @@ def _resolve_token(token) -> bytes:
 
 
 class ClientConnection:
-    def __init__(self, address: str, token=None):
+    def __init__(self, address: str, token=None,
+                 reconnect_attempts: int = 20,
+                 reconnect_backoff_s: float = 0.25):
         host, port = address.rsplit(":", 1)
-        self._conn = _MpClient((host, int(port)), family="AF_INET",
-                               authkey=_resolve_token(token))
+        self._addr = (host, int(port))
+        self._authkey = _resolve_token(token)
+        self._reconnect_attempts = int(reconnect_attempts)
+        self._reconnect_backoff_s = float(reconnect_backoff_s)
+        self._conn = _MpClient(self._addr, family="AF_INET",
+                               authkey=self._authkey)
         self._lock = threading.Lock()
         # Refs released by ClientObjectRef.__del__ queue here and piggyback
         # on the next request: __del__ can fire from cyclic GC *inside*
@@ -43,17 +49,74 @@ class ClientConnection:
         # (the reference routes releases through a background datapath for
         # the same reason, util/client/dataclient.py).
         self._pending_releases: list = []
+        # fn/class registrations, replayed onto a restarted head so the
+        # SAME driver session keeps working after a head crash
+        # (reference: gcs_client_reconnection_test.cc — clients
+        # re-establish and continue).
+        self._registrations: list = []  # (op, id_kw, id_val, blob)
+        self._closed = False
         assert self._request("ping")["ok"]
 
     # -- plumbing ----------------------------------------------------------
+    def _reconnect_locked(self):
+        """Re-dial the head with exponential backoff (caller holds
+        _lock). Raises HeadConnectionError when attempts run out."""
+        import time as _time
+
+        from ...exceptions import HeadConnectionError
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+        delay = self._reconnect_backoff_s
+        for _ in range(self._reconnect_attempts):
+            _time.sleep(min(delay, 5.0))
+            delay *= 2
+            try:
+                conn = _MpClient(self._addr, family="AF_INET",
+                                 authkey=self._authkey)
+                # Replay session state the restarted head lost.
+                for op, id_kw, id_val, blob in self._registrations:
+                    conn.send_bytes(cloudpickle.dumps(
+                        {"op": op, id_kw: id_val, "blob": blob}))
+                    cloudpickle.loads(conn.recv_bytes())
+                self._conn = conn
+                return
+            except Exception:
+                continue
+        raise HeadConnectionError(
+            f"head at {self._addr[0]}:{self._addr[1]} unreachable after "
+            f"{self._reconnect_attempts} reconnect attempts")
+
     def _request(self, op: str, **payload) -> dict:
+        from ...exceptions import HeadConnectionError
         payload["op"] = op
+        drained: list = []
         if self._pending_releases:
             drained, self._pending_releases = self._pending_releases, []
             payload["__releases__"] = drained
         with self._lock:
-            self._conn.send_bytes(cloudpickle.dumps(payload))
-            result = cloudpickle.loads(self._conn.recv_bytes())
+            try:
+                self._conn.send_bytes(cloudpickle.dumps(payload))
+                result = cloudpickle.loads(self._conn.recv_bytes())
+            except (EOFError, OSError) as e:
+                if drained:
+                    # The piggybacked releases died with the request; on
+                    # a transient drop the head is still holding those
+                    # objects — re-queue them for the next call.
+                    self._pending_releases = drained + \
+                        self._pending_releases
+                if self._closed or self._reconnect_attempts <= 0:
+                    raise
+                # The head died mid-call. Reconnect for FUTURE calls,
+                # but fail THIS one with a typed error: whether the op
+                # applied is unknowable, so a silent replay could
+                # double-execute it.
+                self._reconnect_locked()
+                raise HeadConnectionError(
+                    f"head connection lost during {op!r}; reconnected — "
+                    f"in-flight results were lost, retry the call"
+                ) from e
         if not result.pop("__ok__", False):
             raise RuntimeError(
                 f"client call failed: {result.get('error')}\n"
@@ -88,9 +151,12 @@ class ClientConnection:
         if isinstance(target, type):
             cls_id = f"c_{uuid.uuid4().hex}"
             self._request("register_class", cls_id=cls_id, blob=blob)
+            self._registrations.append(
+                ("register_class", "cls_id", cls_id, blob))
             return ClientActorClass(self, cls_id, target.__name__)
         fn_id = f"f_{uuid.uuid4().hex}"
         self._request("register_fn", fn_id=fn_id, blob=blob)
+        self._registrations.append(("register_fn", "fn_id", fn_id, blob))
         return ClientRemoteFunction(self, fn_id, target.__name__)
 
     def get(self, refs: Union[ClientObjectRef, List[ClientObjectRef]],
@@ -119,6 +185,7 @@ class ClientConnection:
             pass  # interpreter teardown
 
     def close(self):
+        self._closed = True
         try:
             self._conn.close()
         except Exception:

@@ -1,0 +1,166 @@
+"""Head fault tolerance: SIGKILL the head mid-workload, restart it on
+the same storage path, and the SAME driver session + node daemons
+continue (VERDICT r2 #2 done-when).
+
+Reference strategy: src/ray/gcs/gcs_client/test/
+gcs_client_reconnection_test.cc — kill/restart the GCS server while
+clients hold live channels; clients reconnect with backoff, raylets
+re-register, in-flight RPCs fail with a typed error.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu.exceptions import HeadConnectionError
+from ray_tpu.util.client import connect
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+TOKEN = "ab" * 16
+
+
+def _head_env(storage, head_port):
+    env = dict(os.environ)
+    env.update({
+        "RAY_TPU_CLUSTER_TOKEN_HEX": TOKEN,
+        "RAY_TPU_GCS_STORAGE_PATH": storage,
+        "RAY_TPU_HEAD_PORT": str(head_port),
+        "JAX_PLATFORMS": "cpu",
+    })
+    return env
+
+
+def _start_head(storage, head_port, client_port):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+         "--host", "127.0.0.1", "--port", str(client_port),
+         "--dashboard-port", "0", "--num-cpus", "2"],
+        env=_head_env(storage, head_port),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    return proc
+
+
+def _connect_with_retry(client_port, deadline_s=90):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return connect(f"127.0.0.1:{client_port}", token=TOKEN)
+        except Exception as e:  # noqa: BLE001 — head still booting
+            last = e
+            time.sleep(0.5)
+    raise RuntimeError(f"head never came up: {last}")
+
+
+def test_head_sigkill_restart_same_session(tmp_path):
+    storage = str(tmp_path / "gcs.sqlite")
+    head_port = _free_port()
+    client_port = _free_port()
+
+    head = _start_head(storage, head_port, client_port)
+    daemon = None
+    conn = None
+    try:
+        conn = _connect_with_retry(client_port)
+
+        # A node daemon joins with reconnect enabled (production join
+        # mode semantics).
+        denv = dict(os.environ)
+        denv.update({
+            "RAY_TPU_CLUSTER_TOKEN_HEX": TOKEN,
+            "RAY_TPU_HEAD_RECONNECT_ATTEMPTS": "60",
+            "JAX_PLATFORMS": "cpu",
+        })
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.daemon",
+             "--address", f"127.0.0.1:{head_port}",
+             "--num-cpus", "2", "--resources", '{"W": 2}'],
+            env=denv, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+        def n_alive_nodes():
+            return sum(1 for n in conn.api_call("list_nodes")
+                       if n.get("alive", True))
+
+        deadline = time.monotonic() + 60
+        while n_alive_nodes() < 2 and time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert n_alive_nodes() == 2, "daemon never joined"
+
+        # Workload works pre-crash, on both nodes.
+        def sq(x):
+            return x * x
+
+        f = conn.remote(sq)
+        assert conn.get(f.remote(7)) == 49
+        assert conn.get(f.options(resources={"W": 1}).remote(8)) == 64
+
+        # -- SIGKILL the head MID-workload -----------------------------
+        def slow(x):
+            import time as _t
+            _t.sleep(30)
+            return x
+
+        g = conn.remote(slow)
+        inflight = g.remote(1)
+        time.sleep(1.0)
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=10)
+
+        # The restarted head binds the same ports + storage.
+        head2 = _start_head(storage, head_port, client_port)
+        try:
+            # In-flight get fails with the TYPED error (the client
+            # reconnects underneath).
+            with pytest.raises(HeadConnectionError):
+                conn.get(inflight, timeout=120)
+
+            # SAME session continues without re-init: the replayed
+            # registration makes f usable again.
+            assert conn.get(f.remote(9)) == 81
+
+            # The daemon rejoined the restarted head and still serves
+            # its resources.
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                try:
+                    if n_alive_nodes() >= 2:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            assert n_alive_nodes() >= 2, "daemon did not rejoin"
+            assert conn.get(
+                f.options(resources={"W": 1}).remote(12)) == 144
+        finally:
+            head2.send_signal(signal.SIGTERM)
+            try:
+                head2.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                head2.kill()
+    finally:
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        for proc in (daemon, head):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
