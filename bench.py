@@ -35,6 +35,14 @@ def bench_core(extras):
     import ray_tpu
 
     ray_tpu.init(num_cpus=min(os.cpu_count() or 4, 16))
+    # Which store served the put numbers (arena vs file fallback) —
+    # the two differ 2-3x in put bandwidth.
+    from ray_tpu._private import state as _state
+    extras["store_backend"] = type(_state.current().store).__name__
+    from ray_tpu import _native as _nat
+    extras["native_dispatch"] = bool(
+        _nat.available()
+        and os.environ.get("RAY_TPU_NATIVE_DISPATCH", "1") != "0")
 
     @ray_tpu.remote
     def nop():
@@ -164,7 +172,7 @@ def bench_serve(extras):
         ray_tpu.init(num_cpus=min(os.cpu_count() or 4, 16))
         serve.start()
 
-        @serve.deployment(max_ongoing_requests=64)
+        @serve.deployment(max_ongoing_requests=64, num_replicas=2)
         def nop(request):
             return "ok"
 
@@ -178,9 +186,23 @@ def bench_serve(extras):
             return c
 
         warm = mkconn()
-        for _ in range(20):
+        for _ in range(50):
             warm.request("POST", "/nop", body=b"{}")
             warm.getresponse().read()
+
+        # Serial p50: request latency without client-side queueing (the
+        # 16-way p50 below measures queue depth on small boxes, not the
+        # proxy).
+        slat = []
+        stop_serial = time.time() + 2.0
+        while time.time() < stop_serial:
+            t0 = time.perf_counter()
+            warm.request("POST", "/nop", body=b"{}")
+            warm.getresponse().read()
+            slat.append(time.perf_counter() - t0)
+        slat.sort()
+        extras["serve_http_p50_serial_ms"] = round(
+            1000 * slat[len(slat) // 2], 2) if slat else None
 
         lat, count = [], [0]
         stop_at = time.time() + 4.0
@@ -249,6 +271,38 @@ def bench_broadcast(extras):
         extras["broadcast_256mb_nodes"] = n_nodes
         extras["broadcast_gb_per_s"] = round(
             n_nodes * payload.nbytes / dt / 1e9, 2)
+
+        # Push-tree broadcast primitive (reference: push_manager.h) —
+        # best of 3 (first tree run still faults pages).
+        from ray_tpu.experimental import broadcast_object
+        best = 0.0
+        for _ in range(3):
+            ref3 = ray_tpu.put(payload)
+            t0 = time.perf_counter()
+            n = broadcast_object(ref3)
+            dt = time.perf_counter() - t0
+            best = max(best, (n - 1) * payload.nbytes / dt / 1e9)
+            del ref3
+        extras["broadcast_tree_gb_per_s"] = round(best, 2)
+
+        # 8-node 1 GiB-class broadcast (reference: 1 GiB to N nodes
+        # scalability bench). 8 daemons x 256 MB = 2 GiB of shm copies;
+        # scale down if /dev/shm can't hold it.
+        import shutil
+        free_shm = shutil.disk_usage("/dev/shm").free
+        if _budget_left() > 120 and free_shm > 4 * (1 << 30):
+            for i in range(n_nodes, 8):
+                cluster.add_node(num_cpus=1, resources={f"n{i}": 1},
+                                 daemon=True)
+            ref8 = ray_tpu.put(payload)
+            broadcast_object(ray_tpu.put(
+                np.zeros(1 << 20, dtype=np.uint8)))  # warm conns
+            t0 = time.perf_counter()
+            n = broadcast_object(ref8)
+            dt = time.perf_counter() - t0
+            extras["broadcast8_nodes"] = n
+            extras["broadcast8_gb_per_s"] = round(
+                (n - 1) * payload.nbytes / dt / 1e9, 2)
         cluster.shutdown()
     except Exception as e:
         extras["broadcast_bench_error"] = f"{type(e).__name__}: {e}"

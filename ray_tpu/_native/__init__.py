@@ -251,6 +251,20 @@ class NativeStore:
             raise KeyError(f"object not found/sealed")
         return self._view[off.value:off.value + size.value]
 
+    def locate(self, object_id):
+        """(offset, size) of the object inside the arena file; PINS the
+        object (call release() when done) so the slot cannot be
+        recycled while a same-host peer reads the file directly."""
+        if not self._h:
+            raise KeyError("store closed")
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rt_store_get(self._h, self._key(object_id),
+                                    ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            raise KeyError("object not found/sealed")
+        return off.value, size.value
+
     def contains(self, object_id) -> bool:
         if not self._h:
             return False

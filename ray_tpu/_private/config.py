@@ -83,6 +83,12 @@ class RayConfig:
         # Pull admission control: concurrent cross-node object pulls
         # (reference: pull_manager.h in-flight bytes cap).
         "pull_max_concurrent": 4,
+        # Objects above this split into parallel range-pulls (reference:
+        # object_buffer_pool.h chunked transfers); one TCP stream's recv
+        # loop caps well under NIC/loopback bandwidth.
+        "pull_parallel_threshold_mb": 64.0,
+        # Connections per large-object pull (1 = sequential).
+        "pull_parallel_streams": 4,
         # Infeasible tasks fail fast by default; an active autoscaler
         # raises this so demand can park while capacity is launched
         # (reference: infeasible queue + autoscaler demand satisfaction).

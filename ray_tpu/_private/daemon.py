@@ -65,9 +65,10 @@ class NodeDaemon:
             node_id_hex=self.node_hex)
         from .config import ray_config
         paths_for, view_for = store_paths_factory(self.store)
+        from .netcomm import store_local_locator
         self.transfer = TransferServer(
             paths_for, token, host=str(ray_config.node_host),
-            view_for=view_for)
+            view_for=view_for, locate_for=store_local_locator(self.store))
         self.pull_mgr = PullManager(
             self.store, token,
             max_concurrent=int(ray_config.pull_max_concurrent))
@@ -284,6 +285,25 @@ class NodeDaemon:
                             handle.conn.send_bytes(frame)
                     except Exception:
                         pass
+        elif msg_type == P.LOCALIZE_OBJECT:
+            # Head-orchestrated push (broadcast tree): pull the object
+            # from the named source node and ack (reference:
+            # push_manager.h — the sender drives chunked pushes; here
+            # the head drives pulls, which reuses the authenticated
+            # transfer path).
+            def _localize(payload=payload):
+                req_id = payload["req_id"]
+                try:
+                    self.localize(payload["object_id"], payload["node"])
+                    result = True
+                except BaseException as e:  # noqa: BLE001
+                    result = {"__error__": e}
+                try:
+                    self._send(P.NODE_REPLY,
+                               {"req_id": req_id, "result": result})
+                except Exception:
+                    pass
+            self._exec.submit(_localize)
         elif msg_type == P.NODE_REPLY:
             fut = self._pending.pop(payload["req_id"], None)
             if fut is not None:

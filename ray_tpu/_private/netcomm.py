@@ -2,15 +2,25 @@
 
 TPU-native analogue of the reference's ObjectManager data plane
 (src/ray/object_manager/object_manager.h:117 chunked push/pull over gRPC,
-pull_manager.h:53 admission control). The store is file-per-object shm
-(object_store.py), so the server streams the object's backing file with
-``os.sendfile`` (zero userspace copies) and the puller receives straight
-into the destination store's mmap — the chunking/buffer-pool machinery the
-reference needs (object_buffer_pool.h) collapses into kernel pagecache.
+pull_manager.h:53 admission control, push_manager.h:30 push scheduling).
+The store is file-per-object shm (object_store.py), so the server streams
+the object's backing file with ``os.sendfile`` (zero userspace copies) and
+the puller receives straight into the destination store's mmap — the
+chunking/buffer-pool machinery the reference needs (object_buffer_pool.h)
+collapses into kernel pagecache.
+
+Large objects (> pull_parallel_threshold_mb) are pulled as K disjoint
+RANGES over K parallel connections — the multi-stream analogue of the
+reference's chunked parallel pushes (object_buffer_pool.h chunk splits),
+which one TCP stream's congestion window / single-core recv loop caps.
 
 Auth: HMAC-SHA256 challenge/response keyed on the per-cluster token (the
 same token daemons use to join the control plane), so an open port does
 not serve objects to strangers.
+
+Wire protocol (v2): request = 16-byte object id + ">QQ" (offset, length;
+length 0 = to end of object). Response = ">Q" total object size (or
+NOT_FOUND), then the requested byte range.
 """
 
 from __future__ import annotations
@@ -22,9 +32,13 @@ import struct
 import threading
 from typing import Callable, List, Optional, Tuple
 
-_MAGIC = b"RTX1"
+_MAGIC = b"RTX2"
 _NOT_FOUND = 0xFFFFFFFFFFFFFFFF
-_CHUNK = 8 << 20  # advisory sendfile window
+# offset sentinel: "tell me the backing file instead of streaming" —
+# the same-host fast path (reference: same-node plasma clients mmap the
+# store directly instead of copying through the object manager).
+_REQ_LOCAL = 0xFFFFFFFFFFFFFFFE
+_CHUNK = 8 << 20  # advisory sendfile/recv window
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -45,11 +59,15 @@ class TransferServer:
 
     def __init__(self, paths_for: Callable[[bytes], List[str]],
                  authkey: bytes, host: str = "0.0.0.0", port: int = 0,
-                 view_for: Optional[Callable] = None):
+                 view_for: Optional[Callable] = None,
+                 locate_for: Optional[Callable] = None):
         self._paths_for = paths_for
         # Arena-backed stores have no per-object file: view_for returns
         # a pinned zero-copy memoryview instead (released after send).
         self._view_for = view_for
+        # Same-host fast path: (path, offset, size, release_fn) of the
+        # object's backing file, pinned until release_fn().
+        self._locate_for = locate_for
         self._authkey = authkey
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -82,10 +100,15 @@ class TransferServer:
             # Connection reuse: serve requests until the peer hangs up.
             while True:
                 try:
-                    oid = _recv_exact(conn, 16)
+                    req = _recv_exact(conn, 32)
                 except EOFError:
                     return
-                self._serve_one(conn, oid)
+                oid = req[:16]
+                offset, length = struct.unpack(">QQ", req[16:])
+                if offset == _REQ_LOCAL:
+                    self._serve_local(conn, oid)
+                else:
+                    self._serve_one(conn, oid, offset, length)
         except (OSError, EOFError):
             pass  # peer dropped mid-request/mid-send
         finally:
@@ -94,7 +117,39 @@ class TransferServer:
             except OSError:
                 pass
 
-    def _serve_one(self, conn: socket.socket, oid: bytes):
+    def _serve_local(self, conn: socket.socket, oid: bytes):
+        """Same-host fast path: reply with the object's backing file +
+        offset so the (loopback) peer copies straight from pagecache.
+        Response: [u64 size][u16 path_len][path][u64 data_offset]; the
+        object stays pinned until the peer's 1-byte ack (arena slots
+        recycle; plain files survive via the peer's open fd anyway).
+        NOT_FOUND here only means "no fast path" — the peer falls back
+        to the streaming pull, which decides existence."""
+        loc = None
+        if self._locate_for is not None:
+            try:
+                loc = self._locate_for(oid)
+            except Exception:
+                loc = None
+        if loc is None:
+            conn.sendall(struct.pack(">Q", _NOT_FOUND))
+            return
+        path, offset, size, release = loc
+        try:
+            pb = path.encode()
+            conn.sendall(struct.pack(">Q", size)
+                         + struct.pack(">H", len(pb)) + pb
+                         + struct.pack(">Q", offset))
+            if pb:
+                _recv_exact(conn, 1)  # peer done copying
+        finally:
+            try:
+                release()
+            except Exception:
+                pass
+
+    def _serve_one(self, conn: socket.socket, oid: bytes,
+                   offset: int, length: int):
         fd = None
         for path in self._paths_for(oid):
             try:
@@ -108,18 +163,21 @@ class TransferServer:
                 conn.sendall(struct.pack(">Q", _NOT_FOUND))
                 return
             try:
-                conn.sendall(struct.pack(">Q", len(view)))
-                conn.sendall(view)
+                size = len(view)
+                end = size if length == 0 else min(size, offset + length)
+                conn.sendall(struct.pack(">Q", size))
+                if offset < end:
+                    conn.sendall(view[offset:end])
             finally:
                 view.release()
             return
         try:
             size = os.fstat(fd).st_size
+            end = size if length == 0 else min(size, offset + length)
             conn.sendall(struct.pack(">Q", size))
-            offset = 0
-            while offset < size:
+            while offset < end:
                 sent = os.sendfile(conn.fileno(), fd, offset,
-                                   min(_CHUNK, size - offset))
+                                   min(_CHUNK, end - offset))
                 if sent == 0:
                     raise EOFError("peer closed mid-send")
                 offset += sent
@@ -144,7 +202,27 @@ class _PeerConn:
         if hdr[:4] != _MAGIC:
             raise ConnectionError("bad transfer-server magic")
         self.sock.sendall(hmac.new(authkey, hdr[4:], "sha256").digest())
-        self.lock = threading.Lock()
+
+    def request_range(self, oid: bytes, offset: int, length: int) -> int:
+        """Send a range request; returns the TOTAL object size. Raises
+        ObjectLostError on the NOT_FOUND sentinel — a mid-pull eviction
+        on the source sends no payload, and treating the sentinel as a
+        size would hang the recv loop forever."""
+        from ..exceptions import ObjectLostError
+        self.sock.sendall(oid + struct.pack(">QQ", offset, length))
+        (size,) = struct.unpack(">Q", _recv_exact(self.sock, 8))
+        if size == _NOT_FOUND:
+            raise ObjectLostError(
+                oid.hex(), "object not present on source node")
+        return size
+
+    def recv_into_range(self, view, offset: int, end: int):
+        got = offset
+        while got < end:
+            r = self.sock.recv_into(view[got:end], min(_CHUNK, end - got))
+            if r == 0:
+                raise EOFError("source closed mid-transfer")
+            got += r
 
     def close(self):
         try:
@@ -156,15 +234,26 @@ class _PeerConn:
 class PullManager:
     """Client side: dedupe + admission-controlled pulls into a local store
     (reference: PullManager, pull_manager.h:53 — bounded in-flight bytes,
-    one pull per object no matter how many requesters)."""
+    one pull per object no matter how many requesters). Objects above
+    the parallel threshold split into range-pulls over parallel
+    connections (reference: object_buffer_pool.h chunked transfers)."""
 
-    def __init__(self, store, authkey: bytes, max_concurrent: int = 4):
+    def __init__(self, store, authkey: bytes, max_concurrent: int = 4,
+                 parallel_threshold: Optional[int] = None,
+                 parallel_streams: Optional[int] = None):
+        from .config import ray_config
         self._store = store
         self._authkey = authkey
         self._sem = threading.Semaphore(max_concurrent)
         self._lock = threading.Lock()
         self._inflight: dict = {}   # oid bytes -> (event, [error])
-        self._conns: dict = {}      # (host, port) -> _PeerConn
+        self._conns: dict = {}      # (host, port) -> [_PeerConn]
+        self._par_threshold = int(
+            parallel_threshold if parallel_threshold is not None
+            else float(ray_config.pull_parallel_threshold_mb) * (1 << 20))
+        self._par_streams = int(
+            parallel_streams if parallel_streams is not None
+            else ray_config.pull_parallel_streams)
 
     def pull(self, object_id, host: str, port: int) -> None:
         """Ensure `object_id` is in the local store, pulling from
@@ -198,84 +287,176 @@ class PullManager:
                 self._inflight.pop(key, None)
             entry[0].set()
 
-    def _conn_for(self, host: str, port: int) -> _PeerConn:
+    # -- connection pool (a LIST per peer: parallel range streams) -----
+    def _acquire_conn(self, host: str, port: int) -> _PeerConn:
         with self._lock:
-            conn = self._conns.get((host, port))
-        if conn is None:
-            conn = _PeerConn(host, port, self._authkey)
-            with self._lock:
-                old = self._conns.get((host, port))
-                if old is not None:
-                    conn.close()
-                    conn = old
-                else:
-                    self._conns[(host, port)] = conn
-        return conn
+            pool = self._conns.setdefault((host, port), [])
+            if pool:
+                return pool.pop()
+        return _PeerConn(host, port, self._authkey)
 
-    def _drop_conn(self, host: str, port: int, conn: "_PeerConn"):
+    def _release_conn(self, host: str, port: int, conn: _PeerConn):
         with self._lock:
-            if self._conns.get((host, port)) is conn:
-                self._conns.pop((host, port), None)
+            pool = self._conns.setdefault((host, port), [])
+            if len(pool) < max(self._par_streams, 4):
+                pool.append(conn)
+                return
         conn.close()
 
     def _pull_once(self, object_id, host: str, port: int) -> None:
         from ..exceptions import ObjectLostError
-        conn = self._conn_for(host, port)
-        with conn.lock:
+        oid = object_id.binary()
+        if host in ("127.0.0.1", "localhost", "::1"):
+            # Same-host peer: copy straight from its store's backing
+            # file (one memcpy through pagecache, no TCP byte-shuffling
+            # — the reference's same-node plasma mmap behavior).
             try:
-                self._recv_object(conn.sock, object_id)
+                if self._pull_local(object_id, host, port):
+                    return
+            except Exception:
+                pass  # any wrinkle: use the streaming path
+        conn = self._acquire_conn(host, port)
+        retried = False
+        while True:
+            try:
+                size = conn.request_range(oid, 0, self._par_threshold)
+                break
+            except ObjectLostError:
+                self._release_conn(host, port, conn)  # clean protocol state
+                raise
             except (OSError, EOFError, ConnectionError):
                 # Stale pooled connection: retry once on a fresh one.
-                self._drop_conn(host, port, conn)
-                fresh = self._conn_for(host, port)
-                with fresh.lock:
-                    try:
-                        self._recv_object(fresh.sock, object_id)
-                    except ObjectLostError:
-                        raise  # clean protocol state, conn reusable
-                    except BaseException:
-                        self._drop_conn(host, port, fresh)
-                        raise
-            except ObjectLostError:
-                raise  # NOT_FOUND: no payload followed, conn stays clean
-            except BaseException:
-                # Any other failure (store full, abort mid-payload) may
-                # leave unread payload bytes queued — reusing the
-                # connection would desync the protocol into silent
-                # corruption. Drop it.
-                self._drop_conn(host, port, conn)
-                raise
-
-    def _recv_object(self, sock: socket.socket, object_id) -> None:
-        from ..exceptions import ObjectLostError
-        sock.sendall(object_id.binary())
-        (size,) = struct.unpack(">Q", _recv_exact(sock, 8))
-        if size == _NOT_FOUND:
-            raise ObjectLostError(
-                object_id.hex(), "object not present on source node")
+                conn.close()
+                if retried:
+                    raise
+                retried = True
+                conn = _PeerConn(host, port, self._authkey)
         view = self._store.create(object_id, size)
         try:
-            got = 0
-            while got < size:
-                r = sock.recv_into(view[got:], min(_CHUNK, size - got))
-                if r == 0:
-                    raise EOFError("source closed mid-transfer")
-                got += r
+            head_end = min(size, self._par_threshold)
+            if size > head_end and self._par_streams > 1:
+                # Parallel tail ranges pull WHILE the head range streams
+                # on this connection.
+                tail = size - head_end
+                k = min(self._par_streams - 1,
+                        max(1, tail // max(1, self._par_threshold // 2)))
+                k = int(k)
+                step = (tail + k - 1) // k
+                errors: list = []
+                threads = []
+                for i in range(k):
+                    lo = head_end + i * step
+                    hi = min(size, lo + step)
+                    if lo >= hi:
+                        break
+                    t = threading.Thread(
+                        target=self._pull_range,
+                        args=(oid, host, port, view, lo, hi, errors),
+                        daemon=True, name="pull-range")
+                    t.start()
+                    threads.append(t)
+                try:
+                    conn.recv_into_range(view, 0, head_end)
+                finally:
+                    # Range threads hold slices of `view`: they MUST end
+                    # before the error path releases/aborts it, or the
+                    # release raises over live exports while writers
+                    # scribble into a recycled slot.
+                    for t in threads:
+                        t.join()
+                if errors:
+                    raise errors[0]
+            else:
+                conn.recv_into_range(view, 0, head_end)
+                if size > head_end:
+                    # Single-stream mode: fetch the tail sequentially on
+                    # the same connection.
+                    conn.request_range(oid, head_end, 0)
+                    conn.recv_into_range(view, head_end, size)
         except BaseException:
             view.release()
             abort = getattr(self._store, "_abort_reserve", None)
             if abort is not None:
                 abort(object_id)
+            conn.close()
             raise
         view.release()
         self._store.seal(object_id)
+        self._release_conn(host, port, conn)
+
+    def _pull_local(self, object_id, host: str, port: int) -> bool:
+        """Same-host fast path; True when the object landed locally.
+        False/raise => caller falls back to streaming."""
+        import mmap as _mmap
+        oid = object_id.binary()
+        conn = self._acquire_conn(host, port)
+        try:
+            conn.sock.sendall(oid + struct.pack(">QQ", _REQ_LOCAL, 0))
+            (size,) = struct.unpack(">Q", _recv_exact(conn.sock, 8))
+            if size == _NOT_FOUND:
+                self._release_conn(host, port, conn)
+                return False
+            (plen,) = struct.unpack(">H", _recv_exact(conn.sock, 2))
+            path = _recv_exact(conn.sock, plen).decode()
+            (data_off,) = struct.unpack(">Q", _recv_exact(conn.sock, 8))
+            try:
+                fd = os.open(path, os.O_RDONLY)
+            except OSError:
+                conn.sock.sendall(b"\x01")  # release the source pin
+                self._release_conn(host, port, conn)
+                return False
+            try:
+                page = _mmap.ALLOCATIONGRANULARITY
+                aligned = data_off - (data_off % page)
+                delta = data_off - aligned
+                mm = _mmap.mmap(fd, size + delta, prot=_mmap.PROT_READ,
+                                offset=aligned)
+            finally:
+                os.close(fd)
+            view = self._store.create(object_id, size)
+            try:
+                view[0:size] = memoryview(mm)[delta:delta + size]
+            except BaseException:
+                view.release()
+                abort = getattr(self._store, "_abort_reserve", None)
+                if abort is not None:
+                    abort(object_id)
+                raise
+            finally:
+                mm.close()
+                try:
+                    conn.sock.sendall(b"\x01")  # source may unpin now
+                except OSError:
+                    pass
+            view.release()
+            self._store.seal(object_id)
+            self._release_conn(host, port, conn)
+            return True
+        except BaseException:
+            conn.close()
+            raise
+
+    def _pull_range(self, oid: bytes, host: str, port: int, view,
+                    lo: int, hi: int, errors: list):
+        try:
+            conn = self._acquire_conn(host, port)
+            try:
+                conn.request_range(oid, lo, hi - lo)
+                conn.recv_into_range(view, lo, hi)
+            except BaseException:
+                conn.close()
+                raise
+            self._release_conn(host, port, conn)
+        except BaseException as e:  # noqa: BLE001 — joined by leader
+            errors.append(e)
 
     def shutdown(self):
         with self._lock:
-            conns = list(self._conns.values())
+            pools = list(self._conns.values())
             self._conns.clear()
-        for c in conns:
-            c.close()
+        for pool in pools:
+            for c in pool:
+                c.close()
 
 
 def store_paths_factory(store):
@@ -302,3 +483,43 @@ def store_paths_factory(store):
             return None
 
     return spill_paths_for, view_for
+
+
+def store_local_locator(store):
+    """locate_for hook for the same-host fast path: (path, offset,
+    size, release) of an object's backing file, pinned until release.
+    Returns None when the backend can't provide one (spilled, etc.)."""
+    from .ids import ObjectID
+
+    file_path = getattr(store, "_path", None)
+    if callable(file_path):
+        def locate_file(oid_bytes: bytes):
+            oid = ObjectID(oid_bytes)
+            for path in (store._path(oid), store._spill_path(oid)):
+                try:
+                    size = os.stat(path).st_size
+                    return (path, 0, size, lambda: None)
+                except OSError:
+                    continue
+            return None
+        return locate_file
+
+    native = getattr(store, "_store", None)
+    arena_path = getattr(store, "_path", None)
+    if native is None or not isinstance(arena_path, str):
+        return None
+
+    def locate_arena(oid_bytes: bytes):
+        oid = ObjectID(oid_bytes)
+        try:
+            off, size = native.locate(oid)  # pins
+        except KeyError:
+            # Spilled objects live in plain files.
+            path = store._spill_path(oid)
+            try:
+                fsize = os.stat(path).st_size
+                return (path, 0, fsize, lambda: None)
+            except OSError:
+                return None
+        return (arena_path, off, size, lambda: native.release(oid))
+    return locate_arena

@@ -63,6 +63,7 @@ KILL_WORKER = "kill_worker"      # head -> daemon: terminate a worker
 WORKER_DEDICATED = "worker_dedicated"  # head -> daemon: pooled worker became an actor
 WORKER_DIED = "worker_died"      # daemon -> head: a worker process exited
 SHUTDOWN_NODE = "shutdown_node"  # head -> daemon: drain and exit
+LOCALIZE_OBJECT = "localize_obj"  # head -> daemon: pull object from a node
 
 # Object location kinds
 LOC_INLINE = "inline"            # bytes travel in the message

@@ -234,9 +234,11 @@ class Node:
         self.gcs.kv.put("cluster_token", self.cluster_token,
                         namespace="__head__")
         paths_for, view_for = store_paths_factory(self.store)
+        from .netcomm import store_local_locator
         self.transfer_server = TransferServer(
             paths_for, self.cluster_token,
-            host=str(ray_config.node_host), view_for=view_for)
+            host=str(ray_config.node_host), view_for=view_for,
+            locate_for=store_local_locator(self.store))
         self.transfer_port = self.transfer_server.port
         self.pull_mgr = PullManager(
             self.store, self.cluster_token,
@@ -438,6 +440,50 @@ class Node:
         with self._ready_cond:
             self._ready_cond.notify_all()
         self.scheduler.notify_worker_free()
+
+    def broadcast_object(self, object_id: ObjectID,
+                         timeout: float = 300.0) -> int:
+        """Push one shm object to EVERY alive daemon node via a binomial
+        tree: each round, every node that already holds a copy feeds one
+        that doesn't, so a 1->N broadcast costs O(log N) rounds with all
+        links busy (reference: push_manager.h push scheduling; the
+        1 GiB broadcast scalability benchmark,
+        release/benchmarks README). Returns the number of nodes holding
+        a copy afterwards (including the source)."""
+        import collections
+        from concurrent.futures import wait as _fwait
+
+        entry = self.gcs.objects.entry(object_id)
+        if entry is None or not entry.event.is_set():
+            raise ValueError(
+                f"broadcast_object: {object_id.hex()} is not ready")
+        loc = entry.location
+        if loc is None or loc[0] != P.LOC_SHM:
+            # Inline objects ride control messages; nothing to push.
+            return 1
+        src_hex = loc[2] if len(loc) > 2 else self.node_id.hex()
+        holders = [src_hex]
+        remaining = collections.deque(
+            h for h in self.head_server.daemons.values()
+            if h.alive and h.node_id_hex != src_hex)
+        while remaining:
+            batch = [remaining.popleft()
+                     for _ in range(min(len(holders), len(remaining)))]
+            futs = {}
+            for i, target in enumerate(batch):
+                source = holders[i % len(holders)]
+                futs[self._handler_pool.submit(
+                    target.request, P.LOCALIZE_OBJECT,
+                    {"object_id": object_id, "node": source},
+                    timeout)] = target
+            _fwait(list(futs))
+            for fut, target in futs.items():
+                try:
+                    fut.result()
+                    holders.append(target.node_id_hex)
+                except Exception:
+                    pass  # target died mid-broadcast: skip it
+        return len(holders)
 
     def _all_worker_handles(self):
         handles = list(self.pool.workers.values())

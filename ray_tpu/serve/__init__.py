@@ -170,10 +170,12 @@ def start(http_options: Optional[HTTPOptions] = None, *,
         # Multi-host data plane: the controller keeps one proxy actor on
         # every non-head node (reference: proxy_state.py EveryNode
         # location default); the in-driver proxy above covers the head.
+        # The configured host applies verbatim to every proxy — the
+        # loopback default stays loopback (pass
+        # HTTPOptions(host="0.0.0.0") to expose ingress off-host).
         try:
             ray_tpu.get(controller.configure_proxies.remote(
-                opts.host if opts.host != "127.0.0.1" else "0.0.0.0",
-                opts.port), timeout=30)
+                opts.host, opts.port), timeout=30)
         except Exception:
             pass
     return controller

@@ -1,0 +1,68 @@
+"""Cross-node transfer: parallel range-pulls and the broadcast tree.
+
+Reference strategy: object manager transfer tests
+(src/ray/object_manager/test/object_manager_test.cc chunked transfers;
+push_manager.h push scheduling; the 1 GiB broadcast scalability
+benchmark in release/benchmarks)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.experimental import broadcast_object
+
+
+@pytest.fixture(scope="module")
+def transfer_cluster():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    a = cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
+    b = cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
+    yield cluster, a, b
+    try:
+        cluster.shutdown()
+    except Exception:
+        pass
+
+
+def test_large_object_parallel_pull(transfer_cluster):
+    """A >64MB object crosses nodes via parallel range streams and
+    arrives bit-exact."""
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 255, size=96 << 20, dtype=np.uint8)  # 96 MB
+    ref = ray.put(data)
+
+    @ray.remote(resources={"A": 1})
+    def digest(x):
+        import hashlib
+        return hashlib.sha256(np.ascontiguousarray(x)).hexdigest()
+
+    import hashlib
+    expect = hashlib.sha256(data).hexdigest()
+    assert ray.get(digest.remote(ref), timeout=180) == expect
+
+
+def test_broadcast_object_tree(transfer_cluster):
+    cluster, a, b = transfer_cluster
+    data = np.arange(20 << 20, dtype=np.uint8)  # 20 MB
+    ref = ray.put(data)
+    n = broadcast_object(ref)
+    assert n == 3, n  # head + both daemons hold a copy
+
+    # Tasks on both nodes read the (now-local) copy correctly.
+    @ray.remote(resources={"A": 1})
+    def sum_a(x):
+        return int(x.sum())
+
+    @ray.remote(resources={"B": 1})
+    def sum_b(x):
+        return int(x.sum())
+
+    expect = int(data.sum())
+    assert ray.get(sum_a.remote(ref), timeout=120) == expect
+    assert ray.get(sum_b.remote(ref), timeout=120) == expect
+
+
+def test_broadcast_inline_object_noop(transfer_cluster):
+    ref = ray.put(42)  # inline: rides control messages
+    assert broadcast_object(ref) == 1
