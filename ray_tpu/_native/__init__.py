@@ -238,23 +238,11 @@ class NativeStore:
         if self._lib.rt_store_seal(self._h, self._key(object_id)) != 0:
             raise KeyError("seal: object not in CREATED state")
 
-    def get(self, object_id) -> memoryview:
-        """Zero-copy read view; pins the object (call release() when
-        done, plasma client semantics)."""
-        if not self._h:
-            raise KeyError("store closed")
-        off = ctypes.c_uint64()
-        size = ctypes.c_uint64()
-        rc = self._lib.rt_store_get(self._h, self._key(object_id),
-                                    ctypes.byref(off), ctypes.byref(size))
-        if rc != 0:
-            raise KeyError(f"object not found/sealed")
-        return self._view[off.value:off.value + size.value]
-
     def locate(self, object_id):
         """(offset, size) of the object inside the arena file; PINS the
         object (call release() when done) so the slot cannot be
-        recycled while a same-host peer reads the file directly."""
+        recycled while a reader (zero-copy view or same-host peer
+        reading the file directly) is live."""
         if not self._h:
             raise KeyError("store closed")
         off = ctypes.c_uint64()
@@ -264,6 +252,12 @@ class NativeStore:
         if rc != 0:
             raise KeyError("object not found/sealed")
         return off.value, size.value
+
+    def get(self, object_id) -> memoryview:
+        """Zero-copy read view; pins the object (call release() when
+        done, plasma client semantics)."""
+        off, size = self.locate(object_id)
+        return self._view[off:off + size]
 
     def contains(self, object_id) -> bool:
         if not self._h:

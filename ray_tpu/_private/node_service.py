@@ -372,11 +372,15 @@ class HeadServer:
                 except Exception:
                     pass
 
-    def all_proxies(self) -> List[RemoteWorkerProxy]:
+    def all_daemons(self) -> List[DaemonHandle]:
+        """Snapshot under the lock — registration/eviction are
+        concurrent with callers iterating."""
         with self._lock:
-            daemons = list(self.daemons.values())
+            return list(self.daemons.values())
+
+    def all_proxies(self) -> List[RemoteWorkerProxy]:
         out: List[RemoteWorkerProxy] = []
-        for d in daemons:
+        for d in self.all_daemons():
             out.extend(d.proxies.values())
         return out
 

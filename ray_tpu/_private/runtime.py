@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..exceptions import (
     ActorDiedError,
+    ActorError,
     GetTimeoutError,
     ObjectLostError,
     TaskCancelledError,
@@ -464,7 +465,7 @@ class Node:
         src_hex = loc[2] if len(loc) > 2 else self.node_id.hex()
         holders = [src_hex]
         remaining = collections.deque(
-            h for h in self.head_server.daemons.values()
+            h for h in self.head_server.all_daemons()
             if h.alive and h.node_id_hex != src_hex)
         while remaining:
             batch = [remaining.popleft()
@@ -1122,6 +1123,19 @@ class Node:
         self._flush_actor_queue(st)
 
     def _fail_actor(self, st: _ActorState, error_blob: bytes, cause: str):
+        # Whatever killed the actor (unschedulable restart, env setup,
+        # worker crash), method calls must surface a DETERMINISTIC typed
+        # error: ActorDiedError carrying the underlying cause
+        # (reference: ActorDiedError wraps the creation task error) —
+        # not the raw cause type, which varies with submission timing.
+        try:
+            err = serialization.loads(error_blob)
+        except Exception:
+            err = None
+        if not isinstance(err, (ActorDiedError, ActorError)):
+            error_blob = serialization.dumps(ActorDiedError(
+                f"Actor {st.spec.actor_id.hex()} died ({cause}): "
+                f"{err!r}"))
         self.gcs.actors.set_dead(st.spec.actor_id, cause,
                                  creation_error=error_blob)
         if st.spec.lifetime == "detached":
@@ -1222,6 +1236,40 @@ class Node:
                 worker.send(P.EXEC_TASK, {"spec": spec})
             except Exception:
                 pass  # death path handles in-flight failures
+            if not worker.alive:
+                # The death path may have drained worker.running BEFORE
+                # our insert (flush raced the death callback): whoever
+                # pops the spec owns it. Re-queue at the FRONT without
+                # re-flushing (no retry burned, no recursion into the
+                # same dead handle) — the death path / restart
+                # completion flushes the queue later. Only if the actor
+                # is already terminally dead do we fail the call here.
+                if worker.running.pop(spec.task_id.binary(),
+                                      None) is not None:
+                    with st.lock:
+                        dead = st.dead
+                        if not dead:
+                            st.in_flight.discard(spec.task_id.binary())
+                            st.queue.appendleft([spec, set()])
+                        # A restart may ALREADY have produced a fresh
+                        # worker; flushing to it is safe (its own death
+                        # path guards it) and nothing else would.
+                        refetch = (not dead and st.ready
+                                   and st.worker is not None
+                                   and st.worker is not worker)
+                    if dead:
+                        blob = serialization.dumps(ActorDiedError(
+                            f"Actor {spec.actor_id.hex()}'s worker "
+                            f"died before the call could run"))
+                        if spec.streaming:
+                            self._finish_gen_stream(
+                                spec.task_id, None, blob)
+                        for rid in spec.return_ids:
+                            self.gcs.objects.register_ready(
+                                rid, (P.LOC_ERROR, blob))
+                        self._unpin_task_args(spec)
+                    elif refetch:
+                        self._flush_actor_queue(st)
 
     def get_actor(self, name: str, namespace: Optional[str] = None):
         entry = self.gcs.actors.get_by_name(name,

@@ -19,8 +19,18 @@ Supported fields:
                              this image has no egress; set
                              RAY_TPU_PIP_OFFLINE=0 where PyPI is
                              reachable. Reference: runtime_env/pip.py.
+  uv           [str]         like pip, but materialized with the `uv`
+                             tool (uv venv + uv pip install — an order
+                             of magnitude faster resolver); falls back
+                             to the pip machinery when uv is absent.
+                             Reference: runtime_env/uv.py.
+  conda        dict | str    conda env from a spec dict (cached by
+                             spec hash) or an existing named env;
+                             requires a conda/mamba binary — raises a
+                             clear error when none is installed
+                             (reference: runtime_env/conda.py).
 Gated (raise at validation, like the reference when the backing tool is
-absent): conda, container.
+absent): container.
 """
 import hashlib
 import json
@@ -28,8 +38,9 @@ import os
 from typing import Any, Dict, Optional
 
 ENV_VAR = "RAY_TPU_RUNTIME_ENV"
-_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "uv"}
-_GATED = {"conda", "container"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "uv",
+              "conda"}
+_GATED = {"container"}
 
 
 class RuntimeEnvSetupError(RuntimeError):
@@ -51,6 +62,7 @@ def _envs_root() -> str:
 
 
 _failed_envs: Dict[str, str] = {}
+_named_conda_envs: Dict[str, str] = {}  # name -> python (list is slow)
 
 
 def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -63,24 +75,51 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
         if key in _GATED:
             raise ValueError(
                 f"runtime_env field '{key}' requires containerized "
-                "tooling this environment gates off; use pip/"
+                "tooling this environment gates off; use pip/uv/"
                 "working_dir/py_modules instead")
         if key not in _SUPPORTED:
             raise ValueError(f"Unknown runtime_env field '{key}' "
                              f"(supported: {sorted(_SUPPORTED)})")
-    reqs = runtime_env.get("pip") or runtime_env.get("uv")
-    if reqs is not None:
+    for tool in ("pip", "uv"):
+        reqs = runtime_env.get(tool)
+        if reqs is None:
+            continue
         if not (isinstance(reqs, list)
                 and all(isinstance(r, str) for r in reqs)):
-            raise TypeError("runtime_env pip must be a list of "
+            raise TypeError(f"runtime_env {tool} must be a list of "
                             "requirement strings / local wheel paths")
         # Warm the venv in the background so the scheduler's dispatch
         # thread usually finds it ready (the reference's async env
         # agent, collapsed to a builder thread).
         import threading
-        threading.Thread(target=lambda: _try_build(list(reqs)),
-                         daemon=True,
-                         name="pip-env-warm").start()
+        threading.Thread(
+            target=lambda r=list(reqs), t=tool: _try_build(r, t),
+            daemon=True, name=f"{tool}-env-warm").start()
+    interp_fields = [f for f in ("pip", "uv", "conda")
+                     if runtime_env.get(f) is not None]
+    if len(interp_fields) > 1:
+        # One interpreter source per env (the reference rejects
+        # pip+conda combinations the same way, runtime_env/validation).
+        raise ValueError(
+            f"runtime_env fields {interp_fields} are mutually "
+            f"exclusive — each selects the worker's interpreter")
+    conda_spec = runtime_env.get("conda")
+    if conda_spec is not None:
+        if not isinstance(conda_spec, (dict, str)):
+            raise TypeError("runtime_env conda must be a spec dict or "
+                            "an existing env name")
+        if _conda_bin() is None:
+            raise ValueError(
+                "runtime_env conda requires a conda/mamba/micromamba "
+                "binary on PATH; none found (use pip/uv instead — "
+                "reference: runtime_env/conda.py raises the same way "
+                "when the tool is missing)")
+        # Background warm, like pip/uv: `conda env create` can take
+        # minutes and must not stall the dispatch thread.
+        import threading
+        threading.Thread(
+            target=lambda spec=conda_spec: _try_build_conda(spec),
+            daemon=True, name="conda-env-warm").start()
     ev = runtime_env.get("env_vars", {})
     if not all(isinstance(k, str) and isinstance(v, str)
                for k, v in ev.items()):
@@ -114,33 +153,65 @@ def worker_extra_env(runtime_env: Optional[Dict[str, Any]]
     payload = {k: v for k, v in runtime_env.items() if k != "env_vars"}
     if payload:
         extra[ENV_VAR] = json.dumps(payload)
-    reqs = runtime_env.get("pip") or runtime_env.get("uv")
-    if reqs:
-        extra["RAY_TPU_PYTHON"] = ensure_pip_env(list(reqs))
+    if runtime_env.get("pip"):
+        extra["RAY_TPU_PYTHON"] = ensure_pip_env(
+            list(runtime_env["pip"]), tool="pip")
+    elif runtime_env.get("uv"):
+        extra["RAY_TPU_PYTHON"] = ensure_pip_env(
+            list(runtime_env["uv"]), tool="uv")
+    elif runtime_env.get("conda") is not None:
+        extra["RAY_TPU_PYTHON"] = ensure_conda_env(runtime_env["conda"])
     return extra
 
 
-def _try_build(requirements: list):
+def _try_build(requirements: list, tool: str = "pip"):
     try:
-        ensure_pip_env(requirements)
+        ensure_pip_env(requirements, tool=tool)
     except Exception:
         pass  # memoized; surfaces as the task's error at dispatch
 
 
-def ensure_pip_env(requirements: list) -> str:
+def _try_build_conda(spec):
+    try:
+        ensure_conda_env(spec)
+    except Exception:
+        pass  # memoized; surfaces as the task's error at dispatch
+
+
+def _uv_bin() -> Optional[str]:
+    import shutil
+    return shutil.which("uv")
+
+
+def _conda_bin() -> Optional[str]:
+    import shutil
+    for tool in ("mamba", "conda", "micromamba"):
+        path = shutil.which(tool)
+        if path:
+            return path
+    return None
+
+
+def ensure_pip_env(requirements: list, tool: str = "pip") -> str:
     """Create (or reuse) the venv for `requirements`; returns its python.
 
-    Reference: runtime_env/pip.py — a venv per requirements-hash with
-    URI caching; concurrent creators serialize on a file lock. The venv
-    inherits site-packages (jax/numpy stay importable) and installs the
-    requirements on top. Offline by default: local wheel/sdist paths in
-    the list become --find-links sources and pip runs --no-index.
+    Reference: runtime_env/pip.py and runtime_env/uv.py — a venv per
+    requirements-hash with URI caching; concurrent creators serialize on
+    a file lock. The venv inherits site-packages (jax/numpy stay
+    importable) and installs the requirements on top. tool="uv" builds
+    with `uv venv` + `uv pip install` (much faster resolver), falling
+    back to the pip machinery when uv is absent. Offline by default:
+    local wheel/sdist paths in the list become --find-links sources and
+    the installer runs --no-index.
     """
     import fcntl
     import subprocess
     import sys
 
-    key = hashlib.sha1(json.dumps(sorted(requirements)).encode()
+    uv = _uv_bin() if tool == "uv" else None
+    if tool == "uv" and uv is None:
+        tool = "pip"  # documented fallback
+    key = hashlib.sha1(json.dumps([tool] + sorted(requirements)).encode()
                        ).hexdigest()[:12]
     if key in _failed_envs:
         raise RuntimeEnvSetupError(_failed_envs[key])
@@ -154,32 +225,114 @@ def ensure_pip_env(requirements: list) -> str:
         fcntl.flock(lock, fcntl.LOCK_EX)
         if os.path.exists(os.path.join(env_dir, ".ready")):
             return python
-        subprocess.run(
-            [sys.executable, "-m", "venv", "--system-site-packages",
-             env_dir],
-            check=True, capture_output=True, text=True, timeout=300)
         offline = os.environ.get("RAY_TPU_PIP_OFFLINE", "1") == "1"
         find_links = sorted({os.path.dirname(os.path.abspath(r))
                              for r in requirements
                              if os.path.exists(r)})
-        cmd = [python, "-m", "pip", "install", "-q",
-               "--no-build-isolation"]
-        if offline:
-            cmd.append("--no-index")
-        for d in find_links:
-            cmd += ["--find-links", d]
-        cmd += requirements
+        if uv is not None:
+            subprocess.run(
+                [uv, "venv", "--system-site-packages",
+                 "--python", sys.executable, env_dir],
+                check=True, capture_output=True, text=True, timeout=300)
+            cmd = [uv, "pip", "install", "--python", python,
+                   "--no-build-isolation"]
+            if offline:
+                cmd.append("--no-index")
+            for d in find_links:
+                cmd += ["--find-links", d]
+            cmd += requirements
+        else:
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 env_dir],
+                check=True, capture_output=True, text=True, timeout=300)
+            cmd = [python, "-m", "pip", "install", "-q",
+                   "--no-build-isolation"]
+            if offline:
+                cmd.append("--no-index")
+            for d in find_links:
+                cmd += ["--find-links", d]
+            cmd += requirements
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=600)
         if proc.returncode != 0:
             import shutil
             shutil.rmtree(env_dir, ignore_errors=True)
-            msg = (f"runtime_env pip install failed for "
+            msg = (f"runtime_env {tool} install failed for "
                    f"{requirements}:\n{proc.stderr[-2000:]}")
             _failed_envs[key] = msg  # retries fail fast, not rebuild
             raise RuntimeEnvSetupError(msg)
         with open(os.path.join(env_dir, ".ready"), "w") as f:
             f.write(json.dumps(requirements))
+    return python
+
+
+def ensure_conda_env(spec) -> str:
+    """Materialize a conda env (reference: runtime_env/conda.py).
+
+    str spec = an EXISTING named env (resolved via `conda env list`);
+    dict spec = environment.yml content, created under the per-uid
+    cache keyed by spec hash. Returns the env's python. Raises
+    RuntimeEnvSetupError when the tool or env is unavailable.
+    """
+    import fcntl
+    import subprocess
+
+    conda = _conda_bin()
+    if conda is None:
+        raise RuntimeEnvSetupError(
+            "runtime_env conda requires a conda/mamba/micromamba binary")
+    if isinstance(spec, str):
+        cached = _named_conda_envs.get(spec)
+        if cached is not None:
+            return cached
+        proc = subprocess.run([conda, "env", "list", "--json"],
+                              capture_output=True, text=True, timeout=60)
+        try:
+            envs = json.loads(proc.stdout).get("envs", [])
+        except Exception:
+            envs = []
+        for env_path in envs:
+            if os.path.basename(env_path) == spec:
+                python = os.path.join(env_path, "bin", "python")
+                _named_conda_envs[spec] = python
+                return python
+        raise RuntimeEnvSetupError(
+            f"conda env {spec!r} not found in `conda env list`")
+    key = hashlib.sha1(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:12]
+    if key in _failed_envs:
+        raise RuntimeEnvSetupError(_failed_envs[key])
+    root = _envs_root()
+    env_dir = os.path.join(root, f"conda_{key}")
+    python = os.path.join(env_dir, "bin", "python")
+    if os.path.exists(os.path.join(env_dir, ".ready")):
+        return python
+    lock_path = os.path.join(root, f"conda_{key}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(os.path.join(env_dir, ".ready")):
+            return python
+        spec_path = os.path.join(root, f"conda_{key}.yml")
+        try:
+            import yaml
+            with open(spec_path, "w") as f:
+                yaml.safe_dump(spec, f)
+        except ImportError:
+            with open(spec_path, "w") as f:
+                json.dump(spec, f)  # conda accepts JSON-as-YAML
+        proc = subprocess.run(
+            [conda, "env", "create", "--prefix", env_dir,
+             "--file", spec_path],
+            capture_output=True, text=True, timeout=1800)
+        if proc.returncode != 0:
+            import shutil
+            shutil.rmtree(env_dir, ignore_errors=True)
+            msg = (f"conda env create failed:\n{proc.stderr[-2000:]}")
+            _failed_envs[key] = msg
+            raise RuntimeEnvSetupError(msg)
+        with open(os.path.join(env_dir, ".ready"), "w") as f:
+            f.write("ok")
     return python
 
 

@@ -72,9 +72,11 @@ def _kernel_ok(seq_len: int) -> bool:
 def _pick_block(seq_len: int) -> int:
     """Largest block that divides the sequence: fewer grid steps amortize
     the per-step VPU/online-softmax overhead (measured on v5e: 512 beats
-    128 by ~2.5x at S=2048); the causal index clamp assumes exact
-    tiling."""
-    for b in (512, 256, 128):
+    128 by ~2.5x at S=2048, and 1024 beats 512 by ~10% at S=1024 —
+    docs/MFU_ROOFLINE.md block sweep). Capped at 1024: the f32 score
+    block is block_q*block_k*4B of VMEM (4 MB at 1024²); the causal
+    index clamp assumes exact tiling."""
+    for b in (1024, 512, 256, 128):
         if seq_len % b == 0:
             return b
     return seq_len

@@ -37,7 +37,16 @@ def pvary(tree, axis_name):
     from jax import lax
     pcast = getattr(lax, "pcast", None)
     if pcast is not None:
-        fn = lambda x: pcast(x, (axis_name,), to="varying")  # noqa: E731
+        def fn(x):
+            try:
+                return pcast(x, (axis_name,), to="varying")
+            except ValueError as e:
+                # Only the already-varying case is benign (pvary was
+                # idempotent); other ValueErrors must surface here, not
+                # as confusing type mismatches deep inside shard_map.
+                if "varying" in str(e):
+                    return x
+                raise
     elif hasattr(lax, "pvary"):
         fn = lambda x: lax.pvary(x, (axis_name,))  # noqa: E731
     else:

@@ -116,6 +116,207 @@ def make_pipelined_fn(mesh, stage_fn: Callable, n_microbatches: int,
                                      axis_name, params_spec, x_spec))
 
 
+def one_f1b_schedule(n_stages: int, n_micro: int):
+    """Static 1F1B tick table (reference for the schedule shape:
+    Megatron-LM's non-interleaved 1F1B; the reference framework's users
+    build this from ADAG actor pipelines, dag/compiled_dag_node.py:767).
+
+    Simulated at trace time: each tick every stage runs one of
+    idle(0)/forward(1)/backward(2) on a microbatch. Policy: stage s
+    keeps at most (n_stages - s) microbatches in flight — warmup
+    forwards, steady 1F1B alternation, cooldown backwards — which is
+    what bounds the activation stash by pipeline depth instead of
+    microbatch count.
+
+    Returns (action[T, S], mb[T, S]) numpy int32 arrays.
+    """
+    import numpy as np
+
+    S, M = n_stages, n_micro
+    f_done = [[-1] * M for _ in range(S)]   # tick F(s,m) completed
+    b_done = [[-1] * M for _ in range(S)]
+    next_f = [0] * S
+    next_b = [0] * S
+    actions, mbs = [], []
+    t = 0
+    while any(nb < M for nb in next_b):
+        act_row = [0] * S
+        mb_row = [0] * S
+        for s in range(S):
+            m_f, m_b = next_f[s], next_b[s]
+            f_ready = m_f < M and (
+                s == 0 or (f_done[s - 1][m_f] >= 0
+                           and f_done[s - 1][m_f] < t))
+            b_ready = m_b < M and f_done[s][m_b] >= 0 and (
+                s == S - 1 or (b_done[s + 1][m_b] >= 0
+                               and b_done[s + 1][m_b] < t))
+            in_flight = m_f - m_b
+            cap = S - s
+            # 1F1B: forward only while under the in-flight cap (the
+            # memory bound); at the cap, drain a backward (or wait).
+            do_b = b_ready and (in_flight >= cap or not f_ready)
+            do_f = (not do_b) and f_ready and in_flight < cap
+            if do_b:
+                act_row[s], mb_row[s] = 2, m_b
+                b_done[s][m_b] = t
+                next_b[s] += 1
+            elif do_f:
+                act_row[s], mb_row[s] = 1, m_f
+                f_done[s][m_f] = t
+                next_f[s] += 1
+        actions.append(act_row)
+        mbs.append(mb_row)
+        t += 1
+        if t > 4 * (M + S) + 8:  # defensive: schedule must terminate
+            raise RuntimeError("1F1B schedule did not converge")
+    return (np.asarray(actions, dtype=np.int32),
+            np.asarray(mbs, dtype=np.int32))
+
+
+def make_1f1b_train_fn(mesh, stage_fn: Callable, loss_fn: Callable,
+                       n_microbatches: int, axis_name: str = "pp",
+                       params_spec=None, x_spec=None):
+    """Training step over a 1F1B pipeline schedule: like
+    make_pipelined_train_fn but with the backward INSIDE the schedule —
+    per-stage activation stash bounded by pipeline depth (not microbatch
+    count), and the stage backward recomputes the stage forward from the
+    saved INPUT (Megatron-style activation recompute), so per-tick
+    residuals never accumulate across ticks.
+
+    Returns jitted ``step(stage_params, x, y) -> (loss, grads)`` with
+    the same contract as make_pipelined_train_fn.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.ops import shard_map
+
+    params_spec = params_spec if params_spec is not None else P(axis_name)
+    x_spec = x_spec if x_spec is not None else P()
+    n_stages = mesh.shape[axis_name]
+    action_tbl, mb_tbl = one_f1b_schedule(n_stages, n_microbatches)
+
+    def local_fn(stage_params, x, y):
+        own = jax.tree.map(lambda p: p[0], stage_params)
+        xm = x.reshape((n_microbatches, -1) + x.shape[1:])
+        ym = y.reshape((n_microbatches, -1) + y.shape[1:])
+        rank = lax.axis_index(axis_name)
+        S = n_stages
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+        act_t = jnp.asarray(action_tbl)
+        mb_t = jnp.asarray(mb_tbl)
+        mb_shape = xm.shape[1:]
+
+        from .ops import pvary
+        # Stash of stage INPUTS, ring-indexed mb % S — the 1F1B memory
+        # bound. grad ring buffers out-of-order backward arrivals.
+        stash = jnp.zeros((S,) + mb_shape, xm.dtype)
+        grads_in = jnp.zeros((S,) + mb_shape, xm.dtype)
+        dparams = jax.tree.map(jnp.zeros_like, own)
+        loss_acc = jnp.zeros((), jnp.float32)
+        carry0 = pvary((stash, grads_in, dparams, loss_acc), axis_name)
+
+        is_first = rank == 0
+        is_last = rank == S - 1
+
+        def stage_loss(params, a_in, y_mb):
+            out = stage_fn(params, a_in)
+            return loss_fn(out, y_mb)
+
+        def tick(carry, t):
+            stash, grads_in, dparams, loss_acc = carry
+            action = act_t[t, rank]
+            m = mb_t[t, rank]
+            slot = m % S
+
+            def do_idle(stash, grads_in, dparams, loss_acc):
+                z = jnp.zeros(mb_shape, xm.dtype)
+                return pvary((stash, grads_in, dparams, loss_acc,
+                              z, jnp.int32(0), z, jnp.int32(0)),
+                             axis_name)
+
+            def do_fwd(stash, grads_in, dparams, loss_acc):
+                a_in = jnp.where(is_first, xm[m], stash[slot])
+                # Stage 0's saved input is its x microbatch (uniform
+                # stash so the backward recompute reads one place).
+                stash = lax.dynamic_update_index_in_dim(
+                    stash, a_in, slot, 0)
+                out = stage_fn(own, a_in)
+                return pvary((stash, grads_in, dparams, loss_acc,
+                              out, jnp.int32(1),
+                              jnp.zeros(mb_shape, xm.dtype),
+                              jnp.int32(0)), axis_name)
+
+            def do_bwd(stash, grads_in, dparams, loss_acc):
+                a_in = stash[slot]
+
+                def last_branch(_):
+                    (lval, (dp, da)) = jax.value_and_grad(
+                        stage_loss, argnums=(0, 1))(own, a_in, ym[m])
+                    # Both cond branches must carry identical
+                    # varying-manual-axes types.
+                    return pvary((lval, dp, da), axis_name)
+
+                def mid_branch(_):
+                    _out, vjp = jax.vjp(stage_fn, own, a_in)
+                    dp, da = vjp(grads_in[slot])
+                    return pvary((jnp.zeros((), jnp.float32), dp, da),
+                                 axis_name)
+
+                lval, dp, da = lax.cond(is_last, last_branch,
+                                        mid_branch, None)
+                dparams = jax.tree.map(jnp.add, dparams, dp)
+                loss_acc = loss_acc + lval
+                return pvary((stash, grads_in, dparams, loss_acc,
+                              jnp.zeros(mb_shape, xm.dtype), jnp.int32(0),
+                              da.astype(xm.dtype), jnp.int32(1)),
+                             axis_name)
+
+            (stash, grads_in, dparams, loss_acc,
+             f_msg, f_valid, b_msg, b_valid) = lax.switch(
+                action, [do_idle, do_fwd, do_bwd],
+                stash, grads_in, dparams, loss_acc)
+
+            # Hop messages every tick: F outputs ride forward, input
+            # grads ride backward; receivers file them by microbatch.
+            f_rx = lax.ppermute((f_msg, f_valid, m), axis_name, fwd_perm)
+            b_rx = lax.ppermute((b_msg, b_valid, m), axis_name, bwd_perm)
+            rx_act, rx_fv, rx_fm = f_rx
+            rx_grad, rx_bv, rx_bm = b_rx
+            # The rings wrap: stage S-1's F output lands on stage 0 and
+            # stage 0's input grad lands on stage S-1. Neither is a real
+            # message — storing them would CORRUPT a live stash slot of
+            # the same residue class.
+            rx_fv = jnp.where(is_first, 0, rx_fv)
+            rx_bv = jnp.where(is_last, 0, rx_bv)
+            stash = jnp.where(
+                rx_fv > 0,
+                lax.dynamic_update_index_in_dim(
+                    stash, rx_act, rx_fm % S, 0),
+                stash)
+            grads_in = jnp.where(
+                rx_bv > 0,
+                lax.dynamic_update_index_in_dim(
+                    grads_in, rx_grad, rx_bm % S, 0),
+                grads_in)
+            return (stash, grads_in, dparams, loss_acc), None
+
+        (stash, grads_in, dparams, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(action_tbl.shape[0]))
+        # Per-mb losses live on the last stage; grads are per-stage.
+        # Both are SUMS over microbatches of per-mb means — divide by M
+        # so loss/grads equal the full-batch mean formulation.
+        loss = lax.psum(jnp.where(is_last, loss_acc, 0.0),
+                        axis_name) / n_microbatches
+        grads = jax.tree.map(lambda g: g[None] / n_microbatches, dparams)
+        return loss, grads
+
+    fn = shard_map(local_fn, mesh=mesh,
+                   in_specs=(params_spec, x_spec, x_spec),
+                   out_specs=(P(), params_spec))
+    return jax.jit(fn)
+
+
 def make_pipelined_train_fn(mesh, stage_fn: Callable, loss_fn: Callable,
                             n_microbatches: int, axis_name: str = "pp",
                             params_spec=None, x_spec=None):

@@ -224,6 +224,98 @@ class TestPipelineTraining:
         np.testing.assert_allclose(pipe_losses, seq_losses, rtol=1e-4)
 
 
+class Test1F1B:
+    """1F1B pipeline schedule (VERDICT r2 #8): explicit in-schedule
+    backward with the activation stash bounded by PIPELINE DEPTH, not
+    microbatch count (Megatron-LM non-interleaved 1F1B + activation
+    recompute; the reference's users build this from ADAG actor
+    pipelines, dag/compiled_dag_node.py:767)."""
+
+    def _mesh(self, n):
+        return Mesh(np.array(jax.devices()[:n]), ("pp",))
+
+    @staticmethod
+    def _stage(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    @staticmethod
+    def _loss(out, y):
+        return jnp.mean((out - y) ** 2)
+
+    def _params(self, S, D, key):
+        ks = jax.random.split(key, 2)
+        return {"w": jax.random.normal(ks[0], (S, D, D)) * 0.5,
+                "b": jax.random.normal(ks[1], (S, D)) * 0.1}
+
+    def _seq_loss(self, S):
+        def seq(params, x, y):
+            h = x
+            for s in range(S):
+                h = self._stage(
+                    {"w": params["w"][s], "b": params["b"][s]}, h)
+            return self._loss(h, y)
+        return seq
+
+    def test_schedule_properties(self):
+        from ray_tpu.parallel.pipeline import one_f1b_schedule
+        for S, M in [(2, 4), (4, 8), (3, 7)]:
+            act, mb = one_f1b_schedule(S, M)
+            T = act.shape[0]
+            assert T == 2 * (M + S - 1)  # ideal 1F1B makespan
+            for s in range(S):
+                live = peak = 0
+                for t in range(T):
+                    if act[t, s] == 1:
+                        live += 1
+                    elif act[t, s] == 2:
+                        live -= 1
+                    peak = max(peak, live)
+                # THE 1F1B property: in-flight bounded by depth.
+                assert peak <= S - s
+
+    def test_grads_match_single_device(self):
+        from ray_tpu.parallel.pipeline import make_1f1b_train_fn
+
+        for S, M in [(2, 4), (4, 8)]:
+            D = 16
+            key = jax.random.PRNGKey(S * 10 + M)
+            params = self._params(S, D, key)
+            x = jax.random.normal(jax.random.fold_in(key, 1), (M * 4, D))
+            y = jax.random.normal(jax.random.fold_in(key, 2), (M * 4, D))
+            step = make_1f1b_train_fn(self._mesh(S), self._stage,
+                                      self._loss, M)
+            loss_p, grads_p = step(params, x, y)
+            loss_s, grads_s = jax.value_and_grad(
+                self._seq_loss(S))(params, x, y)
+            np.testing.assert_allclose(float(loss_p), float(loss_s),
+                                       rtol=1e-5)
+            for k in ("w", "b"):
+                np.testing.assert_allclose(
+                    np.asarray(jax.device_get(grads_p[k])),
+                    np.asarray(grads_s[k]), rtol=1e-4, atol=1e-6)
+
+    def test_lower_peak_memory_than_gpipe(self):
+        """VERDICT done-when: lower peak live activations than GPipe at
+        M=8, S=4 (XLA-reported temp allocation of the compiled step)."""
+        from ray_tpu.parallel.pipeline import (make_1f1b_train_fn,
+                                               make_pipelined_train_fn)
+
+        S, M, D = 4, 8, 256
+        mesh = self._mesh(S)
+        params = {"w": jnp.zeros((S, D, D)), "b": jnp.zeros((S, D))}
+        x = jnp.zeros((M * 32, D))
+        y = jnp.zeros((M * 32, D))
+        f1 = make_1f1b_train_fn(mesh, self._stage, self._loss, M)
+        fg = make_pipelined_train_fn(mesh, self._stage, self._loss, M)
+        m1 = f1.lower(params, x, y).compile().memory_analysis()
+        mg = fg.lower(params, x, y).compile().memory_analysis()
+        t1 = getattr(m1, "temp_size_in_bytes", None)
+        tg = getattr(mg, "temp_size_in_bytes", None)
+        if t1 is None or tg is None:
+            pytest.skip("backend reports no memory analysis")
+        assert t1 < tg, (t1, tg)
+
+
 class TestMultiSlice:
     """DCN / multi-slice mesh: slices emulated as contiguous CPU device
     groups (SURVEY §4 CPU-mirror); batch shards over (dp_dcn, dp) so the
