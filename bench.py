@@ -285,24 +285,31 @@ def bench_broadcast(extras):
             del ref3
         extras["broadcast_tree_gb_per_s"] = round(best, 2)
 
-        # 8-node 1 GiB-class broadcast (reference: 1 GiB to N nodes
-        # scalability bench). 8 daemons x 256 MB = 2 GiB of shm copies;
-        # scale down if /dev/shm can't hold it.
+        # 8-node broadcast (reference: the 1 GiB-to-N-nodes scalability
+        # bench). Uses a true 1 GiB object when /dev/shm can hold
+        # 9 copies + slack; falls back to 256 MB otherwise.
         import shutil
         free_shm = shutil.disk_usage("/dev/shm").free
         if _budget_left() > 120 and free_shm > 4 * (1 << 30):
             for i in range(n_nodes, 8):
                 cluster.add_node(num_cpus=1, resources={f"n{i}": 1},
                                  daemon=True)
-            ref8 = ray_tpu.put(payload)
+            if free_shm > 12 * (1 << 30) and _budget_left() > 300:
+                # ~70 s of copies on a 1-core box; needs budget slack.
+                payload8 = np.zeros((1 << 30,), dtype=np.uint8)  # 1 GiB
+            else:
+                payload8 = payload
+            ref8 = ray_tpu.put(payload8)
             broadcast_object(ray_tpu.put(
                 np.zeros(1 << 20, dtype=np.uint8)))  # warm conns
             t0 = time.perf_counter()
             n = broadcast_object(ref8)
             dt = time.perf_counter() - t0
             extras["broadcast8_nodes"] = n
+            extras["broadcast8_mb"] = payload8.nbytes >> 20
             extras["broadcast8_gb_per_s"] = round(
-                (n - 1) * payload.nbytes / dt / 1e9, 2)
+                (n - 1) * payload8.nbytes / dt / 1e9, 2)
+            del ref8
         cluster.shutdown()
     except Exception as e:
         extras["broadcast_bench_error"] = f"{type(e).__name__}: {e}"

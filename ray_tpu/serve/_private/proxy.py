@@ -181,11 +181,16 @@ class HTTPProxy:
         mode = self._modes.get(mode_key, "unary")
         if mode == "unary":
             try:
-                # assign_request can block (replica ready-wait, queue
-                # probes) — keep it off the event loop; the response
-                # await itself is callback-based.
-                resp = await loop.run_in_executor(
-                    None, lambda: handle.remote(req))
+                # Fast path: when replicas are ready and probes fresh,
+                # assignment cannot block — submit inline and skip the
+                # executor hop. Otherwise assign_request can block
+                # (replica ready-wait, queue probes): keep it off the
+                # event loop. The response await is callback-based
+                # either way.
+                resp = handle._remote_fast(req)
+                if resp is None:
+                    resp = await loop.run_in_executor(
+                        None, lambda: handle.remote(req))
                 result = await resp
                 payload, ctype = _encode_body(result)
                 return web.Response(body=payload, content_type=ctype)

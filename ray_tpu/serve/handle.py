@@ -159,6 +159,53 @@ class _Router:
         return a if self._replica_score(a, now) <= \
             self._replica_score(b, now) else b
 
+    def _probe_stale(self, candidates: List[int], now: float) -> bool:
+        """Caller holds self._lock."""
+        return any(now - getattr(self, "_qlen_ts", {}).get(i, 0.0)
+                   > self._PROBE_TTL_S for i in candidates)
+
+    def _submit_to(self, idx: int, replica, method_name: str,
+                   args: tuple, kwargs: dict):
+        """Submit a unary call to a picked replica, with the in-flight
+        decrement wired to completion (shared by the blocking and
+        event-loop fast paths — the bookkeeping must never diverge)."""
+        ref = replica.handle_request.remote(method_name, args, kwargs)
+
+        def _done(_):
+            with self._lock:
+                if idx in self._inflight and self._inflight[idx] > 0:
+                    self._inflight[idx] -= 1
+        try:
+            ref.future().add_done_callback(_done)
+        except Exception:
+            pass
+        return ref
+
+    def try_assign_fast(self, method_name: str, args: tuple,
+                        kwargs: dict):
+        """Non-blocking assignment for callers that must not stall an
+        event loop (the async proxy): succeeds only when replicas are
+        ready AND the sampled candidates' queue-length probes are fresh
+        — anything that could block (ready-wait, probe RPC) returns
+        None and the caller falls back to an executor thread."""
+        if not self._ready.is_set():
+            return None
+        import time as _time
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                return None
+            if n > 1:
+                candidates = random.sample(range(n), 2)
+                if self._probe_stale(candidates, _time.monotonic()):
+                    return None  # probe due: take the blocking path
+                idx = self._pick(candidates)
+            else:
+                idx = 0
+            replica = self._replicas[idx]
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        return self._submit_to(idx, replica, method_name, args, kwargs)
+
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
                        timeout_s: float = 30.0, stream: bool = False):
         if not self._ready.wait(timeout=timeout_s):
@@ -190,17 +237,7 @@ class _Router:
             except Exception:
                 _stream_done()
             return gen
-        ref = replica.handle_request.remote(method_name, args, kwargs)
-
-        def _done(_):
-            with self._lock:
-                if idx in self._inflight and self._inflight[idx] > 0:
-                    self._inflight[idx] -= 1
-        try:
-            ref.future().add_done_callback(_done)
-        except Exception:
-            pass
-        return ref
+        return self._submit_to(idx, replica, method_name, args, kwargs)
 
     def shutdown(self):
         self._long_poll.stop()
@@ -259,6 +296,24 @@ class DeploymentHandle:
         if self._stream:
             return DeploymentResponseGenerator(out)
         return DeploymentResponse(out)
+
+    def _remote_fast(self, *args, **kwargs):
+        """Event-loop-safe submission: DeploymentResponse, or None when
+        assignment would block (proxy falls back to an executor).
+        Router CONSTRUCTION blocks (controller lookup + replica
+        snapshot), so an unbuilt router also means None."""
+        if self._stream:
+            return None
+        with self._lock:
+            router = self._router
+        if router is None:
+            return None
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
+                      else v) for k, v in kwargs.items()}
+        ref = router.try_assign_fast(self._method, args, kwargs)
+        return DeploymentResponse(ref) if ref is not None else None
 
     def shutdown(self):
         with self._lock:
