@@ -38,6 +38,10 @@ class AlgorithmConfig:
         self.env_to_module_connector: Optional[Callable] = None
         self.module_to_env_connector: Optional[Callable] = None
         self.learner_connector: Optional[Callable] = None
+        # evaluation (reference: AlgorithmConfig.evaluation)
+        self.evaluation_interval: int = 0       # 0 = no periodic eval
+        self.evaluation_duration: int = 5       # episodes per round
+        self.evaluation_num_env_runners: int = 0  # 0 = driver rollouts
 
     def environment(self, env=None, *, env_config: Optional[Dict] = None):
         if env is not None:
@@ -82,6 +86,23 @@ class AlgorithmConfig:
     def debugging(self, *, seed: Optional[int] = None):
         if seed is not None:
             self.seed = seed
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None,
+                   evaluation_num_env_runners: Optional[int] = None):
+        """Periodic evaluation config (reference:
+        AlgorithmConfig.evaluation — evaluation_interval iterations
+        between eval rounds, evaluation_duration episodes per round,
+        dedicated eval runner actors when evaluation_num_env_runners >
+        0; 0 = greedy driver-side rollouts)."""
+        if evaluation_interval is not None:
+            self.evaluation_interval = int(evaluation_interval)
+        if evaluation_duration is not None:
+            self.evaluation_duration = int(evaluation_duration)
+        if evaluation_num_env_runners is not None:
+            self.evaluation_num_env_runners = int(
+                evaluation_num_env_runners)
         return self
 
     def multi_agent(self, *, policies: Optional[Dict[str, Any]] = None,
@@ -150,6 +171,18 @@ class Algorithm:
             # sampling actors (reference: offline algos run without
             # rollout workers).
             self.env_runner_group = None
+        # Dedicated evaluation runners (reference: the eval
+        # EnvRunnerGroup the Algorithm keeps when
+        # evaluation_num_env_runners > 0) — distinct seeds, weights
+        # synced right before each eval round.
+        if getattr(config, "evaluation_num_env_runners", 0) > 0:
+            self.eval_env_runner_group = EnvRunnerGroup(
+                config.env_spec, config.env_config, self.module,
+                num_env_runners=config.evaluation_num_env_runners,
+                seed=config.seed + 10_000,
+                env_to_module=self._e2m, module_to_env=self._m2e)
+        else:
+            self.eval_env_runner_group = None
 
     # subclass hooks
     def _build_module(self, obs_dim: int, num_actions: int):
@@ -189,11 +222,22 @@ class Algorithm:
             "num_env_steps_sampled_lifetime": self._total_steps,
             "time_this_iter_s": time.perf_counter() - t0,
         })
+        interval = getattr(self.config, "evaluation_interval", 0)
+        if interval and self.iteration % interval == 0:
+            # Periodic eval nested under result["evaluation"]
+            # (reference: Algorithm.train eval rounds).
+            result["evaluation"] = self.evaluate(
+                getattr(self.config, "evaluation_duration", 5))
         return result
 
     def evaluate(self, num_episodes: int = 5) -> Dict[str, float]:
-        """Greedy rollouts on a fresh env (reference:
-        Algorithm.evaluate)."""
+        """Evaluation round (reference: Algorithm.evaluate): parallel
+        episodes on the dedicated eval runner group when configured,
+        else greedy rollouts on a fresh driver-side env."""
+        # getattr: subclasses with bespoke __init__ (MultiAgentPPO)
+        # don't build an eval group.
+        if getattr(self, "eval_env_runner_group", None) is not None:
+            return self._evaluate_with_runners(num_episodes)
         from ..env.env_runner import _make_env
         env = _make_env(self.config.env_spec, self.config.env_config)
         # Stateful connector pieces (running obs stats) accumulate in the
@@ -221,6 +265,40 @@ class Algorithm:
         env.close()
         return {"evaluation_return_mean": float(np.mean(returns)),
                 "evaluation_return_max": float(np.max(returns))}
+
+    def _evaluate_with_runners(self, num_episodes: int) -> Dict[str, float]:
+        """Sample the eval group until `num_episodes` episodes finish
+        (evaluation_duration unit=episodes, the reference default).
+        GREEDY actions (explore=False), trained connector stats pushed
+        to the eval runners, and a hard episode reset first so no
+        counted return mixes weights from two rounds."""
+        group = self.eval_env_runner_group
+        group.sync_weights(self.get_weights())
+        self._sync_connector_states()
+        getter = getattr(self._e2m, "get_state", None)
+        if getter is not None:
+            try:
+                group.set_connector_state(getter())
+            except Exception:
+                pass  # stateless pipelines have nothing to push
+        group.reset_episodes()
+        group.collect_metrics()  # drain episodes from prior rounds
+        returns: list = []
+        frag = int(self.config.rollout_fragment_length)
+        for _ in range(64):  # bounded: never loop forever on a non-terminating env
+            group.sample(frag, explore=False)
+            for m in group.collect_metrics():
+                returns.append(m["episode_return"])
+            if len(returns) >= num_episodes:
+                break
+        if not returns:
+            return {"evaluation_return_mean": float("nan"),
+                    "evaluation_return_max": float("nan"),
+                    "evaluation_episodes": 0}
+        returns = returns[:num_episodes]
+        return {"evaluation_return_mean": float(np.mean(returns)),
+                "evaluation_return_max": float(np.max(returns)),
+                "evaluation_episodes": len(returns)}
 
     def save(self, checkpoint_dir: str) -> str:
         os.makedirs(checkpoint_dir, exist_ok=True)
@@ -329,6 +407,8 @@ class Algorithm:
     def stop(self):
         if self.env_runner_group is not None:
             self.env_runner_group.stop()
+        if getattr(self, "eval_env_runner_group", None) is not None:
+            self.eval_env_runner_group.stop()
 
     # Tune integration: Algorithm is usable as a trainable
     # (reference: Algorithm IS a Trainable).

@@ -136,6 +136,26 @@ class SingleAgentEnvRunner:
         getter = getattr(self._env_to_module, "get_state", None)
         return getter() if getter is not None else {}
 
+    def set_connector_state(self, state) -> bool:
+        """Adopt trained connector stats (eval runners must normalize
+        with the statistics the policy trained under)."""
+        setter = getattr(self._env_to_module, "set_state", None)
+        if setter is not None and state:
+            setter(state)
+        return True
+
+    def reset_episode(self, seed=None) -> bool:
+        """Hard episode boundary (evaluation rounds): discard any
+        in-progress episode so counted returns never mix weights from
+        two rounds."""
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._episode_len = 0
+        hook = getattr(self.module, "on_episode_end", None)
+        if hook is not None:
+            hook()
+        return True
+
     def ping(self) -> bool:
         return True
 
@@ -179,6 +199,14 @@ class EnvRunnerGroup:
         to merge (reference: driver-side filter-stat merging)."""
         return ray_tpu.get([r.get_connector_state.remote()
                             for r in self._runners])
+
+    def set_connector_state(self, state):
+        ray_tpu.get([r.set_connector_state.remote(state)
+                     for r in self._runners])
+
+    def reset_episodes(self, seed=None):
+        ray_tpu.get([r.reset_episode.remote(seed)
+                     for r in self._runners])
 
     def stop(self):
         for r in self._runners:

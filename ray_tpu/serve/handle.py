@@ -286,11 +286,19 @@ class DeploymentHandle:
             raise AttributeError(name)
         return self.options(method_name=name)
 
+    @staticmethod
+    def _unwrap(args, kwargs):
+        """DeploymentResponse args become their underlying refs
+        (model-composition chaining) — shared by both submit paths."""
+        return (tuple(a._to_object_ref()
+                      if isinstance(a, DeploymentResponse) else a
+                      for a in args),
+                {k: (v._to_object_ref()
+                     if isinstance(v, DeploymentResponse) else v)
+                 for k, v in kwargs.items()})
+
     def remote(self, *args, **kwargs):
-        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
-                     else a for a in args)
-        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
-                      else v) for k, v in kwargs.items()}
+        args, kwargs = self._unwrap(args, kwargs)
         out = self._get_router().assign_request(
             self._method, args, kwargs, stream=self._stream)
         if self._stream:
@@ -308,10 +316,7 @@ class DeploymentHandle:
             router = self._router
         if router is None:
             return None
-        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
-                     else a for a in args)
-        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
-                      else v) for k, v in kwargs.items()}
+        args, kwargs = self._unwrap(args, kwargs)
         ref = router.try_assign_fast(self._method, args, kwargs)
         return DeploymentResponse(ref) if ref is not None else None
 

@@ -86,6 +86,30 @@ def test_ppo_checkpoint_roundtrip(tmp_path):
         algo.stop()
 
 
+def test_periodic_evaluation_with_eval_runners():
+    """AlgorithmConfig.evaluation (reference: evaluation_interval /
+    evaluation_duration / dedicated eval EnvRunnerGroup): train()
+    nests eval metrics every `evaluation_interval` iterations, sampled
+    on the separate eval runner actors."""
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=1, rollout_fragment_length=128)
+            .training(lr=3e-4, train_batch_size=128)
+            .evaluation(evaluation_interval=2, evaluation_duration=2,
+                        evaluation_num_env_runners=1)
+            .build())
+    try:
+        assert algo.eval_env_runner_group is not None
+        r1 = algo.train()
+        assert "evaluation" not in r1        # iter 1: off-interval
+        r2 = algo.train()                    # iter 2: eval round
+        ev = r2["evaluation"]
+        assert ev["evaluation_episodes"] >= 1
+        assert np.isfinite(ev["evaluation_return_mean"])
+    finally:
+        algo.stop()
+
+
 def test_dqn_cartpole_smoke():
     algo = (DQNConfig()
             .environment("CartPole-v1")
