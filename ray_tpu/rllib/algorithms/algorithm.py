@@ -214,6 +214,14 @@ class Algorithm:
         self._episode_returns.extend(
             m["episode_return"] for m in metrics)
         recent = self._episode_returns[-100:]
+        interval = getattr(self.config, "evaluation_interval", 0)
+        if interval and self.iteration % interval == 0:
+            # Periodic eval nested under result["evaluation"]
+            # (reference: Algorithm.train eval rounds). Runs BEFORE the
+            # timing update so time_this_iter_s covers it — eval-heavy
+            # iterations are the slow ones.
+            result["evaluation"] = self.evaluate(
+                getattr(self.config, "evaluation_duration", 5))
         result.update({
             "training_iteration": self.iteration,
             "episode_return_mean": float(np.mean(recent)) if recent
@@ -222,12 +230,6 @@ class Algorithm:
             "num_env_steps_sampled_lifetime": self._total_steps,
             "time_this_iter_s": time.perf_counter() - t0,
         })
-        interval = getattr(self.config, "evaluation_interval", 0)
-        if interval and self.iteration % interval == 0:
-            # Periodic eval nested under result["evaluation"]
-            # (reference: Algorithm.train eval rounds).
-            result["evaluation"] = self.evaluate(
-                getattr(self.config, "evaluation_duration", 5))
         return result
 
     def evaluate(self, num_episodes: int = 5) -> Dict[str, float]:
@@ -272,6 +274,7 @@ class Algorithm:
         GREEDY actions (explore=False), trained connector stats pushed
         to the eval runners, and a hard episode reset first so no
         counted return mixes weights from two rounds."""
+        import logging
         group = self.eval_env_runner_group
         group.sync_weights(self.get_weights())
         self._sync_connector_states()
@@ -280,18 +283,29 @@ class Algorithm:
             try:
                 group.set_connector_state(getter())
             except Exception:
-                pass  # stateless pipelines have nothing to push
+                # A dead eval runner mid-round: stale stats beat a
+                # failed train() — but say so.
+                logging.getLogger(__name__).warning(
+                    "eval connector-state push failed", exc_info=True)
         group.reset_episodes()
         group.collect_metrics()  # drain episodes from prior rounds
         returns: list = []
         frag = int(self.config.rollout_fragment_length)
-        for _ in range(64):  # bounded: never loop forever on a non-terminating env
-            group.sample(frag, explore=False)
+        # Step budget scales with the ask: ~4000 steps per requested
+        # episode per runner covers gym-length episodes; bounded so a
+        # never-terminating env cannot hang train().
+        max_rounds = max(8, (num_episodes * 4000) // max(1, frag))
+        for _ in range(max_rounds):
+            group.sample(frag, explore=False, update_connectors=False)
             for m in group.collect_metrics():
                 returns.append(m["episode_return"])
             if len(returns) >= num_episodes:
                 break
         if not returns:
+            logging.getLogger(__name__).warning(
+                "evaluation round finished 0 episodes within %d steps"
+                " per runner — episodes longer than the budget?",
+                max_rounds * frag)
             return {"evaluation_return_mean": float("nan"),
                     "evaluation_return_max": float("nan"),
                     "evaluation_episodes": 0}

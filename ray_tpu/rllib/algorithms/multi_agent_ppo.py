@@ -64,6 +64,13 @@ class MultiAgentPPO(Algorithm):
             raise ValueError(
                 "MultiAgentPPO needs config.multi_agent(policies=..., "
                 "policy_mapping_fn=...)")
+        if getattr(config, "evaluation_num_env_runners", 0) > 0:
+            # Reject rather than silently evaluate on the driver.
+            raise ValueError(
+                "MultiAgentPPO does not support dedicated eval runner "
+                "actors yet (evaluation_num_env_runners must be 0; "
+                "driver-side evaluate() still runs per "
+                "evaluation_interval)")
         if (config.env_to_module_connector
                 or config.module_to_env_connector
                 or config.learner_connector):

@@ -60,6 +60,7 @@ class SingleAgentEnvRunner:
         return True
 
     def sample(self, num_steps: int, explore: bool = True,
+               update_connectors: bool = True,
                **explore_kw) -> Dict[str, np.ndarray]:
         """Collect num_steps transitions (truncating episodes as needed).
         Returns a columnar batch (reference: SampleBatch columns)."""
@@ -70,8 +71,12 @@ class SingleAgentEnvRunner:
         extras: Dict[str, List] = {}
         for _ in range(num_steps):
             raw_obs = np.asarray(self._obs, np.float32)[None]
+            # Evaluation rounds freeze stateful connector stats
+            # (update_connectors=False), mirroring the driver-side
+            # evaluate() path's update=False.
             obs_b = self._env_to_module(
-                {"obs": raw_obs}, module=self.module)["obs"]
+                {"obs": raw_obs}, module=self.module,
+                update=update_connectors)["obs"]
             if explore:
                 action, info = self.module.forward_exploration(
                     self.params, obs_b, self.rng, **explore_kw)

@@ -73,7 +73,9 @@ def cmd_start(args):
             (host, int(port)), bytes.fromhex(token_hex),
             num_cpus=args.num_cpus,
             resources=json.loads(args.resources) if args.resources
-            else None)
+            else None,
+            labels=json.loads(args.labels) if getattr(args, "labels",
+                                                      None) else None)
         print(f"ray_tpu node daemon joined head at {args.address} "
               f"(node {daemon.node_hex[:12]}, resources "
               f"{json.dumps(daemon.totals)})", flush=True)
@@ -305,6 +307,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="cluster token printed by the head")
     sp.add_argument("--resources", default=None,
                     help="JSON dict of custom resources for this node")
+    sp.add_argument("--labels", default=None,
+                    help="JSON dict of node labels for "
+                    "NodeLabelSchedulingStrategy (reference: "
+                    "`ray start --labels`)")
     sp.add_argument("--no-block", action="store_true",
                     help="return instead of serving (embedding only; "
                     "the head dies with this process)")
