@@ -567,6 +567,10 @@ class _NativeMux:
         self._states: Dict[int, tuple] = {}  # token -> (handle, on_msg, on_eof)
         self._next_token = 0
         self._stopped = False
+        # Serializes native-core registration against destroy(): a
+        # prestart thread's register racing shutdown must never touch a
+        # freed Dispatcher (segfault), it must see _stopped instead.
+        self._reg_lock = threading.Lock()
         self._cap = 8 << 20
         self._buf = ctypes.create_string_buffer(self._cap)
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -580,7 +584,21 @@ class _NativeMux:
             token = self._next_token
             self._states[token] = (handle, on_message, on_eof)
         try:
-            ok = self._core.add(handle.conn.fileno(), token)
+            with self._reg_lock:
+                if self._stopped:
+                    ok = False  # shutdown raced this registration
+                else:
+                    ok = self._core.add(handle.conn.fileno(), token)
+                    if ok:
+                        # Publish INSIDE the reg lock: stop() detaches
+                        # handles after setting _stopped under this
+                        # lock, so a publish outside it could attach a
+                        # handle to a core stop() already destroyed.
+                        # send_lock still serializes against in-flight
+                        # conn.send_bytes (no frame interleaving).
+                        with handle.send_lock:
+                            handle.native_token = token
+                            handle.native_mux = self
         except (OSError, ValueError):
             ok = False
         if not ok:
@@ -588,12 +606,6 @@ class _NativeMux:
                 self._states.pop(token, None)
             on_eof(handle)
             return
-        # Flip sends to the native queue. Taking send_lock serializes
-        # against any in-flight conn.send_bytes, so frames never
-        # interleave across the two paths.
-        with handle.send_lock:
-            handle.native_token = token
-            handle.native_mux = self
 
     def send_framed(self, token: int, data: bytes) -> bool:
         return self._core.send(token, data)
@@ -641,7 +653,8 @@ class _NativeMux:
                     traceback.print_exc()
 
     def stop(self):
-        self._stopped = True
+        with self._reg_lock:
+            self._stopped = True
         # Detach every handle first: a late send() must fall back to
         # conn.send_bytes, not enqueue into a core being torn down.
         with self._lock:
@@ -652,10 +665,12 @@ class _NativeMux:
                 handle.native_mux = None
         self._core.stop()
         self._thread.join(timeout=2.0)
-        if not self._thread.is_alive():
+        if self._thread.is_alive():
+            return  # pump stuck in a slow handler: leak, don't free
+        with self._reg_lock:
+            # No register() can be inside the core now (_stopped was
+            # set under this lock before any destroy).
             self._core.destroy()
-        # else: pump is stuck in a slow handler — leak the core rather
-        # than free memory a live thread still dereferences.
 
 
 def _make_recv_mux():

@@ -228,3 +228,26 @@ def test_arena_spill_and_restore(tmp_path):
         assert store.stats()["restored_count"] >= 1
     finally:
         store.shutdown()
+
+
+def test_init_shutdown_churn_no_native_crash():
+    """Regression: a prestart thread's native-mux registration racing
+    shutdown() used to disp_add into a destroyed Dispatcher (segfault).
+    Rapid init/shutdown cycles drive exactly that window."""
+    import os
+
+    import ray_tpu
+    from ray_tpu import _native
+    from ray_tpu._private import state as _state
+    from ray_tpu._private.scheduler import _NativeMux
+
+    if (not _native.available()
+            or os.environ.get("RAY_TPU_NATIVE_DISPATCH") == "0"):
+        pytest.skip("native dispatch core unavailable")
+    for i in range(6):
+        ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+        if i == 0:
+            # Not vacuous: the cycles must actually exercise the
+            # native mux, not the pure-Python fallback.
+            assert isinstance(_state.current().pool._mux, _NativeMux)
+        ray_tpu.shutdown()  # immediately: prestart threads still booting
