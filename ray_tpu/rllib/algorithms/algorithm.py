@@ -29,6 +29,10 @@ class AlgorithmConfig:
         self.gamma: float = 0.99
         self.train_batch_size: int = 400
         self.hidden: tuple = (64, 64)
+        # Catalog model config (reference: AlgorithmConfig.model /
+        # MODEL_DEFAULTS) — merged over rllib.core.catalog.MODEL_DEFAULTS
+        # by the module.
+        self.model: Dict[str, Any] = {}
         self.seed: int = 0
         self.extra: Dict[str, Any] = {}
         # multi-agent (reference: AlgorithmConfig.multi_agent)
@@ -76,8 +80,14 @@ class AlgorithmConfig:
             self.gamma = gamma
         if train_batch_size is not None:
             self.train_batch_size = train_batch_size
-        if model is not None and "hidden" in model:
-            self.hidden = tuple(model["hidden"])
+        if model is not None:
+            from ..core.catalog import merge_model_config
+            merge_model_config(model)  # validate keys up front
+            self.model.update(model)
+            if "hidden" in model:
+                self.hidden = tuple(model["hidden"])
+            elif "fcnet_hiddens" in model:
+                self.hidden = tuple(model["fcnet_hiddens"])
         if "learner_connector" in kwargs:
             self.learner_connector = kwargs.pop("learner_connector")
         self.extra.update(kwargs)
@@ -123,11 +133,15 @@ class AlgorithmConfig:
 
 
 def _env_dims(env_spec, env_config) -> tuple:
-    """(obs_dim, action_dim) — action_dim is `n` for discrete spaces,
-    the action vector length for continuous (Box) spaces."""
+    """(obs_dim, action_dim) — obs_dim is the flat width for vector
+    obs, the full `(H, W, C)` shape tuple for image (rank-3) obs so the
+    Catalog can build a CNN; action_dim is `n` for discrete spaces, the
+    action vector length for continuous (Box) spaces."""
     from ..env.env_runner import _make_env
     env = _make_env(env_spec, env_config or {})
-    obs_dim = int(np.prod(env.observation_space.shape))
+    shape = env.observation_space.shape or (1,)
+    obs_dim = tuple(int(d) for d in shape) if len(shape) == 3 \
+        else int(np.prod(shape))
     space = env.action_space
     if hasattr(space, "n"):
         num_actions = int(space.n)

@@ -68,7 +68,8 @@ class MARWIL(Algorithm):
         super().__init__(config)
 
     def _build_module(self, obs_dim, num_actions):
-        return PPOModule(obs_dim, num_actions, self.config.hidden)
+        return PPOModule(obs_dim, num_actions, self.config.hidden,
+                         model_config=self.config.model)
 
     def _build_learner(self):
         cfg = self.config

@@ -82,7 +82,8 @@ class CQL(Algorithm):
         self._key = jax.random.PRNGKey(cfg.seed)
 
     def _build_module(self, obs_dim, num_actions):
-        return SACModule(obs_dim, num_actions, self.config.hidden)
+        return SACModule(obs_dim, num_actions, self.config.hidden,
+                         model_config=self.config.model)
 
     def _build_learner(self):
         return None  # CQL owns its jitted update (twin nets + alpha)

@@ -43,7 +43,8 @@ class DQN(Algorithm):
             lambda p, obs: self.module.apply(p, obs))
 
     def _build_module(self, obs_dim, num_actions):
-        return DQNModule(obs_dim, num_actions, self.config.hidden)
+        return DQNModule(obs_dim, num_actions, self.config.hidden,
+                         model_config=self.config.model)
 
     def _build_learner(self):
         return JaxLearner(self.module, make_dqn_loss(self.config.gamma),

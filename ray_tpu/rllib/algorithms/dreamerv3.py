@@ -437,6 +437,10 @@ class DreamerV3(Algorithm):
 
     def _build_module(self, obs_dim, num_actions):
         ex = self.config.extra
+        # Dreamer's hand-rolled MLP world model is vector-obs only
+        # (documented in the module docstring); image obs flatten.
+        if not isinstance(obs_dim, int):
+            obs_dim = int(np.prod(obs_dim))
         return DreamerModule(
             obs_dim, num_actions,
             n_deter=int(ex.get("n_deter", 256)),

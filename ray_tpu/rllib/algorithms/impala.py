@@ -88,7 +88,8 @@ class IMPALA(Algorithm):
             lambda p, o: self.module.apply(p, o)[1])
 
     def _build_module(self, obs_dim, num_actions):
-        return PPOModule(obs_dim, num_actions, self.config.hidden)
+        return PPOModule(obs_dim, num_actions, self.config.hidden,
+                         model_config=self.config.model)
 
     def _build_learner(self):
         cfg = self.config

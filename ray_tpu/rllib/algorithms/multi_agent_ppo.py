@@ -86,7 +86,8 @@ class MultiAgentPPO(Algorithm):
                                   config.policies,
                                   config.policy_mapping_fn)
         self.module = MultiRLModule({
-            mid: PPOModule(obs_dim, n_act, config.hidden)
+            mid: PPOModule(obs_dim, n_act, config.hidden,
+                           model_config=config.model)
             for mid, (obs_dim, n_act) in dims.items()})
         ex = config.extra
         loss = make_ppo_loss(

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.learner import JaxLearner
-from ..core.rl_module import PPOModule
+from ..core.rl_module import PPOModule, RecurrentPPOModule
 from .algorithm import Algorithm, AlgorithmConfig
 
 
@@ -39,6 +39,79 @@ def make_ppo_loss(clip: float = 0.2, vf_coeff: float = 0.5,
 
 
 ppo_loss = make_ppo_loss()  # default-coefficient loss (tests, docs)
+
+
+def make_recurrent_ppo_loss(clip: float = 0.2, vf_coeff: float = 0.5,
+                            entropy_coeff: float = 0.01):
+    """Sequence PPO loss for use_lstm modules: the LSTM re-runs from the
+    recorded rollout carry at each chunk start, padded steps masked out
+    (reference: ppo loss + rllib sequence masking via seq_lens)."""
+
+    def loss(params, module, batch):
+        logits, values = module.seq_forward(
+            params, batch["obs"],
+            (batch["carry_c"], batch["carry_h"]), batch["resets"])
+        mask = batch["mask"]
+        msum = jnp.maximum(mask.sum(), 1.0)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        ratio = jnp.exp(logp - batch["action_logp"])
+        adv = batch["advantages"]
+        surrogate = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        policy_loss = -jnp.sum(surrogate * mask) / msum
+        vf_loss = 0.5 * jnp.sum(
+            (values - batch["value_targets"]) ** 2 * mask) / msum
+        entropy = -jnp.sum(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1) * mask) / msum
+        total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    return loss
+
+
+def _chunk_fragments(frags, max_seq_len: int) -> Dict[str, np.ndarray]:
+    """Cut GAE'd rollout fragments into (num_seqs, max_seq_len) rows for
+    truncated BPTT: each row carries the TRUE rollout LSTM state at its
+    start (`state_in_*` recorded per step) plus in-row episode-boundary
+    resets; short tails are zero-padded with mask=0 (reference: the
+    max_seq_len chunking + padding in rllib's sequence handling)."""
+    keys = ("obs", "actions", "advantages", "value_targets", "action_logp")
+    rows: Dict[str, list] = {k: [] for k in
+                             keys + ("resets", "mask", "carry_c", "carry_h")}
+    L = int(max_seq_len)
+    for b in frags:
+        t0 = len(b["rewards"])
+        done = np.logical_or(b["terminateds"], b["truncateds"])
+        resets = np.zeros(t0, np.float32)
+        resets[1:] = done[:-1].astype(np.float32)
+        for s in range(0, t0, L):
+            e = min(s + L, t0)
+            pad = L - (e - s)
+
+            def cut(x):
+                x = np.asarray(x[s:e])
+                if pad:
+                    x = np.concatenate(
+                        [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+                return x
+
+            for k in keys:
+                rows[k].append(cut(b[k]))
+            # The recorded carry supplies cross-chunk state, so the
+            # chunk's first step never resets.
+            r = cut(resets)
+            r[0] = 0.0
+            rows["resets"].append(r)
+            m = np.zeros(L, np.float32)
+            m[:e - s] = 1.0
+            rows["mask"].append(m)
+            rows["carry_c"].append(b["state_in_c"][s])
+            rows["carry_h"].append(b["state_in_h"][s])
+    return {k: np.stack(v) for k, v in rows.items()}
 
 
 def compute_gae(batch: Dict[str, np.ndarray], gamma: float,
@@ -84,11 +157,16 @@ def compute_gae(batch: Dict[str, np.ndarray], gamma: float,
 
 class PPO(Algorithm):
     def _build_module(self, obs_dim, num_actions):
-        return PPOModule(obs_dim, num_actions, self.config.hidden)
+        cls = RecurrentPPOModule if self.config.model.get("use_lstm") \
+            else PPOModule
+        return cls(obs_dim, num_actions, self.config.hidden,
+                   model_config=self.config.model)
 
     def _build_learner(self):
         ex = self.config.extra
-        loss = make_ppo_loss(
+        make = make_recurrent_ppo_loss \
+            if getattr(self.module, "recurrent", False) else make_ppo_loss
+        loss = make(
             clip=float(ex.get("clip_param", 0.2)),
             vf_coeff=float(ex.get("vf_loss_coeff", 0.5)),
             entropy_coeff=float(ex.get("entropy_coeff", 0.01)))
@@ -102,6 +180,8 @@ class PPO(Algorithm):
                           seed=self.config.seed)
 
     def training_step(self) -> Dict:
+        if getattr(self.module, "recurrent", False):
+            return self._training_step_recurrent()
         cfg = self.config
         frags = self.env_runner_group.sample(cfg.rollout_fragment_length)
         if self._learner_conn is not None:
@@ -141,6 +221,54 @@ class PPO(Algorithm):
                 mb = idx[s:s + minibatch]
                 if len(mb) < 2:
                     continue
+                stats = self.learner.update(
+                    {k: v[mb] for k, v in batch.items()})
+        self.env_runner_group.sync_weights(self.learner.get_weights())
+        return dict(stats)
+
+    def _training_step_recurrent(self) -> Dict:
+        """use_lstm path: GAE bootstraps from the recorded post-step
+        carries, then minibatches are (sequence-chunk)-level, never
+        shuffled within time."""
+        cfg = self.config
+        frags = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        if self._learner_conn is not None:
+            frags = [self._learner_conn(dict(b), module=self.module)
+                     for b in frags]
+        params = self.learner.get_weights()
+        mod = self.module
+
+        def _gae(b):
+            bootstrap = 0.0
+            if not (b["terminateds"][-1] or b["truncateds"][-1]):
+                v = mod.value_with_state(
+                    params, b["next_obs"][-1:].astype(np.float32),
+                    (b["state_out_c"][-1:], b["state_out_h"][-1:]))
+                bootstrap = float(v[0])
+            trunc = np.logical_and(b["truncateds"], ~b["terminateds"])
+            trunc_nv = None
+            if trunc.any():
+                trunc_nv = np.asarray(mod.value_with_state(
+                    params, b["next_obs"].astype(np.float32),
+                    (b["state_out_c"], b["state_out_h"])))
+            return compute_gae(b, cfg.gamma,
+                               cfg.extra.get("lambda_", 0.95),
+                               bootstrap_value=bootstrap,
+                               trunc_next_values=trunc_nv)
+
+        frags = [_gae(b) for b in frags]
+        self._total_steps += sum(len(b["rewards"]) for b in frags)
+        batch = _chunk_fragments(frags, mod.max_seq_len)
+        n = len(batch["mask"])
+        mb_seqs = max(1, int(cfg.extra.get("minibatch_size", 128))
+                      // mod.max_seq_len)
+        idx = np.arange(n)
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        stats: Dict = {}
+        for _ in range(int(cfg.extra.get("num_epochs", 4))):
+            rng.shuffle(idx)
+            for s in range(0, n, mb_seqs):
+                mb = idx[s:s + mb_seqs]
                 stats = self.learner.update(
                     {k: v[mb] for k, v in batch.items()})
         self.env_runner_group.sync_weights(self.learner.get_weights())

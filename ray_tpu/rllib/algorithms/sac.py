@@ -115,7 +115,8 @@ class SAC(Algorithm):
         self.env_runner_group.sync_weights(self._state["params"])
 
     def _build_module(self, obs_dim, num_actions):
-        return SACModule(obs_dim, num_actions, self.config.hidden)
+        return SACModule(obs_dim, num_actions, self.config.hidden,
+                         model_config=self.config.model)
 
     def _build_learner(self):
         return None  # SAC owns its jitted update (twin nets + alpha)

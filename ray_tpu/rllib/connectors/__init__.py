@@ -133,9 +133,14 @@ class Lambda(ConnectorV2):
 # -- env_to_module pieces --------------------------------------------------
 class FlattenObservations(ConnectorV2):
     """Flatten per-row observation tensors to 1-D vectors (reference:
-    connectors/env_to_module/flatten_observations.py)."""
+    connectors/env_to_module/flatten_observations.py). No-op for modules
+    whose Catalog encoder is a CNN (`module.preserve_obs_shape`) — a
+    flattened image can't reach the conv stack."""
 
-    def __call__(self, batch, **ctx):
+    def __call__(self, batch, module=None, **ctx):
+        if module is not None and getattr(module, "preserve_obs_shape",
+                                          False):
+            return batch
         obs = np.asarray(batch["obs"])
         batch["obs"] = obs.reshape(obs.shape[0], -1)
         return batch

@@ -1,0 +1,232 @@
+"""Model catalog: obs-space-driven default encoder construction.
+
+Reference parity: rllib/core/models/catalog.py (Catalog — picks the
+encoder architecture from the observation space + model config) and
+rllib/models/catalog.py (MODEL_DEFAULTS: fcnet_hiddens/fcnet_activation/
+conv_filters/conv_activation/post_fcnet_hiddens/use_lstm/lstm_cell_size/
+max_seq_len). TPU-first re-design: encoders are flax.linen modules —
+convs and denses lower onto the MXU, NHWC layout (jax's conv default),
+no torch/tf framework split.
+
+Usage mirrors the reference: `AlgorithmConfig.training(model={...})`
+merges over MODEL_DEFAULTS; algorithms hand the merged dict to their
+RLModule, whose net embeds `Catalog.build_encoder(obs_shape, cfg)`.
+Image observations (rank-3 `(H, W, C)` obs spaces) automatically get a
+CNN stack (auto-sized filters when `conv_filters` is None, like the
+reference's default filter tables); vector observations get the
+configured MLP.
+"""
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+# Reference: rllib/models/catalog.py MODEL_DEFAULTS (the subset that has
+# meaning in this framework; unknown keys are rejected by validate()).
+MODEL_DEFAULTS: Dict[str, Any] = {
+    # MLP torso for vector obs.
+    "fcnet_hiddens": [64, 64],
+    "fcnet_activation": "tanh",
+    # CNN torso for (H, W, C) obs; None -> auto filters from resolution.
+    "conv_filters": None,
+    "conv_activation": "relu",
+    # Dense layers after the conv flatten (reference post_fcnet_hiddens).
+    "post_fcnet_hiddens": [256],
+    # Recurrent wrapper (PPO; reference use_lstm auto-wrapping).
+    "use_lstm": False,
+    "lstm_cell_size": 128,
+    "max_seq_len": 20,
+}
+
+_ACTIVATIONS = {
+    "tanh": nn.tanh,
+    "relu": nn.relu,
+    "silu": nn.silu,
+    "swish": nn.silu,
+    "gelu": nn.gelu,
+    "elu": nn.elu,
+    "linear": lambda x: x,
+}
+
+
+def get_activation(name: str):
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name!r}; one of {sorted(_ACTIVATIONS)}")
+
+
+def merge_model_config(model_config: Optional[Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+    """MODEL_DEFAULTS <- user dict, rejecting unknown keys (the
+    reference warns on unknown model-config keys; silent acceptance of
+    a typo'd `conv_filers` would be a debugging trap)."""
+    if model_config is not None and set(model_config) == set(MODEL_DEFAULTS):
+        return dict(model_config)  # already merged (idempotent fast path)
+    cfg = dict(MODEL_DEFAULTS)
+    if model_config:
+        unknown = set(model_config) - set(MODEL_DEFAULTS) - {"hidden"}
+        if unknown:
+            raise ValueError(
+                f"Unknown model config keys {sorted(unknown)}; "
+                f"known: {sorted(MODEL_DEFAULTS)}")
+        cfg.update(model_config)
+        # Back-compat alias from earlier rounds: model={"hidden": ...}.
+        if "hidden" in model_config and "fcnet_hiddens" not in model_config:
+            cfg["fcnet_hiddens"] = list(model_config["hidden"])
+    return cfg
+
+
+class MLPEncoder(nn.Module):
+    """Dense torso for vector obs (reference: the default MLP encoder
+    built by Catalog for Box(1-D) spaces). Flattens higher-rank input
+    so it also serves as the fallback for image obs with
+    conv_filters=[] (explicitly disabled CNN)."""
+    hidden: Sequence[int] = (64, 64)
+    activation: str = "tanh"
+
+    @nn.compact
+    def __call__(self, x):
+        act = get_activation(self.activation)
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        for h in self.hidden:
+            x = act(nn.Dense(h)(x))
+        return x
+
+
+class ConvEncoder(nn.Module):
+    """CNN torso for (B, H, W, C) image obs (reference: the CNN encoder
+    Catalog builds from conv_filters). SAME padding + stride downsampling;
+    the flattened features pass through post_fcnet dense layers so the
+    latent width is resolution-independent."""
+    filters: Tuple[Tuple[int, int, int], ...]  # (out_ch, kernel, stride)
+    activation: str = "relu"
+    post: Tuple[int, ...] = (256,)
+
+    @nn.compact
+    def __call__(self, x):
+        act = get_activation(self.activation)
+        if x.ndim != 4:
+            raise ValueError(
+                f"ConvEncoder expects (B, H, W, C) input, got shape "
+                f"{x.shape}; batch single images with obs[None]")
+        for out_ch, kernel, stride in self.filters:
+            x = act(nn.Conv(int(out_ch), (int(kernel), int(kernel)),
+                            strides=(int(stride), int(stride)),
+                            padding="SAME")(x))
+        x = x.reshape(x.shape[0], -1)
+        for h in self.post:
+            x = act(nn.Dense(int(h))(x))
+        return x
+
+
+class LSTMEncoder(nn.Module):
+    """Recurrent torso (reference: the use_lstm auto-wrapper,
+    rllib/models catalog + rllib/core/models/configs.py
+    RecurrentEncoderConfig): inner encoder per timestep, then an LSTM
+    scanned over time with carry resets at episode boundaries.
+
+    TPU-first: the time scan is `jax.lax.scan` (one compiled program,
+    no per-step dispatch); resets are data (a (B, T) float mask), so
+    episode boundaries never retrace.
+
+    Call: `(feats, carry) = enc(x, carry, resets)` with
+      x: (B, T, *obs), carry: (c, h) each (B, cell), resets: (B, T)
+      1.0 where the state must zero BEFORE consuming step t.
+    Step mode is T=1."""
+    encoder: nn.Module
+    cell_size: int = 128
+
+    @nn.compact
+    def __call__(self, x, carry, resets):
+        b, t = x.shape[0], x.shape[1]
+        z = self.encoder(x.reshape((b * t,) + x.shape[2:]))
+        z = z.reshape(b, t, -1)
+
+        def body(cell, carry_t, inp):
+            z_t, r_t = inp
+            keep = (1.0 - r_t)[:, None]
+            carry_t = (carry_t[0] * keep, carry_t[1] * keep)
+            return cell(carry_t, z_t)
+
+        # scan over time: inputs swapped to (T, B, ...)
+        (c, h), outs = nn.scan(
+            body,
+            variable_broadcast="params", split_rngs={"params": False},
+            in_axes=0, out_axes=0,
+        )(nn.OptimizedLSTMCell(self.cell_size), carry,
+          (jnp.swapaxes(z, 0, 1),
+           jnp.swapaxes(resets.astype(z.dtype), 0, 1)))
+        return jnp.swapaxes(outs, 0, 1), (c, h)
+
+    @nn.nowrap
+    def initial_carry(self, batch: int):
+        zeros = jnp.zeros((batch, self.cell_size), jnp.float32)
+        return (zeros, zeros)
+
+
+def default_conv_filters(obs_shape: Sequence[int]
+                         ) -> Tuple[Tuple[int, int, int], ...]:
+    """Auto-size a conv stack for the input resolution (reference:
+    rllib/models/utils.py get_filter_config's per-resolution tables,
+    generalized): stride-2 4x4 convs halving the spatial dims until
+    <= 4 px, channels doubling 16 -> 256."""
+    h, w = int(obs_shape[0]), int(obs_shape[1])
+    filters = []
+    ch = 16
+    while min(h, w) > 4 and len(filters) < 8:
+        filters.append((ch, 4, 2))
+        h, w = (h + 1) // 2, (w + 1) // 2
+        ch = min(ch * 2, 256)
+    if not filters:  # tiny inputs still get one conv mixing channels
+        filters.append((16, 3, 1))
+    return tuple(filters)
+
+
+class Catalog:
+    """Reference: rllib/core/models/catalog.py Catalog. Classmethods so
+    custom catalogs can subclass and override encoder choice."""
+
+    @classmethod
+    def build_encoder(cls, obs_shape: Sequence[int],
+                      model_config: Optional[Dict[str, Any]] = None
+                      ) -> nn.Module:
+        """Encoder for an observation of shape `obs_shape` (no batch
+        dim). Rank-3 (H, W, C) -> CNN; anything else -> MLP. An empty
+        `conv_filters` (any sequence type) explicitly disables the CNN."""
+        cfg = merge_model_config(model_config)
+        if cls.is_image(obs_shape, cfg):
+            filters = cfg["conv_filters"] or default_conv_filters(obs_shape)
+            return ConvEncoder(
+                filters=tuple(tuple(int(v) for v in f) for f in filters),
+                activation=cfg["conv_activation"],
+                post=tuple(int(h) for h in cfg["post_fcnet_hiddens"]))
+        return MLPEncoder(hidden=tuple(int(h) for h in cfg["fcnet_hiddens"]),
+                          activation=cfg["fcnet_activation"])
+
+    @classmethod
+    def is_image(cls, obs_shape: Sequence[int],
+                 model_config: Optional[Dict[str, Any]] = None) -> bool:
+        """True when `obs_shape` gets a CNN: rank-3, and conv_filters is
+        not an explicitly empty sequence (None means auto-filters)."""
+        cfg = merge_model_config(model_config)
+        filt = cfg["conv_filters"]
+        disabled = filt is not None and len(filt) == 0
+        return len(obs_shape) == 3 and not disabled
+
+
+def encoder_out_dim(encoder: nn.Module, obs_shape: Sequence[int]) -> int:
+    """Output feature width of an encoder for `obs_shape` inputs,
+    via jax shape inference (eval_shape: no FLOPs, no params on device)."""
+    import jax
+
+    def run(x):
+        return encoder.init_with_output(jax.random.PRNGKey(0), x)[0]
+
+    out = jax.eval_shape(
+        lambda x: run(x),
+        jnp.zeros((1,) + tuple(obs_shape), jnp.float32))
+    return int(np.prod(out.shape[1:]))

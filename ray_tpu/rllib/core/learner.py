@@ -65,6 +65,11 @@ class JaxLearner:
                    if getattr(v, "ndim", 0) else 0
                    for v in batch.values())
         m = (lead // d) * d   # drop ragged tail so shards are equal
+        if m == 0:
+            # Batch smaller than the mesh (tiny recurrent-sequence
+            # minibatches): replicate instead of sharding to nothing.
+            return {k: jax.device_put(jnp.asarray(v), repl)
+                    for k, v in batch.items()}
         out = {}
         for k, v in batch.items():
             if getattr(v, "ndim", 0) == 0:

@@ -6,34 +6,28 @@ JAX: a module is a pure flax.linen network + explicit param pytrees, so
 the same definition runs in env-runner actors (numpy in, actions out) and
 in the learner's jitted/pjit'ed update.
 """
-from typing import Any, Dict, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-
-class MLPEncoder(nn.Module):
-    """Shared torso (reference: rllib's default MLP encoder,
-    catalog/model configs)."""
-    hidden: Sequence[int] = (64, 64)
-
-    @nn.compact
-    def __call__(self, x):
-        for h in self.hidden:
-            x = nn.tanh(nn.Dense(h)(x))
-        return x
+from .catalog import Catalog, LSTMEncoder, MLPEncoder, merge_model_config
 
 
 class ActorCriticNet(nn.Module):
-    """Policy logits + value head (PPO-style)."""
+    """Policy logits + value head (PPO-style). `encoder` is any torso
+    from the Catalog (MLP for vector obs, CNN for images)."""
     num_actions: int
     hidden: Sequence[int] = (64, 64)
+    encoder: Optional[nn.Module] = None
 
     @nn.compact
     def __call__(self, obs):
-        z = MLPEncoder(self.hidden)(obs)
+        enc = self.encoder if self.encoder is not None \
+            else MLPEncoder(self.hidden)
+        z = enc(obs)
         logits = nn.Dense(self.num_actions)(z)
         value = jnp.squeeze(nn.Dense(1)(z), -1)
         return logits, value
@@ -43,10 +37,13 @@ class QNet(nn.Module):
     """Q-values per action (DQN-style)."""
     num_actions: int
     hidden: Sequence[int] = (64, 64)
+    encoder: Optional[nn.Module] = None
 
     @nn.compact
     def __call__(self, obs):
-        z = MLPEncoder(self.hidden)(obs)
+        enc = self.encoder if self.encoder is not None \
+            else MLPEncoder(self.hidden)
+        z = enc(obs)
         return nn.Dense(self.num_actions)(z)
 
 
@@ -54,46 +51,123 @@ class GaussianActorNet(nn.Module):
     """Squashed-Gaussian policy head (SAC-style): mean + log_std."""
     action_dim: int
     hidden: Sequence[int] = (64, 64)
+    encoder: Optional[nn.Module] = None
 
     @nn.compact
     def __call__(self, obs):
-        z = MLPEncoder(self.hidden)(obs)
+        enc = self.encoder if self.encoder is not None \
+            else MLPEncoder(self.hidden)
+        z = enc(obs)
         mean = nn.Dense(self.action_dim)(z)
         log_std = jnp.clip(nn.Dense(self.action_dim)(z), -10.0, 2.0)
         return mean, log_std
 
 
 class TwinQNet(nn.Module):
-    """Two independent Q(s, a) critics (clipped double-Q, SAC/TD3)."""
+    """Two independent Q(s, a) critics (clipped double-Q, SAC/TD3).
+
+    Vector obs keep the round-1 shape: MLP over concat(obs, action).
+    Image obs (an `encoder` is set) encode first, then concat the latent
+    with the action — convolving an action-broadcast image would be
+    meaningless."""
     hidden: Sequence[int] = (64, 64)
+    activation: str = "tanh"
+    encoder: Optional[nn.Module] = None
 
     @nn.compact
     def __call__(self, obs, action):
-        x = jnp.concatenate([obs, action], axis=-1)
-        q1 = jnp.squeeze(nn.Dense(1)(MLPEncoder(self.hidden)(x)), -1)
-        q2 = jnp.squeeze(nn.Dense(1)(MLPEncoder(self.hidden)(x)), -1)
+        if self.encoder is not None:
+            z = self.encoder(obs)
+            x = jnp.concatenate([z, action], axis=-1)
+        else:
+            x = jnp.concatenate([obs, action], axis=-1)
+        q1 = jnp.squeeze(nn.Dense(1)(
+            MLPEncoder(self.hidden, self.activation)(x)), -1)
+        q2 = jnp.squeeze(nn.Dense(1)(
+            MLPEncoder(self.hidden, self.activation)(x)), -1)
         return q1, q2
 
 
+def _sample_discrete(logits: np.ndarray, rng: np.random.Generator
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Categorical sample + log-prob from raw logits (shared by the
+    feed-forward and recurrent exploration paths)."""
+    z = logits - logits.max(-1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(-1, keepdims=True)
+    n = p.shape[-1]
+    actions = np.array([rng.choice(n, p=pi) for pi in p])
+    logp = np.log(p[np.arange(len(actions)), actions] + 1e-12)
+    return actions, logp
+
+
+class RecurrentActorCriticNet(nn.Module):
+    """LSTM torso + policy/value heads (reference: the use_lstm-wrapped
+    default module). Sequence-shaped: obs (B, T, *obs), carry (c, h)
+    each (B, cell), resets (B, T)."""
+    num_actions: int
+    encoder: nn.Module
+    cell_size: int = 128
+
+    @nn.compact
+    def __call__(self, obs, carry, resets):
+        feats, carry = LSTMEncoder(
+            encoder=self.encoder, cell_size=self.cell_size)(
+            obs, carry, resets)
+        logits = nn.Dense(self.num_actions)(feats)
+        value = jnp.squeeze(nn.Dense(1)(feats), -1)
+        return logits, value, carry
+
+
 class RLModule:
-    """Reference: rl_module.py:260. Stateless apply + explicit params."""
+    """Reference: rl_module.py:260. Stateless apply + explicit params.
+
+    `obs_dim` accepts an int (flat vector obs, the common case) or a
+    shape tuple — rank-3 `(H, W, C)` shapes get a Catalog CNN encoder
+    and set `preserve_obs_shape` so the default FlattenObservations
+    connector passes images through unflattened."""
 
     # Discrete action space by default; continuous modules (SAC) set
     # False so env runners pass float action vectors to env.step.
     discrete = True
+    # Recurrent modules (RecurrentPPOModule) carry rollout state and
+    # accept use_lstm=True; everything else rejects it loudly.
+    recurrent = False
 
-    def __init__(self, obs_dim: int, num_actions: int,
-                 hidden: Sequence[int] = (64, 64)):
-        self.obs_dim = obs_dim
+    def __init__(self, obs_dim: Union[int, Sequence[int]], num_actions: int,
+                 hidden: Sequence[int] = (64, 64),
+                 model_config: Optional[Dict[str, Any]] = None):
+        if isinstance(obs_dim, (int, np.integer)):
+            self.obs_shape: Tuple[int, ...] = (int(obs_dim),)
+        else:
+            self.obs_shape = tuple(int(d) for d in obs_dim)
+        self.obs_dim = int(np.prod(self.obs_shape))
         self.num_actions = num_actions
-        self.hidden = tuple(hidden)
+        self.model_config = dict(model_config) if model_config else None
+        cfg = merge_model_config(self.model_config)
+        mc = self.model_config or {}
+        if "fcnet_hiddens" not in mc and "hidden" not in mc:
+            # Constructor arg wins when the model config doesn't speak.
+            cfg["fcnet_hiddens"] = list(hidden)
+        self.hidden = tuple(cfg["fcnet_hiddens"])
+        self._cfg = cfg
+        if cfg["use_lstm"] and not self.recurrent:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support use_lstm=True "
+                "(recurrent policies are supported for PPO; see "
+                "RecurrentPPOModule)")
+        self.preserve_obs_shape = Catalog.is_image(self.obs_shape, cfg)
         self.net = self._build_net()
+
+    def _make_encoder(self) -> nn.Module:
+        """Catalog torso for this module's obs shape + model config."""
+        return Catalog.build_encoder(self.obs_shape, self._cfg)
 
     def _build_net(self) -> nn.Module:
         raise NotImplementedError
 
     def init_params(self, seed: int = 0):
-        dummy = jnp.zeros((1, self.obs_dim), jnp.float32)
+        dummy = jnp.zeros((1,) + self.obs_shape, jnp.float32)
         return self.net.init(jax.random.PRNGKey(seed), dummy)["params"]
 
     def apply(self, params, obs):
@@ -108,14 +182,16 @@ class RLModule:
         raise NotImplementedError
 
     def __reduce__(self):
-        return (type(self), (self.obs_dim, self.num_actions, self.hidden))
+        return (type(self), (self.obs_shape, self.num_actions, self.hidden,
+                             self.model_config))
 
 
 class PPOModule(RLModule):
     """Reference: rllib/algorithms/ppo default module."""
 
     def _build_net(self):
-        return ActorCriticNet(self.num_actions, self.hidden)
+        return ActorCriticNet(self.num_actions, self.hidden,
+                              encoder=self._make_encoder())
 
     def forward_inference(self, params, obs):
         logits, _ = self.apply(params, jnp.asarray(obs))
@@ -123,14 +199,121 @@ class PPOModule(RLModule):
 
     def forward_exploration(self, params, obs, rng, **kw):
         logits, value = self.apply(params, jnp.asarray(obs))
-        logits = np.asarray(logits)
-        value = np.asarray(value)
-        z = logits - logits.max(-1, keepdims=True)
-        p = np.exp(z)
-        p /= p.sum(-1, keepdims=True)
-        actions = np.array([rng.choice(self.num_actions, p=pi) for pi in p])
-        logp = np.log(p[np.arange(len(actions)), actions] + 1e-12)
-        return actions, {"vf_preds": value, "action_logp": logp}
+        actions, logp = _sample_discrete(np.asarray(logits), rng)
+        return actions, {"vf_preds": np.asarray(value),
+                         "action_logp": logp}
+
+
+class RecurrentPPOModule(PPOModule):
+    """use_lstm PPO module (reference: the rllib use_lstm auto-wrapper;
+    model config keys lstm_cell_size / max_seq_len).
+
+    Rollout state lives on the module instance per process (each env
+    runner actor holds its own pickled copy); `on_episode_end` resets
+    it, matching the reference's state-reset connector. Every
+    exploration step records the PRE-step carry (`state_in_c/h`) in the
+    sample batch, so the learner re-runs the LSTM from the TRUE rollout
+    state at each max_seq_len chunk start instead of zeros."""
+
+    recurrent = True
+
+    def __init__(self, obs_dim, num_actions, hidden=(64, 64),
+                 model_config=None):
+        super().__init__(obs_dim, num_actions, hidden, model_config)
+        self._carry = None
+
+    @property
+    def cell_size(self) -> int:
+        return int(self._cfg["lstm_cell_size"])
+
+    @property
+    def max_seq_len(self) -> int:
+        return int(self._cfg["max_seq_len"])
+
+    def _build_net(self):
+        return RecurrentActorCriticNet(
+            self.num_actions, encoder=self._make_encoder(),
+            cell_size=int(self._cfg["lstm_cell_size"]))
+
+    def _zero_carry(self, batch: int):
+        z = jnp.zeros((batch, int(self._cfg["lstm_cell_size"])),
+                      jnp.float32)
+        return (z, z)
+
+    def init_params(self, seed: int = 0):
+        dummy = jnp.zeros((1, 1) + self.obs_shape, jnp.float32)
+        return self.net.init(jax.random.PRNGKey(seed), dummy,
+                             self._zero_carry(1),
+                             jnp.zeros((1, 1), jnp.float32))["params"]
+
+    # -- sequence/step primitives -----------------------------------------
+    def seq_forward(self, params, obs, carry, resets):
+        """(B, T, *obs) -> logits (B, T, A), values (B, T)."""
+        logits, value, _ = self.net.apply(
+            {"params": params}, jnp.asarray(obs), carry,
+            jnp.asarray(resets, jnp.float32))
+        return logits, value
+
+    def _step(self, params, obs_b, carry):
+        # jit-cached per (batch, obs) shape: an unjitted flax apply
+        # re-traces the LSTM scan EVERY env step and dominates rollout
+        # time (~0.3 s/step on a dev box vs ~1 ms jitted).
+        if getattr(self, "_jit_step", None) is None:
+            def f(params, obs, carry):
+                logits, value, new_carry = self.net.apply(
+                    {"params": params}, obs[:, None], carry,
+                    jnp.zeros((obs.shape[0], 1), jnp.float32))
+                return logits[:, 0], value[:, 0], new_carry
+            self._jit_step = jax.jit(f)
+        return self._jit_step(params, jnp.asarray(obs_b),
+                              (jnp.asarray(carry[0]),
+                               jnp.asarray(carry[1])))
+
+    def value_with_state(self, params, obs, carry):
+        """V(obs) from an explicit carry (bootstrap values at fragment
+        ends / truncation points)."""
+        _, value, _ = self._step(params, obs, (jnp.asarray(carry[0]),
+                                               jnp.asarray(carry[1])))
+        return np.asarray(value)
+
+    def apply(self, params, obs):
+        """Stateless zero-carry T=1 shim (the recurrent training path in
+        PPO never uses it; kept for API compatibility)."""
+        logits, value, _ = self._step(
+            params, obs, self._zero_carry(np.asarray(obs).shape[0]))
+        return logits, value
+
+    # -- rollout-facing forwards (stateful carry) --------------------------
+    def _rollout_carry(self, batch: int):
+        if self._carry is None or self._carry[0].shape[0] != batch:
+            self._carry = self._zero_carry(batch)
+        return self._carry
+
+    def forward_inference(self, params, obs):
+        carry = self._rollout_carry(np.asarray(obs).shape[0])
+        logits, _, carry = self._step(params, obs, carry)
+        self._carry = carry
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    def forward_exploration(self, params, obs, rng, **kw):
+        b = np.asarray(obs).shape[0]
+        carry = self._rollout_carry(b)
+        state_in = (np.asarray(carry[0]), np.asarray(carry[1]))
+        logits, value, carry = self._step(params, obs, carry)
+        self._carry = carry
+        actions, logp = _sample_discrete(np.asarray(logits), rng)
+        return actions, {"vf_preds": np.asarray(value),
+                         "action_logp": logp,
+                         "state_in_c": state_in[0],
+                         "state_in_h": state_in[1],
+                         # post-step carry: the learner's bootstrap
+                         # state for V(next_obs) at fragment ends and
+                         # truncation rows.
+                         "state_out_c": np.asarray(carry[0]),
+                         "state_out_h": np.asarray(carry[1])}
+
+    def on_episode_end(self):
+        self._carry = None
 
 
 class SACModule(RLModule):
@@ -142,16 +325,24 @@ class SACModule(RLModule):
     discrete = False
 
     def _build_net(self):
-        return GaussianActorNet(self.num_actions, self.hidden)
+        return GaussianActorNet(self.num_actions, self.hidden,
+                                encoder=self._make_encoder())
 
     def __init__(self, obs_dim: int, num_actions: int,
-                 hidden: Sequence[int] = (64, 64)):
-        super().__init__(obs_dim, num_actions, hidden)
-        self.q_net = TwinQNet(self.hidden)
+                 hidden: Sequence[int] = (64, 64),
+                 model_config: Optional[Dict[str, Any]] = None):
+        super().__init__(obs_dim, num_actions, hidden, model_config)
+        # Critics get their own encoder params for image obs (separate
+        # instance -> separate init; vector obs keep the flat concat).
+        self.q_net = TwinQNet(
+            self.hidden,
+            activation=self._cfg["fcnet_activation"],
+            encoder=self._make_encoder() if self.preserve_obs_shape
+            else None)
 
     def init_params(self, seed: int = 0):
         ka, kq = jax.random.split(jax.random.PRNGKey(seed))
-        dummy_obs = jnp.zeros((1, self.obs_dim), jnp.float32)
+        dummy_obs = jnp.zeros((1,) + self.obs_shape, jnp.float32)
         dummy_act = jnp.zeros((1, self.num_actions), jnp.float32)
         return {
             "actor": self.net.init(ka, dummy_obs)["params"],
@@ -189,15 +380,13 @@ class SACModule(RLModule):
         action, _ = self.sample_action(params, jnp.asarray(obs), key)
         return np.asarray(action), {}
 
-    def __reduce__(self):
-        return (type(self), (self.obs_dim, self.num_actions, self.hidden))
-
 
 class DQNModule(RLModule):
     """Reference: rllib/algorithms/dqn default module."""
 
     def _build_net(self):
-        return QNet(self.num_actions, self.hidden)
+        return QNet(self.num_actions, self.hidden,
+                    encoder=self._make_encoder())
 
     def forward_inference(self, params, obs):
         q = self.apply(params, jnp.asarray(obs))
