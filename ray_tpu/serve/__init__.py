@@ -25,6 +25,7 @@ import ray_tpu
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .handle import DeploymentHandle, DeploymentResponse
 from .batching import batch, pad_batch_to_bucket
+from .multiplex import get_multiplexed_model_id, multiplexed
 
 _proxy = None  # module-level HTTP proxy singleton (per driver process)
 
@@ -286,6 +287,7 @@ __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "batch",
     "delete", "deployment", "get_app_handle", "get_deployment_handle",
+    "get_multiplexed_model_id", "multiplexed",
     "pad_batch_to_bucket", "proxy_address", "proxy_addresses", "run", "shutdown", "start", "start_grpc",
     "status",
 ]

@@ -172,6 +172,12 @@ class HTTPProxy:
         req = {"path": request.path_qs, "method": request.method,
                "body": body}
         handle = self._state.handle_for(deployment, app_name)
+        # Model multiplexing header (reference: proxy.py reading
+        # SERVE_MULTIPLEXED_MODEL_ID from the request) — routed
+        # model-aware, surfaced via serve.get_multiplexed_model_id().
+        model_id = request.headers.get("serve_multiplexed_model_id", "")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
         loop = asyncio.get_running_loop()
         # Unary fast path: one plain actor call instead of the streaming
         # generator machinery (3 messages + 2 result waits). The replica

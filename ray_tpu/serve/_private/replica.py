@@ -48,14 +48,17 @@ class Replica:
         fn(user_config)
 
     async def handle_request(self, method_name: str, args: tuple,
-                             kwargs: dict) -> Any:
+                             kwargs: dict,
+                             multiplexed_model_id: str = "") -> Any:
         """Run one request through the user callable.
 
         Sync user code is offloaded to a thread so the replica's event loop
         keeps serving concurrent requests (reference fibers/asyncio model:
         replica.py + transport/fiber.h).
         """
+        from ..multiplex import _set_request_model_id
         self._ongoing += 1
+        _set_request_model_id(multiplexed_model_id)
         try:
             if inspect.isfunction(self._callable) or inspect.ismethod(
                     self._callable) or not hasattr(
@@ -71,8 +74,13 @@ class Replica:
             if inspect.iscoroutinefunction(target):
                 result = await target(*args, **kwargs)
             else:
+                import contextvars
+                # ctx.run: the executor thread must see the request's
+                # multiplexed model id (run_in_executor does not
+                # propagate contextvars by itself).
+                ctx = contextvars.copy_context()
                 result = await asyncio.get_event_loop().run_in_executor(
-                    None, lambda: target(*args, **kwargs))
+                    None, lambda: ctx.run(target, *args, **kwargs))
             if inspect.isgenerator(result) or inspect.isasyncgen(result):
                 # Caller used the non-streaming path on a handler that
                 # DYNAMICALLY returned a generator; tell it to retry via
@@ -96,14 +104,17 @@ class Replica:
         return getattr(self._callable, method_name)
 
     def handle_request_streaming(self, method_name: str, args: tuple,
-                                 kwargs: dict):
+                                 kwargs: dict,
+                                 multiplexed_model_id: str = ""):
         """Generator variant of handle_request (reference: streaming
         responses through the proxy, serve/_private/replica.py
         call_user_generator). First yielded item is a marker dict so the
         consumer knows whether the user returned a stream or one value;
         user generators then stream item by item over GEN_ITEM messages.
         """
+        from ..multiplex import _set_request_model_id
         self._ongoing += 1
+        _set_request_model_id(multiplexed_model_id)
         try:
             target = self._resolve_target(method_name)
             result = target(*args, **kwargs)
@@ -122,6 +133,14 @@ class Replica:
         """Power-of-two probe (reference: replica scheduler queue-length
         probes, pow_2_scheduler.py:52)."""
         return self._ongoing
+
+    async def get_queue_len_and_models(self) -> tuple:
+        """Combined probe: (queue length, multiplexed model ids loaded
+        here). Routers use the ids for model-aware routing (reference:
+        pow_2_scheduler's multiplexed ranking via controller-pushed
+        model ids — here the info rides the existing probe instead)."""
+        from ..multiplex import loaded_model_ids
+        return self._ongoing, loaded_model_ids(self._callable)
 
     async def reconfigure(self, user_config) -> bool:
         self._apply_user_config(user_config)

@@ -100,12 +100,19 @@ class _Router:
                               for i in range(len(self._replicas))}
             self._qlen_base = {}
             self._qlen_ts = {}
+            # model id -> replica indices known to hold it (refreshed by
+            # probes; indices are positions in THIS replica list, so a
+            # membership change invalidates everything).
+            self._model_locations = {}
         if self._replicas:
             self._ready.set()
         else:
             self._ready.clear()
 
     _PROBE_TTL_S = 0.1
+    # Queue-length gap beyond which a multiplexed request abandons its
+    # warm replica and spills (the new replica pays one model load).
+    _MUX_SPILL_QLEN = 8
 
     def _replica_score(self, idx: int, now: float) -> float:
         """Replica load = last probed queue length + requests THIS router
@@ -135,12 +142,13 @@ class _Router:
                 self._qlen_ts[i] = now
         if not reps:
             return
-        refs = {i: r.get_queue_len.remote() for i, r in reps.items()}
+        refs = {i: r.get_queue_len_and_models.remote()
+                for i, r in reps.items()}
         try:
-            qlens = ray_tpu.get(list(refs.values()), timeout=2.0)
+            probes = ray_tpu.get(list(refs.values()), timeout=2.0)
         except Exception:
             return  # unreachable replica(s): fall back to local counts
-        for i, qlen in zip(refs, qlens):
+        for i, (qlen, model_ids) in zip(refs, probes):
             with self._lock:
                 if i in self._inflight:
                     # Probe reflects work in flight cluster-wide NOW;
@@ -148,16 +156,43 @@ class _Router:
                     self._qlen_base = getattr(self, "_qlen_base", {})
                     self._qlen_base[i] = float(qlen) - self._inflight.get(
                         i, 0)
+                locs = getattr(self, "_model_locations", None)
+                if locs is None:
+                    locs = self._model_locations = {}
+                for m in list(locs):
+                    locs[m].discard(i)
+                for m in model_ids:
+                    locs.setdefault(m, set()).add(i)
 
-    def _pick(self, candidates: Optional[List[int]] = None) -> int:
+    def _pick(self, candidates: Optional[List[int]] = None,
+              model_id: str = "") -> int:
         import time as _time
         n = len(self._replicas)
         if n == 1:
             return 0
-        a, b = candidates or random.sample(range(n), 2)
         now = _time.monotonic()
-        return a if self._replica_score(a, now) <= \
+        a, b = candidates or random.sample(range(n), 2)
+        fallback = a if self._replica_score(a, now) <= \
             self._replica_score(b, now) else b
+        if model_id:
+            # Model-aware ranking (reference: pow_2_scheduler's
+            # multiplexed preference): pow-2 among replicas that already
+            # hold the model — but SPILL to the plain pow-2 pick when
+            # the holders are loaded well past it, so one hot model
+            # scales onto idle replicas (which then load it) instead of
+            # pinning to a saturated one.
+            locs = getattr(self, "_model_locations", {}).get(model_id)
+            holders = [i for i in (locs or ()) if i < n]
+            if holders:
+                if len(holders) > 2:
+                    holders = random.sample(holders, 2)
+                best = min(holders,
+                           key=lambda i: self._replica_score(i, now))
+                if self._replica_score(best, now) < \
+                        self._replica_score(fallback, now) + \
+                        self._MUX_SPILL_QLEN:
+                    return best
+        return fallback
 
     def _probe_stale(self, candidates: List[int], now: float) -> bool:
         """Caller holds self._lock."""
@@ -165,11 +200,12 @@ class _Router:
                    > self._PROBE_TTL_S for i in candidates)
 
     def _submit_to(self, idx: int, replica, method_name: str,
-                   args: tuple, kwargs: dict):
+                   args: tuple, kwargs: dict, model_id: str = ""):
         """Submit a unary call to a picked replica, with the in-flight
         decrement wired to completion (shared by the blocking and
         event-loop fast paths — the bookkeeping must never diverge)."""
-        ref = replica.handle_request.remote(method_name, args, kwargs)
+        ref = replica.handle_request.remote(method_name, args, kwargs,
+                                            model_id)
 
         def _done(_):
             with self._lock:
@@ -181,8 +217,17 @@ class _Router:
             pass
         return ref
 
+    def _note_model_location(self, model_id: str, idx: int):
+        """Caller holds self._lock. Optimistic: the replica we just sent
+        model_id to will have it loaded by the time the next probe runs."""
+        if model_id:
+            locs = getattr(self, "_model_locations", None)
+            if locs is None:
+                locs = self._model_locations = {}
+            locs.setdefault(model_id, set()).add(idx)
+
     def try_assign_fast(self, method_name: str, args: tuple,
-                        kwargs: dict):
+                        kwargs: dict, model_id: str = ""):
         """Non-blocking assignment for callers that must not stall an
         event loop (the async proxy): succeeds only when replicas are
         ready AND the sampled candidates' queue-length probes are fresh
@@ -199,15 +244,18 @@ class _Router:
                 candidates = random.sample(range(n), 2)
                 if self._probe_stale(candidates, _time.monotonic()):
                     return None  # probe due: take the blocking path
-                idx = self._pick(candidates)
+                idx = self._pick(candidates, model_id)
             else:
                 idx = 0
             replica = self._replicas[idx]
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
-        return self._submit_to(idx, replica, method_name, args, kwargs)
+            self._note_model_location(model_id, idx)
+        return self._submit_to(idx, replica, method_name, args, kwargs,
+                               model_id)
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       timeout_s: float = 30.0, stream: bool = False):
+                       timeout_s: float = 30.0, stream: bool = False,
+                       model_id: str = ""):
         if not self._ready.wait(timeout=timeout_s):
             raise TimeoutError(
                 f"No replicas of '{self._deployment}' became available "
@@ -221,12 +269,14 @@ class _Router:
             if candidates is not None and any(
                     i >= len(self._replicas) for i in candidates):
                 candidates = None  # replica set changed under us
-            idx = self._pick(candidates)
+            idx = self._pick(candidates, model_id)
             replica = self._replicas[idx]
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            self._note_model_location(model_id, idx)
         if stream:
             gen = replica.handle_request_streaming.options(
-                num_returns="streaming").remote(method_name, args, kwargs)
+                num_returns="streaming").remote(method_name, args, kwargs,
+                                                model_id)
 
             def _stream_done():
                 with self._lock:
@@ -237,7 +287,8 @@ class _Router:
             except Exception:
                 _stream_done()
             return gen
-        return self._submit_to(idx, replica, method_name, args, kwargs)
+        return self._submit_to(idx, replica, method_name, args, kwargs,
+                               model_id)
 
     def shutdown(self):
         self._long_poll.stop()
@@ -251,34 +302,53 @@ class DeploymentHandle:
     """
 
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 method_name: str = "__call__", stream: bool = False):
+                 method_name: str = "__call__", stream: bool = False,
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method = method_name
         self._stream = stream
-        self._router: Optional[_Router] = None
+        self._model_id = multiplexed_model_id
+        # Router cell SHARED by every options() copy: whichever handle
+        # routes first builds the router, all copies reuse it (and its
+        # probe caches / model-location map). A per-copy router would
+        # leak a long-poll thread per options() call.
+        self._router_cell: Dict[str, Optional[_Router]] = {"router": None}
         self._lock = threading.Lock()
+
+    @property
+    def _router(self) -> Optional[_Router]:
+        return self._router_cell["router"]
 
     # -- pickling ----------------------------------------------------------
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._method,
-                 self._stream))
+                 self._stream, self._model_id))
 
     # -- routing -----------------------------------------------------------
     def _get_router(self) -> _Router:
         with self._lock:
-            if self._router is None:
+            if self._router_cell["router"] is None:
                 from ._private.controller import get_controller
-                self._router = _Router(self.deployment_name, get_controller())
-            return self._router
+                self._router_cell["router"] = _Router(
+                    self.deployment_name, get_controller())
+            return self._router_cell["router"]
 
     def options(self, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, self.app_name,
-                             method_name or self._method,
-                             self._stream if stream is None else stream)
-        h._router = self._router
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name or self._method,
+            self._stream if stream is None else stream,
+            self._model_id if multiplexed_model_id is None
+            else multiplexed_model_id)
+        # Copies share the router cell AND its build lock, so exactly
+        # one router (one long-poll client) exists per handle family.
+        h._router_cell = self._router_cell
+        h._lock = self._lock
         return h
 
     def __getattr__(self, name: str):
@@ -300,7 +370,8 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         args, kwargs = self._unwrap(args, kwargs)
         out = self._get_router().assign_request(
-            self._method, args, kwargs, stream=self._stream)
+            self._method, args, kwargs, stream=self._stream,
+            model_id=self._model_id)
         if self._stream:
             return DeploymentResponseGenerator(out)
         return DeploymentResponse(out)
@@ -317,11 +388,13 @@ class DeploymentHandle:
         if router is None:
             return None
         args, kwargs = self._unwrap(args, kwargs)
-        ref = router.try_assign_fast(self._method, args, kwargs)
+        ref = router.try_assign_fast(self._method, args, kwargs,
+                                     model_id=self._model_id)
         return DeploymentResponse(ref) if ref is not None else None
 
     def shutdown(self):
         with self._lock:
-            if self._router is not None:
-                self._router.shutdown()
-                self._router = None
+            router = self._router_cell["router"]
+            if router is not None:
+                router.shutdown()
+                self._router_cell["router"] = None

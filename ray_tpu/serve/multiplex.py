@@ -1,0 +1,147 @@
+"""Model multiplexing: many models per deployment, LRU-cached per replica.
+
+Reference parity: python/ray/serve/api.py `@serve.multiplexed` +
+`serve.get_multiplexed_model_id()` and
+python/ray/serve/multiplexed.py (_ModelMultiplexWrapper: per-replica LRU
+of models keyed by model id, loaded through the user's decorated
+loader). Routers prefer replicas that already hold the requested model
+(model-aware power-of-two, reference:
+replica_scheduler/pow_2_scheduler.py multiplexed ranking); affinity
+information rides the existing queue-length probes instead of a
+controller round-trip.
+
+TPU note: "model" here is typically a jitted apply fn + weights pytree;
+multiplexing lets one replica (one chip reservation) serve many LoRA
+variants or small models, evicting least-recently-used weights from HBM.
+"""
+import asyncio
+import contextvars
+import functools
+import inspect
+from collections import OrderedDict
+from typing import Any, Callable, List, Optional
+
+_model_id_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the current request (reference:
+    serve.get_multiplexed_model_id) — set from the handle option
+    `multiplexed_model_id` or the `serve_multiplexed_model_id` HTTP
+    header; empty string outside a multiplexed request."""
+    return _model_id_ctx.get()
+
+
+def _set_request_model_id(model_id: str):
+    """Replica-internal: bind the request's model id into the context."""
+    return _model_id_ctx.set(model_id)
+
+
+async def _cleanup_evicted(evicted: Any):
+    """Run an evicted model's `__del__` eagerly (resources — HBM — must
+    free NOW, not at GC time; async `__del__`s could never be awaited by
+    GC at all), then neuter the class-level `__del__` so garbage
+    collection doesn't run the cleanup a second time."""
+    del_fn = getattr(evicted, "__del__", None)
+    if not callable(del_fn):
+        return
+    try:
+        out = del_fn()
+        if inspect.isawaitable(out):
+            await out
+    except Exception:
+        pass
+    try:
+        cls = type(evicted)
+        evicted.__class__ = type(
+            "_Evicted" + cls.__name__, (cls,),
+            {"__del__": lambda self: None})
+    except TypeError:
+        pass  # non-heap/layout-locked types: accept a double __del__
+
+
+class _ModelMultiplexWrapper:
+    """Per-replica LRU model cache (reference:
+    serve/multiplexed.py _ModelMultiplexWrapper)."""
+
+    def __init__(self, loader: Callable, owner: Any,
+                 max_num_models_per_replica: int):
+        self._loader = loader
+        self._owner = owner
+        self._max = int(max_num_models_per_replica)
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = asyncio.Lock()
+
+    @property
+    def model_ids(self) -> List[str]:
+        return list(self._models.keys())
+
+    async def load_model(self, model_id: str) -> Any:
+        if not isinstance(model_id, str) or not model_id:
+            raise ValueError(
+                "multiplexed model_id must be a non-empty string, got "
+                f"{model_id!r}")
+        async with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+            while len(self._models) >= self._max > 0:
+                evicted_id, evicted = self._models.popitem(last=False)
+                await _cleanup_evicted(evicted)
+            args = (self._owner, model_id) if self._owner is not None \
+                else (model_id,)
+            model = self._loader(*args)
+            if inspect.isawaitable(model):
+                model = await model
+            self._models[model_id] = model
+            return model
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for a deployment's model-loader method (reference:
+    serve/api.py multiplexed). Usage:
+
+        @serve.deployment
+        class M:
+            @serve.multiplexed(max_num_models_per_replica=4)
+            async def get_model(self, model_id: str):
+                return load_weights(model_id)
+
+            async def __call__(self, req):
+                model = await self.get_model(
+                    serve.get_multiplexed_model_id())
+                ...
+
+    The wrapped method returns the cached model, loading (and LRU
+    evicting) as needed; the loader runs at most once per cached id.
+    """
+    if max_num_models_per_replica <= 0:
+        raise ValueError("max_num_models_per_replica must be positive")
+
+    def decorator(fn: Callable):
+        @functools.wraps(fn)
+        async def wrapped(self, model_id: Optional[str] = None):
+            wrapper = getattr(self, "__serve_mux_wrapper__", None)
+            if wrapper is None:
+                wrapper = _ModelMultiplexWrapper(
+                    fn, self, max_num_models_per_replica)
+                setattr(self, "__serve_mux_wrapper__", wrapper)
+            if model_id is None:
+                model_id = get_multiplexed_model_id()
+            return await wrapper.load_model(model_id)
+
+        wrapped.__serve_is_multiplexed__ = True
+        return wrapped
+
+    if func is not None:
+        return decorator(func)
+    return decorator
+
+
+def loaded_model_ids(user_callable: Any) -> List[str]:
+    """Model ids currently cached on a replica's user object (probed by
+    the router for model-aware routing)."""
+    wrapper = getattr(user_callable, "__serve_mux_wrapper__", None)
+    return wrapper.model_ids if wrapper is not None else []

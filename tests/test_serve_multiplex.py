@@ -1,0 +1,196 @@
+"""Model-multiplexing tests (reference strategy: serve/tests/
+test_multiplex.py — wrapper LRU semantics + e2e model-id routing +
+the serve_multiplexed_model_id HTTP header)."""
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.multiplex import _ModelMultiplexWrapper
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_apps():
+    yield
+    try:
+        for app in {i.get("app") for i in serve.status().values()}:
+            if app:
+                serve.delete(app)
+    except Exception:
+        pass
+
+
+class TestWrapperUnits:
+    def _wrapper(self, max_models=2, log=None):
+        log = log if log is not None else []
+
+        async def loader(model_id):
+            log.append(("load", model_id))
+            return {"id": model_id}
+
+        return _ModelMultiplexWrapper(loader, None, max_models), log
+
+    def test_load_caches_and_lru_evicts(self):
+        w, log = self._wrapper(max_models=2)
+
+        async def run():
+            m1 = await w.load_model("a")
+            m2 = await w.load_model("b")
+            assert (await w.load_model("a")) is m1  # cached, no reload
+            await w.load_model("c")  # evicts b (a was refreshed)
+            assert w.model_ids == ["a", "c"]
+            await w.load_model("b")  # reload; evicts a
+            assert w.model_ids == ["c", "b"]
+
+        asyncio.run(run())
+        assert [m for op, m in log if op == "load"] == \
+            ["a", "b", "c", "b"]
+
+    def test_eviction_calls_del(self):
+        deleted = []
+
+        class Model:
+            def __init__(self, mid):
+                self.mid = mid
+
+            def __del__(self):
+                deleted.append(self.mid)
+
+        async def loader(model_id):
+            return Model(model_id)
+
+        w = _ModelMultiplexWrapper(loader, None, 1)
+
+        async def run():
+            await w.load_model("x")
+            await w.load_model("y")
+
+        asyncio.run(run())
+        assert "x" in deleted
+
+    def test_invalid_model_id(self):
+        w, _ = self._wrapper()
+        with pytest.raises(ValueError):
+            asyncio.run(w.load_model(""))
+
+    def test_eviction_del_runs_exactly_once(self):
+        import gc
+        calls = []
+
+        class Model:
+            def __init__(self, mid):
+                self.mid = mid
+
+            def __del__(self):
+                calls.append(self.mid)
+
+        async def loader(model_id):
+            return Model(model_id)
+
+        w = _ModelMultiplexWrapper(loader, None, 1)
+
+        async def run():
+            await w.load_model("x")
+            await w.load_model("y")
+
+        asyncio.run(run())
+        gc.collect()
+        # Explicit eviction cleanup must not be doubled by GC.
+        assert calls.count("x") == 1
+
+    def test_router_spills_hot_model(self):
+        from ray_tpu.serve.handle import _Router
+        r = _Router.__new__(_Router)
+        import threading
+        r._lock = threading.Lock()
+        r._replicas = ["r0", "r1"]
+        r._inflight = {0: 20, 1: 0}
+        r._qlen_base = {}
+        r._qlen_ts = {}
+        r._model_locations = {"hot": {0}}
+        # Warm replica 0 is saturated: the pick must spill to replica 1.
+        assert r._pick([0, 1], model_id="hot") == 1
+        # Balanced load: stick with the warm holder.
+        r._inflight = {0: 2, 1: 0}
+        assert r._pick([0, 1], model_id="hot") == 0
+
+    def test_options_copies_share_router(self):
+        from ray_tpu.serve.handle import DeploymentHandle
+        h = DeploymentHandle("dep")
+        h2 = h.options(multiplexed_model_id="m")
+        h3 = h2.options(multiplexed_model_id="n")
+        assert h._router_cell is h2._router_cell is h3._router_cell
+        assert h._lock is h2._lock
+
+    def test_decorator_validates(self):
+        with pytest.raises(ValueError):
+            serve.multiplexed(max_num_models_per_replica=0)
+
+
+class TestMultiplexE2E:
+    def _deploy(self, num_replicas=2, max_models=2):
+        @serve.deployment(num_replicas=num_replicas)
+        class MultiModel:
+            @serve.multiplexed(max_num_models_per_replica=max_models)
+            async def get_model(self, model_id: str):
+                return {"model": model_id}
+
+            async def __call__(self, req):
+                import os
+                mid = serve.get_multiplexed_model_id()
+                model = await self.get_model(mid)
+                return {"served_by": model["model"], "pid": os.getpid()}
+
+        return serve.run(MultiModel.bind(), name="mux_app",
+                         route_prefix="/mux")
+
+    def test_model_id_reaches_replica(self):
+        handle = self._deploy()
+        out = handle.options(multiplexed_model_id="m1").remote(
+            None).result(timeout_s=30)
+        assert out["served_by"] == "m1"
+        out = handle.options(multiplexed_model_id="m2").remote(
+            None).result(timeout_s=30)
+        assert out["served_by"] == "m2"
+
+    def test_model_affinity_routing(self):
+        handle = self._deploy(num_replicas=2)
+        h1 = handle.options(multiplexed_model_id="warm")
+        # Warm up: first call picks a replica and records the location.
+        first = h1.remote(None).result(timeout_s=30)
+        pids = {h1.remote(None).result(timeout_s=30)["pid"]
+                for _ in range(10)}
+        # All subsequent same-model requests stick to the warm replica.
+        assert pids == {first["pid"]}
+
+    def test_http_header_path(self):
+        self._deploy()
+        addr = serve.proxy_address()
+        req = urllib.request.Request(
+            addr + "/mux", data=b"null", method="POST",
+            headers={"serve_multiplexed_model_id": "hdr-model",
+                     "Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["served_by"] == "hdr-model"
+
+    def test_no_model_id_means_empty_context(self):
+        @serve.deployment
+        class Plain:
+            def __call__(self, req):
+                return {"mid": serve.get_multiplexed_model_id()}
+
+        handle = serve.run(Plain.bind(), name="plain_mux",
+                           route_prefix="/plainmux")
+        assert handle.remote(None).result(timeout_s=30)["mid"] == ""
