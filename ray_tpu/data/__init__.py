@@ -14,24 +14,42 @@ from .block import Block  # noqa: F401
 from .context import DataContext  # noqa: F401
 from .dataset import ActorPoolStrategy, Dataset, GroupedData  # noqa: F401
 from .streaming import DataIterator  # noqa: F401
+from .datasource import (  # noqa: F401
+    Datasink,
+    Datasource,
+    ReadTask,
+    read_datasource,
+)
 from .read_api import (  # noqa: F401
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
+    from_tf,
+    from_torch,
     range,
+    read_avro,
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [
     "ActorPoolStrategy", "Block", "DataContext", "DataIterator", "Dataset",
-    "GroupedData", "from_arrow",
-    "from_items", "from_numpy", "from_pandas", "range",
-    "read_binary_files", "read_csv", "read_json", "read_numpy",
-    "read_parquet", "read_text",
+    "Datasink", "Datasource", "GroupedData", "ReadTask",
+    "from_arrow", "from_huggingface",
+    "from_items", "from_numpy", "from_pandas", "from_tf", "from_torch",
+    "range", "read_avro",
+    "read_binary_files", "read_csv", "read_datasource", "read_images",
+    "read_json", "read_numpy",
+    "read_parquet", "read_sql", "read_text", "read_tfrecords",
+    "read_webdataset",
 ]

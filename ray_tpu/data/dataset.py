@@ -978,6 +978,12 @@ class Dataset:
             _write_block.remote(b.ref, path, "csv", i)
             for i, b in enumerate(bundles) if b.num_rows])
 
+    def write_datasink(self, sink) -> List[Any]:
+        """Write through a custom Datasink plugin (reference:
+        Dataset.write_datasink; see data/datasource.py)."""
+        from .datasource import write_datasink as _wds
+        return _wds(self, sink)
+
     def __repr__(self):
         return (f"Dataset(num_blocks={len(self._plan.stages)}+src, "
                 f"name={self._plan.name})")

@@ -153,3 +153,263 @@ def read_numpy(paths, **kwargs) -> Dataset:
 
 def read_binary_files(paths, **kwargs) -> Dataset:
     return _read(paths, "binary", None)
+
+
+# -- extended IO (reference: read_api.py long tail) -----------------------
+
+def _chunk(items: List, parts: int) -> List[List]:
+    return [items[s:e] for s, e in _split_even(len(items), parts)
+            if e > s]
+
+
+@api.remote
+def _read_image_chunk(paths: List[str], size, mode,
+                      include_paths: bool) -> B.Block:
+    from PIL import Image
+    imgs, kept = [], []
+    for p in paths:
+        img = Image.open(p)
+        if mode is not None:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))  # PIL takes (W, H)
+        imgs.append(np.asarray(img))
+        kept.append(p)
+    if size is not None:
+        col = np.stack(imgs)
+    else:  # ragged shapes: object column
+        col = np.empty(len(imgs), dtype=object)
+        for i, im in enumerate(imgs):
+            col[i] = im
+    blk = {"image": col}
+    if include_paths:
+        blk["path"] = np.asarray(kept, dtype=object)
+    return blk
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: Optional[str] = None, include_paths: bool = False,
+                parallelism: int = 8) -> Dataset:
+    """Reference: read_api.py read_images (ImageDatasource) — PIL
+    decode, optional (H, W) resize + mode convert; uniform sizes stack
+    into one ndarray column, ragged sizes become an object column."""
+    files = _expand_paths(paths, None)
+    if not files:
+        raise FileNotFoundError(f"No files matched {paths!r}")
+    chunks = _chunk(files, parallelism)
+
+    def source():
+        refs = [_read_image_chunk.remote(c, size, mode, include_paths)
+                for c in chunks]
+        return [_RefBundle(r, B.block_length(blk))
+                for r, blk in zip(refs, api.get(refs))]
+
+    def iter_source():
+        for c in chunks:
+            yield (_read_image_chunk.remote(c, size, mode,
+                                            include_paths), len(c))
+    return Dataset(_Plan(source, [], "read_images", iter_source))
+
+
+def _rows_to_block_union(rows: List[Dict[str, Any]]) -> B.Block:
+    """Columnarize rows whose key sets may DIFFER (optional features /
+    heterogeneous webdataset members): the block gets the union of keys,
+    missing cells become None — never misaligned columns."""
+    if not rows:
+        return {}
+    keys: List[str] = []
+    for r in rows:
+        for k in r:
+            if k not in keys:
+                keys.append(k)
+    uniform = all(set(r) == set(rows[0]) for r in rows)
+    out = {}
+    for k in keys:
+        vals = [r.get(k) for r in rows]
+        if uniform:
+            try:
+                arr = np.asarray(vals)
+                if arr.dtype.kind in "US":
+                    # "S" would strip trailing NULs from binary
+                    # payloads; "U" loses object identity — keep both
+                    # as object columns.
+                    arr = np.asarray(vals, dtype=object)
+                out[k] = arr
+                continue
+            except Exception:
+                pass
+        arr = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals):
+            arr[i] = v
+        out[k] = arr
+    return out
+
+
+@api.remote
+def _read_tfrecord_files(paths: List[str]) -> B.Block:
+    import tensorflow as tf
+    rows: List[Dict[str, Any]] = []
+    for path in paths:
+        for raw in tf.data.TFRecordDataset([path]):
+            ex = tf.train.Example()
+            ex.ParseFromString(bytes(raw.numpy()))
+            row: Dict[str, Any] = {}
+            for name, feat in ex.features.feature.items():
+                kind = feat.WhichOneof("kind")
+                vals = list(getattr(feat, kind).value)
+                if kind == "bytes_list":
+                    vals = [v.decode("utf-8", "surrogateescape")
+                            for v in vals]
+                row[name] = vals[0] if len(vals) == 1 else vals
+            rows.append(row)
+    return _rows_to_block_union(rows)
+
+
+def read_tfrecords(paths, *, parallelism: int = 8) -> Dataset:
+    """Reference: read_api.py read_tfrecords — tf.train.Example
+    records parsed into columns (single-value features scalarized)."""
+    files = _expand_paths(paths, None)
+    if not files:
+        raise FileNotFoundError(f"No files matched {paths!r}")
+    chunks = _chunk(files, parallelism)
+
+    def source():
+        refs = [_read_tfrecord_files.remote(c) for c in chunks]
+        return [_RefBundle(r, B.block_length(blk))
+                for r, blk in zip(refs, api.get(refs))]
+
+    def iter_source():
+        for c in chunks:
+            yield (_read_tfrecord_files.remote(c), -1)
+    return Dataset(_Plan(source, [], "read_tfrecords", iter_source))
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism: int = 1) -> Dataset:
+    """Reference: read_api.py read_sql (SQLDatasource) — any DBAPI2
+    connection factory (sqlite3.connect, psycopg2, ...). The query runs
+    in one read task (generic SQL can't be split without a shard key;
+    same behavior as the reference default)."""
+
+    @api.remote
+    def _run_query() -> B.Block:
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            names = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        if not rows:
+            return {n: np.asarray([]) for n in names}
+        cols = list(zip(*rows))
+        out = {}
+        for n, vals in zip(names, cols):
+            arr = np.asarray(vals)
+            if arr.dtype.kind == "U":
+                arr = np.asarray(vals, dtype=object)
+            out[n] = arr
+        return out
+
+    def source():
+        ref = _run_query.remote()
+        blk = api.get(ref)
+        return [_RefBundle(ref, B.block_length(blk))]
+
+    def iter_source():
+        yield (_run_query.remote(), -1)
+    return Dataset(_Plan(source, [], "read_sql", iter_source))
+
+
+@api.remote
+def _read_webdataset_shard(path: str) -> B.Block:
+    import json as jsonlib
+    import tarfile
+    rows: List[Dict[str, Any]] = []
+    current: Dict[str, Any] = {}
+    key = None
+    with tarfile.open(path) as tar:
+        for member in tar:
+            if not member.isfile():
+                continue
+            # WebDataset keying: everything before the FIRST dot of the
+            # basename is the sample key (so x.seg.png groups with
+            # x.cls under key "x", column "seg.png").
+            dirname, fname = os.path.split(member.name)
+            stem, _, ext = fname.partition(".")
+            base = os.path.join(dirname, stem) if dirname else stem
+            if base != key:
+                if current:
+                    rows.append(current)
+                key, current = base, {"__key__": base}
+            data = tar.extractfile(member).read()
+            if ext in ("txt", "cls"):
+                current[ext] = data.decode()
+            elif ext == "json":
+                current[ext] = jsonlib.loads(data)
+            else:
+                current[ext] = data  # images etc. stay bytes
+    if current:
+        rows.append(current)
+    # Union columnarization: samples may have heterogeneous members.
+    return _rows_to_block_union(rows)
+
+
+def read_webdataset(paths, *, parallelism: int = 8) -> Dataset:
+    """Reference: read_api.py read_webdataset — tar shards of
+    samples grouped by basename; .txt/.cls/.json members decoded,
+    everything else (images, tensors) kept as bytes for map_batches
+    decoding."""
+    files = _expand_paths(paths, ".tar")
+    if not files:
+        raise FileNotFoundError(f"No files matched {paths!r}")
+
+    def source():
+        refs = [_read_webdataset_shard.remote(p) for p in files]
+        return [_RefBundle(r, B.block_length(blk))
+                for r, blk in zip(refs, api.get(refs))]
+
+    def iter_source():
+        for p in files:
+            yield (_read_webdataset_shard.remote(p), -1)
+    return Dataset(_Plan(source, [], "read_webdataset", iter_source))
+
+
+def read_avro(paths, **kwargs) -> Dataset:
+    """Gated: fastavro is not available in this environment (reference:
+    read_api.py read_avro)."""
+    raise ImportError(
+        "read_avro requires fastavro, which is not available in this "
+        "environment; convert to parquet/json or install fastavro.")
+
+
+def from_torch(torch_dataset,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    """Reference: read_api.py from_torch — map-style torch Dataset
+    materialized into an 'item' column (samples stay Python objects)."""
+    import builtins
+    # builtins.range: the module-level read_api.range shadows it.
+    items = [torch_dataset[i]
+             for i in builtins.range(len(torch_dataset))]
+    return from_items([{"item": it} for it in items],
+                      override_num_blocks=override_num_blocks)
+
+
+def from_tf(tf_dataset) -> Dataset:
+    """Reference: read_api.py from_tf — tf.data.Dataset materialized;
+    dict elements become columns, anything else an 'item' column."""
+    rows = []
+    for elem in tf_dataset.as_numpy_iterator():
+        if isinstance(elem, dict):
+            rows.append(elem)
+        else:
+            rows.append({"item": elem})
+    return from_items(rows)
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """Reference: read_api.py from_huggingface — a datasets.Dataset's
+    arrow table becomes blocks (zero-copy through pandas at the edge)."""
+    df = hf_dataset.to_pandas()
+    return from_pandas(df)

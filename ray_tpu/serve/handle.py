@@ -104,6 +104,7 @@ class _Router:
             # probes; indices are positions in THIS replica list, so a
             # membership change invalidates everything).
             self._model_locations = {}
+            self._model_note_ts = {}
         if self._replicas:
             self._ready.set()
         else:
@@ -113,6 +114,11 @@ class _Router:
     # Queue-length gap beyond which a multiplexed request abandons its
     # warm replica and spills (the new replica pays one model load).
     _MUX_SPILL_QLEN = 8
+    # Optimistic model-location notes survive probes this long: a model
+    # load (weights into HBM) can take seconds, and wiping the note on
+    # the first pre-load probe would fan concurrent same-model requests
+    # across replicas, each paying a duplicate load.
+    _MUX_NOTE_GRACE_S = 30.0
 
     def _replica_score(self, idx: int, now: float) -> float:
         """Replica load = last probed queue length + requests THIS router
@@ -159,10 +165,23 @@ class _Router:
                 locs = getattr(self, "_model_locations", None)
                 if locs is None:
                     locs = self._model_locations = {}
+                notes = getattr(self, "_model_note_ts", None)
+                if notes is None:
+                    notes = self._model_note_ts = {}
                 for m in list(locs):
+                    if m in model_ids:
+                        continue
+                    # Keep optimistic notes young enough that the load
+                    # may still be in flight; trust the probe otherwise.
+                    if now - notes.get((m, i), -1e9) < \
+                            self._MUX_NOTE_GRACE_S:
+                        continue
                     locs[m].discard(i)
                 for m in model_ids:
                     locs.setdefault(m, set()).add(i)
+                    # Confirmed on-replica: future absence means a real
+                    # eviction, so the optimistic note must not linger.
+                    notes.pop((m, i), None)
 
     def _pick(self, candidates: Optional[List[int]] = None,
               model_id: str = "") -> int:
@@ -219,12 +238,19 @@ class _Router:
 
     def _note_model_location(self, model_id: str, idx: int):
         """Caller holds self._lock. Optimistic: the replica we just sent
-        model_id to will have it loaded by the time the next probe runs."""
+        model_id to will have it loaded by the time the next probe runs;
+        the note timestamp shields it from probe wipes for
+        _MUX_NOTE_GRACE_S while the load is in flight."""
         if model_id:
+            import time as _time
             locs = getattr(self, "_model_locations", None)
             if locs is None:
                 locs = self._model_locations = {}
             locs.setdefault(model_id, set()).add(idx)
+            notes = getattr(self, "_model_note_ts", None)
+            if notes is None:
+                notes = self._model_note_ts = {}
+            notes[(model_id, idx)] = _time.monotonic()
 
     def try_assign_fast(self, method_name: str, args: tuple,
                         kwargs: dict, model_id: str = ""):

@@ -123,16 +123,21 @@ def multiplexed(func: Optional[Callable] = None, *,
     def decorator(fn: Callable):
         @functools.wraps(fn)
         async def wrapped(self, model_id: Optional[str] = None):
-            wrapper = getattr(self, "__serve_mux_wrapper__", None)
+            # Wrappers are keyed by loader name so multiple @multiplexed
+            # methods on one class (model + tokenizer) keep separate
+            # caches instead of silently returning each other's objects.
+            wrappers = getattr(self, "__serve_mux_wrappers__", None)
+            if wrappers is None:
+                wrappers = {}
+                setattr(self, "__serve_mux_wrappers__", wrappers)
+            wrapper = wrappers.get(fn.__name__)
             if wrapper is None:
-                wrapper = _ModelMultiplexWrapper(
+                wrapper = wrappers[fn.__name__] = _ModelMultiplexWrapper(
                     fn, self, max_num_models_per_replica)
-                setattr(self, "__serve_mux_wrapper__", wrapper)
             if model_id is None:
                 model_id = get_multiplexed_model_id()
             return await wrapper.load_model(model_id)
 
-        wrapped.__serve_is_multiplexed__ = True
         return wrapped
 
     if func is not None:
@@ -141,7 +146,15 @@ def multiplexed(func: Optional[Callable] = None, *,
 
 
 def loaded_model_ids(user_callable: Any) -> List[str]:
-    """Model ids currently cached on a replica's user object (probed by
-    the router for model-aware routing)."""
-    wrapper = getattr(user_callable, "__serve_mux_wrapper__", None)
-    return wrapper.model_ids if wrapper is not None else []
+    """Model ids currently cached on a replica's user object, across
+    every multiplexed method (probed by the router for model-aware
+    routing)."""
+    wrappers = getattr(user_callable, "__serve_mux_wrappers__", None)
+    if not wrappers:
+        return []
+    out: List[str] = []
+    for w in wrappers.values():
+        for mid in w.model_ids:
+            if mid not in out:
+                out.append(mid)
+    return out

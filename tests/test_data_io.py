@@ -1,0 +1,273 @@
+"""Extended Data IO tests (reference strategy: data/tests/test_image.py,
+test_tfrecords.py, test_sql.py, test_webdataset.py, test_datasink.py —
+format round-trips through real files + the Datasource/Datasink plugin
+seam)."""
+import json
+import os
+import sqlite3
+import tarfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestReadImages:
+    def _write_pngs(self, tmp_path, sizes):
+        from PIL import Image
+        paths = []
+        for i, (h, w) in enumerate(sizes):
+            arr = np.full((h, w, 3), i * 10, np.uint8)
+            p = str(tmp_path / f"img{i}.png")
+            Image.fromarray(arr).save(p)
+            paths.append(p)
+        return paths
+
+    def test_uniform_images_stack(self, tmp_path):
+        self._write_pngs(tmp_path, [(8, 6)] * 4)
+        ds = rdata.read_images(str(tmp_path), size=(8, 6))
+        batch = ds.take_batch(4)
+        assert batch["image"].shape == (4, 8, 6, 3)
+
+    def test_ragged_images_object_column(self, tmp_path):
+        self._write_pngs(tmp_path, [(8, 6), (4, 4)])
+        ds = rdata.read_images(str(tmp_path), include_paths=True)
+        rows = ds.take_all()
+        assert len(rows) == 2
+        shapes = sorted(r["image"].shape for r in rows)
+        assert shapes == [(4, 4, 3), (8, 6, 3)]
+        assert all(r["path"].endswith(".png") for r in rows)
+
+    def test_resize_and_mode(self, tmp_path):
+        self._write_pngs(tmp_path, [(10, 10)])
+        ds = rdata.read_images(str(tmp_path), size=(5, 7), mode="L")
+        batch = ds.take_batch(1)
+        assert batch["image"].shape == (1, 5, 7)
+
+
+class TestReadTfrecords:
+    def test_round_trip(self, tmp_path):
+        import tensorflow as tf
+        path = str(tmp_path / "data.tfrecord")
+        with tf.io.TFRecordWriter(path) as w:
+            for i in range(5):
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "idx": tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=[i])),
+                    "name": tf.train.Feature(
+                        bytes_list=tf.train.BytesList(
+                            value=[f"row{i}".encode()])),
+                    "score": tf.train.Feature(
+                        float_list=tf.train.FloatList(value=[i * 0.5])),
+                }))
+                w.write(ex.SerializeToString())
+        ds = rdata.read_tfrecords(path)
+        rows = sorted(ds.take_all(), key=lambda r: r["idx"])
+        assert len(rows) == 5
+        assert rows[2]["idx"] == 2
+        assert rows[2]["name"] == "row2"
+        assert rows[2]["score"] == pytest.approx(1.0)
+
+
+class TestHeterogeneousRows:
+    def test_tfrecords_optional_features_align(self, tmp_path):
+        import tensorflow as tf
+        path = str(tmp_path / "opt.tfrecord")
+
+        def feat_i(v):
+            return tf.train.Feature(
+                int64_list=tf.train.Int64List(value=[v]))
+
+        with tf.io.TFRecordWriter(path) as w:
+            w.write(tf.train.Example(features=tf.train.Features(feature={
+                "id": feat_i(0), "label": feat_i(7)})).SerializeToString())
+            w.write(tf.train.Example(features=tf.train.Features(feature={
+                "id": feat_i(1)})).SerializeToString())  # label missing
+        rows = sorted(rdata.read_tfrecords(path).take_all(),
+                      key=lambda r: r["id"])
+        assert len(rows) == 2
+        assert rows[0]["label"] == 7
+        assert rows[1]["label"] is None  # aligned, not shifted
+
+    def test_webdataset_heterogeneous_and_multidot(self, tmp_path):
+        import io
+        shard = str(tmp_path / "h.tar")
+        with tarfile.open(shard, "w") as tar:
+            members = [("a.txt", b"cap-a"), ("a.seg.png", b"\x89segpng"),
+                       ("b.txt", b"cap-b")]  # b lacks seg.png
+            for name, payload in members:
+                ti = tarfile.TarInfo(name)
+                ti.size = len(payload)
+                tar.addfile(ti, io.BytesIO(payload))
+        rows = sorted(rdata.read_webdataset(shard).take_all(),
+                      key=lambda r: r["__key__"])
+        # Multi-dot member stays in sample 'a' under column 'seg.png'.
+        assert [r["__key__"] for r in rows] == ["a", "b"]
+        assert rows[0]["seg.png"] == b"\x89segpng"
+        assert rows[1]["seg.png"] is None
+        assert rows[1]["txt"] == "cap-b"
+
+
+class TestReadSql:
+    def test_sqlite_query(self, tmp_path):
+        db = str(tmp_path / "test.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE t (id INTEGER, name TEXT, v REAL)")
+        conn.executemany("INSERT INTO t VALUES (?, ?, ?)",
+                         [(i, f"n{i}", i * 1.5) for i in range(10)])
+        conn.commit()
+        conn.close()
+        ds = rdata.read_sql("SELECT id, name, v FROM t WHERE id < 7",
+                            lambda: sqlite3.connect(db))
+        rows = sorted(ds.take_all(), key=lambda r: r["id"])
+        assert len(rows) == 7
+        assert rows[3] == {"id": 3, "name": "n3", "v": 4.5}
+
+    def test_empty_result(self, tmp_path):
+        db = str(tmp_path / "e.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE t (a INTEGER)")
+        conn.commit()
+        conn.close()
+        ds = rdata.read_sql("SELECT a FROM t",
+                            lambda: sqlite3.connect(db))
+        assert ds.count() == 0
+
+
+class TestReadWebdataset:
+    def test_shard_grouping(self, tmp_path):
+        import io
+        shard = str(tmp_path / "shard-000.tar")
+        with tarfile.open(shard, "w") as tar:
+            for key in ("s0", "s1"):
+                for ext, payload in (
+                        ("jpg", b"\xff\xd8fakejpeg"),
+                        ("txt", f"caption {key}".encode()),
+                        ("json", json.dumps({"k": key}).encode())):
+                    info = tarfile.TarInfo(f"{key}.{ext}")
+                    info.size = len(payload)
+                    tar.addfile(info, io.BytesIO(payload))
+        ds = rdata.read_webdataset(shard)
+        rows = sorted(ds.take_all(), key=lambda r: r["__key__"])
+        assert len(rows) == 2
+        assert rows[0]["__key__"] == "s0"
+        assert rows[0]["jpg"] == b"\xff\xd8fakejpeg"  # bytes preserved
+        assert rows[0]["txt"] == "caption s0"         # text decoded
+        assert rows[1]["json"] == {"k": "s1"}         # json decoded
+
+
+class TestFromFrameworks:
+    def test_from_torch(self):
+        import torch
+        tds = torch.utils.data.TensorDataset(
+            torch.arange(6), torch.arange(6) * 2)
+        ds = rdata.from_torch(tds)
+        rows = ds.take_all()
+        assert len(rows) == 6
+        x, y = rows[3]["item"]
+        assert int(x) == 3 and int(y) == 6
+
+    def test_from_tf(self):
+        import tensorflow as tf
+        tfds = tf.data.Dataset.from_tensor_slices(
+            {"a": np.arange(4), "b": np.arange(4) * 3.0})
+        ds = rdata.from_tf(tfds)
+        rows = sorted(ds.take_all(), key=lambda r: r["a"])
+        assert rows[2]["a"] == 2 and rows[2]["b"] == pytest.approx(6.0)
+
+    def test_from_huggingface(self):
+        import datasets as hfd
+        hf = hfd.Dataset.from_dict(
+            {"text": ["a", "b", "c"], "label": [0, 1, 0]})
+        ds = rdata.from_huggingface(hf)
+        rows = ds.take_all()
+        assert len(rows) == 3
+        assert {r["text"] for r in rows} == {"a", "b", "c"}
+
+    def test_read_avro_gated(self):
+        with pytest.raises(ImportError, match="fastavro"):
+            rdata.read_avro("/tmp/x.avro")
+
+
+class TestDatasourcePlugin:
+    def test_custom_datasource(self):
+        class RangeSource(rdata.Datasource):
+            def __init__(self, n):
+                self.n = n
+
+            def get_read_tasks(self, parallelism):
+                import numpy as np
+                step = max(1, self.n // parallelism)
+                tasks = []
+                for s in range(0, self.n, step):
+                    e = min(s + step, self.n)
+                    tasks.append(rdata.ReadTask(
+                        (lambda s=s, e=e:
+                         {"v": np.arange(s, e, dtype=np.int64)}),
+                        num_rows=e - s))
+                return tasks
+
+        ds = rdata.read_datasource(RangeSource(100), parallelism=4)
+        assert ds.count() == 100
+        assert ds.sum("v") == sum(range(100))
+        # Streams through the lazy path too.
+        assert sum(b["v"].sum() for b in ds.iter_batches(batch_size=30)) \
+            == sum(range(100))
+
+    def test_empty_datasource_rejected(self):
+        class Empty(rdata.Datasource):
+            def get_read_tasks(self, parallelism):
+                return []
+
+        with pytest.raises(ValueError, match="no read tasks"):
+            rdata.read_datasource(Empty())
+
+    def test_custom_datasink(self, tmp_path):
+        out_dir = str(tmp_path)
+
+        class FileSink(rdata.Datasink):
+            def __init__(self, d):
+                self.d = d
+                self.events = []
+
+            def on_write_start(self):
+                self.events.append("start")
+
+            def write(self, block, ctx):
+                import numpy as np
+                p = os.path.join(self.d, f"part-{ctx['block_index']}.npy")
+                np.save(p, block["id"])
+                return p
+
+            def on_write_complete(self, results):
+                self.events.append(("complete", len(results)))
+
+        sink = FileSink(out_dir)
+        paths = rdata.range(100, override_num_blocks=4).write_datasink(sink)
+        assert len(paths) == 4
+        total = sum(len(np.load(p)) for p in paths)
+        assert total == 100
+        assert sink.events[0] == "start"
+
+    def test_datasink_failure_hook(self):
+        calls = []
+
+        class BadSink(rdata.Datasink):
+            def write(self, block, ctx):
+                raise RuntimeError("disk on fire")
+
+            def on_write_failed(self, error):
+                calls.append(str(error))
+
+        with pytest.raises(Exception, match="disk on fire"):
+            rdata.range(10).write_datasink(BadSink())
+        assert calls and "disk on fire" in calls[0]

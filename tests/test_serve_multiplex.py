@@ -137,6 +137,49 @@ class TestWrapperUnits:
         with pytest.raises(ValueError):
             serve.multiplexed(max_num_models_per_replica=0)
 
+    def test_two_multiplexed_methods_separate_caches(self):
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
+        class Host:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            async def get_model(self, model_id):
+                return ("model", model_id)
+
+            @serve.multiplexed(max_num_models_per_replica=2)
+            async def get_tokenizer(self, model_id):
+                return ("tok", model_id)
+
+        h = Host()
+
+        async def run():
+            m = await h.get_model("x")
+            t = await h.get_tokenizer("x")
+            assert m == ("model", "x")
+            assert t == ("tok", "x")  # NOT the cached model object
+
+        asyncio.run(run())
+        assert loaded_model_ids(h) == ["x"]
+
+    def test_note_grace_survives_probe_wipe(self):
+        import threading
+        import time
+        from ray_tpu.serve.handle import _Router
+        r = _Router.__new__(_Router)
+        r._lock = threading.Lock()
+        r._replicas = ["r0", "r1"]
+        r._inflight = {0: 0, 1: 0}
+        r._qlen_base = {}
+        r._qlen_ts = {}
+        r._model_locations = {}
+        r._model_note_ts = {}
+        with r._lock:
+            r._note_model_location("big", 0)
+        # Emulate the probe-update rule: a fresh note must survive a
+        # probe that does not (yet) see the model loaded.
+        now = time.monotonic()
+        assert now - r._model_note_ts[("big", 0)] < r._MUX_NOTE_GRACE_S
+        assert 0 in r._model_locations["big"]
+
 
 class TestMultiplexE2E:
     def _deploy(self, num_replicas=2, max_models=2):
