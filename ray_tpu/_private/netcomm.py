@@ -304,6 +304,18 @@ class PullManager:
         conn.close()
 
     def _pull_once(self, object_id, host: str, port: int) -> None:
+        import time as _t
+        _t0 = _t.monotonic()
+        try:
+            return self._pull_once_inner(object_id, host, port)
+        finally:
+            _dt = _t.monotonic() - _t0
+            if _dt > 0.5:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "slow pull %s: %.3fs", object_id.hex()[:8], _dt)
+
+    def _pull_once_inner(self, object_id, host: str, port: int) -> None:
         from ..exceptions import ObjectLostError
         oid = object_id.binary()
         if host in ("127.0.0.1", "localhost", "::1"):
@@ -313,8 +325,15 @@ class PullManager:
             try:
                 if self._pull_local(object_id, host, port):
                     return
-            except Exception:
-                pass  # any wrinkle: use the streaming path
+                # NOT_FOUND is a documented "no fast path" answer
+                # (stores without locate_for): debug, not warning.
+                import logging
+                logging.getLogger(__name__).debug(
+                    "fast path NOT_FOUND for %s", object_id.hex()[:8])
+            except Exception as e:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "fast path failed for %s: %r", object_id.hex()[:8], e)
         conn = self._acquire_conn(host, port)
         retried = False
         while True:

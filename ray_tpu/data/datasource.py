@@ -81,6 +81,26 @@ def _exec_write_task(sink: Datasink, block: "B.Block", ctx: dict) -> Any:
     return sink.write(block, ctx)
 
 
+def fanout_dataset(name: str, parts: List[Any], submit: Callable,
+                   rows_for: Optional[Callable] = None) -> Dataset:
+    """Shared reader scaffolding: `submit(part)` returns an ObjectRef of
+    one Block; the eager path materializes bundle sizes, the lazy path
+    submits as the streaming window pulls (every read_* builds on this)."""
+
+    def source():
+        refs = [submit(c) for c in parts]
+        blocks = api.get(refs)
+        return [_RefBundle(r, B.block_length(blk))
+                for r, blk in zip(refs, blocks)]
+
+    def iter_source():
+        for c in parts:
+            n = rows_for(c) if rows_for is not None else None
+            yield (submit(c), n if n is not None else -1)
+
+    return Dataset(_Plan(source, [], name, iter_source))
+
+
 def read_datasource(datasource: Datasource, *,
                     parallelism: int = 8) -> Dataset:
     """Reference: read_api.py read_datasource."""
@@ -88,20 +108,10 @@ def read_datasource(datasource: Datasource, *,
     if not tasks:
         raise ValueError(
             f"{datasource.get_name()} returned no read tasks")
-
-    def source():
-        refs = [_exec_read_task.remote(t) for t in tasks]
-        blocks = api.get(refs)
-        return [_RefBundle(r, B.block_length(blk))
-                for r, blk in zip(refs, blocks)]
-
-    def iter_source():
-        for t in tasks:
-            yield (_exec_read_task.remote(t),
-                   t.num_rows if t.num_rows is not None else -1)
-
-    return Dataset(
-        _Plan(source, [], f"read_{datasource.get_name()}", iter_source))
+    return fanout_dataset(
+        f"read_{datasource.get_name()}", tasks,
+        lambda t: _exec_read_task.remote(t),
+        rows_for=lambda t: t.num_rows)
 
 
 def write_datasink(ds: Dataset, sink: Datasink) -> List[Any]:

@@ -175,7 +175,7 @@ def _read_image_chunk(paths: List[str], size, mode,
             img = img.resize((size[1], size[0]))  # PIL takes (W, H)
         imgs.append(np.asarray(img))
         kept.append(p)
-    if size is not None:
+    if imgs and all(im.shape == imgs[0].shape for im in imgs):
         col = np.stack(imgs)
     else:  # ragged shapes: object column
         col = np.empty(len(imgs), dtype=object)
@@ -193,22 +193,14 @@ def read_images(paths, *, size: Optional[tuple] = None,
     """Reference: read_api.py read_images (ImageDatasource) — PIL
     decode, optional (H, W) resize + mode convert; uniform sizes stack
     into one ndarray column, ragged sizes become an object column."""
+    from .datasource import fanout_dataset
     files = _expand_paths(paths, None)
     if not files:
         raise FileNotFoundError(f"No files matched {paths!r}")
-    chunks = _chunk(files, parallelism)
-
-    def source():
-        refs = [_read_image_chunk.remote(c, size, mode, include_paths)
-                for c in chunks]
-        return [_RefBundle(r, B.block_length(blk))
-                for r, blk in zip(refs, api.get(refs))]
-
-    def iter_source():
-        for c in chunks:
-            yield (_read_image_chunk.remote(c, size, mode,
-                                            include_paths), len(c))
-    return Dataset(_Plan(source, [], "read_images", iter_source))
+    return fanout_dataset(
+        "read_images", _chunk(files, parallelism),
+        lambda c: _read_image_chunk.remote(c, size, mode, include_paths),
+        rows_for=len)
 
 
 def _rows_to_block_union(rows: List[Dict[str, Any]]) -> B.Block:
@@ -257,9 +249,9 @@ def _read_tfrecord_files(paths: List[str]) -> B.Block:
             for name, feat in ex.features.feature.items():
                 kind = feat.WhichOneof("kind")
                 vals = list(getattr(feat, kind).value)
-                if kind == "bytes_list":
-                    vals = [v.decode("utf-8", "surrogateescape")
-                            for v in vals]
+                # bytes features stay bytes (images etc.); text users
+                # decode explicitly — lossy auto-decoding corrupts
+                # binary payloads.
                 row[name] = vals[0] if len(vals) == 1 else vals
             rows.append(row)
     return _rows_to_block_union(rows)
@@ -268,20 +260,13 @@ def _read_tfrecord_files(paths: List[str]) -> B.Block:
 def read_tfrecords(paths, *, parallelism: int = 8) -> Dataset:
     """Reference: read_api.py read_tfrecords — tf.train.Example
     records parsed into columns (single-value features scalarized)."""
+    from .datasource import fanout_dataset
     files = _expand_paths(paths, None)
     if not files:
         raise FileNotFoundError(f"No files matched {paths!r}")
-    chunks = _chunk(files, parallelism)
-
-    def source():
-        refs = [_read_tfrecord_files.remote(c) for c in chunks]
-        return [_RefBundle(r, B.block_length(blk))
-                for r, blk in zip(refs, api.get(refs))]
-
-    def iter_source():
-        for c in chunks:
-            yield (_read_tfrecord_files.remote(c), -1)
-    return Dataset(_Plan(source, [], "read_tfrecords", iter_source))
+    return fanout_dataset(
+        "read_tfrecords", _chunk(files, parallelism),
+        lambda c: _read_tfrecord_files.remote(c))
 
 
 def read_sql(sql: str, connection_factory, *,
@@ -312,14 +297,9 @@ def read_sql(sql: str, connection_factory, *,
             out[n] = arr
         return out
 
-    def source():
-        ref = _run_query.remote()
-        blk = api.get(ref)
-        return [_RefBundle(ref, B.block_length(blk))]
-
-    def iter_source():
-        yield (_run_query.remote(), -1)
-    return Dataset(_Plan(source, [], "read_sql", iter_source))
+    from .datasource import fanout_dataset
+    return fanout_dataset("read_sql", [None],
+                          lambda _: _run_query.remote())
 
 
 @api.remote
@@ -361,19 +341,12 @@ def read_webdataset(paths, *, parallelism: int = 8) -> Dataset:
     samples grouped by basename; .txt/.cls/.json members decoded,
     everything else (images, tensors) kept as bytes for map_batches
     decoding."""
+    from .datasource import fanout_dataset
     files = _expand_paths(paths, ".tar")
     if not files:
         raise FileNotFoundError(f"No files matched {paths!r}")
-
-    def source():
-        refs = [_read_webdataset_shard.remote(p) for p in files]
-        return [_RefBundle(r, B.block_length(blk))
-                for r, blk in zip(refs, api.get(refs))]
-
-    def iter_source():
-        for p in files:
-            yield (_read_webdataset_shard.remote(p), -1)
-    return Dataset(_Plan(source, [], "read_webdataset", iter_source))
+    return fanout_dataset("read_webdataset", files,
+                          lambda p: _read_webdataset_shard.remote(p))
 
 
 def read_avro(paths, **kwargs) -> Dataset:

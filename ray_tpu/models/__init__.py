@@ -35,3 +35,12 @@ from .resnet import (  # noqa: F401
     resnet_init,
     resnet_param_axes,
 )
+from .vit import (  # noqa: F401
+    ViTConfig,
+    make_classifier,
+    make_vit_train_step,
+    vit_forward,
+    vit_init,
+    vit_loss,
+    vit_param_axes,
+)

@@ -1,0 +1,231 @@
+"""Vision Transformer family, TPU-first.
+
+Role in the framework: the image-classification counterpart to the GPT
+flagship (reference ML baselines run ResNet-50 through external torch —
+BASELINE.md Data ResNet config; ViT is the transformer-era equivalent and
+exercises the same serving/training paths with conv-free patch
+embedding). Same design rules as models/gpt.py: bf16 matmuls for the MXU
+(patchify is a reshape + one big matmul, not a conv), fp32 norms/softmax,
+bidirectional Pallas flash attention, logical-axis annotations so
+parallel.partition shards it for TP/FSDP without touching model code,
+per-block rematerialization.
+
+Params are a plain dict pytree; `vit_param_axes` returns the matching
+pytree of logical axis tuples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+from ..ops.layers import rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    @classmethod
+    def vit_b16(cls) -> "ViTConfig":
+        """ViT-Base/16 (86M) — the standard ImageNet configuration."""
+        return cls()
+
+    @classmethod
+    def vit_s16(cls) -> "ViTConfig":
+        """ViT-Small/16 (22M)."""
+        return cls(d_model=384, n_heads=6, d_ff=1536)
+
+    @classmethod
+    def tiny(cls) -> "ViTConfig":
+        return cls(image_size=32, patch_size=8, d_model=64, n_heads=4,
+                   n_layers=2, d_ff=128, num_classes=10)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+def _layer_init(key, cfg: ViTConfig) -> Dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    d, f = cfg.d_model, cfg.d_ff
+    scale = d ** -0.5
+    out_scale = scale / (2 * cfg.n_layers) ** 0.5
+    return {
+        "ln1": jnp.ones((d,), dtype=jnp.float32),
+        "wqkv": (jax.random.normal(k1, (d, 3 * d)) * scale
+                 ).astype(cfg.dtype),
+        "wo": (jax.random.normal(k2, (d, d)) * out_scale
+               ).astype(cfg.dtype),
+        "ln2": jnp.ones((d,), dtype=jnp.float32),
+        "w1": (jax.random.normal(k3, (d, f)) * scale).astype(cfg.dtype),
+        "w2": (jax.random.normal(k4, (f, d)) * out_scale
+               ).astype(cfg.dtype),
+    }
+
+
+def vit_init(key, cfg: ViTConfig) -> Dict:
+    keys = jax.random.split(key, cfg.n_layers + 4)
+    return {
+        # Patchify-as-matmul: (P*P*C, d) — one MXU-shaped projection
+        # instead of a strided conv.
+        "patch": (jax.random.normal(keys[0], (cfg.patch_dim, cfg.d_model))
+                  * cfg.patch_dim ** -0.5).astype(cfg.dtype),
+        "cls": jnp.zeros((1, 1, cfg.d_model), dtype=cfg.dtype),
+        # Learned positions (fp32: added once, tiny).
+        "pos": (jax.random.normal(keys[1],
+                                  (cfg.num_patches + 1, cfg.d_model))
+                * 0.02).astype(jnp.float32),
+        "lnf": jnp.ones((cfg.d_model,), dtype=jnp.float32),
+        "head": (jax.random.normal(keys[2],
+                                   (cfg.d_model, cfg.num_classes))
+                 * cfg.d_model ** -0.5).astype(cfg.dtype),
+        "layers": [_layer_init(keys[i + 3], cfg)
+                   for i in range(cfg.n_layers)],
+    }
+
+
+def vit_param_axes(cfg: ViTConfig) -> Dict:
+    """Logical axis names per parameter (parallel.partition rule input,
+    same vocabulary as gpt_param_axes so one TP/FSDP rule table covers
+    both families)."""
+    layer = {
+        "ln1": ("embed",),
+        "wqkv": ("embed", "mlp"),
+        "wo": ("mlp", "embed"),
+        "ln2": ("embed",),
+        "w1": ("embed", "mlp"),
+        "w2": ("mlp", "embed"),
+    }
+    return {
+        "patch": ("vocab", "embed"),   # shard like an input embedding
+        "cls": (None, None, "embed"),
+        "pos": (None, "embed"),
+        "lnf": ("embed",),
+        # "classes" is deliberately absent from every rule table: class
+        # counts (10, 1000) rarely divide tp, and the head matmul is a
+        # rounding error of the FLOPs — keep it replicated.
+        "head": ("embed", "classes"),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _patchify(images, cfg: ViTConfig):
+    """[b, H, W, C] -> [b, num_patches, P*P*C] via pure reshapes."""
+    b, hgt, wid, c = images.shape
+    p = cfg.patch_size
+    nh, nw = hgt // p, wid // p
+    x = images.reshape(b, nh, p, nw, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, nh * nw, p * p * c)
+
+
+def _block(x, layer, cfg: ViTConfig):
+    b, s, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    y = rms_norm(x, layer["ln1"])
+    qkv = jnp.einsum("bsd,de->bse", y, layer["wqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    attn = flash_attention(q, k, v, causal=False)  # bidirectional
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+    x = x + jnp.einsum("bsd,de->bse", attn, layer["wo"])
+    y = rms_norm(x, layer["ln2"])
+    inner = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, layer["w1"]))
+    x = x + jnp.einsum("bsf,fd->bsd", inner, layer["w2"])
+    return x
+
+
+def vit_forward(params: Dict, images, cfg: ViTConfig):
+    """images [b, H, W, C] float -> logits [b, num_classes] (fp32)."""
+    patches = _patchify(images.astype(cfg.dtype), cfg)
+    x = jnp.einsum("bpk,kd->bpd", patches, params["patch"])
+    b = x.shape[0]
+    cls = jnp.broadcast_to(params["cls"], (b, 1, cfg.d_model)
+                           ).astype(x.dtype)
+    x = jnp.concatenate([cls, x], axis=1)
+    x = (x + params["pos"][None, :x.shape[1]].astype(jnp.float32)
+         ).astype(cfg.dtype)
+    block = functools.partial(_block, cfg=cfg)
+    if cfg.remat:
+        block = jax.checkpoint(
+            block,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    for layer in params["layers"]:
+        x = block(x, layer)
+    x = rms_norm(x[:, 0], params["lnf"])  # CLS token
+    return jnp.einsum("bd,dc->bc", x, params["head"]).astype(jnp.float32)
+
+
+def vit_loss(params: Dict, batch: Tuple, cfg: ViTConfig):
+    """Cross entropy; batch = (images [b,H,W,C], labels [b] int32)."""
+    images, labels = batch
+    logits = vit_forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return -jnp.mean(ll)
+
+
+def make_vit_train_step(cfg: ViTConfig, optimizer=None,
+                        donate: bool = True, mesh=None, rules=None):
+    """Build (init_state, train_step) — same contract as
+    gpt.make_train_step: with a mesh + partition rules the shardings on
+    params/opt-state make XLA insert the dp gradient psum / tp
+    collectives."""
+    from ._training import make_train_step_for
+
+    return make_train_step_for(
+        lambda key: vit_init(key, cfg),
+        lambda params, batch: vit_loss(params, batch, cfg),
+        axes=vit_param_axes(cfg), optimizer=optimizer, donate=donate,
+        mesh=mesh, rules=rules)
+
+
+def make_classifier(cfg: ViTConfig, params=None, key=None):
+    """Jitted (params-closed) classifier for Data actor pools (the
+    `map_batches(ViTPredictor, ...)` serving path; mirror of
+    resnet.make_predictor)."""
+    if params is None:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        params = vit_init(key, cfg)
+
+    @jax.jit
+    def _logits(p, images):
+        return vit_forward(p, images, cfg)
+
+    def predict(images):
+        return jax.device_get(
+            jnp.argmax(_logits(params, jnp.asarray(images)), axis=-1))
+
+    return predict

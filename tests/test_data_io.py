@@ -47,6 +47,13 @@ class TestReadImages:
         assert shapes == [(4, 4, 3), (8, 6, 3)]
         assert all(r["path"].endswith(".png") for r in rows)
 
+    def test_uniform_images_stack_without_size(self, tmp_path):
+        self._write_pngs(tmp_path, [(8, 6)] * 3)
+        batch = rdata.read_images(str(tmp_path)).take_batch(3)
+        # Actual uniformity drives stacking, not the size= argument.
+        assert batch["image"].shape == (3, 8, 6, 3)
+        assert batch["image"].dtype == np.uint8
+
     def test_resize_and_mode(self, tmp_path):
         self._write_pngs(tmp_path, [(10, 10)])
         ds = rdata.read_images(str(tmp_path), size=(5, 7), mode="L")
@@ -74,7 +81,9 @@ class TestReadTfrecords:
         rows = sorted(ds.take_all(), key=lambda r: r["idx"])
         assert len(rows) == 5
         assert rows[2]["idx"] == 2
-        assert rows[2]["name"] == "row2"
+        # bytes features stay bytes (binary payloads like encoded
+        # images must survive; text users decode explicitly)
+        assert rows[2]["name"] == b"row2"
         assert rows[2]["score"] == pytest.approx(1.0)
 
 
