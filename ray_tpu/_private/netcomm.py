@@ -312,7 +312,10 @@ class PullManager:
             _dt = _t.monotonic() - _t0
             if _dt > 0.5:
                 import logging
-                logging.getLogger(__name__).warning(
+                # Big objects legitimately take >0.5s; only multi-second
+                # pulls are worth an operator's attention.
+                lg = logging.getLogger(__name__)
+                (lg.warning if _dt > 5.0 else lg.debug)(
                     "slow pull %s: %.3fs", object_id.hex()[:8], _dt)
 
     def _pull_once_inner(self, object_id, host: str, port: int) -> None:

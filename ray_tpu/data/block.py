@@ -43,12 +43,33 @@ def block_take_indices(block: Block, idx) -> Block:
     return {k: v[idx] for k, v in block.items()}
 
 
+def _object_rows(arr: np.ndarray) -> np.ndarray:
+    """Demote an (n, ...) ndarray column to an (n,) object column of
+    per-row arrays (concat fallback for shape-heterogeneous columns)."""
+    if arr.dtype == object and arr.ndim == 1:
+        return arr
+    out = np.empty(len(arr), dtype=object)
+    for i in range(len(arr)):
+        out[i] = arr[i]
+    return out
+
+
 def block_concat(blocks: List[Block]) -> Block:
     blocks = [b for b in blocks if block_length(b) > 0]
     if not blocks:
         return {}
     keys = blocks[0].keys()
-    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    out = {}
+    for k in keys:
+        cols = [b[k] for b in blocks]
+        try:
+            out[k] = np.concatenate(cols)
+        except ValueError:
+            # Shape/kind-heterogeneous neighbors (e.g. one reader chunk
+            # stacked uniform images, the next was ragged): fall back
+            # to object rows instead of crashing the batch boundary.
+            out[k] = np.concatenate([_object_rows(c) for c in cols])
+    return out
 
 
 def block_to_rows(block: Block) -> Iterator[Dict[str, Any]]:

@@ -13,6 +13,7 @@ import numpy as np
 from .. import api
 from . import block as B
 from .dataset import Dataset, _Plan, _RefBundle
+from .datasource import fanout_dataset
 
 
 def _make_source(blocks: List[B.Block]) -> Dataset:
@@ -193,7 +194,6 @@ def read_images(paths, *, size: Optional[tuple] = None,
     """Reference: read_api.py read_images (ImageDatasource) — PIL
     decode, optional (H, W) resize + mode convert; uniform sizes stack
     into one ndarray column, ragged sizes become an object column."""
-    from .datasource import fanout_dataset
     files = _expand_paths(paths, None)
     if not files:
         raise FileNotFoundError(f"No files matched {paths!r}")
@@ -260,7 +260,6 @@ def _read_tfrecord_files(paths: List[str]) -> B.Block:
 def read_tfrecords(paths, *, parallelism: int = 8) -> Dataset:
     """Reference: read_api.py read_tfrecords — tf.train.Example
     records parsed into columns (single-value features scalarized)."""
-    from .datasource import fanout_dataset
     files = _expand_paths(paths, None)
     if not files:
         raise FileNotFoundError(f"No files matched {paths!r}")
@@ -297,7 +296,6 @@ def read_sql(sql: str, connection_factory, *,
             out[n] = arr
         return out
 
-    from .datasource import fanout_dataset
     return fanout_dataset("read_sql", [None],
                           lambda _: _run_query.remote())
 
@@ -341,7 +339,6 @@ def read_webdataset(paths, *, parallelism: int = 8) -> Dataset:
     samples grouped by basename; .txt/.cls/.json members decoded,
     everything else (images, tensors) kept as bytes for map_batches
     decoding."""
-    from .datasource import fanout_dataset
     files = _expand_paths(paths, ".tar")
     if not files:
         raise FileNotFoundError(f"No files matched {paths!r}")

@@ -182,14 +182,17 @@ class _Router:
                     # Confirmed on-replica: future absence means a real
                     # eviction, so the optimistic note must not linger.
                     notes.pop((m, i), None)
-                # Bounded state: expired notes and emptied location
-                # sets are dead weight on long-lived routers with
-                # churning model ids.
-                for key_ in [k for k, ts in notes.items()
-                             if now - ts >= self._MUX_NOTE_GRACE_S]:
-                    notes.pop(key_, None)
-                for m in [m for m, s_ in locs.items() if not s_]:
-                    locs.pop(m, None)
+        with self._lock:
+            # Bounded state (once per probe round, not per replica):
+            # expired notes and emptied location sets are dead weight
+            # on long-lived routers with churning model ids.
+            notes = getattr(self, "_model_note_ts", {})
+            locs = getattr(self, "_model_locations", {})
+            for key_ in [k for k, ts in notes.items()
+                         if now - ts >= self._MUX_NOTE_GRACE_S]:
+                notes.pop(key_, None)
+            for m in [m for m, s_ in locs.items() if not s_]:
+                locs.pop(m, None)
 
     def _pick(self, candidates: Optional[List[int]] = None,
               model_id: str = "") -> int:

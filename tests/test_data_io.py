@@ -54,6 +54,17 @@ class TestReadImages:
         assert batch["image"].shape == (3, 8, 6, 3)
         assert batch["image"].dtype == np.uint8
 
+    def test_mixed_chunk_shapes_batch_across_blocks(self, tmp_path):
+        # Chunk A uniform 8x6, chunk B uniform 4x4: per-chunk stacking
+        # yields differently-shaped ndarray columns; batching across the
+        # block boundary must fall back to object rows, not crash.
+        self._write_pngs(tmp_path, [(8, 6), (8, 6), (4, 4), (4, 4)])
+        ds = rdata.read_images(str(tmp_path), parallelism=2)
+        batch = ds.take_batch(4)
+        assert len(batch["image"]) == 4
+        shapes = sorted(im.shape for im in batch["image"])
+        assert shapes == [(4, 4, 3), (4, 4, 3), (8, 6, 3), (8, 6, 3)]
+
     def test_resize_and_mode(self, tmp_path):
         self._write_pngs(tmp_path, [(10, 10)])
         ds = rdata.read_images(str(tmp_path), size=(5, 7), mode="L")
