@@ -13,6 +13,11 @@ groupby, iter_batches/streaming_split feeding trainers.
 from .block import Block  # noqa: F401
 from .context import DataContext  # noqa: F401
 from .dataset import ActorPoolStrategy, Dataset, GroupedData  # noqa: F401
+from .preprocessor import (  # noqa: F401
+    Preprocessor,
+    PreprocessorNotFittedException,
+)
+from . import preprocessors  # noqa: F401
 from .streaming import DataIterator  # noqa: F401
 from .datasource import (  # noqa: F401
     Datasink,
@@ -44,7 +49,8 @@ from .read_api import (  # noqa: F401
 
 __all__ = [
     "ActorPoolStrategy", "Block", "DataContext", "DataIterator", "Dataset",
-    "Datasink", "Datasource", "GroupedData", "ReadTask",
+    "Datasink", "Datasource", "GroupedData", "Preprocessor",
+    "PreprocessorNotFittedException", "ReadTask", "preprocessors",
     "from_arrow", "from_huggingface",
     "from_items", "from_numpy", "from_pandas", "from_tf", "from_torch",
     "range", "read_avro",

@@ -16,6 +16,24 @@ import numpy as np
 Block = Dict[str, np.ndarray]
 
 
+def object_column(vals) -> np.ndarray:
+    """(n,) object column from per-row values (shared builder for every
+    ragged/heterogeneous fallback in ray_tpu.data)."""
+    out = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        out[i] = v
+    return out
+
+
+def _col_from_values(vals: List[Any]) -> np.ndarray:
+    """Column array from python values; ragged/irregular values (lists
+    of differing lengths) become an object column instead of raising."""
+    try:
+        return np.asarray(vals)
+    except ValueError:
+        return object_column(vals)
+
+
 def block_from_rows(rows: Sequence[Any]) -> Block:
     """Build a column block from python rows (dicts or scalars)."""
     if not rows:
@@ -26,8 +44,8 @@ def block_from_rows(rows: Sequence[Any]) -> Block:
         for r in rows:
             for k in cols:
                 cols[k].append(r[k])
-        return {k: np.asarray(v) for k, v in cols.items()}
-    return {"item": np.asarray(list(rows))}
+        return {k: _col_from_values(v) for k, v in cols.items()}
+    return {"item": _col_from_values(list(rows))}
 
 
 def block_length(block: Block) -> int:
@@ -48,10 +66,7 @@ def _object_rows(arr: np.ndarray) -> np.ndarray:
     per-row arrays (concat fallback for shape-heterogeneous columns)."""
     if arr.dtype == object and arr.ndim == 1:
         return arr
-    out = np.empty(len(arr), dtype=object)
-    for i in range(len(arr)):
-        out[i] = arr[i]
-    return out
+    return object_column(arr)
 
 
 def block_concat(blocks: List[Block]) -> Block:

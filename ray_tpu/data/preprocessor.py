@@ -1,0 +1,77 @@
+"""Preprocessor base: fit on a Dataset, transform via map_batches.
+
+Reference parity: python/ray/data/preprocessor.py (Preprocessor —
+fit/transform/fit_transform/transform_batch lifecycle with a fitted-state
+check). TPU-first notes: fitted statistics are tiny numpy/dict state
+computed with the Dataset's distributed aggregates (Welford moments,
+min/max, unique — one pass per column, no per-row python), and
+`transform` lowers to `map_batches` over numpy-dict blocks so the work
+runs in the same task/actor pools as every other stage.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict
+
+from .dataset import Dataset
+
+
+class PreprocessorNotFittedException(RuntimeError):
+    """transform() before fit() (reference: preprocessor.py same name)."""
+
+
+class Preprocessor:
+    """Reference: data/preprocessor.py Preprocessor."""
+
+    class FitStatus(str, enum.Enum):
+        NOT_FITTABLE = "NOT_FITTABLE"
+        NOT_FITTED = "NOT_FITTED"
+        FITTED = "FITTED"
+
+    # Subclasses with no statistics (Concatenator, Normalizer, ...) set
+    # False and are usable without fit().
+    _is_fittable: bool = True
+
+    def fit_status(self) -> "Preprocessor.FitStatus":
+        if not self._is_fittable:
+            return Preprocessor.FitStatus.NOT_FITTABLE
+        if getattr(self, "_fitted", False):
+            return Preprocessor.FitStatus.FITTED
+        return Preprocessor.FitStatus.NOT_FITTED
+
+    def fit(self, ds: Dataset) -> "Preprocessor":
+        if self._is_fittable:
+            self._fit(ds)
+            self._fitted = True
+        return self
+
+    def fit_transform(self, ds: Dataset) -> Dataset:
+        return self.fit(ds).transform(ds)
+
+    def transform(self, ds: Dataset) -> Dataset:
+        if self._is_fittable and not getattr(self, "_fitted", False):
+            raise PreprocessorNotFittedException(
+                f"{type(self).__name__} must be fit before transform "
+                "(call .fit(ds) or .fit_transform(ds))")
+        return ds.map_batches(self._transform_numpy)
+
+    def transform_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Apply to one in-memory batch (serving-time path; reference:
+        Preprocessor.transform_batch)."""
+        if self._is_fittable and not getattr(self, "_fitted", False):
+            raise PreprocessorNotFittedException(
+                f"{type(self).__name__} must be fit before "
+                "transform_batch")
+        return self._transform_numpy(dict(batch))
+
+    # -- subclass hooks ----------------------------------------------------
+    def _fit(self, ds: Dataset) -> None:
+        raise NotImplementedError
+
+    def _transform_numpy(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def __repr__(self):
+        state = {k: v for k, v in self.__dict__.items()
+                 if not k.startswith("_")}
+        return f"{type(self).__name__}({state})"

@@ -1,0 +1,553 @@
+"""Built-in preprocessors (reference: python/ray/data/preprocessors/ —
+scaler.py, encoder.py, imputer.py, normalizer.py, concatenator.py,
+chain.py, discretizer.py, hasher.py, tokenizer.py, vectorizer.py).
+
+Each fits with the Dataset's distributed aggregates (one pass per
+column) and transforms through map_batches on numpy-dict blocks.
+Deliberately absent (documented): PowerTransformer (boxcox/yeo-johnson
+lambda search — niche, sklearn covers it host-side) and the torch
+tensor preprocessors (jax arrays flow through plain numpy columns here).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+from .dataset import Dataset
+from .preprocessor import Preprocessor
+
+
+@api.remote
+def _block_stats(block, fn):
+    return fn(block)
+
+
+def _map_blocks(ds: Dataset, fn) -> List[Any]:
+    """Run `fn(block) -> small stats` remotely on every block (the
+    distributed-fit workhorse: per-block partials, driver-side merge —
+    full columns never cross to the driver)."""
+    return api.get([_block_stats.remote(b.ref, fn)
+                    for b in ds._plan.execute() if b.num_rows])
+
+
+def _col_moments(ds: Dataset, column: str):
+    """(n, mean, m2) in ONE distributed pass (Dataset.mean/std each
+    rerun the same moment sweep; fit paths need all three at once)."""
+    return ds._merged_moments(column)
+
+
+def _col_minmax(ds: Dataset, column: str):
+    parts = ds._minmax(column)
+    return (float(min(lo for lo, _ in parts)),
+            float(max(hi for _, hi in parts)))
+
+__all__ = [
+    "Chain", "Concatenator", "CountVectorizer", "FeatureHasher",
+    "LabelEncoder", "MaxAbsScaler", "MinMaxScaler", "MultiHotEncoder",
+    "Normalizer", "OneHotEncoder", "OrdinalEncoder", "RobustScaler",
+    "SimpleImputer", "StandardScaler", "Tokenizer",
+    "UniformKBinsDiscretizer",
+]
+
+
+# ---------------------------------------------------------------------------
+# scalers (reference: preprocessors/scaler.py)
+# ---------------------------------------------------------------------------
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column; zero-variance columns center only."""
+
+    def __init__(self, columns: List[str], ddof: int = 0):
+        self.columns = list(columns)
+        self.ddof = ddof
+
+    def _fit(self, ds: Dataset) -> None:
+        self.stats_ = {}
+        for c in self.columns:
+            n, mean, m2 = _col_moments(ds, c)
+            std = float(np.sqrt(m2 / (n - self.ddof))) \
+                if n > self.ddof else 0.0
+            self.stats_[c] = (float(mean), std)
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            x = np.asarray(batch[c], np.float64) - mean
+            batch[c] = (x / std if std and np.isfinite(std) else x
+                        ).astype(np.float32)
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column -> [0, 1]."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, ds: Dataset) -> None:
+        self.stats_ = {c: _col_minmax(ds, c) for c in self.columns}
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            span = hi - lo
+            x = np.asarray(batch[c], np.float64) - lo
+            batch[c] = (x / span if span else x).astype(np.float32)
+        return batch
+
+
+class MaxAbsScaler(Preprocessor):
+    """x / max(|x|) per column -> [-1, 1]."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, ds: Dataset) -> None:
+        self.stats_ = {}
+        for c in self.columns:
+            lo, hi = _col_minmax(ds, c)
+            self.stats_[c] = max(abs(lo), abs(hi))
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            m = self.stats_[c]
+            x = np.asarray(batch[c], np.float64)
+            batch[c] = (x / m if m else x).astype(np.float32)
+        return batch
+
+
+class RobustScaler(Preprocessor):
+    """(x - median) / IQR per column (reference: scaler.py RobustScaler).
+
+    Quantiles come from a distributed per-block histogram merge
+    (1,000-bin within observed min/max): one extra pass, no full-column
+    materialization on the driver."""
+
+    def __init__(self, columns: List[str],
+                 quantile_range: tuple = (0.25, 0.75)):
+        self.columns = list(columns)
+        self.quantile_range = quantile_range
+
+    def _fit(self, ds: Dataset) -> None:
+        lo_q, hi_q = self.quantile_range
+        self.stats_ = {}
+        bounds = {c: _col_minmax(ds, c) for c in self.columns}
+        hist_cols = {c: np.linspace(lo, hi, 1001)
+                     for c, (lo, hi) in bounds.items() if hi > lo}
+        merged = {c: np.zeros(1000, np.int64) for c in hist_cols}
+        if hist_cols:
+            def block_hists(blk, edges=hist_cols):
+                return {c: np.histogram(
+                    np.asarray(blk[c], np.float64), bins=e)[0]
+                    for c, e in edges.items()}
+            for part in _map_blocks(ds, block_hists):
+                for c, h in part.items():
+                    merged[c] += h
+        for c in self.columns:
+            lo, hi = bounds[c]
+            if c not in hist_cols:
+                self.stats_[c] = (lo, 0.0)
+                continue
+            edges = hist_cols[c]
+            counts = merged[c]
+            cdf = np.cumsum(counts) / max(1, counts.sum())
+            centers = (edges[:-1] + edges[1:]) / 2
+
+            def q(p):
+                return float(centers[np.searchsorted(cdf, p)])
+
+            self.stats_[c] = (q(0.5), q(hi_q) - q(lo_q))
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            med, iqr = self.stats_[c]
+            x = np.asarray(batch[c], np.float64) - med
+            batch[c] = (x / iqr if iqr else x).astype(np.float32)
+        return batch
+
+
+# ---------------------------------------------------------------------------
+# encoders (reference: preprocessors/encoder.py)
+# ---------------------------------------------------------------------------
+def _sorted_unique(ds: Dataset, column: str) -> List:
+    return ds.unique(column)
+
+
+class OrdinalEncoder(Preprocessor):
+    """Category -> integer index (unknowns -> -1)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, ds: Dataset) -> None:
+        self.stats_ = {c: {v: i for i, v in
+                           enumerate(_sorted_unique(ds, c))}
+                       for c in self.columns}
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            table = self.stats_[c]
+            batch[c] = np.asarray(
+                [table.get(v, -1) for v in batch[c]], np.int64)
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    """Category column -> one `{col}_{value}` 0/1 column per category
+    (unknowns encode all-zero)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, ds: Dataset) -> None:
+        self.stats_ = {c: _sorted_unique(ds, c) for c in self.columns}
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            vals = np.asarray(batch.pop(c))
+            for cat in self.stats_[c]:
+                batch[f"{c}_{cat}"] = (vals == cat).astype(np.int8)
+        return batch
+
+
+class MultiHotEncoder(Preprocessor):
+    """List-valued column -> fixed multi-hot count vector column."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, ds: Dataset) -> None:
+        cols = self.columns
+
+        def block_vocab(blk):
+            return {c: set().union(*[set(row) for row in blk[c]])
+                    if len(blk[c]) else set() for c in cols}
+
+        seen = {c: set() for c in cols}
+        for part in _map_blocks(ds, block_vocab):
+            for c, vs in part.items():
+                seen[c] |= vs
+        self.stats_ = {c: {v: i for i, v in enumerate(sorted(seen[c]))}
+                       for c in cols}
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            table = self.stats_[c]
+            out = np.zeros((len(batch[c]), len(table)), np.int8)
+            for i, row in enumerate(batch[c]):
+                for v in row:
+                    j = table.get(v)
+                    if j is not None:
+                        out[i, j] += 1
+            batch[c] = out
+        return batch
+
+
+class LabelEncoder(Preprocessor):
+    """Ordinal encoding of ONE label column (unknowns raise)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+
+    def _fit(self, ds: Dataset) -> None:
+        self.stats_ = {v: i for i, v in enumerate(
+            _sorted_unique(ds, self.label_column))}
+
+    def _transform_numpy(self, batch):
+        c = self.label_column
+        try:
+            batch[c] = np.asarray([self.stats_[v] for v in batch[c]],
+                                  np.int64)
+        except KeyError as e:
+            raise ValueError(
+                f"LabelEncoder saw unknown label {e.args[0]!r}") from e
+        return batch
+
+
+# ---------------------------------------------------------------------------
+# imputer / normalizer / concatenator (reference: imputer.py,
+# normalizer.py, concatenator.py)
+# ---------------------------------------------------------------------------
+class SimpleImputer(Preprocessor):
+    """Fill NaNs with mean / most_frequent / a constant."""
+
+    def __init__(self, columns: List[str], strategy: str = "mean",
+                 fill_value: Optional[Any] = None):
+        if strategy not in ("mean", "most_frequent", "constant"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("strategy='constant' needs fill_value")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+
+    def _fit(self, ds: Dataset) -> None:
+        self.stats_ = {}
+        cols = self.columns
+        if self.strategy == "mean":
+            def block_sums(blk):
+                out = {}
+                for c in cols:
+                    x = np.asarray(blk[c], np.float64)
+                    good = ~np.isnan(x)
+                    out[c] = (float(x[good].sum()), int(good.sum()))
+                return out
+
+            totals = {c: [0.0, 0] for c in cols}
+            for part in _map_blocks(ds, block_sums):
+                for c, (t, n) in part.items():
+                    totals[c][0] += t
+                    totals[c][1] += n
+            for c, (t, n) in totals.items():
+                self.stats_[c] = t / n if n else 0.0
+        elif self.strategy == "most_frequent":
+            def block_counts(blk):
+                out = {}
+                for c in cols:
+                    counts: Dict[Any, int] = {}
+                    for v in blk[c]:
+                        if isinstance(v, float) and np.isnan(v):
+                            continue
+                        counts[v] = counts.get(v, 0) + 1
+                    out[c] = counts
+                return out
+
+            merged = {c: {} for c in cols}
+            for part in _map_blocks(ds, block_counts):
+                for c, counts in part.items():
+                    for v, n in counts.items():
+                        merged[c][v] = merged[c].get(v, 0) + n
+            for c in cols:
+                self.stats_[c] = max(merged[c], key=merged[c].get) \
+                    if merged[c] else 0.0
+        else:
+            for c in cols:
+                self.stats_[c] = self.fill_value
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            fill = self.stats_[c]
+            x = np.asarray(batch[c])
+            if x.dtype.kind == "f":
+                batch[c] = np.where(np.isnan(x), fill, x)
+            else:
+                batch[c] = np.asarray(
+                    [fill if (isinstance(v, float) and np.isnan(v))
+                     or v is None else v for v in x])
+        return batch
+
+
+class Normalizer(Preprocessor):
+    """Row-wise lp-normalize across the given columns (stateless)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str], norm: str = "l2"):
+        if norm not in ("l1", "l2", "max"):
+            raise ValueError(f"unknown norm {norm!r}")
+        self.columns = list(columns)
+        self.norm = norm
+
+    def _transform_numpy(self, batch):
+        mat = np.stack([np.asarray(batch[c], np.float64)
+                        for c in self.columns], axis=1)
+        if self.norm == "l1":
+            d = np.abs(mat).sum(axis=1)
+        elif self.norm == "l2":
+            d = np.sqrt((mat * mat).sum(axis=1))
+        else:
+            d = np.abs(mat).max(axis=1)
+        d = np.where(d == 0, 1.0, d)
+        for i, c in enumerate(self.columns):
+            batch[c] = (mat[:, i] / d).astype(np.float32)
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Merge numeric columns into one vector column (stateless;
+    reference: concatenator.py — the trainer-input packing step)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str],
+                 output_column_name: str = "concat_out",
+                 dtype=np.float32):
+        self.columns = list(columns)
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+
+    def _transform_numpy(self, batch):
+        parts = []
+        for c in self.columns:
+            x = np.asarray(batch.pop(c))
+            parts.append(x[:, None] if x.ndim == 1 else x)
+        batch[self.output_column_name] = np.concatenate(
+            parts, axis=1).astype(self.dtype)
+        return batch
+
+
+# ---------------------------------------------------------------------------
+# discretizer / hasher / tokenizer / vectorizer
+# ---------------------------------------------------------------------------
+class UniformKBinsDiscretizer(Preprocessor):
+    """Equal-width binning per column (reference: discretizer.py)."""
+
+    def __init__(self, columns: List[str], bins: int = 10):
+        self.columns = list(columns)
+        self.bins = int(bins)
+
+    def _fit(self, ds: Dataset) -> None:
+        self.stats_ = {}
+        for c in self.columns:
+            lo, hi = ds.min(c), ds.max(c)
+            self.stats_[c] = np.linspace(lo, hi, self.bins + 1)[1:-1]
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            batch[c] = np.digitize(
+                np.asarray(batch[c], np.float64),
+                self.stats_[c]).astype(np.int64)
+        return batch
+
+
+class FeatureHasher(Preprocessor):
+    """Hash token-list columns into a fixed-width count vector
+    (stateless; reference: hasher.py)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str], num_features: int = 256,
+                 output_column_name: str = "hashed_features"):
+        self.columns = list(columns)
+        self.num_features = int(num_features)
+        self.output_column_name = output_column_name
+
+    def _transform_numpy(self, batch):
+        import zlib
+        n = len(batch[self.columns[0]])
+        out = np.zeros((n, self.num_features), np.int32)
+        for c in self.columns:
+            for i, row in enumerate(batch[c]):
+                tokens = row if isinstance(row, (list, tuple, np.ndarray)) \
+                    else [row]
+                for t in tokens:
+                    out[i, zlib.crc32(str(t).encode())
+                        % self.num_features] += 1
+        batch[self.output_column_name] = out
+        return batch
+
+
+class Tokenizer(Preprocessor):
+    """Split string columns into token lists (stateless; reference:
+    tokenizer.py — default whitespace split, custom fn supported)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str],
+                 tokenization_fn: Optional[Callable] = None):
+        self.columns = list(columns)
+        self.tokenization_fn = tokenization_fn or str.split
+
+    def _transform_numpy(self, batch):
+        fn = self.tokenization_fn
+        for c in self.columns:
+            out = np.empty(len(batch[c]), dtype=object)
+            for i, v in enumerate(batch[c]):
+                out[i] = fn(str(v))
+            batch[c] = out
+        return batch
+
+
+class CountVectorizer(Preprocessor):
+    """Token counts over a fitted vocabulary, one `{col}_{token}` column
+    per token (reference: vectorizer.py; `max_features` keeps the most
+    frequent)."""
+
+    def __init__(self, columns: List[str],
+                 tokenization_fn: Optional[Callable] = None,
+                 max_features: Optional[int] = None):
+        self.columns = list(columns)
+        self.tokenization_fn = tokenization_fn or str.split
+        self.max_features = max_features
+
+    def _fit(self, ds: Dataset) -> None:
+        fn = self.tokenization_fn
+        cols = self.columns
+
+        def block_tokens(blk):
+            out = {}
+            for c in cols:
+                counts: Dict[str, int] = {}
+                for v in blk[c]:
+                    for t in fn(str(v)):
+                        counts[t] = counts.get(t, 0) + 1
+                out[c] = counts
+            return out
+
+        merged = {c: {} for c in cols}
+        for part in _map_blocks(ds, block_tokens):
+            for c, counts in part.items():
+                for t, n in counts.items():
+                    merged[c][t] = merged[c].get(t, 0) + n
+        self.stats_ = {}
+        for c in cols:
+            vocab = sorted(merged[c], key=lambda t: (-merged[c][t], t))
+            if self.max_features:
+                vocab = vocab[:self.max_features]
+            self.stats_[c] = sorted(vocab)
+
+    def _transform_numpy(self, batch):
+        fn = self.tokenization_fn
+        for c in self.columns:
+            vals = batch.pop(c)
+            token_counts = []
+            for v in vals:
+                row: Dict[str, int] = {}
+                for t in fn(str(v)):
+                    row[t] = row.get(t, 0) + 1
+                token_counts.append(row)
+            for t in self.stats_[c]:
+                batch[f"{c}_{t}"] = np.asarray(
+                    [rc.get(t, 0) for rc in token_counts], np.int32)
+        return batch
+
+
+# ---------------------------------------------------------------------------
+# chain (reference: preprocessors/chain.py)
+# ---------------------------------------------------------------------------
+class Chain(Preprocessor):
+    """Sequential composition; fit runs each stage on the output of the
+    previous stages' transforms (reference: chain.py semantics)."""
+
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = list(preprocessors)
+
+    @property
+    def _is_fittable(self):  # type: ignore[override]
+        return any(p._is_fittable for p in self.preprocessors)
+
+    def fit_transform(self, ds: Dataset) -> Dataset:
+        for p in self.preprocessors:
+            if p._is_fittable:
+                p.fit(ds)
+            ds = p.transform(ds)
+        self._fitted = True
+        return ds
+
+    def fit(self, ds: Dataset) -> "Preprocessor":
+        self.fit_transform(ds)
+        return self
+
+    def transform(self, ds: Dataset) -> Dataset:
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def transform_batch(self, batch):
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch
+
+    def _transform_numpy(self, batch):
+        return self.transform_batch(batch)

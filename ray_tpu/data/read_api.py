@@ -179,9 +179,7 @@ def _read_image_chunk(paths: List[str], size, mode,
     if imgs and all(im.shape == imgs[0].shape for im in imgs):
         col = np.stack(imgs)
     else:  # ragged shapes: object column
-        col = np.empty(len(imgs), dtype=object)
-        for i, im in enumerate(imgs):
-            col[i] = im
+        col = B.object_column(imgs)
     blk = {"image": col}
     if include_paths:
         blk["path"] = np.asarray(kept, dtype=object)
@@ -230,10 +228,7 @@ def _rows_to_block_union(rows: List[Dict[str, Any]]) -> B.Block:
                 continue
             except Exception:
                 pass
-        arr = np.empty(len(vals), dtype=object)
-        for i, v in enumerate(vals):
-            arr[i] = v
-        out[k] = arr
+        out[k] = B.object_column(vals)
     return out
 
 

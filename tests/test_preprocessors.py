@@ -1,0 +1,194 @@
+"""Preprocessor tests (reference strategy: data/tests/
+test_preprocessors_*.py — fit statistics, transform correctness,
+chaining, not-fitted errors, batch-path parity)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data import Preprocessor, PreprocessorNotFittedException
+from ray_tpu.data.preprocessors import (
+    Chain, Concatenator, CountVectorizer, FeatureHasher, LabelEncoder,
+    MaxAbsScaler, MinMaxScaler, MultiHotEncoder, Normalizer,
+    OneHotEncoder, OrdinalEncoder, RobustScaler, SimpleImputer,
+    StandardScaler, Tokenizer, UniformKBinsDiscretizer)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _num_ds():
+    return rdata.from_items(
+        [{"a": float(i), "b": float(i * 2)} for i in range(10)],
+        override_num_blocks=3)
+
+
+class TestScalers:
+    def test_standard_scaler(self):
+        sc = StandardScaler(["a"])
+        out = sc.fit_transform(_num_ds()).take_all()
+        vals = np.array([r["a"] for r in out])
+        assert vals.mean() == pytest.approx(0.0, abs=1e-6)
+        assert vals.std() == pytest.approx(1.0, abs=1e-5)
+        # b untouched
+        assert out[3]["b"] == 6.0
+
+    def test_min_max_scaler(self):
+        out = MinMaxScaler(["a", "b"]).fit_transform(_num_ds()).take_all()
+        a = np.array([r["a"] for r in out])
+        assert a.min() == 0.0 and a.max() == 1.0
+
+    def test_max_abs_scaler(self):
+        ds = rdata.from_items([{"a": -4.0}, {"a": 2.0}])
+        out = MaxAbsScaler(["a"]).fit_transform(ds).take_all()
+        assert sorted(r["a"] for r in out) == [-1.0, 0.5]
+
+    def test_robust_scaler(self):
+        rng = np.random.default_rng(0)
+        vals = np.concatenate([rng.normal(10, 2, 500), [1000.0]])
+        ds = rdata.from_items([{"a": float(v)} for v in vals])
+        out = RobustScaler(["a"]).fit_transform(ds).take_all()
+        med = np.median([r["a"] for r in out])
+        # Median lands near zero despite the huge outlier.
+        assert abs(med) < 0.5
+
+    def test_not_fitted_raises(self):
+        with pytest.raises(PreprocessorNotFittedException):
+            StandardScaler(["a"]).transform(_num_ds())
+
+
+class TestEncoders:
+    def _cat_ds(self):
+        return rdata.from_items(
+            [{"color": c, "v": i} for i, c in
+             enumerate(["red", "blue", "red", "green"])])
+
+    def test_ordinal(self):
+        out = OrdinalEncoder(["color"]).fit_transform(
+            self._cat_ds()).take_all()
+        # blue=0, green=1, red=2 (sorted)
+        assert [r["color"] for r in out] == [2, 0, 2, 1]
+
+    def test_ordinal_unknown_is_minus_one(self):
+        enc = OrdinalEncoder(["color"]).fit(self._cat_ds())
+        batch = enc.transform_batch({"color": np.asarray(["pink"])})
+        assert batch["color"][0] == -1
+
+    def test_one_hot(self):
+        out = OneHotEncoder(["color"]).fit_transform(
+            self._cat_ds()).take_all()
+        assert out[0]["color_red"] == 1 and out[0]["color_blue"] == 0
+        assert out[1]["color_blue"] == 1
+        assert "color" not in out[0]
+
+    def test_multi_hot(self):
+        ds = rdata.from_items([{"tags": ["a", "b"]},
+                               {"tags": ["b", "b", "c"]}])
+        out = MultiHotEncoder(["tags"]).fit_transform(ds).take_all()
+        assert out[0]["tags"].tolist() == [1, 1, 0]
+        assert out[1]["tags"].tolist() == [0, 2, 1]
+
+    def test_label_encoder_unknown_raises(self):
+        enc = LabelEncoder("color").fit(self._cat_ds())
+        out = enc.transform_batch({"color": np.asarray(["red"])})
+        assert out["color"][0] == 2
+        with pytest.raises(ValueError, match="unknown label"):
+            enc.transform_batch({"color": np.asarray(["pink"])})
+
+
+class TestImputeNormalizeConcat:
+    def test_imputer_mean(self):
+        ds = rdata.from_items([{"a": 1.0}, {"a": float("nan")},
+                               {"a": 3.0}])
+        out = SimpleImputer(["a"], "mean").fit_transform(ds).take_all()
+        assert sorted(r["a"] for r in out) == [1.0, 2.0, 3.0]
+
+    def test_imputer_most_frequent(self):
+        ds = rdata.from_items([{"a": 5.0}, {"a": 5.0},
+                               {"a": float("nan")}, {"a": 7.0}])
+        out = SimpleImputer(["a"], "most_frequent").fit_transform(
+            ds).take_all()
+        assert sorted(r["a"] for r in out) == [5.0, 5.0, 5.0, 7.0]
+
+    def test_imputer_constant(self):
+        ds = rdata.from_items([{"a": float("nan")}])
+        out = SimpleImputer(["a"], "constant",
+                            fill_value=9.0).fit_transform(ds).take_all()
+        assert out[0]["a"] == 9.0
+
+    def test_normalizer_l2(self):
+        ds = rdata.from_items([{"x": 3.0, "y": 4.0}])
+        out = Normalizer(["x", "y"]).transform(ds).take_all()
+        assert out[0]["x"] == pytest.approx(0.6)
+        assert out[0]["y"] == pytest.approx(0.8)
+
+    def test_concatenator(self):
+        ds = rdata.from_items([{"x": 1.0, "y": 2.0, "keep": "k"}])
+        out = Concatenator(["x", "y"], "vec").transform(ds).take_all()
+        assert out[0]["vec"].tolist() == [1.0, 2.0]
+        assert out[0]["keep"] == "k"
+
+
+class TestTextAndBins:
+    def test_discretizer(self):
+        ds = rdata.from_items([{"a": float(i)} for i in range(100)])
+        out = UniformKBinsDiscretizer(["a"], bins=4).fit_transform(
+            ds).take_all()
+        bins = {r["a"] for r in out}
+        assert bins == {0, 1, 2, 3}
+
+    def test_tokenizer_then_hasher(self):
+        ds = rdata.from_items([{"text": "the cat sat"},
+                               {"text": "the dog"}])
+        chain = Chain(Tokenizer(["text"]),
+                      FeatureHasher(["text"], num_features=32))
+        out = chain.fit_transform(ds).take_all()
+        assert out[0]["hashed_features"].sum() == 3
+        assert out[1]["hashed_features"].sum() == 2
+
+    def test_count_vectorizer(self):
+        ds = rdata.from_items([{"t": "a b a"}, {"t": "b c"}])
+        out = CountVectorizer(["t"]).fit_transform(ds).take_all()
+        assert out[0]["t_a"] == 2 and out[0]["t_b"] == 1
+        assert out[1]["t_c"] == 1 and out[1]["t_a"] == 0
+
+    def test_count_vectorizer_max_features(self):
+        ds = rdata.from_items([{"t": "a a a b b c"}])
+        cv = CountVectorizer(["t"], max_features=2).fit(ds)
+        assert cv.stats_["t"] == ["a", "b"]
+
+
+class TestChainAndStatus:
+    def test_chain_scaler_then_concat(self):
+        chain = Chain(MinMaxScaler(["a", "b"]),
+                      Concatenator(["a", "b"], "features"))
+        out = chain.fit_transform(_num_ds()).take_all()
+        assert out[0]["features"].shape == (2,)
+        assert out[-1]["features"].tolist() == [1.0, 1.0]
+
+    def test_fit_status(self):
+        sc = StandardScaler(["a"])
+        assert sc.fit_status() == Preprocessor.FitStatus.NOT_FITTED
+        sc.fit(_num_ds())
+        assert sc.fit_status() == Preprocessor.FitStatus.FITTED
+        assert Normalizer(["a"]).fit_status() == \
+            Preprocessor.FitStatus.NOT_FITTABLE
+
+    def test_transform_batch_matches_dataset_path(self):
+        sc = StandardScaler(["a"]).fit(_num_ds())
+        ds_out = sc.transform(_num_ds()).take_all()
+        b_out = sc.transform_batch(
+            {"a": np.asarray([float(i) for i in range(10)]),
+             "b": np.zeros(10)})
+        assert np.allclose([r["a"] for r in ds_out], b_out["a"])
+
+    def test_preprocessor_pickles(self):
+        import pickle
+        sc = StandardScaler(["a"]).fit(_num_ds())
+        clone = pickle.loads(pickle.dumps(sc))
+        out = clone.transform_batch({"a": np.asarray([4.5])})
+        assert np.isfinite(out["a"][0])
