@@ -400,7 +400,7 @@ class UniformKBinsDiscretizer(Preprocessor):
     def _fit(self, ds: Dataset) -> None:
         self.stats_ = {}
         for c in self.columns:
-            lo, hi = ds.min(c), ds.max(c)
+            lo, hi = _col_minmax(ds, c)
             self.stats_[c] = np.linspace(lo, hi, self.bins + 1)[1:-1]
 
     def _transform_numpy(self, batch):

@@ -23,14 +23,15 @@ from .env.multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
                               MultiAgentEnvRunnerGroup)
 from .offline import (DatasetReader, ImportanceSamplingEstimator,
                       SampleWriter)
-from .utils.replay_buffers import ReplayBuffer
+from .utils.replay_buffers import (PrioritizedReplayBuffer,
+                                   ReplayBuffer)
 
 __all__ = ["APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "BC",
            "DreamerV3", "DreamerV3Config",
            "BCConfig", "DQN",
            "DQNConfig", "DQNModule", "EnvRunnerGroup", "IMPALA",
            "IMPALAConfig", "JaxLearner", "PPO", "PPOConfig", "PPOModule",
-           "MARWIL", "MARWILConfig", "RLModule", "ReplayBuffer", "SAC",
+           "MARWIL", "MARWILConfig", "PrioritizedReplayBuffer", "RLModule", "ReplayBuffer", "SAC",
            "SACConfig", "SACModule",
            "DatasetReader", "ImportanceSamplingEstimator", "SampleWriter",
            "SingleAgentEnvRunner", "vtrace"]

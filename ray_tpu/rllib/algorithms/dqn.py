@@ -8,36 +8,57 @@ import numpy as np
 
 from ..core.learner import JaxLearner
 from ..core.rl_module import DQNModule
-from ..utils.replay_buffers import ReplayBuffer
+from ..utils.replay_buffers import (PrioritizedReplayBuffer,
+                                    ReplayBuffer)
 from .algorithm import Algorithm, AlgorithmConfig
+
+
+def _td_errors(params, module, batch, gamma: float):
+    """Per-sample double-DQN TD errors (shared by the loss and the
+    post-update priority refresh)."""
+    q = module.apply(params, batch["obs"])
+    q_taken = jnp.take_along_axis(
+        q, batch["actions"][:, None].astype(jnp.int32), -1)[:, 0]
+    q_next_online = module.apply(params, batch["next_obs"])
+    next_a = jnp.argmax(q_next_online, -1)
+    q_next_target = jnp.take_along_axis(
+        batch["target_q_next"], next_a[:, None], -1)[:, 0]
+    nonterm = 1.0 - batch["terminateds"].astype(jnp.float32)
+    target = batch["rewards"] + gamma * nonterm * q_next_target
+    return q_taken - jax.lax.stop_gradient(target), q_taken
 
 
 def make_dqn_loss(gamma: float):
     def dqn_loss(params, module, batch):
         """Double-DQN TD loss (reference: dqn learner compute_loss):
-        online net picks argmax a', target net evaluates it."""
-        q = module.apply(params, batch["obs"])
-        q_taken = jnp.take_along_axis(
-            q, batch["actions"][:, None].astype(jnp.int32), -1)[:, 0]
-        q_next_online = module.apply(params, batch["next_obs"])
-        next_a = jnp.argmax(q_next_online, -1)
-        q_next_target = jnp.take_along_axis(
-            batch["target_q_next"], next_a[:, None], -1)[:, 0]
-        nonterm = 1.0 - batch["terminateds"].astype(jnp.float32)
-        target = batch["rewards"] + gamma * nonterm * q_next_target
-        td = q_taken - jax.lax.stop_gradient(target)
-        loss = jnp.mean(jnp.square(td))
+        online net picks argmax a', target net evaluates it. With
+        prioritized replay the batch carries importance `weights` that
+        de-bias the gradient (reference: PER weighted TD loss)."""
+        td, q_taken = _td_errors(params, module, batch, gamma)
+        sq = jnp.square(td)
+        if "weights" in batch:
+            loss = jnp.mean(batch["weights"] * sq)
+        else:
+            loss = jnp.mean(sq)
         return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
-                      "q_mean": jnp.mean(q_taken)}
+                      "q_mean": jnp.mean(q_taken),
+                      # per-sample magnitudes: the PER priority refresh
+                      # reads these from the SAME forward pass the loss
+                      # ran (no duplicate Q-network inference).
+                      "td_abs": jnp.abs(td)}
     return dqn_loss
 
 
 class DQN(Algorithm):
     def __init__(self, config):
         super().__init__(config)
-        self.buffer = ReplayBuffer(
-            int(config.extra.get("buffer_capacity", 50_000)),
-            seed=config.seed)
+        cap = int(config.extra.get("buffer_capacity", 50_000))
+        if config.extra.get("prioritized_replay", False):
+            self.buffer = PrioritizedReplayBuffer(
+                cap, alpha=float(config.extra.get("alpha", 0.6)),
+                seed=config.seed)
+        else:
+            self.buffer = ReplayBuffer(cap, seed=config.seed)
         self.target_params = self.learner.get_weights()
         self._target_q = jax.jit(
             lambda p, obs: self.module.apply(p, obs))
@@ -63,12 +84,31 @@ class DQN(Algorithm):
             self._total_steps += len(frag["rewards"])
         stats: Dict = {"epsilon": epsilon}
         warmup = int(cfg.extra.get("learning_starts", 1000))
+        per = isinstance(self.buffer, PrioritizedReplayBuffer)
+        if per:
+            # Linear beta anneal 0.4 -> 1.0 (reference: PER appendix;
+            # full IS correction as learning converges).
+            beta0 = float(cfg.extra.get("beta", 0.4))
+            frac = min(1.0, self.iteration
+                       / float(cfg.extra.get("beta_iters", 100)))
+            beta = beta0 + (1.0 - beta0) * frac
+            stats["beta"] = beta
         if len(self.buffer) >= max(warmup, cfg.train_batch_size):
             for _ in range(int(cfg.extra.get("updates_per_iter", 8))):
-                batch = self.buffer.sample(cfg.train_batch_size)
+                batch = self.buffer.sample(cfg.train_batch_size,
+                                           beta=beta) if per \
+                    else self.buffer.sample(cfg.train_batch_size)
                 batch["target_q_next"] = np.asarray(self._target_q(
                     self.target_params, jnp.asarray(batch["next_obs"])))
-                stats.update(self.learner.update(batch))
+                idxs = batch.pop("batch_indexes", None)
+                upd = self.learner.update(batch)
+                td = upd.pop("td_abs", None)
+                stats.update(upd)
+                if per and idxs is not None and td is not None:
+                    # The mesh learner drops a ragged batch tail; only
+                    # the rows that actually trained get new priorities.
+                    self.buffer.update_priorities(idxs[:len(td)],
+                                                  np.asarray(td))
         if self.iteration % int(
                 cfg.extra.get("target_update_freq", 5)) == 0:
             self.target_params = self.learner.get_weights()

@@ -86,7 +86,11 @@ class JaxLearner:
         self.params, self.opt_state, loss, aux = self._update(
             self.params, self.opt_state, db)
         out = {"total_loss": float(loss)}
-        out.update({k: float(v) for k, v in aux.items()})
+        for k, v in aux.items():
+            # Vector aux entries (e.g. per-sample |td| for prioritized
+            # replay) pass through as arrays; scalars stay floats.
+            out[k] = float(v) if getattr(v, "ndim", 0) == 0 \
+                else np.asarray(v)
         return out
 
     # -- state (reference: Checkpointable get_state/set_state) -------------
