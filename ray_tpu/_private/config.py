@@ -89,6 +89,14 @@ class RayConfig:
         "pull_parallel_threshold_mb": 64.0,
         # Connections per large-object pull (1 = sequential).
         "pull_parallel_streams": 4,
+        # -- hybrid scheduling policy (reference: scheduler_spread_threshold,
+        # hybrid_scheduling_policy.cc:48 — prefer the local/preferred node
+        # while its critical-resource utilization stays below this, then
+        # spread to the least-utilized node) -----------------------------
+        "scheduler_spread_threshold": 0.5,
+        # Top-k randomization among equally-good spread candidates, as a
+        # fraction of alive nodes (reference: kSchedulerTopKFraction).
+        "scheduler_top_k_fraction": 0.2,
         # Infeasible tasks fail fast by default; an active autoscaler
         # raises this so demand can park while capacity is launched
         # (reference: infeasible queue + autoscaler demand satisfaction).

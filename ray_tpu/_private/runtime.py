@@ -183,7 +183,8 @@ class Node:
             self.resources_mgr, self.pool, self._dispatch,
             max_workers=max(ncpu, 4),
             is_object_ready=self._is_object_ready,
-            nodes=self.node_registry)
+            nodes=self.node_registry,
+            locality_fn=self._arg_locality)
         self._handler_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="handler")
         self._fn_registry: Dict[str, bytes] = {}
@@ -601,6 +602,33 @@ class Node:
         e = self.gcs.objects.entry(oid)
         return (e is not None and e.event.is_set()
                 and e.state != gcs_mod.LOST)
+
+    def _arg_locality(self, spec) -> Dict[str, int]:
+        """Bytes of `spec`'s by-ref args per holder node — the
+        scheduler's locality signal (reference: LocalityDataProviderInterface
+        feeding LocalityAwareLeasePolicy, lease_policy.cc:38-58). Inline
+        and pending args contribute nothing."""
+        out: Dict[str, int] = {}
+        args = list(spec.args or [])
+        if getattr(spec, "kwargs", None):
+            args.extend(spec.kwargs.values())
+        seen: Set[bytes] = set()  # a ref passed N times is pulled once
+        for a in args:
+            oids = []
+            if getattr(a, "kind", None) == "ref" and a.object_id is not None:
+                oids.append(a.object_id)
+            # Refs nested inside by-value args are pull dependencies too
+            # (the dispatch path pins + localizes them the same way).
+            oids.extend(getattr(a, "nested_ids", None) or ())
+            for oid in oids:
+                key = oid.binary()
+                if key in seen:
+                    continue
+                seen.add(key)
+                loc = self._tag_local_loc(self.gcs.objects.location(oid))
+                if loc is not None and loc[0] == P.LOC_SHM:
+                    out[loc[2]] = out.get(loc[2], 0) + int(loc[1])
+        return out
 
     def incref(self, oid: ObjectID):
         self.gcs.objects.incref(oid)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import collections
 import os
+import random
 import threading
 from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
@@ -181,6 +182,9 @@ class NodeRegistry:
                               labels=head_labels)
         self._nodes[head_id_hex] = self.head
         self._spread_rr = 0  # SPREAD round-robin cursor
+        # Single-node fast path: the hybrid scorer is skipped entirely
+        # until a second node registers (the sync-task hot path).
+        self._multi_node = False
 
     def add_node(self, node_id_hex: str, resources: Dict[str, float],
                  daemon=None,
@@ -189,6 +193,8 @@ class NodeRegistry:
                           daemon=daemon, labels=labels)
         with self._lock:
             self._nodes[node_id_hex] = entry
+            self._multi_node = sum(
+                1 for e in self._nodes.values() if e.alive) > 1
         return entry
 
     def get(self, node_id_hex: str) -> Optional[NodeEntry]:
@@ -201,6 +207,10 @@ class NodeRegistry:
             if entry is None or entry.is_head:
                 return None
             entry.alive = False
+            # Dead entries stay in the dict; recompute the fast-path
+            # flag from what is actually alive.
+            self._multi_node = sum(
+                1 for e in self._nodes.values() if e.alive) > 1
             return entry
 
     def entries(self) -> List[NodeEntry]:
@@ -208,26 +218,96 @@ class NodeRegistry:
             return list(self._nodes.values())
 
     def acquire(self, demand: Dict[str, float],
-                strategy=None) -> Optional[str]:
+                strategy=None,
+                locality: Optional[Dict[str, int]] = None) -> Optional[str]:
         """Pick a node and acquire `demand` on it, honoring the task's
         scheduling strategy (reference: scheduling/policy/*.cc —
         hybrid [default], spread, node_affinity, node_label policies).
-        Default: head-first (the hybrid policy's local-node
-        preference), then first-fit over the rest."""
-        for entry in self._candidates(strategy):
+        Default: the hybrid policy — prefer the node holding the most
+        bytes of the task's args (lease_policy.cc:38-58), else the
+        head (the submitting node), while its critical-resource
+        utilization stays below the spread threshold; past that,
+        spread to the least-utilized node with top-k randomization
+        (hybrid_scheduling_policy.cc:48-160)."""
+        for entry in self._candidates(strategy, demand, locality):
             if entry.rm.try_acquire(demand):
                 return entry.node_id_hex
         return None
 
-    def _candidates(self, strategy) -> List[NodeEntry]:
+    def _utilization(self, entry: NodeEntry,
+                     demand: Optional[Dict[str, float]]) -> float:
+        """Critical-resource utilization: the max used/total fraction
+        over the resource kinds the task demands (reference scores on
+        the dominant resource the same way)."""
+        totals, avail = entry.rm.snapshot()
+        keys = ([k for k, v in (demand or {}).items() if v > 0]
+                or (["CPU"] if "CPU" in totals else list(totals)[:1]))
+        u = 0.0
+        for k in keys:
+            tot = totals.get(k, 0.0)
+            if tot <= 0:
+                return 1.0
+            u = max(u, (tot - avail.get(k, 0.0)) / tot)
+        return min(max(u, 0.0), 1.0)
+
+    def _hybrid_candidates(self, demand: Optional[Dict[str, float]],
+                           locality: Optional[Dict[str, int]]
+                           ) -> List[NodeEntry]:
+        if not self._multi_node:
+            # Single node: nothing to score (the sync-task hot path).
+            return [self.head] if self.head.alive else []
+        alive = [e for e in self.entries() if e.alive]
+        if len(alive) <= 1:
+            return alive
+        from .config import ray_config
+        threshold = float(ray_config.scheduler_spread_threshold)
+        # Preferred node: max arg-bytes already local, else the head.
+        pref = None
+        if locality:
+            best_hex = max(sorted(locality), key=lambda h: locality[h])
+            for e in alive:
+                if e.node_id_hex == best_hex:
+                    pref = e
+                    break
+        if pref is None:
+            pref = self.head if self.head.alive else None
+        util = {e.node_id_hex: self._utilization(e, demand)
+                for e in alive}
+        loc = locality or {}
+        if pref is not None and util[pref.node_id_hex] < threshold:
+            rest = sorted(
+                (e for e in alive if e is not pref),
+                key=lambda e: (util[e.node_id_hex] >= threshold,
+                               -loc.get(e.node_id_hex, 0),
+                               util[e.node_id_hex]))
+            return [pref] + rest
+        # Preferred node saturated: spread. Below-threshold nodes all
+        # score equal (0), so order them by locality then utilization,
+        # and shuffle the top-k to avoid herding concurrent decisions
+        # onto one node.
+        ordered = sorted(
+            alive,
+            key=lambda e: (util[e.node_id_hex] >= threshold,
+                           -loc.get(e.node_id_hex, 0),
+                           util[e.node_id_hex]))
+        k = max(1, int(len(ordered)
+                       * float(ray_config.scheduler_top_k_fraction)))
+        if not loc and k > 1:
+            top = ordered[:k]
+            random.shuffle(top)
+            ordered = top + ordered[k:]
+        return ordered
+
+    def _candidates(self, strategy,
+                    demand: Optional[Dict[str, float]] = None,
+                    locality: Optional[Dict[str, int]] = None
+                    ) -> List[NodeEntry]:
         """Ordered candidate nodes for a strategy. Unplaceable-by-
         strategy (dead affinity target, unmatchable hard labels) yields
         an empty list — strategy_unschedulable() tells permanent from
         transient."""
-        if strategy is None:  # the hot default: hybrid head-first
-            rest = [e for e in self.entries()
-                    if e.alive and not e.is_head]
-            return [self.head] + rest
+        if strategy is None:  # the hot default: hybrid policy
+            return self._hybrid_candidates(demand, locality)
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             with self._lock:
                 target = self._nodes.get(strategy.node_id)
@@ -261,10 +341,8 @@ class NodeRegistry:
                 return []
             start = self._spread_rr % len(alive)
             return alive[start:] + alive[:start]
-        # DEFAULT / None / placement-group strategies: hybrid policy.
-        rest = [e for e in self.entries()
-                if e.alive and not e.is_head]
-        return [self.head] + rest
+        # DEFAULT / placement-group strategies: hybrid policy.
+        return self._hybrid_candidates(demand, locality)
 
     def note_spread_grant(self, node_id_hex: str):
         """A SPREAD task was dispatched onto `node_id_hex`: rotate the
@@ -931,7 +1009,8 @@ class Scheduler:
                  dispatch_fn: Callable[[P.TaskSpec, WorkerHandle], None],
                  max_workers: Optional[int] = None,
                  is_object_ready: Optional[Callable[[ObjectID], bool]] = None,
-                 nodes: Optional[NodeRegistry] = None):
+                 nodes: Optional[NodeRegistry] = None,
+                 locality_fn: Optional[Callable] = None):
         self.resources = resources
         # Per-node view; single-node clusters get a one-entry registry so
         # the dispatch path is uniform.
@@ -941,6 +1020,10 @@ class Scheduler:
         self.pool = pool
         self._dispatch_fn = dispatch_fn
         self._is_object_ready = is_object_ready or (lambda oid: False)
+        # spec -> {node_hex: bytes of the task's args already there}
+        # (reference: LocalityAwareLeasePolicy, lease_policy.cc:38-58).
+        # Only consulted once a second node registers.
+        self._locality_fn = locality_fn
         # TPU chip allocator: specific chip ids handed to workers so two
         # workers never share a chip (reference: tpu.py visible-chips
         # isolation; the resource COUNT alone can't prevent collisions).
@@ -1029,7 +1112,8 @@ class Scheduler:
             # and this path can't start workers on the chosen node.
             return False
         demand = spec.resources
-        node_id = self.nodes.acquire(demand, strategy)
+        node_id = self.nodes.acquire(demand, strategy,
+                                     self._locality_of(spec))
         if node_id is None:
             return False
         env_key = self._env_key_for(spec)
@@ -1127,6 +1211,23 @@ class Scheduler:
                     self._ready.append(spec)
                     self._cond.wait(timeout=0.05)
 
+    def _locality_of(self, spec) -> Optional[Dict[str, int]]:
+        """Bytes of `spec`'s args per holder node, or None when the
+        cluster has one node / no locality source (skips the directory
+        walk on the single-node hot path) or the strategy ignores
+        locality (affinity/label/SPREAD candidates never read it)."""
+        if self._locality_fn is None or not self.nodes._multi_node:
+            return None
+        strategy = getattr(spec, "scheduling_strategy", None)
+        if strategy is not None and not isinstance(strategy, str):
+            return None  # NodeAffinity / NodeLabel pin their own order
+        if strategy == "SPREAD":
+            return None
+        try:
+            return self._locality_fn(spec)
+        except Exception:
+            return None
+
     @staticmethod
     def _spec_key(spec) -> bytes:
         return (spec.actor_id.binary() if isinstance(spec, P.ActorSpec)
@@ -1179,7 +1280,8 @@ class Scheduler:
             self._dispatch_fn(spec, None)
             return True
         self._infeasible_since.pop(self._spec_key(spec), None)
-        node_id = self.nodes.acquire(demand, strategy)
+        node_id = self.nodes.acquire(demand, strategy,
+                                     self._locality_of(spec))
         if node_id is None:
             if getattr(strategy, "_fail_on_unavailable", False):
                 from ..exceptions import TaskUnschedulableError
