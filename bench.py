@@ -279,6 +279,12 @@ def bench_broadcast(extras):
         extras["broadcast_256mb_nodes"] = n_nodes
         extras["broadcast_gb_per_s"] = round(
             n_nodes * payload.nbytes / best_dt / 1e9, 2)
+        # Same-host transfers adopt the source arena slot zero-copy
+        # (cross-process pins), so virtual-node "broadcasts" move
+        # headers, not bytes — flagged here so the GB/s figures are
+        # read as what they are. Cross-HOST transfers still copy.
+        from ray_tpu._private.config import ray_config as _rc
+        extras["broadcast_zero_copy"] = bool(_rc.same_host_adoption)
 
         # Push-tree broadcast primitive (reference: push_manager.h) —
         # best of 3 (first tree run still faults pages).

@@ -89,6 +89,15 @@ class RayConfig:
         "pull_parallel_threshold_mb": 64.0,
         # Connections per large-object pull (1 = sequential).
         "pull_parallel_streams": 4,
+        # Same-host transfers of arena-backed objects ADOPT the source
+        # slot in place (zero-copy, cross-process pin through the shared
+        # arena header) instead of copying. Disable to force copies.
+        "same_host_adoption": True,
+        # Same-host copies above this serialize on a host-wide lock:
+        # concurrent first-touch of fresh tmpfs pages collapses ~10x on
+        # small hosts (kernel shmem allocation contention), so big
+        # copies run one at a time per host. 0 disables.
+        "transfer_serialize_threshold_mb": 64.0,
         # -- hybrid scheduling policy (reference: scheduler_spread_threshold,
         # hybrid_scheduling_policy.cc:48 — prefer the local/preferred node
         # while its critical-resource utilization stays below this, then

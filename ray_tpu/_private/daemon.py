@@ -435,8 +435,23 @@ class NodeDaemon:
     def _handle_pull(self, handle: WorkerHandle, payload: dict):
         req_id = payload["req_id"]
         try:
-            self.localize(payload["object_id"], payload["node"])
-            result = True
+            oid = payload["object_id"]
+            self.localize(oid, payload["node"])
+            # Adopted (zero-copy) objects live in ANOTHER node's arena;
+            # the worker's own store handle can't see them, so ship the
+            # mapping and let the worker adopt unpinned (our pin + the
+            # head's task-arg refs cover the read's lifetime). If the
+            # owner's arena file is gone (node died; our established
+            # mmap still works but NEW mmaps can't), materialize a real
+            # local copy instead of shipping a dead path.
+            import os as _os
+            ext = getattr(self.store, "export_adoption",
+                          lambda _o: None)(oid)
+            if ext is not None and (payload.get("materialize")
+                                    or not _os.path.exists(ext[0])):
+                self.store.materialize_external(oid)
+                ext = None
+            result = {"adopt": ext} if ext is not None else True
         except BaseException as e:  # noqa: BLE001
             result = {"__error__": e}
         try:

@@ -460,6 +460,14 @@ class ArenaObjectStore:
         self._spilled_bytes = 0
         self._spilled_count = 0
         self._restored_count = 0
+        # Same-host zero-copy adoption (reference analogue: same-node
+        # plasma clients share one store; here co-hosted NODES share
+        # pages). oid -> (foreign arena path, offset, size, pinned).
+        # The arena header lives in the shared mmap, so a pin taken
+        # through a foreign handle is visible to the owner process and
+        # blocks slot recycling until we release it.
+        self._external: Dict[ObjectID, tuple] = {}
+        self._foreign: Dict[str, Any] = {}  # path -> NativeStore handle
 
     # -- paths ------------------------------------------------------------
     def _spill_path(self, object_id: ObjectID) -> str:
@@ -600,13 +608,139 @@ class ArenaObjectStore:
                 return 0
             return self._spill_locked(used - target_bytes)
 
+    # -- same-host adoption ------------------------------------------------
+    def _foreign_handle(self, path: str):
+        from .. import _native
+        with self._lock:
+            h = self._foreign.get(path)
+            if h is None:
+                h = _native.NativeStore(path, create=False)
+                self._foreign[path] = h
+        return h
+
+    def adopt_native(self, object_id: ObjectID, path: str, offset: int,
+                     size: int, pin: bool = True) -> None:
+        """Adopt a same-host object IN PLACE: map the source node's
+        arena and reference its slot instead of copying (reference
+        analogue: same-node plasma clients mmap one store; fresh-page
+        allocation is also the measured wall on thin hosts). With
+        ``pin=True`` (daemons) a reader pin is taken through the shared
+        header so the owner can't recycle/spill the slot until free();
+        ``pin=False`` (pooled workers, which may be SIGKILLed and would
+        leak pins forever) relies on the daemon's pin + the head's
+        task-arg refs for lifetime."""
+        h = self._foreign_handle(path)
+        if pin:
+            off, sz = h.locate(object_id)  # pins + verifies presence
+            offset, size = off, sz
+        with self._lock:
+            if object_id in self._external:
+                if pin:
+                    h.release(object_id)  # already adopted: drop dup pin
+                return
+            self._external[object_id] = (path, offset, size, pin)
+
+    def _maybe_prune_foreign(self, path: str) -> None:
+        """Close a cached foreign handle once its owner is GONE (arena
+        file unlinked) and no adoption references it — an unlinked
+        multi-GB tmpfs arena stays resident for as long as anyone maps
+        it, so departed peers' handles must not live forever. Handles
+        of live peers stay cached (bounded by co-hosted node count);
+        closing them would no-op the release() of in-flight reader
+        pins."""
+        with self._lock:
+            if any(e[0] == path for e in self._external.values()):
+                return
+            if os.path.exists(path):
+                return
+            h = self._foreign.pop(path, None)
+        if h is not None:
+            try:
+                h.close(unlink=False)
+            except Exception:
+                pass
+
+    def materialize_external(self, object_id: ObjectID) -> bool:
+        """Copy an adopted object into the LOCAL arena (used when the
+        mapping can't be shipped to another process — e.g. the owner's
+        arena file was unlinked after its node died, so new mmaps of it
+        fail while our established one still works). Drops the external
+        entry on success."""
+        try:
+            src = self._external_view(object_id)
+        except KeyError:
+            return self._store.contains(object_id)
+        try:
+            size = len(src)
+            view = self.create(object_id, size)
+            try:
+                view[0:size] = src
+            except BaseException:
+                view.release()
+                self._abort_reserve(object_id)
+                raise
+            view.release()
+            self.seal(object_id)
+        except FileExistsError:
+            pass  # another thread materialized it first
+        finally:
+            src.release()
+        self.free_external_entry(object_id)
+        return True
+
+    def free_external_entry(self, object_id: ObjectID) -> None:
+        with self._lock:
+            ext = self._external.pop(object_id, None)
+        if ext is not None and ext[3]:
+            try:
+                self._foreign_handle(ext[0]).release(object_id)
+            except Exception:
+                pass
+
+    def export_adoption(self, object_id: ObjectID):
+        """(path, offset, size) when this store holds `object_id` as an
+        adopted external reference — what a co-hosted worker needs to
+        map it directly — else None."""
+        with self._lock:
+            ext = self._external.get(object_id)
+        return None if ext is None else (ext[0], ext[1], ext[2])
+
+    def _external_view(self, object_id: ObjectID):
+        """Pinned zero-copy view of an adopted object. Raises KeyError
+        when not adopted. Takes a per-read pin (released with the view)
+        on top of the adoption-lifetime pin so a concurrent free can't
+        recycle the slot under a live reader."""
+        with self._lock:
+            ext = self._external.get(object_id)
+        if ext is None:
+            raise KeyError(object_id)
+        path, offset, size, _pinned = ext
+        h = self._foreign_handle(path)
+        try:
+            off, sz = h.locate(object_id)  # per-read pin
+            view = h._view[off:off + sz]
+        except KeyError:
+            # Owner already dropped it (we were an unpinned adopter and
+            # lost the race): treat as not-present.
+            with self._lock:
+                self._external.pop(object_id, None)
+            raise
+        return memoryview(_ArenaPin(h, _native_key(object_id), view))
+
     # -- read path --------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
-        return (self._store.contains(object_id)
-                or os.path.exists(self._spill_path(object_id)))
+        if self._store.contains(object_id):
+            return True
+        with self._lock:
+            if object_id in self._external:
+                return True
+        return os.path.exists(self._spill_path(object_id))
 
     def _pinned_view(self, object_id: ObjectID):
-        view = self._store.get(object_id)  # pins
+        try:
+            view = self._store.get(object_id)  # pins
+        except KeyError:
+            return self._external_view(object_id)
         pin = _ArenaPin(self._store, _native_key(object_id), view)
         with self._lock:
             self._clock += 1
@@ -652,6 +786,16 @@ class ArenaObjectStore:
         with self._lock:
             self._meta.pop(object_id, None)
             self._access.pop(object_id, None)
+            ext = self._external.pop(object_id, None)
+        if ext is not None:
+            path, _off, _size, pinned = ext
+            if pinned:
+                try:
+                    self._foreign_handle(path).release(object_id)
+                except Exception:
+                    pass
+            self._maybe_prune_foreign(path)
+            return  # adopted objects hold no local bytes
         try:
             os.unlink(self._spill_path(object_id))
         except OSError:
@@ -679,7 +823,16 @@ class ArenaObjectStore:
                     self._pending_delete.append(oid)
 
     def release(self, object_id: ObjectID):
-        pass  # reader pins are view-lifetime (_ArenaPin)
+        # Reader pins are view-lifetime (_ArenaPin); an external entry
+        # dropped here covers cluster-wide frees relayed to workers
+        # (unpinned adopters just forget the mapping).
+        with self._lock:
+            ext = self._external.pop(object_id, None)
+        if ext is not None and ext[3]:
+            try:
+                self._foreign_handle(ext[0]).release(object_id)
+            except Exception:
+                pass
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -688,10 +841,32 @@ class ArenaObjectStore:
                     "spilled_bytes": self._spilled_bytes,
                     "spilled_count": self._spilled_count,
                     "restored_count": self._restored_count,
+                    "adopted_count": len(self._external),
                     "num_objects": self._store.num_objects()}
 
     def shutdown(self):
         import shutil
+        with self._lock:
+            external = dict(self._external)
+            foreign = dict(self._foreign)
+            self._foreign.clear()
+            self._external.clear()
+        # Release adoption pins FIRST — they live in the owner's shared
+        # header and would otherwise block that (still-alive) store from
+        # ever recycling the slots.
+        for oid, (path, _off, _size, pinned) in external.items():
+            if pinned:
+                h = foreign.get(path)
+                if h is not None:
+                    try:
+                        h.release(oid)
+                    except Exception:
+                        pass
+        for h in foreign.values():
+            try:
+                h.close(unlink=False)
+            except Exception:
+                pass
         self._store.close(unlink=self._owner)
         if self._owner:
             shutil.rmtree(self._spill_dir, ignore_errors=True)

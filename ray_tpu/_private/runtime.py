@@ -1500,8 +1500,20 @@ class Node:
                                           payload.get("timeout"))
                 self._reply(handle, req_id, locs)
             elif msg_type == P.PULL_OBJECT:
-                self._ensure_local(payload["object_id"], payload["node"])
-                self._reply(handle, req_id, True)
+                oid = payload["object_id"]
+                self._ensure_local(oid, payload["node"])
+                # Zero-copy adoption: ship the foreign-arena mapping so
+                # the head-attached worker adopts instead of copying.
+                # A dead owner's unlinked arena can't be re-mmapped by
+                # the worker — materialize a local copy instead.
+                ext = getattr(self.store, "export_adoption",
+                              lambda _o: None)(oid)
+                if ext is not None and (payload.get("materialize")
+                                        or not os.path.exists(ext[0])):
+                    self.store.materialize_external(oid)
+                    ext = None
+                self._reply(handle, req_id,
+                            {"adopt": ext} if ext is not None else True)
             elif msg_type == P.GCS_REQUEST:
                 result = self._gcs_op(payload["op"], payload["kwargs"])
                 self._reply(handle, req_id, result)

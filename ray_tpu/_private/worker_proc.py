@@ -201,8 +201,26 @@ class Worker:
                 # Object lives on another node: ask our node (daemon or
                 # head) to localize it before the shm read (reference:
                 # raylet-mediated plasma fetch via PullManager).
-                self.client._request(P.PULL_OBJECT,
-                                     {"object_id": oid, "node": loc[2]})
+                res = self.client._request(P.PULL_OBJECT,
+                                           {"object_id": oid,
+                                            "node": loc[2]})
+                adopt = (res.get("adopt")
+                         if isinstance(res, dict) else None)
+                if adopt is not None and hasattr(self.store,
+                                                 "adopt_native"):
+                    # The node holds it zero-copy in ANOTHER node's
+                    # arena: map the same slot (unpinned — the node's
+                    # pin + the owner's task-arg refs cover the read).
+                    try:
+                        self.store.adopt_native(oid, *adopt, pin=False)
+                    except Exception:
+                        # Mapping unusable in THIS process (owner's
+                        # arena vanished or unreadable): have the node
+                        # materialize a real local copy instead.
+                        self.client._request(P.PULL_OBJECT,
+                                             {"object_id": oid,
+                                              "node": loc[2],
+                                              "materialize": True})
             value = self.store.get(oid)
         elif kind == P.LOC_ERROR:
             raise serialization.deserialize(loc[1])
