@@ -110,6 +110,74 @@ def bench_core(extras):
         del ref
     put_gbps = iters * big.nbytes / (time.perf_counter() - t0) / 1e9
 
+    # -- multi-client rows (ray_perf.py:113-146,185-189 pattern: the
+    # reference's "multi client" is WORKERS/ACTORS acting as clients —
+    # nested puts and nested submission, not extra driver processes).
+    @ray_tpu.remote
+    def do_put_small():
+        for _ in range(100):
+            ray_tpu.put(0)
+
+    def _mc_put():
+        n_tasks = 10
+        t0 = time.perf_counter()
+        ray_tpu.get([do_put_small.remote() for _ in range(n_tasks)])
+        return n_tasks * 100 / (time.perf_counter() - t0)
+    mc_put_rate = best_of(2, _mc_put)
+
+    @ray_tpu.remote
+    def do_put_big():
+        for _ in range(4):
+            ray_tpu.put(np.zeros(10 * 1024 * 1024, dtype=np.int64))
+
+    def _mc_put_gb():
+        n_tasks = 4
+        t0 = time.perf_counter()
+        ray_tpu.get([do_put_big.remote() for _ in range(n_tasks)])
+        per_put = 10 * 1024 * 1024 * 8  # np.zeros(10Mi, int64).nbytes
+        return n_tasks * 4 * per_put / (time.perf_counter() - t0) / 1e9
+    mc_put_gbps = best_of(2, _mc_put_gb)
+
+    @ray_tpu.remote
+    class Submitter:
+        def batch(self, n):
+            ray_tpu.get([nop.remote() for _ in range(n)])
+            return n
+
+    subs = [Submitter.remote() for _ in range(4)]
+    ray_tpu.get([s.batch.remote(10) for s in subs])  # warm
+
+    def _mc_tasks():
+        per = 500
+        t0 = time.perf_counter()
+        ray_tpu.get([s.batch.remote(per) for s in subs])
+        return len(subs) * per / (time.perf_counter() - t0)
+    mc_tasks_rate = best_of(2, _mc_tasks)
+
+    # n:n actor calls async (ray_perf "n:n actor calls async"):
+    # m caller actors each async-calling a distinct callee actor.
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, callee):
+            self.callee = callee
+
+        def drive(self, n):
+            ray_tpu.get([self.callee.nop.remote() for _ in range(n)])
+            return n
+
+    callees = [NopActor.remote() for _ in range(4)]
+    callers = [Caller.remote(c) for c in callees]
+    ray_tpu.get([c.drive.remote(10) for c in callers])  # warm
+
+    def _nn_actor():
+        per = 500
+        t0 = time.perf_counter()
+        ray_tpu.get([c.drive.remote(per) for c in callers])
+        return len(callers) * per / (time.perf_counter() - t0)
+    nn_actor_rate = best_of(2, _nn_actor)
+    for a in subs + callers + callees:
+        ray_tpu.kill(a)
+
     # compiled DAG round trip (reference microbench: compiled DAG vs
     # task-per-call; dag/compiled_dag_node.py)
     @ray_tpu.remote
@@ -151,9 +219,17 @@ def bench_core(extras):
         "actor_calls_async_per_s": round(actor_async, 1),
         "put_get_per_s": round(put_get_rate, 1),
         "put_gb_per_s": round(put_gbps, 2),
+        "multi_client_put_per_s": round(mc_put_rate, 1),
+        "multi_client_put_gb_per_s": round(mc_put_gbps, 2),
+        "multi_client_tasks_async_per_s": round(mc_tasks_rate, 1),
+        "nn_actor_calls_async_per_s": round(nn_actor_rate, 1),
         "baseline_tasks_async_per_s": 8032.4,
         "baseline_actor_sync_per_s": 1985.8,
         "baseline_put_gb_per_s": 18.52,
+        "baseline_multi_client_put_per_s": 15931.8,
+        "baseline_multi_client_put_gb_per_s": 47.39,
+        "baseline_multi_client_tasks_async_per_s": 22745.2,
+        "baseline_nn_actor_calls_async_per_s": 26441.7,
     })
     return sync_rate
 
@@ -517,6 +593,45 @@ def bench_tpu(extras):
         if xla_flops:
             extras["mfu_xla_counted"] = round(xla_flops / dt / peak, 4)
             extras["xla_flops_per_step"] = xla_flops
+
+        # -- llama-class flagship MFU (VERDICT r3 #4): head_dim 128,
+        # GQA, S=2048, bf16 — the TPU-shaped headline. MFU accounting:
+        # `mfu` (6*N*D analytic) is the HEADLINE everywhere in this
+        # bench — it is the industry-standard comparable number;
+        # `mfu_xla_counted` divides XLA's own per-op FLOP count by the
+        # same wall time and runs lower because cost_analysis counts
+        # only compiled-graph FLOPs (no recompute credit, different
+        # attention accounting) — reported as a cross-check, not the
+        # claim. --
+        if _budget_left() > 240:
+            from ray_tpu.models import LlamaConfig, make_llama_train_step
+            lcfg = LlamaConfig.tpu_bench()
+            l_init, l_step = make_llama_train_step(lcfg)
+            l_state = l_init(jax.random.PRNGKey(1))
+            l_params = sum(int(np.prod(x.shape))
+                           for x in jax.tree.leaves(l_state["params"]))
+            LB, LS = 4, 2048
+            ltok = np.random.randint(0, lcfg.vocab_size, (LB, LS),
+                                     dtype=np.int32)
+            lbatch = (jnp.asarray(ltok),
+                      jnp.asarray(np.roll(ltok, -1, 1)))
+            l_state, lm = l_step(l_state, lbatch)  # compile
+            float(lm["loss"])
+            liters = 10
+            t0 = time.perf_counter()
+            for _ in range(liters):
+                l_state, lm = l_step(l_state, lbatch)
+            float(lm["loss"])  # value fetch = honest sync (see above)
+            ldt = (time.perf_counter() - t0) / liters
+            extras["llama_model"] = (
+                f"llama-{l_params/1e6:.0f}M-hd128-gqa4-bf16")
+            extras["llama_tokens_per_s"] = round(LB * LS / ldt, 1)
+            extras["llama_step_ms"] = round(ldt * 1e3, 2)
+            extras["llama_mfu"] = round(
+                6.0 * l_params * LB * LS / ldt / peak, 4)
+            extras["mfu_headline"] = "llama_mfu (6ND analytic)"
+        else:
+            extras["llama_mfu_skipped"] = "bench budget exhausted"
 
         # -- host<->device tunnel bandwidth (explains pipeline numbers
         # on this environment; a real TPU VM moves GB/s over PCIe) ----

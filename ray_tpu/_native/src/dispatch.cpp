@@ -363,7 +363,27 @@ int disp_send(void* h, uint64_t token, const void* data, uint64_t len) {
     std::lock_guard<std::mutex> lk(d->mu);
     auto it = d->conns.find(token);
     if (it == d->conns.end() || it->second->dead) return -1;
-    it->second->outq.push_back(std::move(ob));
+    ConnState* st = it->second.get();
+    if (st->outq.empty() && !st->want_write) {
+      // Inline non-blocking write: the uncontended common case skips
+      // the IO-thread handoff entirely (eventfd wake + two context
+      // switches per frame — the dominant per-task cost on small
+      // hosts). ONE send attempt only — d->mu is dispatcher-global,
+      // so looping a multi-MB frame to completion here would stall
+      // every other connection; a partial write enqueues the
+      // remainder for the IO thread. Ordering holds because the queue
+      // is empty and we hold d->mu, which flush_out's queue
+      // inspection also takes. Errors fall through to the enqueue
+      // path so conn death is handled in one place (flush_out ->
+      // conn_kill).
+      ssize_t w = send(st->fd, ob.data.data(), ob.data.size(),
+                       MSG_NOSIGNAL);
+      if (w >= 0) {
+        if ((size_t)w == ob.data.size()) return 0;
+        ob.off = (size_t)w;
+      }
+    }
+    st->outq.push_back(std::move(ob));
   }
   wake_io(d);
   return 0;

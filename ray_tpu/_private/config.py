@@ -98,6 +98,13 @@ class RayConfig:
         # small hosts (kernel shmem allocation contention), so big
         # copies run one at a time per host. 0 disables.
         "transfer_serialize_threshold_mb": 64.0,
+        # Tasks dispatched onto one (head-local) worker under a single
+        # resource grant before completions must drain it (reference:
+        # max_tasks_in_flight_per_worker=10, direct task transport
+        # pipelining). The worker executes them strictly in order, so
+        # the resource contract holds; the grant releases when the
+        # pipeline drains. TPU tasks never pipeline (chip exclusivity).
+        "max_tasks_in_flight_per_worker": 16,
         # -- hybrid scheduling policy (reference: scheduler_spread_threshold,
         # hybrid_scheduling_policy.cc:48 — prefer the local/preferred node
         # while its critical-resource utilization stays below this, then

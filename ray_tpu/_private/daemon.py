@@ -400,12 +400,12 @@ class NodeDaemon:
         # registers WHERE the object lives (ownership-based object
         # directory, ownership_based_object_directory.h) and skips its
         # local-store adoption.
-        if msg_type == P.TASK_DONE and payload.get("results"):
+        if msg_type == P.TASK_DONE:
+            payload = self._tag_done(payload)
+        elif msg_type == P.TASKS_DONE:
             payload = dict(payload)
-            oids = payload.get("return_oids") or [None] * len(
-                payload["results"])
-            payload["results"] = [self._tag_loc(loc, oid) for loc, oid
-                                  in zip(payload["results"], oids)]
+            payload["batch"] = [self._tag_done(d)
+                                for d in payload["batch"]]
         elif msg_type == P.GEN_ITEM:
             from .ids import object_id_for_return
             payload = dict(payload)
@@ -422,6 +422,18 @@ class NodeDaemon:
                 "frame": P.dump_message(msg_type, payload)})
         except Exception:
             pass
+
+    def _tag_done(self, done: dict) -> dict:
+        """Tag one TASK_DONE payload's result locations with this
+        node's id (shared by the single and batched completion
+        relays)."""
+        if not done.get("results"):
+            return done
+        done = dict(done)
+        oids = done.get("return_oids") or [None] * len(done["results"])
+        done["results"] = [self._tag_loc(loc, oid) for loc, oid
+                           in zip(done["results"], oids)]
+        return done
 
     def _tag_loc(self, loc, oid=None):
         if loc and loc[0] == P.LOC_SHM:

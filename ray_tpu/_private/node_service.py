@@ -38,9 +38,13 @@ class RemoteWorkerProxy:
         self.env: Dict[str, str] = {}
         self.proc = None
         self.send_lock = threading.Lock()  # unused; kept for handle parity
+        self.dispatch_lock = threading.Lock()  # fn-cache/send atomicity
         self.dedicated_actor = None
         self.running: Dict[bytes, P.TaskSpec] = {}
         self.fn_cache: set = set()
+        self.lease = None      # handle parity with WorkerHandle
+        self.inflight = 0
+        self.blocked = 0
         self.chip_ids: List[int] = []
         self.alive = True
         self.last_dispatch_ts = 0.0

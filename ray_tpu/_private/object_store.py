@@ -523,17 +523,33 @@ class ArenaObjectStore:
     def put_serialized(self, object_id: ObjectID,
                        sobj: serialization.SerializedObject) -> int:
         size = sobj.total_size
-        view = self.create(object_id, size)
-        try:
-            sobj.write_into(view)
-        except BaseException:
+        gate = self._put_gate(size)
+        with gate:
+            view = self.create(object_id, size)
+            try:
+                sobj.write_into(view)
+            except BaseException:
+                view.release()
+                self._abort_reserve(object_id)
+                raise
             view.release()
-            self._abort_reserve(object_id)
-            raise
-        view.release()
         self.seal(object_id)
         # creator pin retained: owner-driven free()/spill is the reclaim
         return size
+
+    @staticmethod
+    def _put_gate(size: int):
+        """Host-wide gate for big puts: concurrent first-touch of fresh
+        tmpfs pages from multiple processes collapses ~10x on small
+        hosts (same wall the transfer path gates — netcomm gates pulls,
+        this gates multi-client puts; the two never nest)."""
+        from .config import ray_config
+        thresh = float(ray_config.transfer_serialize_threshold_mb)
+        if thresh > 0 and size >= thresh * (1 << 20):
+            from .netcomm import _host_copy_gate
+            return _host_copy_gate
+        from .netcomm import _NullGate
+        return _NullGate()
 
     def put(self, object_id: ObjectID, value: Any) -> int:
         return self.put_serialized(object_id, serialization.serialize(value))

@@ -32,6 +32,7 @@ REPLY = "reply"                  # response to a worker-originated request
 # Message types: worker -> driver
 REF_COUNT = "ref_count"          # oneway borrow incref/decref from a worker
 TASK_DONE = "task_done"
+TASKS_DONE = "tasks_done"        # worker -> owner: coalesced TASK_DONE batch
 GEN_ITEM = "gen_item"            # one yielded item of a streaming generator
 ACTOR_READY = "actor_ready"
 OWNED_PUT = "owned_put"          # worker did put(); driver adopts ownership

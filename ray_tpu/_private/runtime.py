@@ -752,19 +752,6 @@ class Node:
             self._unpin_task_args(spec)
             return
         self._resolve_arg_locations(spec)
-        # Blob handling without rebuilding the dataclass (hot path):
-        # swap the field around the pickle — each spec is dispatched by
-        # exactly one thread at a time (retries are sequential).
-        blob_swap = False
-        if spec.fn_id in worker.fn_cache:
-            if spec.fn_blob is not None:
-                saved_blob, spec.fn_blob, blob_swap = spec.fn_blob, None, True
-        else:
-            if spec.fn_blob is None:
-                saved_blob, blob_swap = None, True
-                spec.fn_blob = self._fn_registry.get(spec.fn_id)
-            worker.fn_cache.add(spec.fn_id)
-        send_spec = spec
         worker.running[spec.task_id.binary()] = spec
         worker.last_dispatch_ts = time.time()
         self.gcs.record_task_event({
@@ -772,23 +759,47 @@ class Node:
             "state": "RUNNING", "worker_id": worker.worker_id.hex(),
             "ts": time.time()})
         try:
-            worker.send(P.EXEC_TASK, {"spec": send_spec})
-        except Exception:
+            # Blob handling without rebuilding the dataclass (hot path):
+            # swap the field around the pickle. dispatch_lock makes
+            # {cache check -> send} atomic per worker — with pipelining
+            # two threads can dispatch to one worker, and a
+            # blob-stripped frame must not overtake the blob-carrying
+            # one that populated the cache.
+            with worker.dispatch_lock:
+                blob_swap = False
+                if spec.fn_id in worker.fn_cache:
+                    if spec.fn_blob is not None:
+                        saved_blob, spec.fn_blob, blob_swap = \
+                            spec.fn_blob, None, True
+                else:
+                    if spec.fn_blob is None:
+                        saved_blob, blob_swap = None, True
+                        spec.fn_blob = self._fn_registry.get(spec.fn_id)
+                    worker.fn_cache.add(spec.fn_id)
+                try:
+                    worker.send(P.EXEC_TASK, {"spec": spec})
+                finally:
+                    if blob_swap:
+                        spec.fn_blob = saved_blob
+                        blob_swap = False
+        except Exception as send_err:
             # The atomic pop decides which failure path owns this spec:
             # the worker-death handler may race us here (send fails
             # BECAUSE the worker died), and exactly one of us must
-            # release + resubmit.
+            # release + resubmit. (Blob restore already ran in the
+            # inner finally.) Non-IO errors here are DISPATCHER bugs,
+            # not worker deaths — without the log they masquerade as
+            # crashed workers through the retry path.
+            if not isinstance(send_err, (OSError, EOFError, ValueError)):
+                import logging
+                logging.getLogger(__name__).warning(
+                    "dispatch of %s failed pre-send: %r",
+                    spec.name, send_err)
             owned = worker.running.pop(spec.task_id.binary(),
                                        None) is not None
-            if blob_swap:
-                spec.fn_blob = saved_blob
-                blob_swap = False
             if owned:
-                self.scheduler.release_task_resources(spec)
+                self.scheduler.note_task_finished(spec, worker)
                 self._handle_worker_failure_for_task(spec)
-        finally:
-            if blob_swap:
-                spec.fn_blob = saved_blob
 
     def _on_gen_item(self, handle: WorkerHandle, payload: dict):
         """One streamed item landed (reference: TaskManager handling of
@@ -932,8 +943,10 @@ class Node:
         spec = handle.running.pop(task_id.binary(), None)
         is_actor_task = payload.get("actor_id") is not None
         if spec is not None and not is_actor_task:
-            self.scheduler.release_task_resources(spec)
-            self._push_idle(handle)
+            if self.scheduler.note_task_finished(spec, handle):
+                # Lease drained (or per-task grant released): the worker
+                # is genuinely idle again.
+                self._push_idle(handle)
             # Keep the pipeline full without a dispatch-thread hop; the
             # notify still runs so the loop re-checks remaining slack.
             self.scheduler.dispatch_after_completion()
@@ -1477,6 +1490,10 @@ class Node:
                 self.gcs.objects.decref(payload["object_id"])
         elif msg_type == P.TASK_DONE:
             self._on_task_done(handle, payload)
+        elif msg_type == P.TASKS_DONE:
+            # Coalesced completions from a pipelined worker burst.
+            for done in payload["batch"]:
+                self._on_task_done(handle, done)
         elif msg_type == P.GEN_ITEM:
             self._on_gen_item(handle, payload)
         elif msg_type == P.ACTOR_READY:
@@ -1494,6 +1511,13 @@ class Node:
     def _handle_blocking_request(self, handle: WorkerHandle, msg_type: str,
                                  payload: dict):
         req_id = payload["req_id"]
+        # The worker's current task is (potentially) parked in a
+        # get/wait: exclude it from pipeline targeting while it waits —
+        # worker execution is sequential, so a task queued behind a
+        # blocked one would wait with it.
+        mark = msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS)
+        if mark:
+            handle.blocked += 1
         try:
             if msg_type == P.GET_LOCATIONS:
                 locs = self.get_locations(payload["object_ids"],
@@ -1524,6 +1548,9 @@ class Node:
                 self._reply(handle, req_id, (ready, not_ready))
         except BaseException as e:  # noqa: BLE001
             self._reply(handle, req_id, error=e)
+        finally:
+            if mark:
+                handle.blocked -= 1
 
     def _handle_quick_request(self, handle: WorkerHandle, msg_type: str,
                               payload: dict):
@@ -1548,7 +1575,14 @@ class Node:
                         oid, loc, size, nested_ids=nested)
                 self._reply(handle, req_id, True)
             elif msg_type == P.SUBMIT_TASK:
-                self.submit_task(payload["spec"])
+                spec = payload["spec"]
+                # Worker-submitted (nested) tasks never pipeline: a
+                # child queued behind its own blocked parent on a
+                # sequential worker is a permanent deadlock the
+                # driver-side queue recovers from and the pipeline
+                # cannot.
+                spec._nested = True
+                self.submit_task(spec)
                 self._reply(handle, req_id, True)
             elif msg_type == P.SUBMIT_ACTOR_TASK:
                 self.submit_actor_task(payload["spec"])

@@ -445,6 +445,24 @@ class WorkerHandle:
         self.recv_thread: Optional[threading.Thread] = None
         self.dedicated_actor = None   # ActorID when pinned to an actor
         self.running: Dict[bytes, P.TaskSpec] = {}  # in-flight tasks
+        # Serializes {fn-cache check -> EXEC_TASK send} per worker: with
+        # pipelined dispatch two threads can target one worker, and the
+        # blob-stripped second frame must not overtake the blob-carrying
+        # first (the worker would see an uncached fn id).
+        self.dispatch_lock = threading.Lock()
+        # Worker-lease pipelining (reference: the owner pushes up to
+        # max_tasks_in_flight_per_worker tasks onto one leased worker,
+        # direct_task_transport). The worker executes its queue
+        # strictly in order under ONE resource grant, so admission
+        # semantics hold; workers blocked in get/wait are excluded as
+        # pipeline targets, and TPU tasks never pipeline (chip
+        # exclusivity). lease = (node_id_hex, demand) while held.
+        self.lease: Optional[Tuple[str, Dict[str, float]]] = None
+        self.inflight = 0  # dispatched-not-finished count (sched._lock)
+        # >0 while the worker's task sits in a blocking get/wait on the
+        # head: pipelining behind a blocked task would park the new
+        # task indefinitely (worker execution is sequential).
+        self.blocked = 0
         self.fn_cache: Set[str] = set()
         self.chip_ids: List[int] = []  # TPU chips pinned to this worker
         self.alive = True
@@ -970,6 +988,27 @@ class WorkerPool:
         with self._lock:
             return len(self._idle.get(env_key, ()))
 
+    def pipeline_candidate(self, env_key: str, demand: Dict[str, float],
+                           cap: int) -> Optional[WorkerHandle]:
+        """Least-loaded BUSY worker whose lease matches (env + exact
+        resource shape) with pipeline headroom — the target for
+        dispatching another task under its existing grant (reference:
+        max_tasks_in_flight_per_worker pipelining in the owner's
+        direct task transport)."""
+        best = None
+        with self._lock:
+            for h in self.workers.values():
+                if (h.alive and h.dedicated_actor is None
+                        and h.env_key == env_key
+                        and h.lease is not None
+                        and 0 < h.inflight < cap
+                        and h.blocked == 0
+                        and h.lease[1] == demand
+                        and (best is None
+                             or h.inflight < best.inflight)):
+                    best = h
+        return best
+
     def shutdown(self):
         with self._lock:
             handles = list(self.workers.values())
@@ -1024,6 +1063,14 @@ class Scheduler:
         # (reference: LocalityAwareLeasePolicy, lease_policy.cc:38-58).
         # Only consulted once a second node registers.
         self._locality_fn = locality_fn
+        # Worker-lease pipelining (reference:
+        # max_tasks_in_flight_per_worker in the owner's direct task
+        # transport): spec keys running under a worker's lease rather
+        # than holding their own grant.
+        from .config import ray_config
+        self._leased: Set[bytes] = set()
+        self._max_inflight = max(
+            1, int(ray_config.max_tasks_in_flight_per_worker))
         # TPU chip allocator: specific chip ids handed to workers so two
         # workers never share a chip (reference: tpu.py visible-chips
         # isolation; the resource COUNT alone can't prevent collisions).
@@ -1115,17 +1162,71 @@ class Scheduler:
         node_id = self.nodes.acquire(demand, strategy,
                                      self._locality_of(spec))
         if node_id is None:
-            return False
+            return self._try_pipeline(spec, demand, strategy)
         env_key = self._env_key_for(spec)
         entry = self.nodes.get(node_id)
         if entry is not None and entry.daemon is not None:
             worker = entry.daemon.pop_idle(env_key)
+            local = False
         else:
             worker = self.pool.pop_idle(env_key)
+            local = True
         if worker is None:
             self.nodes.release(node_id, demand)
+            return self._try_pipeline(spec, demand, strategy)
+        key = self._spec_key(spec)
+        self._task_node[key] = node_id
+        if local and not isinstance(spec, P.ActorSpec):
+            self._begin_lease(worker, node_id, demand, key)
+        self._dispatch_fn(spec, worker)
+        return True
+
+    def _begin_lease(self, worker: WorkerHandle, node_id: str,
+                     demand: Dict[str, float], key: bytes):
+        """First task of a fresh worker lease: the grant acquired for it
+        becomes the worker's, shared by pipelined followers."""
+        with self._lock:
+            worker.lease = (node_id, dict(demand))
+            worker.inflight = 1
+            self._leased.add(key)
+
+    def _try_pipeline(self, spec, demand, strategy) -> bool:
+        """Dispatch onto a BUSY worker's existing lease (no new grant):
+        the async-burst fast path once every grant is held (reference:
+        max_tasks_in_flight_per_worker pipelining)."""
+        if (self._max_inflight <= 1
+                or isinstance(spec, P.ActorSpec)
+                or (strategy is not None
+                    and strategy != "DEFAULT")
+                or spec.placement_group_id is not None
+                or getattr(spec, "_nested", False)):
+            # _nested: worker-submitted children must queue driver-side
+            # — pipelined behind their own (about-to-block) parent on a
+            # sequential worker would deadlock permanently.
             return False
-        self._task_node[self._spec_key(spec)] = node_id
+        env_key = self._env_key_for(spec)
+        if env_key.startswith("tpu:"):
+            # Never pipeline chip tasks: two JAX computations sharing
+            # one pinned chip means HBM OOM / contended execution.
+            return False
+        worker = self.pool.pipeline_candidate(
+            env_key, demand, self._max_inflight)
+        if worker is None:
+            return False
+        key = self._spec_key(spec)
+        with self._lock:
+            # Re-verify EVERYTHING under the lock: between the scan and
+            # here the lease can drain and restart with a different
+            # shape/node, the pipeline can fill, or the worker's task
+            # can enter a blocking get.
+            if (worker.lease is None or not worker.alive
+                    or worker.blocked != 0
+                    or not (0 < worker.inflight < self._max_inflight)
+                    or worker.lease[1] != demand):
+                return False
+            worker.inflight += 1
+            self._task_node[key] = worker.lease[0]
+            self._leased.add(key)
         self._dispatch_fn(spec, worker)
         return True
 
@@ -1237,10 +1338,40 @@ class Scheduler:
         """Release a finished/failed task's resources on the node that
         granted them. Idempotent: the _task_node pop is the arbiter, so
         concurrent failure paths (send-failure branch vs worker-death
-        handler) can both call this without double-releasing."""
-        node_id = self._task_node.pop(self._spec_key(spec), None)
+        handler) can both call this without double-releasing. Tasks
+        running under a worker lease release nothing here — the lease
+        (released in note_task_finished / on_worker_removed) owns the
+        grant."""
+        key = self._spec_key(spec)
+        node_id = self._task_node.pop(key, None)
+        with self._lock:
+            if key in self._leased:
+                self._leased.discard(key)
+                return
         if node_id is not None:
             self.nodes.release(node_id, spec.resources)
+
+    def note_task_finished(self, spec, worker: WorkerHandle) -> bool:
+        """Accounting when a dispatched non-actor task leaves its
+        worker (completion or send-failure). Returns True when the
+        worker became idle and may rejoin the pool."""
+        key = self._spec_key(spec)
+        node_id = self._task_node.pop(key, None)
+        lease = None
+        with self._lock:
+            if key in self._leased:
+                self._leased.discard(key)
+                worker.inflight = max(0, worker.inflight - 1)
+                if worker.inflight > 0:
+                    return False  # pipeline still draining
+                lease, worker.lease = worker.lease, None
+            else:
+                # Per-task grant (daemon-node workers).
+                if node_id is not None:
+                    lease = (node_id, spec.resources)
+        if lease is not None:
+            self.nodes.release(lease[0], lease[1])
+        return True
 
     def node_of_task(self, spec) -> Optional[str]:
         return self._task_node.get(self._spec_key(spec))
@@ -1291,7 +1422,7 @@ class Scheduler:
                     f"now and _fail_on_unavailable=True")
                 self._dispatch_fn(spec, None)
                 return True
-            return False
+            return self._try_pipeline(spec, demand, strategy)
         env_key = self._env_key_for(spec)
         entry = self.nodes.get(node_id)
         if entry is not None and entry.daemon is not None:
@@ -1348,15 +1479,21 @@ class Scheduler:
                 worker = None  # boot failure: release + retry later
         if worker is None:
             self.nodes.release(node_id, demand)
-            return False
-        self._task_node[self._spec_key(spec)] = node_id
+            return self._try_pipeline(spec, demand, strategy)
+        key = self._spec_key(spec)
+        self._task_node[key] = node_id
         if strategy == "SPREAD":
             self.nodes.note_spread_grant(node_id)
+        if not is_actor_creation:
+            self._begin_lease(worker, node_id, demand, key)
         self._dispatch_fn(spec, worker)
         return True
 
     def on_worker_removed(self, handle: WorkerHandle):
-        """A worker died; open a cap slot / return its chips."""
+        """A worker died; open a cap slot / return its chips, and
+        release its lease grant ONCE (the per-spec failure path then
+        skips leased specs)."""
+        lease = None
         if not getattr(handle, "is_remote", False):
             with self._lock:
                 if handle.dedicated_actor is None and handle.env_key == "":
@@ -1364,6 +1501,10 @@ class Scheduler:
                 if handle.chip_ids:
                     self._free_chips.extend(handle.chip_ids)
                     handle.chip_ids = []
+                lease, handle.lease = handle.lease, None
+                handle.inflight = 0
+        if lease is not None:
+            self.nodes.release(lease[0], lease[1])
         self.notify_worker_free()
 
     def _maybe_start_worker(self, env_key: str, spec,

@@ -157,10 +157,35 @@ class Worker:
         self._req_lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
         self._fn_cache: Dict[str, Any] = {}
+        # fn_id -> cloudpickled blob, stashed by the (single-threaded)
+        # recv loop BEFORE the task is handed to the executor pool:
+        # pipelined tasks arrive blob-stripped and may reach _load_fn
+        # before the blob-carrying task does.
+        self._fn_blobs: Dict[str, bytes] = {}
+        # ONE thread: plain tasks execute strictly sequentially, so
+        # pipelined tasks queued on this worker (scheduler worker-lease
+        # pipelining) respect the resource contract — a queued task
+        # must not run while the lease's current task runs (reference:
+        # the worker executes its scheduling queue in order).
         self._task_pool = ThreadPoolExecutor(
-            max_workers=64, thread_name_prefix="task")
+            max_workers=1, thread_name_prefix="task")
         self._running: Dict[bytes, int] = {}  # task_id bytes -> thread ident
         self._running_lock = threading.Lock()
+        # Cancellations for tasks queued in this worker but not yet
+        # started (pipelined dispatch): checked at _execute entry.
+        self._cancelled_pending: set = set()
+        # tid -> actor_id for tasks received but not yet started, so a
+        # queued-task cancel reports with the right identity and a
+        # cancel racing a completed task is ignored (no leak, no
+        # spurious TASK_DONE).
+        self._queued_meta: Dict[bytes, Any] = {}
+        # TASK_DONE group-commit coalescing: completions that land while
+        # another thread is mid-send ride along in one TASKS_DONE frame
+        # (fewer owner wakeups/syscalls per task under pipelined
+        # bursts); nothing ever WAITS to be sent.
+        self._done_lock = threading.Lock()
+        self._done_buf: list = []
+        self._done_flushing = False
         # Actor state
         self._actor_instance = None
         self._actor_spec: Optional[P.ActorSpec] = None
@@ -240,9 +265,12 @@ class Worker:
         fn = self._fn_cache.get(spec.fn_id)
         if fn is None:
             if spec.fn_blob is None:
+                spec.fn_blob = self._fn_blobs.get(spec.fn_id)
+            if spec.fn_blob is None:
                 raise RuntimeError(f"function {spec.fn_id} not cached on worker")
             fn = cloudpickle.loads(spec.fn_blob)
             self._fn_cache[spec.fn_id] = fn
+            self._fn_blobs.pop(spec.fn_id, None)
         return fn
 
     def _package_returns(self, spec: P.TaskSpec, result: Any):
@@ -291,9 +319,47 @@ class Worker:
             index += 1
         return index
 
+    def _emit_done(self, payload: dict):
+        """Ship one task's completion with group-commit coalescing:
+        every completion flushes immediately UNLESS another thread is
+        mid-flush, in which case it parks in the buffer and the flusher
+        drains it in the same TASKS_DONE frame. Batching emerges only
+        under genuine completion bursts — a lone task (or a fast task
+        next to slow siblings) never waits."""
+        with self._done_lock:
+            self._done_buf.append(payload)
+            if self._done_flushing:
+                return
+            self._done_flushing = True
+        while True:
+            with self._done_lock:
+                buf, self._done_buf = self._done_buf, []
+                if not buf:
+                    self._done_flushing = False
+                    return
+            try:
+                if len(buf) == 1:
+                    self.send(P.TASK_DONE, buf[0])
+                else:
+                    self.send(P.TASKS_DONE, {"batch": buf})
+            except BaseException:
+                # Re-stash and clear the flag so a send failure (dying
+                # pipe, unpicklable payload) can't wedge the flusher
+                # forever with completions silently parking in the
+                # buffer.
+                with self._done_lock:
+                    self._done_buf = buf + self._done_buf
+                    self._done_flushing = False
+                raise
+
     def _execute(self, spec: P.TaskSpec):
         tid = spec.task_id.binary()
         with self._running_lock:
+            self._queued_meta.pop(tid, None)
+            if tid in self._cancelled_pending:
+                # Cancelled while queued; _cancel already reported it.
+                self._cancelled_pending.discard(tid)
+                return
             self._running[tid] = threading.get_ident()
         ctx_token = _task_ctx_var.set(spec)
         trace_token = None
@@ -343,12 +409,12 @@ class Worker:
                     result = asyncio.run(result)
             if spec.streaming:
                 n_items = self._stream_generator(spec, result)
-                self.send(P.TASK_DONE, {
+                self._emit_done({
                     "task_id": spec.task_id, "results": [], "error": None,
                     "streamed": n_items, "actor_id": spec.actor_id})
             else:
                 locs, nested = self._package_returns(spec, result)
-                self.send(P.TASK_DONE, {
+                self._emit_done({
                     "task_id": spec.task_id, "results": locs,
                     "error": None, "nested": nested,
                     "actor_id": spec.actor_id,
@@ -375,7 +441,7 @@ class Worker:
             except Exception:
                 blob = serialization.dumps(
                     TaskError(RuntimeError(repr(e)), task_repr=spec.name))
-            self.send(P.TASK_DONE, {
+            self._emit_done({
                 "task_id": spec.task_id, "results": None, "error": blob,
                 "actor_id": spec.actor_id})
         finally:
@@ -455,12 +521,28 @@ class Worker:
         """Raise TaskCancelledError inside the executing thread (the
         reference interrupts running tasks similarly via
         execute_task_with_cancellation_handler, _raylet.pyx:2077)."""
+        tid = task_id.binary()
         with self._running_lock:
-            ident = self._running.get(task_id.binary())
+            ident = self._running.get(tid)
+            queued = ident is None and tid in self._queued_meta
+            if queued:
+                # Dispatched but not started (queued behind the lease's
+                # current task): mark it so _execute skips it, and
+                # report the cancellation NOW — the caller must not
+                # wait for the queue to drain to see it.
+                self._cancelled_pending.add(tid)
+                actor_id = self._queued_meta.pop(tid)
         if ident is not None:
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_long(ident),
                 ctypes.py_object(TaskCancelledError))
+        elif queued:
+            self._emit_done({
+                "task_id": task_id, "results": None,
+                "error": serialization.dumps(
+                    TaskCancelledError(task_id.hex())),
+                "actor_id": actor_id})
+        # else: already finished — the real completion won the race.
 
     # -- main loop ---------------------------------------------------------
     def run(self):
@@ -472,6 +554,12 @@ class Worker:
             msg_type, payload = cloudpickle.loads(data)
             if msg_type == P.EXEC_TASK:
                 spec: P.TaskSpec = payload["spec"]
+                if (spec.fn_blob is not None
+                        and spec.fn_id not in self._fn_cache):
+                    self._fn_blobs[spec.fn_id] = spec.fn_blob
+                with self._running_lock:
+                    self._queued_meta[spec.task_id.binary()] = \
+                        spec.actor_id
                 if spec.actor_id is not None and self._actor_executor is not None:
                     self._executor_for(spec).submit(self._execute, spec)
                 else:

@@ -53,6 +53,17 @@ class LlamaConfig:
         return cls(vocab_size=512, d_model=64, n_heads=4, n_kv_heads=2,
                    n_layers=2, d_ff=96, max_seq_len=128)
 
+    @classmethod
+    def tpu_bench(cls) -> "LlamaConfig":
+        """Single-chip MFU-bench shape: head_dim 128 (MXU-native lane
+        width — GPT-2's head_dim 64 half-fills the systolic array, the
+        documented MFU sink in docs/MFU_ROOFLINE.md), 4:1 GQA, S=2048,
+        ~250M params so optimizer+activations fit v5e HBM without
+        remat."""
+        return cls(vocab_size=32000, d_model=1024, n_heads=8,
+                   n_kv_heads=2, n_layers=16, d_ff=2816,
+                   max_seq_len=2048, remat=False)
+
 
 def _layer_init(key, cfg: LlamaConfig) -> Dict:
     kq, kkv, ko, kg, ku, kd = jax.random.split(key, 6)
