@@ -139,10 +139,17 @@ void drain_frames(Dispatcher* d, ConnState* st) {
 }
 
 void conn_kill(Dispatcher* d, ConnState* st) {
-  if (st->dead) return;
-  st->dead = true;
-  epoll_ctl(d->epfd, EPOLL_CTL_DEL, st->fd, nullptr);
-  close(st->fd);
+  // dead + close under d->mu: disp_send's inline fast path checks
+  // `dead` and send()s while holding d->mu, so the fd must not be
+  // closed (and potentially reused by another open()) between that
+  // check and the write.
+  {
+    std::lock_guard<std::mutex> lk(d->mu);
+    if (st->dead) return;
+    st->dead = true;
+    epoll_ctl(d->epfd, EPOLL_CTL_DEL, st->fd, nullptr);
+    close(st->fd);
+  }
   Frame f;
   f.token = st->token;
   f.eof = true;

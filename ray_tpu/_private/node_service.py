@@ -45,6 +45,7 @@ class RemoteWorkerProxy:
         self.lease = None      # handle parity with WorkerHandle
         self.inflight = 0
         self.blocked = 0
+        self.lease_released = False
         self.chip_ids: List[int] = []
         self.alive = True
         self.last_dispatch_ts = 0.0

@@ -33,6 +33,8 @@ REPLY = "reply"                  # response to a worker-originated request
 REF_COUNT = "ref_count"          # oneway borrow incref/decref from a worker
 TASK_DONE = "task_done"
 TASKS_DONE = "tasks_done"        # worker -> owner: coalesced TASK_DONE batch
+RECALL_QUEUED = "recall_queued"  # owner -> worker: evacuate queued tasks
+TASKS_RECALLED = "tasks_recalled"  # worker -> owner: tids it gave back
 GEN_ITEM = "gen_item"            # one yielded item of a streaming generator
 ACTOR_READY = "actor_ready"
 OWNED_PUT = "owned_put"          # worker did put(); driver adopts ownership

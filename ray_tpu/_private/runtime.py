@@ -938,6 +938,22 @@ class Node:
         else:
             self.pool.push_idle(handle)
 
+    def _on_tasks_recalled(self, handle: WorkerHandle, tids: list):
+        """A blocked worker evacuated queued pipelined tasks: return
+        their lease slots and put them back on the scheduler queue so
+        any other worker (or this one, once unblocked) can take them."""
+        for tid in tids:
+            spec = handle.running.pop(tid, None)
+            if spec is None:
+                continue  # completed/cancelled concurrently
+            if self.scheduler.note_task_finished(spec, handle):
+                # Rare but real: the blocked head completed before the
+                # recall landed, so this recall drained the lease — the
+                # worker must rejoin the idle pool or it leaks.
+                self._push_idle(handle)
+            self.scheduler.submit(spec, self._unresolved_deps(spec))
+        self.scheduler.notify_worker_free()
+
     def _on_task_done(self, handle: WorkerHandle, payload: dict):
         task_id: TaskID = payload["task_id"]
         spec = handle.running.pop(task_id.binary(), None)
@@ -1494,6 +1510,8 @@ class Node:
             # Coalesced completions from a pipelined worker burst.
             for done in payload["batch"]:
                 self._on_task_done(handle, done)
+        elif msg_type == P.TASKS_RECALLED:
+            self._on_tasks_recalled(handle, payload["task_ids"])
         elif msg_type == P.GEN_ITEM:
             self._on_gen_item(handle, payload)
         elif msg_type == P.ACTOR_READY:
@@ -1517,7 +1535,18 @@ class Node:
         # blocked one would wait with it.
         mark = msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS)
         if mark:
-            handle.blocked += 1
+            # Blocked in get/wait: hand the lease's grant back so
+            # dependency tasks can schedule (reference: blocked
+            # workers release their CPU), and evacuate any tasks
+            # queued behind the blocked one — they may BE its
+            # dependencies (sequential executor). Counter managed
+            # under the scheduler lock (pipeline-dispatch race).
+            if (self.scheduler.note_worker_blocked(handle)
+                    and getattr(handle, "inflight", 0) > 1):
+                try:
+                    handle.send(P.RECALL_QUEUED, {})
+                except Exception:
+                    pass
         try:
             if msg_type == P.GET_LOCATIONS:
                 locs = self.get_locations(payload["object_ids"],
@@ -1550,7 +1579,7 @@ class Node:
             self._reply(handle, req_id, error=e)
         finally:
             if mark:
-                handle.blocked -= 1
+                self.scheduler.note_worker_unblocked(handle)
 
     def _handle_quick_request(self, handle: WorkerHandle, msg_type: str,
                               payload: dict):

@@ -459,6 +459,9 @@ class WorkerHandle:
         # exclusivity). lease = (node_id_hex, demand) while held.
         self.lease: Optional[Tuple[str, Dict[str, float]]] = None
         self.inflight = 0  # dispatched-not-finished count (sched._lock)
+        # True while the lease's grant has been returned to the pool
+        # because the current task is blocked in get/wait.
+        self.lease_released = False
         # >0 while the worker's task sits in a blocking get/wait on the
         # head: pipelining behind a blocked task would park the new
         # task indefinitely (worker execution is sequential).
@@ -988,6 +991,16 @@ class WorkerPool:
         with self._lock:
             return len(self._idle.get(env_key, ()))
 
+    def count_blocked(self, env_key: str = "") -> int:
+        """Alive pooled workers whose current task is parked in a
+        blocking get/wait (under the pool lock — the workers dict is
+        mutated concurrently by worker starts)."""
+        with self._lock:
+            return sum(1 for h in self.workers.values()
+                       if h.alive and getattr(h, "blocked", 0) > 0
+                       and h.dedicated_actor is None
+                       and h.env_key == env_key)
+
     def pipeline_candidate(self, env_key: str, demand: Dict[str, float],
                            cap: int) -> Optional[WorkerHandle]:
         """Least-loaded BUSY worker whose lease matches (env + exact
@@ -1001,6 +1014,7 @@ class WorkerPool:
                 if (h.alive and h.dedicated_actor is None
                         and h.env_key == env_key
                         and h.lease is not None
+                        and not getattr(h, "lease_released", False)
                         and 0 < h.inflight < cap
                         and h.blocked == 0
                         and h.lease[1] == demand
@@ -1221,6 +1235,7 @@ class Scheduler:
             # can enter a blocking get.
             if (worker.lease is None or not worker.alive
                     or worker.blocked != 0
+                    or getattr(worker, "lease_released", False)
                     or not (0 < worker.inflight < self._max_inflight)
                     or worker.lease[1] != demand):
                 return False
@@ -1228,6 +1243,17 @@ class Scheduler:
             self._task_node[key] = worker.lease[0]
             self._leased.add(key)
         self._dispatch_fn(spec, worker)
+        with self._lock:
+            raced_block = worker.blocked > 0
+        if raced_block:
+            # The worker blocked between our re-check and the send: its
+            # one-shot recall may have fired before our frame arrived,
+            # leaving this task parked behind the blocked head. A
+            # second recall is idempotent and cheap.
+            try:
+                worker.send(P.RECALL_QUEUED, {})
+            except Exception:
+                pass
         return True
 
     def dispatch_after_completion(self) -> bool:
@@ -1365,6 +1391,12 @@ class Scheduler:
                 if worker.inflight > 0:
                     return False  # pipeline still draining
                 lease, worker.lease = worker.lease, None
+                if getattr(worker, "lease_released", False):
+                    # Grant already returned while the task sat blocked
+                    # in get/wait (note_worker_blocked) and was never
+                    # reacquired: nothing to release now.
+                    worker.lease_released = False
+                    lease = None
             else:
                 # Per-task grant (daemon-node workers).
                 if node_id is not None:
@@ -1372,6 +1404,46 @@ class Scheduler:
         if lease is not None:
             self.nodes.release(lease[0], lease[1])
         return True
+
+    def note_worker_blocked(self, worker: WorkerHandle) -> bool:
+        """The worker's current task parked in a blocking get/wait:
+        bump the blocked counter (under the SAME lock _try_pipeline's
+        re-check reads it under, closing the dispatch race) and return
+        its lease grant to the pool so dependency tasks can schedule
+        (reference: a worker blocked in ray.get releases its CPU to
+        the raylet — the classic nested-task deadlock mitigation).
+        Returns True on the 0->1 transition."""
+        with self._lock:
+            worker.blocked += 1
+            first = worker.blocked == 1
+            if (worker.lease is None
+                    or getattr(worker, "lease_released", False)):
+                return first
+            worker.lease_released = True
+            lease = worker.lease
+        self.nodes.release(lease[0], lease[1])
+        self.notify_worker_free()
+        return first
+
+    def note_worker_unblocked(self, worker: WorkerHandle):
+        """Borrow-back on unblock: reacquire the lease grant if it is
+        available; if not, the task simply finishes oversubscribed
+        (reference CPU-borrowing semantics) and the drain path skips
+        the final release."""
+        with self._lock:
+            worker.blocked -= 1
+            if (worker.blocked > 0 or worker.lease is None
+                    or not getattr(worker, "lease_released", False)):
+                return
+            lease = worker.lease
+        entry = self.nodes.get(lease[0])
+        if entry is not None and entry.rm.try_acquire(lease[1]):
+            with self._lock:
+                if worker.lease is not None:
+                    worker.lease_released = False
+                    return
+            # Lease drained while we reacquired: give it back.
+            self.nodes.release(lease[0], lease[1])
 
     def node_of_task(self, spec) -> Optional[str]:
         return self._task_node.get(self._spec_key(spec))
@@ -1503,6 +1575,9 @@ class Scheduler:
                     handle.chip_ids = []
                 lease, handle.lease = handle.lease, None
                 handle.inflight = 0
+                if getattr(handle, "lease_released", False):
+                    handle.lease_released = False
+                    lease = None  # grant already back in the pool
         if lease is not None:
             self.nodes.release(lease[0], lease[1])
         self.notify_worker_free()
@@ -1510,12 +1585,18 @@ class Scheduler:
     def _maybe_start_worker(self, env_key: str, spec,
                             dedicated: bool = False
                             ) -> Optional[WorkerHandle]:
+        # Workers parked in a blocking get/wait don't consume CPU; the
+        # pool may grow past the cap by their count so their DEPENDENCY
+        # tasks can run (reference: the worker pool starts replacement
+        # workers for blocked ones — why Ray shows more worker
+        # processes than cores).
+        blocked_extra = self.pool.count_blocked(env_key)
         with self._lock:
             # Actor workers are dedicated processes and bypass the pool cap
             # (the reference starts a fresh worker per actor too); only
             # generic pooled workers count against it.
             if not dedicated and env_key == "":
-                if self._started_workers >= self._max_workers:
+                if self._started_workers >= self._max_workers + blocked_extra:
                     return None
                 self._started_workers += 1
         extra_env = {}

@@ -174,10 +174,15 @@ class Worker:
         # Cancellations for tasks queued in this worker but not yet
         # started (pipelined dispatch): checked at _execute entry.
         self._cancelled_pending: set = set()
-        # tid -> actor_id for tasks received but not yet started, so a
-        # queued-task cancel reports with the right identity and a
-        # cancel racing a completed task is ignored (no leak, no
-        # spurious TASK_DONE).
+        # tid -> executor Future for plain tasks not yet started —
+        # recallable (Future.cancel) when the owner evacuates a blocked
+        # worker's queue.
+        self._queued_futures: Dict[bytes, Future] = {}
+        # tid -> (actor_id, fn_id) for tasks received but not yet
+        # started, so a queued-task cancel reports with the right
+        # identity, a cancel racing a completed task is ignored (no
+        # leak, no spurious TASK_DONE), and a cancelled task's stashed
+        # fn blob can be dropped when no other queued task needs it.
         self._queued_meta: Dict[bytes, Any] = {}
         # TASK_DONE group-commit coalescing: completions that land while
         # another thread is mid-send ride along in one TASKS_DONE frame
@@ -352,9 +357,27 @@ class Worker:
                     self._done_flushing = False
                 raise
 
+    def _recall_queued(self):
+        """Evacuate not-yet-started plain tasks back to the owner (the
+        owner's worker blocked in a get/wait; tasks queued behind it on
+        this strictly-sequential executor could be its own
+        dependencies — a permanent deadlock unless they reschedule
+        elsewhere). Future.cancel() is the arbiter: it fails for the
+        running task and races with task start safely."""
+        recalled = []
+        with self._running_lock:
+            for tid, fut in list(self._queued_futures.items()):
+                if fut.cancel():
+                    self._queued_futures.pop(tid, None)
+                    self._queued_meta.pop(tid, None)
+                    recalled.append(tid)
+        if recalled:
+            self.send(P.TASKS_RECALLED, {"task_ids": recalled})
+
     def _execute(self, spec: P.TaskSpec):
         tid = spec.task_id.binary()
         with self._running_lock:
+            self._queued_futures.pop(tid, None)
             self._queued_meta.pop(tid, None)
             if tid in self._cancelled_pending:
                 # Cancelled while queued; _cancel already reported it.
@@ -527,11 +550,17 @@ class Worker:
             queued = ident is None and tid in self._queued_meta
             if queued:
                 # Dispatched but not started (queued behind the lease's
-                # current task): mark it so _execute skips it, and
-                # report the cancellation NOW — the caller must not
-                # wait for the queue to drain to see it.
-                self._cancelled_pending.add(tid)
-                actor_id = self._queued_meta.pop(tid)
+                # current task): report the cancellation NOW — the
+                # caller must not wait for the queue to drain to see
+                # it. (The stashed fn blob stays: the owner's fn-cache
+                # bookkeeping already marks this worker as holding the
+                # fn, so later blob-stripped dispatches still need it.)
+                actor_id, _fn_id = self._queued_meta.pop(tid)
+                fut = self._queued_futures.pop(tid, None)
+                if fut is None or not fut.cancel():
+                    # About to start (or untracked): _execute consumes
+                    # this marker and skips silently.
+                    self._cancelled_pending.add(tid)
         if ident is not None:
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_long(ident),
@@ -559,11 +588,15 @@ class Worker:
                     self._fn_blobs[spec.fn_id] = spec.fn_blob
                 with self._running_lock:
                     self._queued_meta[spec.task_id.binary()] = \
-                        spec.actor_id
+                        (spec.actor_id, spec.fn_id)
                 if spec.actor_id is not None and self._actor_executor is not None:
                     self._executor_for(spec).submit(self._execute, spec)
                 else:
-                    self._task_pool.submit(self._execute, spec)
+                    fut = self._task_pool.submit(self._execute, spec)
+                    with self._running_lock:
+                        self._queued_futures[spec.task_id.binary()] = fut
+            elif msg_type == P.RECALL_QUEUED:
+                self._recall_queued()
             elif msg_type == P.REPLY:
                 fut = self._pending.pop(payload["req_id"], None)
                 if fut is not None:

@@ -33,6 +33,15 @@ class DataContext:
     # Preserve submission order when streaming (determinism); False lets
     # bundles be yielded as they complete.
     preserve_order: bool = True
+    # Resource-aware backpressure (reference: resource_manager.py +
+    # backpressure_policy/): above this object-store usage fraction the
+    # streaming executor stops topping up the in-flight window (keeps
+    # >=1 chain so the pipeline still drains) until consumers free
+    # blocks — a fat intermediate stage throttles instead of spilling
+    # the whole store.
+    backpressure_store_fraction: float = 0.8
+    # Observability: how many top-up rounds the throttle held back.
+    backpressure_throttle_count: int = 0
 
     _lock: ClassVar[threading.Lock] = threading.Lock()
     _current: ClassVar[Optional["DataContext"]] = None

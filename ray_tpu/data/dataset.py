@@ -44,8 +44,14 @@ class ActorPoolStrategy:
     max_size: Optional[int] = None
 
     @property
-    def pool_size(self) -> int:
+    def pool_min(self) -> int:
         return int(self.size or self.min_size or 2)
+
+    @property
+    def pool_max(self) -> int:
+        # A fixed `size` pins the pool; (min,max) enables autoscaling
+        # (reference: AutoscalingActorPool honors max_size).
+        return int(self.size or self.max_size or self.pool_min)
 
 
 @dataclass
@@ -242,15 +248,21 @@ def _block_unique(blk: B.Block, on: str):
 
 
 class _MapBatchesActorPool:
-    """Actor-pool compute for map_batches (reference:
-    ActorPoolMapOperator, operators/actor_pool_map_operator.py:34).
+    """AUTOSCALING actor-pool compute for map_batches (reference:
+    AutoscalingActorPool inside ActorPoolMapOperator,
+    operators/actor_pool_map_operator.py:34,446,530 — queue-driven
+    scale-up between min and max, scale-down when drained).
 
     Supports bulk `map` (plan execution) and per-bundle `submit`
-    (streaming execution: round-robin dispatch, one in-flight chain per
-    call — the streaming executor caps total in-flight)."""
+    (streaming execution: least-loaded dispatch; completions observed
+    at submit time drive the scaling decision)."""
 
-    def __init__(self, fn_cls, pool_size, opts, ctor_args, ctor_kwargs):
-        self._rr = 0
+    # Outstanding-per-actor above this spawns another actor (reference:
+    # scale up while queued-per-actor exceeds its threshold).
+    _SCALE_UP_QUEUE = 2
+
+    def __init__(self, fn_cls, min_size, max_size, opts, ctor_args,
+                 ctor_kwargs):
         @api.remote
         class _BatchMapper:
             def __init__(self, blob):
@@ -280,19 +292,81 @@ class _MapBatchesActorPool:
         # constructor and retries in-flight applies; transient
         # exceptions (e.g. a compile-service hiccup) retry via
         # retry_exceptions below. User opts can override.
-        opts = {"max_restarts": 3, "max_task_retries": 2, **opts}
-        self.actors = [
-            _BatchMapper.options(**opts).remote(blob)
-            for _ in range(pool_size)
-        ]
+        self._opts = {"max_restarts": 3, "max_task_retries": 2, **opts}
+        self._cls = _BatchMapper
+        self._blob = blob
+        self._min = max(1, int(min_size))
+        self._max = max(self._min, int(max_size))
+        self.actors = [self._spawn() for _ in range(self._min)]
+        # actor index -> WEAK refs of outstanding outputs (pruned at
+        # submit). Weak, not strong: the pool must not pin completed
+        # blocks in the store between submits — downstream (the
+        # streaming window / consumer prefetch) owns their lifetime,
+        # matching the submitter-side weakref design note below.
+        self._outstanding: Dict[int, list] = {
+            i: [] for i in range(self._min)}
         self._call_opts = {"retry_exceptions": True, "max_task_retries": 2}
+
+    def _spawn(self):
+        return self._cls.options(**self._opts).remote(self._blob)
+
+    def _prune(self):
+        """Drop dead and completed entries from the per-actor
+        outstanding lists (ONE zero-timeout wait over the union of
+        still-live refs — the pool's completion signal)."""
+        live = {}
+        for i, wrefs in self._outstanding.items():
+            live[i] = [(w, r) for w in wrefs if (r := w()) is not None]
+        all_refs = [r for pairs in live.values() for _w, r in pairs]
+        if not all_refs:
+            self._outstanding = {i: [] for i in self._outstanding}
+            return
+        _, not_ready = api.wait(all_refs, num_returns=len(all_refs),
+                                timeout=0)
+        pending = {id(r) for r in not_ready}
+        self._outstanding = {
+            i: [w for w, r in pairs if id(r) in pending]
+            for i, pairs in live.items()}
+
+    def _maybe_scale(self):
+        """Queue-depth-driven autoscaling (reference:
+        actor_pool_map_operator.py:446 scale_up / :530 scale_down)."""
+        total = sum(len(v) for v in self._outstanding.values())
+        n = len(self.actors)
+        if n < self._max and total >= n * self._SCALE_UP_QUEUE:
+            self.actors.append(self._spawn())
+            self._outstanding[n] = []
+        elif n > self._min and total <= (n - 1):
+            # Drained: retire the idlest actor (never one with work).
+            for i in range(n - 1, -1, -1):
+                if not self._outstanding.get(i):
+                    a = self.actors.pop(i)
+                    # Reindex outstanding to match the actor list.
+                    out = [self._outstanding[j]
+                           for j in range(len(self.actors) + 1) if j != i]
+                    self._outstanding = {j: v for j, v in enumerate(out)}
+                    try:
+                        api.kill(a)
+                    except Exception:
+                        pass
+                    break
+
+    @property
+    def size(self) -> int:
+        return len(self.actors)
 
     def submit(self, blk_ref, batch_size, batch_format, fn_args,
                fn_kwargs):
-        actor = self.actors[self._rr % len(self.actors)]
-        self._rr += 1
-        return actor.apply.options(**self._call_opts).remote(
+        self._prune()
+        self._maybe_scale()
+        # Least-loaded dispatch.
+        idx = min(range(len(self.actors)),
+                  key=lambda i: len(self._outstanding.get(i, ())))
+        out = self.actors[idx].apply.options(**self._call_opts).remote(
             blk_ref, batch_size, batch_format, fn_args, fn_kwargs)
+        import weakref
+        self._outstanding.setdefault(idx, []).append(weakref.ref(out))
+        return out
 
     def map(self, bundles, batch_size, batch_format, fn_args, fn_kwargs):
         from ..util.actor_pool import ActorPool
@@ -422,7 +496,8 @@ class Dataset:
 
             def stage_fn(bundles: List[_RefBundle]) -> List[_RefBundle]:
                 pool = _MapBatchesActorPool(
-                    fn, compute.pool_size, opts, tuple(fn_constructor_args),
+                    fn, compute.pool_min, compute.pool_max, opts,
+                    tuple(fn_constructor_args),
                     fn_constructor_kwargs)
                 try:
                     return pool.map(bundles, batch_size, batch_format,
@@ -432,7 +507,8 @@ class Dataset:
 
             def make_submitter():
                 pool = _MapBatchesActorPool(
-                    fn, compute.pool_size, opts, tuple(fn_constructor_args),
+                    fn, compute.pool_min, compute.pool_max, opts,
+                    tuple(fn_constructor_args),
                     fn_constructor_kwargs)
                 # Weakrefs, not refs: holding strong ObjectRefs here
                 # would pin every intermediate block until close() and

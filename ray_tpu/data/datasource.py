@@ -89,9 +89,23 @@ def fanout_dataset(name: str, parts: List[Any], submit: Callable,
 
     def source():
         refs = [submit(c) for c in parts]
-        blocks = api.get(refs)
-        return [_RefBundle(r, B.block_length(blk))
-                for r, blk in zip(refs, blocks)]
+        bundles = []
+        unknown = []  # (index, ref) needing a row count
+        for i, (r, c) in enumerate(zip(refs, parts)):
+            n = rows_for(c) if rows_for is not None else None
+            if n is not None:
+                bundles.append(_RefBundle(r, int(n)))
+            else:
+                bundles.append(None)
+                unknown.append((i, r))
+        if unknown:
+            # Only fetch blocks whose count the source can't provide —
+            # api.get on EVERY ref would materialize the whole dataset
+            # (e.g. all decoded images) in driver memory.
+            blocks = api.get([r for _, r in unknown])
+            for (i, r), blk in zip(unknown, blocks):
+                bundles[i] = _RefBundle(r, B.block_length(blk))
+        return bundles
 
     def iter_source():
         for c in parts:

@@ -151,11 +151,19 @@ class RobustScaler(Preprocessor):
                 continue
             edges = hist_cols[c]
             counts = merged[c]
-            cdf = np.cumsum(counts) / max(1, counts.sum())
+            if counts.sum() == 0:
+                # All values NaN (np.histogram drops them) with
+                # finite-distinct min/max: no quantiles to take, and
+                # searchsorted on an all-zero cdf would index past the
+                # last bin.
+                self.stats_[c] = (lo, 0.0)
+                continue
+            cdf = np.cumsum(counts) / counts.sum()
             centers = (edges[:-1] + edges[1:]) / 2
 
             def q(p):
-                return float(centers[np.searchsorted(cdf, p)])
+                i = min(int(np.searchsorted(cdf, p)), len(centers) - 1)
+                return float(centers[i])
 
             self.stats_[c] = (q(0.5), q(hi_q) - q(lo_q))
 

@@ -212,11 +212,16 @@ def _rows_to_block_union(rows: List[Dict[str, Any]]) -> B.Block:
         for k in r:
             if k not in keys:
                 keys.append(k)
-    uniform = all(set(r) == set(rows[0]) for r in rows)
     out = {}
     for k in keys:
         vals = [r.get(k) for r in rows]
-        if uniform:
+        # Per COLUMN: only columns actually missing from some rows
+        # need the object-column fallback — one row lacking one
+        # optional key must not demote every numeric column to
+        # dtype=object (which changes downstream aggregate/concat
+        # behavior).
+        present_in_all = all(k in r for r in rows)
+        if present_in_all:
             try:
                 arr = np.asarray(vals)
                 if arr.dtype.kind in "US":

@@ -25,6 +25,19 @@ from .context import DataContext
 StreamedBundle = Tuple[api.ObjectRef, int]
 
 
+def _store_pressure() -> float:
+    """Driver-store usage fraction — the backpressure signal (the head
+    store is where streamed intermediates land on a single-node
+    cluster, and the first store to hurt on any cluster)."""
+    try:
+        from .._private import state
+        st = state.current().store.stats()
+        cap = st.get("capacity") or 0
+        return (st.get("used_bytes", 0) / cap) if cap else 0.0
+    except Exception:
+        return 0.0
+
+
 def stream_bundles(
     source: Iterator[StreamedBundle],
     submitters: List[Callable[[api.ObjectRef], api.ObjectRef]],
@@ -45,6 +58,17 @@ def stream_bundles(
     exhausted = False
     while True:
         while not exhausted and len(in_flight) < window:
+            if (in_flight
+                    and _store_pressure()
+                    >= ctx.backpressure_store_fraction):
+                # Resource-aware backpressure (reference:
+                # resource_manager.py per-operator budgets): the store
+                # is near capacity, so stop admitting new chains —
+                # consuming the ones in flight frees blocks — while
+                # never dropping below one chain (the pipeline must
+                # still drain to relieve the pressure).
+                ctx.backpressure_throttle_count += 1
+                break
             try:
                 ref, rows = next(source)
             except StopIteration:

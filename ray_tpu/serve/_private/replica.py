@@ -112,17 +112,45 @@ class Replica:
         consumer knows whether the user returned a stream or one value;
         user generators then stream item by item over GEN_ITEM messages.
         """
+        import contextvars
+
         from ..multiplex import _set_request_model_id
         self._ongoing += 1
-        _set_request_model_id(multiplexed_model_id)
+        # Per-REQUEST context: two interleaved streaming requests share
+        # this thread, so the model id must live in a context copied
+        # for this generator — user code calling
+        # serve.get_multiplexed_model_id() after the first yield must
+        # never read the OTHER request's id.
+        req_ctx = contextvars.copy_context()
         try:
-            target = self._resolve_target(method_name)
-            result = target(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = asyncio.run(result)
+            def _start():
+                _set_request_model_id(multiplexed_model_id)
+                target = self._resolve_target(method_name)
+                result = target(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = asyncio.run(result)
+                return result
+
+            result = req_ctx.run(_start)
             if inspect.isgenerator(result):
                 yield {"__stream__": True}
-                yield from result
+                try:
+                    while True:
+                        try:
+                            item = req_ctx.run(next, result)
+                        except StopIteration:
+                            break
+                        yield item
+                finally:
+                    # An abandoned stream (consumer close ->
+                    # GeneratorExit at the yield above) must close the
+                    # USER generator now so its finally/context-manager
+                    # cleanup runs deterministically, as `yield from`
+                    # would have done.
+                    try:
+                        req_ctx.run(result.close)
+                    except Exception:
+                        pass
             else:
                 yield {"__stream__": False}
                 yield result

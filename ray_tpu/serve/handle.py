@@ -301,7 +301,19 @@ class _Router:
             n = len(self._replicas)
         candidates = random.sample(range(n), 2) if n > 1 else None
         if candidates is not None:
-            self._maybe_probe(candidates)
+            probe_set = list(candidates)
+            if model_id:
+                # Holders of the model compete against the sampled
+                # candidates in _pick's warm-vs-spill comparison, so
+                # their queue lengths must be comparably fresh — a
+                # holder probed only at load time would keep a stale
+                # (often zero) score and soak every request.
+                with self._lock:
+                    locs = getattr(self, "_model_locations", {}).get(
+                        model_id, ())
+                    probe_set.extend(i for i in locs
+                                     if i < n and i not in probe_set)
+            self._maybe_probe(probe_set)
         with self._lock:
             if candidates is not None and any(
                     i >= len(self._replicas) for i in candidates):

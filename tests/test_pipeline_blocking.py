@@ -1,0 +1,90 @@
+"""Worker-lease pipelining vs blocking tasks: no deadlocks.
+
+Reference semantics: a worker blocked in ray.get releases its CPU to
+the raylet so dependency tasks can schedule (the classic nested-task
+deadlock mitigation), and pipelined-but-unstarted tasks must not be
+pinned behind a blocked task forever (here: RECALL_QUEUED evacuation).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _one_cpu_runtime():
+    # ONE CPU: the hardest case — any blocked grant-holder starves
+    # everyone unless blocked workers release their grant. Owns its
+    # runtime: a shared 4-CPU runtime would mask the starvation, and
+    # leaking a 1-CPU runtime breaks later modules' resource
+    # assertions.
+    rt.shutdown()
+    rt.init(num_cpus=1)
+    yield
+    rt.shutdown()
+
+
+def test_nested_get_on_full_cluster_completes():
+    @rt.remote
+    def child():
+        return 21
+
+    @rt.remote
+    def parent():
+        return rt.get(child.remote()) * 2
+
+    # parent holds the only CPU and blocks on child: the blocked lease
+    # must release its grant so child can run.
+    assert rt.get(parent.remote(), timeout=60) == 42
+
+
+@rt.remote(num_cpus=0, max_concurrency=2)
+class _Gate:
+    def __init__(self):
+        self._open = False
+
+    def open(self):
+        self._open = True
+        return True
+
+    def wait_open(self):
+        while not self._open:
+            time.sleep(0.02)
+        return 7
+
+
+@rt.remote
+def _victim():
+    return 42
+
+
+@rt.remote
+def _parent(gate):
+    return rt.get(gate.wait_open.remote())
+
+
+def test_victims_run_while_parent_blocked():
+    gate = _Gate.remote()
+    p = _parent.remote(gate)
+    time.sleep(1.0)  # parent is now blocked in get on the gate call
+    # Victims submitted AFTER the block: the blocked worker is not a
+    # pipeline target and its grant is released, so they must complete
+    # while the parent still blocks.
+    vs = [_victim.remote() for _ in range(3)]
+    assert rt.get(vs, timeout=30) == [42] * 3
+    rt.get(gate.open.remote())
+    assert rt.get(p, timeout=30) == 7
+
+
+def test_victims_evacuate_when_queued_before_block():
+    gate = _Gate.remote()
+    p = _parent.remote(gate)
+    # Victims submitted IMMEDIATELY: they may pipeline behind the
+    # parent before it blocks; once it blocks, the queue must be
+    # recalled and re-dispatched instead of waiting on the gate.
+    vs = [_victim.remote() for _ in range(3)]
+    assert rt.get(vs, timeout=30) == [42] * 3
+    rt.get(gate.open.remote())
+    assert rt.get(p, timeout=30) == 7
