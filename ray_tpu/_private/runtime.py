@@ -1521,8 +1521,15 @@ class Node:
             # GCS requests may block (placement-group waits, cross-node
             # pulls), so they run on the handler pool, never the
             # per-worker recv thread.
-            self._handler_pool.submit(
-                self._handle_blocking_request, handle, msg_type, payload)
+            try:
+                self._handler_pool.submit(
+                    self._handle_blocking_request, handle, msg_type,
+                    payload)
+            except RuntimeError:
+                # Pool already shut down: a worker message raced
+                # runtime teardown; dropping it is correct (the worker
+                # is about to be killed) and beats a traceback storm.
+                pass
         else:
             self._handle_quick_request(handle, msg_type, payload)
 

@@ -1439,10 +1439,14 @@ class Scheduler:
         entry = self.nodes.get(lease[0])
         if entry is not None and entry.rm.try_acquire(lease[1]):
             with self._lock:
-                if worker.lease is not None:
+                if worker.lease is not None and worker.blocked == 0:
                     worker.lease_released = False
                     return
-            # Lease drained while we reacquired: give it back.
+            # Lease drained — or the worker re-blocked while we
+            # reacquired (its note_worker_blocked saw lease_released
+            # and skipped releasing): either way the grant goes back,
+            # or a blocked worker would sit on resources its
+            # dependency tasks need.
             self.nodes.release(lease[0], lease[1])
 
     def node_of_task(self, spec) -> Optional[str]:

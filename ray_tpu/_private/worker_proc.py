@@ -594,7 +594,12 @@ class Worker:
                 else:
                     fut = self._task_pool.submit(self._execute, spec)
                     with self._running_lock:
-                        self._queued_futures[spec.task_id.binary()] = fut
+                        # Only while still queued: if _execute already
+                        # ran (popped the meta) this entry would be a
+                        # permanent orphan — done futures never cancel.
+                        if spec.task_id.binary() in self._queued_meta:
+                            self._queued_futures[
+                                spec.task_id.binary()] = fut
             elif msg_type == P.RECALL_QUEUED:
                 self._recall_queued()
             elif msg_type == P.REPLY:

@@ -22,6 +22,7 @@ import inspect
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import ray_tpu
+from .asgi import ingress
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .handle import DeploymentHandle, DeploymentResponse
 from .batching import batch, pad_batch_to_bucket
@@ -287,7 +288,7 @@ __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "batch",
     "delete", "deployment", "get_app_handle", "get_deployment_handle",
-    "get_multiplexed_model_id", "multiplexed",
+    "get_multiplexed_model_id", "ingress", "multiplexed",
     "pad_batch_to_bucket", "proxy_address", "proxy_addresses", "run", "shutdown", "start", "start_grpc",
     "status",
 ]

@@ -76,7 +76,9 @@ class _ProxyState:
                         or norm == "/":
                     if best is None or len(norm) > len(best[0]):
                         best = (norm, target)
-            return best[1] if best else None
+            # (app_name, deployment, matched_prefix) — the prefix rides
+            # to ASGI ingress deployments as the root_path.
+            return (best[1][0], best[1][1], best[0]) if best else None
 
     def handle_for(self, deployment: str, app: str):
         with self._lock:
@@ -90,6 +92,29 @@ class _ProxyState:
 
     def stop(self):
         self._long_poll.stop()
+
+
+def _to_web_response(result):
+    """Translate a replica result into an aiohttp response. ASGI
+    ingress envelopes replay the app's real status/headers/body;
+    anything else goes through the classic body encoding."""
+    from aiohttp import web
+    if isinstance(result, dict) and result.get("__asgi__"):
+        resp = web.Response(body=result.get("body", b""),
+                            status=int(result.get("status", 200)))
+        for k, v in result.get("headers", []):
+            lk = k.lower()
+            if lk in ("content-length", "transfer-encoding"):
+                continue  # aiohttp recomputes framing headers
+            if lk == "content-type":
+                resp.headers[k] = v  # single-valued by construction
+            else:
+                # add(), not assignment: repeatable headers (multiple
+                # Set-Cookie) must all reach the client.
+                resp.headers.add(k, v)
+        return resp
+    payload, ctype = _encode_body(result)
+    return web.Response(body=payload, content_type=ctype)
 
 
 def _encode_body(body):
@@ -109,6 +134,10 @@ class HTTPProxy:
                  port: int = 8000):
         self._state = _ProxyState(controller)
         self._modes: Dict[str, str] = {}  # deployment -> unary | stream
+        # deployment -> True (ASGI ingress) | False (classic handler);
+        # absent until the first response teaches us which half of the
+        # request envelope the deployment consumes.
+        self._asgi: Dict[tuple, bool] = {}
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
         self._start_error = None
@@ -163,14 +192,27 @@ class HTTPProxy:
         target = self._state.match(path)
         if target is None:
             return web.json_response({"error": "no route"}, status=404)
-        app_name, deployment = target
+        app_name, deployment, matched_prefix = target
         raw = await request.read()
-        try:
-            body = json.loads(raw) if raw else None
-        except Exception:
-            body = raw.decode(errors="replace")
+        # Learned per deployment from its first response: ASGI ingress
+        # deployments consume the raw bytes + headers and ignore the
+        # decoded body; classic handlers are the reverse. Shipping both
+        # would double the serialized payload on every request, so
+        # until the first response both ride, then only one does.
+        mode_key = (app_name, deployment)
+        is_asgi = self._asgi.get(mode_key)
+        if is_asgi is True:
+            body = None
+        else:
+            try:
+                body = json.loads(raw) if raw else None
+            except Exception:
+                body = raw.decode(errors="replace")
         req = {"path": request.path_qs, "method": request.method,
-               "body": body}
+               "body": body, "route_prefix": matched_prefix}
+        if is_asgi is not False:
+            req["raw_body"] = raw
+            req["headers"] = [(k, v) for k, v in request.headers.items()]
         handle = self._state.handle_for(deployment, app_name)
         # Model multiplexing header (reference: proxy.py reading
         # SERVE_MULTIPLEXED_MODEL_ID from the request) — routed
@@ -183,7 +225,6 @@ class HTTPProxy:
         # generator machinery (3 messages + 2 result waits). The replica
         # raises StreamingResponseRequired when the handler actually
         # streams; the verdict is cached per deployment.
-        mode_key = (app_name, deployment)
         mode = self._modes.get(mode_key, "unary")
         if mode == "unary":
             try:
@@ -198,14 +239,18 @@ class HTTPProxy:
                     resp = await loop.run_in_executor(
                         None, lambda: handle.remote(req))
                 result = await resp
-                payload, ctype = _encode_body(result)
-                return web.Response(body=payload, content_type=ctype)
+                if is_asgi is None:
+                    self._asgi[mode_key] = bool(
+                        isinstance(result, dict)
+                        and result.get("__asgi__"))
+                return _to_web_response(result)
             except Exception as e:
                 # TaskError carries the remote class name in its message.
                 if "StreamingResponseRequired" not in f"{e!r}{e}":
                     return web.json_response({"error": str(e)},
                                              status=500)
                 self._modes[mode_key] = "stream"
+                self._asgi.setdefault(mode_key, False)
         try:
             rg = await loop.run_in_executor(
                 None, lambda: handle.options(stream=True).remote(req))
@@ -216,8 +261,7 @@ class HTTPProxy:
             if not is_stream:
                 result = await loop.run_in_executor(
                     None, lambda: rg.single_result(timeout_s=60.0))
-                payload, ctype = _encode_body(result)
-                return web.Response(body=payload, content_type=ctype)
+                return _to_web_response(result)
         except Exception as e:
             return web.json_response({"error": str(e)}, status=500)
         # Streaming: one chunk per generator item (reference: streaming

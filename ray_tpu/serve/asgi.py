@@ -1,0 +1,125 @@
+"""ASGI ingress for Serve deployments.
+
+Reference parity: ``@serve.ingress(app)`` (python/ray/serve/api.py:170)
+— wrap a FastAPI/Starlette/any-ASGI application as a deployment's HTTP
+surface. The proxy forwards the raw request (method, path, query,
+headers, body); the replica drives one ASGI request/response cycle
+through the app and ships back status + headers + body, which the proxy
+replays verbatim. Works with ANY ASGI3 callable — FastAPI is just the
+common case (not bundled in this environment; the tests use a plain
+ASGI app).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["ingress"]
+
+
+async def _run_asgi_once(app, req: Dict[str, Any]) -> Dict[str, Any]:
+    """Drive one http request through an ASGI3 app; returns the proxy
+    replay envelope."""
+    path_qs = req.get("path", "/")
+    path, _, query = path_qs.partition("?")
+    prefix = req.get("route_prefix") or ""
+    if prefix == "/":
+        prefix = ""  # root mount: no prefix to strip (ASGI root_path "")
+    if prefix and path.startswith(prefix):
+        sub_path = path[len(prefix):] or "/"
+    else:
+        sub_path = path
+    scope = {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": req.get("method", "GET"),
+        "scheme": "http",
+        # root_path carries the deployment's route prefix so apps with
+        # absolute routes mount correctly (reference: serve mounts the
+        # FastAPI app at the route prefix).
+        "root_path": prefix,
+        "path": sub_path,
+        "raw_path": path.encode(),
+        "query_string": query.encode(),
+        "headers": [(k.lower().encode(), v.encode())
+                    for k, v in (req.get("headers") or [])],
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 80),
+    }
+    body = req.get("raw_body")
+    if body is None:
+        body = b""
+    elif isinstance(body, str):
+        body = body.encode()
+
+    sent = {"body": body, "done": False}
+
+    async def receive():
+        if sent["done"]:
+            return {"type": "http.disconnect"}
+        sent["done"] = True
+        return {"type": "http.request", "body": sent["body"],
+                "more_body": False}
+
+    out: Dict[str, Any] = {"status": 200, "headers": [], "chunks": []}
+
+    async def send(message):
+        if message["type"] == "http.response.start":
+            out["status"] = int(message["status"])
+            out["headers"] = [
+                (k.decode() if isinstance(k, (bytes, bytearray)) else k,
+                 v.decode() if isinstance(v, (bytes, bytearray)) else v)
+                for k, v in message.get("headers", [])]
+        elif message["type"] == "http.response.body":
+            chunk = message.get("body", b"")
+            if chunk:
+                out["chunks"].append(bytes(chunk))
+
+    await app(scope, receive, send)
+    return {"__asgi__": True, "status": out["status"],
+            "headers": out["headers"], "body": b"".join(out["chunks"])}
+
+
+def ingress(app) -> Callable[[type], type]:
+    """Class decorator: route the deployment's HTTP traffic through an
+    ASGI app (reference: serve/api.py:170 ``@serve.ingress``). Methods
+    on the class remain callable through deployment handles; HTTP
+    requests run one ASGI cycle and replay the app's real status code,
+    headers, and body through the proxy.
+
+    Usage::
+
+        app = FastAPI()          # or any ASGI3 callable
+
+        @serve.deployment
+        @serve.ingress(app)
+        class Api:
+            @app.get("/hello")
+            def hello(self):
+                return {"msg": "hi"}
+    """
+
+    def decorator(cls: type) -> type:
+        if not callable(app):
+            raise TypeError(
+                f"serve.ingress expects an ASGI app, got {type(app)}")
+
+        class _ASGIIngress(cls):  # type: ignore[misc,valid-type]
+            __serve_asgi_app__ = app
+
+            async def __call__(self, request):
+                if not isinstance(request, dict):
+                    request = {"path": "/", "method": "GET",
+                               "raw_body": None, "headers": []}
+                return await _run_asgi_once(
+                    type(self).__serve_asgi_app__, request)
+
+        _ASGIIngress.__name__ = cls.__name__
+        _ASGIIngress.__qualname__ = getattr(cls, "__qualname__",
+                                            cls.__name__)
+        _ASGIIngress.__module__ = cls.__module__
+        return _ASGIIngress
+
+    return decorator

@@ -201,7 +201,12 @@ class _Router:
         if n == 1:
             return 0
         now = _time.monotonic()
-        a, b = candidates or random.sample(range(n), 2)
+        if candidates:
+            a, b = candidates
+        elif n == 2:
+            a, b = 0, 1  # the common 2-replica case: sampling is noise
+        else:
+            a, b = random.sample(range(n), 2)
         fallback = a if self._replica_score(a, now) <= \
             self._replica_score(b, now) else b
         if model_id:
@@ -237,12 +242,26 @@ class _Router:
         ref = replica.handle_request.remote(method_name, args, kwargs,
                                             model_id)
 
-        def _done(_):
+        def _done():
             with self._lock:
                 if idx in self._inflight and self._inflight[idx] > 0:
                     self._inflight[idx] -= 1
         try:
-            ref.future().add_done_callback(_done)
+            # Readiness callback straight off the object directory: the
+            # decrement needs no value, so building a concurrent.Future
+            # + resolver-pool get() per request (the .future() path)
+            # would be pure overhead on the serve hot path. Worker
+            # processes (deployment composition: a replica holding a
+            # handle) have no object directory — fall back to the
+            # future-based path there rather than silently never
+            # decrementing.
+            from ray_tpu._private import state as _state
+            objects = getattr(getattr(_state.current(), "gcs", None),
+                              "objects", None)
+            if objects is not None:
+                objects.add_ready_callback(ref.id, _done)
+            else:
+                ref.future().add_done_callback(lambda _f: _done())
         except Exception:
             pass
         return ref
