@@ -1439,7 +1439,11 @@ class Scheduler:
         entry = self.nodes.get(lease[0])
         if entry is not None and entry.rm.try_acquire(lease[1]):
             with self._lock:
-                if worker.lease is not None and worker.blocked == 0:
+                if (worker.lease is not None and worker.blocked == 0
+                        and worker.lease_released):
+                    # lease_released check: a concurrent unblock may
+                    # have already reclaimed the grant — only ONE
+                    # reacquisition may stick or capacity leaks.
                     worker.lease_released = False
                     return
             # Lease drained — or the worker re-blocked while we

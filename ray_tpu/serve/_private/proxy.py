@@ -20,12 +20,13 @@ from .long_poll import LongPollClient
 
 
 class _ProxyState:
-    def __init__(self, controller):
+    def __init__(self, controller, on_routes_changed=None):
         self._controller = controller
         self._routes: Dict[str, tuple] = {}
         self._handles: Dict[str, object] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        self._on_routes_changed = on_routes_changed
         self._long_poll = LongPollClient(
             controller, {"routes": self._update_routes})
         import ray_tpu
@@ -37,7 +38,13 @@ class _ProxyState:
 
     def _update_routes(self, routes: Dict[str, tuple]):
         with self._lock:
+            changed = self._routes != dict(routes or {})
             self._routes = dict(routes or {})
+        if changed and self._on_routes_changed is not None:
+            # Deployments may have been replaced under the same name
+            # with a different TYPE: learned per-deployment verdicts
+            # (unary/stream, ASGI/classic) must re-learn.
+            self._on_routes_changed()
 
     def match(self, path: str) -> Optional[tuple]:
         """Longest-prefix route match (reference: proxy.py route matching).
@@ -132,12 +139,13 @@ class HTTPProxy:
 
     def __init__(self, controller, host: str = "127.0.0.1",
                  port: int = 8000):
-        self._state = _ProxyState(controller)
         self._modes: Dict[str, str] = {}  # deployment -> unary | stream
         # deployment -> True (ASGI ingress) | False (classic handler);
         # absent until the first response teaches us which half of the
         # request envelope the deployment consumes.
         self._asgi: Dict[tuple, bool] = {}
+        self._state = _ProxyState(
+            controller, on_routes_changed=self._forget_learned)
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
         self._start_error = None
@@ -151,6 +159,10 @@ class HTTPProxy:
             raise RuntimeError("serve proxy failed to start in 30s")
         if self._start_error is not None:
             raise self._start_error
+
+    def _forget_learned(self):
+        self._modes.clear()
+        self._asgi.clear()
 
     # -- server thread -------------------------------------------------
     def _run(self, host: str, port: int):
@@ -213,6 +225,10 @@ class HTTPProxy:
         if is_asgi is not False:
             req["raw_body"] = raw
             req["headers"] = [(k, v) for k, v in request.headers.items()]
+            # Undecoded path+query for the ASGI half: path_qs is
+            # percent-DECODED by yarl, which would corrupt encoded
+            # metacharacters (%26 etc.) before the app's query parser.
+            req["raw_path"] = request.raw_path
         handle = self._state.handle_for(deployment, app_name)
         # Model multiplexing header (reference: proxy.py reading
         # SERVE_MULTIPLEXED_MODEL_ID from the request) — routed

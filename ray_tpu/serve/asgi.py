@@ -12,8 +12,7 @@ ASGI app).
 
 from __future__ import annotations
 
-import inspect
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict
 
 __all__ = ["ingress"]
 
@@ -21,8 +20,14 @@ __all__ = ["ingress"]
 async def _run_asgi_once(app, req: Dict[str, Any]) -> Dict[str, Any]:
     """Drive one http request through an ASGI3 app; returns the proxy
     replay envelope."""
-    path_qs = req.get("path", "/")
-    path, _, query = path_qs.partition("?")
+    # Prefer the undecoded path (proxy's raw_path): percent-encoded
+    # metacharacters must reach the app's own query parser intact. Per
+    # the ASGI spec, scope["path"] is DECODED while query_string and
+    # raw_path stay encoded.
+    from urllib.parse import unquote
+    path_qs = req.get("raw_path") or req.get("path", "/")
+    raw_path, _, query = path_qs.partition("?")
+    path = unquote(raw_path)
     prefix = req.get("route_prefix") or ""
     if prefix == "/":
         prefix = ""  # root mount: no prefix to strip (ASGI root_path "")
@@ -41,7 +46,7 @@ async def _run_asgi_once(app, req: Dict[str, Any]) -> Dict[str, Any]:
         # FastAPI app at the route prefix).
         "root_path": prefix,
         "path": sub_path,
-        "raw_path": path.encode(),
+        "raw_path": raw_path.encode(),
         "query_string": query.encode(),
         "headers": [(k.lower().encode(), v.encode())
                     for k, v in (req.get("headers") or [])],

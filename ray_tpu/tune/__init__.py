@@ -12,9 +12,12 @@ from .result_grid import Result, ResultGrid
 from .schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
     TrialScheduler,
 )
 from .search import (
@@ -40,7 +43,8 @@ from .searchers import (
     TuneBOHB,
     ZOOptSearch,
 )
-from .session import get_checkpoint, get_trial_dir, get_trial_id, report
+from .session import (get_checkpoint, get_trial_dir, get_trial_id,
+                      get_trial_resources, report)
 from .trainable import Trainable, with_parameters, with_resources
 from .tuner import TuneConfig, Tuner
 
@@ -49,7 +53,9 @@ ASHAScheduler = AsyncHyperBandScheduler  # reference alias (tune.schedulers)
 __all__ = [
     "ASHAScheduler", "AsyncHyperBandScheduler", "CheckpointConfig",
     "FIFOScheduler", "FailureConfig", "HyperBandScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining", "Result", "ResultGrid",
+    "HyperBandForBOHB", "MedianStoppingRule", "PB2",
+    "PopulationBasedTraining", "ResourceChangingScheduler", "Result",
+    "ResultGrid", "TuneBOHB", "get_trial_resources",
     "RunConfig", "Trainable", "TrialScheduler", "TuneConfig", "Tuner",
     "choice", "get_checkpoint", "get_trial_dir", "get_trial_id",
     "grid_search", "loguniform", "qrandint", "quniform", "randint",

@@ -215,3 +215,223 @@ class PopulationBasedTraining(TrialScheduler):
                     factor = self._rng.choice([0.8, 1.2])
                     out[key] = type(cur)(cur * factor)
         return out
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (reference: schedulers/pb2.py PB2:256).
+
+    PBT where the exploit step's new hyperparameters come from a
+    GP-bandit instead of random perturbation: a Gaussian process is fit
+    to observed (time, hyperparams) -> reward-CHANGE data across the
+    population, and the clone's config maximizes UCB over the bounded
+    search box. The reference fits a time-varying kernel with GPy; this
+    build uses a native numpy RBF-GP with UCB over sampled candidates —
+    the same exploit policy without the GPy dependency (offline image).
+
+    hyperparam_bounds: {key: (low, high)} continuous search box (ints
+    are detected from the bound types and rounded).
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(time_attr=time_attr, metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations=None,
+                         quantile_fraction=quantile_fraction, seed=seed)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds")
+        self._bounds = dict(hyperparam_bounds)
+        self._keys = sorted(self._bounds)
+        # Observations: rows of [t, *config] with y = score delta since
+        # the trial's previous observation (the GP models reward
+        # CHANGE, pb2_utils in the reference).
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._prev: Dict[str, Tuple[float, float]] = {}  # tid -> (t, score)
+
+    # Controller hook: result + the trial's CURRENT config.
+    def observe(self, trial_id: str, result: Dict, config: Dict):
+        if not self._has_metric(result):
+            return
+        t = float(result.get(self._time_attr, 0))
+        score = self._score(result)
+        prev = self._prev.get(trial_id)
+        self._prev[trial_id] = (t, score)
+        if prev is None or t <= prev[0]:
+            return
+        dy = (score - prev[1]) / (t - prev[0])
+        row = [t] + [float(config.get(k, self._bounds[k][0]))
+                     for k in self._keys]
+        self._X.append(row)
+        self._y.append(dy)
+        if len(self._X) > 512:  # sliding window: old dynamics go stale
+            self._X.pop(0)
+            self._y.pop(0)
+
+    def _mutate(self, config: Dict) -> Dict:
+        """GP-UCB selection replaces random perturbation."""
+        import numpy as np
+        out = dict(config)
+        if len(self._y) < 4:
+            # Cold start: uniform sample inside the box.
+            for k in self._keys:
+                lo, hi = self._bounds[k]
+                v = self._rng.uniform(float(lo), float(hi))
+                out[k] = self._cast(k, v)
+            return out
+        X = np.asarray(self._X, dtype=np.float64)
+        y = np.asarray(self._y, dtype=np.float64)
+        # Normalize to the unit box (t included).
+        lo = X.min(axis=0)
+        span = np.maximum(X.max(axis=0) - lo, 1e-9)
+        Xn = (X - lo) / span
+        ystd = y.std() or 1.0
+        yn = (y - y.mean()) / ystd
+        # RBF GP posterior.
+        ell, noise = 0.3, 1e-3
+        d2 = ((Xn[:, None, :] - Xn[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-d2 / (2 * ell * ell)) + noise * np.eye(len(Xn))
+        try:
+            L = np.linalg.cholesky(K)
+            alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        except np.linalg.LinAlgError:
+            return super()._mutate(config)
+        t_now = Xn[:, 0].max()
+        n_cand = 256
+        cand = np.empty((n_cand, X.shape[1]))
+        cand[:, 0] = t_now
+        rng = np.random.default_rng(self._rng.randrange(2 ** 31))
+        cand[:, 1:] = rng.uniform(0.0, 1.0, size=(n_cand, len(self._keys)))
+        d2c = ((cand[:, None, :] - Xn[None, :, :]) ** 2).sum(-1)
+        Kc = np.exp(-d2c / (2 * ell * ell))
+        mu = Kc @ alpha
+        v = np.linalg.solve(L, Kc.T)
+        var = np.maximum(1.0 - (v * v).sum(axis=0), 1e-12)
+        ucb = mu + 1.0 * np.sqrt(var)
+        best = cand[int(ucb.argmax())]
+        for i, k in enumerate(self._keys):
+            blo, bhi = self._bounds[k]
+            val = float(lo[i + 1] + best[i + 1] * span[i + 1])
+            val = min(max(val, float(blo)), float(bhi))
+            out[k] = self._cast(k, val)
+        return out
+
+    def _cast(self, key: str, val: float):
+        lo, hi = self._bounds[key]
+        if isinstance(lo, int) and isinstance(hi, int):
+            return int(round(val))
+        return float(val)
+
+
+class HyperBandForBOHB(AsyncHyperBandScheduler):
+    """BOHB's bracket scheduler (reference: schedulers/hb_bohb.py).
+
+    The reference pairs synchronous HyperBand brackets with the TuneBOHB
+    searcher; this build keeps the successive-halving rung core (shared
+    with ASHA — the async promotion rule, which BOHB's own authors note
+    performs comparably) and feeds every rung-crossing observation to a
+    paired TuneBOHB searcher so its model trains on intermediate
+    budgets, not just final results."""
+
+    def __init__(self, *args, searcher=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._paired_searcher = searcher
+
+    def pair_with(self, searcher):
+        self._paired_searcher = searcher
+
+    def observe(self, trial_id: str, result: Dict, config: Dict):
+        s = self._paired_searcher
+        if s is not None and self._has_metric(result):
+            budget = int(result.get(self._time_attr, 0))
+            s.observe_budget(config, self._score(result), budget)
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Reallocate trial resources mid-experiment (reference:
+    schedulers/resource_changing_scheduler.py:592).
+
+    Wraps a base scheduler (decisions delegate to it) and, at every
+    reallocation interval, asks `resources_allocation_function(
+    cluster_resources, trial_id, result, trial_resources_map)` for the
+    trial's new resource dict. A change restarts the trial FROM ITS
+    CHECKPOINT with the new allocation — the controller owns the
+    restart, exactly like a PBT exploit."""
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None,
+                 reallocation_interval: int = 2,
+                 time_attr: str = "training_iteration"):
+        self._base = base_scheduler or FIFOScheduler()
+        self._alloc = (resources_allocation_function
+                       or evenly_distribute_cpus)
+        self._interval = reallocation_interval
+        self._time_attr = time_attr
+        self._last_realloc: Dict[str, int] = {}
+
+    def set_metric(self, metric: str, mode: str):
+        super().set_metric(metric, mode)
+        self._base.set_metric(metric, mode)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return self._base.on_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str):
+        self._base.on_trial_complete(trial_id)
+
+    # Full delegation so wrapping PBT/PB2/BOHB keeps their behavior
+    # (the controller unwraps via `base_scheduler` for isinstance
+    # checks; these forward the per-result hooks).
+    @property
+    def base_scheduler(self) -> TrialScheduler:
+        return self._base
+
+    def exploit_decision(self, trial_id: str, configs: Dict[str, Dict]):
+        return self._base.exploit_decision(trial_id, configs)
+
+    def should_perturb(self, trial_id: str, result: Dict) -> bool:
+        fn = getattr(self._base, "should_perturb", None)
+        return bool(fn(trial_id, result)) if fn is not None else False
+
+    def observe(self, trial_id: str, result: Dict, config: Dict):
+        fn = getattr(self._base, "observe", None)
+        if fn is not None:
+            fn(trial_id, result, config)
+
+    def reallocate_decision(self, trial_id: str, result: Dict,
+                            cluster_resources: Dict[str, float],
+                            trial_resources: Dict[str, Dict[str, float]]
+                            ) -> Optional[Dict[str, float]]:
+        """New resources for `trial_id`, or None to keep the current
+        allocation. Rate-limited by reallocation_interval."""
+        t = int(result.get(self._time_attr, 0))
+        last = self._last_realloc.get(trial_id, 0)
+        if t - last < self._interval:
+            return None
+        self._last_realloc[trial_id] = t
+        new = self._alloc(cluster_resources, trial_id, result,
+                          trial_resources)
+        if new is None or new == trial_resources.get(trial_id):
+            return None
+        return new
+
+
+def evenly_distribute_cpus(cluster_resources: Dict[str, float],
+                           trial_id: str, result: Dict,
+                           trial_resources: Dict[str, Dict[str, float]]
+                           ) -> Optional[Dict[str, float]]:
+    """Default allocation policy (reference: DistributeResources in
+    resource_changing_scheduler.py): split the cluster's CPUs evenly
+    over live trials, so finished trials' capacity flows to survivors."""
+    n = max(1, len(trial_resources))
+    total = int(cluster_resources.get("CPU", 1))
+    share = max(1, total // n)
+    cur = dict(trial_resources.get(trial_id) or {})
+    if cur.get("CPU") == share:
+        return None
+    cur["CPU"] = share
+    return cur

@@ -118,6 +118,14 @@ def get_trial_id() -> Optional[str]:
     return s.trial_id if s else None
 
 
+def get_trial_resources() -> Dict[str, Any]:
+    """The trial's CURRENT resource allocation (reference:
+    tune.get_trial_resources) — changes when a
+    ResourceChangingScheduler restarts the trial with a new grant."""
+    s = _session
+    return dict(getattr(s, "trial_resources", {}) or {}) if s else {}
+
+
 def get_trial_dir() -> Optional[str]:
     s = _session
     return s.trial_dir if s else None

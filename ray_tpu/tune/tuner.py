@@ -62,6 +62,9 @@ class _Trial:
     error: Optional[str] = None
     failures: int = 0
     restore_from: Optional[str] = None
+    # Per-trial resource override (ResourceChangingScheduler); None =
+    # the experiment default.
+    resources: Optional[Dict[str, float]] = None
     actor: Any = None
     run_ref: Any = None
     dir: str = ""
@@ -77,7 +80,8 @@ class _TrialActor:
 
     def run(self, fn_blob: bytes, config: Dict, trial_id: str,
             trial_dir: str, restore_path: Optional[str],
-            stop_conditions: Optional[Dict] = None) -> Dict:
+            stop_conditions: Optional[Dict] = None,
+            resources: Optional[Dict] = None) -> Dict:
         import cloudpickle
 
         fn = cloudpickle.loads(fn_blob)
@@ -85,6 +89,7 @@ class _TrialActor:
                    if restore_path else None)
         s = tune_session._TuneSession(trial_id, trial_dir, restore,
                                       stop_conditions)
+        s.trial_resources = dict(resources or {})
         if self._stop:
             s.stop_requested = True
         self._session = s
@@ -225,15 +230,16 @@ class _TuneController:
     # -- trial lifecycle ---------------------------------------------------
     def _start_trial(self, t: _Trial):
         os.makedirs(t.dir, exist_ok=True)
+        res = t.resources or self._resources
         t.actor = _TrialActor.options(
             max_concurrency=4,
-            resources={k: v for k, v in self._resources.items()
+            resources={k: v for k, v in res.items()
                        if k not in ("CPU", "TPU")},
-            num_cpus=self._resources.get("CPU", 1),
-            num_tpus=self._resources.get("TPU", 0) or None).remote()
+            num_cpus=res.get("CPU", 1),
+            num_tpus=res.get("TPU", 0) or None).remote()
         t.run_ref = t.actor.run.remote(
             self._fn_blob, t.config, t.trial_id, t.dir, t.restore_from,
-            self._stop_conditions)
+            self._stop_conditions, dict(res))
         t.state = RUNNING
 
     def _finalize_trial(self, t: _Trial):
@@ -287,14 +293,40 @@ class _TuneController:
             if k in metrics and metrics[k] >= v:
                 self._request_stop(t)
                 return
+        # Config-aware observation hook (PB2's GP data, BOHB's
+        # budget-tagged model points).
+        observe = getattr(self._scheduler, "observe", None)
+        if observe is not None:
+            observe(t.trial_id, metrics, t.config)
         decision = self._scheduler.on_result(t.trial_id, metrics)
         if decision == sched_mod.STOP:
             self._request_stop(t)
             return
+        # ResourceChangingScheduler: a new allocation restarts the
+        # trial from its checkpoint with the new resources (reference:
+        # resource_changing_scheduler.py:592).
+        rcs = self._scheduler
+        if (isinstance(rcs, sched_mod.ResourceChangingScheduler)
+                and t.checkpoint_path):
+            # No checkpoint -> no reallocation: restarting from scratch
+            # would silently discard the trial's progress (the
+            # reference refuses non-checkpointing trainables too).
+            live = {x.trial_id: dict(x.resources or self._resources)
+                    for x in self._trials if x.state == RUNNING}
+            new_res = rcs.reallocate_decision(
+                t.trial_id, metrics, api.cluster_resources(), live)
+            if new_res is not None:
+                t.resources = new_res
+                t.restore_from = t.checkpoint_path
+                self._request_stop(t, restart=True)
+                return
         # PBT exploit: bottom-quantile trial adopts a top trial's
         # checkpoint + mutated config at perturbation boundaries.
+        # (ResourceChangingScheduler delegates; unwrap for the type
+        # check but call through the wrapper.)
         pbt = self._scheduler
-        if isinstance(pbt, sched_mod.PopulationBasedTraining) \
+        base = getattr(pbt, "base_scheduler", pbt)
+        if isinstance(base, sched_mod.PopulationBasedTraining) \
                 and pbt.should_perturb(t.trial_id, metrics):
             configs = {x.trial_id: x.config for x in self._trials}
             decision2 = pbt.exploit_decision(t.trial_id, configs)

@@ -1,0 +1,164 @@
+"""PB2, BOHB, ResourceChangingScheduler (VERDICT r3 #7).
+
+Reference: tune/schedulers/pb2.py:256, hb_bohb.py,
+resource_changing_scheduler.py:592.
+"""
+
+import time
+
+import pytest
+
+import json
+import os
+import tempfile
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import CheckpointConfig, RunConfig
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+def _ckpt(state):
+    d = tempfile.mkdtemp(prefix="advsched_ckpt_")
+    with open(os.path.join(d, "state.json"), "w") as f:
+        json.dump(state, f)
+    return Checkpoint.from_directory(d)
+
+
+def _ckpt_state(ckpt):
+    with open(os.path.join(ckpt.path, "state.json")) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _runtime():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+
+
+def _pb2_trainable(config):
+    # Score accumulates at a rate peaked at lr=0.7: exploit+GP should
+    # herd the population toward it.
+    x = 0.0
+    lr = config["lr"]
+    ckpt = tune.get_checkpoint()
+    start = 1
+    if ckpt is not None:
+        state = _ckpt_state(ckpt)
+        x, start = state["x"], state["iter"] + 1
+    for i in range(start, 25):
+        x += max(0.0, 1.0 - 3.0 * abs(lr - 0.7))
+        tune.report({"score": x, "training_iteration": i},
+                    checkpoint=_ckpt({"x": x, "iter": i}))
+
+
+def _run_tune(scheduler=None, search_alg=None, seed=0, num_samples=4):
+    tuner = tune.Tuner(
+        _pb2_trainable,
+        param_space={"lr": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=num_samples,
+            seed=seed, scheduler=scheduler, search_alg=search_alg),
+        run_config=RunConfig(
+            name=f"adv_{seed}_{type(scheduler).__name__}_{time.time()}"))
+    return tuner.fit()
+
+
+def test_pb2_beats_random_on_seeded_objective():
+    pb2 = tune.PB2(metric="score", mode="max",
+                   perturbation_interval=4,
+                   quantile_fraction=0.5,
+                   hyperparam_bounds={"lr": (0.0, 1.0)}, seed=7)
+    # SAME seed both runs: identical seeded starting populations, so
+    # the only difference is PB2's exploit+GP scheduling.
+    pb2_grid = _run_tune(scheduler=pb2, seed=7)
+    rnd_grid = _run_tune(scheduler=None, seed=7)
+
+    def scores(grid):
+        return [r.metrics.get("score", 0.0) for r in grid
+                if r.metrics]
+
+    pb2_scores = scores(pb2_grid)
+    rnd_scores = scores(rnd_grid)
+    assert pb2_scores and rnd_scores
+    # Exploit+GP lifts the POPULATION: bottom trials clone top
+    # checkpoints and continue with model-selected lr, so the mean
+    # final score must beat pure random sampling's.
+    pb2_mean = sum(pb2_scores) / len(pb2_scores)
+    rnd_mean = sum(rnd_scores) / len(rnd_scores)
+    assert pb2_mean > rnd_mean, (pb2_scores, rnd_scores)
+    # The GP actually trained (observations flowed through observe()).
+    assert len(pb2._y) > 0
+
+
+def test_pb2_requires_bounds():
+    with pytest.raises(ValueError, match="hyperparam_bounds"):
+        tune.PB2(metric="score", mode="max")
+
+
+def _rcs_trainable(config):
+    for i in range(1, 7):
+        res = tune.get_trial_resources()
+        tune.report({"cpus": float(res.get("CPU", 0)), "score": float(i),
+                     "training_iteration": i},
+                    checkpoint=_ckpt({"iter": i}))
+        time.sleep(0.05)
+
+
+def test_resource_changing_scheduler_resizes_mid_experiment():
+    rcs = tune.ResourceChangingScheduler(reallocation_interval=2)
+    tuner = tune.Tuner(
+        _rcs_trainable,
+        param_space={"a": tune.choice([1, 2])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=2, seed=1,
+            scheduler=rcs, max_concurrent_trials=2),
+        run_config=RunConfig(name=f"rcs_{time.time()}"))
+    grid = tuner.fit()
+    # 4 cluster CPUs over 2 trials -> evenly_distribute grants CPU=2;
+    # the restart must be OBSERVED by the trainable (the actor really
+    # got a bigger grant), not just recorded controller-side.
+    seen = [r.metrics.get("cpus") for r in grid if r.metrics]
+    assert any(c == 2.0 for c in seen), seen
+
+
+def test_bohb_pair_converges():
+    searcher = tune.TuneBOHB(metric="score", mode="max", seed=5,
+                             min_points=4)
+    sched = tune.HyperBandForBOHB(
+        metric="score", mode="max", max_t=16, grace_period=2,
+        reduction_factor=4, searcher=searcher)
+
+    def trainable(config):
+        x = config["x"]
+        for i in range(1, 17):
+            tune.report({"score": i * (1.0 - (x - 0.3) ** 2),
+                         "training_iteration": i})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=10, seed=5,
+            scheduler=sched, search_alg=searcher),
+        run_config=RunConfig(name=f"bohb_{time.time()}"))
+    grid = tuner.fit()
+    best = max(r.metrics.get("score", 0) for r in grid if r.metrics)
+    assert best > 10.0, best  # near-optimum x survives the rungs
+    # Budget-tagged observations reached the searcher's model.
+    assert searcher._by_budget, "no rung observations flowed"
+
+
+def test_rcs_delegates_to_wrapped_pbt():
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"lr": tune.loguniform(1e-4, 1e-1)}, seed=0)
+    rcs = tune.ResourceChangingScheduler(base_scheduler=pbt)
+    rcs.set_metric("score", "max")
+    rcs.on_result("weak", {"training_iteration": 2, "score": 0.1})
+    rcs.on_result("strong", {"training_iteration": 2, "score": 0.9})
+    assert rcs.base_scheduler is pbt
+    assert rcs.should_perturb("weak", {"training_iteration": 2})
+    decision = rcs.exploit_decision(
+        "weak", {"weak": {"lr": 1e-3}, "strong": {"lr": 1e-2}})
+    assert decision is not None and decision[0] == "strong"
