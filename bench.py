@@ -234,6 +234,86 @@ def bench_core(extras):
     return sync_rate
 
 
+def bench_envelope(extras):
+    """Single-node scalability envelope (reference:
+    release/benchmarks/README.md:27-31 + the committed results in
+    release/perf_metrics/scalability/single_node.json — 10k args
+    17.28s, 3k returns 5.81s, 10k-object get 23.88s, 1M queued 193s,
+    100 GiB put+get 30.34s on an m4.16xlarge). Run at this box's scale;
+    the queued-task row reports a per-million scaling of the measured
+    100k."""
+    if _budget_left() < 180:
+        extras["envelope_skipped"] = "bench budget exhausted"
+        return
+    try:
+        import numpy as np
+
+        import ray_tpu
+        ray_tpu.init(num_cpus=min(os.cpu_count() or 4, 16))
+
+        @ray_tpu.remote
+        def many_args(*args):
+            return len(args)
+
+        @ray_tpu.remote
+        def nop():
+            return 1
+
+        refs = [ray_tpu.put(i) for i in range(10000)]
+        t0 = time.perf_counter()
+        assert ray_tpu.get(many_args.remote(*refs)) == 10000
+        extras["env_10k_args_s"] = round(time.perf_counter() - t0, 2)
+        del refs
+
+        @ray_tpu.remote(num_returns=3000)
+        def many_returns():
+            return tuple(range(3000))
+
+        t0 = time.perf_counter()
+        out = ray_tpu.get(list(many_returns.remote()))
+        assert out[-1] == 2999
+        extras["env_3k_returns_s"] = round(time.perf_counter() - t0, 2)
+
+        refs = [ray_tpu.put(np.zeros(100)) for _ in range(10000)]
+        t0 = time.perf_counter()
+        ray_tpu.get(refs)
+        extras["env_10k_get_s"] = round(time.perf_counter() - t0, 2)
+        del refs
+
+        n_q = 100_000
+        t0 = time.perf_counter()
+        refs = [nop.remote() for _ in range(n_q)]
+        ray_tpu.get(refs)
+        dt = time.perf_counter() - t0
+        extras["env_100k_queued_s"] = round(dt, 2)
+        extras["env_queued_scaled_1m_s"] = round(dt * 1e6 / n_q, 1)
+        del refs
+
+        import shutil
+        gib = 4 if shutil.disk_usage("/dev/shm").free > 12 << 30 else 1
+        big = np.zeros((gib << 30,), dtype=np.uint8)
+        t0 = time.perf_counter()
+        got = ray_tpu.get(ray_tpu.put(big))
+        assert got.nbytes == big.nbytes
+        extras["env_big_put_get_gib"] = gib
+        extras["env_big_put_get_s"] = round(time.perf_counter() - t0, 2)
+        del big, got
+        extras.update({
+            "baseline_env_10k_args_s": 17.28,
+            "baseline_env_3k_returns_s": 5.81,
+            "baseline_env_10k_get_s": 23.88,
+            "baseline_env_1m_queued_s": 193.0,
+        })
+    except Exception as e:
+        extras["envelope_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            import ray_tpu
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
 def bench_serve(extras):
     """HTTP data-plane micro-bench (VERDICT r1 #9: nop deployment
     req/s + p50 through the async proxy)."""
@@ -665,6 +745,7 @@ def bench_tpu(extras):
 def main():
     extras = {}
     sync_rate = bench_core(extras)
+    bench_envelope(extras)
     bench_serve(extras)
     bench_broadcast(extras)
     # The resnet PIPELINE bench must precede the driver's own jax TPU

@@ -59,6 +59,11 @@ class RayConfig:
         # -- object spilling (reference: object_spilling_config,
         #    LocalObjectManager) -----------------------------------------
         "object_spilling_enabled": True,
+        # Spill target URI routed through pyarrow.fs ("" = the session-
+        # local spill directory). file://, gs://, s3:// — TPU VMs with
+        # small local disks spill to object storage (reference:
+        # object_spilling_config URIs incl. S3).
+        "object_spilling_path": "",
         # objects below this size stay in shm (reference default 100 MiB;
         # small here so capacity-bounded test stores can spill anything)
         "min_spilling_size": 0,

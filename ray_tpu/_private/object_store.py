@@ -87,6 +87,7 @@ class ObjectStore:
         # a disk directory derived from the store dir — deterministic, so
         # any process of the session can restore without coordination.
         self._spill_dir = session_dir.rstrip("/") + "_spill"
+        self._spill = _SpillTarget(self._spill_dir)
         self._spilled_bytes = 0
         self._spilled_count = 0
         self._restored_count = 0
@@ -211,18 +212,25 @@ class ObjectStore:
         for _, oid, seg in candidates:
             if reclaimed >= need_bytes:
                 break
-            dst = self._spill_path(oid)
-            tmp = dst + ".tmp"
             try:
-                import shutil
-                shutil.copyfile(seg.path, tmp)
-                os.rename(tmp, dst)
+                if self._spill.remote:
+                    with open(seg.path, "rb") as f:
+                        self._spill.write(oid.hex(), f.read())
+                else:
+                    dst = self._spill_path(oid)
+                    tmp = dst + ".tmp"
+                    try:
+                        import shutil
+                        shutil.copyfile(seg.path, tmp)
+                        os.rename(tmp, dst)
+                    except OSError:
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
+                        raise
                 os.unlink(seg.path)
-            except OSError:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+            except Exception:
                 continue
             seg.file_exists = False
             self._segments.pop(oid, None)
@@ -259,7 +267,7 @@ class ObjectStore:
         with self._lock:
             return (object_id in self._segments
                     or os.path.exists(self._path(object_id))
-                    or os.path.exists(self._spill_path(object_id)))
+                    or self._spill.exists(object_id.hex()))
 
     def _open(self, object_id: ObjectID) -> _Segment:
         with self._lock:
@@ -276,17 +284,27 @@ class ObjectStore:
                 fd = os.open(path, os.O_RDWR)
             except OSError:
                 # Spilled (by this or another process — possibly between
-                # our getsize and open): restore from disk. The mapping
-                # reads straight off the page cache; the object is NOT
-                # re-admitted to shm accounting.
-                path = self._spill_path(object_id)
-                size = os.path.getsize(path)
-                fd = os.open(path, os.O_RDWR)
+                # our getsize and open): restore. Local spills mmap off
+                # the page cache; URI spills stream into an anonymous
+                # mapping. The object is NOT re-admitted to shm
+                # accounting either way.
                 from_spill = True
-            try:
-                mm = mmap.mmap(fd, size)
-            finally:
-                os.close(fd)
+                if self._spill.remote:
+                    data = self._spill.read_view(object_id.hex())
+                    size = len(data)
+                    mm = mmap.mmap(-1, max(1, size))
+                    mm[0:size] = data
+                    path = self._spill_path(object_id)
+                    fd = None
+                else:
+                    path = self._spill_path(object_id)
+                    size = os.path.getsize(path)
+                    fd = os.open(path, os.O_RDWR)
+            if fd is not None:
+                try:
+                    mm = mmap.mmap(fd, size)
+                finally:
+                    os.close(fd)
             if seg is None:
                 # Readers do not own capacity accounting; only creators do.
                 seg = _Segment(path, mm, size, sealed=True, counted=False)
@@ -333,11 +351,11 @@ class ObjectStore:
     def free(self, object_id: ObjectID):
         with self._lock:
             seg = self._segments.pop(object_id, None)
-            for p in (self._path(object_id), self._spill_path(object_id)):
-                try:
-                    os.unlink(p)
-                except OSError:
-                    pass
+            try:
+                os.unlink(self._path(object_id))
+            except OSError:
+                pass
+            self._spill.delete(object_id.hex())
             if seg is None:
                 return
             seg.file_exists = False
@@ -379,7 +397,112 @@ class ObjectStore:
             # Files written by workers that never reported back (crashes)
             # are not in _segments; sweep the whole session dir.
             shutil.rmtree(self._dir, ignore_errors=True)
-            shutil.rmtree(self._spill_dir, ignore_errors=True)
+            self._spill.cleanup()
+
+
+class _SpillTarget:
+    """Spill-location seam (reference: object spilling to URIs incl.
+    S3 — src/ray/raylet/local_object_manager.* + the spill-worker IO
+    protocol, configured via object_spilling_config). The default is
+    the session-local directory (plain file ops + mmap restore); a
+    `ray_config.object_spilling_path` URI routes writes through
+    pyarrow.fs, so TPU VMs with small local disks can spill to
+    file://, gs://, or s3:// targets."""
+
+    def __init__(self, local_dir: str):
+        self.local_dir = local_dir
+        self._fs = None
+        self._base = None
+        self._base_made = False
+        uri = str(getattr(ray_config, "object_spilling_path", "") or "")
+        if uri:
+            import pyarrow.fs as pafs
+            self._fs, base = pafs.FileSystem.from_uri(uri)
+            # Session-unique subdir: concurrent clusters sharing one
+            # bucket must not collide.
+            self._base = base.rstrip("/") + "/" + os.path.basename(
+                local_dir.rstrip("/"))
+
+    @property
+    def remote(self) -> bool:
+        return self._fs is not None
+
+    def _key(self, oid_hex: str) -> str:
+        return f"{self._base}/{oid_hex}"
+
+    def write(self, oid_hex: str, view) -> None:
+        if self._fs is None:
+            os.makedirs(self.local_dir, exist_ok=True)
+            dst = os.path.join(self.local_dir, oid_hex)
+            tmp = dst + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(view)
+                os.rename(tmp, dst)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            return
+        if not self._base_made:
+            self._fs.create_dir(self._base, recursive=True)
+            self._base_made = True
+        # tmp + move for the same atomicity the local path gets: a
+        # write failing mid-stream must not leave a truncated object at
+        # the final key that exists()/read_view() would then trust.
+        tmp = self._key(oid_hex) + ".tmp"
+        try:
+            with self._fs.open_output_stream(tmp) as f:
+                f.write(view)
+            self._fs.move(tmp, self._key(oid_hex))
+        except Exception:
+            try:
+                self._fs.delete_file(tmp)
+            except Exception:
+                pass
+            raise
+
+    def exists(self, oid_hex: str) -> bool:
+        if self._fs is None:
+            return os.path.exists(os.path.join(self.local_dir, oid_hex))
+        import pyarrow.fs as pafs
+        info = self._fs.get_file_info(self._key(oid_hex))
+        return info.type != pafs.FileType.NotFound
+
+    def read_view(self, oid_hex: str):
+        """Zero-copy-ish read: local spills mmap (pagecache); remote
+        spills stream into one bytes buffer."""
+        if self._fs is None:
+            import mmap as _mmap
+            path = os.path.join(self.local_dir, oid_hex)
+            fd = os.open(path, os.O_RDWR)
+            try:
+                mm = _mmap.mmap(fd, os.path.getsize(path))
+            finally:
+                os.close(fd)
+            return memoryview(mm)
+        with self._fs.open_input_stream(self._key(oid_hex)) as f:
+            return memoryview(f.read())
+
+    def delete(self, oid_hex: str) -> None:
+        try:
+            if self._fs is None:
+                os.unlink(os.path.join(self.local_dir, oid_hex))
+            else:
+                self._fs.delete_file(self._key(oid_hex))
+        except Exception:
+            pass
+
+    def cleanup(self) -> None:
+        import shutil
+        shutil.rmtree(self.local_dir, ignore_errors=True)
+        if self._fs is not None:
+            try:
+                self._fs.delete_dir(self._base)
+            except Exception:
+                pass
 
 
 class _ArenaPin:
@@ -443,6 +566,7 @@ class ArenaObjectStore:
         self._path = os.path.join(session_dir, "arena.shm")
         self._capacity = capacity or _default_capacity()
         self._spill_dir = session_dir.rstrip("/") + "_spill"
+        self._spill = _SpillTarget(self._spill_dir)
         try:
             self._store = _native.NativeStore(
                 self._path, self._capacity, create=True)
@@ -578,19 +702,11 @@ class ArenaObjectStore:
                 # Created-but-unsealed (a writer is mid two-phase put):
                 # not spillable NOW, but must stay tracked.
                 continue
-            dst = self._spill_path(oid)
-            tmp = dst + ".tmp"
             try:
-                with open(tmp, "wb") as f:
-                    f.write(view)
-                os.rename(tmp, dst)
-            except OSError:
+                self._spill.write(oid.hex(), view)
+            except Exception:
                 view.release()
                 self._store.release(oid)
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
                 continue
             view.release()
             self._store.release(oid)   # our read pin
@@ -599,10 +715,7 @@ class ArenaObjectStore:
                 self._store.delete(oid)
             except RuntimeError:
                 # Reader still pinning: keep it resident, drop the copy.
-                try:
-                    os.unlink(dst)
-                except OSError:
-                    pass
+                self._spill.delete(oid.hex())
                 # re-take the creator pin we dropped
                 try:
                     v = self._store.get(oid)
@@ -750,7 +863,7 @@ class ArenaObjectStore:
         with self._lock:
             if object_id in self._external:
                 return True
-        return os.path.exists(self._spill_path(object_id))
+        return self._spill.exists(object_id.hex())
 
     def _pinned_view(self, object_id: ObjectID):
         try:
@@ -779,18 +892,13 @@ class ArenaObjectStore:
             return self._restore_view(object_id)
 
     def _restore_view(self, object_id: ObjectID):
-        """Read a spilled object from disk (page-cache mmap; not
-        re-admitted to the arena)."""
-        import mmap as _mmap
-        path = self._spill_path(object_id)
-        fd = os.open(path, os.O_RDWR)
-        try:
-            mm = _mmap.mmap(fd, os.path.getsize(path))
-        finally:
-            os.close(fd)
+        """Read a spilled object back (local: page-cache mmap; URI
+        targets: streamed through pyarrow.fs; not re-admitted to the
+        arena)."""
+        view = self._spill.read_view(object_id.hex())
         with self._lock:
             self._restored_count += 1
-        return memoryview(mm)
+        return view
 
     def adopt(self, object_id: ObjectID, size: int):
         """Owner-side tracking for a segment a worker created (arena
@@ -812,10 +920,7 @@ class ArenaObjectStore:
                     pass
             self._maybe_prune_foreign(path)
             return  # adopted objects hold no local bytes
-        try:
-            os.unlink(self._spill_path(object_id))
-        except OSError:
-            pass
+        self._spill.delete(object_id.hex())
         try:
             self._store.release(object_id)  # drop creator pin
             self._store.delete(object_id)
@@ -885,7 +990,7 @@ class ArenaObjectStore:
                 pass
         self._store.close(unlink=self._owner)
         if self._owner:
-            shutil.rmtree(self._spill_dir, ignore_errors=True)
+            self._spill.cleanup()
             shutil.rmtree(os.path.dirname(self._path),
                           ignore_errors=True)
 

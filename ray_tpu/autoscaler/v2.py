@@ -236,7 +236,9 @@ class InstanceManager:
         spawn/terminate, potentially seconds each) run OUTSIDE the lock
         so launch decisions never serialize behind slow drains."""
         with self._lock:
+            self._pending_dead_terminations: List[Instance] = []
             self._progress_lifecycles()
+            dead = self._pending_dead_terminations
             demands, bundles = self._cluster_demand()
             # get_nodes_to_launch is called EVERY pass (with possibly
             # empty demand): it is also what maintains min_workers
@@ -267,6 +269,12 @@ class InstanceManager:
                 self.provider.terminate(inst)
             finally:
                 inst.transition(TERMINATED)
+        for inst in dead:
+            # Already TERMINATED state-wise; release the machine.
+            try:
+                self.provider.terminate(inst)
+            except Exception:
+                pass
         return self.status_counts()
 
     def _queue_instance(self, node_type: str):
@@ -297,8 +305,13 @@ class InstanceManager:
                     inst.transition(TERMINATED)
             elif inst.status == RAY_RUNNING:
                 # Instance whose daemon died externally: reconcile out.
+                # The machine itself still needs releasing — for cloud
+                # providers (k8s pod, TPU slice) it may still be
+                # running/billing — but provider calls are slow, so the
+                # caller terminates OUTSIDE the lock (dead_list).
                 if inst.node_id_hex not in self._rt.head_server.daemons:
                     inst.transition(TERMINATED)
+                    self._pending_dead_terminations.append(inst)
 
     def _node_busy(self, node_id_hex: str) -> bool:
         entry = self._rt.node_registry.get(node_id_hex)

@@ -38,12 +38,13 @@ class _ProxyState:
 
     def _update_routes(self, routes: Dict[str, tuple]):
         with self._lock:
-            changed = self._routes != dict(routes or {})
             self._routes = dict(routes or {})
-        if changed and self._on_routes_changed is not None:
-            # Deployments may have been replaced under the same name
-            # with a different TYPE: learned per-deployment verdicts
-            # (unary/stream, ASGI/classic) must re-learn.
+        if self._on_routes_changed is not None:
+            # Route pushes only happen on deploy/delete, and a redeploy
+            # under the SAME name/prefix produces an identical table —
+            # so every push clears the learned per-deployment verdicts
+            # (unary/stream, ASGI/classic); one re-learning request per
+            # deploy is the cost.
             self._on_routes_changed()
 
     def match(self, path: str) -> Optional[tuple]:

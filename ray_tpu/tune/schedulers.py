@@ -253,6 +253,16 @@ class PB2(PopulationBasedTraining):
         self._y: List[float] = []
         self._prev: Dict[str, Tuple[float, float]] = {}  # tid -> (t, score)
 
+    def exploit_decision(self, trial_id: str,
+                         configs: Dict[str, Dict]) -> Optional[Tuple[str, Dict]]:
+        decision = super().exploit_decision(trial_id, configs)
+        if decision is not None:
+            # The clone resumes from the SOURCE's checkpoint: the score
+            # jump across the boundary is inheritance, not this
+            # config's reward change — it must not train the GP.
+            self._prev.pop(trial_id, None)
+        return decision
+
     # Controller hook: result + the trial's CURRENT config.
     def observe(self, trial_id: str, result: Dict, config: Dict):
         if not self._has_metric(result):

@@ -1,0 +1,154 @@
+"""Kubernetes/GKE node provider with a fake kubectl runner.
+
+Reference: the kuberay autoscaler path
+(python/ray/autoscaler/_private/kuberay/node_provider.py). The fake
+runner implements an in-memory pod store speaking kubectl's JSON
+surface, so provisioning logic and the v2 InstanceManager integration
+run without a cluster.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.k8s_provider import (KubernetesNodeProvider,
+                                             NodeProviderInstanceAdapter)
+from ray_tpu.autoscaler.node_provider import TAG_NODE_TYPE
+
+
+class FakeKubectl:
+    """In-memory pod store behind kubectl's argv surface."""
+
+    def __init__(self):
+        self.pods = {}
+        self.lock = threading.Lock()
+        self.calls = []
+
+    def __call__(self, argv, stdin_text=None):
+        self.calls.append(list(argv))
+        assert argv[0] == "kubectl" and argv[1] == "-n"
+        args = argv[3:]
+        with self.lock:
+            if args[0] == "create":
+                pod = json.loads(stdin_text)
+                pod.setdefault("status", {})["phase"] = "Pending"
+                self.pods[pod["metadata"]["name"]] = pod
+                return ""
+            if args[0] == "get":
+                sel = args[args.index("-l") + 1]
+                key, val = sel.split("=", 1)
+                items = [p for p in self.pods.values()
+                         if p["metadata"]["labels"].get(key) == val]
+                return json.dumps({"items": items})
+            if args[0] == "delete":
+                self.pods.pop(args[2], None)
+                return ""
+        raise AssertionError(f"unexpected kubectl {args}")
+
+    def set_running(self, name, ip="10.0.0.9"):
+        with self.lock:
+            self.pods[name]["status"] = {"phase": "Running", "podIP": ip}
+
+
+@pytest.fixture()
+def provider():
+    fake = FakeKubectl()
+    prov = KubernetesNodeProvider(
+        {"namespace": "ray", "image": "img:1",
+         "tpu_accelerator": "tpu-v5-lite-podslice",
+         "tpu_topology": "2x4", "tpu_chips_per_host": 4,
+         "head_address": "10.0.0.1:6379"},
+        cluster_name="kc", runner=fake)
+    return prov, fake
+
+
+def test_create_list_tags_terminate(provider):
+    prov, fake = provider
+    ids = prov.create_node({}, {TAG_NODE_TYPE: "tpu_worker"}, 2)
+    assert len(ids) == 2
+    assert sorted(prov.non_terminated_nodes({})) == sorted(ids)
+    assert prov.node_tags(ids[0])[TAG_NODE_TYPE] == "tpu_worker"
+    assert not prov.is_running(ids[0])  # Pending
+    fake.set_running(ids[0])
+    assert prov.is_running(ids[0])
+    assert prov.internal_ip(ids[0]) == "10.0.0.9"
+    prov.terminate_node(ids[1])
+    assert prov.non_terminated_nodes({}) == [ids[0]]
+
+
+def test_manifest_targets_gke_tpu_node_pool(provider):
+    prov, fake = provider
+    (nid,) = prov.create_node({}, {TAG_NODE_TYPE: "tpu_worker"}, 1)
+    pod = fake.pods[nid]
+    sel = pod["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    limits = pod["spec"]["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == "4"
+    assert "--address=10.0.0.1:6379" in \
+        pod["spec"]["containers"][0]["command"][-1]
+
+
+def test_v2_instance_manager_scales_up_and_down(provider, shutdown_only):
+    from ray_tpu.autoscaler.v2 import RAY_RUNNING, InstanceManager
+
+    prov, fake = provider
+    ray_tpu.init(num_cpus=1)
+
+    # Fake correlation: a Running pod "registers" a daemon whose node
+    # hex is derived from the pod name (the injectable seam real
+    # deployments fill via head registration).
+    registered = {}
+
+    def lookup(pod_name):
+        return registered.get(pod_name)
+
+    adapter = NodeProviderInstanceAdapter(prov, daemon_lookup=lookup)
+    mgr = InstanceManager(
+        node_types={"tpu_worker": {"resources": {"CPU": 1, "pool": 1},
+                                   "max_workers": 2,
+                                   "node_config": {}}},
+        provider=adapter, max_workers=2, idle_timeout_s=0.5)
+    try:
+        @ray_tpu.remote(resources={"pool": 1})
+        def probe():
+            return 1
+
+        ref = probe.remote()  # standing demand for the pool resource
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not fake.pods:
+            mgr.reconcile()
+            time.sleep(0.1)
+        assert fake.pods, "v2 demand never created a pod"
+
+        # Pod comes up; the 'daemon' registers; instance turns RUNNING.
+        name = next(iter(fake.pods))
+        fake.set_running(name)
+        registered[name] = "feedbeef" * 4
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            mgr.reconcile()
+            if any(i.status == RAY_RUNNING
+                   for i in mgr.instances.values()):
+                break
+            time.sleep(0.1)
+        assert any(i.status == RAY_RUNNING
+                   for i in mgr.instances.values())
+        ray_tpu.cancel(ref)
+
+        # Scale-down: the fake daemon never really registered with the
+        # head, so the next passes reconcile the instance out — the
+        # provider must DELETE this pod through kubectl. (Residual
+        # demand may spawn a fresh replacement pod; the assertion is
+        # about THIS instance's teardown.)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and name in fake.pods:
+            mgr.reconcile()
+            time.sleep(0.1)
+        assert name not in fake.pods, list(fake.pods)
+    finally:
+        mgr.shutdown()

@@ -1,0 +1,61 @@
+"""Scalability-envelope invariants at CI scale.
+
+Reference commits these limits for a single node
+(release/benchmarks/README.md:27-31): many object args to one task,
+thousands of returns, many-object gets, deep task queues, and
+multi-GiB objects. bench.py measures them at full scale; these tests
+pin the INVARIANTS (they work at all, results are correct) at a scale
+that stays fast in-suite.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _runtime():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+
+
+def test_thousand_object_args_single_task():
+    @ray_tpu.remote
+    def many(*args):
+        return sum(args)
+
+    refs = [ray_tpu.put(i) for i in range(1000)]
+    assert ray_tpu.get(many.remote(*refs), timeout=120) == sum(range(1000))
+
+
+def test_five_hundred_returns():
+    @ray_tpu.remote(num_returns=500)
+    def gen():
+        return tuple(range(500))
+
+    out = ray_tpu.get(list(gen.remote()), timeout=120)
+    assert out == list(range(500))
+
+
+def test_two_thousand_object_get_ordered():
+    refs = [ray_tpu.put(np.full(10, i)) for i in range(2000)]
+    vals = ray_tpu.get(refs, timeout=120)
+    assert all(int(v[0]) == i for i, v in enumerate(vals))
+
+
+def test_ten_thousand_queued_tasks():
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    refs = [one.remote() for _ in range(10000)]
+    assert sum(ray_tpu.get(refs, timeout=300)) == 10000
+
+
+def test_one_gib_object_roundtrip():
+    big = np.arange(1 << 27, dtype=np.uint8)  # 128 MiB pattern x checks
+    ref = ray_tpu.put(big)
+    got = ray_tpu.get(ref)
+    assert got.nbytes == big.nbytes
+    assert got[12345] == big[12345] and got[-1] == big[-1]

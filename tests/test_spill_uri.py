@@ -1,0 +1,105 @@
+"""Object spilling through the pyarrow-fs URI seam.
+
+Reference: spilling to URI targets including S3
+(src/ray/raylet/local_object_manager.* + spill workers configured via
+object_spilling_config). The seam is exercised with file:// — the same
+pyarrow.fs code path gs:// and s3:// take.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.config import ray_config
+from ray_tpu._private.ids import ObjectID
+
+
+@pytest.fixture()
+def uri_spill(tmp_path, monkeypatch):
+    target = tmp_path / "bucket"
+    target.mkdir()
+    monkeypatch.setitem(ray_config._values, "object_spilling_path",
+                        f"file://{target}")
+    yield str(target)
+
+
+def _arena(tmp_path, capacity):
+    pytest.importorskip("ray_tpu._native")
+    from ray_tpu import _native
+    if not _native.available():
+        pytest.skip("native store unavailable")
+    from ray_tpu._private.object_store import ArenaObjectStore
+    return ArenaObjectStore(str(tmp_path / "store"), capacity=capacity)
+
+
+def test_spill_restore_roundtrip_through_uri(tmp_path, uri_spill):
+    store = _arena(tmp_path, capacity=4 << 20)
+    try:
+        payloads = {}
+        # Overflow a tiny arena: earlier objects must spill to the URI.
+        for i in range(6):
+            oid = ObjectID.from_random()
+            data = np.full(1 << 20, i, dtype=np.uint8)
+            store.put(oid, data)
+            payloads[oid] = data
+        stats = store.stats()
+        assert stats["spilled_count"] > 0, stats
+        # Spilled bytes landed under the URI target, not the local dir.
+        spilled_files = []
+        for root, _dirs, files in os.walk(uri_spill):
+            spilled_files += files
+        assert spilled_files, "nothing written through the pyarrow.fs seam"
+        # Every object restores with correct bytes, wherever it lives.
+        for oid, data in payloads.items():
+            got = store.get(oid)
+            assert np.array_equal(got, data), int(data[0])
+    finally:
+        store.shutdown()
+
+
+def test_uri_spill_free_deletes_remote_copy(tmp_path, uri_spill):
+    store = _arena(tmp_path, capacity=4 << 20)
+    try:
+        oids = []
+        for i in range(4):
+            oid = ObjectID.from_random()
+            store.put(oid, np.full(1 << 20, i, dtype=np.uint8))
+            oids.append(oid)
+        n_before = sum(len(f) for _r, _d, f in os.walk(uri_spill))
+        assert n_before > 0
+        for oid in oids:
+            store.free(oid)
+        n_after = sum(len(f) for _r, _d, f in os.walk(uri_spill))
+        assert n_after == 0, n_after
+    finally:
+        store.shutdown()
+
+
+def test_shutdown_cleans_uri_target(tmp_path, uri_spill):
+    store = _arena(tmp_path, capacity=4 << 20)
+    for i in range(4):
+        store.put(ObjectID.from_random(),
+                  np.full(1 << 20, i, dtype=np.uint8))
+    store.shutdown()
+    leftovers = [f for _r, _d, fs in os.walk(uri_spill) for f in fs]
+    assert not leftovers, leftovers
+
+
+def test_file_store_uri_spill_roundtrip(tmp_path, uri_spill):
+    from ray_tpu._private.object_store import ObjectStore
+    store = ObjectStore(str(tmp_path / "fstore"), capacity=4 << 20)
+    try:
+        payloads = {}
+        for i in range(6):
+            oid = ObjectID.from_random()
+            data = np.full(1 << 20, i, dtype=np.uint8)
+            store.put(oid, data)
+            payloads[oid] = data
+        assert store.stats()["spilled_count"] > 0
+        files = [f for _r, _d, fs in os.walk(uri_spill) for f in fs]
+        assert files, "file store never wrote through the URI seam"
+        for oid, data in payloads.items():
+            assert np.array_equal(store.get(oid), data)
+    finally:
+        store.shutdown()
