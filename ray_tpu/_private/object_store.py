@@ -214,8 +214,11 @@ class ObjectStore:
                 break
             try:
                 if self._spill.remote:
-                    with open(seg.path, "rb") as f:
-                        self._spill.write(oid.hex(), f.read())
+                    # NOTE: remote spill I/O currently runs under the
+                    # store lock (like the local copy it replaces);
+                    # streamed in chunks so no whole-object heap copy
+                    # happens at the moment of memory pressure.
+                    self._spill.write_file(oid.hex(), seg.path)
                 else:
                     dst = self._spill_path(oid)
                     tmp = dst + ".tmp"
@@ -456,6 +459,33 @@ class _SpillTarget:
         try:
             with self._fs.open_output_stream(tmp) as f:
                 f.write(view)
+            self._fs.move(tmp, self._key(oid_hex))
+        except Exception:
+            try:
+                self._fs.delete_file(tmp)
+            except Exception:
+                pass
+            raise
+
+    def write_file(self, oid_hex: str, src_path: str,
+                   chunk: int = 8 << 20) -> None:
+        """Stream a local file to the target in chunks (no whole-object
+        heap copy — spilling happens under memory pressure)."""
+        if self._fs is None:
+            self.write(oid_hex, open(src_path, "rb").read())
+            return
+        if not self._base_made:
+            self._fs.create_dir(self._base, recursive=True)
+            self._base_made = True
+        tmp = self._key(oid_hex) + ".tmp"
+        try:
+            with open(src_path, "rb") as src, \
+                    self._fs.open_output_stream(tmp) as dst:
+                while True:
+                    buf = src.read(chunk)
+                    if not buf:
+                        break
+                    dst.write(buf)
             self._fs.move(tmp, self._key(oid_hex))
         except Exception:
             try:

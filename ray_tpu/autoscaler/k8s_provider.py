@@ -70,6 +70,14 @@ class KubernetesNodeProvider(NodeProvider):
         self._run = runner or _default_runner
         self.namespace = provider_config.get("namespace", "default")
         self.image = provider_config.get("image", "ray-tpu:latest")
+        # Pod-list micro-cache: one reconcile pass queries
+        # is_running/node_tags/internal_ip per instance — without the
+        # cache that is O(instances) kubectl subprocess round-trips
+        # per pass, inside the InstanceManager lock.
+        self._pods_cache: Optional[List[Dict[str, Any]]] = None
+        self._pods_cache_t = 0.0
+        self.pods_cache_ttl_s = float(
+            provider_config.get("pods_cache_ttl_s", 2.0))
 
     # -- kubectl plumbing --------------------------------------------------
     def _kubectl(self, args: List[str],
@@ -78,10 +86,20 @@ class KubernetesNodeProvider(NodeProvider):
                          stdin_text)
 
     def _pods(self) -> List[Dict[str, Any]]:
+        import time
+        now = time.monotonic()
+        if (self._pods_cache is not None
+                and now - self._pods_cache_t < self.pods_cache_ttl_s):
+            return self._pods_cache
         raw = self._kubectl([
             "get", "pods", "-l", f"{_CLUSTER_LABEL}={self.cluster_name}",
             "-o", "json"])
-        return json.loads(raw or "{}").get("items", [])
+        self._pods_cache = json.loads(raw or "{}").get("items", [])
+        self._pods_cache_t = now
+        return self._pods_cache
+
+    def _invalidate_pods(self):
+        self._pods_cache = None
 
     # -- NodeProvider surface ---------------------------------------------
     def non_terminated_nodes(self, tag_filters: Optional[Dict] = None
@@ -125,10 +143,12 @@ class KubernetesNodeProvider(NodeProvider):
             self._kubectl(["create", "-f", "-"],
                           stdin_text=json.dumps(manifest))
             created.append(name)
+        self._invalidate_pods()
         return created
 
     def terminate_node(self, node_id: str):
         self._kubectl(["delete", "pod", node_id, "--wait=false"])
+        self._invalidate_pods()
 
     # -- manifest ----------------------------------------------------------
     def _tags_of(self, pod: Dict[str, Any]) -> Dict[str, str]:

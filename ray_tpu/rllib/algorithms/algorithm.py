@@ -38,6 +38,11 @@ class AlgorithmConfig:
         # multi-agent (reference: AlgorithmConfig.multi_agent)
         self.policies: Optional[Dict[str, Any]] = None
         self.policy_mapping_fn: Optional[Callable] = None
+        self.policies_to_train: Optional[list] = None
+        # curriculum learning (reference: env_task_fn — called with
+        # (train_result, current_task) after every iteration; a changed
+        # return value is pushed to every env runner via env.set_task).
+        self.env_task_fn: Optional[Callable] = None
         # connector factories (reference: AlgorithmConfig connectors)
         self.env_to_module_connector: Optional[Callable] = None
         self.module_to_env_connector: Optional[Callable] = None
@@ -47,11 +52,14 @@ class AlgorithmConfig:
         self.evaluation_duration: int = 5       # episodes per round
         self.evaluation_num_env_runners: int = 0  # 0 = driver rollouts
 
-    def environment(self, env=None, *, env_config: Optional[Dict] = None):
+    def environment(self, env=None, *, env_config: Optional[Dict] = None,
+                    env_task_fn: Optional[Callable] = None):
         if env is not None:
             self.env_spec = env
         if env_config is not None:
             self.env_config = dict(env_config)
+        if env_task_fn is not None:
+            self.env_task_fn = env_task_fn
         return self
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
@@ -116,16 +124,21 @@ class AlgorithmConfig:
         return self
 
     def multi_agent(self, *, policies: Optional[Dict[str, Any]] = None,
-                    policy_mapping_fn: Optional[Callable] = None):
+                    policy_mapping_fn: Optional[Callable] = None,
+                    policies_to_train: Optional[list] = None):
         """Reference: AlgorithmConfig.multi_agent(policies=...,
-        policy_mapping_fn=...). `policies` maps module_id → None (infer
-        spaces from the env's first mapped agent) or (obs_dim,
-        num_actions). The mapping fn takes an agent id and returns a
-        module id."""
+        policy_mapping_fn=..., policies_to_train=...). `policies` maps
+        module_id → None (infer spaces from the env's first mapped
+        agent) or (obs_dim, num_actions). The mapping fn takes an agent
+        id and returns a module id. `policies_to_train` restricts
+        gradient updates to the listed module ids — frozen opponents in
+        league/self-play setups sample but never learn."""
         if policies is not None:
             self.policies = dict(policies)
         if policy_mapping_fn is not None:
             self.policy_mapping_fn = policy_mapping_fn
+        if policies_to_train is not None:
+            self.policies_to_train = list(policies_to_train)
         return self
 
     def build(self) -> "Algorithm":
@@ -244,6 +257,19 @@ class Algorithm:
             "num_env_steps_sampled_lifetime": self._total_steps,
             "time_this_iter_s": time.perf_counter() - t0,
         })
+        task_fn = getattr(self.config, "env_task_fn", None)
+        if task_fn is not None:
+            # Curriculum learning (reference: env_task_fn): the task fn
+            # sees the iteration result + current task; a CHANGED value
+            # is pushed to every env runner via env.set_task().
+            cur = getattr(self, "_current_task", None)
+            new_task = task_fn(result, cur)
+            self._current_task = new_task
+            if new_task != cur:
+                group = getattr(self, "env_runner_group", None)
+                if group is not None and hasattr(group, "set_task"):
+                    group.set_task(new_task)
+            result["env_task"] = new_task
         return result
 
     def evaluate(self, num_episodes: int = 5) -> Dict[str, float]:

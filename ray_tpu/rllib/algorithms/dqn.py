@@ -73,11 +73,19 @@ class DQN(Algorithm):
 
     def training_step(self) -> Dict:
         cfg = self.config
-        eps_start = float(cfg.extra.get("epsilon_start", 1.0))
-        eps_end = float(cfg.extra.get("epsilon_end", 0.05))
-        eps_iters = float(cfg.extra.get("epsilon_iters", 20))
-        epsilon = max(eps_end, eps_start - (eps_start - eps_end)
-                      * self.iteration / eps_iters)
+        eps_spec = cfg.extra.get("epsilon")
+        if eps_spec is not None:
+            # Schedule-format exploration (reference: the new-API
+            # `epsilon=[[t, v], ...]` config + utils/schedules/):
+            # resolved against total ENV STEPS sampled so far.
+            from ..utils.schedules import Scheduler
+            epsilon = Scheduler(eps_spec).value(self._total_steps)
+        else:
+            eps_start = float(cfg.extra.get("epsilon_start", 1.0))
+            eps_end = float(cfg.extra.get("epsilon_end", 0.05))
+            eps_iters = float(cfg.extra.get("epsilon_iters", 20))
+            epsilon = max(eps_end, eps_start - (eps_start - eps_end)
+                          * self.iteration / eps_iters)
         for frag in self.env_runner_group.sample(
                 cfg.rollout_fragment_length, epsilon=epsilon):
             self.buffer.add_batch(frag)

@@ -135,8 +135,14 @@ class MultiAgentPPO(Algorithm):
         num_epochs = int(cfg.extra.get("num_epochs", 4))
         minibatch = int(cfg.extra.get("minibatch_size", 128))
         stats: Dict[str, Any] = {}
+        to_train = getattr(cfg, "policies_to_train", None)
         for mid, frags in frags_by_mid.items():
             if not frags:
+                continue
+            if to_train is not None and mid not in to_train:
+                # Frozen policy (reference: policies_to_train): samples
+                # for its opponents but never receives gradients —
+                # league/self-play opponents stay fixed snapshots.
                 continue
             params = self.learners[mid].get_weights()
             frags = [self._gae_fragment(mid, f, params) for f in frags]

@@ -31,7 +31,11 @@ def make_ppo_loss(clip: float = 0.2, vf_coeff: float = 0.5,
         policy_loss = -jnp.mean(surrogate)
         vf_loss = 0.5 * jnp.mean((values - batch["value_targets"]) ** 2)
         entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
-        total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        # Scheduled entropy coefficient rides the batch as a scalar
+        # (reference: entropy_coeff_schedule resolved by Scheduler per
+        # update) — absent, the constructor constant applies.
+        ec = batch.get("entropy_coeff", entropy_coeff)
+        total = policy_loss + vf_coeff * vf_loss - ec * entropy
         return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
                        "entropy": entropy}
 
@@ -209,6 +213,12 @@ class PPO(Algorithm):
         batch = {k: np.concatenate([f[k] for f in frags])
                  for k in frags[0]}
         self._total_steps += len(batch["rewards"])
+        ec_sched = cfg.extra.get("entropy_coeff_schedule")
+        ec_now = None
+        if ec_sched is not None:
+            from ..utils.schedules import Scheduler
+            ec_now = np.float32(
+                Scheduler(ec_sched).value(self._total_steps))
         n = len(batch["rewards"])
         idx = np.arange(n)
         rng = np.random.default_rng(cfg.seed + self.iteration)
@@ -221,8 +231,10 @@ class PPO(Algorithm):
                 mb = idx[s:s + minibatch]
                 if len(mb) < 2:
                     continue
-                stats = self.learner.update(
-                    {k: v[mb] for k, v in batch.items()})
+                mb_batch = {k: v[mb] for k, v in batch.items()}
+                if ec_now is not None:
+                    mb_batch["entropy_coeff"] = ec_now
+                stats = self.learner.update(mb_batch)
         self.env_runner_group.sync_weights(self.learner.get_weights())
         return dict(stats)
 

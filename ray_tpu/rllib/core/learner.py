@@ -29,6 +29,15 @@ class JaxLearner:
         # jitted update (reference: rllib/connectors/learner/).
         self.connector = connector
         self.params = module.init_params(seed)
+        if isinstance(lr, (list, tuple)):
+            # Schedule-format lr (reference: `lr=[[t, v], ...]` +
+            # utils/schedules/Scheduler): piecewise-linear over
+            # OPTIMIZER update steps, expressed with jnp.interp so it
+            # traces into the jitted update.
+            ts = np.asarray([float(t) for t, _ in lr], dtype=np.float32)
+            vs = np.asarray([float(v) for _, v in lr], dtype=np.float32)
+            lr = (lambda step: jnp.interp(
+                jnp.asarray(step, jnp.float32), ts, vs))
         self.tx = optax.chain(
             optax.clip_by_global_norm(max_grad_norm),
             optax.adam(lr))

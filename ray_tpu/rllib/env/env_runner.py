@@ -161,6 +161,21 @@ class SingleAgentEnvRunner:
             hook()
         return True
 
+    def set_task(self, task) -> bool:
+        """Curriculum hook (reference: env_task_fn + TaskSettableEnv):
+        forwarded to env.set_task (or env.unwrapped.set_task); the
+        in-flight episode resets so the new task applies cleanly."""
+        target = self.env
+        fn = getattr(target, "set_task", None)
+        if fn is None:
+            fn = getattr(getattr(target, "unwrapped", target),
+                         "set_task", None)
+        if fn is None:
+            return False
+        fn(task)
+        self.reset_episode()
+        return True
+
     def ping(self) -> bool:
         return True
 
@@ -212,6 +227,11 @@ class EnvRunnerGroup:
     def reset_episodes(self, seed=None):
         ray_tpu.get([r.reset_episode.remote(seed)
                      for r in self._runners])
+
+    def set_task(self, task):
+        """Fan a curriculum task out to every runner's env."""
+        return ray_tpu.get([r.set_task.remote(task)
+                            for r in self._runners])
 
     def stop(self):
         for r in self._runners:

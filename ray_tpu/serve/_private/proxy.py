@@ -38,13 +38,13 @@ class _ProxyState:
 
     def _update_routes(self, routes: Dict[str, tuple]):
         with self._lock:
+            changed = self._routes != dict(routes or {})
             self._routes = dict(routes or {})
-        if self._on_routes_changed is not None:
-            # Route pushes only happen on deploy/delete, and a redeploy
-            # under the SAME name/prefix produces an identical table —
-            # so every push clears the learned per-deployment verdicts
-            # (unary/stream, ASGI/classic); one re-learning request per
-            # deploy is the cost.
+        if changed and self._on_routes_changed is not None:
+            # Table changed: deployments may be new types — forget the
+            # learned verdicts. (A same-name redeploy leaves the table
+            # identical; that case self-corrects response-side — the
+            # proxy re-learns the verdict from every response.)
             self._on_routes_changed()
 
     def match(self, path: str) -> Optional[tuple]:
@@ -256,10 +256,15 @@ class HTTPProxy:
                     resp = await loop.run_in_executor(
                         None, lambda: handle.remote(req))
                 result = await resp
-                if is_asgi is None:
-                    self._asgi[mode_key] = bool(
-                        isinstance(result, dict)
-                        and result.get("__asgi__"))
+                # ALWAYS refresh from the response (not just when
+                # unknown): a same-name redeploy swapping the
+                # deployment type leaves the route table identical, so
+                # this is the invalidation path — one degraded request,
+                # then the verdict is right again.
+                got_asgi = bool(isinstance(result, dict)
+                                and result.get("__asgi__"))
+                if self._asgi.get(mode_key) != got_asgi:
+                    self._asgi[mode_key] = got_asgi
                 return _to_web_response(result)
             except Exception as e:
                 # TaskError carries the remote class name in its message.
