@@ -922,29 +922,13 @@ class Dataset:
         host-only `prefetch_batches` can't provide; VERDICT r3 weak
         #6). `sharding` (a jax.sharding.Sharding) places batches onto a
         mesh for pjit'd steps; `device` pins a single device."""
-        import collections
-
-        import jax
-
-        if device is not None and sharding is not None:
-            raise ValueError(
-                "iter_jax_batches: pass device= OR sharding=, not both")
-        # jax.device_put(v, None) == default placement, so one lambda
-        # covers the pinned, sharded, and default cases.
-        target = sharding if sharding is not None else device
-
-        def convert(v):
-            return jax.device_put(v, target)
-        depth = max(1, int(device_prefetch))
-        window: collections.deque = collections.deque()
-        # device_put is async: the transfer streams while host code
-        # continues, so the window holds in-flight uploads.
-        for batch in self._iter_framework_batches(convert, **kwargs):
-            window.append(batch)
-            if len(window) > depth:
-                yield window.popleft()
-        while window:
-            yield window.popleft()
+        from . import streaming
+        streaming._require_drop_last_for_sharding(sharding, kwargs)
+        kwargs.pop("batch_format", None)  # conversion fixes the format
+        return streaming.jax_device_feed(
+            self.iter_batches(batch_format="numpy", **kwargs),
+            device=device, sharding=sharding,
+            device_prefetch=device_prefetch)
 
     def iter_torch_batches(self, **kwargs):
         """(reference: dataset.py iter_torch_batches)"""

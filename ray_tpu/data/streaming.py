@@ -193,6 +193,48 @@ class _SplitCoordinator:
         return {"rows_given": list(self._rows_given)}
 
 
+def jax_device_feed(batches: Iterator, *, device=None, sharding=None,
+                    device_prefetch: int = 2) -> Iterator:
+    """Shared device-upload window behind Dataset.iter_jax_batches and
+    DataIterator.iter_jax_batches: yields batches already on the
+    accelerator with up to `device_prefetch` async uploads in flight
+    (0 = upload synchronously with consumption, no device-side
+    buffering). jax.device_put(v, None) is default placement, so one
+    target covers the pinned, sharded, and default cases."""
+    import collections
+
+    import jax
+
+    if device is not None and sharding is not None:
+        raise ValueError("pass device= OR sharding=, not both")
+    target = sharding if sharding is not None else device
+    depth = int(device_prefetch)
+    if depth < 0:
+        raise ValueError("device_prefetch must be >= 0")
+    window: collections.deque = collections.deque()
+    for batch in batches:
+        put = {k: jax.device_put(v, target) for k, v in batch.items()}
+        if depth == 0:
+            yield put
+            continue
+        window.append(put)
+        if len(window) > depth:
+            yield window.popleft()
+    while window:
+        yield window.popleft()
+
+
+def _require_drop_last_for_sharding(sharding, kwargs: dict) -> None:
+    """A mesh sharding needs every batch divisible by the axis size;
+    the trailing partial batch generally is not — demand an explicit
+    drop_last=True instead of crashing at epoch end."""
+    if sharding is not None and not kwargs.get("drop_last"):
+        raise ValueError(
+            "iter_jax_batches(sharding=...) requires drop_last=True: "
+            "the final partial batch is generally not divisible by the "
+            "mesh axis and jax.device_put would fail at epoch end")
+
+
 class DataIterator:
     """Per-consumer shard stream (reference: data/iterator.py DataIterator
     returned by streaming_split). Picklable — holds only the coordinator
@@ -235,6 +277,19 @@ class DataIterator:
                 **{k: v for k, v in kwargs.items()
                    if k in ("batch_size", "drop_last")}):
             yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    def iter_jax_batches(self, *, device=None, device_prefetch: int = 2,
+                         sharding=None, **kwargs):
+        """Device-resident shard feed for train workers (same contract
+        as Dataset.iter_jax_batches): upload latency hides behind the
+        worker's jitted step."""
+        _require_drop_last_for_sharding(sharding, kwargs)
+        batches = self.iter_batches(
+            batch_format="numpy",
+            **{k: v for k, v in kwargs.items()
+               if k in ("batch_size", "drop_last", "prefetch_batches")})
+        return jax_device_feed(batches, device=device, sharding=sharding,
+                               device_prefetch=device_prefetch)
 
     def materialize(self):
         """Collect this shard into a list of blocks (mostly for tests)."""

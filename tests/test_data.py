@@ -434,3 +434,34 @@ def test_iter_jax_batches_sharding(ray_start_shared):
         assert batch["x"].sharding == sharding
         n += batch["x"].shape[0]
     assert n > 0
+
+
+def test_iter_jax_batches_sharding_requires_drop_last(ray_start_shared):
+    import pytest as _pytest
+
+    import jax
+    import numpy as np
+
+    import ray_tpu.data as rdata
+    if len(jax.devices()) < 2:
+        _pytest.skip("needs a multi-device mesh")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    ds = rdata.range(100)
+    with _pytest.raises(ValueError, match="drop_last"):
+        next(iter(ds.iter_jax_batches(batch_size=16, sharding=sharding)))
+
+
+def test_data_iterator_iter_jax_batches(ray_start_shared):
+    import jax
+    import numpy as np
+
+    import ray_tpu.data as rdata
+    ds = rdata.range(256, override_num_blocks=4)
+    (it,) = ds.streaming_split(1)
+    got = []
+    for batch in it.iter_jax_batches(batch_size=64, device_prefetch=1):
+        assert isinstance(batch["id"], jax.Array)
+        got.append(np.asarray(batch["id"]))
+    assert sorted(np.concatenate(got).tolist()) == list(range(256))
