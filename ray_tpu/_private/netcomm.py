@@ -548,12 +548,8 @@ class PullManager:
             gate = (_host_copy_gate if size >= self._serialize_threshold
                     else _NullGate())
             try:
-                import time as _t
-                _g0 = _t.perf_counter()
                 with gate:
-                    _g1 = _t.perf_counter()
                     view = self._store.create(object_id, size)
-                    _g2 = _t.perf_counter()
                     try:
                         view[0:size] = memoryview(mm)[delta:delta + size]
                     except BaseException:
@@ -562,13 +558,6 @@ class PullManager:
                         if abort is not None:
                             abort(object_id)
                         raise
-                    _g3 = _t.perf_counter()
-                if os.environ.get("RAY_TPU_PULL_TRACE"):
-                    with open("/tmp/pull_trace.log", "a") as f:
-                        f.write(f"{os.getpid()} size={size} "
-                                f"gatewait={_g1-_g0:.3f} "
-                                f"create={_g2-_g1:.3f} "
-                                f"copy={_g3-_g2:.3f}\n")
             finally:
                 mm.close()
                 try:
