@@ -61,7 +61,11 @@ struct ConnState {
   uint64_t token = 0;
   std::vector<uint8_t> inbuf;
   std::deque<OutBuf> outq;  // guarded by Dispatcher::mu
-  bool want_write = false;  // IO thread only
+  // Written by the IO thread (EPOLLOUT arm/disarm in flush_out) and
+  // read by app threads on disp_send's inline fast path; atomic so the
+  // cross-thread read is defined. Relaxed is enough: the fast path
+  // only fires with an empty outq, so any stale read is benign.
+  std::atomic<bool> want_write{false};
   bool dead = false;        // IO thread only (after registration)
 };
 

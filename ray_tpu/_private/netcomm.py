@@ -47,16 +47,24 @@ KIND_ARENA = 1  # native arena slot: the peer may adopt it in place
 
 
 class _HostCopyGate:
-    """Serializes big same-host copies across ALL processes on this host
-    (flock on a fixed path). Concurrent first-touch of fresh tmpfs pages
-    collapses superlinearly on small hosts — measured 1.48 GB/s solo vs
-    0.04 GB/s each at 4-way on a 1-core box (kernel shmem allocation
-    contention) — so copies above the threshold take turns. Best-effort
-    by design: if the lock file is unusable (permissions, hostile
-    pre-creation) or held for longer than _MAX_WAIT_S, the copy runs
-    ungated — a slow transfer beats a wedged one."""
+    """Serializes big same-host copies across all ray_tpu processes OF
+    THIS UID on this host (flock on a per-uid path). Concurrent
+    first-touch of fresh tmpfs pages collapses superlinearly on small
+    hosts — measured 1.48 GB/s solo vs 0.04 GB/s each at 4-way on a
+    1-core box (kernel shmem allocation contention) — so copies above
+    the threshold take turns. Scoping the lock per-uid is a deliberate
+    security tradeoff: a fixed world-writable path would let any local
+    user symlink-squat it (and have a root daemon chmod an arbitrary
+    file) or hold LOCK_EX to add latency to every large copy; the cost
+    is that copies from DIFFERENT uids on one host no longer take turns.
+    Best-effort by design: if the lock file is unusable (permissions,
+    hostile pre-creation) or held for longer than _MAX_WAIT_S, the copy
+    runs ungated — a slow transfer beats a wedged one."""
 
-    _PATH = "/tmp/.ray_tpu_host_copy.lock"
+    # Per-uid path: processes of other users neither share nor can
+    # pre-create our gate, so a hostile symlink/flock-squat at a fixed
+    # world-writable name is off the table.
+    _PATH = "/tmp/.ray_tpu_host_copy.%d.lock" % os.getuid()
     _MAX_WAIT_S = 120.0
 
     def __init__(self):
@@ -65,16 +73,21 @@ class _HostCopyGate:
         self._flocked = False           # guarded by _tlock
 
     def __enter__(self):
+        import stat as _stat
         import time as _t
         self._tlock.acquire()
         self._flocked = False
         try:
             if self._fd is None:
-                fd = os.open(self._PATH, os.O_CREAT | os.O_RDWR, 0o666)
-                try:
-                    os.fchmod(fd, 0o666)  # umask clips os.open's mode
-                except OSError:
-                    pass
+                fd = os.open(
+                    self._PATH,
+                    os.O_CREAT | os.O_RDWR | os.O_NOFOLLOW | os.O_CLOEXEC,
+                    0o600,
+                )
+                st = os.fstat(fd)
+                if not _stat.S_ISREG(st.st_mode) or st.st_uid != os.getuid():
+                    os.close(fd)
+                    raise OSError("host-copy gate path is not ours")
                 self._fd = fd
             deadline = _t.monotonic() + self._MAX_WAIT_S
             while True:

@@ -92,6 +92,12 @@ class ObjectStore:
         self._spilled_count = 0
         self._restored_count = 0
         self._access_clock = 0
+        # Objects mid-free: the spill delete runs OUTSIDE the store lock
+        # (it can be a remote round trip), so a concurrent get() must not
+        # resurrect the object from its still-present spill file.
+        # Refcounted (not a set): two concurrent free()s of one id must
+        # keep the tombstone until BOTH unlocked deletes finish.
+        self._freeing: Dict[ObjectID, int] = {}
 
     # -- paths -------------------------------------------------------------
     def _path(self, object_id: ObjectID) -> str:
@@ -268,6 +274,8 @@ class ObjectStore:
     # -- read path ---------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
+            if object_id in self._freeing:
+                return False
             return (object_id in self._segments
                     or os.path.exists(self._path(object_id))
                     or self._spill.exists(object_id.hex()))
@@ -279,6 +287,12 @@ class ObjectStore:
             if seg is not None and seg.mm is not None:
                 seg.last_access = self._access_clock
                 return seg
+            if object_id in self._freeing:
+                # Mid-free: the shm file is already gone and the spill
+                # copy is being deleted unlocked — do not resurrect it.
+                # OSError subclass: same failure shape a fully-freed
+                # object produces (missing backing file).
+                raise FileNotFoundError(f"object {object_id.hex()} freed")
             counted = seg is not None  # adopted placeholder keeps accounting
             from_spill = False
             try:
@@ -353,24 +367,42 @@ class ObjectStore:
     # -- free path ---------------------------------------------------------
     def free(self, object_id: ObjectID):
         with self._lock:
+            # Tombstone BEFORE releasing the lock: the spill delete below
+            # runs unlocked, and without this a concurrent _open() could
+            # restore the object from its not-yet-deleted spill file and
+            # re-insert a segment, breaking free()'s gone-after-free
+            # contract.
+            self._freeing[object_id] = self._freeing.get(object_id, 0) + 1
             seg = self._segments.pop(object_id, None)
             try:
                 os.unlink(self._path(object_id))
             except OSError:
                 pass
+            if seg is not None:
+                seg.file_exists = False
+                if seg.counted:
+                    self._used -= seg.size
+                if seg.mm is not None:
+                    try:
+                        seg.mm.close()
+                    except BufferError:
+                        # Live numpy views alias this mapping; the OS
+                        # keeps pages until the map closes. Retry on
+                        # future allocations.
+                        self._graveyard.append(seg.mm)
+        # Spill delete OUTSIDE the store lock: with a remote
+        # object_spilling_path this is a filesystem/HTTP round trip, and
+        # holding the lock across it would stall every concurrent
+        # create/get/contains for its duration.
+        try:
             self._spill.delete(object_id.hex())
-            if seg is None:
-                return
-            seg.file_exists = False
-            if seg.counted:
-                self._used -= seg.size
-            if seg.mm is not None:
-                try:
-                    seg.mm.close()
-                except BufferError:
-                    # Live numpy views alias this mapping; the OS keeps pages
-                    # until the map closes. Retry on future allocations.
-                    self._graveyard.append(seg.mm)
+        finally:
+            with self._lock:
+                n = self._freeing.get(object_id, 0) - 1
+                if n <= 0:
+                    self._freeing.pop(object_id, None)
+                else:
+                    self._freeing[object_id] = n
 
     def _collect_graveyard(self):
         alive = []

@@ -214,22 +214,33 @@ class HTTPProxy:
         # until the first response both ride, then only one does.
         mode_key = (app_name, deployment)
         is_asgi = self._asgi.get(mode_key)
-        if is_asgi is True:
-            body = None
-        else:
-            try:
-                body = json.loads(raw) if raw else None
-            except Exception:
-                body = raw.decode(errors="replace")
-        req = {"path": request.path_qs, "method": request.method,
-               "body": body, "route_prefix": matched_prefix}
-        if is_asgi is not False:
-            req["raw_body"] = raw
-            req["headers"] = [(k, v) for k, v in request.headers.items()]
-            # Undecoded path+query for the ASGI half: path_qs is
-            # percent-DECODED by yarl, which would corrupt encoded
-            # metacharacters (%26 etc.) before the app's query parser.
-            req["raw_path"] = request.raw_path
+
+        def _build_req(verdict):
+            """One request dict, trimmed per the learned verdict.
+            verdict None ships BOTH halves (first contact / retry)."""
+            r = {"path": request.path_qs, "method": request.method,
+                 "body": None, "route_prefix": matched_prefix}
+            if verdict is not True:  # classic half: decoded body
+                try:
+                    r["body"] = json.loads(raw) if raw else None
+                except Exception:
+                    r["body"] = raw.decode(errors="replace")
+            if verdict is not False:  # ASGI half: raw bytes + headers
+                r["raw_body"] = raw
+                r["headers"] = [(k, v)
+                                for k, v in request.headers.items()]
+                # Undecoded path+query for the ASGI half: path_qs is
+                # percent-DECODED by yarl, which would corrupt encoded
+                # metacharacters (%26 etc.) before the app's query
+                # parser.
+                r["raw_path"] = request.raw_path
+            if verdict is not None:
+                # Lets the replica refuse a mismatched trim BEFORE user
+                # code runs (same-name redeploy swapping the type).
+                r["__trim__"] = "asgi" if verdict else "classic"
+            return r
+
+        req = _build_req(is_asgi)
         handle = self._state.handle_for(deployment, app_name)
         # Model multiplexing header (reference: proxy.py reading
         # SERVE_MULTIPLEXED_MODEL_ID from the request) — routed
@@ -244,35 +255,50 @@ class HTTPProxy:
         # streams; the verdict is cached per deployment.
         mode = self._modes.get(mode_key, "unary")
         if mode == "unary":
-            try:
-                # Fast path: when replicas are ready and probes fresh,
-                # assignment cannot block — submit inline and skip the
-                # executor hop. Otherwise assign_request can block
-                # (replica ready-wait, queue probes): keep it off the
-                # event loop. The response await is callback-based
-                # either way.
-                resp = handle._remote_fast(req)
-                if resp is None:
-                    resp = await loop.run_in_executor(
-                        None, lambda: handle.remote(req))
-                result = await resp
-                # ALWAYS refresh from the response (not just when
-                # unknown): a same-name redeploy swapping the
-                # deployment type leaves the route table identical, so
-                # this is the invalidation path — one degraded request,
-                # then the verdict is right again.
-                got_asgi = bool(isinstance(result, dict)
-                                and result.get("__asgi__"))
-                if self._asgi.get(mode_key) != got_asgi:
-                    self._asgi[mode_key] = got_asgi
-                return _to_web_response(result)
-            except Exception as e:
-                # TaskError carries the remote class name in its message.
-                if "StreamingResponseRequired" not in f"{e!r}{e}":
+            # Up to two attempts: the replica raises VerdictMismatch —
+            # BEFORE running user code — when the learned verdict
+            # trimmed the request but a same-name redeploy swapped the
+            # deployment's kind (ASGI <-> classic). Drop the verdict and
+            # resend the full request exactly once. Genuine handler
+            # errors are NOT retried (requests may be non-idempotent).
+            for attempt in (0, 1):
+                try:
+                    # Fast path: when replicas are ready and probes
+                    # fresh, assignment cannot block — submit inline and
+                    # skip the executor hop. Otherwise assign_request
+                    # can block (replica ready-wait, queue probes): keep
+                    # it off the event loop. The response await is
+                    # callback-based either way.
+                    resp = handle._remote_fast(req)
+                    if resp is None:
+                        resp = await loop.run_in_executor(
+                            None, lambda: handle.remote(req))
+                    result = await resp
+                    # ALWAYS refresh from the response (not just when
+                    # unknown): a same-name redeploy swapping the
+                    # deployment type leaves the route table identical,
+                    # so this is the invalidation path — one degraded
+                    # request, then the verdict is right again.
+                    got_asgi = bool(isinstance(result, dict)
+                                    and result.get("__asgi__"))
+                    if self._asgi.get(mode_key) != got_asgi:
+                        self._asgi[mode_key] = got_asgi
+                    return _to_web_response(result)
+                except Exception as e:
+                    # TaskError carries the remote class name in its
+                    # message.
+                    if "StreamingResponseRequired" in f"{e!r}{e}":
+                        self._modes[mode_key] = "stream"
+                        self._asgi.setdefault(mode_key, False)
+                        break
+                    if (attempt == 0
+                            and "__ray_tpu_verdict_mismatch__"
+                            in f"{e!r}{e}"):
+                        self._asgi.pop(mode_key, None)
+                        req = _build_req(None)
+                        continue
                     return web.json_response({"error": str(e)},
                                              status=500)
-                self._modes[mode_key] = "stream"
-                self._asgi.setdefault(mode_key, False)
         try:
             rg = await loop.run_in_executor(
                 None, lambda: handle.options(stream=True).remote(req))
@@ -285,6 +311,15 @@ class HTTPProxy:
                     None, lambda: rg.single_result(timeout_s=60.0))
                 return _to_web_response(result)
         except Exception as e:
+            if "__ray_tpu_verdict_mismatch__" in f"{e!r}{e}":
+                # Stream-mode deployment swapped kind by a same-name
+                # redeploy: forget both learned verdicts and re-handle
+                # from scratch. Bounded: the rebuilt request ships both
+                # halves with no trim marker, so a second mismatch is
+                # impossible.
+                self._modes.pop(mode_key, None)
+                self._asgi.pop(mode_key, None)
+                return await self._handle(request)
             return web.json_response({"error": str(e)}, status=500)
         # Streaming: one chunk per generator item (reference: streaming
         # responses through the proxy over ASGI).

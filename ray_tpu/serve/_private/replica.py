@@ -21,6 +21,33 @@ class StreamingResponseRequired(Exception):
     caller must retry via handle_request_streaming."""
 
 
+class VerdictMismatch(Exception):
+    """The proxy trimmed the request per its learned ASGI/classic
+    verdict, but this replica's handler is the OTHER kind (a same-name
+    redeploy swapped the deployment type). Raised BEFORE user code runs,
+    so the proxy can safely retry with the full request."""
+
+    # The proxy sees remote errors as flattened TaskError text, so it
+    # matches this token rather than the class name — a user exception
+    # merely MENTIONING "VerdictMismatch" must not trigger a retry
+    # (requests may be non-idempotent).
+    TOKEN = "__ray_tpu_verdict_mismatch__"
+
+    def __init__(self, deployment_name: str):
+        super().__init__(f"{self.TOKEN} {deployment_name}")
+
+
+def _check_trim(req, callable_obj, deployment_name: str) -> None:
+    """Pop the proxy's __trim__ marker and refuse (before user code
+    runs) if the learned verdict no longer matches this handler's
+    kind."""
+    if isinstance(req, dict) and "__trim__" in req:
+        trim = req.pop("__trim__")
+        handler_is_asgi = hasattr(callable_obj, "__serve_asgi_app__")
+        if (trim == "asgi") != handler_is_asgi:
+            raise VerdictMismatch(deployment_name)
+
+
 class Replica:
     """User-code host (reference: replica.py UserCallableWrapper)."""
 
@@ -60,6 +87,16 @@ class Replica:
         self._ongoing += 1
         _set_request_model_id(multiplexed_model_id)
         try:
+            # Proxy HTTP requests carry a __trim__ marker when a learned
+            # verdict dropped one half of the request payload. If the
+            # verdict no longer matches this replica's handler kind (a
+            # same-name redeploy swapped ASGI <-> classic), refuse
+            # BEFORE running user code: the proxy drops its verdict and
+            # retries once with the full request — no side effects run
+            # twice and no stale-verdict 500 loop forms.
+            if args:
+                _check_trim(args[0], self._callable,
+                            self._deployment_name)
             if inspect.isfunction(self._callable) or inspect.ismethod(
                     self._callable) or not hasattr(
                         self._callable, method_name):
@@ -125,6 +162,12 @@ class Replica:
         try:
             def _start():
                 _set_request_model_id(multiplexed_model_id)
+                # Same mismatch refusal as the unary path: a stream-mode
+                # deployment swapped to the other kind by a same-name
+                # redeploy must not silently run on a trimmed request.
+                if args:
+                    _check_trim(args[0], self._callable,
+                                self._deployment_name)
                 target = self._resolve_target(method_name)
                 result = target(*args, **kwargs)
                 if inspect.iscoroutine(result):

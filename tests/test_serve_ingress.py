@@ -120,3 +120,50 @@ def test_ingress_404_from_app(ingress_app):
 def test_ingress_methods_still_callable_via_handle(ingress_app):
     h = serve.get_deployment_handle("Api", "ing")
     assert h.direct.remote().result(timeout_s=30) == "direct-ok"
+
+
+def test_redeploy_swap_asgi_to_classic_recovers(ingress_app):
+    """A same-name redeploy that swaps an ASGI ingress for a classic
+    handler must not leave the proxy's learned is_asgi verdict poisoned:
+    the first failing request drops the verdict and retries with both
+    request halves, so clients see no lasting 500 loop."""
+    host, port = ingress_app
+    # Teach the proxy the ASGI verdict for this deployment name.
+    status, _h, _d = _request(host, port, "GET", "/api/hello")
+    assert status == 200
+
+    @serve.deployment(name="Api")
+    class Classic:
+        def __call__(self, request):
+            # A classic handler that REQUIRES the decoded body — the
+            # stale verdict would have shipped body=None forever.
+            body = request["body"]
+            if body is None:
+                raise ValueError("classic handler got no body")
+            return {"classic": body}
+
+    try:
+        serve.run(Classic.bind(), name="ing", route_prefix="/api")
+        deadline = 30
+        import time
+        last = None
+        for _ in range(deadline * 2):
+            status, _h, data = _request(
+                host, port, "POST", "/api/anything",
+                body=json.dumps({"x": 1}))
+            last = (status, data)
+            if (status == 200
+                    and json.loads(data).get("classic") == {"x": 1}):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"proxy never recovered: {last}")
+        # And it must KEEP working (verdict re-learned as classic).
+        status, _h, data = _request(
+            host, port, "POST", "/api/anything",
+            body=json.dumps({"x": 2}))
+        assert status == 200
+        assert json.loads(data)["classic"] == {"x": 2}
+    finally:
+        # Restore the ASGI app for any later test using the fixture.
+        serve.run(Api.bind(), name="ing", route_prefix="/api")
