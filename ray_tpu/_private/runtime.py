@@ -1488,6 +1488,8 @@ class Node:
     # ------------------------------------------------------------------
     def _reply(self, handle: WorkerHandle, req_id, result=None,
                error: Optional[BaseException] = None):
+        if req_id is None:
+            return  # oneway message: nobody is waiting
         payload = {"req_id": req_id,
                    "result": {"__error__": error} if error is not None
                    else result}
@@ -1588,41 +1590,86 @@ class Node:
             if mark:
                 self.scheduler.note_worker_unblocked(handle)
 
+    def _register_submit_error(self, spec, exc: BaseException) -> None:
+        """Route a failed oneway submission to its return refs: the
+        submitting worker never blocks on an ack, so errors must surface
+        where the caller will look — ray_tpu.get on the returned ids
+        (reference: submission failures surface as errors on the ref)."""
+        try:
+            blob = serialization.dumps(
+                exc if isinstance(exc, TaskError)
+                else TaskError(f"{type(exc).__name__}: {exc}"))
+            for rid in getattr(spec, "return_ids", ()) or ():
+                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+        except Exception:
+            pass
+
+    def _worker_submit(self, handle: WorkerHandle, spec, req_id,
+                       submit_fn) -> None:
+        """Shared scaffolding for worker-originated task/actor-task
+        submissions: borrow the return ids on the submitter's behalf
+        (api._make_return_refs skips the per-ref REF_COUNT frame; the
+        worker's refs decref on drop to balance), submit, and route
+        failures to the return refs when the submitter isn't waiting."""
+        for rid in spec.return_ids:
+            self.gcs.objects.incref(rid)
+        try:
+            submit_fn(spec)
+        except BaseException as e:  # noqa: BLE001
+            if req_id is not None:
+                raise
+            self._register_submit_error(spec, e)
+        if req_id is not None:
+            self._reply(handle, req_id, True)
+
     def _handle_quick_request(self, handle: WorkerHandle, msg_type: str,
                               payload: dict):
+        # Submits and puts arrive ONEWAY (req_id None): the worker does
+        # not wait, so failures are registered on the object ids instead
+        # of replied. Request/reply remains for the informational calls
+        # below (get_actor, gcs ops, legacy callers).
         req_id = payload.get("req_id")
         try:
             if msg_type == P.OWNED_PUT:
                 oid = payload["object_id"]
-                nested = payload.get("nested") or []
-                if "inline" in payload:
-                    self.gcs.objects.register_ready(
-                        oid, (P.LOC_INLINE, payload["inline"]),
-                        len(payload["inline"]), nested_ids=nested)
-                else:
-                    size = payload["size"]
-                    node = payload.get("node")
-                    if node and node != self.node_id.hex():
-                        loc = (P.LOC_SHM, size, node)
+                try:
+                    nested = payload.get("nested") or []
+                    if "inline" in payload:
+                        self.gcs.objects.register_ready(
+                            oid, (P.LOC_INLINE, payload["inline"]),
+                            len(payload["inline"]), nested_ids=nested)
                     else:
-                        self.store.adopt(oid, size)
-                        loc = (P.LOC_SHM, size, self.node_id.hex())
+                        size = payload["size"]
+                        node = payload.get("node")
+                        if node and node != self.node_id.hex():
+                            loc = (P.LOC_SHM, size, node)
+                        else:
+                            self.store.adopt(oid, size)
+                            loc = (P.LOC_SHM, size, self.node_id.hex())
+                        self.gcs.objects.register_ready(
+                            oid, loc, size, nested_ids=nested)
+                except BaseException as e:  # noqa: BLE001
+                    if req_id is not None:
+                        raise
+                    blob = serialization.dumps(
+                        TaskError(f"{type(e).__name__}: {e}"))
                     self.gcs.objects.register_ready(
-                        oid, loc, size, nested_ids=nested)
-                self._reply(handle, req_id, True)
+                        oid, (P.LOC_ERROR, blob))
+                if req_id is not None:
+                    self._reply(handle, req_id, True)
             elif msg_type == P.SUBMIT_TASK:
                 spec = payload["spec"]
-                # Worker-submitted (nested) tasks never pipeline: a
-                # child queued behind its own blocked parent on a
-                # sequential worker is a permanent deadlock the
-                # driver-side queue recovers from and the pipeline
-                # cannot.
+                # Worker-submitted (nested) tasks pipeline like driver
+                # tasks EXCEPT onto their own submitter's worker (the
+                # self-deadlock case — child queued behind its blocked
+                # parent on a sequential worker); see _try_pipeline.
                 spec._nested = True
-                self.submit_task(spec)
-                self._reply(handle, req_id, True)
+                spec._submitter_wid = handle.worker_id.binary()
+                self._worker_submit(handle, spec, req_id,
+                                    self.submit_task)
             elif msg_type == P.SUBMIT_ACTOR_TASK:
-                self.submit_actor_task(payload["spec"])
-                self._reply(handle, req_id, True)
+                self._worker_submit(handle, payload["spec"], req_id,
+                                    self.submit_actor_task)
             elif msg_type == P.CREATE_ACTOR_REQ:
                 self.create_actor(payload["spec"])
                 self._reply(handle, req_id, True)

@@ -426,6 +426,31 @@ class NodeRegistry:
         return rows
 
 
+# Dispatch coalescing: while the native recv pump drains one frame
+# batch, its EXEC_TASK sends buffer on each TARGET WORKER's handle
+# (pickled immediately — blob swap state must be captured at send time)
+# and flush as ONE EXEC_TASKS frame per worker when the drain ends.
+# Amortizes the dominant per-dispatch costs (native send call, worker
+# recv wake) across a burst. The buffer lives on the handle under its
+# send_lock — NOT on the pump thread — so a send from ANY thread
+# (a driver .remote() pipelining onto the same worker, a CANCEL_TASK, a
+# REPLY) flushes the buffered frames first and per-worker FIFO order
+# holds; only the pump thread (marked via this thread-local) appends.
+_dispatch_coalesce = threading.local()
+
+
+def _coalesce_flush(dirty) -> None:
+    for handle in dirty:
+        try:
+            with handle.send_lock:
+                handle._flush_coalesced_locked()
+        except Exception:
+            # Send failure == worker death; the EOF death callback fails
+            # the in-flight tasks exactly as for inline dispatch errors.
+            pass
+    dirty.clear()
+
+
 class WorkerHandle:
     """Driver-side handle to one worker process (reference: the raylet's
     view of a leased worker, worker_pool.h)."""
@@ -438,6 +463,9 @@ class WorkerHandle:
         self.env_key = env_key
         self.env = env
         self.send_lock = threading.Lock()
+        # Pickled specs awaiting a coalesced EXEC_TASKS flush (guarded
+        # by send_lock; see _dispatch_coalesce).
+        self.coalesce_buf: list = []
         # Set (under send_lock) when a _NativeMux adopts this conn: sends
         # then enqueue into the C++ core instead of write(2)-ing inline.
         self.native_mux = None
@@ -475,8 +503,31 @@ class WorkerHandle:
         self.death_handled = False
 
     def send(self, msg_type: str, payload: dict):
+        if (msg_type == P.EXEC_TASK
+                and getattr(_dispatch_coalesce, "dirty", None) is not None):
+            # Pump-thread dispatch during a drain: buffer for the
+            # end-of-drain batch flush. Capture the pickled spec NOW —
+            # _dispatch restores the fn_blob swap right after this call
+            # returns, so a deferred pickle would serialize the wrong
+            # blob state.
+            import pickle
+            try:
+                sb = pickle.dumps(payload["spec"], protocol=5)
+            except Exception:
+                sb = None  # exotic payload: inline cloudpickle path
+            if sb is not None:
+                with self.send_lock:
+                    self.coalesce_buf.append(sb)
+                _dispatch_coalesce.dirty.add(self)
+                return
         data = P.dump_message(msg_type, payload)
         with self.send_lock:
+            # Per-worker FIFO: ANY send (CANCEL_TASK, RECALL_QUEUED,
+            # REPLY, an inline EXEC from another thread) must not
+            # overtake frames buffered for this worker — a cancel or
+            # recall arriving before the task it targets would miss it.
+            if self.coalesce_buf:
+                self._flush_coalesced_locked()
             # Native path: enqueue into the C++ IO thread (no syscall on
             # this thread). A False return means the conn is gone from
             # the core; fall through so conn.send_bytes raises the same
@@ -485,6 +536,18 @@ class WorkerHandle:
             if mux is not None and mux.send_framed(self.native_token, data):
                 return
             self.conn.send_bytes(data)
+
+    def _flush_coalesced_locked(self):
+        """Ship buffered EXEC frames as one EXEC_TASKS message.
+        Caller holds send_lock."""
+        if not self.coalesce_buf:
+            return  # raced: another sender already flushed
+        frames, self.coalesce_buf = self.coalesce_buf, []
+        data = P.dump_message(P.EXEC_TASKS, {"specs_pickled": frames})
+        mux = self.native_mux
+        if mux is not None and mux.send_framed(self.native_token, data):
+            return
+        self.conn.send_bytes(data)
 
     def kill(self):
         """Force-kill the process (SIGKILL — jax.distributed installs a
@@ -725,31 +788,41 @@ class _NativeMux:
                 mv = memoryview(self._buf)
                 continue
             pos = 0
-            while pos < n:
-                token, ln = struct.unpack_from("=QQ", mv, pos)
-                with self._lock:
-                    state = self._states.get(token)
-                if ln == self._eof_len:
-                    pos += 16
-                    self._core.remove(token)
-                    if state is not None:
-                        handle = state[0]
-                        with handle.send_lock:
-                            handle.native_mux = None
-                        with self._lock:
-                            self._states.pop(token, None)
-                        state[2](handle)
-                    continue
-                frame = mv[pos + 16:pos + 16 + ln]
-                pos += 16 + ln
-                if state is None:
-                    continue
-                try:
-                    msg_type, payload = cloudpickle.loads(frame)
-                    state[1](state[0], msg_type, payload)
-                except Exception:
-                    import traceback
-                    traceback.print_exc()
+            # Dispatch coalescing for this drain: EXEC_TASK sends from
+            # the handlers below buffer per worker and flush as one
+            # EXEC_TASKS frame each when the batch ends (see
+            # _dispatch_coalesce).
+            dirty = set()
+            _dispatch_coalesce.dirty = dirty
+            try:
+                while pos < n:
+                    token, ln = struct.unpack_from("=QQ", mv, pos)
+                    with self._lock:
+                        state = self._states.get(token)
+                    if ln == self._eof_len:
+                        pos += 16
+                        self._core.remove(token)
+                        if state is not None:
+                            handle = state[0]
+                            with handle.send_lock:
+                                handle.native_mux = None
+                            with self._lock:
+                                self._states.pop(token, None)
+                            state[2](handle)
+                        continue
+                    frame = mv[pos + 16:pos + 16 + ln]
+                    pos += 16 + ln
+                    if state is None:
+                        continue
+                    try:
+                        msg_type, payload = cloudpickle.loads(frame)
+                        state[1](state[0], msg_type, payload)
+                    except Exception:
+                        import traceback
+                        traceback.print_exc()
+            finally:
+                _dispatch_coalesce.dirty = None
+                _coalesce_flush(dirty)
 
     def stop(self):
         with self._reg_lock:
@@ -1002,12 +1075,15 @@ class WorkerPool:
                        and h.env_key == env_key)
 
     def pipeline_candidate(self, env_key: str, demand: Dict[str, float],
-                           cap: int) -> Optional[WorkerHandle]:
+                           cap: int,
+                           exclude_wid: Optional[bytes] = None
+                           ) -> Optional[WorkerHandle]:
         """Least-loaded BUSY worker whose lease matches (env + exact
         resource shape) with pipeline headroom — the target for
         dispatching another task under its existing grant (reference:
         max_tasks_in_flight_per_worker pipelining in the owner's
-        direct task transport)."""
+        direct task transport). `exclude_wid` bars a nested task from
+        its own submitter's queue (see _try_pipeline)."""
         best = None
         with self._lock:
             for h in self.workers.values():
@@ -1018,6 +1094,8 @@ class WorkerPool:
                         and 0 < h.inflight < cap
                         and h.blocked == 0
                         and h.lease[1] == demand
+                        and (exclude_wid is None
+                             or h.worker_id.binary() != exclude_wid)
                         and (best is None
                              or h.inflight < best.inflight)):
                     best = h
@@ -1208,15 +1286,22 @@ class Scheduler:
         """Dispatch onto a BUSY worker's existing lease (no new grant):
         the async-burst fast path once every grant is held (reference:
         max_tasks_in_flight_per_worker pipelining)."""
+        nested = getattr(spec, "_nested", False)
+        submitter_wid = getattr(spec, "_submitter_wid", None)
         if (self._max_inflight <= 1
                 or isinstance(spec, P.ActorSpec)
                 or (strategy is not None
                     and strategy != "DEFAULT")
                 or spec.placement_group_id is not None
-                or getattr(spec, "_nested", False)):
-            # _nested: worker-submitted children must queue driver-side
-            # — pipelined behind their own (about-to-block) parent on a
-            # sequential worker would deadlock permanently.
+                or (nested and submitter_wid is None)):
+            # Nested tasks pipeline like driver tasks — with one hard
+            # exclusion below: never onto the SUBMITTER's own worker
+            # (a child queued behind its about-to-block parent on that
+            # sequential worker is the self-deadlock case; cross-worker
+            # queues are covered by the blocked-worker recall, exactly
+            # as for driver-submitted pipelined tasks). Nested specs
+            # missing submitter identity keep the conservative
+            # no-pipeline path.
             return False
         env_key = self._env_key_for(spec)
         if env_key.startswith("tpu:"):
@@ -1224,7 +1309,8 @@ class Scheduler:
             # one pinned chip means HBM OOM / contended execution.
             return False
         worker = self.pool.pipeline_candidate(
-            env_key, demand, self._max_inflight)
+            env_key, demand, self._max_inflight,
+            exclude_wid=submitter_wid if nested else None)
         if worker is None:
             return False
         key = self._spec_key(spec)

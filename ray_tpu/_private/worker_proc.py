@@ -27,8 +27,10 @@ import asyncio
 import ctypes
 import inspect
 import os
+import struct as _struct
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
@@ -66,6 +68,10 @@ class WorkerClient:
     (driver) over the pipe.
     """
 
+    # api._make_return_refs: the head increfs a nested submission's
+    # return ids itself (one frame per call instead of submit + incref).
+    head_increfs_returns = True
+
     def __init__(self, worker):
         self._worker = worker
 
@@ -76,31 +82,40 @@ class WorkerClient:
     # from arg deserialization lands before this task's TASK_DONE unpin) --
     def incref(self, object_id: ObjectID):
         try:
-            self._worker.send(P.REF_COUNT,
-                              {"object_id": object_id, "delta": 1})
+            self._worker.send_lazy(P.REF_COUNT,
+                                   {"object_id": object_id, "delta": 1})
         except Exception:
             pass
 
     def decref(self, object_id: ObjectID):
         try:
-            self._worker.send(P.REF_COUNT,
-                              {"object_id": object_id, "delta": -1})
+            self._worker.send_lazy(P.REF_COUNT,
+                                   {"object_id": object_id, "delta": -1})
         except Exception:
             pass
 
     # -- objects ----------------------------------------------------------
     def put(self, value: Any) -> ObjectID:
+        # Oneway (no round trip): pipe ordering guarantees the head
+        # registers the object before it sees ANY later message that
+        # could reference the id from this worker (a nested submit, a
+        # TASK_DONE result, a GET_LOCATIONS) — and other workers can
+        # only learn the id through the head. Registration failures
+        # surface as LOC_ERROR on the id, not at the put() call
+        # (reference: plasma put errors surface on get).
         oid = ObjectID.from_random()
         with serialization.collect_object_refs() as nested:
             sobj = serialization.serialize(value)
         if sobj.total_size <= inline_threshold():
-            self._request(P.OWNED_PUT, {"object_id": oid,
-                                        "inline": sobj.to_bytes(),
-                                        "nested": list(nested)})
+            self._worker.send_lazy(P.OWNED_PUT,
+                                   {"object_id": oid,
+                                    "inline": sobj.to_bytes(),
+                                    "nested": list(nested)})
         else:
             size = self._worker.store.put_serialized(oid, sobj)
-            self._request(P.OWNED_PUT, {"object_id": oid, "size": size,
-                                        "nested": list(nested)})
+            self._worker.send_lazy(P.OWNED_PUT,
+                                   {"object_id": oid, "size": size,
+                                    "nested": list(nested)})
         return oid
 
     def get_locations(self, object_ids: List[ObjectID], timeout=None) -> List:
@@ -121,10 +136,16 @@ class WorkerClient:
 
     # -- tasks / actors ---------------------------------------------------
     def submit_task(self, spec: P.TaskSpec):
-        self._request(P.SUBMIT_TASK, {"spec": spec})
+        # Oneway: the old synchronous ack made every nested .remote() a
+        # full head round trip — the dominant cost of worker-as-client
+        # submission bursts (the reference submits from workers without
+        # blocking on the raylet either; errors surface on the returned
+        # ref). Head-side failures are registered as LOC_ERROR on the
+        # return ids.
+        self._worker.send_lazy(P.SUBMIT_TASK, {"spec": spec})
 
     def submit_actor_task(self, spec: P.TaskSpec):
-        self._request(P.SUBMIT_ACTOR_TASK, {"spec": spec})
+        self._worker.send_lazy(P.SUBMIT_ACTOR_TASK, {"spec": spec})
 
     def create_actor(self, spec: P.ActorSpec):
         self._request(P.CREATE_ACTOR_REQ, {"spec": spec})
@@ -153,6 +174,11 @@ class Worker:
         self.store = create_store(config.store_dir)
         self.client = WorkerClient(self)
         self._send_lock = threading.Lock()
+        # Oneway-send coalescing (send_lazy): framed bytes awaiting one
+        # combined write; guarded by _send_lock.
+        self._lazy_buf: list = []
+        self._lazy_event = threading.Event()
+        self._lazy_flusher: Optional[threading.Thread] = None
         self._req_counter = 0
         self._req_lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
@@ -204,7 +230,65 @@ class Worker:
     def send(self, msg_type: str, payload: dict):
         data = P.dump_message(msg_type, payload)
         with self._send_lock:
+            if self._lazy_buf:
+                # Ride the flush: buffered oneway frames + this one in a
+                # single write, preserving send order.
+                self._lazy_buf.append(self._frame(data))
+                self._flush_locked()
+                return
             self.conn.send_bytes(data)
+
+    @staticmethod
+    def _frame(data: bytes) -> bytes:
+        # multiprocessing.Connection wire framing (matched by the head's
+        # native dispatch parser): i32 BE length, -1 escape + u64 BE for
+        # huge frames.
+        n = len(data)
+        if n < 0x7FFFFFFF:
+            return _struct.pack("!i", n) + data
+        return _struct.pack("!i", -1) + _struct.pack("!Q", n) + data
+
+    def _flush_locked(self):
+        blob = b"".join(self._lazy_buf)
+        self._lazy_buf.clear()
+        fd = self.conn.fileno()
+        view = memoryview(blob)
+        while view:
+            written = os.write(fd, view)
+            view = view[written:]
+
+    def send_lazy(self, msg_type: str, payload: dict):
+        """Oneway send with burst coalescing: frames buffer briefly and
+        flush as ONE write when (a) the buffer fills, (b) any
+        synchronous send follows (ordering), or (c) the 1 ms flusher
+        fires — so a submission burst costs one syscall per ~32 frames
+        instead of one each, and the owner's recv side wakes once per
+        batch. Nothing here waits: worst-case added latency is the
+        flusher period."""
+        data = P.dump_message(msg_type, payload)
+        with self._send_lock:
+            self._lazy_buf.append(self._frame(data))
+            if len(self._lazy_buf) >= 32:
+                self._flush_locked()
+                return
+            if self._lazy_flusher is None:
+                self._lazy_flusher = threading.Thread(
+                    target=self._lazy_flush_loop, daemon=True,
+                    name="lazy-flush")
+                self._lazy_flusher.start()
+        self._lazy_event.set()
+
+    def _lazy_flush_loop(self):
+        while not self._shutdown.is_set():
+            self._lazy_event.wait()
+            self._lazy_event.clear()
+            time.sleep(0.001)  # let the burst accumulate
+            with self._send_lock:
+                if self._lazy_buf:
+                    try:
+                        self._flush_locked()
+                    except OSError:
+                        return  # owner gone; recv loop handles exit
 
     def request(self, msg_type: str, payload: dict) -> Any:
         with self._req_lock:
@@ -573,8 +657,28 @@ class Worker:
                 "actor_id": actor_id})
         # else: already finished — the real completion won the race.
 
+    def _handle_exec(self, spec: P.TaskSpec):
+        if (spec.fn_blob is not None
+                and spec.fn_id not in self._fn_cache):
+            self._fn_blobs[spec.fn_id] = spec.fn_blob
+        with self._running_lock:
+            self._queued_meta[spec.task_id.binary()] = \
+                (spec.actor_id, spec.fn_id)
+        if spec.actor_id is not None and self._actor_executor is not None:
+            self._executor_for(spec).submit(self._execute, spec)
+        else:
+            fut = self._task_pool.submit(self._execute, spec)
+            with self._running_lock:
+                # Only while still queued: if _execute already
+                # ran (popped the meta) this entry would be a
+                # permanent orphan — done futures never cancel.
+                if spec.task_id.binary() in self._queued_meta:
+                    self._queued_futures[
+                        spec.task_id.binary()] = fut
+
     # -- main loop ---------------------------------------------------------
     def run(self):
+        import pickle
         while not self._shutdown.is_set():
             try:
                 data = self.conn.recv_bytes()
@@ -582,24 +686,14 @@ class Worker:
                 break
             msg_type, payload = cloudpickle.loads(data)
             if msg_type == P.EXEC_TASK:
-                spec: P.TaskSpec = payload["spec"]
-                if (spec.fn_blob is not None
-                        and spec.fn_id not in self._fn_cache):
-                    self._fn_blobs[spec.fn_id] = spec.fn_blob
-                with self._running_lock:
-                    self._queued_meta[spec.task_id.binary()] = \
-                        (spec.actor_id, spec.fn_id)
-                if spec.actor_id is not None and self._actor_executor is not None:
-                    self._executor_for(spec).submit(self._execute, spec)
-                else:
-                    fut = self._task_pool.submit(self._execute, spec)
-                    with self._running_lock:
-                        # Only while still queued: if _execute already
-                        # ran (popped the meta) this entry would be a
-                        # permanent orphan — done futures never cancel.
-                        if spec.task_id.binary() in self._queued_meta:
-                            self._queued_futures[
-                                spec.task_id.binary()] = fut
+                self._handle_exec(payload["spec"])
+            elif msg_type == P.EXEC_TASKS:
+                # Coalesced dispatch burst: one frame, N specs pickled
+                # individually (the owner buffers per-worker while
+                # draining a recv batch — one send syscall and one recv
+                # wake amortized over the burst).
+                for sb in payload["specs_pickled"]:
+                    self._handle_exec(pickle.loads(sb))
             elif msg_type == P.RECALL_QUEUED:
                 self._recall_queued()
             elif msg_type == P.REPLY:

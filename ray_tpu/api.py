@@ -43,6 +43,23 @@ __all__ = [
 ]
 
 
+def _make_return_refs(rt, return_ids):
+    """Build the ObjectRefs for a just-submitted task's return ids.
+
+    Worker contexts skip the per-ref oneway REF_COUNT frame: the head
+    increfs the return ids itself while processing the (oneway) nested
+    submission, so one frame rides the wire per call instead of two —
+    submission frames halve on worker-as-client bursts (reference shape:
+    ray_perf.py multi-client rows). The refs are still marked owned so
+    dropping them decrefs, balancing the head-side incref."""
+    if getattr(rt, "head_increfs_returns", False):
+        refs = [ObjectRef(rid, _incref=False) for rid in return_ids]
+        for r in refs:
+            r._owned = True
+        return refs
+    return [ObjectRef(rid) for rid in return_ids]
+
+
 # ---------------------------------------------------------------------------
 # ObjectRef
 # ---------------------------------------------------------------------------
@@ -540,7 +557,7 @@ class RemoteFunction:
             placement_group_bundle_index=bundle_index,
             scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=self._runtime_env)
-        refs = [ObjectRef(rid) for rid in return_ids]
+        refs = _make_return_refs(rt, return_ids)
         tr = _tracing()
         if tr is not None and tr.is_enabled():
             with tr.span(f"submit:{spec.name}", task_id=task_id.hex()):
@@ -631,7 +648,7 @@ class ActorHandle:
                          else int(opts["max_task_retries"])),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             streaming=streaming)
-        refs = [ObjectRef(rid) for rid in return_ids]
+        refs = _make_return_refs(rt, return_ids)
         tr = _tracing()
         if tr is not None and tr.is_enabled():
             with tr.span(f"submit:{spec.name}", task_id=task_id.hex()):
