@@ -396,6 +396,24 @@ class NodeDaemon:
         if msg_type == P.PULL_OBJECT:
             self._exec.submit(self._handle_pull, handle, payload)
             return
+        if (msg_type == P.GCS_REQUEST
+                and payload.get("op") == "spill_store"):
+            # Full-arena escalation targets the FULL NODE's store — this
+            # one, not the head's (relaying would spill the head's arena
+            # while the worker's local arena stays full).
+            try:
+                need = int(payload.get("kwargs", {}).get("need", 0))
+                used = self.store.stats().get("used_bytes", 0)
+                reclaimed = self.store.spill_objects(
+                    max(0, used - 2 * need))
+            except Exception:
+                reclaimed = 0
+            try:
+                handle.send(P.REPLY, {"req_id": payload.get("req_id"),
+                                      "result": reclaimed})
+            except Exception:
+                pass
+            return
         # Tag node-local shm locations with this node's id so the head
         # registers WHERE the object lives (ownership-based object
         # directory, ownership_based_object_directory.h) and skips its

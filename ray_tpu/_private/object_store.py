@@ -674,6 +674,14 @@ class ArenaObjectStore:
             self._meta[object_id] = size
             self._access[object_id] = self._clock
 
+    # Set by worker processes to a callable asking the OWNER to spill
+    # (gcs_request "spill_store"): a worker's local spill can only move
+    # its OWN objects — a full arena is usually other processes' sealed
+    # blocks, which only the owner (who adopted them) may spill
+    # (reference: the raylet, not the plasma client, orchestrates
+    # spilling — local_object_manager.cc).
+    request_spill = None
+
     def create(self, object_id: ObjectID, size: int):
         """Writable view for a two-phase write (seal after); used by the
         puller and put_serialized."""
@@ -686,6 +694,26 @@ class ArenaObjectStore:
             try:
                 view = self._store.create(object_id, size)
             except MemoryError as e:
+                if self.request_spill is not None:
+                    # Retry with backoff: a concurrent creator can claim
+                    # the space the owner just spilled, and blocks
+                    # pinned by in-flight readers only become spillable
+                    # as their tasks finish.
+                    import time as _time
+                    view = None
+                    for attempt in range(5):
+                        try:
+                            self.request_spill(size)
+                        except Exception:
+                            break
+                        try:
+                            view = self._store.create(object_id, size)
+                            break
+                        except MemoryError:
+                            _time.sleep(0.05 * (attempt + 1))
+                    if view is not None:
+                        self._track(object_id, size)
+                        return view
                 raise ObjectStoreFullError(
                     f"Object of {size} bytes does not fit: "
                     f"{self.used_bytes}/{self.capacity} arena bytes used "

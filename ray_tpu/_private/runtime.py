@@ -1716,6 +1716,16 @@ class Node:
             return self.gcs.spans()
         if op == "object_stats":
             return self.gcs.objects.stats()
+        if op == "spill_store":
+            # A head-attached worker's create() hit a full arena: only
+            # the owner may spill other processes' sealed blocks (it
+            # adopted them). Free ~2x the request (slack absorbs
+            # concurrent creates) — never drain the whole arena. Daemon
+            # nodes intercept this op locally (daemon.py) so it always
+            # targets the full node's own store.
+            need = int(kwargs.get("need", 0))
+            used = self.store.stats().get("used_bytes", 0)
+            return self.store.spill_objects(max(0, used - 2 * need))
         if op == "list_objects":
             return self.gcs.objects.list_entries(
                 limit=kwargs.get("limit", 1000))

@@ -173,6 +173,11 @@ class Worker:
         self.config = config
         self.store = create_store(config.store_dir)
         self.client = WorkerClient(self)
+        # Full-arena escalation: ask the owner to spill (see
+        # object_store create()).
+        self.store.request_spill = (
+            lambda need: self.client.gcs_request("spill_store",
+                                                 need=need))
         self._send_lock = threading.Lock()
         # Oneway-send coalescing (send_lazy): framed bytes awaiting one
         # combined write; guarded by _send_lock.

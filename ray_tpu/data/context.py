@@ -42,6 +42,11 @@ class DataContext:
     backpressure_store_fraction: float = 0.8
     # Observability: how many top-up rounds the throttle held back.
     backpressure_throttle_count: int = 0
+    # Output partition count for STREAMING shuffles/sorts/groupbys — the
+    # stream's length is unknown when the operator starts, so the bulk
+    # path's n=num_blocks heuristic doesn't apply (reference:
+    # DataContext.min_parallelism feeding the shuffle planner).
+    shuffle_partitions: int = 16
 
     _lock: ClassVar[threading.Lock] = threading.Lock()
     _current: ClassVar[Optional["DataContext"]] = None

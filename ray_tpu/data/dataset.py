@@ -17,6 +17,7 @@ jax directly — the reference's GPU actor-pool inference path
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass
 from typing import (
     Any,
@@ -150,6 +151,62 @@ def _reduce_partition(mode, key, descending, seed, *parts: B.Block):
             order = order[::-1]
         return B.block_take_indices(out, order)
     return out
+
+
+@api.remote
+def _sort_and_sample(blk: B.Block, key: str, k: int):
+    """Streaming-sort phase 1: sort one block, emit (sorted block,
+    evenly spaced sample of the key column). num_returns=2 at call
+    sites."""
+    order = np.argsort(blk[key], kind="stable")
+    sblk = B.block_take_indices(blk, order)
+    vals = np.asarray(sblk[key])
+    if len(vals):
+        idx = np.linspace(0, len(vals) - 1,
+                          num=min(k, len(vals))).astype(int)
+        sample = vals[idx]
+    else:
+        sample = vals[:0]
+    return sblk, sample
+
+
+@api.remote
+def _sort_bounds(n: int, *samples):
+    """Range boundaries from the union of per-block samples."""
+    live = [s for s in samples if len(s)]
+    if not live or n <= 1:
+        return np.asarray([])
+    allv = np.sort(np.concatenate(live))
+    return np.asarray([allv[int(i * len(allv) / n)] for i in range(1, n)])
+
+
+@api.remote
+def _partition_sorted(blk: B.Block, n: int, bounds, key: str):
+    """Range-split an already-sorted block into n contiguous slices
+    (streaming-sort phase 2 — cheap: searchsorted + slicing). Degenerate
+    boundary sets (all-empty input blocks sample nothing, so len(bounds)
+    may be < n-1) pad with empty trailing slices — the reducer count is
+    fixed at n."""
+    length = B.block_length(blk)
+    vals = np.asarray(blk[key]) if length else np.asarray([])
+    cuts = [int(c) for c in np.searchsorted(vals, bounds, side="right")]
+    edges = [0] + cuts + [length]
+    parts = [B.block_slice(blk, edges[i], edges[i + 1])
+             for i in range(len(edges) - 1)]
+    while len(parts) < n:
+        parts.append(B.block_slice(blk, length, length))
+    parts = tuple(parts[:n])
+    return parts[0] if n == 1 else parts
+
+
+@api.remote
+def _merge_agg_results(key: str, *parts) -> B.Block:
+    """Merge per-partition aggregate dicts into one sorted block."""
+    rows = []
+    for part in parts:
+        rows.extend(part.values())
+    rows.sort(key=lambda r: r[key])
+    return B.block_from_rows(rows)
 
 
 @api.remote
@@ -392,19 +449,27 @@ class _MapBatchesActorPool:
 # plan
 # ---------------------------------------------------------------------------
 class _Stage:
-    """One plan stage. `fn` is the bulk executor (all bundles at once —
-    barriers like shuffle need it); `make_submitter`, when present, marks
-    the stage streamable: it returns (submit, close) where submit maps
-    one block ref to the stage-output ref via a single remote call, so the
-    streaming executor can pipeline bundles through stage chains
-    (reference: streaming_executor.py operator topology)."""
+    """One plan stage. `fn` is the bulk executor (all bundles at once);
+    `make_submitter`, when present, marks the stage map-streamable (it
+    returns (submit, close), wrapped into a MapOperator); and
+    `make_operator` builds a full physical operator — including
+    streaming barrier ops (ShuffleOperator / SampledSortOperator) — for
+    the per-operator streaming executor (reference:
+    streaming_executor.py operator topology + planner physical ops)."""
 
     def __init__(self, name: str,
                  fn: Callable[[List[_RefBundle]], List[_RefBundle]],
-                 make_submitter: Optional[Callable] = None):
+                 make_submitter: Optional[Callable] = None,
+                 make_operator: Optional[Callable] = None):
         self.name = name
         self.fn = fn
         self.make_submitter = make_submitter
+        self.make_operator = make_operator
+
+    @property
+    def streamable(self) -> bool:
+        return (self.make_submitter is not None
+                or self.make_operator is not None)
 
 
 class _Plan:
@@ -437,6 +502,76 @@ class _Plan:
                 bundles = stage.fn(bundles)
             self._cache = bundles
         return self._cache
+
+
+def _bulk_shuffle(bundles: List["_RefBundle"], mode: str, key,
+                  descending: bool, seed, boundaries
+                  ) -> List["_RefBundle"]:
+    """Shared bulk two-phase shuffle body (map-side partition +
+    reduce-side merge) used by _shuffle_like and sort's stage."""
+    n = max(1, len(bundles))
+    part_refs = []
+    for b in bundles:
+        parts = _partition_block.options(
+            num_returns=n).remote(b.ref, n, mode, key, boundaries, seed)
+        part_refs.append([parts] if n == 1 else list(parts))
+    out = []
+    for j in range(n):
+        ref = _reduce_partition.remote(
+            mode, key, descending,
+            None if seed is None else seed + j,
+            *[pr[j] for pr in part_refs])
+        out.append(_RefBundle(ref, _wait_rows(ref)))
+    if mode == "sort" and descending:
+        # Range partitions are ascending; flip for descending.
+        out.reverse()
+    return out
+
+
+class _LazySplitFeeder:
+    """Shares one streaming execution of a parent dataset across n
+    split shards (Dataset.split). Pulling any shard advances the shared
+    stream; each shard's full history is kept (refs, not blocks) so
+    shards are re-iterable across epochs — re-iteration replays the
+    history, then keeps pumping if the parent isn't exhausted."""
+
+    def __init__(self, ds: "Dataset", n: int):
+        self._ds = ds
+        self._n = n
+        self._given: List[List] = [[] for _ in range(n)]
+        self._next = 0
+        self._it = None
+        self._done = False
+        self._lock = threading.Lock()
+
+    def _pump_for(self, i: int, have: int) -> None:
+        """Advance the parent until shard i has > `have` bundles or the
+        parent is exhausted."""
+        with self._lock:
+            if self._it is None:
+                self._it = self._ds._iter_bundles()
+            while len(self._given[i]) <= have and not self._done:
+                try:
+                    ref, rows = next(self._it)
+                except StopIteration:
+                    self._done = True
+                    return
+                self._given[self._next].append((ref, rows))
+                self._next = (self._next + 1) % self._n
+
+    def iter_for(self, i: int):
+        pos = 0
+        while True:
+            while pos < len(self._given[i]):
+                yield self._given[i][pos]
+                pos += 1
+            self._pump_for(i, pos)
+            if pos >= len(self._given[i]) and self._done:
+                return
+
+    def bundles_for(self, i: int) -> List["_RefBundle"]:
+        return [_RefBundle(ref, rows if rows >= 0 else _wait_rows(ref))
+                for ref, rows in self.iter_for(i)]
 
 
 def _bundle_from_block(blk: B.Block) -> _RefBundle:
@@ -513,7 +648,8 @@ class Dataset:
                 # Weakrefs, not refs: holding strong ObjectRefs here
                 # would pin every intermediate block until close() and
                 # defeat the in-flight backpressure cap. Downstream
-                # (stream_bundles' window / the consumer's prefetch)
+                # (the executor's in-flight window / the consumer's
+                # prefetch)
                 # keeps unconsumed refs alive; once the consumer drops a
                 # ref its task is done and the weakref dies.
                 import weakref
@@ -644,26 +780,38 @@ class Dataset:
                       descending: bool = False, seed: Optional[int] = None,
                       boundaries=None, name: str = "Shuffle") -> "Dataset":
         def stage_fn(bundles):
-            n = max(1, len(bundles))
-            part_refs = []
-            for b in bundles:
+            return _bulk_shuffle(bundles, mode, key, descending, seed,
+                                 boundaries)
+
+        def make_operator():
+            # Streaming shuffle (reference: the reference's shuffle task
+            # scheduler under the streaming executor): map-side
+            # partitions stream with a bounded budget; partition blocks
+            # live in the store (spilling under pressure); reduces
+            # stream their outputs after the barrier. Partition count is
+            # a context knob because the stream's length is unknown.
+            from . import executor as EX
+            from .context import DataContext
+            n = DataContext.get_current().shuffle_partitions
+
+            def partition_submit(ref, nparts):
                 parts = _partition_block.options(
-                    num_returns=n).remote(b.ref, n, mode, key,
-                                          boundaries, seed)
-                if n == 1:
-                    parts = [parts]
-                part_refs.append(parts)
-            out = []
-            for j in range(n):
-                ref = _reduce_partition.remote(
-                    mode, key, descending, None if seed is None
-                    else seed + j, *[pr[j] for pr in part_refs])
-                out.append(_RefBundle(ref, _wait_rows(ref)))
-            if mode == "sort" and descending:
-                # Range partitions are ascending; flip for descending.
-                out.reverse()
-            return out
-        return Dataset(self._plan.with_stage(_Stage(name, stage_fn)))
+                    num_returns=nparts).remote(ref, nparts, mode, key,
+                                               boundaries, seed)
+                return [parts] if nparts == 1 else list(parts)
+
+            def reduce_submit(j, parts):
+                return _reduce_partition.remote(
+                    mode, key, descending,
+                    None if seed is None else seed + j, *parts)
+
+            return EX.ShuffleOperator(
+                name, n, partition_submit, reduce_submit,
+                ordered_output=(mode == "sort"),
+                reverse_output=(mode == "sort" and descending))
+
+        return Dataset(self._plan.with_stage(
+            _Stage(name, stage_fn, make_operator=make_operator)))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Distributed two-phase shuffle (reference: dataset.py
@@ -676,24 +824,61 @@ class Dataset:
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         """Sample-partitioned distributed sort (reference: dataset.py
-        sort — boundary sampling + range partition + per-part sort)."""
-        samples = []
-        for b in self._plan.execute():
-            blk = api.get(b.ref)
-            if B.block_length(blk):
-                vals = np.asarray(blk[key])
-                k = min(16, len(vals))
-                samples.append(np.random.default_rng(0).choice(
-                    vals, size=k, replace=False))
-        n = max(1, len(self._plan.execute()))
-        if samples:
-            allv = np.sort(np.concatenate(samples))
-            qs = [allv[int(i * len(allv) / n)] for i in range(1, n)]
-            boundaries = np.asarray(qs)
-        else:
-            boundaries = np.asarray([])
-        return self._shuffle_like("sort", key=key, descending=descending,
-                                  boundaries=boundaries, name="Sort")
+        sort — boundary sampling + range partition + per-part merge).
+        Fully lazy: the bulk path samples inside the stage; the
+        streaming path is an external sort (SampledSortOperator) that
+        sorts+samples blocks ON the stream, computes boundaries at the
+        barrier, then range-partitions and merges — data stays in the
+        object store (spilling under pressure) throughout, so a sort
+        larger than the store holds its memory envelope."""
+        def stage_fn(bundles):
+            samples = []
+            for b in bundles:
+                blk = api.get(b.ref)
+                if B.block_length(blk):
+                    vals = np.asarray(blk[key])
+                    k = min(16, len(vals))
+                    samples.append(np.random.default_rng(0).choice(
+                        vals, size=k, replace=False))
+            n = max(1, len(bundles))
+            if samples:
+                allv = np.sort(np.concatenate(samples))
+                boundaries = np.asarray(
+                    [allv[int(i * len(allv) / n)] for i in range(1, n)])
+            else:
+                boundaries = np.asarray([])
+            return _bulk_shuffle(bundles, "sort", key, descending, None,
+                                 boundaries)
+
+        def make_operator():
+            from . import executor as EX
+            from .context import DataContext
+            n = DataContext.get_current().shuffle_partitions
+
+            def sort_and_sample(ref):
+                return _sort_and_sample.options(num_returns=2).remote(
+                    ref, key, 16)
+
+            def partition_with_bounds(ref, nparts, bounds_ref):
+                parts = _partition_sorted.options(
+                    num_returns=nparts).remote(ref, nparts, bounds_ref,
+                                               key)
+                return [parts] if nparts == 1 else list(parts)
+
+            def reduce_submit(j, parts):
+                return _reduce_partition.remote(
+                    "sort", key, descending, None, *parts)
+
+            def bounds_from_samples(sample_refs, nparts):
+                return _sort_bounds.remote(nparts, *sample_refs)
+
+            return EX.SampledSortOperator(
+                "Sort", n, sort_and_sample, partition_with_bounds,
+                reduce_submit, bounds_from_samples,
+                reverse_output=descending)
+
+        return Dataset(self._plan.with_stage(
+            _Stage("Sort", stage_fn, make_operator=make_operator)))
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -855,34 +1040,38 @@ class Dataset:
         return Dataset(_Plan(source, [], "zip"))
 
     def _iter_bundles(self):
-        """Streaming bundle iterator. If every stage is streamable
-        (per-bundle submitters), pump bundles through the chain with the
-        streaming executor — stage N of bundle i overlaps stage 1 of
-        bundle i+k, with an in-flight cap for backpressure (reference:
-        StreamingExecutor, streaming_executor.py:48). Plans containing a
-        barrier (shuffle/sort/repartition) fall back to bulk execution."""
-        from . import streaming
+        """Streaming bundle iterator. If every stage is streamable —
+        map stages via their submitters, barrier stages
+        (sort/shuffle/groupby) via streaming operators — the plan runs
+        on the per-operator streaming executor: each operator owns a
+        queue and an in-flight budget, completions move bundles
+        downstream via ready callbacks, and under store pressure only
+        the most-downstream operator dispatches (reference:
+        StreamingExecutor streaming_executor.py:48 + resource_manager +
+        backpressure policies). Plans with a non-streamable stage
+        (repartition, zip, limit) fall back to bulk execution."""
         plan = self._plan
         if plan._cache is not None or \
-                any(st.make_submitter is None for st in plan.stages):
+                any(not st.streamable for st in plan.stages):
             for b in plan.execute():
                 yield (b.ref, b.num_rows)
             return
-        subs, closers = [], []
-        try:
-            for st in plan.stages:
-                submit, close = st.make_submitter()
-                subs.append(submit)
-                if close is not None:
-                    closers.append(close)
-            if plan.iter_source is not None:
-                src = plan.iter_source()
+        from . import executor as EX
+        from .context import DataContext
+        ctx = DataContext.get_current()
+        ops = []
+        for st in plan.stages:
+            if st.make_operator is not None:
+                ops.append(st.make_operator())
             else:
-                src = ((b.ref, b.num_rows) for b in plan.source())
-            yield from streaming.stream_bundles(src, subs)
-        finally:
-            for c in closers:
-                c()
+                submit, close = st.make_submitter()
+                ops.append(EX.MapOperator(st.name, submit, close,
+                                          ordered=ctx.preserve_order))
+        if plan.iter_source is not None:
+            src = plan.iter_source()
+        else:
+            src = ((b.ref, b.num_rows) for b in plan.source())
+        yield from EX.StreamingExecutor(ops, ctx).execute(src)
 
     def iter_rows(self) -> Iterator[Dict]:
         for ref, _ in self._iter_bundles():
@@ -961,17 +1150,21 @@ class Dataset:
 
     # -- splitting (train integration) ------------------------------------
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
-        """(reference: dataset.py split)"""
+        """(reference: dataset.py split) — LAZY: nothing executes at
+        split() time. The n datasets share one streaming execution of
+        the parent (first consumption starts it); bundles assign
+        round-robin, and shards consumed later buffer REFS only —
+        blocks stay in the object store and spill under pressure, so a
+        split of a dataset larger than the store holds its envelope."""
         ds = self.repartition(n) if equal else self
-        bundles = ds._plan.execute()
-        shards: List[List[_RefBundle]] = [[] for _ in range(n)]
-        for i, b in enumerate(bundles):
-            shards[i % n].append(b)
-        out = []
-        for shard in shards:
-            out.append(Dataset(_Plan(
-                functools.partial(lambda s: s, shard), [], "split")))
-        return out
+        feeder = _LazySplitFeeder(ds, n)
+        return [
+            Dataset(_Plan(functools.partial(feeder.bundles_for, i), [],
+                          "split",
+                          iter_source=functools.partial(feeder.iter_for,
+                                                        i)))
+            for i in range(n)
+        ]
 
     def split_at_indices(self, indices: Sequence[int]) -> List["Dataset"]:
         """Row-index split points → len(indices)+1 datasets (reference:
@@ -1110,7 +1303,21 @@ class GroupedData:
             rows.sort(key=lambda r: r[key])
             blk = B.block_from_rows(rows)
             return [_bundle_from_block(blk)]
-        return Dataset(ds._plan.with_stage(_Stage("Aggregate", stage_fn)))
+
+        def make_operator():
+            # Streaming: per-partition aggregates stream in (small
+            # dicts); one merge task at the barrier emits the result
+            # block — groupby never materializes the dataset driverside.
+            from . import executor as EX
+            return EX.FinalizeOperator(
+                "Aggregate",
+                submit=lambda ref: _aggregate_block.remote(ref, key,
+                                                           aggs),
+                finalize=lambda outs: _merge_agg_results.remote(
+                    key, *outs))
+
+        return Dataset(ds._plan.with_stage(
+            _Stage("Aggregate", stage_fn, make_operator=make_operator)))
 
     def count(self) -> Dataset:
         return self._aggregate({"count()": (None, "count")})

@@ -1,0 +1,601 @@
+"""Per-operator streaming executor.
+
+Reference parity: python/ray/data/_internal/execution/streaming_executor.py
+(StreamingExecutor :48), resource_manager.py, and backpressure_policy/ —
+a pull-based operator topology executed by a driver pump thread, with
+per-operator in-flight budgets and spill-aware admission. This replaces
+the single global in-flight window of ray_tpu.data.streaming for plans
+whose stages provide operators: each operator owns its queue + budget,
+completions move bundles downstream via object-ready callbacks (no
+polling), and under store pressure only the most-downstream operator
+with queued input may dispatch (drain-priority — the reference's
+backpressure policies pick memory-reducing ops), so a dataset much
+larger than the object store streams through a multi-stage pipeline
+inside a bounded store footprint (intermediates free as they are
+consumed; what must persist — shuffle partitions — spills).
+
+TPU note: the executor is pure control plane. Blocks move through the
+shared-memory store and its spill path; operators submit ordinary
+remote tasks, so the task scheduler (locality, leases, pipelining)
+stays the data plane under this topology exactly as it is under the
+chain-submission path.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from .. import api
+from .context import DataContext
+
+# A streamed bundle: (ObjectRef, row count or -1 when unknown)
+Bundle = Tuple[api.ObjectRef, int]
+
+
+def _store_stats() -> Tuple[int, int]:
+    """(used_bytes, capacity) of the driver store — the backpressure
+    signal (single-node: where intermediates land; multi-node: the
+    first store to hurt)."""
+    try:
+        from .._private import state
+        st = state.current().store.stats()
+        return st.get("used_bytes", 0), st.get("capacity") or 0
+    except Exception:
+        return 0, 0
+
+
+class Operator:
+    """One physical operator (reference: PhysicalOperator,
+    _internal/execution/interfaces/physical_operator.py).
+
+    Lifecycle driven by the executor pump:
+      add_input(bundle)  — one upstream bundle; may submit remote work
+                           (through self.watch for completion routing).
+      inputs_done()      — upstream exhausted; barrier ops launch their
+                           reduce phase here.
+      work_left()        — True while outputs may still appear.
+    Operators push finished bundles with self.emit(bundle) and register
+    interest in a ref with self.watch(ref, fn) — fn runs on the pump
+    thread when the object is ready. Both are injected by the executor.
+    """
+
+    name = "op"
+
+    def __init__(self):
+        self.emit: Callable[[Bundle], None] = lambda b: None
+        self.watch: Callable[[api.ObjectRef, Callable], None] = None
+        self.in_flight = 0          # submitted-not-completed remote work
+        self.max_in_flight = 4      # per-operator budget (resource mgr)
+        self.queued: collections.deque = collections.deque()
+        self.done_called = False
+
+    def add_input(self, bundle: Bundle) -> None:
+        raise NotImplementedError
+
+    def inputs_done(self) -> None:
+        self.done_called = True
+
+    def dispatch(self, budget: int) -> int:
+        """Submit up to `budget` queued items; returns number started.
+        Default implementation for queue+submit operators."""
+        return 0
+
+    def work_left(self) -> bool:
+        return bool(self.in_flight or self.queued or not self.done_called)
+
+    def close(self) -> None:
+        pass
+
+
+class MapOperator(Operator):
+    """1 bundle in -> 1 bundle out via one remote call (reference:
+    TaskPoolMapOperator / ActorPoolMapOperator — the actor pool lives
+    inside `submit` for actor stages). With `ordered` (the default,
+    DataContext.preserve_order), outputs emit in input order via a
+    head-of-line reorder buffer; completions themselves may land in any
+    order."""
+
+    def __init__(self, name: str, submit: Callable, close: Optional[Callable],
+                 max_in_flight: int = 4, ordered: bool = True):
+        super().__init__()
+        self.name = name
+        self._submit = submit
+        self._close = close
+        self.max_in_flight = max_in_flight
+        self._ordered = ordered
+        self._seq_next = 0          # next seq to assign at dispatch
+        self._emit_next = 0         # next seq to emit
+        self._done_buf: Dict[int, api.ObjectRef] = {}
+
+    def add_input(self, bundle: Bundle) -> None:
+        self.queued.append(bundle)
+
+    def dispatch(self, budget: int) -> int:
+        started = 0
+        while (self.queued and started < budget
+               and self.in_flight < self.max_in_flight):
+            ref, _rows = self.queued.popleft()
+            out = self._submit(ref)
+            self.in_flight += 1
+            started += 1
+            seq = self._seq_next
+            self._seq_next += 1
+            self.watch(out, lambda r, seq=seq: self._on_ready(seq, r))
+        return started
+
+    def _on_ready(self, seq: int, ref: api.ObjectRef) -> None:
+        self.in_flight -= 1
+        if not self._ordered:
+            self._emit_next += 1
+            self.emit((ref, -1))
+            return
+        self._done_buf[seq] = ref
+        while self._emit_next in self._done_buf:
+            self.emit((self._done_buf.pop(self._emit_next), -1))
+            self._emit_next += 1
+
+    def work_left(self) -> bool:
+        return bool(self.in_flight or self.queued or self._done_buf
+                    or not self.done_called)
+
+    def close(self) -> None:
+        if self._close is not None:
+            self._close()
+
+
+class ShuffleOperator(Operator):
+    """All-to-all operator: map-side partition streams with a bounded
+    budget, reduce-side runs after the input barrier and streams its
+    outputs (reference: _internal/planner/exchange/ shuffle task
+    scheduler). The barrier holds REFS only — partition blocks live in
+    the object store and spill under pressure, which is what lets a
+    sort/groupby over a dataset larger than the store hold a memory
+    envelope (external sort through the spill path).
+
+    partition(ref, n) -> n refs   (remote, num_returns=n)
+    reduce(j, parts) -> ref       (remote, one output partition)
+    """
+
+    def __init__(self, name: str, num_partitions: int,
+                 partition_submit: Callable[[api.ObjectRef, int], List],
+                 reduce_submit: Callable[[int, List], api.ObjectRef],
+                 ordered_output: bool = False,
+                 reverse_output: bool = False,
+                 max_in_flight: int = 4):
+        super().__init__()
+        self.name = name
+        self._n = max(1, int(num_partitions))
+        self._partition = partition_submit
+        self._reduce = reduce_submit
+        self._parts: List[List] = []     # per input: n part refs
+        self._map_done = 0
+        self._reduce_started = False
+        self._reduce_in_flight: Dict[int, api.ObjectRef] = {}
+        self._reduce_next = 0
+        self._reduce_out: Dict[int, api.ObjectRef] = {}
+        self._ordered = ordered_output
+        self._reverse = reverse_output
+        self._emitted = 0
+        self.max_in_flight = max_in_flight
+
+    def add_input(self, bundle: Bundle) -> None:
+        self.queued.append(bundle)
+
+    def dispatch(self, budget: int) -> int:
+        started = 0
+        while (self.queued and started < budget
+               and self.in_flight < self.max_in_flight):
+            ref, _rows = self.queued.popleft()
+            parts = self._partition(ref, self._n)
+            self._parts.append(parts)
+            self.in_flight += 1
+            started += 1
+            # Watch the LAST part: parts come from one num_returns=n
+            # task, so all n land together.
+            self.watch(parts[-1], self._on_map_ready)
+        if (self.done_called and not self.queued and self.in_flight == 0
+                and not self._reduce_started):
+            self._reduce_started = True
+            started += self._dispatch_reduces(max(1, budget))
+        elif self._reduce_started:
+            started += self._dispatch_reduces(budget)
+        return started
+
+    def _on_map_ready(self, _ref) -> None:
+        self._map_done += 1
+        self.in_flight -= 1
+
+    def _dispatch_reduces(self, budget: int) -> int:
+        started = 0
+        while (self._reduce_next < self._n and started < budget
+               and len(self._reduce_in_flight) < self.max_in_flight):
+            j = self._reduce_next
+            self._reduce_next += 1
+            out = self._reduce(j, [parts[j] for parts in self._parts])
+            self._reduce_in_flight[j] = out
+            started += 1
+            self.watch(out, lambda r, j=j: self._on_reduce_ready(j, r))
+        return started
+
+    def _on_reduce_ready(self, j: int, ref: api.ObjectRef) -> None:
+        self._reduce_in_flight.pop(j, None)
+        if not self._ordered:
+            self._emitted += 1
+            self.emit((ref, -1))
+            if self._emitted == self._n:
+                self._release_parts()
+            return
+        # Ordered (sort): emit partitions in range order (reversed for
+        # descending) as soon as the next-expected one lands.
+        self._reduce_out[j] = ref
+        order = range(self._n - 1, -1, -1) if self._reverse \
+            else range(self._n)
+        order = list(order)
+        while self._emitted < self._n:
+            want = order[self._emitted]
+            if want not in self._reduce_out:
+                break
+            self._emitted += 1
+            self.emit((self._reduce_out.pop(want), -1))
+        if self._emitted == self._n:
+            self._release_parts()
+
+    def _release_parts(self) -> None:
+        # Drop partition refs promptly: they are the shuffle's working
+        # set (potentially the whole dataset) and must not outlive the
+        # reduce phase.
+        self._parts = []
+
+    def work_left(self) -> bool:
+        if not self.done_called or self.queued or self.in_flight:
+            return True
+        return self._emitted < self._n
+
+
+class SampledSortOperator(ShuffleOperator):
+    """Streaming external sort (reference: dataset.py sort — but the
+    reference samples AFTER materializing; this samples ON the stream).
+
+    Phase 1 (streaming): sort each incoming block and extract a small
+    sample (one extra remote hop per block, bounded in-flight).
+    Barrier: compute range boundaries from the union of samples.
+    Phase 2+3: range-partition each sorted block, then merge each range
+    — both streaming with bounded budgets. Data lives in the store the
+    whole time (spills under pressure); the driver holds refs + samples
+    only.
+    """
+
+    def __init__(self, name: str, num_partitions: int,
+                 sort_and_sample: Callable,   # ref -> (sorted_ref, sample_ref)
+                 partition_with_bounds: Callable,  # (ref, n, bounds_ref) -> [refs]
+                 reduce_submit: Callable,
+                 bounds_from_samples: Callable,    # [sample refs] -> bounds_ref
+                 reverse_output: bool,
+                 max_in_flight: int = 4):
+        super().__init__(name, num_partitions,
+                         partition_submit=None, reduce_submit=reduce_submit,
+                         ordered_output=True, reverse_output=reverse_output,
+                         max_in_flight=max_in_flight)
+        self._sort_and_sample = sort_and_sample
+        self._partition_with_bounds = partition_with_bounds
+        self._bounds_from_samples = bounds_from_samples
+        self._sorted: List[api.ObjectRef] = []
+        self._samples: List[api.ObjectRef] = []
+        self._phase1_in_flight = 0
+        self._bounds_ref = None
+        self._part_next = 0
+
+    def dispatch(self, budget: int) -> int:
+        started = 0
+        # Phase 1: sort+sample the stream.
+        while (self.queued and started < budget
+               and self._phase1_in_flight < self.max_in_flight):
+            ref, _rows = self.queued.popleft()
+            sorted_ref, sample_ref = self._sort_and_sample(ref)
+            self._sorted.append(sorted_ref)
+            self._samples.append(sample_ref)
+            self._phase1_in_flight += 1
+            self.in_flight += 1
+            started += 1
+            self.watch(sorted_ref, self._on_phase1_ready)
+        # Barrier: boundaries once the stream is fully sorted.
+        if (self.done_called and not self.queued
+                and self._phase1_in_flight == 0
+                and self._bounds_ref is None):
+            self._n = max(1, min(self._n, len(self._sorted)) or 1)
+            self._bounds_ref = self._bounds_from_samples(
+                self._samples, self._n)
+            self._samples = []
+        # Phase 2: range-partition sorted blocks.
+        if self._bounds_ref is not None:
+            while (self._part_next < len(self._sorted)
+                   and started < budget
+                   and self.in_flight < self.max_in_flight):
+                i = self._part_next
+                self._part_next += 1
+                parts = self._partition_with_bounds(
+                    self._sorted[i], self._n, self._bounds_ref)
+                self._parts.append(parts)
+                self.in_flight += 1
+                started += 1
+                self.watch(parts[-1], self._on_map_ready)
+            # Phase 3: merge ranges once every block is partitioned.
+            if (self._part_next == len(self._sorted)
+                    and self.in_flight == 0):
+                if not self._reduce_started:
+                    self._reduce_started = True
+                    self._sorted = []  # partitions supersede them
+                started += self._dispatch_reduces(max(1, budget))
+            elif self._reduce_started:
+                started += self._dispatch_reduces(budget)
+        return started
+
+    def _on_phase1_ready(self, _ref) -> None:
+        self._phase1_in_flight -= 1
+        self.in_flight -= 1
+
+    def work_left(self) -> bool:
+        if not self.done_called or self.queued or self.in_flight:
+            return True
+        if self._bounds_ref is None:
+            return True
+        if self._part_next < len(self._sorted):
+            return True
+        return self._emitted < self._n
+
+
+class FinalizeOperator(Operator):
+    """Map each input through one remote call, then ONE finalize remote
+    call over all outputs at the barrier — for stages whose
+    per-partition results are small (aggregates). The finalize output
+    is the operator's single emitted bundle."""
+
+    def __init__(self, name: str, submit: Callable,
+                 finalize: Callable[[List[api.ObjectRef]], api.ObjectRef],
+                 max_in_flight: int = 4):
+        super().__init__()
+        self.name = name
+        self._submit = submit
+        self._finalize = finalize
+        self._outs: List[api.ObjectRef] = []
+        self._finalized = False
+        self._emitted = False
+        self.max_in_flight = max_in_flight
+
+    def add_input(self, bundle: Bundle) -> None:
+        self.queued.append(bundle)
+
+    def dispatch(self, budget: int) -> int:
+        started = 0
+        while (self.queued and started < budget
+               and self.in_flight < self.max_in_flight):
+            ref, _rows = self.queued.popleft()
+            out = self._submit(ref)
+            self._outs.append(out)
+            self.in_flight += 1
+            started += 1
+            self.watch(out, self._on_ready)
+        if (self.done_called and not self.queued and self.in_flight == 0
+                and not self._finalized):
+            self._finalized = True
+            final = self._finalize(self._outs)
+            self._outs = []
+            self.watch(final, self._on_final_ready)
+            started += 1
+        return started
+
+    def _on_ready(self, _ref) -> None:
+        self.in_flight -= 1
+
+    def _on_final_ready(self, ref: api.ObjectRef) -> None:
+        self._emitted = True
+        self.emit((ref, -1))
+
+    def work_left(self) -> bool:
+        if not self.done_called or self.queued or self.in_flight:
+            return True
+        return not self._emitted
+
+
+class OperatorResourceManager:
+    """Per-operator budgets + spill-aware admission (reference:
+    _internal/execution/resource_manager.py + backpressure_policy/).
+
+    Global budget B (ctx.max_in_flight_bundles) splits across operators,
+    minimum 2 each so every stage keeps pipelining. Above the store
+    pressure threshold, only the most-downstream operator with queued
+    work may dispatch — completing downstream work frees upstream
+    blocks — and source admission pauses."""
+
+    def __init__(self, ops: List[Operator], ctx: DataContext):
+        self._ops = ops
+        self._ctx = ctx
+        budget = max(2, ctx.max_in_flight_bundles)
+        per = max(2, budget // max(1, len(ops)))
+        for op in ops:
+            op.max_in_flight = per
+
+    def store_pressure(self) -> bool:
+        used, cap = _store_stats()
+        if not cap:
+            return False
+        return (used / cap) >= self._ctx.backpressure_store_fraction
+
+    def admit_source(self, total_queued: int) -> bool:
+        if total_queued >= 2 * max(
+                2, self._ctx.max_in_flight_bundles):
+            return False
+        if self.store_pressure():
+            self._ctx.backpressure_throttle_count += 1
+            return False
+        return True
+
+    def dispatch_order(self) -> List[int]:
+        """Downstream-first always — draining reduces memory; under
+        pressure, ONLY the most-downstream op with work dispatches."""
+        idxs = list(range(len(self._ops) - 1, -1, -1))
+        if not self.store_pressure():
+            return idxs
+        for i in idxs:
+            op = self._ops[i]
+            if op.queued or (op.work_left() and op.done_called):
+                return [i]
+        return idxs[:1] if idxs else []
+
+
+class StreamingExecutor:
+    """Pump thread driving bundles source -> op1 -> ... -> opN -> output
+    (reference: streaming_executor.py:48 — 'a pull-based operator
+    topology executed in a driver thread')."""
+
+    def __init__(self, ops: List[Operator],
+                 ctx: Optional[DataContext] = None):
+        self._ops = ops
+        self._ctx = ctx or DataContext.get_current()
+        self._rm = OperatorResourceManager(ops, self._ctx)
+        self._cond = threading.Condition()
+        self._ready_cbs: collections.deque = collections.deque()
+        self._output: collections.deque = collections.deque()
+        self._output_cap = max(2, self._ctx.prefetch_batches + 1)
+        self._stopped = False
+        self._error: Optional[BaseException] = None
+        self._pump_done = threading.Event()
+        # Wiring: op i emits into op i+1; last op emits to output.
+        for i, op in enumerate(ops):
+            op.watch = self._watch
+            if i + 1 < len(ops):
+                nxt = ops[i + 1]
+                op.emit = (lambda b, nxt=nxt: nxt.add_input(b))
+            else:
+                op.emit = self._emit_output
+
+    # -- plumbing (pump thread only, under _cond via _pump) ---------------
+    def _watch(self, ref: api.ObjectRef, fn: Callable) -> None:
+        """Run fn(ref) on the pump thread when ref's object is ready.
+        The runtime's ready callback fires on its completion-dispatch
+        thread — never run operator logic (or submissions) there."""
+        def _cb():
+            with self._cond:
+                self._ready_cbs.append((fn, ref))
+                self._cond.notify_all()
+        _add_ready_callback(ref, _cb)
+
+    def _emit_output(self, bundle: Bundle) -> None:
+        self._output.append(bundle)
+
+    def execute(self, source: Iterator[Bundle]) -> Iterator[Bundle]:
+        """Run the topology over `source`; yields output bundles in
+        topology order (operators preserve per-op FIFO; ordered barrier
+        ops handle their own ordering)."""
+        if not self._ops:
+            yield from source
+            return
+        pump = threading.Thread(target=self._pump, args=(source,),
+                                daemon=True, name="data-streaming-pump")
+        pump.start()
+        try:
+            while True:
+                with self._cond:
+                    while (not self._output and self._error is None
+                           and not self._pump_done.is_set()):
+                        self._cond.wait(timeout=0.5)
+                    if self._output:
+                        bundle = self._output.popleft()
+                        self._cond.notify_all()  # room for the pump
+                    elif self._error is not None:
+                        raise self._error
+                    else:
+                        return
+                yield bundle
+        finally:
+            with self._cond:
+                self._stopped = True
+                self._cond.notify_all()
+            pump.join(timeout=30)
+            for op in self._ops:
+                try:
+                    op.close()
+                except Exception:
+                    pass
+
+    # -- the pump ----------------------------------------------------------
+    def _pump(self, source: Iterator[Bundle]) -> None:
+        exhausted = False
+        try:
+            while True:
+                with self._cond:
+                    if self._stopped:
+                        return
+                    cbs = list(self._ready_cbs)
+                    self._ready_cbs.clear()
+                # Completion routing OUTSIDE the lock: emit() may push
+                # downstream queues; only the output deque is shared
+                # with the consumer (append is atomic; cap checked
+                # below).
+                for fn, ref in cbs:
+                    fn(ref)
+                # Source admission.
+                total_queued = sum(len(op.queued) for op in self._ops)
+                while (not exhausted and self._ops
+                       and self._rm.admit_source(total_queued)
+                       and len(self._output) < self._output_cap):
+                    try:
+                        bundle = next(source)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    self._ops[0].add_input(bundle)
+                    total_queued += 1
+                if exhausted and not self._ops[0].done_called:
+                    self._ops[0].inputs_done()
+                # Dispatch, downstream-first; propagate inputs_done down
+                # the chain as ops drain.
+                if len(self._output) < self._output_cap:
+                    for i in self._rm.dispatch_order():
+                        self._ops[i].dispatch(budget=8)
+                for i in range(len(self._ops) - 1):
+                    op, nxt = self._ops[i], self._ops[i + 1]
+                    if (op.done_called and not op.work_left()
+                            and not nxt.done_called):
+                        nxt.inputs_done()
+                # Termination: source drained and no op has work.
+                if exhausted and all(not op.work_left()
+                                     for op in self._ops):
+                    return
+                if not self._ops and exhausted:
+                    return
+                with self._cond:
+                    if self._ready_cbs or self._stopped:
+                        continue
+                    self._cond.notify_all()  # outputs may have landed
+                    self._cond.wait(timeout=0.05)
+        except BaseException as e:  # noqa: BLE001
+            with self._cond:
+                self._error = e
+                self._cond.notify_all()
+        finally:
+            self._pump_done.set()
+            with self._cond:
+                self._cond.notify_all()
+
+
+def _add_ready_callback(ref: api.ObjectRef, cb: Callable) -> None:
+    """Object-ready notification for driver-held refs; worker/client
+    contexts fall back to a waiter thread (same split as
+    ObjectRef.future)."""
+    from .._private import state
+    rt = state.get_node()
+    objects = getattr(getattr(rt, "gcs", None), "objects", None)
+    if objects is not None:
+        objects.add_ready_callback(ref.id, cb)
+        return
+
+    def _wait():
+        try:
+            api.wait([ref], num_returns=1, timeout=None)
+        except Exception:
+            pass
+        cb()
+    threading.Thread(target=_wait, daemon=True).start()

@@ -1,15 +1,11 @@
-"""Streaming execution: pipelined bundle flow + split iterators.
+"""Streaming consumption utilities + split iterators.
 
-Reference parity: python/ray/data/_internal/execution/streaming_executor.py
-(StreamingExecutor :48) and _internal/iterator/stream_split_iterator.py
-(StreamSplitDataIterator :31). The TPU redesign leans on the task
-scheduler itself for pipelining: a chain of per-bundle map stages is
-submitted as a dependency chain of remote calls, so stage N of bundle i
-runs while stage 1 of bundle i+k is still executing — the pull-based
-operator topology of the reference collapses into dataflow on ObjectRefs.
-Backpressure = a cap on submitted-but-unconsumed chains
-(DataContext.max_in_flight_bundles), bounding store footprint the way the
-reference's resource manager + backpressure policies do.
+Reference parity: _internal/iterator/stream_split_iterator.py
+(StreamSplitDataIterator :31) and _internal/block_batching. The
+pull-based operator topology itself lives in executor.py
+(StreamingExecutor); this module provides the consumption side — block
+resolution with prefetch, batch re-chunking, the streaming_split
+coordinator, and the jax device feed.
 """
 
 from __future__ import annotations
@@ -25,83 +21,14 @@ from .context import DataContext
 StreamedBundle = Tuple[api.ObjectRef, int]
 
 
-def _store_pressure() -> float:
-    """Driver-store usage fraction — the backpressure signal (the head
-    store is where streamed intermediates land on a single-node
-    cluster, and the first store to hurt on any cluster)."""
-    try:
-        from .._private import state
-        st = state.current().store.stats()
-        cap = st.get("capacity") or 0
-        return (st.get("used_bytes", 0) / cap) if cap else 0.0
-    except Exception:
-        return 0.0
-
-
-def stream_bundles(
-    source: Iterator[StreamedBundle],
-    submitters: List[Callable[[api.ObjectRef], api.ObjectRef]],
-    window: Optional[int] = None,
-) -> Iterator[StreamedBundle]:
-    """Pump bundles from `source` through a chain of per-bundle stage
-    submitters, keeping at most `window` chains in flight.
-
-    Each submitter takes a block ref and returns the ref of the stage's
-    output — typically one `remote()` call whose argument is the upstream
-    ref, so the scheduler interleaves stages across bundles (no barrier
-    between stages; the reference's streaming topology, executor-less).
-    """
-    ctx = DataContext.get_current()
-    window = window or ctx.max_in_flight_bundles
-    preserve_order = ctx.preserve_order
-    in_flight: collections.deque = collections.deque()
-    exhausted = False
-    while True:
-        while not exhausted and len(in_flight) < window:
-            if (in_flight
-                    and _store_pressure()
-                    >= ctx.backpressure_store_fraction):
-                # Resource-aware backpressure (reference:
-                # resource_manager.py per-operator budgets): the store
-                # is near capacity, so stop admitting new chains —
-                # consuming the ones in flight frees blocks — while
-                # never dropping below one chain (the pipeline must
-                # still drain to relieve the pressure).
-                ctx.backpressure_throttle_count += 1
-                break
-            try:
-                ref, rows = next(source)
-            except StopIteration:
-                exhausted = True
-                break
-            for submit in submitters:
-                ref = submit(ref)
-            # Row count is unknown once a transform ran (rows may change).
-            in_flight.append((ref, rows if not submitters else -1))
-        if not in_flight:
-            return
-        if preserve_order or len(in_flight) == 1:
-            yield in_flight.popleft()
-        else:
-            # Completed-order: yield whichever chain finishes first so a
-            # slow head block can't stall finished successors.
-            ready, _ = api.wait([r for r, _ in in_flight],
-                                num_returns=1, timeout=None)
-            done = ready[0]
-            for i, (r, rows) in enumerate(in_flight):
-                if r is done:
-                    del in_flight[i]
-                    yield (r, rows)
-                    break
-
-
 def iter_blocks(bundles: Iterator[StreamedBundle],
                 prefetch: int = 0) -> Iterator[B.Block]:
     """Resolve bundle refs to blocks; with `prefetch` > 0, hold that many
     upcoming refs before the one being consumed. Pulling ahead from
-    `bundles` advances stream_bundles' in-flight window, so later chains
-    execute (and their results land in the store) while the current block
-    is being consumed — the reference's iter_batches read-ahead."""
+    `bundles` advances the streaming executor's admission, so later
+    bundles execute (and their results land in the store) while the
+    current block is being consumed — the reference's iter_batches
+    read-ahead."""
     window: collections.deque = collections.deque()
     for bundle in bundles:
         window.append(bundle)

@@ -14,7 +14,6 @@ import pytest
 import ray_tpu as ray
 import ray_tpu.data as rdata
 from ray_tpu.data import context as data_context
-from ray_tpu.data import streaming as data_streaming
 from ray_tpu.data.dataset import ActorPoolStrategy, _MapBatchesActorPool
 
 
@@ -84,12 +83,13 @@ def test_streaming_backpressure_throttles_under_store_pressure(
     before = ctx.backpressure_throttle_count
     calls = {"n": 0}
 
-    def fake_pressure():
+    def fake_stats():
         # High pressure for the first few admission checks, then clear.
         calls["n"] += 1
-        return 0.99 if calls["n"] < 4 else 0.0
+        return (99, 100) if calls["n"] < 4 else (0, 100)
 
-    monkeypatch.setattr(data_streaming, "_store_pressure", fake_pressure)
+    from ray_tpu.data import executor as data_executor
+    monkeypatch.setattr(data_executor, "_store_stats", fake_stats)
     # No barrier stages: repartition would force bulk execution and
     # bypass the streaming window entirely.
     ds = rdata.range(32, override_num_blocks=8).map_batches(
