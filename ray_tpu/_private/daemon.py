@@ -402,10 +402,9 @@ class NodeDaemon:
             # one, not the head's (relaying would spill the head's arena
             # while the worker's local arena stays full).
             try:
-                need = int(payload.get("kwargs", {}).get("need", 0))
-                used = self.store.stats().get("used_bytes", 0)
-                reclaimed = self.store.spill_objects(
-                    max(0, used - 2 * need))
+                from .object_store import escalated_spill
+                reclaimed = escalated_spill(
+                    self.store, payload.get("kwargs", {}).get("need", 0))
             except Exception:
                 reclaimed = 0
             try:

@@ -39,6 +39,15 @@ def inline_threshold() -> int:
     return int(ray_config.inline_object_max_bytes)
 
 
+def escalated_spill(store, need: int) -> int:
+    """Owner-side response to a worker's full-arena escalation (see
+    create()'s request_spill): free ~2x the requested bytes — slack for
+    concurrent creates — never the whole arena. One policy shared by
+    the head (runtime.py) and per-node daemons (daemon.py)."""
+    used = store.stats().get("used_bytes", 0)
+    return store.spill_objects(max(0, used - 2 * int(need)))
+
+
 def _default_capacity() -> int:
     """Default store capacity: a fraction of /dev/shm (reference defaults
     plasma to 30% of system memory, ray_config_def.h object_store_memory;

@@ -1719,13 +1719,10 @@ class Node:
         if op == "spill_store":
             # A head-attached worker's create() hit a full arena: only
             # the owner may spill other processes' sealed blocks (it
-            # adopted them). Free ~2x the request (slack absorbs
-            # concurrent creates) — never drain the whole arena. Daemon
-            # nodes intercept this op locally (daemon.py) so it always
-            # targets the full node's own store.
-            need = int(kwargs.get("need", 0))
-            used = self.store.stats().get("used_bytes", 0)
-            return self.store.spill_objects(max(0, used - 2 * need))
+            # adopted them). Daemon nodes intercept this op locally
+            # (daemon.py) so it always targets the full node's store.
+            from .object_store import escalated_spill
+            return escalated_spill(self.store, kwargs.get("need", 0))
         if op == "list_objects":
             return self.gcs.objects.list_entries(
                 limit=kwargs.get("limit", 1000))

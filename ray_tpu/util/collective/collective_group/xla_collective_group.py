@@ -14,9 +14,15 @@ TPU the bytes ride ICI, and on CPU the same code path rides the
 jax.distributed gRPC transport. This is the "same test matrix against a
 host-CPU jax backend vs real ICI" pattern from SURVEY.md §4.
 
-Constraint: `jax.distributed.initialize` is once-per-process, so all groups
-in one process must span the same process set (the reference's NCCL comms
-have an analogous one-comm-per-device-set restriction).
+Subset groups (reference: GroupManager supporting multiple groups with
+different member sets per process, collective.py:40,120): the FIRST group
+a process joins initializes the one-per-process `jax.distributed`
+runtime; any later group whose topology differs is treated as a SUBSET
+over that global runtime — members publish their global process index
+through the KV, and the group's mesh is built from just those
+processes' devices. Ops over a subset mesh are programs only the member
+processes enter (the same pairwise-mesh trick the p2p path uses), so
+e.g. disjoint TP groups inside a DP world each allreduce independently.
 """
 
 from __future__ import annotations
@@ -86,16 +92,19 @@ def _rendezvous(group_name: str, world_size: int, rank: int,
         f"Rendezvous for group '{group_name}' timed out after {timeout_s}s")
 
 
+def runtime_initialized() -> bool:
+    with _init_lock:
+        return bool(_distributed_state)
+
+
 def ensure_distributed(coordinator: str, world_size: int, rank: int):
     """Initialize the jax.distributed runtime exactly once per process
-    (replaces dist.init_process_group / NCCL comm init)."""
+    (replaces dist.init_process_group / NCCL comm init). A later group
+    whose topology differs simply reuses the existing runtime — its
+    membership is resolved through the KV (see _subset_members), never
+    from the runtime's topology."""
     with _init_lock:
         if _distributed_state:
-            prev = _distributed_state
-            if (prev["world_size"] != world_size or prev["rank"] != rank):
-                raise RuntimeError(
-                    "jax.distributed already initialized with a different "
-                    f"topology ({prev}); one process set per process.")
             return
         import jax
         if world_size > 1:
@@ -113,8 +122,9 @@ class XLAGroup(BaseGroup):
 
     def __init__(self, world_size: int, rank: int, group_name: str):
         super().__init__(world_size, rank, group_name)
-        coordinator = _rendezvous(group_name, world_size, rank)
-        ensure_distributed(coordinator, world_size, rank)
+        if not runtime_initialized():
+            coordinator = _rendezvous(group_name, world_size, rank)
+            ensure_distributed(coordinator, world_size, rank)
         import jax
         self._jax = jax
         # One representative device per process => 'world' axis length equals
@@ -122,15 +132,76 @@ class XLAGroup(BaseGroup):
         per_proc: Dict[int, object] = {}
         for d in sorted(jax.devices(), key=lambda d: (d.process_index, d.id)):
             per_proc.setdefault(d.process_index, d)
-        if len(per_proc) != world_size:
+        # EVERY group resolves membership through the KV — including a
+        # whole-world group. Deciding owner-vs-subset per process from
+        # local runtime state is unsound (two members of one group could
+        # take different paths and deadlock); uniform KV resolution is
+        # one put + world_size gets, trivial next to the jax init.
+        member_procs = self._subset_members(group_name, world_size,
+                                            rank, jax.process_index())
+        if len(set(member_procs)) != world_size:
             raise RuntimeError(
-                f"Group '{group_name}': expected {world_size} processes, "
-                f"found {len(per_proc)} in the jax runtime.")
+                f"Group '{group_name}': member process indices "
+                f"{member_procs} are not distinct — the members do not "
+                "share one jax.distributed runtime (a process that "
+                "first created a world_size=1 group never joins a "
+                "shared runtime; create the multi-process group first).")
+        for p in member_procs:
+            if p not in per_proc:
+                raise RuntimeError(
+                    f"Group '{group_name}': member process {p} has no "
+                    "devices in the jax runtime.")
         from jax.sharding import Mesh
-        self._devices = [per_proc[i] for i in sorted(per_proc)]
+        self._devices = [per_proc[p] for p in member_procs]
         self._mesh = Mesh(np.array(self._devices), ("world",))
         self._local_device = per_proc[jax.process_index()]
         self._jit_cache: Dict[Tuple, object] = {}
+
+    @staticmethod
+    def _subset_members(group_name: str, world_size: int, rank: int,
+                        my_process_index: int,
+                        timeout_s: float = 60.0) -> list:
+        """Publish this member's global process index; wait for all
+        world_size members, returning their process indices in
+        group-rank order (rank i of the group == i-th entry).
+
+        A confirm round guards against stale keys from a crashed
+        earlier group of the same name: every member publishes the
+        membership signature it resolved and loops until all members
+        published the SAME signature. A stale proc key is overwritten
+        by the live member for that rank, so divergent first reads
+        converge; mismatched signatures force a re-read."""
+        _kv_put(f"{group_name}/proc/{rank}",
+                str(my_process_index).encode())
+        deadline = time.monotonic() + timeout_s
+
+        def _poll(key):
+            while time.monotonic() < deadline:
+                raw = _kv_get(key)
+                if raw is not None:
+                    return raw
+                time.sleep(0.05)
+            raise TimeoutError(
+                f"group '{group_name}' rendezvous timed out on {key}")
+
+        while True:
+            members = [int(_poll(f"{group_name}/proc/{r}").decode())
+                       for r in range(world_size)]
+            sig = ",".join(map(str, members))
+            _kv_put(f"{group_name}/confirm/{rank}", sig.encode())
+            agreed = True
+            for r in range(world_size):
+                other = _poll(f"{group_name}/confirm/{r}").decode()
+                if other != sig:
+                    agreed = False
+                    break
+            if agreed:
+                return members
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"group '{group_name}' members disagree on "
+                    f"membership ({sig} vs {other})")
+            time.sleep(0.1)
 
     @classmethod
     def backend(cls) -> str:
@@ -357,3 +428,11 @@ class XLAGroup(BaseGroup):
 
     def destroy_group(self):
         self._jit_cache.clear()
+        # Drop rendezvous keys so the group name is cleanly reusable.
+        for key in (f"{self._group_name}/proc/{self._rank}",
+                    f"{self._group_name}/confirm/{self._rank}",
+                    f"{self._group_name}/coordinator"):
+            try:
+                _kv().gcs_request("kv_del", key=key, namespace=_KV_NS)
+            except Exception:
+                pass
