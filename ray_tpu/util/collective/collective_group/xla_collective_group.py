@@ -92,19 +92,26 @@ def _rendezvous(group_name: str, world_size: int, rank: int,
         f"Rendezvous for group '{group_name}' timed out after {timeout_s}s")
 
 
-def runtime_initialized() -> bool:
-    with _init_lock:
-        return bool(_distributed_state)
-
-
-def ensure_distributed(coordinator: str, world_size: int, rank: int):
+def ensure_distributed(coordinator: str, world_size: int, rank: int,
+                       strict: bool = True):
     """Initialize the jax.distributed runtime exactly once per process
-    (replaces dist.init_process_group / NCCL comm init). A later group
-    whose topology differs simply reuses the existing runtime — its
-    membership is resolved through the KV (see _subset_members), never
-    from the runtime's topology."""
+    (replaces dist.init_process_group / NCCL comm init). With
+    ``strict`` (the default — train's JaxBackendConfig.on_start), an
+    already-initialized runtime with a DIFFERENT topology raises
+    loudly: silently keeping the stale topology would make later
+    collectives hang or run with wrong world semantics. Group creation
+    (XLAGroup) passes strict=False because its membership comes from
+    the KV rendezvous, not the runtime topology."""
     with _init_lock:
         if _distributed_state:
+            prev = _distributed_state
+            if strict and (prev["world_size"] != world_size
+                           or prev["rank"] != rank):
+                raise RuntimeError(
+                    "jax.distributed already initialized with a "
+                    f"different topology ({prev}); a worker process "
+                    "cannot re-initialize at a new world size — "
+                    "elastic resizes must restart worker processes.")
             return
         import jax
         if world_size > 1:
@@ -112,9 +119,13 @@ def ensure_distributed(coordinator: str, world_size: int, rank: int):
                 coordinator_address=coordinator,
                 num_processes=world_size,
                 process_id=rank)
+        import os as _os
         _distributed_state.update(
             {"world_size": world_size, "rank": rank,
-             "coordinator": coordinator})
+             # A world_size-1 "runtime" has no shared coordinator; tag
+             # it per-process so split-brain detection never mistakes
+             # two solo runtimes for a shared one.
+             "coordinator": coordinator or f"local:{_os.getpid()}"})
 
 
 class XLAGroup(BaseGroup):
@@ -122,9 +133,19 @@ class XLAGroup(BaseGroup):
 
     def __init__(self, world_size: int, rank: int, group_name: str):
         super().__init__(world_size, rank, group_name)
-        if not runtime_initialized():
-            coordinator = _rendezvous(group_name, world_size, rank)
-            ensure_distributed(coordinator, world_size, rank)
+        # Phase A: agree on runtime state across ALL members before any
+        # blocking jax.distributed.initialize — a per-process decision
+        # here deadlocks groups that mix initialized and uninitialized
+        # processes (one side waits in initialize, the other skips it),
+        # and silently accepts split-brain groups spanning two separate
+        # runtimes. Members publish their state; creation proceeds only
+        # when all are fresh (one shared initialize) or all already
+        # share ONE runtime (subset group).
+        mode, coordinator = self._pre_rendezvous(group_name, world_size,
+                                                 rank)
+        if mode == "create":
+            ensure_distributed(coordinator, world_size, rank,
+                               strict=False)
         import jax
         self._jax = jax
         # One representative device per process => 'world' axis length equals
@@ -156,6 +177,55 @@ class XLAGroup(BaseGroup):
         self._mesh = Mesh(np.array(self._devices), ("world",))
         self._local_device = per_proc[jax.process_index()]
         self._jit_cache: Dict[Tuple, object] = {}
+
+    @staticmethod
+    def _pre_rendezvous(group_name: str, world_size: int, rank: int,
+                        timeout_s: float = 60.0):
+        """Pre-init agreement: every member publishes whether its
+        process already runs a jax.distributed runtime (and which, by
+        coordinator tag). Returns ("create", coordinator) when all
+        members are fresh, ("join", tag) when all share one runtime;
+        raises for mixed membership or two different runtimes — those
+        groups cannot work (a process cannot join a runtime late), so
+        fail loudly instead of hanging in initialize/collectives."""
+        with _init_lock:
+            my_tag = (_distributed_state.get("coordinator")
+                      if _distributed_state else "uninit")
+        _kv_put(f"{group_name}/pre/{rank}", str(my_tag).encode())
+        deadline = time.monotonic() + timeout_s
+        last_tags = None
+        mixed_since = None
+        while time.monotonic() < deadline:
+            tags = []
+            for r in range(world_size):
+                raw = _kv_get(f"{group_name}/pre/{r}")
+                tags.append(raw.decode() if raw is not None else None)
+            if None not in tags:
+                last_tags = tags
+                if all(t == "uninit" for t in tags):
+                    return ("create",
+                            _rendezvous(group_name, world_size, rank))
+                if "uninit" not in tags and len(set(tags)) == 1:
+                    return ("join", tags[0])
+                # Mixed / divergent: could be stale keys from a crashed
+                # earlier group mid-overwrite — give live members a 3s
+                # window to overwrite before declaring it fatal.
+                now = time.monotonic()
+                mixed_since = mixed_since or now
+                if now - mixed_since >= 3.0:
+                    raise RuntimeError(
+                        f"Group '{group_name}': members span "
+                        f"incompatible runtime states {tags} — every "
+                        "member must either be fresh (first group "
+                        "creates the runtime) or already share ONE "
+                        "jax.distributed runtime; a process cannot "
+                        "join an existing runtime late.")
+            else:
+                mixed_since = None
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"group '{group_name}' pre-rendezvous timed out "
+            f"(tags={last_tags})")
 
     @staticmethod
     def _subset_members(group_name: str, world_size: int, rank: int,
@@ -431,6 +501,7 @@ class XLAGroup(BaseGroup):
         # Drop rendezvous keys so the group name is cleanly reusable.
         for key in (f"{self._group_name}/proc/{self._rank}",
                     f"{self._group_name}/confirm/{self._rank}",
+                    f"{self._group_name}/pre/{self._rank}",
                     f"{self._group_name}/coordinator"):
             try:
                 _kv().gcs_request("kv_del", key=key, namespace=_KV_NS)
