@@ -12,9 +12,11 @@ with power-of-two padding so XLA compiles a few bucket shapes
 from .batch import (ChatTemplateStage, DetokenizeStage, GPTInferenceStage,
                     HttpRequestStage, Processor, ProcessorConfig,
                     TokenizeStage, build_processor)
+from .continuous import ContinuousBatchingEngine
 from .serving import ByteTokenizer, LLMEngine, build_llm_app
 
-__all__ = ["ByteTokenizer", "ChatTemplateStage", "DetokenizeStage",
+__all__ = ["ByteTokenizer", "ChatTemplateStage",
+           "ContinuousBatchingEngine", "DetokenizeStage",
            "GPTInferenceStage", "HttpRequestStage", "LLMEngine",
            "Processor", "ProcessorConfig", "TokenizeStage",
            "build_llm_app", "build_processor"]

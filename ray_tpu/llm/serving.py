@@ -87,9 +87,15 @@ class LLMEngine:
 
 
 def build_llm_app(cfg=None, params=None, *, num_replicas: int = 1,
-                  num_tpus: float = 0):
+                  num_tpus: float = 0, continuous_batching: bool = False,
+                  max_batch: int = 8):
     """Serve application: POST {"prompt": ..., "max_tokens": ...,
-    "stream": bool} — streaming responses ride Serve's chunked path."""
+    "stream": bool} — streaming responses ride Serve's chunked path.
+
+    ``continuous_batching=True`` backs each replica with ONE shared
+    ContinuousBatchingEngine (llm/continuous.py): concurrent requests
+    decode together in a slot-reuse KV batch, so a late request joins
+    the running decode instead of queueing behind it."""
     from .. import serve
 
     actor_opts: Dict[str, Any] = {}
@@ -97,10 +103,27 @@ def build_llm_app(cfg=None, params=None, *, num_replicas: int = 1,
         actor_opts["num_tpus"] = num_tpus
 
     @serve.deployment(num_replicas=num_replicas,
-                      ray_actor_options=actor_opts or None)
+                      ray_actor_options=actor_opts or None,
+                      max_ongoing_requests=max(16, 2 * max_batch))
     class LLMServer:
         def __init__(self):
-            self.engine = LLMEngine(cfg=cfg, params=params)
+            if continuous_batching:
+                from .continuous import ContinuousBatchingEngine
+                self.engine = ContinuousBatchingEngine(
+                    cfg=cfg, params=params, max_batch=max_batch)
+                self._stream = self.engine.submit
+            else:
+                self.engine = LLMEngine(cfg=cfg, params=params)
+                self._stream = self.engine.stream
+
+        def _lazy_stream(self, prompt, max_tokens, temperature):
+            # Defer the submit to first iteration: the serve replica's
+            # dynamic-generator handshake re-runs the handler once on
+            # the first stream=True request (StreamingResponseRequired
+            # retry), and an EAGER submit there would enqueue a second,
+            # abandoned copy that burns a continuous-batching KV slot
+            # for its whole token budget.
+            yield from self._stream(prompt, max_tokens, temperature)
 
         def __call__(self, request):
             body = request.get("body") or {}
@@ -114,13 +137,13 @@ def build_llm_app(cfg=None, params=None, *, num_replicas: int = 1,
                 return {"error": "max_tokens must be an int and "
                         "temperature a float"}
             if body.get("stream"):
-                return self.engine.stream(prompt, max_tokens, temperature)
-            return {"text": self.engine.complete(
-                prompt, max_tokens, temperature)}
+                return self._lazy_stream(prompt, max_tokens,
+                                         temperature)
+            return {"text": "".join(
+                self._stream(prompt, max_tokens, temperature))}
 
         def generate_stream(self, prompt: str, max_tokens: int = 32,
                             temperature: float = 0.0):
-            yield from self.engine.stream(prompt, max_tokens,
-                                          temperature)
+            yield from self._stream(prompt, max_tokens, temperature)
 
     return LLMServer.bind()

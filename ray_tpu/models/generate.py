@@ -35,12 +35,18 @@ def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> List[Dict]:
 def _cached_block(x, layer, cache_layer, start_pos, cfg: GPTConfig):
     """One transformer block reading/writing the KV cache.
 
-    x: [b, L, d] at absolute positions [start_pos, start_pos + L).
-    Returns (x_out, new_cache_layer).
+    x: [b, L, d]. `start_pos` is the absolute offset of x's positions —
+    a scalar (all rows aligned: prefill / single-stream decode) or a
+    [b] vector (continuous batching: every row decodes at its own
+    position). One implementation serves both so the attention formulas
+    can't diverge; only the cache write and causal mask specialize on
+    the index shape. Returns (x_out, new_cache_layer).
     """
     b, L, d = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
     max_len = cache_layer["k"].shape[-2]
+    sp = jnp.asarray(start_pos)
+    per_row = sp.ndim == 1
 
     y = rms_norm(x, layer["ln1"])
     qkv = jnp.einsum("bsd,de->bse", y, layer["wqkv"])
@@ -49,25 +55,42 @@ def _cached_block(x, layer, cache_layer, start_pos, cfg: GPTConfig):
     k = k.reshape(b, L, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, L, h, hd).transpose(0, 2, 1, 3)
     # Rotary embeddings at absolute (possibly traced) positions —
-    # the same rope() the training forward uses.
-    positions = start_pos + jnp.arange(L)
+    # the same rope() the training forward uses ([L] or [b, L]).
+    if per_row:
+        positions = sp[:, None] + jnp.arange(L)[None]
+    else:
+        positions = sp + jnp.arange(L)
     q = rope(q, positions=positions)
     k = rope(k, positions=positions)
 
-    k_cache = jax.lax.dynamic_update_slice(
-        cache_layer["k"], k.astype(cache_layer["k"].dtype),
-        (0, 0, start_pos, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        cache_layer["v"], v.astype(cache_layer["v"].dtype),
-        (0, 0, start_pos, 0))
+    if per_row:
+        rows = jnp.arange(b)[:, None]                    # (b, 1)
+        cols = sp[:, None] + jnp.arange(L)[None]         # (b, L)
+        # Advanced indexing on axes 0 and 2 moves the index dims to
+        # the front: value shape (b, L, h, hd).
+        k_cache = cache_layer["k"].at[rows, :, cols, :].set(
+            k.transpose(0, 2, 1, 3).astype(cache_layer["k"].dtype))
+        v_cache = cache_layer["v"].at[rows, :, cols, :].set(
+            v.transpose(0, 2, 1, 3).astype(cache_layer["v"].dtype))
+    else:
+        k_cache = jax.lax.dynamic_update_slice(
+            cache_layer["k"], k.astype(cache_layer["k"].dtype),
+            (0, 0, sp, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            cache_layer["v"], v.astype(cache_layer["v"].dtype),
+            (0, 0, sp, 0))
 
     scale = hd ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k_cache.astype(jnp.float32)) * scale
-    q_pos = start_pos + jax.lax.broadcasted_iota(
-        jnp.int32, (L, max_len), 0)
+    q_iota = jax.lax.broadcasted_iota(jnp.int32, (L, max_len), 0)
     k_pos = jax.lax.broadcasted_iota(jnp.int32, (L, max_len), 1)
-    s = jnp.where((k_pos <= q_pos)[None, None], s, DEFAULT_MASK_VALUE)
+    if per_row:
+        q_pos = sp[:, None, None] + q_iota[None]         # (b, L, max)
+        mask = (k_pos[None] <= q_pos)[:, None]           # (b,1,L,max)
+    else:
+        mask = (k_pos <= sp + q_iota)[None, None]        # (1,1,L,max)
+    s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1)
     attn = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_cache.dtype),
                       v_cache)
@@ -121,6 +144,49 @@ def make_generate_fns(cfg: GPTConfig, max_len: int):
         return logits[:, 0, :], cache
 
     return prefill, decode_step
+
+
+@functools.lru_cache(maxsize=8)
+def make_continuous_fns(cfg: GPTConfig, max_len: int, batch: int):
+    """(insert_prefill, decode_batch) for CONTINUOUS BATCHING: one
+    shared [batch, ...] KV cache whose slots belong to independent
+    requests. A new request prefills into a free slot while the other
+    slots keep decoding; decode_batch advances EVERY slot one token at
+    its own position per call (per-slot rotary offsets + causal masks).
+    TPU-native analogue of vLLM-style continuous batching: static
+    shapes (one compile per prompt bucket + one decode compile), slot
+    reuse instead of dynamic batch shapes, so XLA never recompiles as
+    requests come and go.
+
+    insert_prefill(params, tokens[1, Lp], cache, slot, true_len)
+        -> (last_logits[vocab], cache)  # logits at true_len-1; the
+        prompt may be right-padded to the Lp bucket, padding positions
+        are never read back (decode overwrites position p before any
+        read at p).
+    decode_batch(params, tokens[B], pos[B], cache)
+        -> (logits[B, vocab], cache)
+    """
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def insert_prefill(params, tokens, cache, slot, true_len):
+        sub = [{k: jax.lax.dynamic_slice_in_dim(cl[k], slot, 1, axis=0)
+                for k in ("k", "v")} for cl in cache]
+        logits, new_sub = cached_forward(params, tokens, sub, 0, cfg)
+        out = [{k: jax.lax.dynamic_update_slice_in_dim(
+                    cl[k], ns[k], slot, axis=0) for k in ("k", "v")}
+               for cl, ns in zip(cache, new_sub)]
+        last = jax.lax.dynamic_slice_in_dim(
+            logits[0], true_len - 1, 1, axis=0)[0]
+        return last, out
+
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def decode_batch(params, tokens, pos, cache):
+        # cached_forward with a PER-ROW start_pos vector — the same
+        # block implementation as prefill and sequential decode.
+        logits, cache = cached_forward(
+            params, tokens[:, None], cache, pos, cfg)
+        return logits[:, 0, :], cache
+
+    return insert_prefill, decode_batch
 
 
 def _bucket_len(n: int, cap: int) -> int:

@@ -22,19 +22,25 @@ def rms_norm(x, weight, eps: float = 1e-6):
 def rope(x, position_offset=0, base: float = 10000.0, positions=None):
     """Rotary position embedding for [batch, heads, seq, head_dim].
 
-    `positions` (len-seq array, may be traced — the KV-cache decode path
-    passes start_pos + arange) overrides `position_offset`; one
-    implementation serves train and decode so the formulas can't
-    diverge."""
+    `positions` overrides `position_offset` and may be traced: shape
+    (seq,) — the KV-cache decode path passes start_pos + arange — or
+    (batch, seq) for per-sequence offsets (continuous batching decodes
+    every slot at its own position). One implementation serves train
+    and decode so the formulas can't diverge."""
     *_, seq_len, head_dim = x.shape
     if positions is None:
         positions = position_offset + jnp.arange(seq_len)
     pos = jnp.asarray(positions, jnp.float32)
     inv_freq = 1.0 / (base ** (
         jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    angles = pos[:, None] * inv_freq[None, :]        # (seq, d/2)
-    cos = jnp.cos(angles)[None, None]
-    sin = jnp.sin(angles)[None, None]
+    if pos.ndim == 2:                                # (batch, seq)
+        angles = pos[:, :, None] * inv_freq          # (b, seq, d/2)
+        cos = jnp.cos(angles)[:, None]               # (b, 1, seq, d/2)
+        sin = jnp.sin(angles)[:, None]
+    else:
+        angles = pos[:, None] * inv_freq[None, :]    # (seq, d/2)
+        cos = jnp.cos(angles)[None, None]
+        sin = jnp.sin(angles)[None, None]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     rotated = jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)

@@ -1,0 +1,162 @@
+"""Continuous batching (VERDICT r4 next #8): late requests join a
+RUNNING decode batch, slots are reused on completion, and aggregate
+throughput beats sequential decoding at 8 concurrent streams.
+
+Correctness anchor: with temperature 0, the continuous engine's output
+must be byte-identical to models.generate's sequential path for the
+same params (same formulas — per-slot positions and masks are the only
+difference)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_tpu.llm.continuous import ContinuousBatchingEngine
+from ray_tpu.llm.serving import ByteTokenizer, LLMEngine
+from ray_tpu.models import GPTConfig, gpt_init
+
+
+@pytest.fixture(scope="module")
+def small_setup():
+    cfg = GPTConfig(vocab_size=272, d_model=64, n_heads=4, n_layers=2,
+                    d_ff=128, max_seq_len=256)
+    params = gpt_init(jax.random.PRNGKey(7), cfg)
+    return cfg, params
+
+
+@pytest.fixture()
+def engine(small_setup):
+    cfg, params = small_setup
+    eng = ContinuousBatchingEngine(cfg=cfg, params=params, max_batch=4)
+    yield eng
+    eng.close()
+
+
+def _reference(cfg, params, prompt, n):
+    return LLMEngine(cfg=cfg, params=params).complete(
+        prompt, max_new_tokens=n, temperature=0.0)
+
+
+class TestCorrectness:
+    def test_matches_sequential_reference(self, small_setup, engine):
+        cfg, params = small_setup
+        out = engine.complete("hello world", 24, 0.0)
+        ref = _reference(cfg, params, "hello world", 24)
+        assert out == ref
+
+    def test_multiple_prompts_all_match(self, small_setup, engine):
+        cfg, params = small_setup
+        prompts = ["alpha", "the quick brown fox", "z", "data 123"]
+        streams = [engine.submit(p, 16, 0.0) for p in prompts]
+        outs = ["".join(s) for s in streams]
+        for p, o in zip(prompts, outs):
+            assert o == _reference(cfg, params, p, 16), p
+
+    def test_slot_reuse_more_requests_than_slots(self, small_setup,
+                                                 engine):
+        cfg, params = small_setup
+        prompts = [f"prompt {i}" for i in range(10)]  # > max_batch=4
+        streams = [engine.submit(p, 8, 0.0) for p in prompts]
+        outs = ["".join(s) for s in streams]
+        for p, o in zip(prompts, outs):
+            assert o == _reference(cfg, params, p, 8), p
+
+
+class TestLateJoin:
+    def test_late_request_joins_running_decode(self, small_setup,
+                                               engine):
+        cfg, params = small_setup
+        long_stream = engine.submit("long running request", 48, 0.0)
+        first = []
+        # Consume a few tokens so the batch is demonstrably mid-decode.
+        it = iter(long_stream)
+        for _ in range(6):
+            first.append(next(it))
+        steps_before = engine.steps
+        assert steps_before > 0
+        late = "".join(engine.submit("late arrival", 8, 0.0))
+        rest = "".join(it)
+        # The long request is unaffected by the mid-flight join...
+        assert "".join(first) + rest == _reference(
+            cfg, params, "long running request", 48)
+        # ...the late one is correct...
+        assert late == _reference(cfg, params, "late arrival", 8)
+        # ...and it decoded on steps AFTER the batch was already
+        # running (it joined, it did not restart the engine).
+        assert engine.steps > steps_before
+
+
+class TestThroughput:
+    def test_concurrent_beats_sequential_2x(self, small_setup):
+        cfg, params = small_setup
+        n_streams, n_tokens = 8, 24
+        prompts = [f"stream number {i}" for i in range(n_streams)]
+
+        seq = LLMEngine(cfg=cfg, params=params)
+        seq.complete("warmup", n_tokens, 0.0)  # compile outside timing
+
+        def time_seq():
+            t0 = time.perf_counter()
+            for p in prompts:
+                seq.complete(p, n_tokens, 0.0)
+            return time.perf_counter() - t0
+
+        # Best-of-2 on a shared box: one scheduling hiccup must not
+        # decide the comparison.
+        t_seq = min(time_seq(), time_seq())
+
+        eng = ContinuousBatchingEngine(cfg=cfg, params=params,
+                                       max_batch=n_streams)
+        try:
+            eng.complete("warmup", n_tokens, 0.0)  # compile
+            outs = [None] * n_streams
+
+            def run(i):
+                outs[i] = eng.complete(prompts[i], n_tokens, 0.0)
+
+            def time_cb():
+                threads = [threading.Thread(target=run, args=(i,))
+                           for i in range(n_streams)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                return time.perf_counter() - t0
+
+            t_cb = min(time_cb(), time_cb())
+        finally:
+            eng.close()
+        for i, p in enumerate(prompts):
+            assert outs[i] == _reference(cfg, params, p, n_tokens), p
+        speedup = t_seq / t_cb
+        assert speedup >= 2.0, (
+            f"continuous batching {t_cb:.2f}s vs sequential "
+            f"{t_seq:.2f}s -> {speedup:.2f}x (< 2x)")
+
+
+class TestServeIntegration:
+    def test_serve_app_with_continuous_batching(self, ray_start_shared,
+                                                small_setup):
+        import ray_tpu
+        from ray_tpu import serve
+        from ray_tpu.llm import build_llm_app
+
+        cfg, params = small_setup
+        serve.start()
+        app = build_llm_app(cfg=cfg, params=params,
+                            continuous_batching=True, max_batch=4)
+        serve.run(app, name="cbllm", route_prefix="/cbllm")
+        try:
+            h = serve.get_deployment_handle("LLMServer", "cbllm")
+            out = h.remote({"body": {"prompt": "hi", "max_tokens": 8}}
+                           ).result(timeout_s=120)
+            assert out["text"] == _reference(cfg, params, "hi", 8)
+        finally:
+            # Full shutdown (not just delete): later serve tests in the
+            # shared session boot their own proxy + controller.
+            serve.shutdown()
