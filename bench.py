@@ -22,9 +22,15 @@ _T0 = time.monotonic()
 # once the REMAINING time can't cover their own cost, bounding overshoot
 # (the always-on GPT section reserves its compile via the gates below).
 try:
-    _BUDGET_S = float(os.environ.get("RAY_TPU_BENCH_BUDGET_S", "900"))
+    # 1800 s default: the r5 envelope's REAL 1M-queued run (~175-350 s)
+    # and >=32 GiB put+get (~10-16 s/GiB measured at scale on this
+    # box's thin-provisioned page allocator) need the headroom; every
+    # expensive section remains individually budget-gated, so a tighter
+    # external budget still produces a complete (smaller-scale) result
+    # line.
+    _BUDGET_S = float(os.environ.get("RAY_TPU_BENCH_BUDGET_S", "1800"))
 except (TypeError, ValueError):
-    _BUDGET_S = 900.0
+    _BUDGET_S = 1800.0
 
 
 def _budget_left() -> float:
@@ -239,17 +245,29 @@ def bench_envelope(extras):
     release/benchmarks/README.md:27-31 + the committed results in
     release/perf_metrics/scalability/single_node.json — 10k args
     17.28s, 3k returns 5.81s, 10k-object get 23.88s, 1M queued 193s,
-    100 GiB put+get 30.34s on an m4.16xlarge). Run at this box's scale;
-    the queued-task row reports a per-million scaling of the measured
-    100k."""
+    100 GiB put+get 30.34s on an m4.16xlarge). The 1M-queued row is a
+    REAL 1M run when the budget allows (falls back to a labeled
+    extrapolation otherwise), and the big-object row sizes itself to
+    the remaining budget from a measured probe (this box's
+    thin-provisioned page allocator makes fresh-page touch the wall —
+    see docs/TASK_THROUGHPUT_ROOFLINE.md)."""
     if _budget_left() < 180:
         extras["envelope_skipped"] = "bench budget exhausted"
         return
     try:
+        import shutil
+
         import numpy as np
 
         import ray_tpu
-        ray_tpu.init(num_cpus=min(os.cpu_count() or 4, 16))
+        free_shm = shutil.disk_usage("/dev/shm").free
+        store_cap = None
+        if free_shm > 64 << 30:
+            # The arena is a sparse mmap — a high cap costs nothing
+            # until touched, and the big-object row below needs it.
+            store_cap = 56 << 30
+        ray_tpu.init(num_cpus=min(os.cpu_count() or 4, 16),
+                     object_store_memory=store_cap)
 
         @ray_tpu.remote
         def many_args(*args):
@@ -286,18 +304,61 @@ def bench_envelope(extras):
         ray_tpu.get(refs)
         dt = time.perf_counter() - t0
         extras["env_100k_queued_s"] = round(dt, 2)
-        extras["env_queued_scaled_1m_s"] = round(dt * 1e6 / n_q, 1)
         del refs
 
-        import shutil
-        gib = 4 if shutil.disk_usage("/dev/shm").free > 12 << 30 else 1
-        big = np.zeros((gib << 30,), dtype=np.uint8)
-        t0 = time.perf_counter()
-        got = ray_tpu.get(ray_tpu.put(big))
-        assert got.nbytes == big.nbytes
-        extras["env_big_put_get_gib"] = gib
-        extras["env_big_put_get_s"] = round(time.perf_counter() - t0, 2)
-        del big, got
+        # REAL 1M queued (reference: 193 s measured on an m4.16xlarge)
+        # when the remaining budget covers the projected wall +
+        # headroom; superlinear effects (queue memory, GC pressure,
+        # scheduler scans) are exactly what this row exists to catch.
+        projected_1m = dt * 10.0
+        if _budget_left() > projected_1m * 1.6 + 120:
+            t0 = time.perf_counter()
+            refs = [nop.remote() for _ in range(1_000_000)]
+            ray_tpu.get(refs)
+            extras["env_1m_queued_s"] = round(
+                time.perf_counter() - t0, 1)
+            del refs
+        else:
+            extras["env_1m_queued_s"] = round(projected_1m, 1)
+            extras["env_1m_queued_estimated"] = True
+
+        # Big object put+get: run the largest of {48, 32, 16, 8, 4}
+        # GiB that fits the remaining budget and /dev/shm (>=32 GiB is
+        # the envelope target; smaller runs carry the measured-ceiling
+        # label). Cost model is MEASURED AT SCALE on this box, not
+        # probed small: fresh-page touch collapses superlinearly on the
+        # thin-provisioned allocator (2 GiB probes run ~6x faster per
+        # GiB than 16 GiB runs), so a small probe wildly under-gates.
+        # Measured: source alloc+touch ~9 s/GiB, put+get ~7.5 s/GiB at
+        # 16 GiB -> ~17 s/GiB end-to-end wall per candidate.
+        per_gib_wall = 17.0
+        gib = 0
+        for cand in (48, 32, 16, 8, 4):
+            need_bytes = (cand << 30) * 2 + (8 << 30)  # src + store
+            if (shutil.disk_usage("/dev/shm").free > need_bytes
+                    and _budget_left() > cand * per_gib_wall + 90):
+                gib = cand
+                break
+        if gib:
+            big = np.zeros((gib << 30,), dtype=np.uint8)
+            # Source pages materialize OUTSIDE the timed window (the
+            # probe did the same): the row measures the store's
+            # put+get, not numpy allocation.
+            big[::4096] = 1
+            t0 = time.perf_counter()
+            got = ray_tpu.get(ray_tpu.put(big))
+            assert got.nbytes == big.nbytes
+            extras["env_big_put_get_gib"] = gib
+            extras["env_big_put_get_s"] = round(
+                time.perf_counter() - t0, 2)
+            if gib < 32:
+                extras["env_big_put_get_ceiling_note"] = (
+                    "largest size fitting the bench budget on this "
+                    "box's ~17 s/GiB page-allocator wall")
+            del big, got
+        else:
+            extras["env_big_put_get_skipped"] = (
+                "budget/shm too small for any candidate size")
         extras.update({
             "baseline_env_10k_args_s": 17.28,
             "baseline_env_3k_returns_s": 5.81,
@@ -710,6 +771,22 @@ def bench_tpu(extras):
             extras["llama_mfu"] = round(
                 6.0 * l_params * LB * LS / ldt / peak, 4)
             extras["mfu_headline"] = "llama_mfu (6ND analytic)"
+            # XLA-counted cross-check for the FLAGSHIP headline too
+            # (VERDICT r4 next #7): same wall time, XLA's own per-op
+            # FLOP count — a second full compile, so budget-gated.
+            if _budget_left() > 300:
+                try:
+                    lcost = jax.jit(l_step).lower(
+                        l_state, lbatch).compile().cost_analysis()
+                    if isinstance(lcost, list):
+                        lcost = lcost[0]
+                    l_xla = float(lcost.get("flops", 0.0))
+                    if l_xla:
+                        extras["llama_mfu_xla_counted"] = round(
+                            l_xla / ldt / peak, 4)
+                        extras["llama_xla_flops_per_step"] = l_xla
+                except Exception:
+                    pass
         else:
             extras["llama_mfu_skipped"] = "bench budget exhausted"
 
@@ -745,7 +822,6 @@ def bench_tpu(extras):
 def main():
     extras = {}
     sync_rate = bench_core(extras)
-    bench_envelope(extras)
     bench_serve(extras)
     bench_broadcast(extras)
     # The resnet PIPELINE bench must precede the driver's own jax TPU
@@ -754,6 +830,9 @@ def main():
     # bench_tpu are the headline TPU metrics and always run.
     bench_resnet(extras)
     bench_tpu(extras)
+    # Envelope LAST: its 1M-queued and multi-GiB rows consume whatever
+    # budget the headline sections left, scaling themselves to it.
+    bench_envelope(extras)
     extras["bench_wall_s"] = round(time.monotonic() - _T0, 1)
     print(json.dumps({
         "metric": "tasks_per_second_sync",

@@ -206,6 +206,9 @@ class HTTPProxy:
         if target is None:
             return web.json_response({"error": "no route"}, status=404)
         app_name, deployment, matched_prefix = target
+        if request.headers.get("Upgrade", "").lower() == "websocket":
+            return await self._handle_ws(request, app_name, deployment,
+                                         matched_prefix)
         raw = await request.read()
         # Learned per deployment from its first response: ASGI ingress
         # deployments consume the raw bytes + headers and ignore the
@@ -350,6 +353,113 @@ class HTTPProxy:
             pass  # mid-stream failure: truncate, never a second status
         await resp.write_eof()
         return resp
+
+    async def _handle_ws(self, request, app_name: str, deployment: str,
+                         matched_prefix: str):
+        """Websocket pass-through (reference: proxy.py:418 carrying
+        websocket ASGI scopes): pin ONE replica for the connection's
+        lifetime (pick_sticky), open the app's websocket cycle there,
+        pump outbound events from a streaming call, and feed client
+        frames as ordered actor calls. The upgrade is accepted before
+        the app runs; an app that closes without accepting just closes
+        the socket."""
+        import asyncio
+        import uuid
+
+        from aiohttp import WSMsgType, web
+
+        from ..handle import DeploymentResponseGenerator
+
+        loop = asyncio.get_running_loop()
+        handle = self._state.handle_for(deployment, app_name)
+        try:
+            router = await loop.run_in_executor(None, handle._get_router)
+            replica, release = await loop.run_in_executor(
+                None, router.pick_sticky)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=503)
+        conn_id = uuid.uuid4().hex
+        req = {"path": request.path_qs,
+               "raw_path": request.raw_path,
+               "route_prefix": matched_prefix,
+               "headers": [(k, v) for k, v in request.headers.items()]}
+        ws = web.WebSocketResponse()
+        opened = False
+        try:
+            # Inside the release-guard: a client that resets between
+            # the Upgrade request and prepare() must not leak the
+            # sticky in-flight count.
+            await ws.prepare(request)
+            ok = await replica.handle_request.remote(
+                "ws_open", (conn_id, req), {}, "")
+            opened = True
+            if not ok:
+                await ws.close()
+                return ws
+            raw_gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(
+                    "ws_stream", (conn_id,), {}, "")
+            rg = DeploymentResponseGenerator(raw_gen)
+            it = iter(rg)
+
+            def _next():
+                try:
+                    return next(it)
+                except StopIteration:
+                    return _SENTINEL
+
+            async def _pump_out():
+                # try/finally: a replica death mid-stream (next(it)
+                # raises) or a send failure must still close the
+                # client socket — otherwise the client waits forever
+                # for frames that will never come.
+                try:
+                    while True:
+                        item = await loop.run_in_executor(None, _next)
+                        if item is _SENTINEL:
+                            break
+                        kind, data = item
+                        if kind == "accept":
+                            continue  # upgrade already accepted above
+                        if kind == "text":
+                            await ws.send_str(data)
+                        elif kind == "bytes":
+                            await ws.send_bytes(data)
+                        elif kind == "close":
+                            await ws.close(code=data)
+                            return
+                    await ws.close()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    try:
+                        await ws.close(code=1011)
+                    except Exception:
+                        pass
+
+            pump = asyncio.create_task(_pump_out())
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    replica.handle_request.remote(
+                        "ws_push", (conn_id, "text", msg.data), {}, "")
+                elif msg.type == WSMsgType.BINARY:
+                    replica.handle_request.remote(
+                        "ws_push", (conn_id, "bytes", msg.data), {}, "")
+                elif msg.type in (WSMsgType.CLOSE, WSMsgType.CLOSING,
+                                  WSMsgType.ERROR):
+                    break
+            pump.cancel()
+        except Exception:
+            pass  # handshake/transport failure: cleanup below
+        finally:
+            if opened:
+                try:
+                    replica.handle_request.remote(
+                        "ws_close", (conn_id,), {}, "")
+                except Exception:
+                    pass
+            release()
+        return ws
 
     def stop(self):
         self._state.stop()

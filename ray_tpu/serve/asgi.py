@@ -87,6 +87,83 @@ async def _run_asgi_once(app, req: Dict[str, Any]) -> Dict[str, Any]:
             "headers": out["headers"], "body": b"".join(out["chunks"])}
 
 
+class _WsConn:
+    """One live websocket's replica-side state: inbound events ride an
+    asyncio queue consumed by the app's receive() on the actor loop;
+    outbound events ride a THREAD-SAFE queue drained by the sync
+    ws_stream generator on the replica's streaming thread."""
+
+    def __init__(self):
+        import asyncio
+        import queue
+        self.in_q: "asyncio.Queue" = asyncio.Queue()
+        self.out_q: "queue.Queue" = queue.Queue()
+        self.task = None
+
+
+async def _run_asgi_ws(app, conn: _WsConn, req: Dict[str, Any]) -> None:
+    """Drive one websocket connection cycle through an ASGI3 app."""
+    from urllib.parse import unquote
+
+    path_qs = req.get("raw_path") or req.get("path", "/")
+    raw_path, _, query = path_qs.partition("?")
+    path = unquote(raw_path)
+    prefix = req.get("route_prefix") or ""
+    if prefix == "/":
+        prefix = ""
+    sub_path = path[len(prefix):] or "/" if (
+        prefix and path.startswith(prefix)) else path
+    scope = {
+        "type": "websocket",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "scheme": "ws",
+        "root_path": prefix,
+        "path": sub_path,
+        "raw_path": raw_path.encode(),
+        "query_string": query.encode(),
+        "headers": [(k.lower().encode(), v.encode())
+                    for k, v in (req.get("headers") or [])],
+        "subprotocols": [],
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 80),
+    }
+    started = {"connect": False}
+
+    async def receive():
+        if not started["connect"]:
+            started["connect"] = True
+            return {"type": "websocket.connect"}
+        return await conn.in_q.get()
+
+    async def send(message):
+        t = message["type"]
+        if t == "websocket.accept":
+            conn.out_q.put(("accept",
+                            message.get("subprotocol") or ""))
+        elif t == "websocket.send":
+            if message.get("text") is not None:
+                conn.out_q.put(("text", message["text"]))
+            else:
+                conn.out_q.put(("bytes", message.get("bytes", b"")))
+        elif t == "websocket.close":
+            conn.out_q.put(("close", int(message.get("code", 1000))))
+
+    try:
+        await app(scope, receive, send)
+    except BaseException:
+        # App crashed mid-connection: tell the client it was an
+        # ERROR close (1011), not a clean end, and keep the traceback
+        # observable instead of dying silently in a dropped task.
+        import logging
+        logging.getLogger(__name__).exception(
+            "ASGI websocket app raised")
+        conn.out_q.put(("close", 1011))
+    finally:
+        # End the outbound stream so the proxy's pump terminates and
+        # closes the client socket.
+        conn.out_q.put(("__end__", None))
+
+
 def ingress(app) -> Callable[[type], type]:
     """Class decorator: route the deployment's HTTP traffic through an
     ASGI app (reference: serve/api.py:170 ``@serve.ingress``). Methods
@@ -120,6 +197,71 @@ def ingress(app) -> Callable[[type], type]:
                                "raw_body": None, "headers": []}
                 return await _run_asgi_once(
                     type(self).__serve_asgi_app__, request)
+
+            # -- websocket pass-through (reference: the ASGI proxy
+            # carrying websocket scopes, serve/_private/proxy.py:418).
+            # The proxy pins one replica per connection and drives
+            # these: ws_open starts the app cycle on the actor loop,
+            # ws_push feeds client frames, ws_stream streams outbound
+            # events back, ws_close injects the disconnect. --
+            def _ws_conns(self) -> Dict[str, _WsConn]:
+                d = self.__dict__.get("__serve_ws_conns__")
+                if d is None:
+                    d = {}
+                    self.__dict__["__serve_ws_conns__"] = d
+                return d
+
+            async def ws_open(self, conn_id: str, req: dict) -> bool:
+                import asyncio
+                conn = _WsConn()
+                self._ws_conns()[conn_id] = conn
+                conn.task = asyncio.get_running_loop().create_task(
+                    _run_asgi_ws(type(self).__serve_asgi_app__, conn,
+                                 req))
+                return True
+
+            async def ws_push(self, conn_id: str, kind: str,
+                              data) -> bool:
+                conn = self._ws_conns().get(conn_id)
+                if conn is None:
+                    return False
+                msg = {"type": "websocket.receive"}
+                if kind == "text":
+                    msg["text"] = data
+                else:
+                    msg["bytes"] = data
+                await conn.in_q.put(msg)
+                return True
+
+            async def ws_close(self, conn_id: str,
+                               code: int = 1000) -> bool:
+                import asyncio
+                conn = self._ws_conns().pop(conn_id, None)
+                if conn is None:
+                    return False
+                await conn.in_q.put({"type": "websocket.disconnect",
+                                     "code": code})
+                if conn.task is not None:
+                    # Grace for the app to unwind on the disconnect,
+                    # then cancel a straggler so the task can't leak.
+                    task = conn.task
+
+                    async def _reap():
+                        await asyncio.sleep(5.0)
+                        if not task.done():
+                            task.cancel()
+                    asyncio.get_running_loop().create_task(_reap())
+                return True
+
+            def ws_stream(self, conn_id: str):
+                conn = self._ws_conns().get(conn_id)
+                if conn is None:
+                    return
+                while True:
+                    kind, data = conn.out_q.get()
+                    if kind == "__end__":
+                        return
+                    yield (kind, data)
 
         _ASGIIngress.__name__ = cls.__name__
         _ASGIIngress.__qualname__ = getattr(cls, "__qualname__",

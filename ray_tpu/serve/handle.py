@@ -309,9 +309,11 @@ class _Router:
         return self._submit_to(idx, replica, method_name, args, kwargs,
                                model_id)
 
-    def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       timeout_s: float = 30.0, stream: bool = False,
-                       model_id: str = ""):
+    def _pick_and_claim(self, model_id: str, timeout_s: float):
+        """Shared pow-2 selection + in-flight claim (used by
+        assign_request and pick_sticky): ready-wait, sample+probe,
+        stale-candidate revalidation, pick, increment. Returns
+        (idx, replica)."""
         if not self._ready.wait(timeout=timeout_s):
             raise TimeoutError(
                 f"No replicas of '{self._deployment}' became available "
@@ -341,6 +343,31 @@ class _Router:
             replica = self._replicas[idx]
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
             self._note_model_location(model_id, idx)
+        return idx, replica
+
+    def pick_sticky(self, timeout_s: float = 30.0):
+        """Pick ONE replica for a long-lived connection (websockets):
+        returns (replica_actor, release). The connection counts as
+        in-flight load until `release()` so the pow-2 chooser steers
+        short requests away from replicas holding many sockets
+        (reference: the proxy pins a websocket to one replica for the
+        connection's lifetime, serve/_private/proxy.py:418)."""
+        idx, replica = self._pick_and_claim("", timeout_s)
+        released = threading.Event()
+
+        def release():
+            if released.is_set():
+                return
+            released.set()
+            with self._lock:
+                if idx in self._inflight and self._inflight[idx] > 0:
+                    self._inflight[idx] -= 1
+        return replica, release
+
+    def assign_request(self, method_name: str, args: tuple, kwargs: dict,
+                       timeout_s: float = 30.0, stream: bool = False,
+                       model_id: str = ""):
+        idx, replica = self._pick_and_claim(model_id, timeout_s)
         if stream:
             gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(method_name, args, kwargs,
