@@ -604,10 +604,18 @@ class Dataset:
                     num_cpus: Optional[float] = None,
                     num_tpus: Optional[float] = None,
                     num_gpus: Optional[float] = None,
+                    max_concurrency: Optional[int] = None,
                     **_ignored) -> "Dataset":
         """(reference: dataset.py:407 map_batches) — fn may be a function
         (task pool) or a callable class (actor pool; `num_tpus=1` gives
-        each actor a pinned TPU chip for jit inference)."""
+        each actor a pinned TPU chip for jit inference).
+
+        `max_concurrency` (actor classes only) lets N applies interleave
+        on one actor: with jax's async dispatch, batch N+1's host->device
+        upload overlaps batch N's compute + result fetch, which is what
+        saturates a bandwidth-bound device feed (upload becomes the only
+        serial term). Default 1 — two concurrent jax computations on one
+        pinned chip can contend for HBM, so opting in is explicit."""
         fn_kwargs = fn_kwargs or {}
         fn_constructor_kwargs = fn_constructor_kwargs or {}
         is_class = isinstance(fn, type)
@@ -618,6 +626,8 @@ class Dataset:
             opts["num_tpus"] = num_tpus
         if num_gpus is not None and num_gpus > 0 and num_tpus is None:
             opts["num_tpus"] = num_gpus  # gpu-arg compat: treat as chips
+        if max_concurrency is not None and is_class:
+            opts["max_concurrency"] = int(max_concurrency)
 
         if is_class:
             if compute is None:

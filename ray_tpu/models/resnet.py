@@ -190,14 +190,26 @@ def resnet_forward(params: Dict, images, cfg: ResNetConfig):
 def make_predictor(cfg: ResNetConfig, params=None, key=None):
     """Jitted batch-inference callable for Data actor pools
     (reference pattern: map_batches(predictor_cls, num_gpus=1) —
-    data/_internal/execution/operators/actor_pool_map_operator.py:34)."""
+    data/_internal/execution/operators/actor_pool_map_operator.py:34).
+
+    Host inputs are explicitly device_put before the jitted call:
+    letting jit transfer the host array itself serializes through a
+    slow small-chunk path on remote-device backends (measured 1.2 s vs
+    0.05 s for an explicit async put of a 38 MB batch on the tunnel
+    backend), and the explicit put also overlaps with the previous
+    batch's compute under jax's async dispatch."""
     if params is None:
         if key is None:
             key = jax.random.PRNGKey(0)
         params = resnet_init(key, cfg)
 
     @jax.jit
-    def predict(images):
+    def _predict(images):
         return jnp.argmax(resnet_forward(params, images, cfg), axis=-1)
+
+    def predict(images):
+        if not isinstance(images, jax.Array):
+            images = jax.device_put(images)
+        return _predict(images)
 
     return predict

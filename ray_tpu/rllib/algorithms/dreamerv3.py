@@ -15,10 +15,12 @@ discrete actions:
 
 Everything — sequence posterior scan, imagination scan, all three
 optimizers — is one jitted update; on TPU the scans stay on-device and
-the MXU sees batched GRU/MLP matmuls. Omissions vs the full reference
-implementation (documented, not hidden): CNN encoder (vector obs only),
-two-hot critic targets (symlog MSE instead), and the EMA critic
-regularizer.
+the MXU sees batched GRU/MLP/conv matmuls. Image observations (rank-3
+`(H, W, C)` spaces) use a strided-conv encoder + conv-transpose decoder
+in NHWC; the critic trains on two-hot targets over symlog-spaced bins
+with a zero-initialized output layer, as in the paper. Remaining
+omission vs the full reference implementation (documented, not hidden):
+the EMA critic regularizer.
 """
 from typing import Dict
 
@@ -80,24 +82,79 @@ def _gru(p, x, h):
     return (1.0 - z) * n + z * h
 
 
+def _conv_init(key, c_in, c_out, k=4):
+    scale = jnp.sqrt(2.0 / (k * k * c_in))
+    return {"w": jax.random.normal(key, (k, k, c_in, c_out)) * scale,
+            "b": jnp.zeros((c_out,))}
+
+
+def _conv(p, x, stride=2):
+    y = jax.lax.conv_general_dilated(
+        x, p["w"], (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y + p["b"]
+
+
+def _deconv(p, x, stride=2):
+    y = jax.lax.conv_transpose(
+        x, p["w"], (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y + p["b"]
+
+
 class DreamerModule:
     """World model + actor + critic parameter factory and pure apply fns.
 
     Latent: deter `h` (n_deter) + stochastic `z` of `n_cat` categorical
     distributions with `n_classes` classes each (flattened one-hots).
+
+    Observations: vector obs use MLP encoder/decoder; rank-3 `(H, W,
+    C)` obs use a strided-conv encoder and a mirrored conv-transpose
+    decoder (reference: dreamerv3's CNN encoder; NHWC so the convs
+    lower straight onto the MXU). H and W must be divisible by 2 per
+    conv level (levels auto-chosen down to a 4-6 px core).
     """
 
     discrete = True
 
-    def __init__(self, obs_dim: int, num_actions: int, n_deter=256,
-                 n_cat=8, n_classes=8, hidden=256):
-        self.obs_dim = obs_dim
+    def __init__(self, obs_dim, num_actions: int, n_deter=256,
+                 n_cat=8, n_classes=8, hidden=256, cnn_depth=16,
+                 n_bins=41):
+        self.is_image = isinstance(obs_dim, (tuple, list))
+        # FlattenObservations connector opt-out: a flattened image
+        # can't reach the conv stack.
+        self.preserve_obs_shape = self.is_image
+        if self.is_image:
+            self.obs_shape = tuple(int(d) for d in obs_dim)
+            self.obs_dim = int(np.prod(self.obs_shape))
+            # Conv plan: halve the spatial dims per level until the
+            # core is <= 6 px (or parity breaks), doubling depth.
+            h, w, c = self.obs_shape
+            self.conv_shapes = [(h, w, c)]
+            depth = cnn_depth
+            while (h > 6 and w > 6 and h % 2 == 0 and w % 2 == 0
+                   and len(self.conv_shapes) < 5):
+                h, w = h // 2, w // 2
+                self.conv_shapes.append((h, w, depth))
+                depth *= 2
+            if len(self.conv_shapes) < 2:
+                raise ValueError(
+                    f"obs shape {self.obs_shape} too small for the CNN "
+                    "encoder (needs even H/W > 6)")
+            self.enc_flat = int(np.prod(self.conv_shapes[-1]))
+        else:
+            self.obs_shape = (int(obs_dim),)
+            self.obs_dim = int(obs_dim)
         self.num_actions = num_actions
         self.n_deter = n_deter
         self.n_cat = n_cat
         self.n_classes = n_classes
         self.n_stoch = n_cat * n_classes
         self.hidden = hidden
+        # Two-hot critic (paper: return distribution over symlog-spaced
+        # bins; the value is the symexp of the expected bin).
+        self.n_bins = int(n_bins)
+        self.bins_symlog = jnp.linspace(-20.0, 20.0, self.n_bins)
         # Acting state (per env-runner process; reset via the runner's
         # on_episode_end hook).
         self._h = None
@@ -107,20 +164,94 @@ class DreamerModule:
     def init_params(self, seed: int = 0) -> Dict:
         k = jax.random.split(jax.random.PRNGKey(seed), 8)
         feat = self.n_deter + self.n_stoch
+        if self.is_image:
+            n_lv = len(self.conv_shapes) - 1
+            eks = jax.random.split(k[0], n_lv + 1)
+            embed = {"convs": [
+                _conv_init(eks[i], self.conv_shapes[i][2],
+                           self.conv_shapes[i + 1][2])
+                for i in range(n_lv)],
+                "out": _dense(eks[-1], self.enc_flat, self.hidden)}
+            dks = jax.random.split(k[4], n_lv + 1)
+            # Mirror: dense to the conv core, then conv-transpose back
+            # up; the last level outputs the obs channels directly.
+            deconvs = []
+            for i in range(n_lv, 0, -1):
+                c_in = self.conv_shapes[i][2]
+                c_out = self.conv_shapes[i - 1][2]
+                deconvs.append(_conv_init(dks[i], c_in, c_out))
+            decoder = {"in": _dense(dks[0], feat, self.enc_flat),
+                       "deconvs": deconvs}
+        else:
+            embed = _mlp(k[0], [self.obs_dim, self.hidden, self.hidden])
+            decoder = _mlp(k[4], [feat, self.hidden, self.obs_dim])
+        critic = _mlp(jax.random.fold_in(k[7], 1),
+                      [feat, self.hidden, self.n_bins])
+        # Zero-init the critic output layer (paper: the return
+        # distribution starts uniform, stabilizing early training).
+        critic[-1]["w"] = jnp.zeros_like(critic[-1]["w"])
         return {
-            "embed": _mlp(k[0], [self.obs_dim, self.hidden, self.hidden]),
+            "embed": embed,
             "gru": _gru_init(k[1], self.n_stoch + self.num_actions,
                              self.n_deter),
             "prior": _mlp(k[2], [self.n_deter, self.hidden, self.n_stoch]),
             "post": _mlp(k[3], [self.n_deter + self.hidden, self.hidden,
                                 self.n_stoch]),
-            "decoder": _mlp(k[4], [feat, self.hidden, self.obs_dim]),
+            "decoder": decoder,
             "reward": _mlp(k[5], [feat, self.hidden, 1]),
             "cont": _mlp(k[6], [feat, self.hidden, 1]),
             "actor": _mlp(k[7], [feat, self.hidden, self.num_actions]),
-            "critic": _mlp(jax.random.fold_in(k[7], 1),
-                           [feat, self.hidden, 1]),
+            "critic": critic,
         }
+
+    # -- obs codec -------------------------------------------------------
+    def encode(self, params, obs_symlog):
+        """[..., *obs_shape] (already symlog'd) -> [..., hidden] for
+        image obs, [..., obs_dim] embedding for vector obs."""
+        if not self.is_image:
+            return _apply_mlp(params["embed"], obs_symlog)
+        lead = obs_symlog.shape[:-3]
+        x = obs_symlog.reshape((-1,) + self.obs_shape)
+        for cp in params["embed"]["convs"]:
+            x = jax.nn.silu(_conv(cp, x))
+        x = x.reshape(x.shape[0], -1)
+        out = params["embed"]["out"]
+        x = jax.nn.silu(x @ out["w"] + out["b"])
+        return x.reshape(lead + (self.hidden,))
+
+    def decode(self, params, feat):
+        """[..., feat] -> reconstruction in symlog obs space
+        ([..., *obs_shape] for images, [..., obs_dim] for vectors)."""
+        if not self.is_image:
+            return _apply_mlp(params["decoder"], feat)
+        lead = feat.shape[:-1]
+        dp = params["decoder"]
+        x = feat.reshape(-1, feat.shape[-1]) @ dp["in"]["w"] \
+            + dp["in"]["b"]
+        x = x.reshape((-1,) + self.conv_shapes[-1])
+        for i, cp in enumerate(dp["deconvs"]):
+            x = _deconv(cp, x)
+            if i + 1 < len(dp["deconvs"]):
+                x = jax.nn.silu(x)   # last level: raw pixel regression
+        return x.reshape(lead + self.obs_shape)
+
+    # -- two-hot critic ---------------------------------------------------
+    def twohot(self, y_symlog):
+        """Two-hot encoding of symlog targets over the critic bins
+        (paper: the two nearest bins share the mass linearly)."""
+        y = jnp.clip(y_symlog, self.bins_symlog[0], self.bins_symlog[-1])
+        idx = jnp.searchsorted(self.bins_symlog, y, side="right") - 1
+        idx = jnp.clip(idx, 0, self.n_bins - 2)
+        lo, hi = self.bins_symlog[idx], self.bins_symlog[idx + 1]
+        frac = (y - lo) / (hi - lo)
+        oh_lo = jax.nn.one_hot(idx, self.n_bins) * (1.0 - frac[..., None])
+        oh_hi = jax.nn.one_hot(idx + 1, self.n_bins) * frac[..., None]
+        return oh_lo + oh_hi
+
+    def critic_value(self, critic, feats):
+        """Expected return: symexp of the distribution's mean bin."""
+        p = jax.nn.softmax(_apply_mlp(critic, feats), -1)
+        return symexp(p @ self.bins_symlog)
 
     # -- latent machinery ------------------------------------------------
     def _sample_cat(self, logits, key):
@@ -156,17 +287,25 @@ class DreamerModule:
         return jnp.concatenate([h, z], -1)
 
     # -- acting (runner-side, numpy in/out) ------------------------------
+    def _act_step(self, params, obs, h, z, a, key):
+        """One jitted acting step (jit matters for the CNN path: an
+        eager conv stack per env step dominates rollout wall time)."""
+        emb = self.encode(params, symlog(obs))
+        h2, z2, _, _ = self.obs_step(params, h, z, a, emb, key)
+        logits = _apply_mlp(params["actor"], self.feat(h2, z2))
+        return h2, z2, logits
+
     def _act(self, params, obs, rng, greedy: bool):
         B = obs.shape[0]
         if self._h is None or self._h.shape[0] != B:
             self._h = jnp.zeros((B, self.n_deter))
             self._z = jnp.zeros((B, self.n_stoch))
             self._a = jnp.zeros((B, self.num_actions))
+        if getattr(self, "_act_jit", None) is None:
+            self._act_jit = jax.jit(self._act_step)
         key = jax.random.PRNGKey(int(rng.integers(0, 2**31 - 1)))
-        emb = _apply_mlp(params["embed"], symlog(jnp.asarray(obs)))
-        h, z, _, _ = self.obs_step(params, self._h, self._z, self._a,
-                                   emb, key)
-        logits = _apply_mlp(params["actor"], self.feat(h, z))
+        h, z, logits = self._act_jit(params, jnp.asarray(obs),
+                                     self._h, self._z, self._a, key)
         if greedy:
             a = jnp.argmax(logits, -1)
         else:
@@ -211,9 +350,9 @@ def make_dreamer_update(module: DreamerModule, *, horizon=15,
         return jnp.sum(jnp.exp(lp) * (lp - rp), axis=(-1, -2))
 
     def world_model_loss(wm, batch, key):
-        obs = symlog(batch["obs"])                      # [B, L, D]
-        B, L, _ = obs.shape
-        emb = _apply_mlp(wm["embed"], obs)
+        obs = symlog(batch["obs"])      # [B, L, D] or [B, L, H, W, C]
+        B, L = obs.shape[:2]
+        emb = module.encode(wm, obs)
         actions = jax.nn.one_hot(batch["actions"], module.num_actions)
         a_prev = jnp.concatenate(
             [jnp.zeros_like(actions[:, :1]), actions[:, :-1]], 1)
@@ -241,10 +380,13 @@ def make_dreamer_update(module: DreamerModule, *, horizon=15,
         priors = jnp.moveaxis(priors, 0, 1)
         posts = jnp.moveaxis(posts, 0, 1)
         feat = module.feat(hs, zs)
-        recon = _apply_mlp(wm["decoder"], feat)
+        recon = module.decode(wm, feat)
         rew_hat = _apply_mlp(wm["reward"], feat)[..., 0]
         cont_hat = _apply_mlp(wm["cont"], feat)[..., 0]
-        recon_loss = jnp.mean(jnp.sum((recon - obs) ** 2, -1))
+        # Sum the squared error over ALL obs dims (pixels included),
+        # mean over batch and time.
+        err = (recon - obs).reshape(B, L, -1)
+        recon_loss = jnp.mean(jnp.sum(err ** 2, -1))
         reward_loss = jnp.mean(
             (rew_hat - symlog(batch["rewards"])) ** 2)
         cont = 1.0 - batch["terminateds"].astype(jnp.float32)
@@ -296,8 +438,7 @@ def make_dreamer_update(module: DreamerModule, *, horizon=15,
         feats, acts, logits = imagine(wm, actor, hs, zs, key)
         rew = symexp(_apply_mlp(wm["reward"], feats)[..., 0])
         cont = jax.nn.sigmoid(_apply_mlp(wm["cont"], feats)[..., 0])
-        values = symexp(
-            _apply_mlp(critic, feats)[..., 0])          # [H, N]
+        values = module.critic_value(critic, feats)     # [H, N]
         rets = lambda_returns(rew, cont, values)        # [H, N]
         # Return normalizer (paper: scale by the 5th-95th percentile
         # range, EMA'd outside).
@@ -312,11 +453,15 @@ def make_dreamer_update(module: DreamerModule, *, horizon=15,
         weight = jax.lax.stop_gradient(weight)
         actor_loss = -jnp.mean(
             weight * (taken * adv + entropy_coef * entropy))
-        critic_pred = _apply_mlp(critic, jax.lax.stop_gradient(
-            feats))[..., 0]
+        # Two-hot critic loss (paper: cross-entropy against the
+        # two-hot encoding of the symlog return).
+        critic_logits = _apply_mlp(critic,
+                                   jax.lax.stop_gradient(feats))
+        target = jax.lax.stop_gradient(
+            module.twohot(symlog(rets)))                # [H, N, bins]
+        logp_bins = jax.nn.log_softmax(critic_logits, -1)
         critic_loss = jnp.mean(
-            weight * (critic_pred - jax.lax.stop_gradient(
-                symlog(rets))) ** 2)
+            weight * -jnp.sum(target * logp_bins, -1))
         stats = {"actor_loss": actor_loss, "critic_loss": critic_loss,
                  "imag_return": jnp.mean(rets),
                  "actor_entropy": jnp.mean(entropy),
@@ -437,16 +582,16 @@ class DreamerV3(Algorithm):
 
     def _build_module(self, obs_dim, num_actions):
         ex = self.config.extra
-        # Dreamer's hand-rolled MLP world model is vector-obs only
-        # (documented in the module docstring); image obs flatten.
-        if not isinstance(obs_dim, int):
-            obs_dim = int(np.prod(obs_dim))
+        # Vector obs -> MLP codec; (H, W, C) obs -> CNN encoder +
+        # conv-transpose decoder (reference: dreamerv3's CNN path).
         return DreamerModule(
             obs_dim, num_actions,
             n_deter=int(ex.get("n_deter", 256)),
             n_cat=int(ex.get("n_cat", 8)),
             n_classes=int(ex.get("n_classes", 8)),
-            hidden=self.config.hidden[0] if self.config.hidden else 256)
+            hidden=self.config.hidden[0] if self.config.hidden else 256,
+            cnn_depth=int(ex.get("cnn_depth", 16)),
+            n_bins=int(ex.get("critic_bins", 41)))
 
     def _build_learner(self):
         return None  # custom three-optimizer update below

@@ -385,6 +385,7 @@ class HTTPProxy:
                "headers": [(k, v) for k, v in request.headers.items()]}
         ws = web.WebSocketResponse()
         opened = False
+        seq = 0  # before any fallible step: the finally's ws_close uses it
         try:
             # Inside the release-guard: a client that resets between
             # the Upgrade request and prepare() must not leak the
@@ -438,13 +439,23 @@ class HTTPProxy:
                         pass
 
             pump = asyncio.create_task(_pump_out())
+            # Frames carry proxy-assigned sequence numbers: ws_push
+            # tasks execute on the replica's multi-threaded pool, so
+            # arrival order is NOT delivery order — the replica
+            # releases them to the app in seq order, and the final
+            # disconnect takes the last seq so it can't overtake a
+            # frame.
             async for msg in ws:
                 if msg.type == WSMsgType.TEXT:
                     replica.handle_request.remote(
-                        "ws_push", (conn_id, "text", msg.data), {}, "")
+                        "ws_push", (conn_id, seq, "text", msg.data),
+                        {}, "")
+                    seq += 1
                 elif msg.type == WSMsgType.BINARY:
                     replica.handle_request.remote(
-                        "ws_push", (conn_id, "bytes", msg.data), {}, "")
+                        "ws_push", (conn_id, seq, "bytes", msg.data),
+                        {}, "")
+                    seq += 1
                 elif msg.type in (WSMsgType.CLOSE, WSMsgType.CLOSING,
                                   WSMsgType.ERROR):
                     break
@@ -455,7 +466,7 @@ class HTTPProxy:
             if opened:
                 try:
                     replica.handle_request.remote(
-                        "ws_close", (conn_id,), {}, "")
+                        "ws_close", (conn_id, seq), {}, "")
                 except Exception:
                     pass
             release()

@@ -87,11 +87,25 @@ async def _run_asgi_once(app, req: Dict[str, Any]) -> Dict[str, Any]:
             "headers": out["headers"], "body": b"".join(out["chunks"])}
 
 
+import os as _os
+
+# Each live websocket's ws_stream generator occupies one replica
+# executor thread for the connection's lifetime; the replica's pool has
+# max(2*max_ongoing_requests, 16) threads, so the connection count must
+# stay safely below it or queued work (including the disconnects that
+# would free the threads) deadlocks behind the blocked generators.
+_WS_PER_REPLICA = int(_os.environ.get("RAY_TPU_SERVE_WS_PER_REPLICA",
+                                      "8"))
+
+
 class _WsConn:
     """One live websocket's replica-side state: inbound events ride an
     asyncio queue consumed by the app's receive() on the actor loop;
     outbound events ride a THREAD-SAFE queue drained by the sync
-    ws_stream generator on the replica's streaming thread."""
+    ws_stream generator on the replica's streaming thread. Inbound
+    frames carry proxy-assigned sequence numbers and are released to
+    the app in order (ws_push tasks run on a multi-threaded executor,
+    so arrival order alone is not delivery order)."""
 
     def __init__(self):
         import asyncio
@@ -99,6 +113,16 @@ class _WsConn:
         self.in_q: "asyncio.Queue" = asyncio.Queue()
         self.out_q: "queue.Queue" = queue.Queue()
         self.task = None
+        self.next_seq = 0
+        self.pending: dict = {}  # seq -> message (actor-loop only)
+
+    async def deliver(self, seq: int, msg: dict) -> None:
+        """Release messages to the app in sequence order. Runs only on
+        the actor loop, so the reorder state needs no lock."""
+        self.pending[seq] = msg
+        while self.next_seq in self.pending:
+            await self.in_q.put(self.pending.pop(self.next_seq))
+            self.next_seq += 1
 
 
 async def _run_asgi_ws(app, conn: _WsConn, req: Dict[str, Any]) -> None:
@@ -213,14 +237,20 @@ def ingress(app) -> Callable[[type], type]:
 
             async def ws_open(self, conn_id: str, req: dict) -> bool:
                 import asyncio
+                conns = self._ws_conns()
+                if len(conns) >= _WS_PER_REPLICA:
+                    # Capacity, not deadlock: every live socket holds
+                    # one executor thread (see _WS_PER_REPLICA); the
+                    # proxy closes the upgrade when we refuse.
+                    return False
                 conn = _WsConn()
-                self._ws_conns()[conn_id] = conn
+                conns[conn_id] = conn
                 conn.task = asyncio.get_running_loop().create_task(
                     _run_asgi_ws(type(self).__serve_asgi_app__, conn,
                                  req))
                 return True
 
-            async def ws_push(self, conn_id: str, kind: str,
+            async def ws_push(self, conn_id: str, seq: int, kind: str,
                               data) -> bool:
                 conn = self._ws_conns().get(conn_id)
                 if conn is None:
@@ -230,17 +260,19 @@ def ingress(app) -> Callable[[type], type]:
                     msg["text"] = data
                 else:
                     msg["bytes"] = data
-                await conn.in_q.put(msg)
+                await conn.deliver(seq, msg)
                 return True
 
-            async def ws_close(self, conn_id: str,
+            async def ws_close(self, conn_id: str, seq: int,
                                code: int = 1000) -> bool:
                 import asyncio
                 conn = self._ws_conns().pop(conn_id, None)
                 if conn is None:
                     return False
-                await conn.in_q.put({"type": "websocket.disconnect",
-                                     "code": code})
+                # The disconnect takes its place IN SEQUENCE after the
+                # last client frame — it must not overtake one.
+                await conn.deliver(seq, {"type": "websocket.disconnect",
+                                         "code": code})
                 if conn.task is not None:
                     # Grace for the app to unwind on the disconnect,
                     # then cancel a straggler so the task can't leak.
