@@ -821,7 +821,10 @@ def bench_tpu(extras):
             l_state = l_init(jax.random.PRNGKey(1))
             l_params = sum(int(np.prod(x.shape))
                            for x in jax.tree.leaves(l_state["params"]))
-            LB, LS = 4, 2048
+            # B=8 amortizes the non-matmul overhead ~4.5% better than
+            # B=4 (0.561 vs 0.537 MFU measured on v5e; fits HBM with
+            # remat off at this model size).
+            LB, LS = 8, 2048
             ltok = np.random.randint(0, lcfg.vocab_size, (LB, LS),
                                      dtype=np.int32)
             lbatch = (jnp.asarray(ltok),
