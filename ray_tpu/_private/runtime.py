@@ -13,6 +13,7 @@ from __future__ import annotations
 import atexit
 import collections
 import os
+import sys
 import threading
 import time
 import uuid
@@ -147,6 +148,13 @@ class Node:
                  namespace: str = "default", session_dir: Optional[str] = None,
                  object_store_memory: Optional[int] = None):
         self.namespace = namespace
+        # Snappier GIL handoff for the head's recv pump / handler pool /
+        # submitter threads (see worker_proc.worker_main for the
+        # measured rationale). Scoped to the runtime's lifetime only in
+        # spirit — Python has no per-thread interval — but 1 ms costs
+        # pure-Python work little and the head is IO-shaped.
+        sys.setswitchinterval(float(os.environ.get(
+            "RAY_TPU_GIL_SWITCH_INTERVAL", "0.001")))
         self.node_id = NodeID.from_random()
         _gc_stale_sessions()
         session_name = f"session_{int(time.time())}_{uuid.uuid4().hex[:8]}"

@@ -50,6 +50,10 @@ def ray_start_regular():
 
 @pytest.fixture
 def shutdown_only():
-    """Test calls init() itself (reference: conftest.py:449 shutdown_only)."""
+    """Test calls init() itself (reference: conftest.py:449
+    shutdown_only). Shuts down BEFORE as well: a module-scoped session
+    left running by an earlier test file must not leak into a test that
+    needs its own init() (e.g. a custom object_store_memory)."""
+    ray_tpu.shutdown()
     yield
     ray_tpu.shutdown()
