@@ -15,6 +15,7 @@ from .algorithms.impala import APPO, APPOConfig, IMPALA, IMPALAConfig, vtrace
 from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig
 from .algorithms.ppo import PPO, PPOConfig
 from .algorithms.sac import SAC, SACConfig
+from .algorithms.td3 import TD3, TD3Config
 from .core.learner import JaxLearner
 from .core.rl_module import (DQNModule, MultiRLModule, PPOModule, RLModule,
                              SACModule)
@@ -32,6 +33,6 @@ __all__ = ["APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "BC",
            "DQNConfig", "DQNModule", "EnvRunnerGroup", "IMPALA",
            "IMPALAConfig", "JaxLearner", "PPO", "PPOConfig", "PPOModule",
            "MARWIL", "MARWILConfig", "PrioritizedReplayBuffer", "RLModule", "ReplayBuffer", "SAC",
-           "SACConfig", "SACModule",
+           "SACConfig", "SACModule", "TD3", "TD3Config",
            "DatasetReader", "ImportanceSamplingEstimator", "SampleWriter",
            "SingleAgentEnvRunner", "vtrace"]

@@ -99,7 +99,56 @@ def make_sac_update(module: SACModule, gamma: float, lr: float,
     return init_state, update
 
 
-class SAC(Algorithm):
+class OffPolicyTraining:
+    """Shared off-policy driver loop (SAC, TD3): sample -> replay
+    buffer -> warmup-gated jitted updates -> weight sync, with
+    checkpointing that bypasses Algorithm's learner-based paths.
+    Subclasses own their jitted update factory and set _STATE_KEY for
+    checkpoint compatibility."""
+
+    _STATE_KEY = "off_policy_state"
+
+    def _build_learner(self):
+        return None  # the subclass owns its jitted update
+
+    def get_weights(self):
+        return self._state["params"]
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        for frag in self.env_runner_group.sample(
+                cfg.rollout_fragment_length):
+            self.buffer.add_batch(frag)
+            self._total_steps += len(frag["rewards"])
+        stats: Dict = {}
+        warmup = int(cfg.extra.get("learning_starts", 1000))
+        metrics: Dict = {}
+        if len(self.buffer) >= max(warmup, cfg.train_batch_size):
+            for _ in range(int(cfg.extra.get("updates_per_iter", 16))):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                batch = {k: jnp.asarray(v) for k, v in batch.items()
+                         if k in ("obs", "actions", "rewards",
+                                  "terminateds", "next_obs")}
+                self._key, sub = jax.random.split(self._key)
+                self._state, metrics = self._update(
+                    self._state, batch, sub)
+            stats.update({k: float(v) for k, v in metrics.items()})
+        self.env_runner_group.sync_weights(self._state["params"])
+        return stats
+
+    def _get_algo_state(self):
+        return {self._STATE_KEY: jax.tree.map(np.asarray, self._state)}
+
+    def _set_algo_state(self, state):
+        if self._STATE_KEY in state:
+            self._state = jax.tree.map(jnp.asarray,
+                                       state[self._STATE_KEY])
+            self.env_runner_group.sync_weights(self._state["params"])
+
+
+class SAC(OffPolicyTraining, Algorithm):
+    _STATE_KEY = "sac_state"
+
     def __init__(self, config):
         super().__init__(config)
         cfg = config
@@ -117,44 +166,6 @@ class SAC(Algorithm):
     def _build_module(self, obs_dim, num_actions):
         return SACModule(obs_dim, num_actions, self.config.hidden,
                          model_config=self.config.model)
-
-    def _build_learner(self):
-        return None  # SAC owns its jitted update (twin nets + alpha)
-
-    # Algorithm base expects learner-backed weights; override the points
-    # that touch it.
-    def get_weights(self):
-        return self._state["params"]
-
-    def training_step(self) -> Dict:
-        cfg = self.config
-        for frag in self.env_runner_group.sample(
-                cfg.rollout_fragment_length):
-            self.buffer.add_batch(frag)
-            self._total_steps += len(frag["rewards"])
-        stats: Dict = {}
-        warmup = int(cfg.extra.get("learning_starts", 1000))
-        if len(self.buffer) >= max(warmup, cfg.train_batch_size):
-            for _ in range(int(cfg.extra.get("updates_per_iter", 16))):
-                batch = self.buffer.sample(cfg.train_batch_size)
-                batch = {k: jnp.asarray(v) for k, v in batch.items()
-                         if k in ("obs", "actions", "rewards",
-                                  "terminateds", "next_obs")}
-                self._key, sub = jax.random.split(self._key)
-                self._state, metrics = self._update(
-                    self._state, batch, sub)
-            stats.update({k: float(v) for k, v in metrics.items()})
-        self.env_runner_group.sync_weights(self._state["params"])
-        return stats
-
-    # -- checkpointing (Algorithm's learner-based paths bypassed) ----------
-    def _get_algo_state(self):
-        return {"sac_state": jax.tree.map(np.asarray, self._state)}
-
-    def _set_algo_state(self, state):
-        if "sac_state" in state:
-            self._state = jax.tree.map(jnp.asarray, state["sac_state"])
-            self.env_runner_group.sync_weights(self._state["params"])
 
 
 class SACConfig(AlgorithmConfig):

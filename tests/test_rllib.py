@@ -574,3 +574,82 @@ class TestDreamerV3Pixels:
             expect = float(np.clip(v, symexp(-20.0), symexp(20.0)))
             np.testing.assert_allclose(float(back), expect,
                                        rtol=1e-3, atol=1e-3)
+
+
+def test_td3_policy_delay_holds_actor():
+    """The delayed policy update must actually FREEZE the actor (and
+    its optimizer state) on masked steps — zeroed grads alone would let
+    Adam momentum keep moving it."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.td3 import TD3Module, make_td3_update
+
+    m = TD3Module(3, 1, hidden=(8,))
+    init_state, update = make_td3_update(
+        m, gamma=0.99, lr=1e-2, tau=0.05, policy_delay=2,
+        target_noise=0.2, noise_clip=0.5)
+    state = init_state(0)
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": jnp.asarray(rng.normal(size=(16, 3)), jnp.float32),
+        "actions": jnp.asarray(rng.uniform(-1, 1, (16, 1)), jnp.float32),
+        "rewards": jnp.asarray(rng.normal(size=(16,)), jnp.float32),
+        "terminateds": jnp.zeros((16,), jnp.float32),
+        "next_obs": jnp.asarray(rng.normal(size=(16, 3)), jnp.float32),
+    }
+    key = jax.random.PRNGKey(0)
+    state1, _ = update(state, batch, key)      # step 0: actor updates
+    actor1 = jax.tree.map(np.asarray, state1["params"]["actor"])
+    state2, _ = update(state1, batch, key)     # step 1: actor FROZEN
+    actor2 = jax.tree.map(np.asarray, state2["params"]["actor"])
+    for a, b in zip(jax.tree_util.tree_leaves(actor1),
+                    jax.tree_util.tree_leaves(actor2)):
+        np.testing.assert_array_equal(a, b)
+    # ...but the critic moved on the masked step.
+    q1 = jax.tree_util.tree_leaves(state1["params"]["q"])
+    q2 = jax.tree_util.tree_leaves(state2["params"]["q"])
+    assert any(not np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(q1, q2))
+    state3, _ = update(state2, batch, key)     # step 2: actor moves
+    actor3 = jax.tree.map(np.asarray, state3["params"]["actor"])
+    assert any(not np.array_equal(a, b) for a, b in zip(
+        jax.tree_util.tree_leaves(actor1),
+        jax.tree_util.tree_leaves(actor3)))
+
+
+def test_td3_trains_and_checkpoints(ray_start_shared):
+    """TD3: deterministic tanh actor, twin critics, target-policy
+    smoothing, delayed policy/target updates (reference:
+    rllib/algorithms/td3 — the DDPG-family continuous-control
+    algorithm)."""
+    from ray_tpu.rllib import TD3Config
+
+    algo = (TD3Config().environment("Pendulum-v1")
+            .env_runners(num_env_runners=1, rollout_fragment_length=200)
+            .training(train_batch_size=64, learning_starts=200,
+                      updates_per_iter=4, policy_delay=2)
+            .debugging(seed=0).build())
+    try:
+        for _ in range(3):
+            result = algo.train()
+        assert "q_loss" in result and "actor_loss" in result
+        assert np.isfinite(result["episode_return_mean"])
+        # The delayed schedule really ran: step count advanced.
+        assert int(algo._state["step"]) == 12
+        import tempfile
+        d = tempfile.mkdtemp()
+        algo.save(d)
+        w = algo.get_weights()
+        algo2 = (TD3Config().environment("Pendulum-v1")
+                 .debugging(seed=1).build())
+        algo2.restore(d)
+        import jax
+        a = np.concatenate([np.ravel(x) for x in
+                            jax.tree_util.tree_leaves(w)])
+        b = np.concatenate([np.ravel(x) for x in jax.tree_util
+                            .tree_leaves(algo2.get_weights())])
+        np.testing.assert_allclose(a, b)
+        algo2.stop()
+    finally:
+        algo.stop()
