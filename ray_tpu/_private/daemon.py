@@ -216,7 +216,9 @@ class NodeDaemon:
                 self._send(P.NODE_PING, {
                     "ts": time.time(),
                     "store_used": getattr(self.store, "used_bytes", 0),
-                    "num_workers": len(self.pool.workers)})
+                    "num_workers": len(self.pool.workers),
+                    "free_chips": len(getattr(self, "_free_chips", ())),
+                    "pool_workers": getattr(self, "_pool_workers", 0)})
             except Exception:
                 if int(ray_config.head_reconnect_attempts) > 0:
                     # Reconnect mode: the run() loop owns rejoining;
@@ -247,6 +249,14 @@ class NodeDaemon:
             self.shutdown()
 
     def _route(self, msg_type: str, payload: dict):
+        if msg_type == P.NODE_SYNC:
+            # Heartbeat ACK carrying the head's cluster resource view
+            # (reference: ray_syncer bidirectional gossip). Kept fresh
+            # for local observers and workers (GCS_REQUEST op
+            # "local_node_view" serves it without a head round trip).
+            self.cluster_view = {"ts": payload.get("ts"),
+                                 "view": payload.get("view") or []}
+            return
         if msg_type == P.TO_WORKER:
             handle = self.pool.workers.get(WorkerID(payload["worker"]))
             if handle is not None and handle.alive:
@@ -395,6 +405,21 @@ class NodeDaemon:
                            payload: dict):
         if msg_type == P.PULL_OBJECT:
             self._exec.submit(self._handle_pull, handle, payload)
+            return
+        if (msg_type == P.GCS_REQUEST
+                and payload.get("op") == "local_node_view"):
+            # Serve the gossiped cluster view locally: a worker asking
+            # about cluster shape gets the daemon's last NODE_SYNC
+            # snapshot without a head round trip (reference: raylets
+            # answering from their synced resource view).
+            try:
+                handle.send(P.REPLY, {
+                    "req_id": payload.get("req_id"),
+                    "result": {"node_id": self.node_hex,
+                               **(getattr(self, "cluster_view", None)
+                                  or {"ts": None, "view": []})}})
+            except Exception:
+                pass
             return
         if (msg_type == P.GCS_REQUEST
                 and payload.get("op") == "spill_store"):

@@ -344,7 +344,21 @@ class HeadServer:
         elif msg_type == P.NODE_PING:
             handle.last_ping = time.time()
             handle.load = {k: payload.get(k)
-                           for k in ("store_used", "num_workers")}
+                           for k in ("store_used", "num_workers",
+                                     "free_chips", "pool_workers")}
+            # Bidirectional sync (reference: ray_syncer.h — raylets and
+            # the GCS gossip per-node resource views over a stream):
+            # every heartbeat is acknowledged with the scheduler's
+            # current cluster view, so each daemon holds a fresh map of
+            # every node's totals/availability — the data a local
+            # fallback scheduler or observer needs without asking the
+            # head.
+            try:
+                handle.send(P.NODE_SYNC, {
+                    "ts": time.time(),
+                    "view": self._node.node_registry.snapshot()})
+            except Exception:
+                pass  # dying conn: the heartbeat monitor handles it
         elif msg_type == P.NODE_REPLY:
             handle.resolve_reply(payload)
         elif msg_type == P.NODE_REQUEST:

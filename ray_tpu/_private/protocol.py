@@ -58,6 +58,10 @@ PULL_OBJECT = "pull_object"      # worker asks its node to localize an object
 REGISTER_NODE = "register_node"  # daemon -> head: join the cluster
 NODE_ACK = "node_ack"            # head -> daemon: registration accepted
 NODE_PING = "node_ping"          # daemon -> head: heartbeat + load report
+NODE_SYNC = "node_sync"          # head -> daemon: cluster resource view
+                                 # (the ray_syncer gossip made explicit:
+                                 # each heartbeat is ACKed with the
+                                 # head's current per-node view)
 NODE_REQUEST = "node_request"    # daemon -> head: blocking metadata op
 NODE_REPLY = "node_reply"        # either direction: response to a request
 START_WORKER = "start_worker"    # head -> daemon: start a worker process

@@ -1724,6 +1724,12 @@ class Node:
             return self.gcs.spans()
         if op == "object_stats":
             return self.gcs.objects.stats()
+        if op == "local_node_view":
+            # Head-attached workers get the authoritative view directly
+            # (daemon-attached workers are answered by their daemon's
+            # gossiped snapshot — daemon.py NODE_SYNC intercept).
+            return {"node_id": self.node_id.hex(), "ts": time.time(),
+                    "view": self.node_registry.snapshot()}
         if op == "spill_store":
             # A head-attached worker's create() hit a full arena: only
             # the owner may spill other processes' sealed blocks (it

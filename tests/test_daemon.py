@@ -117,6 +117,44 @@ def test_cluster_resources_include_daemon(daemon_cluster):
 
 # -- destructive tests (tear down the shared runtime); keep them LAST ----
 
+def test_node_sync_gossip_reaches_daemons(daemon_cluster):
+    """Bidirectional resource sync (reference: ray_syncer.h — raylets
+    and the GCS gossip per-node resource views): every heartbeat is
+    ACKed with the head's cluster view, and a worker on a daemon node
+    reads that view FROM ITS DAEMON (op local_node_view) without a
+    head round trip."""
+    cluster, a, b = daemon_cluster
+
+    @ray.remote(resources={"A": 1})
+    def view_from_daemon():
+        import time
+
+        from ray_tpu._private import state
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            out = state.current().gcs_request("local_node_view")
+            if out.get("view") and len(out["view"]) >= 3:
+                return out
+            time.sleep(0.5)  # next heartbeat carries the sync
+        return out
+
+    out = ray.get(view_from_daemon.remote(), timeout=90)
+    # Answered by the daemon (its own node id), holding a 3-node view
+    # (head + 2 daemons) with per-node resource totals.
+    assert out["node_id"] == a.node_id, out
+    assert out["ts"] is not None
+    nodes = {n["node_id"]: n for n in out["view"]}
+    assert len(nodes) >= 3, nodes.keys()
+    totals = [n for n in out["view"]
+              if n.get("resources_total", {}).get("A")]
+    assert totals, out["view"]
+
+    # Head-attached callers get the authoritative view directly.
+    from ray_tpu._private import state as _state
+    head_view = _state.current().gcs_request("local_node_view")
+    assert len(head_view["view"]) >= 3
+
+
 def test_daemon_kill_task_retry():
     """Killing a node daemon fails its in-flight tasks through the worker
     death path; retries land on surviving nodes (reference:
