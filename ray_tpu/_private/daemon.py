@@ -153,6 +153,10 @@ class NodeDaemon:
         with self._lock:
             self._pool_workers = 0
             self._free_chips = list(range(int(self.totals.get("TPU", 0))))
+        # The dead head's gossiped cluster view must not be served with
+        # a valid-looking timestamp after the rejoin; the first
+        # post-rejoin NODE_SYNC repopulates it.
+        self.cluster_view = None
 
     def _reconnect_with_backoff(self) -> bool:
         """Try to rejoin the head, doubling backoff per attempt (capped

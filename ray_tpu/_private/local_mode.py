@@ -139,6 +139,10 @@ class LocalRuntime:
             return [{"node_id": "local", "alive": True, "is_head": True,
                      "resources_total": dict(self._resources),
                      "resources_available": dict(self._resources)}]
+        if op == "local_node_view":
+            import time as _t
+            return {"node_id": "local", "ts": _t.time(),
+                    "view": self.gcs_request("list_nodes")}
         # Iterating list-shaped ops must not crash in local mode
         # (timeline/task_events/list_* have nothing to report here).
         if op.startswith("list_") or op in ("task_events", "kv_keys"):

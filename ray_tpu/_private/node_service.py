@@ -352,11 +352,18 @@ class HeadServer:
             # current cluster view, so each daemon holds a fresh map of
             # every node's totals/availability — the data a local
             # fallback scheduler or observer needs without asking the
-            # head.
+            # head. ONE snapshot per second is shared across all N
+            # daemons' acks (the reference sends versioned deltas for
+            # the same reason): rebuilding O(N) rows per ping would be
+            # O(N^2) registry scans per interval.
             try:
-                handle.send(P.NODE_SYNC, {
-                    "ts": time.time(),
-                    "view": self._node.node_registry.snapshot()})
+                now = time.time()
+                cached = getattr(self, "_sync_cache", None)
+                if cached is None or now - cached[0] > 1.0:
+                    cached = (now, self._node.node_registry.snapshot())
+                    self._sync_cache = cached
+                handle.send(P.NODE_SYNC, {"ts": cached[0],
+                                          "view": cached[1]})
             except Exception:
                 pass  # dying conn: the heartbeat monitor handles it
         elif msg_type == P.NODE_REPLY:

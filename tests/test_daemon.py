@@ -115,8 +115,6 @@ def test_cluster_resources_include_daemon(daemon_cluster):
     assert totals.get("A", 0) >= 4 and totals.get("B", 0) >= 4
 
 
-# -- destructive tests (tear down the shared runtime); keep them LAST ----
-
 def test_node_sync_gossip_reaches_daemons(daemon_cluster):
     """Bidirectional resource sync (reference: ray_syncer.h — raylets
     and the GCS gossip per-node resource views): every heartbeat is
@@ -154,6 +152,8 @@ def test_node_sync_gossip_reaches_daemons(daemon_cluster):
     head_view = _state.current().gcs_request("local_node_view")
     assert len(head_view["view"]) >= 3
 
+
+# -- destructive tests (tear down the shared runtime); keep them LAST ----
 
 def test_daemon_kill_task_retry():
     """Killing a node daemon fails its in-flight tasks through the worker
