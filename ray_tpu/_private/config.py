@@ -85,6 +85,26 @@ class RayConfig:
         "head_port": 0,
         # Daemon heartbeat interval (liveness + load report).
         "node_heartbeat_s": 2.0,
+        # Missed heartbeats tolerated before the head declares a node
+        # dead even though its TCP connection looks open (half-open
+        # links, frozen daemons; reference:
+        # gcs_health_check_manager.h failure_threshold). Deliberately
+        # generous (15 x 2s = 30s, the reference's classic node-failure
+        # window): the head process may stall its routing thread for
+        # seconds under GIL-heavy driver work, and a false node death
+        # is far costlier than slow detection. 0 disables.
+        "node_heartbeat_miss_limit": 15.0,
+        # -- pull/reconnect hardening (reference: object manager retries
+        # + gcs_rpc_client.h exponential backoff) ------------------------
+        # Transient-failure retries per object pull (connect resets,
+        # mid-transfer EOF). Exponential backoff with jitter between
+        # attempts; ObjectLostError after exhaustion.
+        "pull_retry_attempts": 4,
+        # Initial retry backoff; doubles per attempt, capped at 2s.
+        "pull_retry_backoff_s": 0.1,
+        # Overall wall-clock budget for one object pull including all
+        # retries; a hung transfer fails typed instead of wedging.
+        "pull_deadline_s": 120.0,
         # Pull admission control: concurrent cross-node object pulls
         # (reference: pull_manager.h in-flight bytes cap).
         "pull_max_concurrent": 4,

@@ -26,6 +26,7 @@ import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from . import fault
 from . import protocol as P
 from .config import ray_config
 from .ids import NodeID, WorkerID
@@ -99,6 +100,8 @@ class NodeDaemon:
         gcs_server_main.cc:47; on reconnection the node re-registers
         like a fresh join — gcs_client_reconnection_test.cc)."""
         from multiprocessing.connection import Client
+        if fault.enabled:
+            fault.fire("daemon.connect", head=str(self._address))
         conn = Client(self._address, family="AF_INET",
                       authkey=self._token)
         register = P.dump_message(P.REGISTER_NODE, {
@@ -162,10 +165,14 @@ class NodeDaemon:
         """Try to rejoin the head, doubling backoff per attempt (capped
         5s). Returns True once reconnected, False when attempts are
         exhausted (or reconnect is disabled)."""
+        import random
         attempts = int(ray_config.head_reconnect_attempts)
         delay = float(ray_config.head_reconnect_backoff_s)
         for i in range(attempts):
-            if self._stopped.wait(min(delay, 5.0)):
+            # Jitter decorrelates a cluster's daemons re-joining a
+            # restarted head (thundering-herd on the accept loop).
+            if self._stopped.wait(min(delay, 5.0)
+                                  * (0.5 + 0.5 * random.random())):
                 return False
             delay *= 2
             try:
@@ -216,6 +223,15 @@ class NodeDaemon:
 
     def _heartbeat_loop(self):
         while not self._stopped.wait(self._heartbeat_interval):
+            if fault.enabled:
+                # raise => exactly one missed ping (the head's
+                # miss-limit path) — NOT the send-failure branch below,
+                # which would end the loop; kill => this daemon dies
+                # mid-job (chaos tier).
+                try:
+                    fault.fire("daemon.heartbeat", node=self.node_hex[:8])
+                except Exception:
+                    continue
             try:
                 self._send(P.NODE_PING, {
                     "ts": time.time(),
@@ -429,18 +445,26 @@ class NodeDaemon:
                 and payload.get("op") == "spill_store"):
             # Full-arena escalation targets the FULL NODE's store — this
             # one, not the head's (relaying would spill the head's arena
-            # while the worker's local arena stays full).
-            try:
-                from .object_store import escalated_spill
-                reclaimed = escalated_spill(
-                    self.store, payload.get("kwargs", {}).get("need", 0))
-            except Exception:
-                reclaimed = 0
-            try:
-                handle.send(P.REPLY, {"req_id": payload.get("req_id"),
-                                      "result": reclaimed})
-            except Exception:
-                pass
+            # while the worker's local arena stays full). Dispatched on
+            # the executor like PULL_OBJECT: a multi-GB spill is seconds
+            # of disk IO, and running it inline would stall this
+            # message-routing thread (heartbeats, task relays) for the
+            # duration.
+            def _spill(payload=payload):
+                try:
+                    from .object_store import escalated_spill
+                    reclaimed = escalated_spill(
+                        self.store,
+                        payload.get("kwargs", {}).get("need", 0))
+                except Exception:
+                    reclaimed = 0
+                try:
+                    handle.send(P.REPLY,
+                                {"req_id": payload.get("req_id"),
+                                 "result": reclaimed})
+                except Exception:
+                    pass
+            self._exec.submit(_spill)
             return
         # Tag node-local shm locations with this node's id so the head
         # registers WHERE the object lives (ownership-based object
@@ -527,10 +551,11 @@ class NodeDaemon:
         if addr is None:
             addr = self._request("transfer_addr", node_hex=source_node_hex)
             if addr is None:
-                from ..exceptions import ObjectLostError
-                raise ObjectLostError(
-                    object_id.hex(),
-                    f"source node {source_node_hex[:8]} is gone")
+                from ..exceptions import NodeDiedError
+                raise NodeDiedError(
+                    source_node_hex,
+                    f"object {object_id.hex()[:8]}: source node "
+                    f"{source_node_hex[:8]} is gone")
             addr = tuple(addr)
             self._transfer_addrs[source_node_hex] = addr
         self.pull_mgr.pull(object_id, addr[0], addr[1])

@@ -1,0 +1,297 @@
+"""Deterministic fault-injection plane for the control and data planes.
+
+Reference parity: the chaos tier of the reference test suite
+(python/ray/tests/test_chaos.py driving the ResourceKillerActor
+hierarchy in _private/test_utils.py:1512 — RayletKiller:1618,
+WorkerKillerActor:1679). Where the reference kills whole components at
+wall-clock intervals, this plane injects faults at *named sites inside*
+the runtime — every place a real cluster fails (connect, recv,
+heartbeat, exec, spill, rendezvous) — on a *seeded, reproducible*
+schedule, so a failure found by a chaos run can be replayed exactly.
+
+Sites threaded through the runtime (see docs/FAULT_INJECTION.md):
+
+    netcomm.connect          opening a transfer connection to a peer
+    netcomm.recv             receiving object bytes from a peer
+    netcomm.serve            serving an object range to a peer
+    daemon.connect           a node daemon (re)joining the head
+    daemon.heartbeat         one daemon heartbeat tick
+    worker.exec              a worker starting one task/actor method
+    worker.start             spawning a worker process
+    gcs.op                   one GCS metadata op (KV / directory)
+    store.pull               one admission-controlled object pull
+    store.spill              one escalated spill pass
+    collective.rendezvous    one collective rendezvous KV round
+
+Usage — the hot-path gate is a single module-attribute truthiness
+check, so disabled runs pay one dict lookup per site:
+
+    from . import fault
+    ...
+    if fault.enabled:
+        fault.fire("netcomm.connect", peer=host)
+
+Configuration comes from ``ray_tpu.init(fault_config={...})`` or the
+``RAY_TPU_FAULT_CONFIG`` env var (JSON, inherited by spawned daemon and
+worker processes so the whole tree injects from one schedule):
+
+    {"seed": 7, "rules": [
+        {"site": "netcomm.connect", "action": "raise", "prob": 0.1,
+         "exc": "ConnectionError"},
+        {"site": "daemon.heartbeat", "action": "kill", "at": [3],
+         "scope": "victim"}]}
+
+Rule fields:
+    site      required; one of the names above.
+    action    "raise" (default) | "delay" | "drop" | "kill".
+              drop == raise ConnectionResetError (a vanished peer);
+              kill == SIGKILL the current process.
+    prob      probability per firing (deterministic per (seed, site,
+              seq) — see below). Mutually composable with `at`.
+    at        explicit firing sequence numbers (per site, 0-based) to
+              hit; takes precedence over prob when present.
+    after     skip the first N firings of the site.
+    max_count number of injections this rule may perform per process
+              (None = unlimited).
+    exc       exception name for raise/drop: ConnectionError,
+              ConnectionResetError, ConnectionRefusedError, OSError,
+              EOFError, TimeoutError.
+    delay_s   sleep length for "delay" (default 0.05).
+    scope     only active in processes whose RAY_TPU_FAULT_SCOPE env
+              var equals this string (how a test designates ONE daemon
+              of a cluster as the kill victim).
+
+Determinism guarantee: the decision for the k-th firing of a site is a
+pure function of (seed, site, k) — ``random.Random(f"{seed}:{site}:{k}")``
+— independent of thread interleaving across sites and of wall clock.
+Two runs with the same seed and the same per-site firing counts inject
+the identical (site, seq, action) sequence; ``injection_log()`` exposes
+it for replay assertions.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# Hot-path gate: module attribute looked up as `fault.enabled` (one
+# dict lookup); everything else only runs when truthy.
+enabled = False
+
+_ENV_VAR = "RAY_TPU_FAULT_CONFIG"
+_SCOPE_VAR = "RAY_TPU_FAULT_SCOPE"
+
+SITES = (
+    "netcomm.connect", "netcomm.recv", "netcomm.serve",
+    "daemon.connect", "daemon.heartbeat",
+    "worker.exec", "worker.start",
+    "gcs.op", "store.pull", "store.spill",
+    "collective.rendezvous",
+)
+
+_EXCEPTIONS = {
+    "ConnectionError": ConnectionError,
+    "ConnectionResetError": ConnectionResetError,
+    "ConnectionRefusedError": ConnectionRefusedError,
+    "OSError": OSError,
+    "EOFError": EOFError,
+    "TimeoutError": TimeoutError,
+}
+
+
+class _Rule:
+    __slots__ = ("site", "action", "prob", "at", "after", "max_count",
+                 "exc", "delay_s", "scope", "hits")
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.site = spec["site"]
+        self.action = spec.get("action", "raise")
+        self.prob = float(spec.get("prob", 1.0))
+        self.at = frozenset(spec["at"]) if spec.get("at") is not None \
+            else None
+        self.after = int(spec.get("after", 0))
+        mc = spec.get("max_count")
+        self.max_count = None if mc is None else int(mc)
+        self.exc = spec.get("exc", "ConnectionError")
+        self.delay_s = float(spec.get("delay_s", 0.05))
+        self.scope = spec.get("scope")
+        self.hits = 0
+
+
+class FaultInjector:
+    """Process-wide registry; one per process, built from one config."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.seed = int(config.get("seed", 0))
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._log: List[Tuple[str, int, str]] = []
+        self.rules: Dict[str, List[_Rule]] = {}
+        my_scope = os.environ.get(_SCOPE_VAR)
+        for spec in config.get("rules", ()):
+            rule = _Rule(spec)
+            # Validate BEFORE the scope filter: a typo'd site in a
+            # scoped rule must fail loudly in EVERY process at configure
+            # time, not only inside the scoped victim (where
+            # configure_from_env would swallow it and the chaos run
+            # would silently inject nothing).
+            if rule.site not in SITES:
+                raise ValueError(
+                    f"unknown fault site {rule.site!r}; known: {SITES}")
+            if rule.scope is not None and rule.scope != my_scope:
+                continue
+            self.rules.setdefault(rule.site, []).append(rule)
+
+    # -- decision ------------------------------------------------------
+    def _draw(self, site: str, seq: int) -> float:
+        # Pure function of (seed, site, seq): thread interleaving across
+        # sites cannot perturb the schedule of any one site.
+        return random.Random(f"{self.seed}:{site}:{seq}").random()
+
+    def fire(self, site: str, **ctx) -> None:
+        rules = self.rules.get(site)
+        if not rules:
+            return
+        with self._lock:
+            seq = self._counts.get(site, 0)
+            self._counts[site] = seq + 1
+            chosen: Optional[_Rule] = None
+            for rule in rules:
+                if seq < rule.after:
+                    continue
+                if rule.max_count is not None and rule.hits >= rule.max_count:
+                    continue
+                if rule.at is not None:
+                    hit = seq in rule.at
+                else:
+                    hit = self._draw(site, seq) < rule.prob
+                if hit:
+                    rule.hits += 1
+                    chosen = rule
+                    break
+            if chosen is None:
+                return
+            self._log.append((site, seq, chosen.action))
+        self._act(chosen, site, seq, ctx)
+
+    def _act(self, rule: _Rule, site: str, seq: int, ctx: dict) -> None:
+        logger.debug("fault injected: %s#%d %s %s", site, seq,
+                     rule.action, ctx)
+        if rule.action == "delay":
+            time.sleep(rule.delay_s)
+            return
+        if rule.action == "kill":
+            import signal
+            logger.warning("fault plane killing pid %d at %s#%d",
+                           os.getpid(), site, seq)
+            os.kill(os.getpid(), signal.SIGKILL)
+            return  # pragma: no cover — unreachable
+        exc_name = "ConnectionResetError" if rule.action == "drop" \
+            else rule.exc
+        exc_cls = _EXCEPTIONS.get(exc_name, ConnectionError)
+        raise exc_cls(f"injected fault at {site}#{seq}")
+
+    def log(self) -> List[Tuple[str, int, str]]:
+        with self._lock:
+            return list(self._log)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+_injector: Optional[FaultInjector] = None
+
+
+def configure(config: Optional[Dict[str, Any]],
+              propagate_env: bool = True) -> None:
+    """Install (or clear, with None) the process-wide fault plane.
+    With ``propagate_env`` the config is mirrored into
+    RAY_TPU_FAULT_CONFIG so daemons and workers spawned from this
+    process inherit the same schedule."""
+    global enabled, _injector
+    if not config:
+        enabled = False
+        _injector = None
+        if propagate_env:
+            os.environ.pop(_ENV_VAR, None)
+        return
+    _injector = FaultInjector(config)
+    # Scope filtering can leave this process with zero active rules —
+    # keep the hot-path flag falsy then.
+    enabled = bool(_injector.rules)
+    if propagate_env:
+        os.environ[_ENV_VAR] = json.dumps(config)
+
+
+def configure_from_env() -> None:
+    """Pick up RAY_TPU_FAULT_CONFIG (spawned daemon/worker processes);
+    no-op when unset or already configured."""
+    global _injector
+    if _injector is not None:
+        return
+    raw = os.environ.get(_ENV_VAR)
+    if not raw:
+        return
+    try:
+        configure(json.loads(raw), propagate_env=False)
+    except Exception:
+        logger.exception("malformed %s ignored", _ENV_VAR)
+
+
+def fire(site: str, **ctx) -> None:
+    """Injection point. Callers gate on ``fault.enabled`` first so the
+    disabled hot path never reaches this call."""
+    inj = _injector
+    if inj is not None:
+        inj.fire(site, **ctx)
+
+
+def injection_log() -> List[Tuple[str, int, str]]:
+    """(site, seq, action) tuples in injection order (this process)."""
+    return _injector.log() if _injector is not None else []
+
+
+def site_counts() -> Dict[str, int]:
+    return _injector.counts() if _injector is not None else {}
+
+
+# ---------------------------------------------------------------------------
+# Hardening helper: exponential backoff with decorrelated jitter + deadline
+# (reference: the retry/backoff pattern of the GCS rpc client,
+# gcs_rpc_client.h exponential backoff).
+# ---------------------------------------------------------------------------
+def backoff_delays(attempts: int, base_s: float, cap_s: float = 5.0,
+                   deadline: Optional[float] = None,
+                   rng: Optional[random.Random] = None):
+    """Yield once per RETRY attempt (attempts-1 times for `attempts`
+    total tries), sleeping an exponentially growing, jittered delay
+    before each. Stops early when `deadline` (time.monotonic()) would
+    pass mid-sleep, so a caller's overall budget bounds the loop."""
+    rng = rng or random
+    delay = base_s
+    for i in range(max(0, attempts - 1)):
+        # full jitter: uniform in (0.5x, 1.0x] of the current window —
+        # concurrent retriers decorrelate instead of thundering back.
+        sleep_s = delay * (0.5 + 0.5 * rng.random())
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            sleep_s = min(sleep_s, remaining)
+        time.sleep(sleep_s)
+        yield i
+        delay = min(delay * 2, cap_s)
+
+
+# Spawned processes pick their schedule up at import time: daemon.py and
+# worker_proc.py import this module during boot, and the env var rides
+# the spawn environment.
+configure_from_env()

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..exceptions import ObjectLostError
+from . import fault
 from . import protocol as P
 from .ids import ActorID, ObjectID, TaskID, WorkerID
 
@@ -331,6 +332,8 @@ class KvStore:
 
     def put(self, key: str, value: bytes, namespace: str = "default",
             overwrite: bool = True) -> bool:
+        if fault.enabled:
+            fault.fire("gcs.op", op="kv_put", key=key)
         with self._lock:
             ns = self._data.setdefault(namespace, {})
             if not overwrite and key in ns:
@@ -339,6 +342,8 @@ class KvStore:
             return True
 
     def get(self, key: str, namespace: str = "default") -> Optional[bytes]:
+        if fault.enabled:
+            fault.fire("gcs.op", op="kv_get", key=key)
         with self._lock:
             return self._data.get(namespace, {}).get(key)
 

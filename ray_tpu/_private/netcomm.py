@@ -33,6 +33,8 @@ import struct
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from . import fault
+
 _MAGIC = b"RTX2"
 _NOT_FOUND = 0xFFFFFFFFFFFFFFFF
 # offset sentinel: "tell me the backing file instead of streaming" —
@@ -235,6 +237,8 @@ class TransferServer:
 
     def _serve_one(self, conn: socket.socket, oid: bytes,
                    offset: int, length: int):
+        if fault.enabled:
+            fault.fire("netcomm.serve", oid=oid.hex()[:8])
         fd = None
         for path in self._paths_for(oid):
             try:
@@ -281,6 +285,8 @@ class _PeerConn:
     """One authenticated, reusable connection to a peer's TransferServer."""
 
     def __init__(self, host: str, port: int, authkey: bytes):
+        if fault.enabled:
+            fault.fire("netcomm.connect", peer=f"{host}:{port}")
         self.sock = socket.create_connection((host, port), timeout=30.0)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         hdr = _recv_exact(self.sock, 36)
@@ -294,6 +300,8 @@ class _PeerConn:
         on the source sends no payload, and treating the sentinel as a
         size would hang the recv loop forever."""
         from ..exceptions import ObjectLostError
+        if fault.enabled:
+            fault.fire("netcomm.recv", oid=oid.hex()[:8])
         self.sock.sendall(oid + struct.pack(">QQ", offset, length))
         (size,) = struct.unpack(">Q", _recv_exact(self.sock, 8))
         if size == _NOT_FOUND:
@@ -368,7 +376,7 @@ class PullManager:
         try:
             with self._sem:
                 if not self._store.contains(object_id):
-                    self._pull_once(object_id, host, port)
+                    self._pull_with_retry(object_id, host, port)
         except BaseException as e:  # noqa: BLE001 — propagate to waiters
             entry[1][0] = e
             raise
@@ -392,6 +400,45 @@ class PullManager:
                 pool.append(conn)
                 return
         conn.close()
+
+    def _pull_with_retry(self, object_id, host: str, port: int) -> None:
+        """One object pull, hardened: transient transport failures
+        (connect resets, mid-transfer EOF, stale pooled connections past
+        the single fast retry) back off exponentially with jitter and
+        retry under an overall deadline; exhaustion surfaces a typed
+        ObjectLostError instead of a hang or a raw socket error
+        (reference: pull_manager.h retries + gcs_rpc_client.h backoff)."""
+        import time as _t
+
+        from ..exceptions import ObjectLostError
+        from .config import ray_config
+        attempts = max(1, int(ray_config.pull_retry_attempts))
+        deadline = _t.monotonic() + float(ray_config.pull_deadline_s)
+        delays = fault.backoff_delays(
+            attempts, float(ray_config.pull_retry_backoff_s), cap_s=2.0,
+            deadline=deadline)
+        tries = 0
+        while True:
+            try:
+                tries += 1
+                if fault.enabled:
+                    fault.fire("store.pull", oid=object_id.hex()[:8])
+                return self._pull_once(object_id, host, port)
+            except ObjectLostError:
+                raise  # definitive: the source says it has no copy
+            except (OSError, EOFError, ConnectionError) as e:
+                if self._store.contains(object_id):
+                    return  # a concurrent path landed the bytes
+                if next(delays, None) is None:
+                    # Report what actually happened: the deadline can
+                    # truncate the backoff before all attempts ran.
+                    raise ObjectLostError(
+                        object_id.hex(),
+                        f"pull of {object_id.hex()[:8]} from "
+                        f"{host}:{port} failed after {tries} of "
+                        f"{attempts} attempts "
+                        f"(deadline {float(ray_config.pull_deadline_s)}"
+                        f"s): {e!r}") from e
 
     def _pull_once(self, object_id, host: str, port: int) -> None:
         import time as _t

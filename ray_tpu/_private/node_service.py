@@ -20,6 +20,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import collections
 
+from . import fault
 from . import protocol as P
 from .ids import WorkerID
 
@@ -82,7 +83,8 @@ class DaemonHandle:
         self.pid = pid
         self.labels = dict(labels or {})
         self.alive = True
-        self.last_ping = time.time()
+        self.last_ping = time.time()        # wall clock: display only
+        self.last_ping_mono = time.monotonic()  # liveness decisions
         self.load: dict = {}
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()
@@ -200,9 +202,65 @@ class HeadServer:
         self.daemons: Dict[str, DaemonHandle] = {}
         self._lock = threading.Lock()
         self._stopped = False
+        self._stop_event = threading.Event()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="head-accept")
         self._accept_thread.start()
+        # Liveness beyond TCP: a frozen daemon (or a half-open link)
+        # keeps its connection "up" while pings stop. Bounded tolerance,
+        # then the node is declared dead (reference:
+        # gcs_health_check_manager.h failure_threshold).
+        self._monitor_thread = threading.Thread(
+            target=self._heartbeat_monitor, daemon=True,
+            name="head-hb-monitor")
+        self._monitor_thread.start()
+
+    def _heartbeat_monitor(self):
+        from .config import ray_config
+        while not self._stop_event.is_set():
+            interval = float(ray_config.node_heartbeat_s)
+            self._stop_event.wait(min(max(interval / 2, 0.05), 1.0))
+            limit = float(ray_config.node_heartbeat_miss_limit)
+            if limit <= 0:
+                continue
+            budget = interval * limit
+            # Monotonic on both sides: an NTP step or a VM suspend must
+            # not make every node's wall-clock ping age jump past the
+            # budget at once (a mass spurious node death).
+            now = time.monotonic()
+            for handle in self.all_daemons():
+                if (not handle.alive
+                        or now - handle.last_ping_mono <= budget):
+                    continue
+                import logging
+                logging.getLogger(__name__).warning(
+                    "node %s missed heartbeats for %.1fs "
+                    "(> %g x %.1fs): declaring it dead",
+                    handle.node_id_hex[:8], now - handle.last_ping_mono,
+                    limit, interval)
+                handle.alive = False
+                # Tear the socket down with shutdown(), not just
+                # close(): the daemon's recv loop is blocked in read on
+                # this fd, and closing an fd another thread is reading
+                # does NOT wake the reader — shutdown() does. The woken
+                # loop then runs the one true death path
+                # (_on_daemon_lost: object loss marking, worker
+                # failure, registry removal).
+                # shutdown() only — no close() here: the woken recv
+                # loop's finally owns closing the Connection. Closing
+                # from this thread would free the fd number while
+                # sender threads may be mid-write on it (fd-reuse
+                # cross-connection corruption).
+                import socket as _socket
+                try:
+                    s = _socket.socket(
+                        fileno=os.dup(handle.conn.fileno()))
+                    try:
+                        s.shutdown(_socket.SHUT_RDWR)
+                    finally:
+                        s.close()
+                except Exception:
+                    pass
 
     def _accept_loop(self):
         while not self._stopped:
@@ -296,9 +354,11 @@ class HeadServer:
         finally:
             if handle is not None:
                 handle.alive = False
+                from ..exceptions import NodeDiedError
                 handle.fail_pending(
-                    ConnectionError(f"node {handle.node_id_hex[:8]} "
-                                    f"disconnected"))
+                    NodeDiedError(handle.node_id_hex,
+                                  f"node {handle.node_id_hex[:8]} "
+                                  f"disconnected"))
                 # A reconnecting daemon re-registers the SAME node id on
                 # a fresh connection; this stale connection's cleanup
                 # must not evict the new registration (reference: GCS
@@ -343,6 +403,7 @@ class HeadServer:
                 self._node._on_worker_death(proxy)
         elif msg_type == P.NODE_PING:
             handle.last_ping = time.time()
+            handle.last_ping_mono = time.monotonic()
             handle.load = {k: payload.get(k)
                            for k in ("store_used", "num_workers",
                                      "free_chips", "pool_workers")}
@@ -377,6 +438,8 @@ class HeadServer:
         try:
             op = payload["op"]
             kwargs = payload.get("kwargs") or {}
+            if fault.enabled:
+                fault.fire("gcs.op", op=op)
             if op == "transfer_addr":
                 result = self._node.transfer_addr_of(kwargs["node_hex"])
             else:
@@ -412,6 +475,7 @@ class HeadServer:
 
     def stop(self):
         self._stopped = True
+        self._stop_event.set()
         try:
             self._sock.close()
         except Exception:

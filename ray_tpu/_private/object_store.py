@@ -23,6 +23,7 @@ import threading
 from typing import Any, Dict, Optional
 
 from ..exceptions import ObjectStoreFullError
+from . import fault
 from . import serialization
 from .ids import ObjectID
 
@@ -44,6 +45,8 @@ def escalated_spill(store, need: int) -> int:
     create()'s request_spill): free ~2x the requested bytes — slack for
     concurrent creates — never the whole arena. One policy shared by
     the head (runtime.py) and per-node daemons (daemon.py)."""
+    if fault.enabled:
+        fault.fire("store.spill", need=int(need))
     used = store.stats().get("used_bytes", 0)
     return store.spill_objects(max(0, used - 2 * int(need)))
 

@@ -106,7 +106,6 @@ def _slim_pickling(cls):
     __reduce__. The restore closure is published as a module global so
     pickle can address it by name."""
     fields = tuple(cls.__dataclass_fields__)
-    n = len(fields)
     field_set = frozenset(fields)
 
     def _restore(vals, extra):
@@ -123,7 +122,13 @@ def _slim_pickling(cls):
 
     def _reduce(self):
         d = self.__dict__
-        if len(d) == n:
+        # Fast path requires KEY IDENTITY, not just matching length: an
+        # instance with one field deleted and one dynamic attr added has
+        # len(d) == len(fields) but tuple(d.values()) would silently
+        # bind the dynamic attr's value to the wrong field on restore.
+        # Instance dicts of normally-constructed dataclasses insert keys
+        # in declaration order, so the tuple compare hits for them.
+        if tuple(d) == fields:
             return (_restore, (tuple(d.values()), None))
         vals = tuple(d.get(f) for f in fields)
         extra = {k: v for k, v in d.items() if k not in field_set}

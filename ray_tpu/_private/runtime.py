@@ -150,9 +150,10 @@ class Node:
         self.namespace = namespace
         # Snappier GIL handoff for the head's recv pump / handler pool /
         # submitter threads (see worker_proc.worker_main for the
-        # measured rationale). Scoped to the runtime's lifetime only in
-        # spirit — Python has no per-thread interval — but 1 ms costs
-        # pure-Python work little and the head is IO-shaped.
+        # measured rationale). Scoped to the runtime's lifetime: the
+        # prior interval is restored in shutdown() so an embedding
+        # process (pytest, a notebook) gets its own setting back.
+        self._prev_switch_interval = sys.getswitchinterval()
         sys.setswitchinterval(float(os.environ.get(
             "RAY_TPU_GIL_SWITCH_INTERVAL", "0.001")))
         self.node_id = NodeID.from_random()
@@ -1030,6 +1031,16 @@ class Node:
         return True
 
     def _resubmit(self, spec: P.TaskSpec):
+        # Idempotence backstop: a failure signal that arrives after the
+        # task's results already landed (the atomic worker.running pop
+        # is the primary arbiter between concurrent failure paths; this
+        # guards the late-signal case it can't see) must not re-run a
+        # completed task — completion already unpinned the args and
+        # registered the returns.
+        entries = [self.gcs.objects.entry(rid) for rid in spec.return_ids]
+        if entries and all(e is not None and e.event.is_set()
+                           and e.state != gcs_mod.LOST for e in entries):
+            return
         for rid in spec.return_ids:
             self.gcs.objects.register_pending(rid, spec)
         # Arguments lost with a dead node must be reconstructed, or the
@@ -1879,6 +1890,10 @@ class Node:
         close_kv = getattr(self.gcs.kv, "close", None)
         if close_kv is not None:
             close_kv()
+        try:
+            sys.setswitchinterval(self._prev_switch_interval)
+        except Exception:
+            pass
         import shutil
         shutil.rmtree(self.session_dir, ignore_errors=True)
         from . import state

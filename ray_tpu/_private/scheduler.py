@@ -25,6 +25,7 @@ import random
 import threading
 from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
+from . import fault
 from . import protocol as P
 from .ids import ObjectID, TaskID, WorkerID
 
@@ -912,6 +913,8 @@ class WorkerPool:
 
         import cloudpickle
 
+        if fault.enabled:
+            fault.fire("worker.start", env_key=env_key)
         worker_id = WorkerID.from_random()
         env = dict(self._base_env)
         # Workers never implicitly grab the TPU: the chip belongs to whoever
@@ -1685,6 +1688,7 @@ class Scheduler:
         # workers for blocked ones — why Ray shows more worker
         # processes than cores).
         blocked_extra = self.pool.count_blocked(env_key)
+        counted = False
         with self._lock:
             # Actor workers are dedicated processes and bypass the pool cap
             # (the reference starts a fresh worker per actor too); only
@@ -1693,40 +1697,49 @@ class Scheduler:
                 if self._started_workers >= self._max_workers + blocked_extra:
                     return None
                 self._started_workers += 1
+                counted = True
         extra_env = {}
         chip_ids: List[int] = []
-        if env_key.startswith("tpu:"):
-            # Pin specific chips before the worker can import jax
-            # (reference: tpu.py set_current_process_visible_accelerator_ids);
-            # specific ids (not just counts) so concurrent TPU workers never
-            # collide on a chip.
-            from .placement import tpu_chips_in_demand
-            nchips = tpu_chips_in_demand(spec.resources) or 1
-            with self._lock:
-                if len(self._free_chips) < nchips:
-                    reclaim = True
-                else:
-                    chip_ids = [self._free_chips.pop()
-                                for _ in range(nchips)]
-                    reclaim = False
-            if reclaim:
-                # Idle TPU workers hold chips; reclaim by retiring them and
-                # retrying once their death returns the chips.
-                self._reclaim_idle_tpu_workers()
-                return None
-            from .resources import tpu_worker_extra_env
-            extra_env = tpu_worker_extra_env(chip_ids)
-        spec_re = getattr(spec, "runtime_env", None)
-        if spec_re:
-            from . import runtime_env as re_mod
-            try:
+        try:
+            if env_key.startswith("tpu:"):
+                # Pin specific chips before the worker can import jax
+                # (reference: tpu.py
+                # set_current_process_visible_accelerator_ids); specific
+                # ids (not just counts) so concurrent TPU workers never
+                # collide on a chip.
+                from .placement import tpu_chips_in_demand
+                nchips = tpu_chips_in_demand(spec.resources) or 1
+                with self._lock:
+                    if len(self._free_chips) < nchips:
+                        reclaim = True
+                    else:
+                        chip_ids = [self._free_chips.pop()
+                                    for _ in range(nchips)]
+                        reclaim = False
+                if reclaim:
+                    # Idle TPU workers hold chips; reclaim by retiring
+                    # them and retrying once their death returns the
+                    # chips.
+                    self._reclaim_idle_tpu_workers()
+                    return None
+                from .resources import tpu_worker_extra_env
+                extra_env = tpu_worker_extra_env(chip_ids)
+            spec_re = getattr(spec, "runtime_env", None)
+            if spec_re:
+                from . import runtime_env as re_mod
                 extra_env.update(re_mod.worker_extra_env(spec_re))
-            except BaseException:
+            handle = self.pool.start_worker(env_key, extra_env)
+        except BaseException:
+            # ANY start failure (env materialization, subprocess spawn,
+            # an injected worker.start fault) must hand back what was
+            # reserved: the cap slot and the pinned chips — or the
+            # phantom count/missing chips starve every later start.
+            with self._lock:
+                if counted:
+                    self._started_workers -= 1
                 if chip_ids:
-                    with self._lock:
-                        self._free_chips.extend(chip_ids)
-                raise
-        handle = self.pool.start_worker(env_key, extra_env)
+                    self._free_chips.extend(chip_ids)
+            raise
         handle.chip_ids = chip_ids
         return handle
 

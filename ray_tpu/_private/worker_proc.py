@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional
 import cloudpickle
 
 from ..exceptions import TaskCancelledError, TaskError
+from . import fault
 from . import protocol as P
 from . import serialization
 from .ids import ActorID, ObjectID, TaskID
@@ -496,6 +497,11 @@ class Worker:
             except Exception:
                 trace_token, exec_span = None, None
         try:
+            if fault.enabled:
+                # raise => the task fails (retry_exceptions path);
+                # kill => this worker dies mid-exec (idempotent
+                # resubmit path on the owner).
+                fault.fire("worker.exec", task=spec.name)
             args = [self.resolve_arg(a) for a in spec.args]
             kwargs = {k: self.resolve_arg(a) for k, a in spec.kwargs.items()}
             if spec.actor_id is not None:

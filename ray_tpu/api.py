@@ -201,22 +201,46 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
          ignore_reinit_error: bool = False, local_mode: bool = False,
          runtime_env: Optional[dict] = None, log_to_driver: bool = True,
          prestart_workers: Optional[int] = None,
+         fault_config: Optional[dict] = None,
          **_compat_kwargs):
-    """Start the runtime (reference: worker.py:1275 ray.init)."""
+    """Start the runtime (reference: worker.py:1275 ray.init).
+
+    ``fault_config`` installs the deterministic fault-injection plane
+    (_private/fault.py; docs/FAULT_INJECTION.md) for this process AND —
+    via the environment — every daemon/worker process spawned under it.
+    """
     with _init_lock:
         if state.is_initialized():
             if ignore_reinit_error:
                 return get_runtime_context()
             raise RuntimeError(
                 "ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        # After the reinit gate: a rejected (or short-circuited)
+        # duplicate init must not flip fault injection on under a live
+        # runtime it didn't create.
+        if fault_config is not None:
+            global _fault_installed_by_init
+            from ._private import fault as fault_mod
+            fault_mod.configure(fault_config)
+            _fault_installed_by_init = True
         if local_mode:
             from ._private.local_mode import LocalRuntime
             state.set_local_runtime(LocalRuntime())
             return get_runtime_context()
         from ._private.runtime import Node
-        node = Node(num_cpus=num_cpus, num_tpus=num_tpus,
-                    resources=resources, namespace=namespace,
-                    object_store_memory=object_store_memory)
+        try:
+            node = Node(num_cpus=num_cpus, num_tpus=num_tpus,
+                        resources=resources, namespace=namespace,
+                        object_store_memory=object_store_memory)
+        except BaseException:
+            # Failed boot: roll the fault plane back (shutdown() never
+            # runs for a runtime that never existed) so a clean retry
+            # init isn't silently chaos-injected.
+            if fault_config is not None and _fault_installed_by_init:
+                from ._private import fault as fault_mod
+                fault_mod.configure(None)
+                _fault_installed_by_init = False
+            raise
         state.set_node(node)
         # Detached actors persisted by a previous head (same durable GCS
         # path) respawn now — after the runtime is current, so creation
@@ -237,12 +261,24 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
         return get_runtime_context()
 
 
+_fault_installed_by_init = False
+
+
 def shutdown():
+    global _fault_installed_by_init
     rt = state.get_node()
     if rt is not None:
         rt.shutdown()
     state.set_node(None)
     state.set_local_runtime(None)
+    # A fault plane installed via init(fault_config=...) is scoped to
+    # that runtime: clear it (and the env propagation) so later inits
+    # in this process start clean. Env-configured processes (spawned
+    # daemons/workers) keep theirs — they never re-init.
+    if _fault_installed_by_init:
+        from ._private import fault as fault_mod
+        fault_mod.configure(None)
+        _fault_installed_by_init = False
 
 
 def is_initialized() -> bool:

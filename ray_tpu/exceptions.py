@@ -94,6 +94,18 @@ class ObjectLostError(RayError):
         )
 
 
+class NodeDiedError(RayError):
+    """A cluster node died (daemon connection lost or heartbeat-miss
+    limit exceeded); operations bound to it fail typed instead of
+    hanging (reference: exceptions.py NodeDiedError)."""
+
+    def __init__(self, node_id_hex: str = "", message: str | None = None):
+        self.node_id_hex = node_id_hex
+        super().__init__(
+            message or f"Node {node_id_hex[:8]} died; operations routed "
+            "to it were aborted.")
+
+
 class ObjectStoreFullError(RayError):
     """The object store is out of memory and eviction could not make room."""
 

@@ -160,8 +160,17 @@ class ContinuousBatchingEngine:
                          self.max_len)
             padded = req.ids + [0] * (bucket - true_len)
             tokens = np.asarray([padded], np.int32)
-            last, self._cache = self._prefill(
-                self.params, tokens, self._cache, i, true_len)
+            try:
+                last, self._cache = self._prefill(
+                    self.params, tokens, self._cache, i, true_len)
+            except BaseException as e:  # noqa: BLE001
+                # The request is already popped from _pending and holds
+                # no slot: _fail_all can't see it, so a prefill failure
+                # (OOM, compile error) must terminate ITS stream here or
+                # submit()'s consumer blocks forever on req.out.
+                req.out.put(e)
+                req.out.put(_SENTINEL)
+                raise
             rng = np.random.default_rng(req.seed)
             slot = _Slot(req, true_len, rng)
             self._slots[i] = slot

@@ -74,10 +74,14 @@ def _free_port() -> int:
 
 
 def _rendezvous(group_name: str, world_size: int, rank: int,
-                timeout_s: float = 60.0) -> str:
+                timeout_s: float = 60.0, gen: str = "") -> str:
     """Agree on a jax.distributed coordinator address via the GCS KV
-    (reference: Rendezvous via named actor, nccl_collective_group.py:29)."""
-    key = f"{group_name}/coordinator"
+    (reference: Rendezvous via named actor, nccl_collective_group.py:29).
+    Keys are namespaced per group GENERATION (`gen`, rotated by rank 0
+    on every creation attempt) so a coordinator address left by a
+    crashed prior group of the same name can never be handed to a new
+    one."""
+    key = f"{group_name}/{gen}/coordinator"
     if rank == 0:
         addr = f"127.0.0.1:{_free_port()}"
         _kv_put(key, addr.encode())
@@ -141,8 +145,9 @@ class XLAGroup(BaseGroup):
         # runtimes. Members publish their state; creation proceeds only
         # when all are fresh (one shared initialize) or all already
         # share ONE runtime (subset group).
-        mode, coordinator = self._pre_rendezvous(group_name, world_size,
-                                                 rank)
+        mode, coordinator, gen = self._pre_rendezvous(group_name,
+                                                      world_size, rank)
+        self._gen = gen
         if mode == "create":
             ensure_distributed(coordinator, world_size, rank,
                                strict=False)
@@ -159,7 +164,8 @@ class XLAGroup(BaseGroup):
         # take different paths and deadlock); uniform KV resolution is
         # one put + world_size gets, trivial next to the jax init.
         member_procs = self._subset_members(group_name, world_size,
-                                            rank, jax.process_index())
+                                            rank, jax.process_index(),
+                                            gen=gen)
         if len(set(member_procs)) != world_size:
             raise RuntimeError(
                 f"Group '{group_name}': member process indices "
@@ -179,40 +185,112 @@ class XLAGroup(BaseGroup):
         self._jit_cache: Dict[Tuple, object] = {}
 
     @staticmethod
+    def _generation(group_name: str, rank: int, deadline: float) -> str:
+        """Resolve this creation attempt's generation nonce. Rank 0
+        ROTATES it (fresh uuid per attempt); other ranks poll for it —
+        and keep following it if it changes (a stale nonce from a
+        crashed prior group is superseded the moment the live rank 0
+        publishes). Namespacing all rendezvous keys under the nonce
+        makes a dead group's leftovers invisible instead of spuriously
+        failing (or worse, spuriously satisfying) a valid new group."""
+        key = f"{group_name}/gen"
+        if rank == 0:
+            import uuid
+            stale = _kv_get(key)
+            gen = uuid.uuid4().hex[:8]
+            _kv_put(key, gen.encode())
+            if stale:
+                # A prior generation that was never destroyed (crashed
+                # group): burn its keys so no member can complete a
+                # rendezvous against the dead state.
+                sg = stale.decode()
+                for k in ([f"{group_name}/{sg}/coordinator"]
+                          + [f"{group_name}/{sg}/{kind}/{r}"
+                             for kind in ("pre", "proc", "confirm")
+                             for r in range(64)]):
+                    try:
+                        _kv().gcs_request("kv_del", key=k,
+                                          namespace=_KV_NS)
+                    except Exception:
+                        break
+            return gen
+        while time.monotonic() < deadline:
+            raw = _kv_get(key)
+            if raw is not None:
+                gen = raw.decode()
+                # Own-key discriminator: under a CURRENT generation this
+                # rank's pre key cannot exist before this rank publishes
+                # it — its presence proves `gen` is a crashed prior
+                # group's leftover pointer read before the live rank 0
+                # rotated it. Keep polling for the rotation instead of
+                # completing a rendezvous against dead state (and
+                # possibly adopting its dead coordinator).
+                if _kv_get(f"{group_name}/{gen}/pre/{rank}") is None:
+                    return gen
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"group '{group_name}' rendezvous: no fresh generation "
+            f"published (rank 0 absent or only stale state found)")
+
+    @staticmethod
     def _pre_rendezvous(group_name: str, world_size: int, rank: int,
                         timeout_s: float = 60.0):
         """Pre-init agreement: every member publishes whether its
         process already runs a jax.distributed runtime (and which, by
-        coordinator tag). Returns ("create", coordinator) when all
-        members are fresh, ("join", tag) when all share one runtime;
-        raises for mixed membership or two different runtimes — those
-        groups cannot work (a process cannot join a runtime late), so
-        fail loudly instead of hanging in initialize/collectives."""
+        coordinator tag). Returns ("create", coordinator, gen) when all
+        members are fresh, ("join", tag, gen) when all share one
+        runtime; raises for mixed membership or two different runtimes —
+        those groups cannot work (a process cannot join a runtime late),
+        so fail loudly instead of hanging in initialize/collectives.
+
+        All keys live under the per-attempt generation nonce (see
+        _generation), so keys from a crashed earlier group of the same
+        name cannot leak into this agreement."""
+        from ...._private import fault
+        if fault.enabled:
+            fault.fire("collective.rendezvous", group=group_name,
+                       rank=rank)
         with _init_lock:
             my_tag = (_distributed_state.get("coordinator")
                       if _distributed_state else "uninit")
-        _kv_put(f"{group_name}/pre/{rank}", str(my_tag).encode())
         deadline = time.monotonic() + timeout_s
+        gen = XLAGroup._generation(group_name, rank, deadline)
+        _kv_put(f"{group_name}/{gen}/pre/{rank}", str(my_tag).encode())
         last_tags = None
         mixed_since = None
+        # Mixed-state grace scales with the caller's budget: members of
+        # big clusters legitimately straggle past a fixed 3s (cold jax
+        # import), and short-timeout callers shouldn't wait 3s to fail.
+        grace = min(max(3.0, 0.25 * timeout_s), 0.5 * timeout_s)
         while time.monotonic() < deadline:
+            if rank != 0:
+                cur = _kv_get(f"{group_name}/gen")
+                cur_gen = cur.decode() if cur else gen
+                if cur_gen != gen:
+                    # Rank 0 started a newer attempt: follow it.
+                    gen = cur_gen
+                    _kv_put(f"{group_name}/{gen}/pre/{rank}",
+                            str(my_tag).encode())
+                    mixed_since = None
             tags = []
             for r in range(world_size):
-                raw = _kv_get(f"{group_name}/pre/{r}")
+                raw = _kv_get(f"{group_name}/{gen}/pre/{r}")
                 tags.append(raw.decode() if raw is not None else None)
             if None not in tags:
                 last_tags = tags
                 if all(t == "uninit" for t in tags):
+                    remaining = max(1.0, deadline - time.monotonic())
                     return ("create",
-                            _rendezvous(group_name, world_size, rank))
+                            _rendezvous(group_name, world_size, rank,
+                                        timeout_s=remaining, gen=gen),
+                            gen)
                 if "uninit" not in tags and len(set(tags)) == 1:
-                    return ("join", tags[0])
-                # Mixed / divergent: could be stale keys from a crashed
-                # earlier group mid-overwrite — give live members a 3s
-                # window to overwrite before declaring it fatal.
+                    return ("join", tags[0], gen)
+                # Mixed / divergent live members: give stragglers the
+                # scaled grace window to overwrite before failing.
                 now = time.monotonic()
                 mixed_since = mixed_since or now
-                if now - mixed_since >= 3.0:
+                if now - mixed_since >= grace:
                     raise RuntimeError(
                         f"Group '{group_name}': members span "
                         f"incompatible runtime states {tags} — every "
@@ -230,18 +308,18 @@ class XLAGroup(BaseGroup):
     @staticmethod
     def _subset_members(group_name: str, world_size: int, rank: int,
                         my_process_index: int,
-                        timeout_s: float = 60.0) -> list:
+                        timeout_s: float = 60.0, gen: str = "") -> list:
         """Publish this member's global process index; wait for all
         world_size members, returning their process indices in
         group-rank order (rank i of the group == i-th entry).
 
-        A confirm round guards against stale keys from a crashed
-        earlier group of the same name: every member publishes the
-        membership signature it resolved and loops until all members
-        published the SAME signature. A stale proc key is overwritten
-        by the live member for that rank, so divergent first reads
-        converge; mismatched signatures force a re-read."""
-        _kv_put(f"{group_name}/proc/{rank}",
+        Keys are namespaced under the group generation (gen) resolved
+        by _pre_rendezvous, so keys from a crashed earlier group of the
+        same name are invisible here. The confirm round still guards
+        against divergent first reads WITHIN a generation: every member
+        publishes the membership signature it resolved and loops until
+        all members published the SAME signature."""
+        _kv_put(f"{group_name}/{gen}/proc/{rank}",
                 str(my_process_index).encode())
         deadline = time.monotonic() + timeout_s
 
@@ -255,13 +333,13 @@ class XLAGroup(BaseGroup):
                 f"group '{group_name}' rendezvous timed out on {key}")
 
         while True:
-            members = [int(_poll(f"{group_name}/proc/{r}").decode())
+            members = [int(_poll(f"{group_name}/{gen}/proc/{r}").decode())
                        for r in range(world_size)]
             sig = ",".join(map(str, members))
-            _kv_put(f"{group_name}/confirm/{rank}", sig.encode())
+            _kv_put(f"{group_name}/{gen}/confirm/{rank}", sig.encode())
             agreed = True
             for r in range(world_size):
-                other = _poll(f"{group_name}/confirm/{r}").decode()
+                other = _poll(f"{group_name}/{gen}/confirm/{r}").decode()
                 if other != sig:
                     agreed = False
                     break
@@ -499,10 +577,18 @@ class XLAGroup(BaseGroup):
     def destroy_group(self):
         self._jit_cache.clear()
         # Drop rendezvous keys so the group name is cleanly reusable.
-        for key in (f"{self._group_name}/proc/{self._rank}",
-                    f"{self._group_name}/confirm/{self._rank}",
-                    f"{self._group_name}/pre/{self._rank}",
-                    f"{self._group_name}/coordinator"):
+        gen = getattr(self, "_gen", "")
+        keys = [f"{self._group_name}/{gen}/proc/{self._rank}",
+                f"{self._group_name}/{gen}/confirm/{self._rank}",
+                f"{self._group_name}/{gen}/pre/{self._rank}",
+                f"{self._group_name}/{gen}/coordinator"]
+        # The {name}/gen pointer is deliberately NOT deleted: a
+        # compare-and-delete over plain KV round-trips can race a
+        # concurrent re-creation's rotation and wipe the NEW pointer
+        # (stranding its late joiners). A stale pointer is harmless —
+        # the next creation's rank 0 rotates it unconditionally, and
+        # readers are guarded by the own-pre-key discriminator.
+        for key in keys:
             try:
                 _kv().gcs_request("kv_del", key=key, namespace=_KV_NS)
             except Exception:

@@ -32,6 +32,15 @@ import pytest  # noqa: E402
 import ray_tpu  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 runs")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection chaos runs (long; also marked "
+        "slow so tier-1's `-m 'not slow'` filter excludes them)")
+
+
 @pytest.fixture(scope="module")
 def ray_start_shared():
     """Module-shared cluster (reference: ray_start_regular_shared)."""

@@ -1,0 +1,566 @@
+"""Deterministic fault-injection plane + failure-path regressions.
+
+Reference strategy: python/ray/tests/test_chaos.py — seeded chaos runs
+over a real multi-node cluster where a mixed workload must complete
+with correct results despite injected connect drops and a node kill
+(RayletKiller semantics, _private/test_utils.py:1618). Here the chaos
+comes from the in-runtime fault plane (_private/fault.py): every
+injection is a pure function of (seed, site, sequence number), so a
+failing run replays exactly.
+"""
+
+import os
+import random
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu._private import fault
+from ray_tpu._private import state as _state
+from ray_tpu._private import protocol as P
+from ray_tpu._private.config import ray_config
+from ray_tpu._private.test_utils import wait_for_condition
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def clean_fault_plane():
+    yield
+    fault.configure(None)
+    ray.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the plane itself
+# ---------------------------------------------------------------------------
+class TestFaultPlane:
+    def test_same_seed_same_schedule(self):
+        """The k-th firing of a site decides identically across runs
+        with one seed, and differently across seeds."""
+        def run(seed):
+            fault.configure(
+                {"seed": seed,
+                 "rules": [{"site": "netcomm.connect", "action": "raise",
+                            "prob": 0.25, "exc": "ConnectionError"}]},
+                propagate_env=False)
+            hits = []
+            for i in range(200):
+                try:
+                    fault.fire("netcomm.connect")
+                except ConnectionError:
+                    hits.append(i)
+            fault.configure(None, propagate_env=False)
+            return hits
+
+        a, b, c = run(11), run(11), run(12)
+        assert a == b
+        assert a != c
+        assert 20 < len(a) < 80  # ~25% of 200
+
+    def test_decisions_are_order_independent_across_sites(self):
+        """Traffic on one site cannot perturb another site's schedule:
+        the decision is a pure function of (seed, site, seq)."""
+        rules = [{"site": "netcomm.connect", "action": "raise",
+                  "prob": 0.3},
+                 {"site": "gcs.op", "action": "raise", "prob": 0.3,
+                  "exc": "TimeoutError"}]
+
+        def run(interleave):
+            fault.configure({"seed": 5, "rules": rules},
+                            propagate_env=False)
+            hits = []
+            for i in range(100):
+                if interleave:
+                    try:
+                        fault.fire("gcs.op")
+                    except TimeoutError:
+                        pass
+                try:
+                    fault.fire("netcomm.connect")
+                except ConnectionError:
+                    hits.append(i)
+            fault.configure(None, propagate_env=False)
+            return hits
+
+        assert run(False) == run(True)
+
+    def test_at_after_and_max_count(self):
+        fault.configure(
+            {"seed": 0,
+             "rules": [{"site": "worker.exec", "action": "raise",
+                        "at": [1, 3, 5], "max_count": 2,
+                        "exc": "OSError"}]},
+            propagate_env=False)
+        outcomes = []
+        for i in range(8):
+            try:
+                fault.fire("worker.exec")
+                outcomes.append("ok")
+            except OSError:
+                outcomes.append("err")
+        fault.configure(None, propagate_env=False)
+        assert outcomes == ["ok", "err", "ok", "err", "ok", "ok", "ok",
+                            "ok"]  # max_count capped the third hit
+
+    def test_scope_filters_rules_per_process(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_FAULT_SCOPE", "not-the-victim")
+        fault.configure(
+            {"seed": 0,
+             "rules": [{"site": "daemon.heartbeat", "action": "raise",
+                        "prob": 1.0, "scope": "victim"}]},
+            propagate_env=False)
+        assert not fault.enabled  # all rules filtered out
+        fault.fire("daemon.heartbeat")  # no-op either way
+        fault.configure(None, propagate_env=False)
+
+    def test_disabled_plane_is_falsy_flag(self):
+        fault.configure(None, propagate_env=False)
+        assert not fault.enabled
+        assert fault.injection_log() == []
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos: the acceptance run
+# ---------------------------------------------------------------------------
+CHAOS_SEED = 1234
+CHAOS_CONFIG = {
+    "seed": CHAOS_SEED,
+    "rules": [
+        # 10% of transfer connections are dropped everywhere — the pull
+        # retry/backoff hardening must absorb them.
+        {"site": "netcomm.connect", "action": "drop", "prob": 0.10},
+        # The very first admission-controlled pull in every process
+        # fails once (guaranteed retry-path coverage regardless of how
+        # the probabilistic drops land).
+        {"site": "store.pull", "action": "raise", "at": [0],
+         "exc": "ConnectionError"},
+        # One daemon (the process spawned with RAY_TPU_FAULT_SCOPE=
+        # chaos-victim) SIGKILLs itself at its 7th heartbeat (~3.5s
+        # after joining at the 0.5s test interval) — a node death in
+        # the middle of the job.
+        {"site": "daemon.heartbeat", "action": "kill", "at": [6],
+         "max_count": 1, "scope": "chaos-victim"},
+    ],
+}
+
+
+def test_seeded_chaos_mixed_workload(clean_fault_plane):
+    """A mixed task/actor/cross-node-pull workload completes with
+    correct results under seeded connect drops and a daemon kill
+    mid-job, and the injections this process performed match the pure
+    seeded schedule exactly."""
+    os.environ["RAY_TPU_NODE_HEARTBEAT_S"] = "0.5"  # daemons inherit
+    try:
+        ray.init(num_cpus=4, fault_config=CHAOS_CONFIG)
+        cluster = Cluster()
+        os.environ["RAY_TPU_FAULT_SCOPE"] = "chaos-victim"
+        try:
+            victim = cluster.add_node(num_cpus=2, daemon=True)
+        finally:
+            del os.environ["RAY_TPU_FAULT_SCOPE"]
+        survivor = cluster.add_node(num_cpus=2, resources={"B": 4},
+                                    daemon=True)
+
+        @ray.remote(max_retries=5)
+        def sq(x):
+            time.sleep(0.25)
+            return x * x
+
+        @ray.remote(resources={"B": 1}, max_retries=5)
+        def produce(n):
+            return np.full(n, 7.0, dtype=np.float32)
+
+        @ray.remote(max_retries=5)
+        def consume(a):
+            return float(a.sum())
+
+        @ray.remote(num_cpus=0.5, resources={"B": 0.5}, max_restarts=3,
+                    max_task_retries=5)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        # Mixed workload, long enough to straddle the victim's death.
+        sq_refs = [sq.remote(i) for i in range(60)]
+        prod_refs = [produce.remote(100_000 + i) for i in range(6)]
+        cons_refs = [consume.remote(r) for r in prod_refs]
+        counter = Counter.remote()
+        count_refs = [counter.add.remote(1) for _ in range(10)]
+
+        assert ray.get(sq_refs, timeout=120) == [i * i for i in range(60)]
+        assert ray.get(cons_refs, timeout=120) == [
+            7.0 * (100_000 + i) for i in range(6)]
+        assert ray.get(count_refs, timeout=120) == list(range(1, 11))
+        # Driver-side reads of the survivor-produced arrays force HEAD
+        # cross-node pulls (consume tasks may have run with locality on
+        # the producing node and never pulled).
+        for i, arr in enumerate(ray.get(prod_refs, timeout=120)):
+            assert arr.shape == (100_000 + i,) and arr[0] == 7.0
+
+        # The victim really died mid-job (SIGKILL from the fault plane)
+        # and the head noticed.
+        wait_for_condition(lambda: victim.proc.poll() is not None,
+                           timeout=30)
+        rt = _state.current()
+        wait_for_condition(
+            lambda: victim.node_id not in rt.head_server.daemons,
+            timeout=30)
+        assert survivor.node_id in rt.head_server.daemons
+
+        # Determinism: every injection this process logged is exactly
+        # what the pure (seed, site, seq) schedule dictates.
+        log = fault.injection_log()
+        for site, seq, action in log:
+            rule = next(r for r in CHAOS_CONFIG["rules"]
+                        if r["site"] == site)
+            if "at" in rule:
+                assert seq in rule["at"]
+            else:
+                draw = random.Random(
+                    f"{CHAOS_SEED}:{site}:{seq}").random()
+                assert draw < rule["prob"]
+        # The guaranteed first-pull injection fired here (the head
+        # pulls survivor-produced arrays to serve ray.get).
+        assert ("store.pull", 0, "raise") in log
+
+        cluster.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_NODE_HEARTBEAT_S", None)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_seeded_chaos_extended(clean_fault_plane):
+    """Longer, harsher seeded run (chaos tier — excluded from tier-1):
+    20% connect drops, heartbeat delays, and a worker kill on top of
+    the daemon kill."""
+    os.environ["RAY_TPU_NODE_HEARTBEAT_S"] = "0.5"
+    try:
+        config = {
+            "seed": 99,
+            "rules": [
+                {"site": "netcomm.connect", "action": "drop",
+                 "prob": 0.2},
+                {"site": "netcomm.recv", "action": "delay",
+                 "prob": 0.05, "delay_s": 0.1},
+                {"site": "daemon.heartbeat", "action": "kill",
+                 "at": [6], "max_count": 1, "scope": "chaos-victim"},
+                {"site": "worker.exec", "action": "kill", "at": [7],
+                 "max_count": 1},
+            ],
+        }
+        ray.init(num_cpus=4, fault_config=config)
+        cluster = Cluster()
+        os.environ["RAY_TPU_FAULT_SCOPE"] = "chaos-victim"
+        try:
+            cluster.add_node(num_cpus=2, daemon=True)
+        finally:
+            del os.environ["RAY_TPU_FAULT_SCOPE"]
+        cluster.add_node(num_cpus=2, resources={"B": 4}, daemon=True)
+
+        @ray.remote(max_retries=10)
+        def work(i):
+            time.sleep(0.05)
+            return np.full(50_000, float(i)).sum()
+
+        refs = [work.remote(i) for i in range(80)]
+        out = ray.get(refs, timeout=300)
+        assert out == [50_000.0 * i for i in range(80)]
+        cluster.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_NODE_HEARTBEAT_S", None)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat-miss tolerance (frozen daemon, TCP still open)
+# ---------------------------------------------------------------------------
+def test_heartbeat_miss_declares_node_dead(clean_fault_plane):
+    """A daemon that stops pinging (SIGSTOP — connection stays open)
+    is declared dead after the bounded miss budget, through the same
+    death path as a connection drop."""
+    os.environ["RAY_TPU_NODE_HEARTBEAT_S"] = "0.3"
+    prev_hb = ray_config.node_heartbeat_s
+    prev_limit = ray_config.node_heartbeat_miss_limit
+    try:
+        ray.init(num_cpus=2)
+        ray_config.set("node_heartbeat_s", 0.3)
+        ray_config.set("node_heartbeat_miss_limit", 3.0)
+        cluster = Cluster()
+        node = cluster.add_node(num_cpus=1, daemon=True)
+        rt = _state.current()
+        assert node.node_id in rt.head_server.daemons
+
+        os.kill(node.proc.pid, signal.SIGSTOP)
+        try:
+            wait_for_condition(
+                lambda: node.node_id not in rt.head_server.daemons,
+                timeout=15)
+        finally:
+            os.kill(node.proc.pid, signal.SIGCONT)
+        cluster.shutdown()
+    finally:
+        ray_config.set("node_heartbeat_s", prev_hb)
+        ray_config.set("node_heartbeat_miss_limit", prev_limit)
+        os.environ.pop("RAY_TPU_NODE_HEARTBEAT_S", None)
+
+
+# ---------------------------------------------------------------------------
+# pull retry/backoff hardening
+# ---------------------------------------------------------------------------
+def test_pull_retries_through_transient_faults(clean_fault_plane):
+    """Three consecutive injected connect failures on the pull path are
+    absorbed by the backoff loop (attempts=4) — the cross-node get
+    still succeeds."""
+    ray.init(num_cpus=2, fault_config={
+        "seed": 0,
+        "rules": [{"site": "store.pull", "action": "raise",
+                   "at": [0, 1, 2], "exc": "ConnectionError"}]})
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1, resources={"B": 2}, daemon=True)
+
+    @ray.remote(resources={"B": 1})
+    def produce():
+        return np.arange(200_000, dtype=np.float32)
+
+    arr = ray.get(produce.remote(), timeout=60)
+    assert float(arr.sum()) == float(
+        np.arange(200_000, dtype=np.float32).sum())
+    assert fault.site_counts().get("store.pull", 0) >= 3
+    cluster.shutdown()
+
+
+def test_worker_start_failure_returns_cap_slot(clean_fault_plane):
+    """Injected worker spawn failures must hand back the pool-cap slot
+    each time — a leaked slot per failure would starve the pool to zero
+    startable workers and wedge the cluster."""
+    ray.init(num_cpus=2, fault_config={
+        "seed": 0,
+        "rules": [{"site": "worker.start", "action": "raise",
+                   "at": [0, 1, 2], "exc": "OSError"}]})
+
+    @ray.remote
+    def f(x):
+        return x + 1
+
+    assert ray.get([f.remote(i) for i in range(8)],
+                   timeout=60) == list(range(1, 9))
+    rt = _state.current()
+    assert fault.site_counts().get("worker.start", 0) >= 3
+    assert rt.scheduler._started_workers <= len(rt.pool.workers)
+
+
+def test_pull_exhaustion_raises_object_lost(clean_fault_plane):
+    """When every retry attempt fails, the pull surfaces a typed
+    ObjectLostError instead of a raw socket error or a hang."""
+    from ray_tpu._private.netcomm import PullManager
+    from ray_tpu.exceptions import ObjectLostError
+    from ray_tpu._private.ids import ObjectID
+
+    fault.configure({"seed": 0, "rules": [
+        {"site": "store.pull", "action": "raise", "prob": 1.0,
+         "exc": "ConnectionError"}]}, propagate_env=False)
+
+    class NeverStore:
+        def contains(self, oid):
+            return False
+
+    prev = (ray_config.pull_retry_attempts, ray_config.pull_retry_backoff_s)
+    ray_config.set("pull_retry_attempts", 3)
+    ray_config.set("pull_retry_backoff_s", 0.01)
+    try:
+        pm = PullManager(NeverStore(), b"k")
+        t0 = time.monotonic()
+        with pytest.raises(ObjectLostError, match="after 3 of 3 attempts"):
+            pm.pull(ObjectID.from_random(), "127.0.0.1", 1)
+        assert time.monotonic() - t0 < 5.0  # deadline-bounded, no hang
+    finally:
+        ray_config.set("pull_retry_attempts", prev[0])
+        ray_config.set("pull_retry_backoff_s", prev[1])
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions
+# ---------------------------------------------------------------------------
+def test_prefill_failure_does_not_wedge_submit():
+    """ADVICE: _admit pops a request before _prefill; a prefill failure
+    must terminate that request's stream (it is in no slot and no
+    queue, so _fail_all can't see it) instead of wedging submit()."""
+    from ray_tpu.llm.continuous import ContinuousBatchingEngine
+    from ray_tpu.models import GPTConfig
+
+    cfg = GPTConfig(vocab_size=272, d_model=32, n_heads=2, n_layers=1,
+                    d_ff=64, max_seq_len=64)
+    eng = ContinuousBatchingEngine(cfg=cfg, max_batch=2, max_len=64)
+
+    def boom(params, tokens, cache, i, true_len):
+        raise RuntimeError("prefill OOM")
+
+    eng._prefill = boom
+    result = {}
+
+    def consume():
+        try:
+            result["out"] = "".join(eng.submit("hello", max_new_tokens=4))
+        except BaseException as e:  # noqa: BLE001
+            result["exc"] = e
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive(), "submit() consumer wedged forever"
+    assert isinstance(result.get("exc"), RuntimeError)
+    # Once the decode loop has fully died, the engine is closed: a late
+    # submit raises instead of parking in a queue nobody drains.
+    eng._thread.join(timeout=10)
+    with pytest.raises(RuntimeError):
+        eng.submit("late", max_new_tokens=2)
+
+
+def test_stale_rendezvous_keys_ignored(clean_fault_plane):
+    """A crashed prior group's rendezvous keys (its generation, its
+    pre/ tags, its coordinator) are invisible to a new group of the
+    same name: rank 0 rotates the generation nonce and both members
+    agree under it — no spurious mixed-state failure, no stale
+    coordinator handed out."""
+    from ray_tpu.util.collective.collective_group import (
+        xla_collective_group as x)
+
+    ray.init(num_cpus=2)
+    # Leftovers of a "crashed" earlier group that got ALL the way
+    # through its rendezvous before dying: a published generation with
+    # a COMPLETE pre/ set (all "uninit" — the most seductive stale
+    # state) and a coordinator nobody serves. Rank 1 deliberately
+    # starts FIRST: before the fix it would read the stale generation,
+    # see the complete all-uninit set, and adopt the dead coordinator.
+    # The own-pre-key discriminator makes it wait for the live rank 0's
+    # rotated generation instead.
+    x._kv_put("g/gen", b"deadbeef")
+    x._kv_put("g/deadbeef/pre/0", b"uninit")
+    x._kv_put("g/deadbeef/pre/1", b"uninit")
+    x._kv_put("g/deadbeef/coordinator", b"10.0.0.9:1")
+
+    results = {}
+
+    def member(rank, delay):
+        time.sleep(delay)
+        try:
+            results[rank] = x.XLAGroup._pre_rendezvous(
+                "g", 2, rank, timeout_s=20.0)
+        except BaseException as e:  # noqa: BLE001
+            results[rank] = e
+
+    threads = [threading.Thread(target=member, args=(0, 0.3)),
+               threading.Thread(target=member, args=(1, 0.0))]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert all(not t.is_alive() for t in threads)
+    for rank in (0, 1):
+        assert not isinstance(results[rank], BaseException), results[rank]
+    mode0, coord0, gen0 = results[0]
+    mode1, coord1, gen1 = results[1]
+    assert (mode0, mode1) == ("create", "create")
+    assert coord0 == coord1 != "10.0.0.9:1"
+    assert gen0 == gen1 != "deadbeef"
+    # Converged well inside the mixed-state grace: the stale keys were
+    # never even considered.
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_rendezvous_grace_scales_with_timeout():
+    from ray_tpu.util.collective.collective_group.xla_collective_group \
+        import XLAGroup  # noqa: F401 — import guards the module parses
+    # grace = min(max(3, t/4), t/2): floor 3s, scaled up for patient
+    # callers, and never more than half the budget for impatient ones.
+    for timeout_s, expect in ((60.0, 15.0), (240.0, 60.0), (2.0, 1.0),
+                              (8.0, 3.0)):
+        grace = min(max(3.0, 0.25 * timeout_s), 0.5 * timeout_s)
+        assert grace == expect
+
+
+def test_slim_pickle_key_identity():
+    """A mutated instance dict with the SAME length as the field tuple
+    but different keys must take the slow path — the old len() gate
+    silently mis-bound values to fields on restore."""
+    import pickle
+
+    a = P.Arg(kind="value", data=b"xy")
+    del a.__dict__["location"]
+    a.__dict__["weird"] = 123  # len(dict) == len(fields) again
+    b = pickle.loads(pickle.dumps(a))
+    assert b.kind == "value" and b.data == b"xy"
+    assert b.location is None          # missing field -> default-None slot
+    assert b.weird == 123              # dynamic attr preserved as extra
+    assert b.nested_ids == []
+    # Normal instances still round-trip on the fast path.
+    c = pickle.loads(pickle.dumps(P.Arg(kind="value", data=b"z")))
+    assert (c.kind, c.data, c.object_id) == ("value", b"z", None)
+
+
+def test_switchinterval_restored(clean_fault_plane):
+    import sys
+    prev = sys.getswitchinterval()
+    ray.init(num_cpus=1)
+    assert sys.getswitchinterval() != prev  # runtime tightened it
+    ray.shutdown()
+    assert sys.getswitchinterval() == prev
+
+
+def test_spill_store_dispatch_offloads_routing_thread():
+    """spill_store escalations run on the daemon executor like
+    PULL_OBJECT: a multi-second spill must not stall the daemon's
+    message-routing thread."""
+    from concurrent.futures import ThreadPoolExecutor
+    from types import SimpleNamespace
+
+    from ray_tpu._private import object_store as os_mod
+    from ray_tpu._private.daemon import NodeDaemon
+
+    replies = []
+
+    class FakeHandle:
+        worker_id = SimpleNamespace(binary=lambda: b"w")
+
+        def send(self, msg_type, payload):
+            replies.append((msg_type, payload))
+
+    orig = os_mod.escalated_spill
+
+    def slow_spill(store, need):
+        time.sleep(1.0)
+        return 4096
+
+    os_mod.escalated_spill = slow_spill
+    try:
+        fake = SimpleNamespace(_exec=ThreadPoolExecutor(max_workers=2),
+                               store=object())
+        t0 = time.monotonic()
+        NodeDaemon._on_worker_message(
+            fake, FakeHandle(), P.GCS_REQUEST,
+            {"op": "spill_store", "req_id": 9, "kwargs": {"need": 1}})
+        routed_in = time.monotonic() - t0
+        assert routed_in < 0.5, (
+            f"routing thread blocked {routed_in:.2f}s on the spill")
+        wait_for_condition(lambda: len(replies) == 1, timeout=10)
+        assert replies[0] == (P.REPLY, {"req_id": 9, "result": 4096})
+    finally:
+        os_mod.escalated_spill = orig
+        fake._exec.shutdown(wait=False)
+
+
+def test_node_died_error_type():
+    from ray_tpu.exceptions import NodeDiedError, RayError
+    e = NodeDiedError("abcd1234ef", "node abcd1234 disconnected")
+    assert isinstance(e, RayError)
+    assert e.node_id_hex == "abcd1234ef"
+    assert "abcd1234" in str(e)
