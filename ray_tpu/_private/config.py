@@ -118,11 +118,18 @@ class RayConfig:
         # slot in place (zero-copy, cross-process pin through the shared
         # arena header) instead of copying. Disable to force copies.
         "same_host_adoption": True,
-        # Same-host copies above this serialize on a host-wide lock:
+        # Same-host copies above this go through the host copy gate:
         # concurrent first-touch of fresh tmpfs pages collapses ~10x on
         # small hosts (kernel shmem allocation contention), so big
-        # copies run one at a time per host. 0 disables.
+        # copies are admission-controlled per host. 0 disables.
         "transfer_serialize_threshold_mb": 64.0,
+        # Width of the host copy gate: how many gated copies may run
+        # concurrently per host (FIFO admission beyond that). 0 = auto,
+        # scaled to the host's cores (1 on 1-2 core boxes — full
+        # serialization, the measured optimum there — up to 4 on big
+        # hosts whose page-allocation bandwidth one copy can't
+        # saturate). netcomm._auto_gate_width.
+        "host_copy_gate_width": 0,
         # Tasks dispatched onto one (head-local) worker under a single
         # resource grant before completions must drain it (reference:
         # max_tasks_in_flight_per_worker=10, direct task transport

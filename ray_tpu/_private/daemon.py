@@ -79,9 +79,23 @@ class NodeDaemon:
         ncpu = int(self.totals.get("CPU", 4))
         self._max_pool_workers = max(ncpu, 4)
         self._lock = threading.Lock()
-        self._send_lock = threading.Lock()
+        # Head-link writer (per connection; swapped on reconnect under
+        # _conn_lock): sends from any daemon thread enqueue and
+        # coalesce into one vectored write per wakeup.
+        self._conn_lock = threading.Lock()
+        self._writer = None
+        # Recv-side: the head's writer may coalesce several messages
+        # into one frame; the ACK read in _connect_head consumes one
+        # FRAME, so trailing messages park here for run().
+        self._recv_backlog: List[Tuple[str, dict]] = []
         self._exec = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="daemon")
+        # Ordered routing executor: the recv loop hands worker-plane
+        # messages (task relays, kills, releases) here instead of
+        # running them inline — a wedged worker pipe can't stall frame
+        # parsing, while per-worker FIFO order holds.
+        from .netcomm import SerialExecutor
+        self._route_exec = SerialExecutor(name="daemon-route")
         self._req_lock = threading.Lock()
         self._req_counter = 0
         self._pending: Dict[int, Future] = {}
@@ -100,10 +114,16 @@ class NodeDaemon:
         gcs_server_main.cc:47; on reconnection the node re-registers
         like a fresh join — gcs_client_reconnection_test.cc)."""
         from multiprocessing.connection import Client
+
+        from .netcomm import ConnectionWriter, tune_control_socket
         if fault.enabled:
             fault.fire("daemon.connect", head=str(self._address))
         conn = Client(self._address, family="AF_INET",
                       authkey=self._token)
+        # Socket audit parity with the head side: NODELAY + KEEPALIVE
+        # on every control connection (the daemon side used to set
+        # neither).
+        tune_control_socket(conn.fileno())
         register = P.dump_message(P.REGISTER_NODE, {
             "node_id_hex": self.node_hex,
             "resources": dict(self.totals),
@@ -112,13 +132,25 @@ class NodeDaemon:
             "pid": os.getpid(),
             "labels": self.labels,
         })
-        # Swap + register under the send lock: the long-lived heartbeat
-        # thread must not slip a NODE_PING onto the fresh connection
-        # before REGISTER_NODE (the head closes conns whose first
-        # message isn't a registration, node_service.py _serve_daemon).
-        with self._send_lock:
+        # REGISTER_NODE is enqueued on the FRESH writer before it is
+        # published: the long-lived heartbeat thread can only reach the
+        # new connection through self._writer, and the writer queue is
+        # FIFO — so no NODE_PING can precede the registration (the head
+        # closes conns whose first message isn't a registration).
+        writer = ConnectionWriter(conn, name="head-writer")
+        writer.send_frame(register)
+        with self._conn_lock:
+            old = self._writer
             self.conn = conn
-            conn.send_bytes(register)
+            self._writer = writer
+            # Frames already parsed off a DEAD connection must not be
+            # served as this connection's NODE_ACK.
+            self._recv_backlog.clear()
+        if old is not None:
+            try:
+                old.close(flush_timeout=0.0)
+            except Exception:
+                pass
         msg_type, payload = self._recv()
         if msg_type != P.NODE_ACK:
             raise RuntimeError(f"head rejected registration: {msg_type}")
@@ -190,33 +222,41 @@ class NodeDaemon:
 
     # -- head link -----------------------------------------------------
     def _send(self, msg_type: str, payload: dict):
-        data = P.dump_message(msg_type, payload)
-        with self._send_lock:
-            self.conn.send_bytes(data)
+        with self._conn_lock:
+            w = self._writer
+        w.send_message(msg_type, payload)
 
     def _recv(self):
-        import cloudpickle
-        return cloudpickle.loads(self.conn.recv_bytes())
+        """Read the next message, buffering coalesced frame-mates."""
+        if self._recv_backlog:
+            return self._recv_backlog.pop(0)
+        msgs = P.load_messages(self.conn.recv_bytes())
+        self._recv_backlog.extend(msgs[1:])
+        return msgs[0]
 
     def _request(self, op: str, **kwargs):
-        """Blocking metadata request to the head (NODE_REQUEST)."""
+        """Blocking metadata request to the head (NODE_REQUEST). The
+        req lock scopes reply-slot bookkeeping only; the send is a
+        lock-free writer enqueue."""
+        fut: Future = Future()
         with self._req_lock:
             self._req_counter += 1
             req_id = self._req_counter
-        fut: Future = Future()
-        self._pending[req_id] = fut
+            self._pending[req_id] = fut
         try:
             self._send(P.NODE_REQUEST, {"req_id": req_id, "op": op,
                                         "kwargs": kwargs})
             result = fut.result(timeout=60.0)
         finally:
-            self._pending.pop(req_id, None)
+            with self._req_lock:
+                self._pending.pop(req_id, None)
         if isinstance(result, dict) and result.get("__error__") is not None:
             raise result["__error__"]
         return result
 
     def _fail_pending(self, error: BaseException):
-        pending, self._pending = dict(self._pending), {}
+        with self._req_lock:
+            pending, self._pending = dict(self._pending), {}
         for fut in pending.values():
             if not fut.done():
                 fut.set_result({"__error__": error})
@@ -277,16 +317,53 @@ class NodeDaemon:
             self.cluster_view = {"ts": payload.get("ts"),
                                  "view": payload.get("view") or []}
             return
+        if msg_type in (P.TO_WORKER, P.KILL_WORKER, P.WORKER_DEDICATED,
+                        P.RELEASE_OBJECTS):
+            # Worker-plane routing runs on the ordered executor, not
+            # this recv thread: relays to a wedged worker pipe must not
+            # stall heartbeat replies or SHUTDOWN handling, and the
+            # executor's FIFO preserves the relay/kill order per
+            # worker.
+            self._route_exec.submit(self._route_worker_plane, msg_type,
+                                    payload)
+        elif msg_type == P.START_WORKER:
+            self._exec.submit(self._start_worker, payload)
+        elif msg_type == P.LOCALIZE_OBJECT:
+            # Head-orchestrated push (broadcast tree): pull the object
+            # from the named source node and ack (reference:
+            # push_manager.h — the sender drives chunked pushes; here
+            # the head drives pulls, which reuses the authenticated
+            # transfer path).
+            def _localize(payload=payload):
+                req_id = payload["req_id"]
+                try:
+                    self.localize(payload["object_id"], payload["node"])
+                    result = True
+                except BaseException as e:  # noqa: BLE001
+                    result = {"__error__": e}
+                try:
+                    self._send(P.NODE_REPLY,
+                               {"req_id": req_id, "result": result})
+                except Exception:
+                    pass
+            self._exec.submit(_localize)
+        elif msg_type == P.NODE_REPLY:
+            with self._req_lock:
+                fut = self._pending.pop(payload["req_id"], None)
+            if fut is not None:
+                fut.set_result(payload.get("result"))
+        elif msg_type == P.SHUTDOWN_NODE:
+            self._stopped.set()
+
+    def _route_worker_plane(self, msg_type: str, payload: dict):
+        """Ordered worker-plane handlers (see _route)."""
         if msg_type == P.TO_WORKER:
             handle = self.pool.workers.get(WorkerID(payload["worker"]))
             if handle is not None and handle.alive:
                 try:
-                    with handle.send_lock:
-                        handle.conn.send_bytes(payload["frame"])
+                    handle.send_raw(payload["frame"])
                 except Exception:
                     pass
-        elif msg_type == P.START_WORKER:
-            self._exec.submit(self._start_worker, payload)
         elif msg_type == P.KILL_WORKER:
             handle = self.pool.workers.get(WorkerID(payload["worker"]))
             if handle is not None:
@@ -311,35 +388,9 @@ class NodeDaemon:
             for handle in list(self.pool.workers.values()):
                 if handle.alive:
                     try:
-                        with handle.send_lock:
-                            handle.conn.send_bytes(frame)
+                        handle.send_raw(frame)
                     except Exception:
                         pass
-        elif msg_type == P.LOCALIZE_OBJECT:
-            # Head-orchestrated push (broadcast tree): pull the object
-            # from the named source node and ack (reference:
-            # push_manager.h — the sender drives chunked pushes; here
-            # the head drives pulls, which reuses the authenticated
-            # transfer path).
-            def _localize(payload=payload):
-                req_id = payload["req_id"]
-                try:
-                    self.localize(payload["object_id"], payload["node"])
-                    result = True
-                except BaseException as e:  # noqa: BLE001
-                    result = {"__error__": e}
-                try:
-                    self._send(P.NODE_REPLY,
-                               {"req_id": req_id, "result": result})
-                except Exception:
-                    pass
-            self._exec.submit(_localize)
-        elif msg_type == P.NODE_REPLY:
-            fut = self._pending.pop(payload["req_id"], None)
-            if fut is not None:
-                fut.set_result(payload.get("result"))
-        elif msg_type == P.SHUTDOWN_NODE:
-            self._stopped.set()
 
     # -- worker lifecycle ----------------------------------------------
     def _start_worker(self, payload: dict):
@@ -580,6 +631,15 @@ class NodeDaemon:
             pass
         import shutil
         shutil.rmtree(self.session_dir, ignore_errors=True)
+        try:
+            self._route_exec.close(drain_timeout=0.5)
+        except Exception:
+            pass
+        try:
+            if self._writer is not None:
+                self._writer.close(flush_timeout=0.5)
+        except Exception:
+            pass
         try:
             self.conn.close()
         except Exception:

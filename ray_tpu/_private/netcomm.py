@@ -25,15 +25,17 @@ NOT_FOUND), then the requested byte range.
 
 from __future__ import annotations
 
+import collections
 import fcntl
 import hmac
 import os
 import socket
 import struct
 import threading
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from . import fault
+from . import protocol as P
 
 _MAGIC = b"RTX2"
 _NOT_FOUND = 0xFFFFFFFFFFFFFFFF
@@ -48,74 +50,266 @@ KIND_FILE = 0   # plain file: the peer copies it
 KIND_ARENA = 1  # native arena slot: the peer may adopt it in place
 
 
-class _HostCopyGate:
-    """Serializes big same-host copies across all ray_tpu processes OF
-    THIS UID on this host (flock on a per-uid path). Concurrent
-    first-touch of fresh tmpfs pages collapses superlinearly on small
-    hosts — measured 1.48 GB/s solo vs 0.04 GB/s each at 4-way on a
-    1-core box (kernel shmem allocation contention) — so copies above
-    the threshold take turns. Scoping the lock per-uid is a deliberate
-    security tradeoff: a fixed world-writable path would let any local
-    user symlink-squat it (and have a root daemon chmod an arbitrary
-    file) or hold LOCK_EX to add latency to every large copy; the cost
-    is that copies from DIFFERENT uids on one host no longer take turns.
-    Best-effort by design: if the lock file is unusable (permissions,
-    hostile pre-creation) or held for longer than _MAX_WAIT_S, the copy
-    runs ungated — a slow transfer beats a wedged one."""
+def _auto_gate_width() -> int:
+    """Concurrency width for big same-host copies, scaled to the host's
+    parallel page-allocation bandwidth. Concurrent first-touch of fresh
+    tmpfs pages collapses superlinearly on SMALL hosts — measured
+    1.48 GB/s solo vs 0.04 GB/s each at 4-way on a 1-core box (kernel
+    shmem allocation contention) — so tiny hosts serialize fully, while
+    many-core hosts overlap several copies (one copy cannot saturate
+    their zeroing + memcpy bandwidth)."""
+    ncpu = os.cpu_count() or 1
+    if ncpu <= 2:
+        return 1
+    if ncpu <= 4:
+        return 2
+    return 4
 
-    # Per-uid path: processes of other users neither share nor can
-    # pre-create our gate, so a hostile symlink/flock-squat at a fixed
-    # world-writable name is off the table.
-    _PATH = "/tmp/.ray_tpu_host_copy.%d.lock" % os.getuid()
+
+class HostCopyGate:
+    """Bandwidth-aware admission gate for big same-host copies across
+    all ray_tpu processes OF THIS UID on this host: up to `width`
+    copies run concurrently; excess waiters queue with FIFO tickets
+    (in-process exact, cross-process best-effort via per-uid flock slot
+    files). The old exclusive gate was correct for one client and
+    catastrophic for many — multi-client puts/pulls serialized on a
+    single host-wide lock; this gate lets them overlap up to what the
+    host's page-allocation bandwidth supports (_auto_gate_width,
+    overridable via ray_config.host_copy_gate_width).
+
+    Scoping the slot files per-uid is a deliberate security tradeoff: a
+    fixed world-writable path would let any local user symlink-squat it
+    (and have a root daemon chmod an arbitrary file) or hold LOCK_EX to
+    add latency to every large copy; the cost is that copies from
+    DIFFERENT uids on one host no longer share the gate. Best-effort by
+    design: if the slot files are unusable (permissions, hostile
+    pre-creation) or all held for longer than max_wait_s, the copy runs
+    ungated — a slow transfer beats a wedged one."""
+
+    _PATH_FMT = "/tmp/.ray_tpu_host_copy.%d.%d.lock"
     _MAX_WAIT_S = 120.0
 
-    def __init__(self):
-        self._fd: Optional[int] = None
-        self._tlock = threading.Lock()  # one flock holder per process
-        self._flocked = False           # guarded by _tlock
+    def __init__(self, width: Optional[int] = None,
+                 max_wait_s: Optional[float] = None):
+        self._width_override = width
+        self._max_wait_s = (self._MAX_WAIT_S if max_wait_s is None
+                            else float(max_wait_s))
+        self._lock = threading.Lock()
+        self._queue: collections.deque = collections.deque()  # FIFO tickets
+        self._holders = 0
+        self._tls = threading.local()  # per-thread (admitted, slot)
+
+    @property
+    def width(self) -> int:
+        if self._width_override is not None:
+            return max(1, int(self._width_override))
+        try:
+            from .config import ray_config
+            cfg = int(ray_config.host_copy_gate_width)
+        except Exception:
+            cfg = 0
+        return max(1, cfg) if cfg > 0 else _auto_gate_width()
+
+    # -- in-process FIFO admission -------------------------------------
+    def _pump_locked(self, width: int):
+        while self._queue and self._holders < width:
+            ticket = self._queue.popleft()
+            self._holders += 1
+            ticket.set()
+
+    def acquire(self) -> bool:
+        """Admit this thread (True) or time out to an ungated copy
+        (False). FIFO: earlier waiters are always admitted first."""
+        width = self.width
+        ticket = threading.Event()
+        with self._lock:
+            self._queue.append(ticket)
+            self._pump_locked(width)
+        if not ticket.wait(self._max_wait_s):
+            admitted_late = False
+            with self._lock:
+                try:
+                    self._queue.remove(ticket)
+                except ValueError:
+                    # Raced an admission: we hold a slot after all.
+                    admitted_late = True
+            if not admitted_late:
+                self._tls.state = (False, None)
+                return False
+        self._tls.state = (True, self._grab_slot(width))
+        return True
+
+    def release(self):
+        admitted, slot = getattr(self._tls, "state", (False, None))
+        self._tls.state = (False, None)
+        if slot is not None:
+            try:
+                os.close(slot)  # per-acquisition fd: close drops the flock
+            except OSError:
+                pass
+        if admitted:
+            with self._lock:
+                self._holders -= 1
+                self._pump_locked(self.width)
+
+    # -- cross-process width (best-effort flock slots) -----------------
+    def _try_slot(self, i: int) -> Tuple[Optional[int], bool]:
+        """Try to lock slot `i` on a FRESH fd. flock(2) is per open
+        file description: a cached shared fd would make a second
+        in-process holder's flock a silent no-op AND let the first
+        release() drop a slot another thread still holds — so every
+        acquisition gets its own fd (closed on release). Returns
+        (locked fd or None, slot file usable)."""
+        import stat as _stat
+        try:
+            fd = os.open(
+                self._PATH_FMT % (os.getuid(), i),
+                os.O_CREAT | os.O_RDWR | os.O_NOFOLLOW | os.O_CLOEXEC,
+                0o600)
+        except OSError:
+            return None, False
+        try:
+            st = os.fstat(fd)
+            if not _stat.S_ISREG(st.st_mode) or st.st_uid != os.getuid():
+                os.close(fd)
+                return None, False  # hostile pre-creation: unusable
+        except OSError:
+            os.close(fd)
+            return None, False
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return fd, True
+        except OSError:
+            os.close(fd)
+            return None, True  # usable but held elsewhere
+
+    def _grab_slot(self, width: int) -> Optional[int]:
+        """Hold one of `width` host-wide flock slots so the TOTAL
+        concurrency across processes honors the width. In-process
+        admission already ran; an unobtainable slot (other processes
+        saturating the host) falls back to running with in-process
+        admission only after max_wait_s; unusable lock files (hostile
+        pre-creation, bad perms) skip the wait entirely."""
+        import time as _t
+        deadline = _t.monotonic() + self._max_wait_s
+        delay = 0.001  # 1 ms first retry; a typical gated copy is tens
+        while True:    # of ms, so coarse polling would waste real time
+            any_usable = False
+            for i in range(width):
+                fd, usable = self._try_slot(i)
+                if fd is not None:
+                    return fd
+                any_usable = any_usable or usable
+            if not any_usable or _t.monotonic() >= deadline:
+                return None  # ungated beats wedged
+            _t.sleep(delay)
+            delay = min(delay * 2, 0.01)
 
     def __enter__(self):
-        import stat as _stat
-        import time as _t
-        self._tlock.acquire()
-        self._flocked = False
-        try:
-            if self._fd is None:
-                fd = os.open(
-                    self._PATH,
-                    os.O_CREAT | os.O_RDWR | os.O_NOFOLLOW | os.O_CLOEXEC,
-                    0o600,
-                )
-                st = os.fstat(fd)
-                if not _stat.S_ISREG(st.st_mode) or st.st_uid != os.getuid():
-                    os.close(fd)
-                    raise OSError("host-copy gate path is not ours")
-                self._fd = fd
-            deadline = _t.monotonic() + self._MAX_WAIT_S
-            while True:
-                try:
-                    fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-                    self._flocked = True
-                    break
-                except OSError:
-                    if _t.monotonic() >= deadline:
-                        break  # run ungated rather than wedge
-                    _t.sleep(0.05)
-        except OSError:
-            pass  # gate unavailable: copy ungated
+        self.acquire()
         return self
 
     def __exit__(self, *exc):
-        try:
-            if self._flocked and self._fd is not None:
-                self._flocked = False
-                fcntl.flock(self._fd, fcntl.LOCK_UN)
-        finally:
-            self._tlock.release()
+        self.release()
         return False
 
 
-_host_copy_gate = _HostCopyGate()
+# Backwards-compatible name: object_store and the pull paths gate
+# through this instance.
+_host_copy_gate = HostCopyGate()
+
+
+class SerialExecutor:
+    """One worker thread draining a FIFO queue: the recv-loop offload
+    seam. Recv threads hand decoded messages here instead of routing
+    inline, so a slow handler (or a handler blocking on a dead worker
+    pipe) can't stall frame parsing or death detection — while
+    per-connection message ORDER is preserved exactly (the property a
+    thread pool would break: WORKER_DIED must not overtake the worker's
+    final TASK_DONE).
+
+    Bounded: past `max_queued` items submit() blocks the caller — the
+    graceful degradation back to the old inline-routing throttling,
+    instead of unbounded memory growth when handlers fall behind a
+    message flood."""
+
+    _MAX_QUEUED = 10_000
+
+    def __init__(self, name: str = "serial-exec",
+                 max_queued: Optional[int] = None):
+        self._q: collections.deque = collections.deque()
+        self._max_queued = (self._MAX_QUEUED if max_queued is None
+                            else int(max_queued))
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._busy = False  # a handler is executing right now
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def submit(self, fn, *args):
+        with self._cond:
+            while len(self._q) >= self._max_queued and not self._stopped:
+                self._cond.wait(timeout=1.0)
+            if self._stopped:
+                return
+            self._q.append((fn, args))
+            self._cond.notify()
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                self._busy = False
+                self._cond.notify_all()  # close()/submit() waiters
+                while not self._q and not self._stopped:
+                    self._cond.wait()
+                if not self._q and self._stopped:
+                    return
+                fn, args = self._q.popleft()
+                self._busy = True
+            try:
+                fn(*args)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def close(self, drain_timeout: float = 2.0):
+        """Stop accepting work; give queued AND in-flight handlers a
+        bounded window to finish (teardown paths want the last
+        completions fully routed before death handling runs), then let
+        the thread exit."""
+        import time as _t
+        deadline = _t.monotonic() + drain_timeout
+        with self._cond:
+            while ((self._q or self._busy)
+                   and _t.monotonic() < deadline):
+                self._cond.wait(timeout=0.05)
+            self._stopped = True
+            self._cond.notify_all()
+
+
+def tune_control_socket(fd: int) -> None:
+    """Uniform socket setup for every CONTROL connection: TCP_NODELAY
+    (micro-batched writers replace Nagle; stacking the two means 40 ms
+    stalls on small frames) and SO_KEEPALIVE (half-open links on
+    long-lived daemon/head connections eventually error out of blocked
+    recv loops instead of wedging forever). Best-effort: non-TCP fds
+    (AF_UNIX worker pipes) ignore the TCP option."""
+    try:
+        s = socket.socket(fileno=os.dup(fd))
+    except OSError:
+        return
+    try:
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        except OSError:
+            pass
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+    finally:
+        s.close()
 
 
 class _NullGate:
@@ -136,6 +330,208 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
             raise EOFError("peer closed during transfer")
         got += r
     return bytes(buf)
+
+
+_IOV_MAX = 512  # conservative bound under the kernel's IOV_MAX (1024)
+
+
+class ConnectionWriter:
+    """Per-connection outbound writer: sends enqueue pre-pickled
+    message chunks; ONE writer thread drains the whole queue per wakeup
+    and ships it as a single vectored write (os.writev) of one
+    multi-message frame (protocol.dump_messages layout). Replaces
+    lock-per-send_bytes — under a burst, N messages cost one syscall
+    and one receiver wake instead of N each, and a slow or dead peer
+    never blocks the calling thread (recv pumps, schedulers, heartbeat
+    loops) in write(2).
+
+    Ordering: strict per-connection FIFO (single queue, single writer).
+    Errors: the first write failure is latched; later send() calls
+    raise it (callers treat that as peer death, same as the old inline
+    send_bytes), and `on_error` fires once for connection-teardown
+    hooks.
+
+    Backpressure: the queue is byte-bounded (`max_queued_bytes`).
+    Below the high-water mark senders never block; above it, send()
+    blocks until the writer drains — the old blocking-send_bytes
+    throttling, degraded to gracefully instead of growing the process
+    without bound against a stalled peer (TCP zero-window, wedged
+    daemon)."""
+
+    _MAX_QUEUED_BYTES = 64 << 20
+
+    def __init__(self, conn, name: str = "conn-writer",
+                 on_error: Optional[Callable[[BaseException], None]] = None,
+                 autostart: bool = True,
+                 max_queued_bytes: Optional[int] = None):
+        self._conn = conn  # keep a ref so the fd outlives us
+        self._fd = conn.fileno()
+        self._on_error = on_error
+        self._cond = threading.Condition()
+        self._q: collections.deque = collections.deque()
+        self._q_bytes = 0
+        self._max_q_bytes = (self._MAX_QUEUED_BYTES
+                             if max_queued_bytes is None
+                             else int(max_queued_bytes))
+        self._busy = False
+        self._stopped = False
+        self._error: Optional[BaseException] = None
+        self.write_calls = 0   # syscall counter (perf_smoke guard)
+        self.frames_sent = 0   # messages shipped
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+        if autostart:
+            self.start()
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=self._name)
+            self._thread.start()
+
+    # -- enqueue -------------------------------------------------------
+    def send_message(self, msg_type: str, payload: dict):
+        """Pickle NOW (payload state is captured at enqueue time) and
+        queue for the next coalesced write. Out-of-band buffers
+        (pickle.PickleBuffer-wrapped fields) stay separate chunks all
+        the way into the vectored write — never copied into the
+        frame."""
+        chunks, _ = P.dump_message_parts(msg_type, payload)
+        self.send_chunks(chunks)
+
+    def send_frame(self, body: bytes):
+        """Queue an already-pickled single-message body."""
+        self.send_chunks([body])
+
+    def send_chunks(self, chunks: List):
+        nbytes = sum(P._chunk_len(c) for c in chunks)
+        with self._cond:
+            # High-water backpressure: only engages against a stalled
+            # or far-too-slow peer (the writer normally drains in ms).
+            while (self._q_bytes > self._max_q_bytes
+                   and self._error is None and not self._stopped):
+                self._cond.wait(timeout=1.0)
+            if self._error is not None:
+                raise self._error
+            if self._stopped:
+                raise OSError("connection writer stopped")
+            self._q.append(chunks)
+            self._q_bytes += nbytes
+            self._cond.notify()
+
+    # -- drain ---------------------------------------------------------
+    def _assemble(self, items: List[List]) -> List:
+        """Build the iovec list for one drain: a lone plain message
+        ships as a classic single-message frame; anything else (bursts,
+        or messages carrying out-of-band buffers) ships as ONE batch
+        frame (protocol.assemble_batch — the single encoder of the
+        batch layout). Chunks are referenced, not joined."""
+        if len(items) == 1 and len(items[0]) == 1:
+            body = items[0][0]
+            return [P.conn_frame_header(P._chunk_len(body)), body]
+        body_chunks = P.assemble_batch(items)
+        total = sum(P._chunk_len(c) for c in body_chunks)
+        return [P.conn_frame_header(total)] + body_chunks
+
+    def _writev_all(self, iov: List):
+        """writev with partial-write + IOV_MAX handling. Zero-length
+        chunks (empty out-of-band buffers) are dropped up front: a
+        trailing empty iovec would make writev return 0 forever and
+        spin this loop."""
+        views = [v for v in
+                 (memoryview(c).cast("B") if not isinstance(c, memoryview)
+                  else c.cast("B") for c in iov)
+                 if v.nbytes]
+        idx = 0
+        off = 0
+        while idx < len(views):
+            batch = [views[idx][off:]]
+            batch.extend(views[idx + 1:idx + _IOV_MAX])
+            n = os.writev(self._fd, batch)
+            self.write_calls += 1
+            while n > 0 and idx < len(views):
+                chunk_left = views[idx].nbytes - off
+                if n >= chunk_left:
+                    n -= chunk_left
+                    idx += 1
+                    off = 0
+                else:
+                    off += n
+                    n = 0
+
+    def drain_once(self) -> int:
+        """Drain the current queue with one vectored write. Returns the
+        number of messages shipped (test seam; the writer thread calls
+        this in its loop)."""
+        with self._cond:
+            if not self._q:
+                return 0
+            items = list(self._q)
+            self._q.clear()
+            self._q_bytes = 0
+            self._busy = True
+            self._cond.notify_all()  # wake backpressured senders
+        try:
+            self._writev_all(self._assemble(items))
+            self.frames_sent += len(items)
+        except (OSError, ValueError) as e:
+            with self._cond:
+                self._error = e
+                self._q.clear()
+                self._q_bytes = 0
+                self._busy = False
+                self._cond.notify_all()
+            if self._on_error is not None:
+                try:
+                    self._on_error(e)
+                except Exception:
+                    pass
+            raise
+        with self._cond:
+            self._busy = False
+            if not self._q:
+                self._cond.notify_all()
+        return len(items)
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._q and not self._stopped \
+                        and self._error is None:
+                    self._cond.wait()
+                if self._error is not None or (self._stopped
+                                               and not self._q):
+                    return
+            try:
+                self.drain_once()
+            except (OSError, ValueError):
+                return
+
+    # -- lifecycle -----------------------------------------------------
+    def flush(self, timeout: Optional[float] = 5.0) -> bool:
+        """Wait until everything queued so far hit the wire (or the
+        writer died). True when the queue drained."""
+        import time as _t
+        deadline = None if timeout is None else _t.monotonic() + timeout
+        with self._cond:
+            while self._q or self._busy:
+                if self._error is not None:
+                    return False
+                remaining = None if deadline is None \
+                    else deadline - _t.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+            return self._error is None
+
+    def close(self, flush_timeout: float = 2.0):
+        self.flush(flush_timeout)
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=1.0)
 
 
 class TransferServer:
@@ -176,6 +572,7 @@ class TransferServer:
     def _serve_conn(self, conn: socket.socket):
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
             nonce = os.urandom(32)
             conn.sendall(_MAGIC + nonce)
             digest = _recv_exact(conn, 32)
@@ -289,6 +686,7 @@ class _PeerConn:
             fault.fire("netcomm.connect", peer=f"{host}:{port}")
         self.sock = socket.create_connection((host, port), timeout=30.0)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
         hdr = _recv_exact(self.sock, 36)
         if hdr[:4] != _MAGIC:
             raise ConnectionError("bad transfer-server magic")

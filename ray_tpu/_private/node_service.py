@@ -38,8 +38,12 @@ class RemoteWorkerProxy:
         self.env_key = env_key
         self.env: Dict[str, str] = {}
         self.proc = None
-        self.send_lock = threading.Lock()  # unused; kept for handle parity
-        self.dispatch_lock = threading.Lock()  # fn-cache/send atomicity
+        # dispatch_lock guards {fn-cache check -> enqueue} exactly like
+        # the local WorkerHandle's; the enqueue itself is the daemon
+        # writer queue (nonblocking), so unlike the old send-lock days
+        # nothing IO-bound ever runs under it. No send_lock here: sends
+        # serialize on the daemon connection's writer queue.
+        self.dispatch_lock = threading.Lock()
         self.dedicated_actor = None
         self.running: Dict[bytes, P.TaskSpec] = {}
         self.fn_cache: set = set()
@@ -54,9 +58,16 @@ class RemoteWorkerProxy:
         self.node_id_hex = daemon.node_id_hex
 
     def send(self, msg_type: str, payload: dict):
+        # The relayed frame is pickled HERE (payload state captured at
+        # call time) and rides the TO_WORKER envelope as a pickle-5
+        # out-of-band buffer when large — the daemon writer ships it as
+        # its own iovec instead of copying it into the envelope.
+        frame = P.dump_message(msg_type, payload)
+        if len(frame) > 16 * 1024:
+            import pickle
+            frame = pickle.PickleBuffer(frame)
         self.daemon.send(P.TO_WORKER, {
-            "worker": self.worker_id.binary(),
-            "frame": P.dump_message(msg_type, payload)})
+            "worker": self.worker_id.binary(), "frame": frame})
 
     def kill(self):
         self.alive = False
@@ -86,43 +97,60 @@ class DaemonHandle:
         self.last_ping = time.time()        # wall clock: display only
         self.last_ping_mono = time.monotonic()  # liveness decisions
         self.load: dict = {}
-        self._send_lock = threading.Lock()
+        # Outbound writer thread: sends from ANY head thread (scheduler
+        # dispatch, broadcasts, request replies) enqueue here and the
+        # writer coalesces them into one vectored write per wakeup —
+        # the old per-send lock serialized unrelated dispatches on a
+        # write(2) each (netcomm.ConnectionWriter).
+        from .netcomm import ConnectionWriter
+        self._writer = ConnectionWriter(
+            conn, name=f"daemon-writer-{node_id_hex[:8]}")
         self._lock = threading.Lock()
         self.proxies: Dict[bytes, RemoteWorkerProxy] = {}
         self._idle: Dict[str, Deque[RemoteWorkerProxy]] = \
             collections.defaultdict(collections.deque)
+        # _req_lock scope: reply-slot bookkeeping ONLY (counter +
+        # pending-future table). Holding it across the send used to
+        # serialize unrelated head->daemon requests behind one
+        # write(2); sends are lock-free enqueues now.
         self._req_lock = threading.Lock()
         self._req_counter = 0
         self._pending: Dict[int, Future] = {}
         # Workers whose WORKER_DIED arrived before start_worker() could
         # register the proxy (boot-crash race).
         self.dead_workers: set = set()
+        # Per-connection ordered routing executor: the recv thread
+        # parses frames and hands worker-plane messages here (see
+        # HeadServer._route) instead of running handlers inline.
+        from .netcomm import SerialExecutor
+        self._route_exec = SerialExecutor(
+            name=f"daemon-route-{node_id_hex[:8]}")
 
     # -- link ----------------------------------------------------------
     def send(self, msg_type: str, payload: dict):
-        data = P.dump_message(msg_type, payload)
-        with self._send_lock:
-            self.conn.send_bytes(data)
+        self._writer.send_message(msg_type, payload)
 
     def request(self, msg_type: str, payload: dict, timeout: float = 120.0):
+        fut: Future = Future()
         with self._req_lock:
             self._req_counter += 1
             req_id = self._req_counter
-        fut: Future = Future()
-        self._pending[req_id] = fut
+            self._pending[req_id] = fut
         payload = dict(payload)
         payload["req_id"] = req_id
         try:
             self.send(msg_type, payload)
             result = fut.result(timeout=timeout)
         finally:
-            self._pending.pop(req_id, None)
+            with self._req_lock:
+                self._pending.pop(req_id, None)
         if isinstance(result, dict) and result.get("__error__") is not None:
             raise result["__error__"]
         return result
 
     def resolve_reply(self, payload: dict):
-        fut = self._pending.pop(payload["req_id"], None)
+        with self._req_lock:
+            fut = self._pending.pop(payload["req_id"], None)
         if fut is not None:
             fut.set_result(payload.get("result"))
 
@@ -132,6 +160,17 @@ class DaemonHandle:
         for fut in pending.values():
             if not fut.done():
                 fut.set_result({"__error__": error})
+
+    def close_link(self):
+        """Tear down the writer + routing executor (connection gone)."""
+        try:
+            self._route_exec.close()
+        except Exception:
+            pass
+        try:
+            self._writer.close(flush_timeout=0.5)
+        except Exception:
+            pass
 
     # -- worker pool face (mirrors WorkerPool pop/push/remove) ---------
     def pop_idle(self, env_key: str = "") -> Optional[RemoteWorkerProxy]:
@@ -284,7 +323,11 @@ class HeadServer:
         # SO_RCVTIMEO bounds the raw reads Connection does during auth.
         sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVTIMEO,
                         _struct.pack("ll", 10, 0))
+        # Uniform control-socket setup: NODELAY (the micro-batching
+        # writers replace Nagle) + KEEPALIVE (half-open daemon links
+        # must eventually error, not wedge recv loops forever).
         sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_KEEPALIVE, 1)
         conn = Connection(sock.detach())
         deliver_challenge(conn, self._token)
         answer_challenge(conn, self._token)
@@ -303,7 +346,8 @@ class HeadServer:
                 except OSError:
                     pass
                 return
-            msg_type, payload = cloudpickle.loads(conn.recv_bytes())
+            first_msgs = P.load_messages(conn.recv_bytes())
+            msg_type, payload = first_msgs[0]
             if msg_type != P.REGISTER_NODE:
                 conn.close()
                 return
@@ -343,10 +387,17 @@ class HeadServer:
             self._node._on_daemon_registered(handle)
             with self._lock:
                 self.daemons[handle.node_id_hex] = handle
+            # A reconnecting daemon's writer may have coalesced early
+            # messages (heartbeats, worker relays) into the SAME frame
+            # as REGISTER_NODE; route them now or they are lost.
+            for mt, pl in first_msgs[1:]:
+                self._route(handle, mt, pl)
             while True:
                 data = conn.recv_bytes()
-                msg_type, payload = cloudpickle.loads(data)
-                self._route(handle, msg_type, payload)
+                # A frame may carry a coalesced burst from the daemon's
+                # writer; expand and route in order.
+                for msg_type, payload in P.load_messages(data):
+                    self._route(handle, msg_type, payload)
         except (EOFError, OSError):
             pass
         except Exception:
@@ -354,6 +405,11 @@ class HeadServer:
         finally:
             if handle is not None:
                 handle.alive = False
+                # Drain routed-but-unprocessed worker messages (bounded)
+                # BEFORE death handling: completions that arrived ahead
+                # of the EOF must not be retried as failures, exactly as
+                # under the old inline routing.
+                handle.close_link()
                 from ..exceptions import NodeDiedError
                 handle.fail_pending(
                     NodeDiedError(handle.node_id_hex,
@@ -383,24 +439,17 @@ class HeadServer:
                 pass
 
     def _route(self, handle: DaemonHandle, msg_type: str, payload: dict):
-        import cloudpickle
+        # Worker-plane messages run on the handle's ordered executor,
+        # not this recv thread: decode stays hot while slow handlers
+        # (task-done bookkeeping, death handling) drain off-thread in
+        # arrival order (WORKER_DIED must never overtake the worker's
+        # final TASK_DONE).
         if msg_type == P.FROM_WORKER:
-            proxy = handle.proxies.get(payload["worker"])
-            if proxy is None:
-                return
-            inner_type, inner_payload = cloudpickle.loads(payload["frame"])
-            self._node._on_worker_message(proxy, inner_type, inner_payload)
+            handle._route_exec.submit(self._route_from_worker, handle,
+                                      payload)
         elif msg_type == P.WORKER_DIED:
-            proxy = handle.proxies.get(payload["worker"])
-            if proxy is None:
-                with handle._lock:
-                    handle.dead_workers.add(payload["worker"])
-                return
-            handle.remove(proxy)
-            if not proxy.death_handled:
-                proxy.death_handled = True
-                proxy.alive = False
-                self._node._on_worker_death(proxy)
+            handle._route_exec.submit(self._route_worker_died, handle,
+                                      payload)
         elif msg_type == P.NODE_PING:
             handle.last_ping = time.time()
             handle.last_ping_mono = time.monotonic()
@@ -432,6 +481,25 @@ class HeadServer:
         elif msg_type == P.NODE_REQUEST:
             self._node._handler_pool.submit(
                 self._handle_node_request, handle, payload)
+
+    def _route_from_worker(self, handle: DaemonHandle, payload: dict):
+        proxy = handle.proxies.get(payload["worker"])
+        if proxy is None:
+            return
+        for inner_type, inner_payload in P.load_messages(payload["frame"]):
+            self._node._on_worker_message(proxy, inner_type, inner_payload)
+
+    def _route_worker_died(self, handle: DaemonHandle, payload: dict):
+        proxy = handle.proxies.get(payload["worker"])
+        if proxy is None:
+            with handle._lock:
+                handle.dead_workers.add(payload["worker"])
+            return
+        handle.remove(proxy)
+        if not proxy.death_handled:
+            proxy.death_handled = True
+            proxy.alive = False
+            self._node._on_worker_death(proxy)
 
     def _handle_node_request(self, handle: DaemonHandle, payload: dict):
         req_id = payload["req_id"]
@@ -488,6 +556,11 @@ class HeadServer:
                 d.send(P.SHUTDOWN_NODE, {})
             except Exception:
                 pass
+            try:
+                d._writer.flush(0.5)
+            except Exception:
+                pass
+            d.close_link()
             try:
                 d.conn.close()
             except Exception:

@@ -51,6 +51,24 @@ def escalated_spill(store, need: int) -> int:
     return store.spill_objects(max(0, used - 2 * int(need)))
 
 
+def _put_gate(size: int):
+    """Host-wide admission gate for big puts, shared by BOTH store
+    backends: concurrent first-touch of fresh tmpfs pages from multiple
+    processes collapses superlinearly on small hosts (kernel shmem
+    allocation contention), so copies above the threshold go through
+    netcomm's bandwidth-aware HostCopyGate — up to gate-width copies
+    overlap (multi-core hosts), excess waiters admit FIFO (the old
+    exclusive lock serialized EVERY multi-client put; the old ungated
+    file-store path thrashed instead)."""
+    from .config import ray_config
+    thresh = float(ray_config.transfer_serialize_threshold_mb)
+    if thresh > 0 and size >= thresh * (1 << 20):
+        from .netcomm import _host_copy_gate
+        return _host_copy_gate
+    from .netcomm import _NullGate
+    return _NullGate()
+
+
 def _default_capacity() -> int:
     """Default store capacity: a fraction of /dev/shm (reference defaults
     plasma to 30% of system memory, ray_config_def.h object_store_memory;
@@ -184,16 +202,22 @@ class ObjectStore:
         """Write path: plain write(2) into the shm file (no mmap — a
         store-side mapping would fault a page per 4 KiB; see
         SerializedObject.write_to_fd). Readers mmap lazily on first get.
+        Big writes go through the host copy gate: N multi-client puts
+        admitted concurrently up to the host's page-allocation
+        bandwidth instead of thrashing it (this path used to run
+        ungated — measured ~3x aggregate collapse at 4-way on a 1-core
+        box).
         """
         size = sobj.total_size
-        fd = self._reserve(object_id, size)
-        try:
-            sobj.write_to_fd(fd)
-        except BaseException:
+        with _put_gate(size):
+            fd = self._reserve(object_id, size)
+            try:
+                sobj.write_to_fd(fd)
+            except BaseException:
+                os.close(fd)
+                self._abort_reserve(object_id)
+                raise
             os.close(fd)
-            self._abort_reserve(object_id)
-            raise
-        os.close(fd)
         self.seal(object_id)
         return size
 
@@ -749,8 +773,7 @@ class ArenaObjectStore:
     def put_serialized(self, object_id: ObjectID,
                        sobj: serialization.SerializedObject) -> int:
         size = sobj.total_size
-        gate = self._put_gate(size)
-        with gate:
+        with _put_gate(size):
             view = self.create(object_id, size)
             try:
                 sobj.write_into(view)
@@ -762,20 +785,6 @@ class ArenaObjectStore:
         self.seal(object_id)
         # creator pin retained: owner-driven free()/spill is the reclaim
         return size
-
-    @staticmethod
-    def _put_gate(size: int):
-        """Host-wide gate for big puts: concurrent first-touch of fresh
-        tmpfs pages from multiple processes collapses ~10x on small
-        hosts (same wall the transfer path gates — netcomm gates pulls,
-        this gates multi-client puts; the two never nest)."""
-        from .config import ray_config
-        thresh = float(ray_config.transfer_serialize_threshold_mb)
-        if thresh > 0 and size >= thresh * (1 << 20):
-            from .netcomm import _host_copy_gate
-            return _host_copy_gate
-        from .netcomm import _NullGate
-        return _NullGate()
 
     def put(self, object_id: ObjectID, value: Any) -> int:
         return self.put_serialized(object_id, serialization.serialize(value))

@@ -183,7 +183,8 @@ class Node:
             self.session_dir, self.store_dir,
             on_worker_message=self._on_worker_message,
             on_worker_death=self._on_worker_death,
-            node_id_hex=self.node_id.hex())
+            node_id_hex=self.node_id.hex(),
+            on_worker_message_batch=self._on_worker_messages)
         ncpu = int(totals.get("CPU", 4))
         from .scheduler import NodeRegistry
         self.node_registry = NodeRegistry(self.node_id.hex(),
@@ -727,12 +728,19 @@ class Node:
                     unresolved.add(a.object_id)
         return unresolved
 
+    # The head owns the submit-time incref of a task's return ids (one
+    # fused gcs pass instead of a per-ref incref from the ObjectRef
+    # constructor); api._make_return_refs skips its per-ref incref and
+    # marks the refs owned, so dropping them balances (the same
+    # contract WorkerClient has always used for nested submissions).
+    head_increfs_returns = True
+
     def submit_task(self, spec: P.TaskSpec):
         if spec.fn_blob is not None:
             self.register_function(spec.fn_id, spec.fn_blob)
         self._pin_task_args(spec)
-        for rid in spec.return_ids:
-            self.gcs.objects.register_pending(rid, spec)
+        self.gcs.objects.register_submitted(spec.return_ids, spec,
+                                            incref_delta=1)
         self.gcs.record_task_event({
             "task_id": spec.task_id.hex(), "name": spec.name,
             "state": "PENDING", "ts": time.time()})
@@ -1233,8 +1241,8 @@ class Node:
         entry = self.gcs.actors.get(spec.actor_id)
         if st is None or entry is None:
             raise ValueError(f"Unknown actor {spec.actor_id}")
-        for rid in spec.return_ids:
-            self.gcs.objects.register_pending(rid, spec)
+        self.gcs.objects.register_submitted(spec.return_ids, spec,
+                                            incref_delta=1)
         if st.dead:
             blob = entry.creation_error or serialization.dumps(
                 ActorDiedError(f"Actor {spec.actor_id.hex()} is dead "
@@ -1517,6 +1525,52 @@ class Node:
         except Exception:
             pass
 
+    def _on_worker_messages(self, handle: WorkerHandle, msgs) -> None:
+        """Burst entry (one coalesced frame from a worker's writer):
+        consecutive SUBMIT_TASK runs collapse into one batched
+        submission — per-tick scheduler work instead of per-message —
+        while everything else routes in arrival order (a REF_COUNT
+        decref between two submits MUST stay between them: reordering
+        it ahead of a submit's arg pin frees the arg early)."""
+        i, n = 0, len(msgs)
+        while i < n:
+            msg_type, payload = msgs[i]
+            if msg_type == P.SUBMIT_TASK:
+                j = i + 1
+                while j < n and msgs[j][0] == P.SUBMIT_TASK:
+                    j += 1
+                if j - i > 1:
+                    self._submit_task_run(
+                        handle, [m[1] for m in msgs[i:j]])
+                    i = j
+                    continue
+            self._on_worker_message(handle, msg_type, payload)
+            i += 1
+
+    def _submit_task_run(self, handle: WorkerHandle, payloads) -> None:
+        """Batched worker-originated submissions: per-spec registration
+        still runs in order, but the scheduler absorbs the whole run
+        through submit_batch (one queue lock + one dispatch wake)."""
+        items = []
+        for p in payloads:
+            spec = p["spec"]
+            spec._nested = True
+            spec._submitter_wid = handle.worker_id.binary()
+            try:
+                if spec.fn_blob is not None:
+                    self.register_function(spec.fn_id, spec.fn_blob)
+                self._pin_task_args(spec)
+                self.gcs.objects.register_submitted(spec.return_ids,
+                                                    spec, incref_delta=1)
+                self.gcs.record_task_event({
+                    "task_id": spec.task_id.hex(), "name": spec.name,
+                    "state": "PENDING", "ts": time.time()})
+                items.append((spec, self._unresolved_deps(spec)))
+            except BaseException as e:  # noqa: BLE001
+                self._register_submit_error(spec, e)
+        if items:
+            self.scheduler.submit_batch(items)
+
     def _on_worker_message(self, handle: WorkerHandle, msg_type: str,
                            payload: dict):
         if msg_type == P.REF_COUNT:
@@ -1626,12 +1680,11 @@ class Node:
     def _worker_submit(self, handle: WorkerHandle, spec, req_id,
                        submit_fn) -> None:
         """Shared scaffolding for worker-originated task/actor-task
-        submissions: borrow the return ids on the submitter's behalf
+        submissions: the return-id incref now rides inside
+        submit_task/submit_actor_task's fused registration
         (api._make_return_refs skips the per-ref REF_COUNT frame; the
         worker's refs decref on drop to balance), submit, and route
         failures to the return refs when the submitter isn't waiting."""
-        for rid in spec.return_ids:
-            self.gcs.objects.incref(rid)
         try:
             submit_fn(spec)
         except BaseException as e:  # noqa: BLE001

@@ -538,6 +538,21 @@ class WorkerHandle:
                 return
             self.conn.send_bytes(data)
 
+    def send_raw(self, data) -> None:
+        """Ship an ALREADY-PICKLED message body (daemon relay path:
+        TO_WORKER frames forwarded verbatim). Same ordering rules as
+        send(): buffered EXEC frames flush first, then the native queue
+        or the connection."""
+        if not isinstance(data, bytes):
+            data = bytes(data)
+        with self.send_lock:
+            if self.coalesce_buf:
+                self._flush_coalesced_locked()
+            mux = self.native_mux
+            if mux is not None and mux.send_framed(self.native_token, data):
+                return
+            self.conn.send_bytes(data)
+
     def _flush_coalesced_locked(self):
         """Ship buffered EXEC frames as one EXEC_TASKS message.
         Caller holds send_lock."""
@@ -566,40 +581,20 @@ class WorkerHandle:
 
 
 class _ConnState:
-    """Per-connection incremental frame reassembly for the recv mux."""
+    """Per-connection state for the recv mux; frame reassembly is the
+    shared streaming parser (protocol.FrameParser — one parser
+    implementation for every raw-socket recv loop)."""
 
-    __slots__ = ("handle", "on_message", "on_eof", "sock", "buf")
+    __slots__ = ("handle", "on_message", "on_eof", "on_batch", "sock",
+                 "parser")
 
-    def __init__(self, handle, on_message, on_eof, sock):
+    def __init__(self, handle, on_message, on_eof, sock, on_batch=None):
         self.handle = handle
         self.on_message = on_message
         self.on_eof = on_eof
+        self.on_batch = on_batch
         self.sock = sock
-        self.buf = bytearray()
-
-    def frames(self):
-        """Parse complete multiprocessing.Connection frames out of the
-        buffer (4-byte '!i' length; -1 escapes to an 8-byte '!Q')."""
-        import struct
-        buf = self.buf
-        while True:
-            if len(buf) < 4:
-                return
-            (n,) = struct.unpack_from("!i", buf, 0)
-            if n == -1:
-                if len(buf) < 12:
-                    return
-                (n64,) = struct.unpack_from("!Q", buf, 4)
-                if len(buf) < 12 + n64:
-                    return
-                frame = bytes(buf[12:12 + n64])
-                del buf[:12 + n64]
-            else:
-                if len(buf) < 4 + n:
-                    return
-                frame = bytes(buf[4:4 + n])
-                del buf[:4 + n]
-            yield frame
+        self.parser = P.FrameParser()
 
 
 class _RecvMux:
@@ -630,9 +625,11 @@ class _RecvMux:
         self._thread.start()
 
     def register(self, handle: "WorkerHandle",
-                 on_message: Callable, on_eof: Callable):
+                 on_message: Callable, on_eof: Callable,
+                 on_batch: Optional[Callable] = None):
         with self._lock:
-            self._pending_add.append((handle, on_message, on_eof))
+            self._pending_add.append((handle, on_message, on_eof,
+                                      on_batch))
         self._wake()
 
     def _wake(self):
@@ -655,16 +652,19 @@ class _RecvMux:
     def _loop(self):
         import socket as _socket
 
-        import cloudpickle
         import selectors
+        _SCRATCH_N = 1 << 20
+        scratch = bytearray(_SCRATCH_N)
+        scratch_view = memoryview(scratch)
         while not self._stopped:
             with self._lock:
                 adds, self._pending_add = self._pending_add, []
-            for handle, on_message, on_eof in adds:
+            for handle, on_message, on_eof, on_batch in adds:
                 try:
                     fd = handle.conn.fileno()
                     sock = _socket.socket(fileno=os.dup(fd))
-                    state = _ConnState(handle, on_message, on_eof, sock)
+                    state = _ConnState(handle, on_message, on_eof, sock,
+                                       on_batch)
                     self._sel.register(fd, selectors.EVENT_READ, state)
                 except (OSError, ValueError):
                     on_eof(handle)
@@ -680,23 +680,34 @@ class _RecvMux:
                 eof = False
                 while True:
                     try:
-                        chunk = state.sock.recv(1 << 20,
-                                                _socket.MSG_DONTWAIT)
+                        # recv_into a reused scratch buffer: no
+                        # intermediate bytes object per read.
+                        r = state.sock.recv_into(scratch, _SCRATCH_N,
+                                                 _socket.MSG_DONTWAIT)
                     except (BlockingIOError, InterruptedError):
                         break
                     except OSError:
                         eof = True
                         break
-                    if not chunk:
+                    if r == 0:
                         eof = True
                         break
-                    state.buf.extend(chunk)
-                    if len(chunk) < (1 << 20):
+                    state.parser.feed(scratch_view[:r])
+                    if r < _SCRATCH_N:
                         break
-                for frame in state.frames():
+                for frame in state.parser.frames():
                     try:
-                        msg_type, payload = cloudpickle.loads(frame)
-                        state.on_message(state.handle, msg_type, payload)
+                        # One frame may carry a coalesced burst from the
+                        # worker's writer thread (multi-message framing);
+                        # burst-aware receivers take the whole batch in
+                        # one call (submission-run coalescing).
+                        msgs = P.load_messages(frame)
+                        if len(msgs) > 1 and state.on_batch is not None:
+                            state.on_batch(state.handle, msgs)
+                        else:
+                            for msg_type, payload in msgs:
+                                state.on_message(state.handle, msg_type,
+                                                 payload)
                     except Exception:
                         import traceback
                         traceback.print_exc()
@@ -727,7 +738,8 @@ class _NativeMux:
         self._core = _native.NativeDispatcher()
         self._eof_len = _native.EOF_LEN
         self._lock = threading.Lock()
-        self._states: Dict[int, tuple] = {}  # token -> (handle, on_msg, on_eof)
+        # token -> (handle, on_msg, on_eof, on_batch)
+        self._states: Dict[int, tuple] = {}
         self._next_token = 0
         self._stopped = False
         # Serializes native-core registration against destroy(): a
@@ -741,11 +753,12 @@ class _NativeMux:
         self._thread.start()
 
     def register(self, handle: "WorkerHandle",
-                 on_message: Callable, on_eof: Callable):
+                 on_message: Callable, on_eof: Callable,
+                 on_batch: Optional[Callable] = None):
         with self._lock:
             self._next_token += 1
             token = self._next_token
-            self._states[token] = (handle, on_message, on_eof)
+            self._states[token] = (handle, on_message, on_eof, on_batch)
         try:
             with self._reg_lock:
                 if self._stopped:
@@ -776,7 +789,6 @@ class _NativeMux:
     def _loop(self):
         import struct
 
-        import cloudpickle
         mv = memoryview(self._buf)
         while not self._stopped:
             n = self._core.recv_batch(self._buf, self._cap, 1000)
@@ -816,8 +828,21 @@ class _NativeMux:
                     if state is None:
                         continue
                     try:
-                        msg_type, payload = cloudpickle.loads(frame)
-                        state[1](state[0], msg_type, payload)
+                        # Writer-coalesced frames expand to their
+                        # messages here — one GIL-held loads() amortized
+                        # over the burst instead of one per message.
+                        # Batch frames are materialized first: their
+                        # out-of-band buffers alias `frame`, a view of
+                        # the REUSED recv buffer, and a handler may
+                        # defer payloads past this drain.
+                        if P.is_batch(frame):
+                            frame = bytes(frame)
+                        msgs = P.load_messages(frame)
+                        if len(msgs) > 1 and state[3] is not None:
+                            state[3](state[0], msgs)
+                        else:
+                            for msg_type, payload in msgs:
+                                state[1](state[0], msg_type, payload)
                     except Exception:
                         import traceback
                         traceback.print_exc()
@@ -833,7 +858,7 @@ class _NativeMux:
         with self._lock:
             states = list(self._states.values())
             self._states.clear()
-        for handle, _on_msg, _on_eof in states:
+        for handle, *_rest in states:
             with handle.send_lock:
                 handle.native_mux = None
         self._core.stop()
@@ -864,10 +889,12 @@ class WorkerPool:
     def __init__(self, session_dir: str, store_dir: str,
                  on_worker_message: Callable, on_worker_death: Callable,
                  worker_env: Optional[Dict[str, str]] = None,
-                 node_id_hex: Optional[str] = None):
+                 node_id_hex: Optional[str] = None,
+                 on_worker_message_batch: Optional[Callable] = None):
         self._session_dir = session_dir
         self._store_dir = store_dir
         self._on_message = on_worker_message
+        self._on_batch = on_worker_message_batch
         self._on_death = on_worker_death
         self._base_env = worker_env or {}
         self._node_id_hex = node_id_hex
@@ -1029,7 +1056,8 @@ class WorkerPool:
         handle = WorkerHandle(worker_id, proc, conn, env_key, env)
         with self._lock:
             self.workers[worker_id] = handle
-        self._mux.register(handle, self._on_message, self._handle_eof)
+        self._mux.register(handle, self._on_message, self._handle_eof,
+                           self._on_batch)
         return handle
 
     def _handle_eof(self, handle: WorkerHandle):
@@ -1198,29 +1226,60 @@ class Scheduler:
             if queue_empty and self._try_dispatch_fast(spec):
                 return
         with self._cond:
-            if unresolved:
-                pt = PendingTask(spec, set(unresolved))
-                for oid in unresolved:
-                    self._waiting.setdefault(oid, []).append(pt)
-                # Close the check-then-register race: a dep may have become
-                # ready between the caller's snapshot and this registration,
-                # in which case its notify already fired and will not recur.
-                for oid in list(pt.unresolved):
-                    if self._is_object_ready(oid):
-                        pt.unresolved.discard(oid)
-                        pts = self._waiting.get(oid)
-                        if pts is not None:
-                            try:
-                                pts.remove(pt)
-                            except ValueError:
-                                pass
-                            if not pts:
-                                del self._waiting[oid]
-                if not pt.unresolved:
-                    self._ready.append(pt.spec)
-            else:
-                self._ready.append(spec)
+            self._enqueue_locked(spec, unresolved)
             self._cond.notify()
+
+    def submit_batch(self, items) -> None:
+        """Submit a burst of (spec, unresolved) in one tick: fast-path
+        dispatches run per item (pipelining is the throughput path),
+        but everything that has to queue is enqueued under ONE cond
+        acquisition with ONE dispatch-loop wake — a 10k-task burst
+        costs one notify, not 10k lock round-trips (the per-tick
+        batching face of the multi-message framing: the transport
+        delivers submissions in bursts, the scheduler absorbs them in
+        bursts)."""
+        queued = []
+        for spec, unresolved in items:
+            # Once anything has queued, FIFO forbids fast-pathing later
+            # items past it — skip the lock entirely for the rest.
+            if (not queued and not unresolved
+                    and not isinstance(spec, P.ActorSpec)):
+                with self._cond:
+                    queue_empty = not self._ready
+                if queue_empty and self._try_dispatch_fast(spec):
+                    continue
+            queued.append((spec, unresolved))
+        if not queued:
+            return
+        with self._cond:
+            for spec, unresolved in queued:
+                self._enqueue_locked(spec, unresolved)
+            self._cond.notify()
+
+    def _enqueue_locked(self, spec, unresolved: Set[ObjectID]) -> None:
+        """Queue one submission (caller holds self._cond)."""
+        if unresolved:
+            pt = PendingTask(spec, set(unresolved))
+            for oid in unresolved:
+                self._waiting.setdefault(oid, []).append(pt)
+            # Close the check-then-register race: a dep may have become
+            # ready between the caller's snapshot and this registration,
+            # in which case its notify already fired and will not recur.
+            for oid in list(pt.unresolved):
+                if self._is_object_ready(oid):
+                    pt.unresolved.discard(oid)
+                    pts = self._waiting.get(oid)
+                    if pts is not None:
+                        try:
+                            pts.remove(pt)
+                        except ValueError:
+                            pass
+                        if not pts:
+                            del self._waiting[oid]
+            if not pt.unresolved:
+                self._ready.append(pt.spec)
+        else:
+            self._ready.append(spec)
 
     def notify_object_ready(self, oid: ObjectID):
         with self._cond:

@@ -27,7 +27,6 @@ import asyncio
 import ctypes
 import inspect
 import os
-import struct as _struct
 import sys
 import threading
 import time
@@ -179,12 +178,14 @@ class Worker:
         self.store.request_spill = (
             lambda need: self.client.gcs_request("spill_store",
                                                  need=need))
-        self._send_lock = threading.Lock()
-        # Oneway-send coalescing (send_lazy): framed bytes awaiting one
-        # combined write; guarded by _send_lock.
-        self._lazy_buf: list = []
-        self._lazy_event = threading.Event()
-        self._lazy_flusher: Optional[threading.Thread] = None
+        # Outbound writer thread: every send enqueues and the writer
+        # coalesces the queue into one vectored write per wakeup
+        # (netcomm.ConnectionWriter) — replaces the old send-lock +
+        # per-message write and the 1 ms lazy flusher. Strict FIFO per
+        # connection, so the borrow-incref-before-TASK_DONE pipe
+        # ordering contract holds unchanged.
+        from .netcomm import ConnectionWriter
+        self._writer = ConnectionWriter(conn, name="worker-writer")
         self._req_counter = 0
         self._req_lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
@@ -234,67 +235,17 @@ class Worker:
 
     # -- plumbing ----------------------------------------------------------
     def send(self, msg_type: str, payload: dict):
-        data = P.dump_message(msg_type, payload)
-        with self._send_lock:
-            if self._lazy_buf:
-                # Ride the flush: buffered oneway frames + this one in a
-                # single write, preserving send order.
-                self._lazy_buf.append(self._frame(data))
-                self._flush_locked()
-                return
-            self.conn.send_bytes(data)
+        """Enqueue for the writer thread: bursts from any thread
+        coalesce into one multi-message frame / one syscall per writer
+        wakeup; a oneway flood and a synchronous request share the same
+        FIFO queue, so ordering is inherent rather than maintained by
+        flush barriers."""
+        self._writer.send_message(msg_type, payload)
 
-    @staticmethod
-    def _frame(data: bytes) -> bytes:
-        # multiprocessing.Connection wire framing (matched by the head's
-        # native dispatch parser): i32 BE length, -1 escape + u64 BE for
-        # huge frames.
-        n = len(data)
-        if n < 0x7FFFFFFF:
-            return _struct.pack("!i", n) + data
-        return _struct.pack("!i", -1) + _struct.pack("!Q", n) + data
-
-    def _flush_locked(self):
-        blob = b"".join(self._lazy_buf)
-        self._lazy_buf.clear()
-        fd = self.conn.fileno()
-        view = memoryview(blob)
-        while view:
-            written = os.write(fd, view)
-            view = view[written:]
-
-    def send_lazy(self, msg_type: str, payload: dict):
-        """Oneway send with burst coalescing: frames buffer briefly and
-        flush as ONE write when (a) the buffer fills, (b) any
-        synchronous send follows (ordering), or (c) the 1 ms flusher
-        fires — so a submission burst costs one syscall per ~32 frames
-        instead of one each, and the owner's recv side wakes once per
-        batch. Nothing here waits: worst-case added latency is the
-        flusher period."""
-        data = P.dump_message(msg_type, payload)
-        with self._send_lock:
-            self._lazy_buf.append(self._frame(data))
-            if len(self._lazy_buf) >= 32:
-                self._flush_locked()
-                return
-            if self._lazy_flusher is None:
-                self._lazy_flusher = threading.Thread(
-                    target=self._lazy_flush_loop, daemon=True,
-                    name="lazy-flush")
-                self._lazy_flusher.start()
-        self._lazy_event.set()
-
-    def _lazy_flush_loop(self):
-        while not self._shutdown.is_set():
-            self._lazy_event.wait()
-            self._lazy_event.clear()
-            time.sleep(0.001)  # let the burst accumulate
-            with self._send_lock:
-                if self._lazy_buf:
-                    try:
-                        self._flush_locked()
-                    except OSError:
-                        return  # owner gone; recv loop handles exit
+    # Oneway sends ride the same writer queue (kept as a distinct name
+    # for call-site intent; the old 1 ms lazy flusher is gone — the
+    # writer coalesces without adding latency).
+    send_lazy = send
 
     def request(self, msg_type: str, payload: dict) -> Any:
         with self._req_lock:
@@ -689,39 +640,17 @@ class Worker:
 
     # -- main loop ---------------------------------------------------------
     def run(self):
-        import pickle
         while not self._shutdown.is_set():
             try:
                 data = self.conn.recv_bytes()
             except (EOFError, OSError):
                 break
-            msg_type, payload = cloudpickle.loads(data)
-            if msg_type == P.EXEC_TASK:
-                self._handle_exec(payload["spec"])
-            elif msg_type == P.EXEC_TASKS:
-                # Coalesced dispatch burst: one frame, N specs pickled
-                # individually (the owner buffers per-worker while
-                # draining a recv batch — one send syscall and one recv
-                # wake amortized over the burst).
-                for sb in payload["specs_pickled"]:
-                    self._handle_exec(pickle.loads(sb))
-            elif msg_type == P.RECALL_QUEUED:
-                self._recall_queued()
-            elif msg_type == P.REPLY:
-                fut = self._pending.pop(payload["req_id"], None)
-                if fut is not None:
-                    fut.set_result(payload.get("result"))
-            elif msg_type == P.CREATE_ACTOR:
-                threading.Thread(
-                    target=self._create_actor, args=(payload["spec"],),
-                    daemon=True).start()
-            elif msg_type == P.CANCEL_TASK:
-                self._cancel(payload["task_id"])
-            elif msg_type == P.RELEASE_OBJECTS:
-                for oid in payload["object_ids"]:
-                    self.store.release(oid)
-            elif msg_type == P.SHUTDOWN:
-                break
+            # One frame may carry many coalesced messages (writer-side
+            # micro-batching); handle in order.
+            for msg_type, payload in P.load_messages(data):
+                if self._handle_message(msg_type, payload):
+                    self._shutdown.set()
+                    break
         self._shutdown.set()
         if self._actor_instance is not None:
             # Best-effort __ray_terminate__-style atexit hook parity.
@@ -731,7 +660,44 @@ class Worker:
                     term()
                 except Exception:
                     pass
+        # Ship anything still queued (TASK_DONEs racing shutdown)
+        # before the hard exit tears the pipe down.
+        try:
+            self._writer.flush(2.0)
+        except Exception:
+            pass
         os._exit(0)
+
+    def _handle_message(self, msg_type: str, payload: dict) -> bool:
+        """Route one decoded message; returns True on SHUTDOWN."""
+        import pickle
+        if msg_type == P.EXEC_TASK:
+            self._handle_exec(payload["spec"])
+        elif msg_type == P.EXEC_TASKS:
+            # Coalesced dispatch burst: one frame, N specs pickled
+            # individually (the owner buffers per-worker while
+            # draining a recv batch — one send syscall and one recv
+            # wake amortized over the burst).
+            for sb in payload["specs_pickled"]:
+                self._handle_exec(pickle.loads(sb))
+        elif msg_type == P.RECALL_QUEUED:
+            self._recall_queued()
+        elif msg_type == P.REPLY:
+            fut = self._pending.pop(payload["req_id"], None)
+            if fut is not None:
+                fut.set_result(payload.get("result"))
+        elif msg_type == P.CREATE_ACTOR:
+            threading.Thread(
+                target=self._create_actor, args=(payload["spec"],),
+                daemon=True).start()
+        elif msg_type == P.CANCEL_TASK:
+            self._cancel(payload["task_id"])
+        elif msg_type == P.RELEASE_OBJECTS:
+            for oid in payload["object_ids"]:
+                self.store.release(oid)
+        elif msg_type == P.SHUTDOWN:
+            return True
+        return False
 
 
 def worker_main(conn, config: P.WorkerConfig):

@@ -39,6 +39,11 @@ def pytest_configure(config):
         "markers",
         "chaos: seeded fault-injection chaos runs (long; also marked "
         "slow so tier-1's `-m 'not slow'` filter excludes them)")
+    config.addinivalue_line(
+        "markers",
+        "perf_smoke: fast, deterministic performance guards (syscall/"
+        "write-count based, never wall-clock) — run in tier-1 and "
+        "selectable standalone via `-m perf_smoke`")
 
 
 @pytest.fixture(scope="module")
