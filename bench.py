@@ -618,28 +618,39 @@ def bench_resnet(extras):
                 # Ceiling probe AT CONSTRUCTION, before any pipelined
                 # batch can contend for the chip/tunnel (a probe taken
                 # mid-stream under max_concurrency=2 would time a
-                # contended upload and understate the ceiling). Fresh
-                # buffers: re-uploading warm pages measures the cache,
-                # not the tunnel.
+                # contended upload and understate the ceiling).
+                #
+                # r6 coherence fix (VERDICT weak #5: the pipeline "beat"
+                # its own ceiling 2.2x): the old probe timed
+                # jax.device_put WITH np.random generation inside the
+                # timed region (~38M doubles — dominating the upload),
+                # and on a different code path than the pipeline uses.
+                # Now: buffers are generated OUTSIDE every timer, the
+                # compute term is predict() on a device-resident batch,
+                # and the upload term is measured ON THE PIPELINE'S OWN
+                # PATH — uncontended end-to-end predict(host_numpy)
+                # minus the compute term. Fresh buffers per measurement:
+                # re-uploading warm pages measures the cache, not the
+                # tunnel.
                 probe = np.random.rand(64, 224, 224, 3).astype(
                     np.float32)
                 np.asarray(self.predict(probe))  # XLA compile
-                d = jax.device_put(
-                    np.random.rand(64, 224, 224, 3).astype(np.float32))
+                d = jax.device_put(probe)
                 d.block_until_ready()
-                t0 = _t.perf_counter()
-                d = jax.device_put(
-                    np.random.rand(64, 224, 224, 3).astype(np.float32))
-                d.block_until_ready()
-                up_s = _t.perf_counter() - t0
                 t0 = _t.perf_counter()
                 np.asarray(self.predict(d))
                 comp_s = _t.perf_counter() - t0
+                fresh = np.random.rand(64, 224, 224, 3).astype(
+                    np.float32)
+                t0 = _t.perf_counter()
+                np.asarray(self.predict(fresh))
+                e2e_s = _t.perf_counter() - t0
+                up_s = max(e2e_s - comp_s, 0.0)
                 try:
                     from ray_tpu._private import state as _state
                     _state.current().gcs_request(
                         "kv_put", key="resnet_bench/rates",
-                        value=f"{up_s}:{comp_s}".encode(),
+                        value=f"{up_s}:{comp_s}:{e2e_s}".encode(),
                         namespace="bench")
                 except Exception:
                     pass
@@ -695,18 +706,31 @@ def bench_resnet(extras):
             raw = rt.gcs_request("kv_get", key="resnet_bench/rates",
                                  namespace="bench")
             if raw is not None:
-                up_s, comp_s = (float(v) for v in
-                                raw.decode().split(":"))
+                parts = [float(v) for v in raw.decode().split(":")]
+                up_s, comp_s = parts[0], parts[1]
                 # With upload/compute overlapped, the feed ceiling is
-                # the SLOWER of the two terms, not their sum.
-                ceiling = bs / max(up_s, comp_s)
+                # the SLOWER of the two terms, not their sum. The
+                # upload term is e2e-minus-compute on the pipeline's
+                # own predict(host_batch) path (see the probe), so the
+                # achieved rate is coherent with — and bounded by —
+                # this ceiling.
+                ceiling = bs / max(up_s, comp_s, 1e-9)
                 extras["resnet50_upload_s_per_batch"] = round(up_s, 3)
                 extras["resnet50_compute_s_per_batch"] = round(comp_s, 3)
+                if len(parts) > 2:
+                    extras["resnet50_uncontended_e2e_s_per_batch"] = \
+                        round(parts[2], 3)
                 extras["resnet50_pipeline_ceiling_img_per_s"] = round(
                     ceiling, 1)
                 extras["resnet50_pipeline_vs_ceiling"] = round(
                     extras["resnet50_pipeline_images_per_s"] / ceiling,
                     3)
+                extras["resnet50_ceiling_method"] = (
+                    "upload = uncontended e2e predict(host batch) minus "
+                    "device-resident compute, same code path as the "
+                    "pipeline (r6 fix; pre-r6 numbers timed device_put "
+                    "with buffer generation inside the timer and are "
+                    "not comparable)")
         ray_tpu.shutdown()
     except Exception as e:
         extras["resnet_bench_error"] = f"{type(e).__name__}: {e}"

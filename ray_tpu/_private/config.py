@@ -56,6 +56,13 @@ class RayConfig:
         "dashboard_port": 8265,
         # usage/telemetry opt-out (reference: RAY_USAGE_STATS_ENABLED)
         "usage_stats_enabled": False,
+        # -- telemetry plane (_private/telemetry.py; on/off itself is
+        # RAY_TPU_TELEMETRY, mirroring RAY_TPU_FAULT_CONFIG) -------------
+        # Per-worker task-event buffer capacity; overflow drops oldest
+        # with an exact counter (reference: task_event_buffer.h bound).
+        "task_event_buffer_size": 4096,
+        # Min seconds between a worker's piggybacked metrics snapshots.
+        "worker_metrics_push_interval_s": 2.0,
         # -- object spilling (reference: object_spilling_config,
         #    LocalObjectManager) -----------------------------------------
         "object_spilling_enabled": True,

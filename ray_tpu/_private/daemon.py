@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 from . import fault
 from . import protocol as P
+from . import telemetry
 from .config import ray_config
 from .ids import NodeID, WorkerID
 from .netcomm import PullManager, TransferServer, store_paths_factory
@@ -273,12 +274,29 @@ class NodeDaemon:
                 except Exception:
                     continue
             try:
-                self._send(P.NODE_PING, {
+                payload = {
                     "ts": time.time(),
                     "store_used": getattr(self.store, "used_bytes", 0),
                     "num_workers": len(self.pool.workers),
                     "free_chips": len(getattr(self, "_free_chips", ())),
-                    "pool_workers": getattr(self, "_pool_workers", 0)})
+                    "pool_workers": getattr(self, "_pool_workers", 0)}
+                if telemetry.enabled:
+                    # Metric federation: refresh this node's gauges and
+                    # piggyback the whole process-local registry on the
+                    # heartbeat (reference: the per-node MetricsAgent
+                    # scrape, collapsed onto the existing ping).
+                    try:
+                        telemetry.record_node_stats(
+                            int(payload["store_used"] or 0),
+                            payload["num_workers"],
+                            payload["free_chips"])
+                        from ..util import metrics as M
+                        payload["metrics"] = M.registry_samples()
+                        payload["metrics_ts"] = payload["ts"]
+                    except Exception:
+                        pass
+                    self._hb_sent_mono = time.monotonic()
+                self._send(P.NODE_PING, payload)
             except Exception:
                 if int(ray_config.head_reconnect_attempts) > 0:
                     # Reconnect mode: the run() loop owns rejoining;
@@ -316,6 +334,17 @@ class NodeDaemon:
             # "local_node_view" serves it without a head round trip).
             self.cluster_view = {"ts": payload.get("ts"),
                                  "view": payload.get("view") or []}
+            if telemetry.enabled:
+                # Ping->ack round trip (includes head routing time) —
+                # the cluster's control-plane health signal. One-shot
+                # pairing: clear the stamp so a late ack (or the first
+                # sync after a reconnect) can't pair with the wrong
+                # ping and record a garbage sample.
+                sent = getattr(self, "_hb_sent_mono", None)
+                self._hb_sent_mono = None
+                if sent is not None:
+                    telemetry.record_heartbeat_rtt(
+                        time.monotonic() - sent)
             return
         if msg_type in (P.TO_WORKER, P.KILL_WORKER, P.WORKER_DEDICATED,
                         P.RELEASE_OBJECTS):

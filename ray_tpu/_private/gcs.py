@@ -465,21 +465,26 @@ class Gcs:
         self.pubsub = Pubsub()
         self.start_time = time.time()
         self.node_id_hex = None  # filled by Node
-        # Task event log for state API / timeline (reference: GcsTaskManager)
+        # Task-event aggregation + metric federation live in the
+        # telemetry store (reference: GcsTaskManager per-job rings +
+        # the dashboard-side metrics aggregation; telemetry.py).
         from .config import ray_config
-        self._task_events: List[dict] = []
+        from .telemetry import TelemetryStore
         self._task_events_lock = threading.Lock()
         self.max_task_events = int(ray_config.max_task_events)
+        self.telemetry = TelemetryStore(self.max_task_events)
         # Tracing spans (reference: OpenTelemetry spans buffered per core
         # worker, flushed to the GCS task-event store; SURVEY.md §5)
         self._spans: List[dict] = []
         self.max_spans = int(ray_config.max_spans)
 
     def record_task_event(self, event: dict):
-        with self._task_events_lock:
-            self._task_events.append(event)
-            if len(self._task_events) > self.max_task_events:
-                del self._task_events[: len(self._task_events) // 2]
+        self.telemetry.record_events((event,))
+
+    def record_task_events(self, events, dropped: int = 0,
+                           from_worker: bool = False):
+        self.telemetry.record_events(events, dropped,
+                                     from_worker=from_worker)
 
     def record_spans(self, spans: List[dict]):
         with self._task_events_lock:
@@ -492,5 +497,4 @@ class Gcs:
             return list(self._spans)
 
     def task_events(self) -> List[dict]:
-        with self._task_events_lock:
-            return list(self._task_events)
+        return self.telemetry.events()

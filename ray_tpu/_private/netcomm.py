@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from . import fault
 from . import protocol as P
+from . import telemetry
 
 _MAGIC = b"RTX2"
 _NOT_FOUND = 0xFFFFFFFFFFFFFFFF
@@ -120,6 +121,8 @@ class HostCopyGate:
     def acquire(self) -> bool:
         """Admit this thread (True) or time out to an ungated copy
         (False). FIFO: earlier waiters are always admitted first."""
+        import time as _t
+        t0 = _t.monotonic() if telemetry.enabled else None
         width = self.width
         ticket = threading.Event()
         with self._lock:
@@ -135,7 +138,11 @@ class HostCopyGate:
                     admitted_late = True
             if not admitted_late:
                 self._tls.state = (False, None)
+                if t0 is not None:
+                    telemetry.record_gate_wait(_t.monotonic() - t0)
                 return False
+        if t0 is not None:
+            telemetry.record_gate_wait(_t.monotonic() - t0)
         self._tls.state = (True, self._grab_slot(width))
         return True
 
@@ -474,6 +481,8 @@ class ConnectionWriter:
         try:
             self._writev_all(self._assemble(items))
             self.frames_sent += len(items)
+            if telemetry.enabled:
+                telemetry.record_writer_batch(len(items))
         except (OSError, ValueError) as e:
             with self._cond:
                 self._error = e
@@ -827,6 +836,8 @@ class PullManager:
             except (OSError, EOFError, ConnectionError) as e:
                 if self._store.contains(object_id):
                     return  # a concurrent path landed the bytes
+                if telemetry.enabled:
+                    telemetry.record_pull_retry()
                 if next(delays, None) is None:
                     # Report what actually happened: the deadline can
                     # truncate the backoff before all attempts ran.

@@ -456,6 +456,18 @@ class HeadServer:
             handle.load = {k: payload.get(k)
                            for k in ("store_used", "num_workers",
                                      "free_chips", "pool_workers")}
+            # Metric federation: the daemon's registry snapshot rides
+            # the ping; store the latest per node for the dashboard's
+            # merged /metrics exposition (telemetry.py).
+            snap = payload.get("metrics")
+            if snap is not None:
+                try:
+                    self._node.gcs.telemetry.metrics_put(
+                        scope="node", node_id=handle.node_id_hex,
+                        worker_id=None, groups=snap,
+                        ts=payload.get("metrics_ts"))
+                except Exception:
+                    pass
             # Bidirectional sync (reference: ray_syncer.h — raylets and
             # the GCS gossip per-node resource views over a stream):
             # every heartbeat is acknowledged with the scheduler's

@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional
 from ..exceptions import ObjectStoreFullError
 from . import fault
 from . import serialization
+from . import telemetry
 from .ids import ObjectID
 
 from .config import ray_config
@@ -219,6 +220,8 @@ class ObjectStore:
                 raise
             os.close(fd)
         self.seal(object_id)
+        if telemetry.enabled:
+            telemetry.record_put_bytes(size)
         return size
 
     def seal(self, object_id: ObjectID):
@@ -384,7 +387,10 @@ class ObjectStore:
 
     def get(self, object_id: ObjectID) -> Any:
         """Deserialize an object, zero-copy for array buffers."""
-        return serialization.deserialize(self._open_view(object_id))
+        view = self._open_view(object_id)
+        if telemetry.enabled:
+            telemetry.record_get_bytes(view.nbytes)
+        return serialization.deserialize(view)
 
     def get_raw(self, object_id: ObjectID) -> memoryview:
         return self._open_view(object_id)
@@ -783,6 +789,8 @@ class ArenaObjectStore:
                 raise
             view.release()
         self.seal(object_id)
+        if telemetry.enabled:
+            telemetry.record_put_bytes(size)
         # creator pin retained: owner-driven free()/spill is the reclaim
         return size
 
@@ -994,6 +1002,8 @@ class ArenaObjectStore:
         except KeyError:
             # Not arena-resident: spilled (or gone — surfaces as OSError)
             view = self._restore_view(object_id)
+        if telemetry.enabled:
+            telemetry.record_get_bytes(view.nbytes)
         return serialization.deserialize(view)
 
     def get_raw(self, object_id: ObjectID):

@@ -49,6 +49,8 @@ GET_ACTOR = "get_actor"          # named actor lookup
 KILL_ACTOR = "kill_actor"
 GCS_REQUEST = "gcs_request"      # generic metadata op (KV, named actors, ...)
 PULL_OBJECT = "pull_object"      # worker asks its node to localize an object
+TASK_EVENTS = "task_evts"        # oneway: drained TaskEventBuffer batch
+METRICS_PUSH = "metrics_push"    # oneway: worker metrics-registry snapshot
 
 # ---------------------------------------------------------------------------
 # Message types: per-host daemon <-> head control service (TCP). The daemon

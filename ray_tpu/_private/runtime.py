@@ -33,6 +33,7 @@ from ..exceptions import (
 from . import gcs as gcs_mod
 from . import protocol as P
 from . import serialization
+from . import telemetry
 from .ids import ActorID, NodeID, ObjectID, TaskID
 from .object_store import ObjectStore, create_store, inline_threshold
 from .resources import detect_node_resources
@@ -423,6 +424,8 @@ class Node:
         self.node_registry.remove_node(handle.node_id_hex)
         self.gcs.pubsub.publish("node", {
             "event": "dead", "node_id": handle.node_id_hex})
+        # Stop re-exporting the dead node's last metrics snapshot.
+        self.gcs.telemetry.forget_node(handle.node_id_hex)
         # Mark objects lost BEFORE failing workers: retries submitted by
         # the death path must see dead-node deps as unresolved (and
         # recover them), not dispatch against locations that are gone.
@@ -743,7 +746,8 @@ class Node:
                                             incref_delta=1)
         self.gcs.record_task_event({
             "task_id": spec.task_id.hex(), "name": spec.name,
-            "state": "PENDING", "ts": time.time()})
+            "state": "PENDING_SCHEDULING", "attempt": 1,
+            "ts": time.time()})
         self.scheduler.submit(spec, self._unresolved_deps(spec))
 
     def _resolve_arg_locations(self, spec) -> None:
@@ -751,11 +755,27 @@ class Node:
             if a.kind == "ref":
                 a.location = self.gcs.objects.location(a.object_id)
 
+    def _attempt_of(self, spec) -> int:
+        """1-based attempt number from the head's retry ledger."""
+        try:
+            return self._retries_used.get(spec.task_id.binary(), 0) + 1
+        except AttributeError:
+            return 1
+
+    def _node_hex_of(self, worker) -> str:
+        return getattr(worker, "node_id_hex", None) or self.node_id.hex()
+
     def _dispatch(self, spec, worker: Optional[WorkerHandle]):
         """Scheduler callback: ship a ready task/actor-creation to a worker."""
+        # The submit-time stamp must not ride the spec onto the wire (a
+        # dynamic attr would demote every spec off the slim-pickle fast
+        # path); pop it here whether or not telemetry is on.
+        t_submit = spec.__dict__.pop("_t_submit", None)
         if isinstance(spec, P.ActorSpec):
             self._dispatch_actor_creation(spec, worker)
             return
+        if telemetry.enabled and t_submit is not None:
+            telemetry.record_dispatch_latency(time.monotonic() - t_submit)
         if worker is None:
             env_err = getattr(spec, "_env_error", None)
             err = env_err if env_err is not None else \
@@ -773,8 +793,9 @@ class Node:
         worker.last_dispatch_ts = time.time()
         self.gcs.record_task_event({
             "task_id": spec.task_id.hex(), "name": spec.name,
-            "state": "RUNNING", "worker_id": worker.worker_id.hex(),
-            "ts": time.time()})
+            "state": "SUBMITTED", "worker_id": worker.worker_id.hex(),
+            "node_id": self._node_hex_of(worker),
+            "attempt": self._attempt_of(spec), "ts": time.time()})
         try:
             # Blob handling without rebuilding the dataclass (hot path):
             # swap the field around the pickle. dispatch_lock makes
@@ -1001,7 +1022,9 @@ class Node:
             self.gcs.record_task_event({
                 "task_id": task_id.hex(), "name": spec.name,
                 "state": "FAILED" if error is not None else "FINISHED",
-                "ts": time.time()})
+                "worker_id": handle.worker_id.hex(),
+                "node_id": self._node_hex_of(handle),
+                "attempt": self._attempt_of(spec), "ts": time.time()})
             return
         if error is not None:
             if spec.retry_exceptions and self._retry_budget(spec):
@@ -1025,14 +1048,19 @@ class Node:
         self.gcs.record_task_event({
             "task_id": task_id.hex(), "name": spec.name,
             "state": "FAILED" if error is not None else "FINISHED",
-            "ts": time.time()})
+            "worker_id": handle.worker_id.hex(),
+            "node_id": self._node_hex_of(handle),
+            "attempt": self._attempt_of(spec), "ts": time.time()})
 
     def _retry_budget(self, spec: P.TaskSpec) -> bool:
+        used = self._retries_used.get(spec.task_id.binary(), 0)
         if spec.max_retries < 0:
             # -1: retry forever (reference: max_retries=-1 /
             # max_task_retries=-1 documented infinite-retry semantics).
+            # Still bump the ledger: attempt numbers on task events (and
+            # the timeline's per-attempt span dedup) read it.
+            self._retries_used[spec.task_id.binary()] = used + 1
             return True
-        used = self._retries_used.get(spec.task_id.binary(), 0)
         if used >= spec.max_retries:
             return False
         self._retries_used[spec.task_id.binary()] = used + 1
@@ -1049,6 +1077,10 @@ class Node:
         if entries and all(e is not None and e.event.is_set()
                            and e.state != gcs_mod.LOST for e in entries):
             return
+        self.gcs.record_task_event({
+            "task_id": spec.task_id.hex(), "name": spec.name,
+            "state": "PENDING_SCHEDULING",
+            "attempt": self._attempt_of(spec), "ts": time.time()})
         for rid in spec.return_ids:
             self.gcs.objects.register_pending(rid, spec)
         # Arguments lost with a dead node must be reconstructed, or the
@@ -1385,6 +1417,9 @@ class Node:
     def _on_worker_death(self, handle: WorkerHandle):
         self.pool.remove(handle)
         self.scheduler.on_worker_removed(handle)
+        # Stop re-exporting the dead worker's pushed metrics snapshot
+        # (worker churn must not grow the store or pin stale gauges).
+        self.gcs.telemetry.forget_worker(handle.worker_id.hex())
         aid = handle.dedicated_actor
         # Drain via atomic popitem: a concurrent send-failure branch in
         # _dispatch also pops, and each spec must be owned by exactly
@@ -1421,6 +1456,16 @@ class Node:
         else:
             reason = "streams are not retryable" if spec.streaming \
                 else "retries exhausted"
+            # Terminal failure with no worker left to report it: the
+            # SIGKILLed-worker case — record FAILED here (with the final
+            # attempt count) or the state API never sees it end.
+            # The attempt that just died is retries_used + 1 (the ledger
+            # counts only granted retries, so it was NOT bumped for this
+            # terminal failure).
+            self.gcs.record_task_event({
+                "task_id": spec.task_id.hex(), "name": spec.name,
+                "state": "FAILED", "attempt": self._attempt_of(spec),
+                "ts": time.time()})
             blob = serialization.dumps(WorkerCrashedError(
                 f"The worker running task {spec.name} died ({reason})."))
             if spec.streaming:
@@ -1564,12 +1609,30 @@ class Node:
                                                     spec, incref_delta=1)
                 self.gcs.record_task_event({
                     "task_id": spec.task_id.hex(), "name": spec.name,
-                    "state": "PENDING", "ts": time.time()})
+                    "state": "PENDING_SCHEDULING", "attempt": 1,
+                    "ts": time.time()})
                 items.append((spec, self._unresolved_deps(spec)))
             except BaseException as e:  # noqa: BLE001
                 self._register_submit_error(spec, e)
         if items:
             self.scheduler.submit_batch(items)
+
+    def _ingest_task_events(self, handle: WorkerHandle, payload: dict):
+        """One drained worker TaskEventBuffer batch. The head stamps the
+        attempt number at ingest (workers don't see the retry ledger):
+        events for attempt N arrive before the head grants retry N, so
+        the ledger read here is the right attempt."""
+        events = payload.get("events") or ()
+        for ev in events:
+            if "attempt" not in ev:
+                try:
+                    ev["attempt"] = self._retries_used.get(
+                        bytes.fromhex(ev["task_id"]), 0) + 1
+                except (KeyError, ValueError, TypeError):
+                    ev["attempt"] = 1
+        self.gcs.record_task_events(events,
+                                    dropped=payload.get("dropped", 0),
+                                    from_worker=True)
 
     def _on_worker_message(self, handle: WorkerHandle, msg_type: str,
                            payload: dict):
@@ -1589,6 +1652,15 @@ class Node:
             self._on_tasks_recalled(handle, payload["task_ids"])
         elif msg_type == P.GEN_ITEM:
             self._on_gen_item(handle, payload)
+        elif msg_type == P.TASK_EVENTS:
+            self._ingest_task_events(handle, payload)
+        elif msg_type == P.METRICS_PUSH:
+            self.gcs.telemetry.metrics_put(
+                scope="worker",
+                node_id=payload.get("node_id") or self.node_id.hex(),
+                worker_id=payload.get("worker_id"),
+                groups=payload.get("groups") or [],
+                ts=payload.get("ts"))
         elif msg_type == P.ACTOR_READY:
             self._on_actor_ready(handle, payload)
         elif msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS, P.GCS_REQUEST,
@@ -1782,6 +1854,10 @@ class Node:
                     for e in self.gcs.actors.list()]
         if op == "task_events":
             return self.gcs.task_events()
+        if op == "cluster_metrics":
+            return telemetry.federated_prometheus_text(self)
+        if op == "telemetry_dropped":
+            return self.gcs.telemetry.dropped_counts()
         if op == "record_spans":
             return self.gcs.record_spans(**kwargs)
         if op == "get_spans":

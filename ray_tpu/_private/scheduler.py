@@ -27,6 +27,7 @@ from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 from . import fault
 from . import protocol as P
+from . import telemetry
 from .ids import ObjectID, TaskID, WorkerID
 
 
@@ -1214,6 +1215,11 @@ class Scheduler:
 
     # -- submission --------------------------------------------------------
     def submit(self, spec: P.TaskSpec, unresolved: Set[ObjectID]):
+        if telemetry.enabled:
+            # Dispatch-latency stamp; runtime._dispatch pops it before
+            # the spec can be pickled (keeps the slim-pickle fast path).
+            import time as _time
+            spec._t_submit = _time.monotonic()
         if not unresolved and not isinstance(spec, P.ActorSpec):
             # Fast path: dispatch inline on the submitter's thread when
             # resources and an idle worker are immediately available —
@@ -1238,6 +1244,11 @@ class Scheduler:
         batching face of the multi-message framing: the transport
         delivers submissions in bursts, the scheduler absorbs them in
         bursts)."""
+        if telemetry.enabled and items:
+            import time as _time
+            now = _time.monotonic()
+            for spec, _u in items:
+                spec._t_submit = now
         queued = []
         for spec, unresolved in items:
             # Once anything has queued, FIFO forbids fast-pathing later

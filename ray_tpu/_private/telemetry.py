@@ -1,0 +1,480 @@
+"""Cluster-wide telemetry plane: task lifecycle events + metric federation.
+
+Reference parity: the reference's observability stack — per-worker task
+event buffers flushed to the GCS-side task manager
+(src/ray/core_worker/task_event_buffer.h -> gcs_task_manager.cc), the
+per-node MetricsAgent federating each process's metrics into one
+Prometheus exposition (_private/metrics_agent.py, prometheus_exporter.py),
+and the dashboard/state API answering ``ray list tasks`` from that
+aggregated state (SURVEY §2.2, §5).
+
+Architecture (no new connections — everything piggybacks on the existing
+control plane):
+
+  * **Task events** — every worker keeps a bounded :class:`TaskEventBuffer`
+    of lifecycle transitions (RUNNING -> FINISHED/FAILED with monotonic
+    wall timestamps, node/worker ids). Buffers flush as one ``TASK_EVENTS``
+    message enqueued on the PR 2 per-connection writer immediately before
+    the task's completion message, so the events ride the SAME vectored
+    write as the TASK_DONE — zero extra syscalls even when enabled. The
+    head records PENDING_SCHEDULING / SUBMITTED / FAILED-with-attempt
+    transitions itself (it owns scheduling and retry state). Drop-oldest
+    under pressure with an exact ``dropped`` counter; recording never
+    blocks the hot path.
+
+  * **Metric federation** — each node daemon snapshots its process-local
+    ``util/metrics.py`` registry into the NODE_PING heartbeat; workers
+    piggyback a throttled ``METRICS_PUSH`` on task completion. The head
+    aggregates the snapshots in :class:`TelemetryStore` and re-exports
+    one merged Prometheus exposition with ``node_id`` / ``worker_id``
+    tags (:func:`federated_prometheus_text`), served by the dashboard's
+    ``/metrics`` and the ``ray_tpu metrics`` CLI.
+
+  * **Hot-path instrumentation** — scheduler queue depth + dispatch
+    latency, writer coalescing batch size, host-copy-gate wait, store
+    put/get bytes, pull retries, heartbeat RTT. Every site is gated on a
+    single module-attribute truthiness check (``telemetry.enabled`` —
+    the exact discipline of ``fault.py``), so the disabled hot path pays
+    one dict lookup and performs no additional work (asserted by the
+    ``perf_smoke`` guard in tests/test_observability.py).
+
+Enable/disable via the ``RAY_TPU_TELEMETRY`` env var (default on) or
+:func:`configure`; the setting propagates to spawned daemons and workers
+through the environment, like RAY_TPU_FAULT_CONFIG.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_ENV_VAR = "RAY_TPU_TELEMETRY"
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(_ENV_VAR, "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+# Hot-path gate: module attribute looked up as `telemetry.enabled` (one
+# dict lookup); every instrumentation site checks it before doing ANY
+# telemetry work (same discipline as fault.enabled).
+enabled = _env_enabled()
+
+# Counter of instrumentation-helper invocations in THIS process — the
+# perf_smoke guard's counter-based proxy for "the disabled path did no
+# telemetry work": every helper below increments it, so a run with
+# telemetry off must leave it untouched.
+_ops = 0
+
+
+def configure(on: bool, propagate_env: bool = True) -> None:
+    """Flip the plane on/off for this process; with ``propagate_env``
+    the setting is mirrored into RAY_TPU_TELEMETRY so spawned daemons
+    and workers inherit it."""
+    global enabled
+    enabled = bool(on)
+    if propagate_env:
+        os.environ[_ENV_VAR] = "1" if on else "0"
+
+
+def instrument_ops() -> int:
+    """Instrumentation helper invocations so far (perf_smoke guard)."""
+    return _ops
+
+
+# ---------------------------------------------------------------------------
+# metric helpers (process-local util/metrics registry, lazily created so
+# a disabled process never materializes a single Metric object)
+# ---------------------------------------------------------------------------
+_LAT_BOUNDS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+_BATCH_BOUNDS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 512.0)
+
+
+_metric_create_lock = threading.Lock()
+
+
+def _metric(name: str, kind: str, desc: str = "",
+            boundaries: Optional[Tuple[float, ...]] = None,
+            tag_keys: Optional[Tuple[str, ...]] = None):
+    from ..util import metrics as M
+    m = M._REGISTRY.get(name)  # GIL-safe read; the common hot case
+    if m is not None:
+        return m
+    # Double-checked create under OUR lock (Metric.__init__ registers
+    # last-writer-wins, so two concurrent constructors would silently
+    # orphan one object's samples).
+    with _metric_create_lock:
+        m = M._REGISTRY.get(name)
+        if m is None:
+            if kind == "counter":
+                m = M.Counter(name, desc, tag_keys=tag_keys)
+            elif kind == "gauge":
+                m = M.Gauge(name, desc, tag_keys=tag_keys)
+            else:
+                m = M.Histogram(name, desc, boundaries=list(
+                    boundaries or _LAT_BOUNDS), tag_keys=tag_keys)
+    return m
+
+
+def record_dispatch_latency(dt: float) -> None:
+    """Submit -> dispatch latency of one task (scheduler hot path)."""
+    global _ops
+    _ops += 1
+    _metric("scheduler_dispatch_latency_s", "histogram",
+            "Task latency from scheduler submit to worker dispatch"
+            ).observe(max(dt, 1e-9))
+
+
+def record_queue_depth(n: int) -> None:
+    global _ops
+    _ops += 1
+    _metric("scheduler_queue_depth", "gauge",
+            "Tasks queued or dependency-parked in the scheduler").set(n)
+
+
+def record_writer_batch(n: int) -> None:
+    """Messages coalesced into one vectored write by a ConnectionWriter."""
+    global _ops
+    _ops += 1
+    _metric("writer_coalesce_batch_size", "histogram",
+            "Messages shipped per connection-writer vectored write",
+            boundaries=_BATCH_BOUNDS).observe(float(n))
+
+
+def record_gate_wait(dt: float) -> None:
+    global _ops
+    _ops += 1
+    _metric("host_copy_gate_wait_s", "histogram",
+            "Time big copies queued for host-copy-gate admission"
+            ).observe(max(dt, 1e-9))
+
+
+def record_put_bytes(n: int) -> None:
+    global _ops
+    _ops += 1
+    if n > 0:  # Counter.inc rejects 0; zero-byte objects add nothing
+        _metric("store_put_bytes_total", "counter",
+                "Bytes written into the local object store").inc(n)
+
+
+def record_get_bytes(n: int) -> None:
+    global _ops
+    _ops += 1
+    if n > 0:
+        _metric("store_get_bytes_total", "counter",
+                "Bytes read from the local object store").inc(n)
+
+
+def record_pull_retry() -> None:
+    global _ops
+    _ops += 1
+    _metric("store_pull_retries_total", "counter",
+            "Transient-failure retries of cross-node object pulls").inc()
+
+
+def record_heartbeat_rtt(dt: float) -> None:
+    """Daemon-side: NODE_PING send -> NODE_SYNC ack round trip."""
+    global _ops
+    _ops += 1
+    _metric("node_heartbeat_rtt_s", "histogram",
+            "Daemon heartbeat round-trip time to the head"
+            ).observe(max(dt, 1e-9))
+
+
+def record_node_stats(store_used: int, num_workers: int,
+                      free_chips: int) -> None:
+    """Per-node gauges refreshed on each daemon heartbeat tick."""
+    global _ops
+    _ops += 1
+    _metric("object_store_used_bytes", "gauge",
+            "Bytes resident in this node's object store").set(store_used)
+    _metric("node_num_workers", "gauge",
+            "Worker processes alive on this node").set(num_workers)
+    _metric("node_free_tpu_chips", "gauge",
+            "Unassigned TPU chips on this node").set(free_chips)
+
+
+# -- serve plane ------------------------------------------------------------
+_serve_inflight_lock = threading.Lock()
+_serve_inflight: Dict[str, int] = {}
+
+
+def serve_inflight(deployment: str, delta: int) -> None:
+    global _ops
+    _ops += 1
+    with _serve_inflight_lock:
+        n = _serve_inflight.get(deployment, 0) + delta
+        _serve_inflight[deployment] = max(n, 0)
+    _metric("serve_inflight_requests", "gauge",
+            "In-flight HTTP requests per deployment",
+            tag_keys=("deployment",)).set(
+                max(n, 0), tags={"deployment": deployment})
+
+
+def serve_request(deployment: str, dt: float) -> None:
+    global _ops
+    _ops += 1
+    _metric("serve_request_latency_s", "histogram",
+            "End-to-end proxy request latency per deployment",
+            tag_keys=("deployment",)).observe(
+                max(dt, 1e-9), tags={"deployment": deployment})
+
+
+def serve_replica_request(deployment: str, dt: float) -> None:
+    global _ops
+    _ops += 1
+    _metric("serve_replica_latency_s", "histogram",
+            "Replica-side request handling latency per deployment",
+            tag_keys=("deployment",)).observe(
+                max(dt, 1e-9), tags={"deployment": deployment})
+
+
+def serve_replica_ongoing(deployment: str, n: int) -> None:
+    global _ops
+    _ops += 1
+    _metric("serve_replica_ongoing_requests", "gauge",
+            "Requests currently executing in this replica",
+            tag_keys=("deployment",)).set(
+                float(n), tags={"deployment": deployment})
+
+
+# ---------------------------------------------------------------------------
+# worker/daemon-side task event buffer
+# ---------------------------------------------------------------------------
+class TaskEventBuffer:
+    """Bounded, drop-oldest buffer of task lifecycle events (reference:
+    core_worker/task_event_buffer.h — bounded, periodically flushed,
+    drops with an explicit counter rather than blocking the task loop).
+    Thread-safe; record() is a deque append under a lock (no syscalls,
+    no allocation beyond the event dict the caller built)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            from .config import ray_config
+            capacity = int(ray_config.task_event_buffer_size)
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque()
+        self.dropped = 0  # total dropped since the last drain()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def record(self, **event) -> None:
+        with self._lock:
+            if len(self._events) >= self.capacity:
+                self._events.popleft()
+                self.dropped += 1
+            self._events.append(event)
+
+    def drain(self) -> Tuple[List[dict], int]:
+        """Pop everything buffered; returns (events, dropped_since_last).
+        Exact accounting: every record beyond capacity since the last
+        drain is counted in `dropped` exactly once."""
+        with self._lock:
+            events = list(self._events)
+            self._events.clear()
+            dropped, self.dropped = self.dropped, 0
+        return events, dropped
+
+
+# ---------------------------------------------------------------------------
+# head-side aggregator
+# ---------------------------------------------------------------------------
+_DEFAULT_JOB = "default"
+
+
+class TelemetryStore:
+    """GCS-side aggregate: bounded per-job rings of task events plus the
+    latest metrics snapshot per node/worker (reference: GcsTaskManager's
+    per-job ring buffers, gcs_task_manager.cc; the dashboard's metrics
+    federation)."""
+
+    def __init__(self, max_events_per_job: int = 10_000):
+        self.max_events_per_job = max(1, int(max_events_per_job))
+        self._lock = threading.Lock()
+        self._rings: Dict[str, collections.deque] = {}
+        self._dropped: Dict[str, int] = {}
+        # ("node"|"worker", key_hex) -> snapshot dict
+        self._metrics: Dict[Tuple[str, str], dict] = {}
+        # Exact counts for the drop/ingest accounting tests + /metrics.
+        self.events_ingested = 0
+        self.events_ingested_from_workers = 0
+        self.worker_reported_dropped = 0
+
+    # -- task events ---------------------------------------------------
+    def record_events(self, events, dropped: int = 0,
+                      from_worker: bool = False) -> None:
+        with self._lock:
+            for ev in events:
+                job = ev.get("job_id") or _DEFAULT_JOB
+                ring = self._rings.get(job)
+                if ring is None:
+                    ring = collections.deque()
+                    self._rings[job] = ring
+                if len(ring) >= self.max_events_per_job:
+                    ring.popleft()
+                    self._dropped[job] = self._dropped.get(job, 0) + 1
+                ring.append(ev)
+                self.events_ingested += 1
+                if from_worker:
+                    self.events_ingested_from_workers += 1
+            if dropped:
+                self.worker_reported_dropped += int(dropped)
+
+    def events(self, job_id: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            if job_id is not None:
+                return list(self._rings.get(job_id, ()))
+            rings = [list(r) for r in self._rings.values()]
+        if len(rings) == 1:
+            return rings[0]
+        out = [ev for ring in rings for ev in ring]
+        out.sort(key=lambda ev: ev.get("ts", 0.0))
+        return out
+
+    def dropped_counts(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._dropped)
+        out["_worker_buffers"] = self.worker_reported_dropped
+        return out
+
+    # -- metrics snapshots ---------------------------------------------
+    def metrics_put(self, scope: str, node_id: Optional[str],
+                    worker_id: Optional[str], groups: List[dict],
+                    ts: Optional[float] = None) -> None:
+        key = (scope, worker_id if scope == "worker" else (node_id or ""))
+        with self._lock:
+            self._metrics[key] = {
+                "node_id": node_id, "worker_id": worker_id,
+                "groups": groups, "ts": ts or time.time()}
+
+    def metrics_snapshots(self, max_age_s: Optional[float] = None
+                          ) -> List[dict]:
+        now = time.time()
+        with self._lock:
+            snaps = list(self._metrics.values())
+        if max_age_s is not None:
+            snaps = [s for s in snaps if now - s["ts"] <= max_age_s]
+        return snaps
+
+    def forget_node(self, node_id_hex: str) -> None:
+        """Drop a dead node's snapshots so /metrics stops re-exporting
+        stale samples for it."""
+        with self._lock:
+            for key in [k for k, v in self._metrics.items()
+                        if v.get("node_id") == node_id_hex]:
+                self._metrics.pop(key, None)
+
+    def forget_worker(self, worker_id_hex: str) -> None:
+        """Drop a dead worker's snapshot — without this, worker churn
+        (OOM kills, actor restarts) grows the store without bound and
+        /metrics keeps exporting the dead replica's last gauges."""
+        with self._lock:
+            self._metrics.pop(("worker", worker_id_hex), None)
+
+
+# ---------------------------------------------------------------------------
+# federation / exposition
+# ---------------------------------------------------------------------------
+def _render_groups(tagged_groups) -> str:
+    """One Prometheus text exposition from [(group, extra_tags)] where
+    `group` is a util.metrics.registry_samples() entry. Samples of the
+    same metric name from different sources merge under one HELP/TYPE
+    header (required by the exposition format)."""
+    order: List[str] = []
+    merged: Dict[str, Tuple[str, str, List]] = {}
+    for group, extra in tagged_groups:
+        name = group.get("name")
+        if not name:
+            continue
+        ent = merged.get(name)
+        if ent is None:
+            ent = (group.get("type", "untyped"), group.get("help", ""), [])
+            merged[name] = ent
+            order.append(name)
+        for sample in group.get("samples", ()):
+            try:
+                sname, tags, value = sample
+            except (TypeError, ValueError):
+                continue
+            t = dict(tags or {})
+            t.update(extra)
+            ent[2].append((sname, t, value))
+    from ..util.metrics import format_sample
+    lines: List[str] = []
+    for name in order:
+        mtype, mhelp, samples = merged[name]
+        lines.append(f"# HELP {name} {mhelp}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for sname, tags, value in samples:
+            lines.append(format_sample(sname, tags, value))
+    return "\n".join(lines) + "\n"
+
+
+def _refresh_head_gauges(node) -> None:
+    """Point-in-time head gauges set at exposition time — zero hot-path
+    cost: nothing is tracked continuously, the values are read off the
+    live runtime when someone actually scrapes."""
+    try:
+        record_queue_depth(node.scheduler.queue_depth())
+    except Exception:
+        pass
+    try:
+        record_node_stats(
+            int(getattr(node.store, "used_bytes", 0) or 0),
+            len(node.pool.workers),
+            len(getattr(node.scheduler, "_free_chips", ())))
+    except Exception:
+        pass
+    try:
+        tstore = node.gcs.telemetry
+        _metric("task_events_ingested_total_gauge", "gauge",
+                "Task lifecycle events aggregated on the head"
+                ).set(tstore.events_ingested)
+        _metric("task_events_dropped", "gauge",
+                "Task events dropped across rings and worker buffers"
+                ).set(sum(tstore.dropped_counts().values()))
+    except Exception:
+        pass
+
+
+def federated_prometheus_text(node) -> str:
+    """The cluster-wide exposition: the head's process-local registry
+    tagged with the head's node id, merged with the latest snapshot
+    pushed by every daemon (NODE_PING) and worker (METRICS_PUSH)."""
+    from ..util import metrics as M
+    if not enabled:
+        return M.prometheus_text()
+    _refresh_head_gauges(node)
+    head_hex = node.node_id.hex()
+    tagged = [(g, {"node_id": head_hex}) for g in M.registry_samples()]
+    for snap in node.gcs.telemetry.metrics_snapshots():
+        extra = {}
+        if snap.get("node_id"):
+            extra["node_id"] = snap["node_id"]
+        if snap.get("worker_id"):
+            extra["worker_id"] = snap["worker_id"]
+        tagged.extend((g, extra) for g in snap.get("groups", ()))
+    return _render_groups(tagged)
+
+
+def cluster_metrics_text() -> str:
+    """Entry point for the dashboard /metrics and the CLI: federated
+    when this process hosts the head runtime, process-local otherwise."""
+    from . import state as _state
+    node = _state.get_node()
+    if node is None or not hasattr(node, "gcs"):
+        from ..util.metrics import prometheus_text
+        return prometheus_text()
+    return federated_prometheus_text(node)
+
+
+__all__ = ["TaskEventBuffer", "TelemetryStore", "cluster_metrics_text",
+           "configure", "enabled", "federated_prometheus_text",
+           "instrument_ops"]

@@ -40,6 +40,7 @@ from ..exceptions import TaskCancelledError, TaskError
 from . import fault
 from . import protocol as P
 from . import serialization
+from . import telemetry
 from .ids import ActorID, ObjectID, TaskID
 from .object_store import ObjectStore, create_store, inline_threshold
 
@@ -224,6 +225,11 @@ class Worker:
         self._done_lock = threading.Lock()
         self._done_buf: list = []
         self._done_flushing = False
+        # Telemetry plane: bounded lifecycle-event buffer, drained as a
+        # TASK_EVENTS message enqueued right before each completion so
+        # both ride ONE writer wakeup / vectored write (telemetry.py).
+        self._task_events = telemetry.TaskEventBuffer()
+        self._metrics_last_push = 0.0
         # Actor state
         self._actor_instance = None
         self._actor_spec: Optional[P.ActorSpec] = None
@@ -365,6 +371,46 @@ class Worker:
             index += 1
         return index
 
+    def _record_task_event(self, spec: P.TaskSpec, state: str, ts: float,
+                           start_ts: Optional[float] = None):
+        """Buffer one lifecycle transition (lock + deque append — no
+        syscalls; callers gate on telemetry.enabled)."""
+        ev = {"task_id": spec.task_id.hex(), "name": spec.name,
+              "state": state, "ts": ts, "src": "worker",
+              "node_id": self.config.node_id_hex,
+              "worker_id": self.config.worker_id.hex()}
+        if start_ts is not None:
+            # Same-clock span bounds: the timeline pairs start_ts/ts
+            # without mixing worker and head clocks.
+            ev["start_ts"] = start_ts
+        self._task_events.record(**ev)
+
+    def _flush_telemetry(self):
+        """Drain buffered events (+ a throttled metrics snapshot) onto
+        the writer queue. Called right before a completion send, so the
+        frames coalesce into the SAME vectored write — the piggyback
+        that makes enabled-mode flushing syscall-free. Failures never
+        break completion delivery."""
+        try:
+            events, dropped = self._task_events.drain()
+            if events or dropped:
+                self.send(P.TASK_EVENTS,
+                          {"events": events, "dropped": dropped})
+            from .config import ray_config
+            now = time.monotonic()
+            if (now - self._metrics_last_push
+                    >= float(ray_config.worker_metrics_push_interval_s)):
+                self._metrics_last_push = now
+                from ..util import metrics as M
+                groups = M.registry_samples()
+                if groups:
+                    self.send(P.METRICS_PUSH, {
+                        "worker_id": self.config.worker_id.hex(),
+                        "node_id": self.config.node_id_hex,
+                        "groups": groups, "ts": time.time()})
+        except Exception:
+            pass
+
     def _emit_done(self, payload: dict):
         """Ship one task's completion with group-commit coalescing:
         every completion flushes immediately UNLESS another thread is
@@ -372,6 +418,8 @@ class Worker:
         drains it in the same TASKS_DONE frame. Batching emerges only
         under genuine completion bursts — a lone task (or a fast task
         next to slow siblings) never waits."""
+        if telemetry.enabled:
+            self._flush_telemetry()
         with self._done_lock:
             self._done_buf.append(payload)
             if self._done_flushing:
@@ -425,6 +473,10 @@ class Worker:
                 self._cancelled_pending.discard(tid)
                 return
             self._running[tid] = threading.get_ident()
+        run_ts = None
+        if telemetry.enabled:
+            run_ts = time.time()
+            self._record_task_event(spec, "RUNNING", run_ts)
         ctx_token = _task_ctx_var.set(spec)
         trace_token = None
         exec_span = None
@@ -478,11 +530,17 @@ class Worker:
                     result = asyncio.run(result)
             if spec.streaming:
                 n_items = self._stream_generator(spec, result)
+                if telemetry.enabled:
+                    self._record_task_event(spec, "FINISHED", time.time(),
+                                            start_ts=run_ts)
                 self._emit_done({
                     "task_id": spec.task_id, "results": [], "error": None,
                     "streamed": n_items, "actor_id": spec.actor_id})
             else:
                 locs, nested = self._package_returns(spec, result)
+                if telemetry.enabled:
+                    self._record_task_event(spec, "FINISHED", time.time(),
+                                            start_ts=run_ts)
                 self._emit_done({
                     "task_id": spec.task_id, "results": locs,
                     "error": None, "nested": nested,
@@ -510,6 +568,9 @@ class Worker:
             except Exception:
                 blob = serialization.dumps(
                     TaskError(RuntimeError(repr(e)), task_repr=spec.name))
+            if telemetry.enabled:
+                self._record_task_event(spec, "FAILED", time.time(),
+                                        start_ts=run_ts)
             self._emit_done({
                 "task_id": spec.task_id, "results": None, "error": blob,
                 "actor_id": spec.actor_id})

@@ -105,11 +105,17 @@ def _routes() -> Dict[str, Any]:
         # reference dashboard modules: healthz, reporter (node stats),
         # serve, log — collapsed to JSON routes.
         "/api/healthz": lambda: {"status": "ok"},
+        "/api/usage": _usage_record,
         "/api/object_store": _object_store_stats,
         "/api/memory": _memory_stats,
         "/api/serve": _serve_status,
         "/api/logs": _log_files,
     }
+
+
+def _usage_record():
+    from .._private.usage import build_usage_record
+    return build_usage_record()
 
 
 def _object_store_stats():
@@ -175,8 +181,11 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
                 if path == "/":
                     self._send(_INDEX_HTML.encode(), "text/html")
                 elif path == "/metrics":
-                    from ..util.metrics import prometheus_text
-                    self._send(prometheus_text().encode(),
+                    # Federated exposition: this process's registry plus
+                    # the latest snapshot from every node daemon and
+                    # worker, node_id/worker_id-tagged (telemetry.py).
+                    from .._private.telemetry import cluster_metrics_text
+                    self._send(cluster_metrics_text().encode(),
                                "text/plain; version=0.0.4")
                 elif path in routes:
                     body = json.dumps(routes[path](), default=str)

@@ -154,6 +154,16 @@ def cmd_summary(args):
     return 0
 
 
+def cmd_metrics(args):
+    """Print the cluster's federated Prometheus exposition: the head's
+    metrics plus every node's and worker's latest snapshot, tagged with
+    node_id/worker_id (reference: the dashboard /metrics endpoint the
+    MetricsAgent fleet feeds)."""
+    call = _backend(args)
+    sys.stdout.write(call("cluster_metrics"))
+    return 0
+
+
 def cmd_timeline(args):
     call = _backend(args)
     events = call("timeline")
@@ -331,6 +341,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("summary", help="task/actor/object summaries")
     add_address(sp)
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("metrics", help="federated cluster metrics "
+                        "(Prometheus text, node_id/worker_id tagged)")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_metrics)
 
     sp = sub.add_parser("timeline", help="export Chrome-trace timeline")
     sp.add_argument("-o", "--output", default=None)

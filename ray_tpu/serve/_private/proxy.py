@@ -14,8 +14,10 @@ ThreadingHTTPServer weakness)."""
 import asyncio
 import json
 import threading
+import time
 from typing import Dict, Optional
 
+from ray_tpu._private import telemetry
 from .long_poll import LongPollClient
 
 
@@ -194,6 +196,29 @@ class HTTPProxy:
         self._started.set()
 
     async def _handle(self, request):
+        """Instrumented entry: request-latency histogram + in-flight
+        gauge per deployment from the telemetry plane (reference:
+        serve_num_http_requests / processing-latency metrics on the
+        proxy). One falsy-flag check when telemetry is off; the route
+        is matched ONCE here and handed to the inner handler (matching
+        twice would double the lock + table scan per request and could
+        mislabel pre-long-poll requests as unmatched)."""
+        if not telemetry.enabled:
+            return await self._handle_inner(request)
+        path = request.path
+        if path in ("/-/healthz", "/-/routes"):
+            return await self._handle_inner(request)
+        target = self._state.match(path)
+        dep = target[1] if target else "_unmatched"
+        t0 = time.monotonic()
+        telemetry.serve_inflight(dep, 1)
+        try:
+            return await self._handle_inner(request, target)
+        finally:
+            telemetry.serve_inflight(dep, -1)
+            telemetry.serve_request(dep, time.monotonic() - t0)
+
+    async def _handle_inner(self, request, _target=None):
         from aiohttp import web
         path = request.path
         if path == "/-/healthz":
@@ -202,7 +227,8 @@ class HTTPProxy:
             with self._state._lock:
                 return web.json_response(
                     {p: t[0] for p, t in self._state._routes.items()})
-        target = self._state.match(path)
+        target = _target if _target is not None \
+            else self._state.match(path)
         if target is None:
             return web.json_response({"error": "no route"}, status=404)
         app_name, deployment, matched_prefix = target
@@ -322,7 +348,7 @@ class HTTPProxy:
                 # impossible.
                 self._modes.pop(mode_key, None)
                 self._asgi.pop(mode_key, None)
-                return await self._handle(request)
+                return await self._handle_inner(request)
             return web.json_response({"error": str(e)}, status=500)
         # Streaming: one chunk per generator item (reference: streaming
         # responses through the proxy over ASGI).

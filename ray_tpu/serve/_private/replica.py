@@ -11,9 +11,11 @@ compiles a handful of bucket shapes once.
 """
 import asyncio
 import inspect
+import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu._private import telemetry
 
 
 class StreamingResponseRequired(Exception):
@@ -85,6 +87,14 @@ class Replica:
         """
         from ..multiplex import _set_request_model_id
         self._ongoing += 1
+        t0 = None
+        if telemetry.enabled:
+            # Replica-side dispatch metrics: these live in the worker
+            # process's registry and reach the head via the piggybacked
+            # METRICS_PUSH (telemetry.py metric federation).
+            t0 = time.monotonic()
+            telemetry.serve_replica_ongoing(self._deployment_name,
+                                            self._ongoing)
         _set_request_model_id(multiplexed_model_id)
         try:
             # Proxy HTTP requests carry a __trim__ marker when a learned
@@ -132,6 +142,11 @@ class Replica:
             return result
         finally:
             self._ongoing -= 1
+            if t0 is not None:
+                telemetry.serve_replica_request(self._deployment_name,
+                                                time.monotonic() - t0)
+                telemetry.serve_replica_ongoing(self._deployment_name,
+                                                self._ongoing)
 
     def _resolve_target(self, method_name: str):
         if inspect.isfunction(self._callable) or inspect.ismethod(

@@ -34,6 +34,7 @@ def registry() -> Dict[str, Callable[..., Any]]:
         "summarize_actors": state.summarize_actors,
         "summarize_objects": state.summarize_objects,
         "timeline": lambda: state.timeline(filename=None),
+        "cluster_metrics": _cluster_metrics,
         "job_submit": lambda **kw: job_client().submit_job(**kw),
         "job_status": lambda job_id: job_client().get_job_status(job_id),
         "job_logs": lambda job_id: job_client().get_job_logs(job_id),
@@ -45,6 +46,13 @@ def registry() -> Dict[str, Callable[..., Any]]:
         "serve_status": _serve_status,
         "serve_shutdown": _serve_shutdown,
     }
+
+
+def _cluster_metrics() -> str:
+    """Federated Prometheus text (telemetry.py): head registry + every
+    node's / worker's latest pushed snapshot, node/worker tagged."""
+    from ray_tpu._private.telemetry import cluster_metrics_text
+    return cluster_metrics_text()
 
 
 def _serve_deploy(config: dict):

@@ -131,6 +131,27 @@ class Histogram(Metric):
         return out
 
 
+def escape_label_value(value) -> str:
+    """Prometheus exposition-format label-value escaping (backslash,
+    quote, newline) — tag values can carry user-controlled strings
+    (deployment names, routes), and one bad character must not break
+    the whole scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_sample(name: str, tags: Optional[Dict[str, str]],
+                  value) -> str:
+    """Render ONE exposition sample line — the single formatter shared
+    by the process-local text endpoint and the cluster-wide federation
+    (_private/telemetry.py)."""
+    if tags:
+        tag_s = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in sorted(tags.items()))
+        return f"{name}{{{tag_s}}} {value}"
+    return f"{name} {value}"
+
+
 def prometheus_text() -> str:
     """Standard Prometheus text exposition of all registered metrics
     (reference: _private/prometheus_exporter.py)."""
@@ -141,13 +162,29 @@ def prometheus_text() -> str:
         lines.append(f"# HELP {m._name} {m._desc}")
         lines.append(f"# TYPE {m._name} {m.TYPE}")
         for name, tags, value in m._samples():
-            if tags:
-                tag_s = ",".join(f'{k}="{v}"'
-                                 for k, v in sorted(tags.items()))
-                lines.append(f"{name}{{{tag_s}}} {value}")
-            else:
-                lines.append(f"{name} {value}")
+            lines.append(format_sample(name, tags, value))
     return "\n".join(lines) + "\n"
+
+
+def registry_samples() -> List[Dict]:
+    """Picklable snapshot of every registered metric — the unit of the
+    cluster-wide metric federation (reference: what the per-node
+    MetricsAgent scrapes from each process). Each entry:
+    ``{"name", "type", "help", "samples": [(name, tags, value), ...]}``;
+    daemons ship this on heartbeats and workers piggyback it on task
+    completion (_private/telemetry.py), and the head re-exports the
+    merged set with node_id/worker_id tags."""
+    with _REG_LOCK:
+        ms = list(_REGISTRY.values())
+    out = []
+    for m in ms:
+        try:
+            samples = m._samples()
+        except Exception:
+            continue
+        out.append({"name": m._name, "type": m.TYPE, "help": m._desc,
+                    "samples": samples})
+    return out
 
 
 _server = None
@@ -192,5 +229,6 @@ def clear_registry():
 
 
 __all__ = ["Counter", "Gauge", "Histogram", "Metric", "clear_registry",
-           "prometheus_text", "start_metrics_server",
+           "escape_label_value", "format_sample", "prometheus_text",
+           "registry_samples", "start_metrics_server",
            "stop_metrics_server"]

@@ -31,24 +31,52 @@ def _match(row: Dict[str, Any], filters) -> bool:
     return True
 
 
+_TERMINAL = ("FINISHED", "FAILED")
+
+
 def list_tasks(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
-    """Latest state per task (reference: state/api.py list_tasks)."""
+    """Latest state per task from the cluster-wide event aggregator
+    (reference: state/api.py list_tasks over GcsTaskManager). Rows carry
+    node/worker ids and the attempt number once events for them arrive
+    (head-side SUBMITTED always has them; worker RUNNING/FINISHED land
+    via the telemetry plane). A terminal event beats a non-terminal one
+    regardless of source-clock ordering (worker and head clocks can
+    disagree across hosts)."""
     events = _gcs("task_events")
     latest: Dict[str, Dict[str, Any]] = {}
     first_ts: Dict[str, float] = {}
+    enrich: Dict[str, Dict[str, Any]] = {}
     for ev in events:
         tid = ev["task_id"]
         first_ts.setdefault(tid, ev["ts"])
+        e = enrich.setdefault(tid, {})
+        for key in ("node_id", "worker_id", "attempt"):
+            if ev.get(key) is not None:
+                e[key] = ev[key]
         cur = latest.get(tid)
-        if cur is None or ev["ts"] >= cur["ts"]:
+        if cur is None:
+            latest[tid] = ev
+            continue
+        # Rank (terminal, attempt, ts): a later ATTEMPT beats an earlier
+        # one even when both are terminal — attempts are stamped by the
+        # head's ledger, so retried-then-succeeded tasks resolve
+        # correctly regardless of cross-host clock skew; ts only breaks
+        # ties within one attempt.
+        if ((ev.get("state") in _TERMINAL, ev.get("attempt") or 0,
+             ev["ts"])
+                >= (cur.get("state") in _TERMINAL,
+                    cur.get("attempt") or 0, cur["ts"])):
             latest[tid] = ev
     rows = []
     for tid, ev in latest.items():
+        e = enrich.get(tid, {})
         row = {"task_id": tid, "name": ev.get("name"),
                "state": ev.get("state"),
-               "worker_id": ev.get("worker_id"),
+               "worker_id": ev.get("worker_id") or e.get("worker_id"),
+               "node_id": ev.get("node_id") or e.get("node_id"),
+               "attempt": ev.get("attempt") or e.get("attempt"),
                "start_time": first_ts.get(tid), "end_time": ev["ts"]
-               if ev.get("state") in ("FINISHED", "FAILED") else None}
+               if ev.get("state") in _TERMINAL else None}
         if _match(row, filters):
             rows.append(row)
         if len(rows) >= limit:
@@ -109,26 +137,54 @@ def summarize_objects() -> Dict[str, int]:
 
 # -- timeline ---------------------------------------------------------------
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Chrome-trace export of task execution spans (reference:
-    ray.timeline, _private/state.py — consumed at chrome://tracing)."""
+    """Chrome-trace export of task execution spans across ALL nodes
+    (reference: ray.timeline, _private/state.py — open in perfetto).
+    Rows (pid) are nodes, threads (tid) are workers. Worker-reported
+    terminal events carry same-clock ``start_ts`` bounds, so spans never
+    mix two hosts' clocks; head-only events (telemetry disabled, or a
+    worker that died mid-task) fall back to SUBMITTED/RUNNING ->
+    terminal pairing on the head's clock."""
     events = _gcs("task_events")
     runs: Dict[str, Dict[str, Any]] = {}
+    spanned = set()
     trace: List[Dict[str, Any]] = []
+
+    def _emit(tid, start_ts, end_ev):
+        trace.append({
+            "name": end_ev.get("name") or tid[:8],
+            "cat": "task", "ph": "X",
+            "ts": start_ts * 1e6,
+            "dur": max(0.0, (end_ev["ts"] - start_ts)) * 1e6,
+            "pid": (end_ev.get("node_id") or "ray_tpu")[:8],
+            "tid": (end_ev.get("worker_id") or "driver")[:8],
+            "args": {"task_id": tid, "state": end_ev["state"],
+                     "attempt": end_ev.get("attempt")},
+        })
+
     for ev in events:
         tid = ev["task_id"]
-        if ev["state"] == "RUNNING":
-            runs[tid] = ev
-        elif ev["state"] in ("FINISHED", "FAILED") and tid in runs:
-            start = runs.pop(tid)
-            trace.append({
-                "name": ev.get("name") or tid[:8],
-                "cat": "task", "ph": "X",
-                "ts": start["ts"] * 1e6,
-                "dur": max(0.0, (ev["ts"] - start["ts"])) * 1e6,
-                "pid": "ray_tpu",
-                "tid": start.get("worker_id", "driver")[:8],
-                "args": {"task_id": tid, "state": ev["state"]},
-            })
+        state = ev["state"]
+        if state in ("RUNNING", "SUBMITTED"):
+            cur = runs.get(tid)
+            # RUNNING (worker-side actual start) refines SUBMITTED.
+            if cur is None or state == "RUNNING":
+                runs[tid] = ev
+        elif state in _TERMINAL:
+            if ev.get("start_ts") is not None:
+                # Same-clock bounds straight from the worker.
+                _emit(tid, ev["start_ts"], ev)
+                spanned.add((tid, ev.get("attempt")))
+                runs.pop(tid, None)
+            elif tid in runs:
+                if (tid, ev.get("attempt")) in spanned:
+                    runs.pop(tid, None)
+                    continue  # worker span already emitted for this try
+                start = runs.pop(tid)
+                merged = dict(ev)
+                for key in ("node_id", "worker_id"):
+                    if merged.get(key) is None:
+                        merged[key] = start.get(key)
+                _emit(tid, start["ts"], merged)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -102,6 +102,49 @@ class _WorkflowStore:
             return serialization.loads(f.read())
 
 
+def options(*, max_retries: Optional[int] = None,
+            catch_exceptions: Optional[bool] = None):
+    """Per-step workflow options (reference: workflow/api.py
+    ``workflow.options`` — ``@workflow.options(max_retries=..,
+    catch_exceptions=..)``). Returns a decorator; apply it to a bound
+    DAG node (or to the @remote function itself) to override the
+    workflow-global settings for that one step:
+
+        step = workflow.options(max_retries=5)(flaky.bind(x))
+        out = workflow.run(step, workflow_id="w1", max_retries=0)
+
+    ``max_retries`` overrides the run()-level retry budget for the
+    step; ``catch_exceptions=True`` makes the step's checkpointed value
+    a ``(result, exception)`` tuple instead of raising (the reference's
+    catch_exceptions contract)."""
+    opts: Dict[str, Any] = {}
+    if max_retries is not None:
+        opts["max_retries"] = int(max_retries)
+    if catch_exceptions is not None:
+        opts["catch_exceptions"] = bool(catch_exceptions)
+
+    def _apply(target):
+        try:
+            target._workflow_options = dict(
+                getattr(target, "_workflow_options", None) or {}, **opts)
+        except (AttributeError, TypeError):
+            raise TypeError(
+                f"workflow.options cannot be applied to {target!r}; "
+                f"apply it to a bound DAG node or a @remote function")
+        return target
+
+    return _apply
+
+
+def _step_options(node: DAGNode) -> Dict[str, Any]:
+    """Effective per-step options: node-level tags win over tags on the
+    underlying remote function."""
+    fn_opts = getattr(getattr(node, "_remote_fn", None),
+                      "_workflow_options", None) or {}
+    node_opts = getattr(node, "_workflow_options", None) or {}
+    return {**fn_opts, **node_opts}
+
+
 def _step_key(node: DAGNode, idx: int, prefix: str = "") -> str:
     name = ""
     if isinstance(node, FunctionNode):
@@ -130,7 +173,14 @@ def _execute_durable(dag: DAGNode, store: _WorkflowStore, input_args: tuple,
         if store.has_step(key):
             cache[id(node)] = store.load_step(key)
             continue
+        # Per-step overrides (workflow.options) beat the run()-level
+        # budget; catch_exceptions checkpoints (result, exception)
+        # instead of failing the workflow.
+        wopts = _step_options(node)
+        step_retries = int(wopts.get("max_retries", max_retries))
+        catch = bool(wopts.get("catch_exceptions"))
         attempts = 0
+        caught: Optional[BaseException] = None
         while True:
             try:
                 ref = node._exec_one(
@@ -138,16 +188,21 @@ def _execute_durable(dag: DAGNode, store: _WorkflowStore, input_args: tuple,
                     input_kwargs)
                 value = ray_tpu.get(ref) if hasattr(ref, "id") else ref
                 break
-            except Exception:
+            except Exception as e:
                 attempts += 1
-                if attempts > max_retries:
-                    raise
-        if isinstance(value, DAGNode):
+                if attempts > step_retries:
+                    if not catch:
+                        raise
+                    caught, value = e, None
+                    break
+        if caught is None and isinstance(value, DAGNode):
             # Continuation: the step returned a new sub-workflow
             # (reference: workflow.continuation / workflow_state_from_dag).
             value = _execute_durable(
                 value, store, (), {}, max_retries,
                 prefix=f"{key}.c", depth=depth + 1)
+        if catch:
+            value = (value, caught)
         store.save_step(key, value)
         cache[id(node)] = value
     return cache[id(dag)]
@@ -252,5 +307,5 @@ from .events import (EventListener, FileEventListener, HTTPEventProvider,
 __all__ = ["CANCELED", "FAILED", "RESUMABLE", "RUNNING", "SUCCESSFUL",
            "EventListener", "FileEventListener", "HTTPEventProvider",
            "TimerListener", "cancel", "delete", "deliver_event",
-           "get_output", "get_status", "init", "list_all", "resume",
-           "run", "run_async", "wait_for_event"]
+           "get_output", "get_status", "init", "list_all", "options",
+           "resume", "run", "run_async", "wait_for_event"]

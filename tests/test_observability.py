@@ -1,10 +1,15 @@
 """State API / metrics / timeline tests (reference strategy:
-python/ray/tests/test_state_api.py, test_metrics_agent.py)."""
+python/ray/tests/test_state_api.py, test_metrics_agent.py), plus the
+cluster-wide telemetry plane (_private/telemetry.py): task lifecycle
+events from workers, metric federation, drop-oldest accounting, and the
+disabled-path perf_smoke guard."""
+import time
 import urllib.request
 
 import pytest
 
 import ray_tpu
+from ray_tpu._private import telemetry
 from ray_tpu.util import metrics
 from ray_tpu.util import state as state_api
 
@@ -213,3 +218,327 @@ def test_log_monitor_final_drain_and_binary_offsets(capsys, tmp_path):
     assert "next line" in capsys.readouterr().err  # offset not drifted
     mon.stop()
     assert "fatal: chip lockup" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# telemetry plane (PR 3): task lifecycle events, federation, guards
+# ---------------------------------------------------------------------------
+class TestTaskEventBuffer:
+    def test_drop_oldest_accounting_is_exact(self):
+        """Flooded buffer: exactly capacity events retained (the newest),
+        every overflow counted once — the acceptance contract for the
+        worker-side buffer under pressure."""
+        buf = telemetry.TaskEventBuffer(capacity=10)
+        for i in range(35):
+            buf.record(task_id=str(i), state="RUNNING", ts=float(i))
+        assert len(buf) == 10
+        events, dropped = buf.drain()
+        assert dropped == 25
+        assert [e["task_id"] for e in events] == [str(i)
+                                                 for i in range(25, 35)]
+        # drain resets both the buffer and the drop counter
+        events2, dropped2 = buf.drain()
+        assert events2 == [] and dropped2 == 0
+        buf.record(task_id="x", state="FINISHED", ts=1.0)
+        events3, dropped3 = buf.drain()
+        assert len(events3) == 1 and dropped3 == 0
+
+    def test_aggregator_ring_bounded_with_drop_counter(self):
+        store = telemetry.TelemetryStore(max_events_per_job=5)
+        store.record_events(
+            [{"task_id": str(i), "ts": float(i), "state": "FINISHED"}
+             for i in range(12)])
+        evs = store.events()
+        assert len(evs) == 5
+        assert [e["task_id"] for e in evs] == [str(i) for i in range(7, 12)]
+        dropped = store.dropped_counts()
+        assert dropped["default"] == 7
+        # worker-reported buffer drops accumulate separately and exactly
+        store.record_events([], dropped=3, from_worker=True)
+        assert store.dropped_counts()["_worker_buffers"] == 3
+        assert store.events_ingested == 12
+
+
+def test_task_events_carry_node_worker_attempt():
+    """Lifecycle transitions for one task: head-side
+    PENDING_SCHEDULING/SUBMITTED plus worker-side RUNNING/FINISHED with
+    node/worker ids and same-clock span bounds."""
+    @ray_tpu.remote
+    def evented(x):
+        return x + 1
+
+    assert ray_tpu.get(evented.remote(1)) == 2
+    from ray_tpu._private.state import get_node
+    node = get_node()
+    head_hex = node.node_id.hex()
+    want = {"PENDING_SCHEDULING", "SUBMITTED", "RUNNING", "FINISHED"}
+    deadline = time.monotonic() + 5
+    evs, states = [], set()
+    while time.monotonic() < deadline:
+        evs = [e for e in node.gcs.task_events()
+               if e.get("name") == "evented"]
+        states = {e["state"] for e in evs}
+        if want <= states:
+            break
+        time.sleep(0.05)
+    assert want <= states, states
+    run_ev = next(e for e in evs if e["state"] == "RUNNING")
+    assert run_ev["node_id"] == head_hex
+    assert run_ev["worker_id"]
+    assert run_ev["src"] == "worker"
+    fin = [e for e in evs
+           if e["state"] == "FINISHED" and e.get("src") == "worker"]
+    assert fin and fin[-1]["start_ts"] <= fin[-1]["ts"]
+    row = [t for t in state_api.list_tasks()
+           if t["name"] == "evented"][0]
+    assert row["state"] == "FINISHED"
+    assert row["node_id"] == head_hex
+    assert row["worker_id"] and row["attempt"] == 1
+
+
+def test_federated_metrics_merges_node_snapshots():
+    """The head's registry (node_id-tagged) merges with pushed node
+    snapshots under ONE HELP/TYPE header per metric name."""
+    from ray_tpu._private.state import get_node
+    node = get_node()
+    node.gcs.telemetry.metrics_put(
+        scope="node", node_id="fakenode01", worker_id=None,
+        groups=[{"name": "object_store_used_bytes", "type": "gauge",
+                 "help": "x",
+                 "samples": [("object_store_used_bytes", {}, 123.0)]}],
+        ts=time.time())
+    try:
+        text = telemetry.federated_prometheus_text(node)
+        assert 'object_store_used_bytes{node_id="fakenode01"} 123.0' \
+            in text
+        head_hex = node.node_id.hex()
+        assert f'scheduler_queue_depth{{node_id="{head_hex}"}}' in text
+        assert f'object_store_used_bytes{{node_id="{head_hex}"}}' in text
+        assert text.count("# TYPE object_store_used_bytes gauge") == 1
+    finally:
+        node.gcs.telemetry.forget_node("fakenode01")
+
+
+def test_usage_report_is_local_and_opt_in(tmp_path):
+    """The usage record is built from the telemetry aggregator and only
+    ever lands in the session dir — opt-in, never the network."""
+    import json
+    import os
+
+    from ray_tpu._private import usage
+    from ray_tpu._private.config import ray_config
+    from ray_tpu._private.state import get_node
+
+    @ray_tpu.remote
+    def counted():
+        return 1
+
+    ray_tpu.get(counted.remote())
+    node = get_node()
+    report = os.path.join(node.session_dir, "usage_report.json")
+    assert not bool(ray_config.usage_stats_enabled)
+    rec = usage.record_usage()
+    assert rec["source"] == "ray_tpu"
+    assert not os.path.exists(report), "disabled must not write"
+    ray_config.set("usage_stats_enabled", True)
+    try:
+        rec = usage.record_usage()
+        assert os.path.exists(report)
+        with open(report) as f:
+            data = json.load(f)
+        assert data["cluster_size"] >= 1
+        assert data["task_state_counts"].get("FINISHED", 0) >= 1
+        assert isinstance(data["libraries"], list)
+        assert "telemetry_dropped" in data
+    finally:
+        ray_config.set("usage_stats_enabled", False)
+        try:
+            os.unlink(report)
+        except OSError:
+            pass
+
+
+# -- destructive tests (re-init the shared runtime); keep them LAST --------
+def test_failed_event_attempt_count_after_worker_sigkill():
+    """A worker SIGKILLed by the fault plane on every exec: the task
+    burns its retry and the state API shows FAILED with the RIGHT
+    attempt count (the dead worker can never report it — the head's
+    failure path must)."""
+    from ray_tpu.exceptions import WorkerCrashedError
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, prestart_workers=0, fault_config={
+        "seed": 3,
+        "rules": [{"site": "worker.exec", "action": "kill", "at": [0]}]})
+    try:
+        @ray_tpu.remote(max_retries=1)
+        def doomed():
+            return 1
+
+        with pytest.raises(WorkerCrashedError):
+            ray_tpu.get(doomed.remote(), timeout=120)
+        from ray_tpu._private.state import get_node
+        evs = [e for e in get_node().gcs.task_events()
+               if e.get("name") == "doomed"]
+        failed = [e for e in evs if e["state"] == "FAILED"]
+        assert failed and failed[-1]["attempt"] == 2
+        # the retry requeue was recorded as attempt 2
+        assert any(e["state"] == "PENDING_SCHEDULING"
+                   and e.get("attempt") == 2 for e in evs)
+        row = [t for t in state_api.list_tasks()
+               if t["name"] == "doomed"][0]
+        assert row["state"] == "FAILED" and row["attempt"] == 2
+    finally:
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+
+
+def test_multinode_task_events_and_federated_metrics():
+    """Acceptance criterion: a 2-node cluster (head + one spawned
+    daemon) — list_tasks returns lifecycle events for tasks that ran on
+    the remote node (states, timestamps, node ids), and /metrics serves
+    scheduler + object-store samples tagged with each node's id."""
+    import os
+
+    ray_tpu.shutdown()
+    from ray_tpu._private.config import ray_config
+    prev_hb = float(ray_config.node_heartbeat_s)
+    os.environ["RAY_TPU_NODE_HEARTBEAT_S"] = "0.25"
+    ray_config.set("node_heartbeat_s", 0.25)
+    from ray_tpu.cluster_utils import Cluster
+    cluster = None
+    try:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+        node = cluster.add_node(num_cpus=2, resources={"R": 2},
+                                daemon=True)
+
+        @ray_tpu.remote(resources={"R": 1})
+        def remote_side():
+            import os
+            return os.getpid()
+
+        ray_tpu.get([remote_side.remote() for _ in range(4)],
+                    timeout=60)
+        from ray_tpu._private.state import get_node
+        head = get_node()
+        head_hex = head.node_id.hex()
+
+        deadline = time.monotonic() + 10
+        rows = []
+        while time.monotonic() < deadline:
+            rows = [t for t in state_api.list_tasks(
+                filters=[("name", "=", "remote_side")])
+                if t["node_id"] == node.node_id]
+            if (len(rows) == 4
+                    and all(r["state"] == "FINISHED" for r in rows)):
+                break
+            time.sleep(0.1)
+        assert len(rows) == 4, rows
+        for r in rows:
+            assert r["state"] == "FINISHED"
+            assert r["worker_id"] and r["attempt"] == 1
+            assert r["start_time"] and r["end_time"] >= r["start_time"]
+        evs = [e for e in head.gcs.task_events()
+               if e.get("name") == "remote_side"]
+        assert any(e["state"] == "RUNNING"
+                   and e.get("node_id") == node.node_id
+                   and e.get("src") == "worker" for e in evs)
+        # timeline spans the remote node: pid = node, tid = worker
+        spans = [s for s in state_api.timeline()
+                 if s["name"] == "remote_side"]
+        assert spans
+        assert all(s["pid"] == node.node_id[:8] for s in spans)
+
+        # federated /metrics through the dashboard, per-node tagged
+        from ray_tpu.dashboard import start_dashboard, stop_dashboard
+        port = start_dashboard(port=0)
+        try:
+            want = f'object_store_used_bytes{{node_id="{node.node_id}"}}'
+            deadline = time.monotonic() + 20
+            body = ""
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=10) as r:
+                    body = r.read().decode()
+                # The RTT histogram needs a full ping->ack->ping cycle
+                # before it rides a snapshot; wait for both.
+                if want in body and "node_heartbeat_rtt_s" in body:
+                    break
+                time.sleep(0.25)
+            assert want in body, body[:2000]
+            assert (f'object_store_used_bytes{{node_id="{head_hex}"}}'
+                    in body)
+            assert (f'scheduler_queue_depth{{node_id="{head_hex}"}}'
+                    in body)
+            # daemon-side heartbeat RTT histogram federated through
+            assert "node_heartbeat_rtt_s" in body
+        finally:
+            stop_dashboard()
+    finally:
+        try:
+            if cluster is not None:
+                cluster.shutdown()
+        except Exception:
+            pass
+        os.environ.pop("RAY_TPU_NODE_HEARTBEAT_S", None)
+        ray_config.set("node_heartbeat_s", prev_hb)
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+
+
+@pytest.mark.perf_smoke
+def test_disabled_telemetry_hot_path_is_costless():
+    """Counter-based guard (wall-clock-free, per the PR 2 pattern): with
+    telemetry OFF, a task batch must (a) invoke ZERO instrumentation
+    helpers in the driver, (b) mutate ZERO metric objects anywhere in
+    the driver process (the syscall-bearing machinery), and (c) deliver
+    ZERO TASK_EVENTS / METRICS_PUSH frames from workers — the only new
+    syscalls the plane could add per task. The head's plain list-append
+    event log (pre-existing behavior) keeps the state API answering."""
+    ray_tpu.shutdown()
+    telemetry.configure(False)
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def probe(x):
+            return x
+
+        ray_tpu.get([probe.remote(i) for i in range(8)])  # warm pool
+        from ray_tpu._private.state import get_node
+        node = get_node()
+        tstore = node.gcs.telemetry
+        ops_before = telemetry.instrument_ops()
+        worker_events_before = tstore.events_ingested_from_workers
+        calls = {"n": 0}
+        orig = (metrics.Counter.inc, metrics.Gauge.set,
+                metrics.Histogram.observe)
+
+        def _count(fn):
+            def wrapper(self, *a, **kw):
+                calls["n"] += 1
+                return fn(self, *a, **kw)
+            return wrapper
+
+        metrics.Counter.inc = _count(orig[0])
+        metrics.Gauge.set = _count(orig[1])
+        metrics.Histogram.observe = _count(orig[2])
+        try:
+            ray_tpu.get([probe.remote(i) for i in range(32)])
+        finally:
+            (metrics.Counter.inc, metrics.Gauge.set,
+             metrics.Histogram.observe) = orig
+        assert telemetry.instrument_ops() == ops_before
+        assert calls["n"] == 0
+        assert (tstore.events_ingested_from_workers
+                == worker_events_before == 0)
+        assert tstore.metrics_snapshots() == []
+        rows = [t for t in state_api.list_tasks(limit=10000)
+                if t["name"] == "probe"]
+        assert len(rows) == 40  # head-side events still answer
+    finally:
+        ray_tpu.shutdown()
+        telemetry.configure(True)
+        ray_tpu.init(num_cpus=4, ignore_reinit_error=True)

@@ -176,3 +176,70 @@ class TestWorkflowEvents:
         node = workflow.wait_for_event(workflow.TimerListener, 0.3)
         out = workflow.run(node, workflow_id="wf_timer")
         assert out >= t0 + 0.3
+
+
+# -- per-step options (workflow.options; reference: workflow/api.py) --------
+@ray_tpu.remote
+def _flaky_until(marker, succeed_at):
+    n = int(open(marker).read()) if os.path.exists(marker) else 0
+    with open(marker, "w") as f:
+        f.write(str(n + 1))
+    if n + 1 < succeed_at:
+        raise ValueError(f"boom on attempt {n + 1}")
+    return "ok"
+
+
+@ray_tpu.remote
+def _always_fails():
+    raise RuntimeError("nope")
+
+
+def test_step_max_retries_overrides_global(tmp_path):
+    """A step tagged workflow.options(max_retries=3) retries past a
+    run()-level budget of ZERO."""
+    marker = str(tmp_path / "attempts")
+    step = workflow.options(max_retries=3)(
+        _flaky_until.bind(marker, 3))
+    out = workflow.run(step, workflow_id="wf_step_retries",
+                       max_retries=0)
+    assert out == "ok"
+    assert int(open(marker).read()) == 3  # 2 failures + 1 success
+
+
+def test_step_max_retries_can_tighten(tmp_path):
+    """The override works the other way too: a step pinned to 0 retries
+    fails even when the global budget would retry."""
+    marker = str(tmp_path / "attempts2")
+    step = workflow.options(max_retries=0)(
+        _flaky_until.bind(marker, 2))
+    with pytest.raises(Exception):
+        workflow.run(step, workflow_id="wf_step_tight", max_retries=5)
+    assert int(open(marker).read()) == 1  # exactly one attempt ran
+
+
+def test_step_catch_exceptions(tmp_path):
+    """catch_exceptions=True checkpoints (result, exception) instead of
+    failing the workflow (reference contract)."""
+    step = workflow.options(catch_exceptions=True, max_retries=0)(
+        _always_fails.bind())
+    result, err = workflow.run(step, workflow_id="wf_catch")
+    assert result is None
+    assert err is not None and "nope" in str(err)
+    assert workflow.get_status("wf_catch") == workflow.SUCCESSFUL
+    # success under catch_exceptions wraps as (value, None)
+    ok_step = workflow.options(catch_exceptions=True)(add.bind(2, 3))
+    result, err = workflow.run(ok_step, workflow_id="wf_catch_ok")
+    assert result == 5 and err is None
+
+
+def test_options_tag_on_remote_function(tmp_path):
+    """options applied to the @remote function itself cover every bind
+    of it; node-level tags win over function-level ones."""
+    marker = str(tmp_path / "attempts3")
+    workflow.options(max_retries=2)(_flaky_until)
+    try:
+        out = workflow.run(_flaky_until.bind(marker, 2),
+                           workflow_id="wf_fn_tag", max_retries=0)
+        assert out == "ok"
+    finally:
+        del _flaky_until._workflow_options
