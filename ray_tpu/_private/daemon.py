@@ -19,6 +19,7 @@ with the cluster token in RAY_TPU_CLUSTER_TOKEN_HEX (or --token-hex).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -27,6 +28,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from . import fault
+from . import lockdep
 from . import protocol as P
 from . import telemetry
 from .config import ray_config
@@ -35,6 +37,8 @@ from .netcomm import PullManager, TransferServer, store_paths_factory
 from .object_store import create_store
 from .resources import detect_node_resources
 from .scheduler import WorkerHandle, WorkerPool
+
+logger = logging.getLogger(__name__)
 
 
 class NodeDaemon:
@@ -79,11 +83,11 @@ class NodeDaemon:
         self._pool_workers = 0
         ncpu = int(self.totals.get("CPU", 4))
         self._max_pool_workers = max(ncpu, 4)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("daemon.state")
         # Head-link writer (per connection; swapped on reconnect under
         # _conn_lock): sends from any daemon thread enqueue and
         # coalesce into one vectored write per wakeup.
-        self._conn_lock = threading.Lock()
+        self._conn_lock = lockdep.lock("daemon.conn")
         self._writer = None
         # Recv-side: the head's writer may coalesce several messages
         # into one frame; the ACK read in _connect_head consumes one
@@ -97,7 +101,7 @@ class NodeDaemon:
         # parsing, while per-worker FIFO order holds.
         from .netcomm import SerialExecutor
         self._route_exec = SerialExecutor(name="daemon-route")
-        self._req_lock = threading.Lock()
+        self._req_lock = lockdep.lock("daemon.req")
         self._req_counter = 0
         self._pending: Dict[int, Future] = {}
         self._transfer_addrs: Dict[str, Tuple[str, int]] = {}
@@ -383,6 +387,13 @@ class NodeDaemon:
                 fut.set_result(payload.get("result"))
         elif msg_type == P.SHUTDOWN_NODE:
             self._stopped.set()
+        else:
+            # Unknown head->daemon type: log, never drop silently (a
+            # head/daemon version skew would otherwise look like lost
+            # work with no trace on either side).
+            logger.warning("daemon %s dropping unknown message type %r "
+                           "from head (protocol skew?)",
+                           self.node_hex[:8], msg_type)
 
     def _route_worker_plane(self, msg_type: str, payload: dict):
         """Ordered worker-plane handlers (see _route)."""

@@ -1,0 +1,456 @@
+"""Runtime lock-order tracker for the control plane ("lockdep").
+
+The dynamic half of the raylint plane (static passes:
+``ray_tpu/devtools/lint/``; reference inspiration: the Linux kernel's
+lockdep — lock-CLASS acquisition-order validation — and TSan's
+happens-before checking, adapted to what pure Python can observe).
+
+The named locks of ``netcomm`` / ``scheduler`` / ``runtime`` /
+``daemon`` / ``node_service`` / ``object_store`` / ``worker_proc`` are
+created through :func:`lock` / :func:`rlock` / :func:`condition`.
+Disabled (the default), those return PLAIN ``threading`` primitives —
+the factory call at object-construction time is the entire overhead,
+and lock acquisition costs exactly what it always did (asserted by the
+counter-based perf_smoke guard in tests/test_lockdep.py, the
+``fault.py``/``telemetry.py`` falsy-flag discipline).
+
+Enabled (``RAY_TPU_LOCKDEP=1`` or :func:`configure`), each named lock
+is wrapped in a :class:`_DebugLock` that records, per thread, the stack
+of locks currently held and where each was acquired. Every first-seen
+ordering pair (A held while acquiring B) adds edge A->B to a global
+lock-CLASS acquisition-order graph; a new edge that closes a cycle is
+reported as a potential ABBA deadlock with BOTH acquisition stacks
+(the Linux-lockdep property: the two conflicting acquisitions never
+have to actually race — seeing each order once, ever, on any thread,
+is enough). A watchdog additionally flags holds of a named lock longer
+than ``RAY_TPU_LOCKDEP_HOLD_S`` (default 1.0s) — the dynamic
+counterpart of the static blocking-under-lock pass.
+
+Like the kernel's lockdep, ordering is tracked per lock NAME (class),
+not per instance: two instances of one class acquired in both orders
+by different code paths is exactly the ABBA shape worth flagging, and
+class-level tracking is what lets one test run validate orderings that
+would need a precise race to deadlock for real.
+
+Reports never raise and never block the runtime: they append to a
+process-local list (``cycle_reports()`` / ``hold_reports()``) and log
+a warning once per distinct cycle. Test suites opt in via the conftest
+fixture (transport + chaos tiers) and assert ``cycle_reports() == []``
+on teardown.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+_ENV_VAR = "RAY_TPU_LOCKDEP"
+_HOLD_ENV_VAR = "RAY_TPU_LOCKDEP_HOLD_S"
+# When set (inherited by spawned daemons/workers), every process that
+# records a potential-ABBA cycle ALSO appends it as a JSON line to
+# <dir>/lockdep-cycles-<pid>.jsonl AT RECORD TIME (SIGKILL-safe, no
+# atexit needed) — how the test harness sees cycles from child
+# processes, whose in-memory reports die with them.
+_DUMP_ENV_VAR = "RAY_TPU_LOCKDEP_DIR"
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(_ENV_VAR, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+# Falsy-flag gate (fault.py discipline): module attribute, one dict
+# lookup at lock-FACTORY time; disabled processes never construct a
+# single tracking object.
+enabled = _env_enabled()
+
+# Instrumentation-work counter: every tracking operation below bumps
+# it, so the perf_smoke guard can assert the disabled path did ZERO
+# lockdep work (not merely "little").
+_ops = 0
+
+
+def hold_threshold_s() -> float:
+    try:
+        return float(os.environ.get(_HOLD_ENV_VAR, "1.0"))
+    except ValueError:
+        return 1.0
+
+
+def configure(on: bool, propagate_env: bool = True) -> None:
+    """Flip tracking for locks created FROM NOW ON in this process;
+    with ``propagate_env`` the setting rides into spawned daemons and
+    workers (their locks are created at boot, after env inheritance)."""
+    global enabled
+    enabled = bool(on)
+    if propagate_env:
+        if on:
+            os.environ[_ENV_VAR] = "1"
+        else:
+            os.environ.pop(_ENV_VAR, None)
+
+
+def instrument_ops() -> int:
+    """Tracking operations performed so far (perf_smoke guard)."""
+    return _ops
+
+
+# ---------------------------------------------------------------------------
+# global state (process-wide; all guarded by _state_lock except the
+# per-thread held stack, which is thread-local by construction)
+# ---------------------------------------------------------------------------
+_state_lock = threading.Lock()
+_edges: Dict[str, Set[str]] = {}            # class name -> successors
+_edge_stacks: Dict[Tuple[str, str], Tuple[str, str]] = {}
+_cycles: List[dict] = []
+_holds: List[dict] = []
+_cycle_keys: Set[Tuple[str, ...]] = set()   # dedup: one report per cycle
+_tls = threading.local()
+
+
+def reset() -> None:
+    """Drop all recorded state (test isolation)."""
+    with _state_lock:
+        _edges.clear()
+        _edge_stacks.clear()
+        _cycles.clear()
+        _holds.clear()
+        _cycle_keys.clear()
+
+
+def cycle_reports() -> List[dict]:
+    with _state_lock:
+        return list(_cycles)
+
+
+def hold_reports() -> List[dict]:
+    with _state_lock:
+        return list(_holds)
+
+
+def format_reports() -> str:
+    """Human-readable dump (what the conftest fixture prints on
+    failure; format documented in docs/STATIC_ANALYSIS.md)."""
+    out: List[str] = []
+    for rep in cycle_reports():
+        out.append("=" * 70)
+        out.append(f"POTENTIAL ABBA DEADLOCK: cycle "
+                   f"{' -> '.join(rep['cycle'])} -> {rep['cycle'][0]}")
+        out.append(f"-- thread {rep['thread']} acquired "
+                   f"{rep['edge'][1]!r} while holding {rep['edge'][0]!r} "
+                   f"here:")
+        out.append(rep["stack_b"].rstrip())
+        out.append(f"-- {rep['edge'][0]!r} was acquired here:")
+        out.append(rep["stack_a"].rstrip())
+        out.append(f"-- the REVERSE order "
+                   f"{' -> '.join(rep['reverse_edge'])} was first "
+                   f"seen: holder stack:")
+        out.append(rep["reverse_stack_a"].rstrip())
+        out.append("-- then acquiring:")
+        out.append(rep["reverse_stack_b"].rstrip())
+    for rep in hold_reports():
+        out.append("=" * 70)
+        out.append(f"LONG HOLD: {rep['name']!r} held "
+                   f"{rep['held_s']:.3f}s (> {rep['threshold_s']:.3f}s) "
+                   f"by thread {rep['thread']}; acquired here:")
+        out.append(rep["stack"].rstrip())
+    return "\n".join(out)
+
+
+def _capture_stack(skip: int = 2, limit: int = 12) -> str:
+    """Cheap-ish stack capture: frame walk, no linecache formatting."""
+    try:
+        frame = sys._getframe(skip)
+    except ValueError:
+        return "<no stack>"
+    lines: List[str] = []
+    depth = 0
+    while frame is not None and depth < limit:
+        code = frame.f_code
+        lines.append(f"  {code.co_filename}:{frame.f_lineno} "
+                     f"in {code.co_name}")
+        frame = frame.f_back
+        depth += 1
+    return "\n".join(lines)
+
+
+def _held_stack() -> List[dict]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS path src -> ... -> dst through the order graph."""
+    seen = {src}
+    stack = [(src, [src])]
+    while stack:
+        node, path = stack.pop()
+        for nxt in _edges.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _dump_cycle(report: dict) -> None:
+    """Best-effort spill of one cycle report for cross-process
+    collection (see _DUMP_ENV_VAR). Caller holds _state_lock."""
+    dump_dir = os.environ.get(_DUMP_ENV_VAR)
+    if not dump_dir:
+        return
+    try:
+        import json
+        path = os.path.join(dump_dir,
+                            f"lockdep-cycles-{os.getpid()}.jsonl")
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(report) + "\n")
+    except OSError:
+        logger.debug("lockdep cycle dump to %s failed", dump_dir,
+                     exc_info=True)
+
+
+def collect_dumped_cycles(dump_dir: str) -> List[dict]:
+    """Read every cycle spilled under `dump_dir` by ANY process of the
+    run (head, daemons, workers)."""
+    import glob
+    import json
+    out: List[dict] = []
+    for path in sorted(glob.glob(
+            os.path.join(dump_dir, "lockdep-cycles-*.jsonl"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def _record_acquire(name: str) -> None:
+    global _ops
+    _ops += 1
+    held = _held_stack()
+    stack = _capture_stack(skip=3)
+    new_edges: List[Tuple[str, str, str, str]] = []
+    for entry in held:
+        a = entry["name"]
+        if a == name:
+            continue  # same class nested (e.g. two writer instances in
+            # a relay chain): ordering within a class is
+            # instance-specific, which class-level tracking
+            # cannot adjudicate — skip the self-edge.
+        if (a, name) not in _edge_stacks:
+            new_edges.append((a, name, entry["stack"], stack))
+    held.append({"name": name, "stack": stack,
+                 "t0": time.monotonic()})
+    if not new_edges:
+        return
+    with _state_lock:
+        for a, b, stack_a, stack_b in new_edges:
+            if (a, b) in _edge_stacks:
+                continue
+            _edge_stacks[(a, b)] = (stack_a, stack_b)
+            _edges.setdefault(a, set()).add(b)
+            # Does b reach a? Then a->b closes a cycle.
+            path = _find_path(b, a)
+            if path is None:
+                continue
+            cycle = [a] + path[:-1] if path[0] == b else [a, b]
+            key = tuple(sorted(set(cycle)))
+            if key in _cycle_keys:
+                continue
+            _cycle_keys.add(key)
+            rev = (path[0], path[1]) if len(path) >= 2 else (b, a)
+            rev_stacks = _edge_stacks.get(rev, ("<unknown>", "<unknown>"))
+            report = {
+                "cycle": cycle,
+                "edge": (a, b),
+                "pid": os.getpid(),
+                "thread": threading.current_thread().name,
+                "stack_a": stack_a,
+                "stack_b": stack_b,
+                "reverse_edge": rev,
+                "reverse_stack_a": rev_stacks[0],
+                "reverse_stack_b": rev_stacks[1],
+            }
+            _cycles.append(report)
+            _dump_cycle(report)
+            logger.warning(
+                "lockdep: potential ABBA deadlock %s -> %s closes cycle "
+                "%s (stacks in lockdep.cycle_reports())",
+                a, b, " -> ".join(cycle))
+
+
+def _record_release(name: str) -> None:
+    # Pops the held entry UNCONDITIONALLY (a lock acquired while
+    # tracking was on must not leave a stale "held" entry if tracking
+    # was flipped off mid-hold — stale entries would fabricate edges
+    # later); the watchdog and the op counter only run while enabled.
+    global _ops
+    held = _held_stack()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i]["name"] == name:
+            entry = held.pop(i)
+            if not enabled:
+                return
+            _ops += 1
+            held_s = time.monotonic() - entry["t0"]
+            thresh = hold_threshold_s()
+            if thresh > 0 and held_s > thresh:
+                with _state_lock:
+                    _holds.append({
+                        "name": name, "held_s": held_s,
+                        "threshold_s": thresh,
+                        "thread": threading.current_thread().name,
+                        "stack": entry["stack"]})
+                logger.warning("lockdep: %r held %.3fs (> %.3fs)",
+                               name, held_s, thresh)
+            return
+
+
+class _DebugLock:
+    """Tracking wrapper over a threading.Lock/RLock. Exposes the full
+    lock protocol (acquire/release/context manager/locked) AND the
+    Condition integration protocol (``_is_owned`` / ``_release_save``
+    / ``_acquire_restore``, delegated to the inner lock), so
+    ``threading.Condition`` composes with it with the inner lock's
+    exact semantics — a reentrant hold survives ``wait()`` correctly.
+    Tracking never raises into the caller, and is gated on the module
+    ``enabled`` flag per operation: flipping lockdep off stops ALL
+    recording immediately, even for wrappers created earlier (stale
+    per-thread holds are still popped so re-enabling can't see
+    fabricated edges)."""
+
+    def __init__(self, name: str, inner, reentrant: bool = False):
+        self._name = name
+        self._inner = inner
+        self._reentrant = reentrant
+        self._tls_depth = threading.local() if reentrant else None
+
+    # -- lock protocol -------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            try:
+                if self._reentrant:
+                    d = getattr(self._tls_depth, "n", 0)
+                    self._tls_depth.n = d + 1
+                    if d:  # reentrant re-acquire: no new ordering info
+                        return got
+                if enabled:
+                    _record_acquire(self._name)
+            except Exception:  # lint: broad-except-ok diagnostics must never break the runtime they watch
+                logger.debug("lockdep acquire tracking failed",
+                             exc_info=True)
+        return got
+
+    def release(self):
+        try:
+            if self._reentrant:
+                d = getattr(self._tls_depth, "n", 1)
+                self._tls_depth.n = d - 1
+                if d > 1:
+                    self._inner.release()
+                    return
+            _record_release(self._name)
+        except Exception:  # lint: broad-except-ok diagnostics must never break the runtime they watch
+            logger.debug("lockdep release tracking failed", exc_info=True)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # -- Condition integration (threading.Condition picks these up) ----
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        # Plain-Lock fallback: the stdlib's own heuristic.
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        """Condition.wait: drop the ENTIRE (possibly reentrant) hold."""
+        depth = 1
+        try:
+            if self._reentrant:
+                depth = getattr(self._tls_depth, "n", 1)
+                self._tls_depth.n = 0
+            _record_release(self._name)
+        except Exception:  # lint: broad-except-ok diagnostics must never break the runtime they watch
+            logger.debug("lockdep release-save tracking failed",
+                         exc_info=True)
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            return ("inner", inner._release_save(), depth)
+        inner.release()
+        return ("plain", None, depth)
+
+    def _acquire_restore(self, state):
+        kind, inner_state, depth = state
+        if kind == "inner":
+            self._inner._acquire_restore(inner_state)
+        else:
+            self._inner.acquire()
+        try:
+            if self._reentrant:
+                self._tls_depth.n = depth
+            if enabled:
+                _record_acquire(self._name)
+        except Exception:  # lint: broad-except-ok diagnostics must never break the runtime they watch
+            logger.debug("lockdep acquire-restore tracking failed",
+                         exc_info=True)
+
+    def __repr__(self):
+        return f"<lockdep {self._name!r} over {self._inner!r}>"
+
+
+# ---------------------------------------------------------------------------
+# factories — the ONLY api the runtime modules use
+# ---------------------------------------------------------------------------
+def lock(name: str):
+    """A named mutex: plain ``threading.Lock`` when lockdep is off."""
+    if not enabled:
+        return threading.Lock()
+    return _DebugLock(name, threading.Lock())
+
+
+def rlock(name: str):
+    if not enabled:
+        return threading.RLock()
+    return _DebugLock(name, threading.RLock(), reentrant=True)
+
+
+def condition(name: str):
+    """A Condition over a named lock. ``wait()`` releases/re-acquires
+    through the wrapper, so park/resume shows up as release/acquire in
+    the ordering graph — exactly the semantics a waiter has. The
+    tracked lock is an RLOCK, matching ``threading.Condition()``'s
+    default: the diagnostic mode must observe, never change, lock
+    semantics (a reentrant condition hold that is legal in production
+    must not deadlock only under RAY_TPU_LOCKDEP=1)."""
+    if not enabled:
+        return threading.Condition()
+    return threading.Condition(rlock(name))

@@ -12,6 +12,7 @@ handling reuse the single-host code paths unchanged.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -21,8 +22,11 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 import collections
 
 from . import fault
+from . import lockdep
 from . import protocol as P
 from .ids import WorkerID
+
+logger = logging.getLogger(__name__)
 
 
 class RemoteWorkerProxy:
@@ -43,7 +47,7 @@ class RemoteWorkerProxy:
         # writer queue (nonblocking), so unlike the old send-lock days
         # nothing IO-bound ever runs under it. No send_lock here: sends
         # serialize on the daemon connection's writer queue.
-        self.dispatch_lock = threading.Lock()
+        self.dispatch_lock = lockdep.lock("node_service.proxy_dispatch")
         self.dedicated_actor = None
         self.running: Dict[bytes, P.TaskSpec] = {}
         self.fn_cache: set = set()
@@ -105,7 +109,7 @@ class DaemonHandle:
         from .netcomm import ConnectionWriter
         self._writer = ConnectionWriter(
             conn, name=f"daemon-writer-{node_id_hex[:8]}")
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("node_service.daemon_handle")
         self.proxies: Dict[bytes, RemoteWorkerProxy] = {}
         self._idle: Dict[str, Deque[RemoteWorkerProxy]] = \
             collections.defaultdict(collections.deque)
@@ -113,7 +117,7 @@ class DaemonHandle:
         # pending-future table). Holding it across the send used to
         # serialize unrelated head->daemon requests behind one
         # write(2); sends are lock-free enqueues now.
-        self._req_lock = threading.Lock()
+        self._req_lock = lockdep.lock("node_service.daemon_req")
         self._req_counter = 0
         self._pending: Dict[int, Future] = {}
         # Workers whose WORKER_DIED arrived before start_worker() could
@@ -239,7 +243,7 @@ class HeadServer:
         self._sock.listen(16)
         self.address: Tuple[str, int] = self._sock.getsockname()
         self.daemons: Dict[str, DaemonHandle] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("node_service.head_registry")
         self._stopped = False
         self._stop_event = threading.Event()
         self._accept_thread = threading.Thread(
@@ -493,6 +497,13 @@ class HeadServer:
         elif msg_type == P.NODE_REQUEST:
             self._node._handler_pool.submit(
                 self._handle_node_request, handle, payload)
+        else:
+            # Unknown daemon->head type: log, never drop silently — a
+            # daemon running newer protocol code would otherwise lose
+            # messages without a trace on either side.
+            logger.warning("head dropping unknown message type %r from "
+                           "node %s (protocol skew?)", msg_type,
+                           handle.node_id_hex[:8])
 
     def _route_from_worker(self, handle: DaemonHandle, payload: dict):
         proxy = handle.proxies.get(payload["worker"])

@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional
 
 from ..exceptions import ObjectStoreFullError
 from . import fault
+from . import lockdep
 from . import serialization
 from . import telemetry
 from .ids import ObjectID
@@ -112,7 +113,7 @@ class ObjectStore:
         self._segments: Dict[ObjectID, _Segment] = {}
         self._used = 0
         self._graveyard = []  # mmaps with live exported buffers
-        self._lock = threading.RLock()
+        self._lock = lockdep.rlock("object_store.file_store")
         # Spilling (reference: LocalObjectManager spill/restore,
         # raylet/local_object_manager.cc): sealed objects move from shm to
         # a disk directory derived from the store dir — deterministic, so
@@ -678,7 +679,7 @@ class ArenaObjectStore:
         except (RuntimeError, FileExistsError):
             self._store = _native.NativeStore(self._path, create=False)
             self._owner = False
-        self._lock = threading.RLock()
+        self._lock = lockdep.rlock("object_store.arena_store")
         # Owner-side metadata for spill candidacy (the native header has
         # no enumeration API): oid -> size, plus an LRU clock.
         self._meta: Dict[ObjectID, int] = {}

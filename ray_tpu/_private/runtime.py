@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import atexit
 import collections
+import logging
 import os
 import sys
 import threading
@@ -31,6 +32,7 @@ from ..exceptions import (
     WorkerCrashedError,
 )
 from . import gcs as gcs_mod
+from . import lockdep
 from . import protocol as P
 from . import serialization
 from . import telemetry
@@ -38,6 +40,8 @@ from .ids import ActorID, NodeID, ObjectID, TaskID
 from .object_store import ObjectStore, create_store, inline_threshold
 from .resources import detect_node_resources
 from .scheduler import ResourceManager, Scheduler, WorkerHandle, WorkerPool
+
+logger = logging.getLogger(__name__)
 
 
 def _gc_stale_sessions(max_age_s: Optional[float] = None):
@@ -136,7 +140,7 @@ class _ActorState:
         self.worker: Optional[WorkerHandle] = None
         self.ready = False
         self.dead = False
-        self.lock = threading.Lock()
+        self.lock = lockdep.lock("runtime.actor_queue")
         # Ordered pending (spec, unresolved_deps) items.
         self.queue: collections.deque = collections.deque()
         self.in_flight: Set[bytes] = set()
@@ -179,7 +183,7 @@ class Node:
         from .placement import PlacementGroupManager
         self.pg_manager = PlacementGroupManager(self.resources_mgr)
         self._pg_ready_refs: Dict[str, ObjectID] = {}
-        self._pg_ready_lock = threading.Lock()
+        self._pg_ready_lock = lockdep.lock("runtime.pg_ready")
         self.pool = WorkerPool(
             self.session_dir, self.store_dir,
             on_worker_message=self._on_worker_message,
@@ -200,16 +204,16 @@ class Node:
             max_workers=32, thread_name_prefix="handler")
         self._fn_registry: Dict[str, bytes] = {}
         self._retries_used: Dict[bytes, int] = {}
-        self._recovery_lock = threading.Lock()
+        self._recovery_lock = lockdep.lock("runtime.recovery")
         self._cancel_requested: Set[bytes] = set()
         self._actors: Dict[ActorID, _ActorState] = {}
         self._actor_dep_waiters: Dict[ObjectID, List[Tuple[_ActorState, list]]] = {}
-        self._actor_dep_lock = threading.Lock()
-        self._ready_cond = threading.Condition()
+        self._actor_dep_lock = lockdep.lock("runtime.actor_deps")
+        self._ready_cond = lockdep.condition("runtime.object_ready")
         self._release_buf: List[ObjectID] = []
-        self._release_lock = threading.Lock()
+        self._release_lock = lockdep.lock("runtime.release_buf")
         # Streaming generator tasks: task binary -> stream state
-        self._gen_lock = threading.Lock()
+        self._gen_lock = lockdep.lock("runtime.gen_streams")
         self._gen_cond = threading.Condition(self._gen_lock)
         self._gen_streams: Dict[bytes, dict] = {}
         self.gcs.objects.subscribe_ready(self._on_object_ready)
@@ -914,8 +918,9 @@ class Node:
         for cb in callbacks:
             try:
                 cb()
-            except Exception:
-                pass
+            except Exception:  # lint: broad-except-ok user callback; stream completion must reach every waiter
+                logger.debug("gen-stream done-callback for %s raised",
+                             task_id.hex()[:8], exc_info=True)
 
     def gen_add_done_callback(self, task_id: TaskID, cb) -> None:
         """Invoke `cb()` when the stream finishes (now if already done)."""
@@ -1158,8 +1163,9 @@ class Node:
         try:
             self.gcs.kv.put(spec.actor_id.hex(), cloudpickle.dumps(spec),
                             namespace=self._DETACHED_NS)
-        except Exception:
-            pass
+        except Exception:  # lint: broad-except-ok persistence is best-effort; the actor still runs this session
+            logger.debug("failed to persist detached actor %s",
+                         spec.actor_id.hex()[:8], exc_info=True)
 
     def _unpersist_detached(self, actor_id: ActorID):
         if not self._kv_durable():
@@ -1167,8 +1173,9 @@ class Node:
         try:
             self.gcs.kv.delete(actor_id.hex(),
                                namespace=self._DETACHED_NS)
-        except Exception:
-            pass
+        except Exception:  # lint: broad-except-ok best-effort unpersist; a stale record is skipped on recovery
+            logger.debug("failed to unpersist detached actor %s",
+                         actor_id.hex()[:8], exc_info=True)
 
     def recover_detached_actors(self) -> int:
         """Respawn detached actors persisted by a previous head with the
@@ -1567,8 +1574,9 @@ class Node:
                    else result}
         try:
             handle.send(P.REPLY, payload)
-        except Exception:
-            pass
+        except Exception:  # lint: broad-except-ok dead worker pipe; its death callback fails the waiter
+            logger.debug("dropping REPLY %s to dead worker %s", req_id,
+                         handle.worker_id.hex()[:8], exc_info=True)
 
     def _on_worker_messages(self, handle: WorkerHandle, msgs) -> None:
         """Burst entry (one coalesced frame from a worker's writer):
@@ -1829,6 +1837,11 @@ class Node:
                 result = self._gcs_op(payload["op"], payload["kwargs"])
                 self._reply(handle, req_id, result)
             else:
+                # Unknown worker-plane type: surface it BOTH ways — the
+                # log catches oneway messages (req_id None, nobody
+                # waits), the error reply catches request/reply skew.
+                logger.warning("head dropping unknown worker message "
+                               "type %r (protocol skew?)", msg_type)
                 self._reply(handle, req_id,
                             error=ValueError(f"unknown message {msg_type}"))
         except BaseException as e:  # noqa: BLE001

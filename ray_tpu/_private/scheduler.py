@@ -26,6 +26,7 @@ import threading
 from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 from . import fault
+from . import lockdep
 from . import protocol as P
 from . import telemetry
 from .ids import ObjectID, TaskID, WorkerID
@@ -36,7 +37,7 @@ class ResourceManager:
     LocalResourceManager, src/ray/raylet/scheduling/)."""
 
     def __init__(self, totals: Dict[str, float]):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("scheduler.resource_manager")
         self.totals = dict(totals)
         self.available = dict(totals)
         # Formatted (placement-group) resources retired by remove():
@@ -178,7 +179,7 @@ class NodeRegistry:
 
     def __init__(self, head_id_hex: str, head_rm: ResourceManager,
                  head_labels: Optional[Dict[str, str]] = None):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("scheduler.node_registry")
         self._nodes: Dict[str, NodeEntry] = {}
         self.head = NodeEntry(head_id_hex, head_rm, is_head=True,
                               labels=head_labels)
@@ -464,7 +465,7 @@ class WorkerHandle:
         self.conn = conn
         self.env_key = env_key
         self.env = env
-        self.send_lock = threading.Lock()
+        self.send_lock = lockdep.lock("scheduler.worker_send")
         # Pickled specs awaiting a coalesced EXEC_TASKS flush (guarded
         # by send_lock; see _dispatch_coalesce).
         self.coalesce_buf: list = []
@@ -479,7 +480,7 @@ class WorkerHandle:
         # pipelined dispatch two threads can target one worker, and the
         # blob-stripped second frame must not overtake the blob-carrying
         # first (the worker would see an uncached fn id).
-        self.dispatch_lock = threading.Lock()
+        self.dispatch_lock = lockdep.lock("scheduler.worker_dispatch")
         # Worker-lease pipelining (reference: the owner pushes up to
         # max_tasks_in_flight_per_worker tasks onto one leased worker,
         # direct_task_transport). The worker executes its queue
@@ -537,7 +538,7 @@ class WorkerHandle:
             mux = self.native_mux
             if mux is not None and mux.send_framed(self.native_token, data):
                 return
-            self.conn.send_bytes(data)
+            self.conn.send_bytes(data)  # lint: blocking-under-lock-ok AF_UNIX pipe to a local worker; a full pipe buffer IS the per-worker backpressure, and FIFO vs coalesce_buf requires the send under this lock
 
     def send_raw(self, data) -> None:
         """Ship an ALREADY-PICKLED message body (daemon relay path:
@@ -552,7 +553,7 @@ class WorkerHandle:
             mux = self.native_mux
             if mux is not None and mux.send_framed(self.native_token, data):
                 return
-            self.conn.send_bytes(data)
+            self.conn.send_bytes(data)  # lint: blocking-under-lock-ok same contract as send(): local pipe, FIFO vs coalesce_buf needs the send under this lock
 
     def _flush_coalesced_locked(self):
         """Ship buffered EXEC frames as one EXEC_TASKS message.
@@ -614,7 +615,7 @@ class _RecvMux:
     def __init__(self):
         import selectors
         self._sel = selectors.DefaultSelector()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("scheduler.recv_mux")
         # Self-pipe to interrupt select() for (un)registration.
         self._rd, self._wr = os.pipe()
         os.set_blocking(self._rd, False)
@@ -738,7 +739,7 @@ class _NativeMux:
         self._ctypes = ctypes
         self._core = _native.NativeDispatcher()
         self._eof_len = _native.EOF_LEN
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("scheduler.native_mux")
         # token -> (handle, on_msg, on_eof, on_batch)
         self._states: Dict[int, tuple] = {}
         self._next_token = 0
@@ -746,7 +747,7 @@ class _NativeMux:
         # Serializes native-core registration against destroy(): a
         # prestart thread's register racing shutdown must never touch a
         # freed Dispatcher (segfault), it must see _stopped instead.
-        self._reg_lock = threading.Lock()
+        self._reg_lock = lockdep.lock("scheduler.native_reg")
         self._cap = 8 << 20
         self._buf = ctypes.create_string_buffer(self._cap)
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -900,7 +901,7 @@ class WorkerPool:
         self._base_env = worker_env or {}
         self._node_id_hex = node_id_hex
         self._authkey = os.urandom(16)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("scheduler.worker_pool")
         self._mux = _make_recv_mux()
         self._idle: Dict[str, Deque[WorkerHandle]] = collections.defaultdict(
             collections.deque)
@@ -1199,7 +1200,7 @@ class Scheduler:
         # workers never share a chip (reference: tpu.py visible-chips
         # isolation; the resource COUNT alone can't prevent collisions).
         self._free_chips = list(range(int(resources.totals.get("TPU", 0))))
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("scheduler.queue")
         self._cond = threading.Condition(self._lock)
         self._ready: Deque[P.TaskSpec] = collections.deque()
         self._waiting: Dict[ObjectID, List[PendingTask]] = {}

@@ -46,10 +46,13 @@ through the environment, like RAY_TPU_FAULT_CONFIG.
 from __future__ import annotations
 
 import collections
+import logging
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 _ENV_VAR = "RAY_TPU_TELEMETRY"
 
@@ -423,15 +426,15 @@ def _refresh_head_gauges(node) -> None:
     live runtime when someone actually scrapes."""
     try:
         record_queue_depth(node.scheduler.queue_depth())
-    except Exception:
-        pass
+    except Exception:  # lint: broad-except-ok scrape-time gauge on a live runtime mid-teardown; exposition must not 500
+        logger.debug("queue-depth gauge refresh failed", exc_info=True)
     try:
         record_node_stats(
             int(getattr(node.store, "used_bytes", 0) or 0),
             len(node.pool.workers),
             len(getattr(node.scheduler, "_free_chips", ())))
-    except Exception:
-        pass
+    except Exception:  # lint: broad-except-ok scrape-time gauge on a live runtime mid-teardown; exposition must not 500
+        logger.debug("node-stats gauge refresh failed", exc_info=True)
     try:
         tstore = node.gcs.telemetry
         _metric("task_events_ingested_total_gauge", "gauge",
@@ -440,8 +443,8 @@ def _refresh_head_gauges(node) -> None:
         _metric("task_events_dropped", "gauge",
                 "Task events dropped across rings and worker buffers"
                 ).set(sum(tstore.dropped_counts().values()))
-    except Exception:
-        pass
+    except Exception:  # lint: broad-except-ok scrape-time gauge on a live runtime mid-teardown; exposition must not 500
+        logger.debug("task-event gauge refresh failed", exc_info=True)
 
 
 def federated_prometheus_text(node) -> str:

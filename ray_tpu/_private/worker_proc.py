@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import inspect
+import logging
 import os
 import sys
 import threading
@@ -38,11 +39,14 @@ import cloudpickle
 
 from ..exceptions import TaskCancelledError, TaskError
 from . import fault
+from . import lockdep
 from . import protocol as P
 from . import serialization
 from . import telemetry
 from .ids import ActorID, ObjectID, TaskID
 from .object_store import ObjectStore, create_store, inline_threshold
+
+logger = logging.getLogger(__name__)
 
 
 # Currently-executing task spec (reference: the worker's runtime
@@ -188,7 +192,7 @@ class Worker:
         from .netcomm import ConnectionWriter
         self._writer = ConnectionWriter(conn, name="worker-writer")
         self._req_counter = 0
-        self._req_lock = threading.Lock()
+        self._req_lock = lockdep.lock("worker.req")
         self._pending: Dict[int, Future] = {}
         self._fn_cache: Dict[str, Any] = {}
         # fn_id -> cloudpickled blob, stashed by the (single-threaded)
@@ -204,7 +208,7 @@ class Worker:
         self._task_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task")
         self._running: Dict[bytes, int] = {}  # task_id bytes -> thread ident
-        self._running_lock = threading.Lock()
+        self._running_lock = lockdep.lock("worker.running")
         # Cancellations for tasks queued in this worker but not yet
         # started (pipelined dispatch): checked at _execute entry.
         self._cancelled_pending: set = set()
@@ -222,7 +226,7 @@ class Worker:
         # another thread is mid-send ride along in one TASKS_DONE frame
         # (fewer owner wakeups/syscalls per task under pipelined
         # bursts); nothing ever WAITS to be sent.
-        self._done_lock = threading.Lock()
+        self._done_lock = lockdep.lock("worker.done")
         self._done_buf: list = []
         self._done_flushing = False
         # Telemetry plane: bounded lifecycle-event buffer, drained as a
@@ -236,7 +240,7 @@ class Worker:
         self._actor_executor: Optional[ThreadPoolExecutor] = None
         self._cg_executors: Dict[str, ThreadPoolExecutor] = {}
         self._actor_loop: Optional[asyncio.AbstractEventLoop] = None
-        self._actor_loop_lock = threading.Lock()
+        self._actor_loop_lock = lockdep.lock("worker.actor_loop")
         self._shutdown = threading.Event()
 
     # -- plumbing ----------------------------------------------------------
@@ -758,6 +762,13 @@ class Worker:
                 self.store.release(oid)
         elif msg_type == P.SHUTDOWN:
             return True
+        else:
+            # Never silently drop a frame: an unknown type here means
+            # protocol skew between owner and worker (version mismatch,
+            # mis-framed batch) — exactly the failure the coalesced-
+            # frame-drop bug hid. Oneway, so a log IS the surfacing.
+            logger.warning("worker dropping unknown message type %r "
+                           "(protocol skew?)", msg_type)
         return False
 
 

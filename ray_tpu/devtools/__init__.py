@@ -1,0 +1,6 @@
+"""Developer tooling for the ray_tpu codebase (not part of the runtime).
+
+Nothing under this package is imported by ``ray_tpu`` at runtime; the
+modules here are pure-stdlib so CI can run them without pulling in jax
+or the native store.
+"""

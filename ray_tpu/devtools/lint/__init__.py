@@ -1,0 +1,49 @@
+"""raylint: project-invariant static analysis for the ray_tpu runtime.
+
+The runtime is a heavily threaded multi-process system — per-connection
+writer threads, serial routing executors, four recv loops dispatching
+~30 protocol message types, and ~40 locks across ``_private/``. The bug
+classes that slip through review there are exactly the ones a machine
+can catch (a recv loop silently dropping an unknown frame, a blocking
+send under a hot lock, a typo'd fault site or config key), so — in the
+spirit of the Linux kernel's lockdep and clang-tidy's project checks,
+adapted to what pure-Python AST walking can see — this package enforces
+them mechanically. The dynamic half lives in
+``ray_tpu/_private/lockdep.py``.
+
+Passes (see docs/STATIC_ANALYSIS.md for the full catalog):
+
+    protocol-coverage   every protocol.py message constant is dispatched
+                        by each recv loop serving its plane, and every
+                        dispatch fallthrough logs unknown types
+    lock-discipline     no blocking call lexically under a designated
+                        hot-path lock
+    gate-discipline     fault sites come from the fault.SITES registry;
+                        telemetry instrumentation sits behind the
+                        falsy-flag gate; metric names are globally unique
+    broad-except        no silent ``except Exception: pass`` in _private/
+    config-keys         every ray_config key read has a declared default
+
+Pre-existing violations are ratcheted in ``baseline.json``: the suite is
+green on day one, any NEW violation fails tier-1 (tests/test_lint.py),
+and the baseline only burns down. Escape hatches are per-line comments
+(``# lint: <rule>-ok <reason>``); see core.SUPPRESS_RE.
+
+Run it:
+
+    python -m ray_tpu.devtools.lint                 # check vs baseline
+    python -m ray_tpu.devtools.lint --no-baseline   # full report
+    python -m ray_tpu.devtools.lint --update-baseline
+
+This package is pure stdlib and never imports the runtime it analyzes.
+"""
+
+from .core import LintTree, Violation, load_baseline, run_passes  # noqa: F401
+
+PASS_NAMES = (
+    "protocol-coverage",
+    "lock-discipline",
+    "gate-discipline",
+    "broad-except",
+    "config-keys",
+)

@@ -1,0 +1,7 @@
+"""``python -m ray_tpu.devtools.lint`` — see cli.py."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,111 @@
+"""Command-line entry: ``python -m ray_tpu.devtools.lint``.
+
+Modes:
+    (default)            run all passes, ratchet against baseline.json;
+                         exit 1 on any NEW violation
+    --no-baseline        full report of every violation, exit 1 if any
+    --update-baseline    rewrite baseline.json from the current tree
+    --root DIR           analyze a different tree (fixtures/tests); the
+                         baseline defaults to empty then
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import PASS_NAMES
+from .core import (LintTree, apply_baseline, fingerprint_counts,
+                   load_baseline, run_passes, save_baseline)
+
+_LINT_DIR = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_ROOT = os.path.dirname(os.path.dirname(_LINT_DIR))  # ray_tpu/
+DEFAULT_BASELINE = os.path.join(_LINT_DIR, "baseline.json")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description="raylint: project-invariant static analysis "
+                    "(docs/STATIC_ANALYSIS.md)")
+    parser.add_argument("--root", default=None,
+                        help="package directory to analyze "
+                             "(default: the installed ray_tpu package)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON path (default: the checked-in "
+                             "devtools/lint/baseline.json; empty when "
+                             "--root points elsewhere)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report everything")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from the current tree")
+    parser.add_argument("--passes", nargs="*", choices=PASS_NAMES,
+                        default=None, metavar="PASS",
+                        help="subset of passes to run")
+    parser.add_argument("-q", "--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else DEFAULT_ROOT
+    if not os.path.isdir(root):
+        print(f"raylint: no such directory: {root}", file=sys.stderr)
+        return 2
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = DEFAULT_BASELINE if args.root is None else None
+
+    tree = LintTree(root)
+    violations = run_passes(tree, args.passes)
+    per_pass = {}
+    for v in violations:
+        per_pass[v.pass_name] = per_pass.get(v.pass_name, 0) + 1
+
+    if args.update_baseline:
+        # The checked-in baseline must only ever be rewritten from a
+        # FULL run of the real tree: a narrowed run (--passes) or a
+        # foreign tree (--root) would silently clobber it — deleting
+        # the live fingerprints (every baselined violation turns NEW)
+        # or masking real ones behind fixture fingerprints.
+        if args.passes is not None:
+            print("raylint: refusing --update-baseline with --passes "
+                  "(a partial run would drop the other passes' "
+                  "baselined fingerprints)", file=sys.stderr)
+            return 2
+        if args.root is not None and args.baseline is None:
+            print("raylint: --update-baseline with --root requires an "
+                  "explicit --baseline path (refusing to overwrite the "
+                  "checked-in baseline with another tree's results)",
+                  file=sys.stderr)
+            return 2
+        path = baseline_path or DEFAULT_BASELINE
+        save_baseline(path, violations)
+        print(f"raylint: baseline updated: {path} "
+              f"({len(violations)} violations, "
+              f"{len(fingerprint_counts(violations))} fingerprints)")
+        return 0
+
+    baseline = {}
+    if baseline_path and not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+    res = apply_baseline(violations, baseline)
+
+    if not args.quiet:
+        for v in res.new:
+            print(v.render())
+        if res.fixed:
+            print(f"raylint: {len(res.fixed)} baselined fingerprint(s) "
+                  f"no longer fire — burn them down with "
+                  f"--update-baseline:")
+            for fp in sorted(res.fixed):
+                print(f"  stale: {fp}")
+        summary = ", ".join(f"{k}={per_pass.get(k, 0)}"
+                            for k in PASS_NAMES)
+        print(f"raylint: {len(violations)} total ({summary}); "
+              f"{len(violations) - len(res.new)} baselined, "
+              f"{len(res.new)} new")
+    return 1 if res.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""Project registries: the raylint passes' knowledge of the runtime.
+
+This is the ONE file to touch when the control plane grows — a new recv
+loop, a newly-designated hot lock, a new plane. Everything is declared
+by (file, class/function, name) so the passes stay generic and the
+fixture trees in tests/test_lint.py can mirror the layout.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# protocol-coverage: the recv loops and the planes they serve.
+#
+# Planes are derived from _private/protocol.py itself (section headers +
+# per-constant direction comments; see protocol_coverage._parse_planes):
+#
+#   to_worker       driver/daemon -> worker control messages
+#   from_worker     worker -> owner messages (both recv muxes)
+#   head_to_daemon  head -> node daemon control
+#   daemon_to_head  node daemon -> head
+#
+# Each loop entry:
+#   file         path relative to the lint root
+#   functions    the dispatch spans these qualnames together (a loop may
+#                fan out across helper methods — coverage is their union)
+#   plane        which plane's constants must ALL be dispatched
+#   dispatch_vars  names the message-type variable goes by in those
+#                functions (comparisons against other vars are ignored)
+#   fallthrough  qualname whose terminal else/trailing code must HANDLE
+#                unknown types (log / counter / reply / relay) instead of
+#                silently dropping the frame; None for relay loops whose
+#                fallthrough IS the relay (checked via relay=True)
+#   relay        the loop forwards anything it doesn't special-case, so
+#                full-plane coverage is satisfied by construction
+#   exempt       {CONSTANT: reason} — intentionally not dispatched here;
+#                the reason is mandatory and surfaces in reports
+# ---------------------------------------------------------------------------
+RECV_LOOPS = {
+    "worker.run": {
+        "file": "_private/worker_proc.py",
+        "functions": ("Worker._handle_message",),
+        "plane": "to_worker",
+        "dispatch_vars": ("msg_type",),
+        "fallthrough": "Worker._handle_message",
+        "relay": False,
+        "exempt": {},
+    },
+    "head.worker_mux": {
+        # The head's worker-plane recv mux: burst entry + single-message
+        # router + the blocking/quick handler split.
+        "file": "_private/runtime.py",
+        "functions": ("Node._on_worker_messages", "Node._on_worker_message",
+                      "Node._handle_blocking_request",
+                      "Node._handle_quick_request"),
+        "plane": "from_worker",
+        "dispatch_vars": ("msg_type",),
+        "fallthrough": "Node._handle_quick_request",
+        "relay": False,
+        "exempt": {},
+    },
+    "daemon.worker_mux": {
+        # The daemon's worker-plane recv mux special-cases node-local
+        # operations (pulls, spill, view) and location tagging, then
+        # relays EVERYTHING else to the head as FROM_WORKER — coverage
+        # of the plane is by construction (relay=True); the pass still
+        # validates that the constants it does mention are plane
+        # members.
+        "file": "_private/daemon.py",
+        "functions": ("NodeDaemon._on_worker_message",),
+        "plane": "from_worker",
+        "dispatch_vars": ("msg_type",),
+        "fallthrough": None,
+        "relay": True,
+        "exempt": {},
+    },
+    "daemon.run": {
+        "file": "_private/daemon.py",
+        "functions": ("NodeDaemon._route", "NodeDaemon._route_worker_plane"),
+        "plane": "head_to_daemon",
+        "dispatch_vars": ("msg_type",),
+        "fallthrough": "NodeDaemon._route",
+        "relay": False,
+        "exempt": {
+            "NODE_ACK": "consumed synchronously by the registration "
+                        "handshake (_connect_head) before run() starts; "
+                        "an ACK arriving later is an unknown-type log",
+        },
+    },
+    "head.daemon_serve": {
+        "file": "_private/node_service.py",
+        "functions": ("HeadServer._serve_daemon", "HeadServer._route"),
+        "plane": "daemon_to_head",
+        "dispatch_vars": ("msg_type",),
+        "fallthrough": "HeadServer._route",
+        "relay": False,
+        "exempt": {},
+    },
+}
+
+# Calls that count as "handling" a fallthrough (vs silently dropping):
+# logging, a metrics/counter bump, an error reply, a relay send, raise.
+FALLTHROUGH_HANDLER_ATTRS = frozenset({
+    "debug", "info", "warning", "error", "exception", "log",
+    "inc", "_reply", "send", "_send",
+})
+
+# ---------------------------------------------------------------------------
+# lock-discipline: designated hot-path locks, scoped (file, class) ->
+# {attr name, ...}. A `with self.<attr>:` in that class is a hot
+# section: no blocking call may sit lexically inside it (escape hatch:
+# `# lint: blocking-under-lock-ok <reason>`).
+#
+# These are the locks on the recv/dispatch/writer hot paths — the ones
+# where a blocked holder stalls frame parsing, dispatch, or teardown for
+# every other thread. Registry-driven so newly-hot locks are ONE line.
+# ---------------------------------------------------------------------------
+HOT_LOCKS = {
+    ("_private/netcomm.py", "ConnectionWriter"): {"_cond"},
+    ("_private/netcomm.py", "SerialExecutor"): {"_cond"},
+    ("_private/netcomm.py", "HostCopyGate"): {"_lock"},
+    ("_private/scheduler.py", "Scheduler"): {"_lock", "_cond"},
+    ("_private/scheduler.py", "WorkerHandle"): {"send_lock",
+                                                "dispatch_lock"},
+    ("_private/scheduler.py", "WorkerPool"): {"_lock"},
+    ("_private/daemon.py", "NodeDaemon"): {"_lock", "_conn_lock",
+                                           "_req_lock"},
+    ("_private/node_service.py", "DaemonHandle"): {"_lock", "_req_lock"},
+    ("_private/node_service.py", "HeadServer"): {"_lock"},
+    ("_private/node_service.py", "RemoteWorkerProxy"): {"dispatch_lock"},
+    ("_private/worker_proc.py", "Worker"): {"_req_lock", "_running_lock",
+                                            "_done_lock"},
+    ("_private/runtime.py", "Node"): {"_release_lock", "_gen_lock",
+                                      "_actor_dep_lock"},
+}
+
+# Blocking-call shapes (see lock_discipline for the matching rules).
+BLOCKING_ATTRS = frozenset({
+    # socket / pipe IO
+    "send", "sendall", "sendmsg", "send_bytes", "sendfile",
+    "recv", "recv_bytes", "recv_into", "recvmsg", "recv_bytes_into",
+    "connect", "accept", "flush",
+    # blocking waits (Condition.wait on the SAME lock is the one
+    # legitimate blocking op under a lock and is excluded in the pass)
+    "result",
+    # serialization of payloads
+    "dumps", "dump_message", "dump_messages", "dump_message_parts",
+})
+BLOCKING_OS_ATTRS = frozenset({
+    "read", "write", "writev", "sendfile", "pread", "pwrite",
+})
+BLOCKING_MODULES = frozenset({"subprocess", "shutil"})
+
+# ---------------------------------------------------------------------------
+# gate-discipline
+# ---------------------------------------------------------------------------
+# Module aliases whose `.enabled` truthiness is THE gate; instrumentation
+# helper calls must sit under an `if <alias>.enabled` (any depth).
+GATED_MODULES = ("telemetry", "fault")
+# Files that implement the planes themselves (helpers live here; their
+# internal calls are exempt from the gating requirement).
+GATE_IMPL_FILES = ("_private/telemetry.py", "_private/fault.py")
+
+# ---------------------------------------------------------------------------
+# broad-except: scope — only the runtime core is held to the standard.
+# ---------------------------------------------------------------------------
+BROAD_EXCEPT_PREFIX = "_private/"

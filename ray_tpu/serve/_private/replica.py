@@ -143,9 +143,9 @@ class Replica:
         finally:
             self._ongoing -= 1
             if t0 is not None:
-                telemetry.serve_replica_request(self._deployment_name,
+                telemetry.serve_replica_request(self._deployment_name,  # lint: ungated-instrumentation-ok t0 is non-None only when telemetry.enabled was set at entry
                                                 time.monotonic() - t0)
-                telemetry.serve_replica_ongoing(self._deployment_name,
+                telemetry.serve_replica_ongoing(self._deployment_name,  # lint: ungated-instrumentation-ok t0 gate, as above
                                                 self._ongoing)
 
     def _resolve_target(self, method_name: str):

@@ -44,6 +44,62 @@ def pytest_configure(config):
         "perf_smoke: fast, deterministic performance guards (syscall/"
         "write-count based, never wall-clock) — run in tier-1 and "
         "selectable standalone via `-m perf_smoke`")
+    config.addinivalue_line(
+        "markers",
+        "lint: project-invariant static-analysis suite "
+        "(ray_tpu/devtools/lint) run against the live tree in tier-1; "
+        "selectable standalone via `-m lint`")
+
+
+# Suites that run under the dynamic lock-order tracker
+# (_private/lockdep.py): the transport-framing tier exercises the
+# writer/executor/gate locks directly, and the chaos tier drives the
+# whole control plane through failure paths — both must come out with
+# ZERO potential-ABBA cycles. Assertion per test so a report is
+# attributable to the test that produced it.
+_LOCKDEP_SUITES = {"test_transport_framing", "test_fault_injection"}
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_guard(request, tmp_path_factory):
+    name = getattr(request.module, "__name__", "")
+    if name.rpartition(".")[2] not in _LOCKDEP_SUITES:
+        yield
+        return
+    from ray_tpu._private import lockdep
+    lockdep.reset()
+    prev = lockdep.enabled
+    # Spill dir: cycles recorded in SPAWNED daemons/workers (which
+    # inherit RAY_TPU_LOCKDEP=1) are process-local and die with them —
+    # every process appends cycles here at record time, so the
+    # assertion below covers the whole process tree, not just the head.
+    dump_dir = str(tmp_path_factory.mktemp("lockdep"))
+    prev_dir = os.environ.get("RAY_TPU_LOCKDEP_DIR")
+    os.environ["RAY_TPU_LOCKDEP_DIR"] = dump_dir
+    lockdep.configure(True)
+    try:
+        yield
+        cycles = list(lockdep.cycle_reports())
+        seen = {(tuple(c["cycle"]), c.get("pid")) for c in cycles}
+        for rep in lockdep.collect_dumped_cycles(dump_dir):
+            key = (tuple(rep["cycle"]), rep.get("pid"))
+            if key not in seen:
+                seen.add(key)
+                cycles.append(rep)
+        if cycles:
+            child = [c for c in cycles if c.get("pid") != os.getpid()]
+            pytest.fail(
+                f"lockdep: {len(cycles)} potential ABBA deadlock(s) "
+                f"recorded during this test ({len(child)} in child "
+                f"processes):\n" + lockdep.format_reports()
+                + "".join(f"\n[child pid {c.get('pid')}] cycle "
+                          f"{' -> '.join(c['cycle'])}" for c in child))
+    finally:
+        lockdep.configure(prev)
+        if prev_dir is None:
+            os.environ.pop("RAY_TPU_LOCKDEP_DIR", None)
+        else:
+            os.environ["RAY_TPU_LOCKDEP_DIR"] = prev_dir
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,564 @@
+"""raylint tier-1 suite: the live tree must be clean vs the baseline,
+and every pass must catch a synthetically introduced violation
+(fixture mini-trees mirroring the registry's file layout), including
+through the real ``python -m ray_tpu.devtools.lint`` entry point.
+
+Budget: the live-tree run parses the package once (~1s); fixture trees
+are a handful of files each. No cluster is started anywhere here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu.devtools.lint import cli, core
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _run(root, passes=None):
+    return core.run_passes(core.LintTree(root), passes)
+
+
+# ---------------------------------------------------------------------------
+# the live tree
+# ---------------------------------------------------------------------------
+def test_live_tree_zero_unbaselined_violations():
+    """All five passes over the real package: nothing beyond the
+    checked-in baseline (the ratchet contract — any NEW violation
+    fails tier-1 right here)."""
+    rc = cli.main(["-q"])
+    if rc != 0:
+        # Re-run loudly so the failure output names the violations.
+        cli.main([])
+    assert rc == 0
+
+
+def test_live_tree_baseline_is_broad_except_only():
+    """The baseline holds ONLY pre-existing broad-except swallows: the
+    other four passes are clean at zero and must stay there (they have
+    no burn-down debt to hide behind)."""
+    baseline = core.load_baseline(cli.DEFAULT_BASELINE)
+    assert baseline, "checked-in baseline missing or empty"
+    wrong = [fp for fp in baseline if not fp.startswith("broad-except:")]
+    assert wrong == []
+
+
+# ---------------------------------------------------------------------------
+# per-pass synthetic violations (fixture trees)
+# ---------------------------------------------------------------------------
+_PROTO = """\
+    # Message types: driver -> worker
+    EXEC_TASK = "exec_task"
+    SHUTDOWN = "shutdown"
+"""
+
+
+def test_protocol_coverage_missing_dispatch_and_fallthrough(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class Worker:
+                def _handle_message(self, msg_type, payload):
+                    if msg_type == P.EXEC_TASK:
+                        return False
+                    return False
+        """,
+    })
+    vs = _run(root, ["protocol-coverage"])
+    keys = {v.key for v in vs}
+    assert "missing:worker.run:SHUTDOWN" in keys
+    assert "fallthrough:Worker._handle_message" in keys
+
+
+def test_protocol_coverage_clean_loop_passes(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PROTO,
+        "_private/worker_proc.py": """\
+            import logging
+            from . import protocol as P
+            logger = logging.getLogger(__name__)
+
+            class Worker:
+                def _handle_message(self, msg_type, payload):
+                    if msg_type == P.EXEC_TASK:
+                        return False
+                    elif msg_type == P.SHUTDOWN:
+                        return True
+                    else:
+                        logger.warning("unknown %r", msg_type)
+                    return False
+        """,
+    })
+    assert _run(root, ["protocol-coverage"]) == []
+
+
+def test_protocol_coverage_undirected_constant(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/protocol.py": """\
+            # Message types: per-host daemon <-> head control service
+            MYSTERY = "mystery"
+        """,
+    })
+    keys = {v.key for v in _run(root, ["protocol-coverage"])}
+    assert "undirected:MYSTERY" in keys
+
+
+def test_lock_discipline_blocking_under_hot_lock(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/netcomm.py": """\
+            import threading
+            import time
+
+            class ConnectionWriter:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def bad(self):
+                    with self._cond:
+                        time.sleep(1.0)
+
+                def fine(self):
+                    with self._cond:
+                        x = 1
+                    time.sleep(0.0)
+                    return x
+        """,
+    })
+    vs = _run(root, ["lock-discipline"])
+    assert len(vs) == 1
+    assert vs[0].key == "ConnectionWriter._cond:time.sleep()"
+    assert vs[0].scope == "ConnectionWriter.bad"
+
+
+def test_lock_discipline_annotation_suppresses(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/netcomm.py": """\
+            import threading
+            import time
+
+            class ConnectionWriter:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def bounded(self):
+                    with self._cond:
+                        time.sleep(0.001)  # lint: blocking-under-lock-ok bounded debounce, measured
+        """,
+    })
+    assert _run(root, ["lock-discipline"]) == []
+
+
+def test_lock_discipline_annotation_without_reason_does_not_suppress(
+        tmp_path):
+    root = _tree(tmp_path, {
+        "_private/netcomm.py": """\
+            import threading
+            import time
+
+            class ConnectionWriter:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def bad(self):
+                    with self._cond:
+                        time.sleep(0.001)  # lint: blocking-under-lock-ok
+        """,
+    })
+    assert len(_run(root, ["lock-discipline"])) == 1
+
+
+def test_gate_discipline_unknown_site_and_ungated(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/fault.py": 'SITES = ("net.connect",)\n',
+        "_private/stuff.py": """\
+            from . import fault
+
+            def a():
+                if fault.enabled:
+                    fault.fire("net.typo")
+
+            def b():
+                fault.fire("net.connect")
+
+            def c():
+                if fault.enabled:
+                    fault.fire("net.connect")
+        """,
+    })
+    keys = {v.key for v in _run(root, ["gate-discipline"])}
+    assert "unknown-site:net.typo" in keys
+    assert "ungated:fault.fire" in keys
+    # c() is fully clean — exactly two distinct defects.
+    assert len(keys) == 2
+
+
+def test_gate_discipline_polarity_branch_and_plane(tmp_path):
+    """The gate check is polarity-, branch-, and plane-aware: an
+    inverted gate (instrumentation running only when the plane is
+    OFF), a call in the wrong branch, or a guard testing the WRONG
+    plane module must all flag — the exact bug class the pass exists
+    to catch."""
+    root = _tree(tmp_path, {
+        "_private/fault.py": 'SITES = ("net.connect",)\n',
+        "_private/telemetry.py": """\
+            enabled = True
+            _ops = 0
+
+            def record_x():
+                global _ops
+                _ops += 1
+        """,
+        "_private/stuff.py": """\
+            from . import fault
+            from . import telemetry
+
+            def inverted():
+                if not telemetry.enabled:
+                    telemetry.record_x()
+
+            def wrong_branch():
+                if telemetry.enabled:
+                    pass
+                else:
+                    telemetry.record_x()
+
+            def wrong_plane():
+                if fault.enabled:
+                    telemetry.record_x()
+
+            def gated_else():
+                if not telemetry.enabled:
+                    pass
+                else:
+                    telemetry.record_x()
+
+            def gated_compound():
+                x = 1
+                if telemetry.enabled and x:
+                    telemetry.record_x()
+        """,
+    })
+    vs = [v for v in _run(root, ["gate-discipline"])
+          if v.key.startswith("ungated:")]
+    scopes = sorted(v.scope for v in vs)
+    assert scopes == ["inverted", "wrong_branch", "wrong_plane"]
+
+
+def test_protocol_coverage_checks_every_dispatch_chain(tmp_path):
+    """A silent-drop chain that is not the LAST chain in the function
+    is still flagged: here the per-message loop chain drops unmatched
+    types on the floor (nothing follows it inside the loop), while a
+    later chain logs properly — only checking the max-lineno chain
+    would miss it. A non-terminal early chain whose following code
+    handles/dispatches passes by construction (the region walk sees
+    those calls)."""
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PROTO,
+        "_private/worker_proc.py": """\
+            import logging
+            from . import protocol as P
+            logger = logging.getLogger(__name__)
+
+            class Worker:
+                def _handle_message(self, msgs, payload):
+                    for msg_type in msgs:
+                        if msg_type == P.EXEC_TASK:
+                            x = 1
+                        # unmatched types silently dropped per-message
+                    msg_type = msgs[-1]
+                    if msg_type == P.SHUTDOWN:
+                        return True
+                    else:
+                        logger.warning("unknown %r", msg_type)
+                    return False
+        """,
+    })
+    vs = [v for v in _run(root, ["protocol-coverage"])
+          if v.key.startswith("fallthrough:")]
+    assert len(vs) == 1  # the loop chain; the terminal one logs
+
+
+def test_gate_discipline_duplicate_metric_kinds(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/a.py": """\
+            from ..util.metrics import Counter
+            m = Counter("jobs_total", "desc")
+        """,
+        "_private/b.py": """\
+            from ..util.metrics import Gauge
+            m = Gauge("jobs_total", "desc")
+        """,
+    })
+    vs = _run(root, ["gate-discipline"])
+    assert {v.key for v in vs} == {"dup-metric:jobs_total"}
+    assert len(vs) == 2  # reported at both definition sites
+
+
+def test_broad_except_swallow_flagged_and_annotated(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/x.py": """\
+            def bad():
+                try:
+                    1 / 0
+                except Exception:
+                    pass
+
+            def annotated():
+                try:
+                    1 / 0
+                except Exception:  # lint: broad-except-ok divide probe, failure means feature off
+                    pass
+
+            def handles():
+                try:
+                    1 / 0
+                except Exception as e:
+                    result = e
+                    return result
+        """,
+        "util/outside_scope.py": """\
+            def elsewhere():
+                try:
+                    1 / 0
+                except Exception:
+                    pass
+        """,
+    })
+    vs = _run(root, ["broad-except"])
+    assert len(vs) == 1
+    assert vs[0].scope == "bad"
+
+
+def test_config_keys_typo_flagged(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/config.py": """\
+            class RayConfig:
+                _DEFAULTS = {"pull_retry_attempts": 4}
+
+            ray_config = RayConfig()
+        """,
+        "_private/y.py": """\
+            from .config import ray_config
+
+            def ok():
+                return ray_config.pull_retry_attempts
+
+            def typo():
+                return ray_config.pull_rety_attempts
+
+            def setter_typo():
+                ray_config.set("pull_retry_attemps", 1)
+        """,
+    })
+    keys = {v.key for v in _run(root, ["config-keys"])}
+    assert keys == {"unknown-key:pull_rety_attempts",
+                    "unknown-key:pull_retry_attemps"}
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet semantics
+# ---------------------------------------------------------------------------
+def test_baseline_ratchet_counts(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/x.py": """\
+            def f():
+                try:
+                    pass
+                except Exception:
+                    pass
+        """,
+    })
+    vs = _run(root, ["broad-except"])
+    assert len(vs) == 1
+    bl = str(tmp_path / "baseline.json")
+    core.save_baseline(bl, vs)
+    # Same tree vs its own baseline: clean.
+    res = core.apply_baseline(vs, core.load_baseline(bl))
+    assert res.new == [] and res.fixed == []
+    # A SECOND identical swallow in the same scope exceeds the
+    # baselined count -> new.
+    (tmp_path / "_private/x.py").write_text(textwrap.dedent("""\
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+            try:
+                pass
+            except Exception:
+                pass
+    """))
+    vs2 = _run(str(tmp_path), ["broad-except"])
+    res2 = core.apply_baseline(vs2, core.load_baseline(bl))
+    assert len(res2.new) == 1
+    # Fixing the code makes the entry stale (burn-down signal).
+    (tmp_path / "_private/x.py").write_text("def f():\n    pass\n")
+    res3 = core.apply_baseline(_run(str(tmp_path), ["broad-except"]),
+                               core.load_baseline(bl))
+    assert res3.new == [] and len(res3.fixed) == 1
+
+
+def test_baseline_file_has_per_pass_counts_header():
+    with open(cli.DEFAULT_BASELINE) as f:
+        data = json.load(f)
+    header = "\n".join(data["__comment__"])
+    assert "Per-pass counts" in header
+    assert "broad-except" in header
+
+
+# ---------------------------------------------------------------------------
+# the real CLI entry point (acceptance: `python -m ray_tpu.devtools.lint`
+# exits nonzero on a synthetic violation)
+# ---------------------------------------------------------------------------
+def test_cli_module_entry_point_exits_nonzero(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/x.py": """\
+            def f():
+                try:
+                    pass
+                except Exception:
+                    pass
+        """,
+    })
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.lint", "--root", root],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "broad-except" in proc.stdout
+    # --update-baseline then re-check: green.
+    bl = str(tmp_path / "bl.json")
+    for args, want in ((["--update-baseline", "--baseline", bl], 0),
+                       (["--baseline", bl], 0)):
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.devtools.lint",
+             "--root", root] + args,
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=120)
+        assert proc.returncode == want, proc.stdout + proc.stderr
+
+
+_VIOLATION_FIXTURES = {
+    "protocol-coverage": {
+        "_private/protocol.py": _PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class Worker:
+                def _handle_message(self, msg_type, payload):
+                    if msg_type == P.EXEC_TASK:
+                        return False
+                    return False
+        """,
+    },
+    "lock-discipline": {
+        "_private/netcomm.py": """\
+            import threading
+            import time
+
+            class ConnectionWriter:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def bad(self):
+                    with self._cond:
+                        time.sleep(1.0)
+        """,
+    },
+    "gate-discipline": {
+        "_private/fault.py": 'SITES = ("net.connect",)\n',
+        "_private/stuff.py": """\
+            from . import fault
+
+            def f():
+                if fault.enabled:
+                    fault.fire("net.typo")
+        """,
+    },
+    "broad-except": {
+        "_private/x.py": """\
+            def f():
+                try:
+                    pass
+                except Exception:
+                    pass
+        """,
+    },
+    "config-keys": {
+        "_private/config.py": """\
+            class RayConfig:
+                _DEFAULTS = {"alpha": 1}
+
+            ray_config = RayConfig()
+        """,
+        "_private/y.py": """\
+            from .config import ray_config
+
+            def f():
+                return ray_config.alhpa
+        """,
+    },
+}
+
+
+@pytest.mark.parametrize("pass_name", sorted(_VIOLATION_FIXTURES))
+def test_cli_exits_nonzero_per_pass_violation(pass_name, tmp_path,
+                                              capsys):
+    """Acceptance: the CLI exits nonzero on a synthetically introduced
+    violation of EACH pass (cli.main is the exact `python -m` code
+    path; the subprocess test above covers the interpreter entry)."""
+    root = _tree(tmp_path, _VIOLATION_FIXTURES[pass_name])
+    rc = cli.main(["--root", root])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert f"[{pass_name}]" in out
+
+
+def test_cli_in_process_flags(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/x.py": "def f():\n    pass\n",
+    })
+    assert cli.main(["--root", root, "-q"]) == 0
+    assert cli.main(["--root", "/nonexistent-raylint-dir"]) == 2
+
+
+def test_update_baseline_refuses_narrowed_scope(tmp_path):
+    """The checked-in baseline can only be rewritten by a FULL run of
+    the real tree: --passes (partial) and --root without an explicit
+    --baseline (foreign tree) must refuse, not clobber."""
+    root = _tree(tmp_path, {
+        "_private/x.py": """\
+            def f():
+                try:
+                    pass
+                except Exception:
+                    pass
+        """,
+    })
+    before = open(cli.DEFAULT_BASELINE, "rb").read()
+    assert cli.main(["--root", root, "--update-baseline"]) == 2
+    assert cli.main(["--passes", "broad-except",
+                     "--update-baseline"]) == 2
+    assert open(cli.DEFAULT_BASELINE, "rb").read() == before
+    # Explicit --baseline keeps fixture flows working.
+    bl = str(tmp_path / "bl.json")
+    assert cli.main(["--root", root, "--update-baseline",
+                     "--baseline", bl]) == 0
+    assert os.path.exists(bl)

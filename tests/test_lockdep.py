@@ -1,0 +1,272 @@
+"""Lockdep (runtime lock-order tracker) suite: seeded ABBA detection
+with both stacks, clean consistent-order runs, the hold watchdog, and
+the zero-work disabled path (perf_smoke, counter-based — the same
+guard pattern as the telemetry plane's)."""
+
+import os
+import threading
+
+import pytest
+
+from ray_tpu._private import lockdep
+
+
+@pytest.fixture(autouse=True)
+def _fresh_lockdep():
+    prev = lockdep.enabled
+    lockdep.reset()
+    yield
+    lockdep.configure(prev, propagate_env=False)
+    lockdep.reset()
+
+
+def _in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(10.0)
+    assert not t.is_alive()
+
+
+def test_seeded_abba_detected_with_both_stacks():
+    """Two threads acquiring (A then B) and (B then A) SEQUENTIALLY —
+    no actual race needed (the lockdep property) — produce exactly one
+    cycle report carrying the stacks of both conflicting
+    acquisitions."""
+    lockdep.configure(True, propagate_env=False)
+    a = lockdep.lock("t.A")
+    b = lockdep.lock("t.B")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    _in_thread(order_ab)
+    _in_thread(order_ba)
+    reports = lockdep.cycle_reports()
+    assert len(reports) == 1
+    rep = reports[0]
+    assert set(rep["cycle"]) == {"t.A", "t.B"}
+    # Both stacks of the closing edge, plus both stacks of the edge
+    # that established the reverse order.
+    for key in ("stack_a", "stack_b", "reverse_stack_a",
+                "reverse_stack_b"):
+        assert "test_lockdep.py" in rep[key], (key, rep[key])
+    assert rep["stack_b"].count("order_ba")
+    assert rep["reverse_stack_b"].count("order_ab")
+    # The human-readable rendering names the cycle.
+    text = lockdep.format_reports()
+    assert "POTENTIAL ABBA DEADLOCK" in text
+    assert "t.A" in text and "t.B" in text
+
+
+def test_consistent_order_is_clean():
+    lockdep.configure(True, propagate_env=False)
+    a = lockdep.lock("c.A")
+    b = lockdep.lock("c.B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    for _ in range(5):
+        _in_thread(ab)
+    assert lockdep.cycle_reports() == []
+
+
+def test_three_lock_cycle_detected():
+    """A->B, B->C, then C->A closes a 3-cycle (not just direct ABBA)."""
+    lockdep.configure(True, propagate_env=False)
+    locks = {n: lockdep.lock(f"tri.{n}") for n in "ABC"}
+
+    def pair(x, y):
+        def go():
+            with locks[x]:
+                with locks[y]:
+                    pass
+        return go
+
+    _in_thread(pair("A", "B"))
+    _in_thread(pair("B", "C"))
+    assert lockdep.cycle_reports() == []
+    _in_thread(pair("C", "A"))
+    reports = lockdep.cycle_reports()
+    assert len(reports) == 1
+    assert set(reports[0]["cycle"]) == {"tri.A", "tri.B", "tri.C"}
+
+
+def test_rlock_reentrancy_not_a_cycle():
+    lockdep.configure(True, propagate_env=False)
+    r = lockdep.rlock("re.R")
+    other = lockdep.lock("re.O")
+
+    def go():
+        with r:
+            with r:        # reentrant: no ordering info
+                with other:
+                    pass
+    _in_thread(go)
+    assert lockdep.cycle_reports() == []
+
+
+def test_condition_wait_tracks_release_and_reacquire():
+    lockdep.configure(True, propagate_env=False)
+    cond = lockdep.condition("cv.C")
+    other = lockdep.lock("cv.O")
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=0.05)
+            # Re-acquired after the timed-out wait; taking another lock
+            # records the edge without error.
+            with other:
+                pass
+    _in_thread(waiter)
+    assert lockdep.cycle_reports() == []
+
+
+def test_hold_watchdog_flags_long_hold(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCKDEP_HOLD_S", "0.02")
+    lockdep.configure(True, propagate_env=False)
+    lk = lockdep.lock("hold.L")
+
+    import time
+
+    def go():
+        with lk:
+            time.sleep(0.08)
+    _in_thread(go)
+    holds = lockdep.hold_reports()
+    assert len(holds) == 1
+    assert holds[0]["name"] == "hold.L"
+    assert holds[0]["held_s"] >= 0.02
+    # Watchdog reports are advisory: NOT in the cycle (failure) set.
+    assert lockdep.cycle_reports() == []
+
+
+@pytest.mark.perf_smoke
+def test_disabled_path_does_zero_lockdep_work():
+    """fault.py/telemetry.py discipline: disabled, the factory returns
+    PLAIN threading primitives (no wrapper in the acquire path at all)
+    and the instrumentation-op counter stays untouched — counter-based,
+    never wall-clock."""
+    lockdep.configure(False, propagate_env=False)
+    lk = lockdep.lock("off.L")
+    rl = lockdep.rlock("off.R")
+    cv = lockdep.condition("off.C")
+    assert type(lk) is type(threading.Lock())
+    assert type(rl) is type(threading.RLock())
+    assert type(cv) is threading.Condition
+    before = lockdep.instrument_ops()
+    for _ in range(2000):
+        with lk:
+            pass
+        with rl:
+            pass
+    with cv:
+        cv.notify_all()
+    assert lockdep.instrument_ops() == before
+
+
+def test_condition_is_reentrant_like_production(monkeypatch):
+    """Diagnostic mode must OBSERVE, not change, lock semantics:
+    threading.Condition() defaults to an RLock, so the tracked
+    condition must too — a reentrant hold that is legal in production
+    must not deadlock only under lockdep."""
+    lockdep.configure(True, propagate_env=False)
+    cond = lockdep.condition("re.cond")
+    with cond:
+        with cond:          # reentrant: deadlocks on a plain Lock
+            pass
+        # wait() must drop the WHOLE recursion and restore it.
+        with cond:
+            cond.wait(timeout=0.01)
+    assert lockdep.cycle_reports() == []
+
+
+def test_configure_off_stops_tracking_existing_wrappers():
+    """configure(False) halts recording immediately even for wrappers
+    created while enabled (stale per-thread holds still pop cleanly,
+    so re-enabling can't see fabricated edges)."""
+    lockdep.configure(True, propagate_env=False)
+    a = lockdep.lock("late.A")
+    b = lockdep.lock("late.B")
+    lockdep.configure(False, propagate_env=False)
+    ops = lockdep.instrument_ops()
+
+    def ba():
+        with b:
+            with a:
+                pass
+    _in_thread(ba)
+    assert lockdep.instrument_ops() == ops
+    # The reverse order was never recorded, so re-enabling and running
+    # the consistent order reports nothing.
+    lockdep.configure(True, propagate_env=False)
+
+    def ab():
+        with a:
+            with b:
+                pass
+    _in_thread(ab)
+    assert lockdep.cycle_reports() == []
+
+
+def test_child_process_cycles_collected_via_dump_dir(tmp_path,
+                                                     monkeypatch):
+    """Cycles recorded in spawned processes (which die with their
+    in-memory reports) surface through RAY_TPU_LOCKDEP_DIR — the
+    channel the conftest guard asserts over for the whole tree."""
+    import subprocess
+    import sys
+    import textwrap
+
+    dump = str(tmp_path)
+    env = dict(os.environ, RAY_TPU_LOCKDEP="1",
+               RAY_TPU_LOCKDEP_DIR=dump,
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    child = textwrap.dedent("""\
+        import threading
+        from ray_tpu._private import lockdep
+        a = lockdep.lock("child.A"); b = lockdep.lock("child.B")
+        def ab():
+            with a:
+                with b: pass
+        def ba():
+            with b:
+                with a: pass
+        for fn in (ab, ba):
+            t = threading.Thread(target=fn); t.start(); t.join()
+        assert len(lockdep.cycle_reports()) == 1
+    """)
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    reports = lockdep.collect_dumped_cycles(dump)
+    assert len(reports) == 1
+    assert set(reports[0]["cycle"]) == {"child.A", "child.B"}
+    assert reports[0]["pid"] != os.getpid()
+
+
+def test_env_propagation_to_children():
+    # Save/restore: an operator-provided RAY_TPU_LOCKDEP=1 in the
+    # outer environment must survive this test (later suites' spawned
+    # daemons read it).
+    prev = os.environ.get("RAY_TPU_LOCKDEP")
+    try:
+        lockdep.configure(True)
+        assert os.environ.get("RAY_TPU_LOCKDEP") == "1"
+        lockdep.configure(False)
+        assert "RAY_TPU_LOCKDEP" not in os.environ
+    finally:
+        if prev is not None:
+            os.environ["RAY_TPU_LOCKDEP"] = prev
+        else:
+            os.environ.pop("RAY_TPU_LOCKDEP", None)
