@@ -10,6 +10,7 @@ attached — flagship GPT train-step tokens/s.
 
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -62,10 +63,18 @@ def bench_core(extras):
     # warmup: spin up workers, cache functions
     ray_tpu.get([nop.remote() for _ in range(100)])
 
-    def best_of(reps, fn):
+    def best_of(reps, fn, key=None):
         """Best-of-N like the reference's microbenchmark harness: on a
-        shared machine one rep can eat a scheduling hiccup."""
-        return max(fn() for _ in range(reps))
+        shared machine one rep can eat a scheduling hiccup. With `key`,
+        the per-rep spread (min/median/max) lands in extras — this box
+        swings ~1.7x between same-state runs (PR 2 caveat), so a bare
+        best-of number is not comparable across rounds without it."""
+        vals = sorted(fn() for _ in range(reps))
+        if key is not None:
+            extras[f"spread_{key}"] = [
+                round(vals[0], 1), round(statistics.median(vals), 1),
+                round(vals[-1], 1)]
+        return vals[-1]
 
     # single client tasks sync (ray_perf.py:174 pattern)
     def _sync():
@@ -74,7 +83,7 @@ def bench_core(extras):
         for _ in range(n):
             ray_tpu.get(nop.remote())
         return n / (time.perf_counter() - t0)
-    sync_rate = best_of(2, _sync)
+    sync_rate = best_of(2, _sync, key="tasks_sync")
 
     # single client tasks async: submit all, get all (ray_perf.py:181)
     def _async():
@@ -82,7 +91,7 @@ def bench_core(extras):
         t0 = time.perf_counter()
         ray_tpu.get([nop.remote() for _ in range(n)])
         return n / (time.perf_counter() - t0)
-    async_rate = best_of(2, _async)
+    async_rate = best_of(2, _async, key="tasks_async")
 
     # 1:1 actor calls sync / async (ray_perf.py:196-232)
     actor = NopActor.remote()
@@ -129,7 +138,7 @@ def bench_core(extras):
         t0 = time.perf_counter()
         ray_tpu.get([do_put_small.remote() for _ in range(n_tasks)])
         return n_tasks * 100 / (time.perf_counter() - t0)
-    mc_put_rate = best_of(2, _mc_put)
+    mc_put_rate = best_of(2, _mc_put, key="mc_put")
 
     @ray_tpu.remote
     def do_put_big():
@@ -142,7 +151,7 @@ def bench_core(extras):
         ray_tpu.get([do_put_big.remote() for _ in range(n_tasks)])
         per_put = 10 * 1024 * 1024 * 8  # np.zeros(10Mi, int64).nbytes
         return n_tasks * 4 * per_put / (time.perf_counter() - t0) / 1e9
-    mc_put_gbps = best_of(2, _mc_put_gb)
+    mc_put_gbps = best_of(2, _mc_put_gb, key="mc_put_gb")
 
     @ray_tpu.remote
     class Submitter:
@@ -158,7 +167,7 @@ def bench_core(extras):
         t0 = time.perf_counter()
         ray_tpu.get([s.batch.remote(per) for s in subs])
         return len(subs) * per / (time.perf_counter() - t0)
-    mc_tasks_rate = best_of(2, _mc_tasks)
+    mc_tasks_rate = best_of(2, _mc_tasks, key="mc_tasks")
 
     # n:n actor calls async (ray_perf "n:n actor calls async"):
     # m caller actors each async-calling a distinct callee actor.
@@ -180,7 +189,7 @@ def bench_core(extras):
         t0 = time.perf_counter()
         ray_tpu.get([c.drive.remote(per) for c in callers])
         return len(callers) * per / (time.perf_counter() - t0)
-    nn_actor_rate = best_of(2, _nn_actor)
+    nn_actor_rate = best_of(2, _nn_actor, key="nn_actor")
     for a in subs + callers + callees:
         ray_tpu.kill(a)
 
@@ -916,6 +925,186 @@ def bench_tpu(extras):
         extras["tpu_error"] = f"{type(e).__name__}: {e}"
 
 
+# ---------------------------------------------------------------------------
+# focus metrics + same-session A/B (variance hardening)
+#
+# `--focus <metric>` measures ONE metric (N reps, spread reported) in a
+# fresh runtime — cheap enough to run repeatedly. `--ab <metric>` proves
+# a working-tree change on THIS box in one bench session: it runs the
+# focus metric on the current tree, `git stash`es the tree back to HEAD,
+# runs the SAME script again (copied out first, so the stashed tree's
+# older bench.py is never needed), pops the stash, and prints both
+# results plus the ratio. Back-to-back on identical machine state, so
+# the PR 2 caveat (~1.7x cross-run swings on this box) cancels instead
+# of drowning the signal.
+# ---------------------------------------------------------------------------
+def _focus_tasks_async(ray_tpu):
+    @ray_tpu.remote
+    def nop():
+        return None
+    ray_tpu.get([nop.remote() for _ in range(200)])
+
+    def measure():
+        n = 5000
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)])
+        return n / (time.perf_counter() - t0)
+    return measure
+
+
+def _focus_put_get(ray_tpu):
+    import numpy as np
+    small = np.zeros(1000, dtype=np.float64)
+    ray_tpu.get(ray_tpu.put(small))
+
+    def measure():
+        n = 1000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(ray_tpu.put(small))
+        return n / (time.perf_counter() - t0)
+    return measure
+
+
+def _focus_mc_tasks(ray_tpu):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    @ray_tpu.remote
+    class Submitter:
+        def batch(self, n):
+            ray_tpu.get([nop.remote() for _ in range(n)])
+            return n
+
+    subs = [Submitter.remote() for _ in range(4)]
+    ray_tpu.get([s.batch.remote(10) for s in subs])
+
+    def measure():
+        per = 500
+        t0 = time.perf_counter()
+        ray_tpu.get([s.batch.remote(per) for s in subs])
+        return len(subs) * per / (time.perf_counter() - t0)
+    return measure
+
+
+def _focus_nn_actor(ray_tpu):
+    @ray_tpu.remote
+    class NopActor:
+        def nop(self):
+            return None
+
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, callee):
+            self.callee = callee
+
+        def drive(self, n):
+            ray_tpu.get([self.callee.nop.remote() for _ in range(n)])
+            return n
+
+    callees = [NopActor.remote() for _ in range(4)]
+    callers = [Caller.remote(c) for c in callees]
+    ray_tpu.get([c.drive.remote(10) for c in callers])
+
+    def measure():
+        per = 500
+        t0 = time.perf_counter()
+        ray_tpu.get([c.drive.remote(per) for c in callers])
+        return len(callers) * per / (time.perf_counter() - t0)
+    return measure
+
+
+FOCUS_METRICS = {
+    "tasks_async_per_s": _focus_tasks_async,
+    "put_get_per_s": _focus_put_get,
+    "multi_client_tasks_async_per_s": _focus_mc_tasks,
+    "nn_actor_calls_async_per_s": _focus_nn_actor,
+}
+
+
+def run_focus(name: str, reps: int = 3) -> None:
+    if name not in FOCUS_METRICS:
+        print(json.dumps({"error": f"unknown focus metric {name}; "
+                          f"known: {sorted(FOCUS_METRICS)}"}))
+        sys.exit(2)
+    import ray_tpu
+    ray_tpu.init(num_cpus=min(os.cpu_count() or 4, 16))
+    try:
+        measure = FOCUS_METRICS[name](ray_tpu)
+        vals = sorted(measure() for _ in range(max(1, reps)))
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps({
+        "metric": name, "value": round(vals[-1], 1),
+        "spread": [round(vals[0], 1), round(statistics.median(vals), 1),
+                   round(vals[-1], 1)]}))
+
+
+def run_ab(name: str, reps: int = 3) -> None:
+    import shutil
+    import subprocess
+    import tempfile
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # The SAME (current) bench script measures both sides — the stashed
+    # tree's bench.py may predate --focus.
+    script = os.path.join(tempfile.mkdtemp(prefix="bench_ab_"),
+                          "bench_ab.py")
+    shutil.copy2(os.path.abspath(__file__), script)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+
+    def one(side: str):
+        p = subprocess.run(
+            [sys.executable, script, "--focus", name, "--reps",
+             str(reps)], capture_output=True, text=True, cwd=repo,
+            env=env, timeout=600)
+        for line in reversed(p.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+        return {"error": f"{side} run produced no result line",
+                "stderr": p.stderr[-2000:]}
+
+    def git(*args):
+        # LC_ALL=C: never parse localized porcelain output.
+        genv = dict(os.environ, LC_ALL="C", LANG="C")
+        return subprocess.run(["git", *args], cwd=repo,
+                              capture_output=True, text=True, env=genv)
+
+    def stash_ref():
+        return git("rev-parse", "-q", "--verify",
+                   "refs/stash").stdout.strip()
+
+    worktree = one("worktree")
+    # "Did the push actually stash?" is answered by refs/stash moving,
+    # not by string-matching git's message — so a clean tree can never
+    # lead to popping someone's unrelated pre-existing stash entry.
+    before_ref = stash_ref()
+    stash = git("stash", "push", "-m", "bench-ab")
+    stashed = stash.returncode == 0 and stash_ref() != before_ref
+    try:
+        head = one("HEAD") if stashed else dict(
+            worktree, note="worktree == HEAD (nothing to stash)")
+    finally:
+        if stashed:
+            pop = git("stash", "pop")
+            if pop.returncode != 0:
+                print(json.dumps({
+                    "error": "git stash pop failed — the diff under "
+                             "test is stranded in `git stash list` "
+                             "as bench-ab",
+                    "stderr": pop.stderr[-500:]}), file=sys.stderr)
+    ratio = None
+    if isinstance(worktree.get("value"), (int, float)) and \
+            isinstance(head.get("value"), (int, float)) and head["value"]:
+        ratio = round(worktree["value"] / head["value"], 3)
+    print(json.dumps({"metric": name, "worktree": worktree,
+                      "head": head, "ratio_worktree_over_head": ratio}))
+
+
 def main():
     extras = {}
     sync_rate = bench_core(extras)
@@ -941,4 +1130,18 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    argv = sys.argv[1:]
+    if argv and argv[0] in ("--focus", "--ab"):
+        mode, metric = argv[0], (argv[1] if len(argv) > 1 else "")
+        reps = 3
+        if "--reps" in argv:
+            try:
+                reps = int(argv[argv.index("--reps") + 1])
+            except (IndexError, ValueError):
+                pass
+        if mode == "--focus":
+            run_focus(metric, reps)
+        else:
+            run_ab(metric, reps)
+    else:
+        main()

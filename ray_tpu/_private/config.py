@@ -137,6 +137,24 @@ class RayConfig:
         # hosts whose page-allocation bandwidth one copy can't
         # saturate). netcomm._auto_gate_width.
         "host_copy_gate_width": 0,
+        # -- direct worker<->worker call plane (reference: the direct
+        # actor transport, core_worker/transport/direct_actor_task_
+        # submitter — steady-state actor calls never route through a
+        # central process). Falsy => every actor call and nested-result
+        # delivery takes the head-routed path unchanged.
+        "direct_calls_enabled": True,
+        # Broker + connect budget for establishing one direct channel;
+        # exhaustion falls back to the head path for that handle.
+        "direct_channel_timeout_s": 10.0,
+        # Nested-submission result forwarding (head -> submitter
+        # RESULT_FWD push replacing the pull round trip). Off => nested
+        # gets go through the classic blocking GET_LOCATIONS, while the
+        # actor-call fast path stays on.
+        "direct_result_forwarding": True,
+        # Resolved direct-call result locations cached caller-side
+        # (evictable — the head's directory is authoritative once the
+        # batched accounting lands).
+        "direct_result_cache_size": 8192,
         # Tasks dispatched onto one (head-local) worker under a single
         # resource grant before completions must drain it (reference:
         # max_tasks_in_flight_per_worker=10, direct task transport

@@ -1,0 +1,1285 @@
+"""Direct worker<->worker call plane: the actor-call fast path.
+
+Reference parity: the direct actor transport
+(core_worker/transport/direct_actor_task_submitter.cc + task_receiver.cc)
+— steady-state actor calls never route through a central process. The
+caller submits straight to the callee worker and the GCS sees only
+registration and failures.
+
+Shape here: when a worker holds an actor handle whose callee is alive,
+the head brokers a channel ONCE (CHANNEL_REQ -> CHANNEL_OPEN ->
+CHANNEL_ADDR; same-node callers dial the callee's UNIX listener,
+cross-node callers its TCP listener with the netcomm socket options),
+and every subsequent ``actor.method.remote()`` ships an ACTOR_CALL frame
+caller->callee on that channel, with the inline result returned
+callee->caller as an ACTOR_RESULT on the same channel — both ends reuse
+the PR 2 transport (ConnectionWriter coalescing, batch frames). The head
+receives only oneway, batched accounting:
+
+  * DIRECT_DONE — completion entries (result locations + the caller's
+    residual local refcounts) so the object directory stays
+    authoritative for refs that escape the caller;
+  * REF_DELTAS — worker incref/decref coalesced into per-burst deltas;
+  * WORKER_BLOCKED / WORKER_UNBLOCKED — the lease-release/recall signal
+    the old blocking GET_LOCATIONS round trip used to carry implicitly.
+
+Nested plain-task submission gets the cheaper half: the head forwards
+results for worker-submitted tasks to the submitter (RESULT_FWD) as it
+registers them, so the submitter's get() resolves locally with no pull
+round trip.
+
+Failure semantics: on callee death the channel EOF drains every
+in-flight call through DIRECT_RECONCILE — the head routes each spec
+through its normal retry machinery (ledger-bumped ``attempt``
+accounting; requeue onto the restarted actor or a typed ActorDiedError).
+A falsy ``direct_calls_enabled`` config routes everything through the
+head path unchanged (zero additional work on the submit/complete paths —
+guarded counter-based by tests/test_direct_calls.py).
+
+Refcount transfer invariant: return ids of in-flight direct calls are
+counted CALLER-LOCALLY (``_refs``); the residual transfers to the head
+inside the DIRECT_DONE entry, enqueued on the caller's head pipe UNDER
+``_cond`` in the same critical section that retires the local count — so
+any later incref/decref for that id (which necessarily observed the
+retired count) enqueues on the same FIFO pipe AFTER the registration it
+depends on.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..exceptions import ActorDiedError, GetTimeoutError
+from . import fault
+from . import lockdep
+from . import protocol as P
+from . import serialization
+from . import telemetry
+
+logger = logging.getLogger(__name__)
+
+# Counter of direct-plane operations in THIS process — the perf_smoke
+# guard's counter-based proxy for "the disabled path did no direct-plane
+# work" (same discipline as telemetry.instrument_ops / lockdep).
+_ops = 0
+
+
+def direct_ops() -> int:
+    """Direct-plane operations performed so far (perf_smoke guard)."""
+    return _ops
+
+
+def _bump() -> None:
+    global _ops
+    _ops += 1
+
+
+# Sentinel: this (caller, actor) pair is pinned to the head path —
+# establishment failed, the channel died, or the plane is disabled.
+_FALLBACK = object()
+
+
+class _TransientEstablish(Exception):
+    """The channel cannot be brokered YET (callee still constructing /
+    restarting): the current call takes the head path, but the pair is
+    NOT pinned to _FALLBACK — the next call retries establishment."""
+
+# A "fwd"-pending local wait falls back to head GET_LOCATIONS after this
+# long without a RESULT_FWD — the head's directory is authoritative for
+# nested submissions, so a missed forward degrades to one round trip
+# instead of a hang. Direct-pending ids never time out here: their
+# resolution signal is the channel itself (result or EOF reconcile).
+_FWD_RESYNC_S = 5.0
+
+PENDING_DIRECT = "direct"
+PENDING_FWD = "fwd"
+
+
+class _DirectChannel:
+    """Caller-side half of one brokered channel to one actor's worker."""
+
+    __slots__ = ("plane", "actor_id", "conn", "writer", "alive",
+                 "inflight", "queue", "pump_running", "_recv_thread",
+                 "callee_wid")
+
+    def __init__(self, plane: "DirectPlane", actor_id, conn,
+                 callee_wid: Optional[str] = None):
+        self.plane = plane
+        self.actor_id = actor_id
+        self.conn = conn
+        # Worker-id hex of the incarnation this channel dialed: the
+        # reconcile payload carries it so the head can tell "requeued
+        # onto the incarnation this EOF implicates" (prepaid retry)
+        # from "requeued onto a later restart" (charges normally).
+        self.callee_wid = callee_wid
+        self.alive = True
+        # task_id bytes -> spec, insertion-ordered (reconcile preserves
+        # submission order). Guarded by plane._cond.
+        self.inflight: "collections.OrderedDict[bytes, Any]" = \
+            collections.OrderedDict()
+        # Ordered not-yet-sent specs (ref args needing location
+        # resolution park here; a single pump drains in order).
+        self.queue: collections.deque = collections.deque()
+        self.pump_running = False
+        from .netcomm import ConnectionWriter
+        self.writer = ConnectionWriter(
+            conn, name=f"direct-w-{actor_id.hex()[:8]}")
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True,
+            name=f"direct-recv-{actor_id.hex()[:8]}")
+        self._recv_thread.start()
+
+    def _recv_loop(self):
+        while True:
+            try:
+                data = self.conn.recv_bytes()
+            except (EOFError, OSError):
+                break
+            try:
+                self.plane._on_channel_messages(self, P.load_messages(data))
+            except Exception:
+                logger.exception("direct channel handler failed")
+        self.plane._on_channel_down(self)
+
+    def close(self):
+        try:
+            self.writer.close(flush_timeout=0.5)
+        except Exception:  # lint: broad-except-ok teardown of an already-dead channel; nothing to report
+            pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class _ServeConn:
+    """Callee-side half of one accepted direct connection: a writer for
+    results plus the recv thread feeding the shared dispatch."""
+
+    __slots__ = ("plane", "conn", "writer")
+
+    def __init__(self, plane: "DirectPlane", conn):
+        self.plane = plane
+        self.conn = conn
+        from .netcomm import ConnectionWriter
+        self.writer = ConnectionWriter(conn, name="direct-serve-w")
+        threading.Thread(target=self._recv_loop, daemon=True,
+                         name="direct-serve-recv").start()
+
+    def _recv_loop(self):
+        while True:
+            try:
+                data = self.conn.recv_bytes()
+            except (EOFError, OSError):
+                break
+            try:
+                self.plane._on_channel_messages(self, P.load_messages(data))
+            except Exception:
+                logger.exception("direct serve handler failed")
+        # Caller hung up: nothing to reconcile callee-side — in-flight
+        # executions fall back to head accounting when their result
+        # send fails (see send_result).
+        try:
+            self.writer.close(flush_timeout=0.0)
+        except Exception:  # lint: broad-except-ok caller hung up mid-teardown; writer/conn close is best-effort
+            pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class DirectPlane:
+    """Per-worker direct-call state: caller channels, the callee
+    listener, the local result cache, and the coalesced accounting
+    buffers. One instance per worker process (Worker.direct)."""
+
+    def __init__(self, worker):
+        self._worker = worker
+        from .config import ray_config
+        self.enabled = bool(ray_config.direct_calls_enabled)
+        self.forwarding = self.enabled and bool(
+            ray_config.direct_result_forwarding)
+        self._cache_cap = max(64, int(ray_config.direct_result_cache_size))
+        # THE plane lock/condition: local results, pending markers,
+        # local refcounts, channel inflight/queues, ref-delta buffer.
+        self._cond = lockdep.condition("direct.state")
+        # actor_id bytes -> _DirectChannel | _FALLBACK (under _cond).
+        self._chans: Dict[bytes, Any] = {}
+        # Serializes channel establishment per process (head round trip).
+        # NEVER taken on the worker's recv loop: _establish blocks in
+        # request() under it, and the REPLY that completes that request
+        # is delivered by the same loop that handles CHANNEL_OPEN — a
+        # shared lock would let an inbound channel open wedge the
+        # whole control plane against an outbound dial.
+        self._estab_lock = lockdep.lock("direct.establish")
+        # Listener creation (callee side, CHANNEL_OPEN on the recv
+        # loop) gets its own lock for exactly that reason.
+        self._listen_lock = lockdep.lock("direct.listener")
+        # oid bytes -> loc: resolved results, evictable FIFO (the head's
+        # directory is authoritative once DIRECT_DONE/register landed).
+        self._results: "collections.OrderedDict[bytes, Tuple]" = \
+            collections.OrderedDict()
+        # oid bytes -> PENDING_DIRECT | PENDING_FWD: ids a local wait
+        # must NOT ask the head about (direct) / prefers not to (fwd).
+        self._pending: Dict[bytes, str] = {}
+        # oid bytes -> [waiter_count_cell, ...]: local waits register a
+        # per-wait countdown so a bulk get() wakes ONCE when its last
+        # id resolves instead of on every result frame (on one core,
+        # spurious waiter wakes are pure GIL churn).
+        self._waiters: Dict[bytes, List] = {}
+        # oid bytes -> caller-local refcount of in-flight AND
+        # resolved-but-unflushed direct return ids (transferred to the
+        # head inside DIRECT_DONE entries at flush time).
+        self._refs: Dict[bytes, int] = {}
+        # Coalesced incref/decref deltas bound for the head.
+        self._ref_buf: Dict[bytes, List] = {}
+        # Retired-but-unflushed DIRECT_DONE completion entries: the
+        # steady-state path sends the head NOTHING per call — entries
+        # drain at the accounting barriers (size threshold, any other
+        # outbound head traffic, task completion).
+        self._done_buf: List[dict] = []
+        self._done_flush_n = 1024
+        self._ref_flush_n = 1024
+        # task_id bytes of calls whose ref args this caller pinned —
+        # kept OFF the spec: a dynamic attr would demote the full-spec
+        # ACTOR_CALL pickle to the slow extra-dict reduce and ship a
+        # meaningless flag to the callee. set.remove under the GIL
+        # keeps the unpin exactly-once across the unwind paths.
+        self._pinned: set = set()
+        # oid bytes of IN-FLIGHT direct return ids that a head-bound
+        # message referenced (nested in a task result, arg of a head
+        # submit or put): the head now holds interest, so their
+        # eventual retirement must flush instead of parking — an idle
+        # worker has no later barrier. Guarded by _cond.
+        self._escaped: set = set()
+        # Direct-path counters, pushed into the metric registry in
+        # batches at accounting flushes (a per-call Metric.inc would
+        # tax the very hot path this plane strips).
+        self._n_calls = 0
+        self._n_results = 0
+        # Callee listener state (created lazily on CHANNEL_OPEN).
+        self._listener_info: Optional[dict] = None
+        self._listeners: List = []
+
+    # ------------------------------------------------------------------
+    # refcounting: local-table interception + per-burst delta coalescing
+    # ------------------------------------------------------------------
+    def ref_delta(self, object_id, delta: int) -> None:
+        """Adjust one ref: direct return ids still counted locally
+        absorb the delta in place; everything else merges into the
+        per-burst buffer shipped as one REF_DELTAS frame at the next
+        accounting barrier (or on overflow)."""
+        _bump()
+        ob = object_id.binary()
+        overflow = False
+        with self._cond:
+            if ob in self._refs:
+                self._refs[ob] += delta
+                return
+            ent = self._ref_buf.get(ob)
+            if ent is None:
+                self._ref_buf[ob] = [object_id, delta]
+            else:
+                ent[1] += delta
+            overflow = len(self._ref_buf) >= self._ref_flush_n
+        if overflow:
+            self.flush_accounting()
+
+    def note_escaped(self, nested_lists) -> None:
+        """A head-bound message (task completion's nested result ids,
+        a worker submit's args, a put) references these ids: any that
+        are still IN-FLIGHT direct calls must flush at retirement —
+        the head-side waiter created by that message has no other way
+        to learn the result on an otherwise idle worker."""
+        if not nested_lists or not any(nested_lists):
+            return
+        with self._cond:
+            for ids in nested_lists:
+                for nid in ids or ():
+                    ob = nid.binary() if hasattr(nid, "binary") else nid
+                    # In flight (pending) OR retired-but-unflushed
+                    # (residual still local in _refs): either way the
+                    # head's interest means the completion entry must
+                    # neither park indefinitely nor be elided.
+                    if (self._pending.get(ob) == PENDING_DIRECT
+                            or ob in self._refs):
+                        self._escaped.add(ob)
+
+    def note_spec_escapes(self, spec) -> None:
+        """Head-submitted spec: its ref args (and their nested ids)
+        escape to the head — see note_escaped."""
+        ids = None
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a.object_id is not None or a.nested_ids:
+                if ids is None:
+                    ids = []
+                if a.object_id is not None:
+                    ids.append(a.object_id)
+                ids.extend(a.nested_ids)
+        if ids:
+            self.note_escaped([ids])
+
+    def flush_accounting(self) -> None:
+        """THE ordering barrier: drain buffered completion entries and
+        ref deltas onto the head pipe BEFORE the caller enqueues
+        anything that could reference them (a nested submit pinning a
+        direct result, a put nesting one, a TASK_DONE unpinning borrow
+        increfs). Sends happen UNDER _cond so nothing this worker later
+        enqueues can overtake the accounting it depends on."""
+        # Racy fast path: both buffers only become non-empty under
+        # _cond; if another thread's entries are in flight, our own
+        # messages carry no dependency on them.
+        if not self._done_buf and not self._ref_buf \
+                and not (self._n_calls or self._n_results):
+            return
+        _bump()
+        with self._cond:
+            self._flush_accounting_locked()
+
+    def _flush_accounting_locked(self) -> None:
+        """Caller holds self._cond."""
+        if self._done_buf:
+            entries, self._done_buf = self._done_buf, []
+            ship = []
+            for ent in entries:
+                obs = [oid.binary() for oid in ent["oids"]]
+                deltas = [self._refs.pop(ob, 0) for ob in obs]
+                # Escaped ids (nested into a head-bound message while
+                # locally owned) can net a ZERO local residual — the
+                # handle incref parked in _ref_buf pre-submit while the
+                # drop hit _refs — even though the head holds a real
+                # nested pin and a waiter. They must always ship.
+                escaped = any(ob in self._escaped for ob in obs)
+                for ob in obs:
+                    self._escaped.discard(ob)
+                # Dead-entry elision: every ref already dropped AND no
+                # backing to reclaim (inline/error locs only) means NO
+                # party can ever reference these ids — any escape path
+                # (nested ids, task args, puts) pins them BEFORE its
+                # own message passes this barrier, which would have
+                # kept the residual positive (or marked them escaped).
+                # The head never needs to hear about them; steady-state
+                # call-and-drop bursts cost it zero registrations.
+                if (not escaped
+                        and all(d <= 0 for d in deltas)
+                        and not any(ln for ln in ent["nested"])
+                        and all(l[0] != P.LOC_SHM for l in ent["locs"])):
+                    continue
+                ent["deltas"] = deltas
+                ship.append(ent)
+            if ship:
+                try:
+                    self._worker.send_lazy(P.DIRECT_DONE,
+                                           {"entries": ship})
+                except Exception:  # lint: broad-except-ok head pipe dead: the worker process is exiting, accounting dies with it
+                    pass
+        if self._ref_buf:
+            buf, self._ref_buf = self._ref_buf, {}
+            items = [(oid, d) for oid, d in buf.values() if d]
+            if items:
+                try:
+                    self._worker.send_lazy(P.REF_DELTAS, {"deltas": items})
+                except Exception:  # lint: broad-except-ok head pipe dead: the worker process is exiting, deltas die with it
+                    pass
+        # Counters reset unconditionally: they also feed the
+        # empty-buffer fast path in flush_accounting — leaving them
+        # nonzero with telemetry off would defeat it forever after the
+        # first direct call.
+        n_calls, self._n_calls = self._n_calls, 0
+        n_results, self._n_results = self._n_results, 0
+        if telemetry.enabled:
+            if n_calls:
+                telemetry.record_direct_calls(n_calls)
+            if n_results:
+                telemetry.record_direct_results(n_results)
+
+    # ------------------------------------------------------------------
+    # local result cache / pending markers
+    # ------------------------------------------------------------------
+    def _cache_put_locked(self, ob: bytes, loc) -> None:
+        res = self._results
+        res[ob] = loc
+        res.move_to_end(ob)
+        while len(res) > self._cache_cap:
+            # Evict oldest FLUSHED entry only: an id still carrying a
+            # local refcount is unknown to the head — its cached loc is
+            # the ONLY copy until the accounting drains.
+            for old in res:
+                if old not in self._refs:
+                    del res[old]
+                    break
+            else:
+                break
+
+    def note_nested_submission(self, spec) -> None:
+        """Mark a head-routed worker submission's return ids as
+        forward-pending: the head pushes their locations back
+        (RESULT_FWD) as it registers them, so get() resolves locally."""
+        if not self.forwarding:
+            return
+        _bump()
+        rids = getattr(spec, "return_ids", None)
+        if not rids:
+            return
+        with self._cond:
+            for rid in rids:
+                self._pending[rid.binary()] = PENDING_FWD
+
+    def _resolve_pending_locked(self, ob: bytes) -> bool:
+        """Retire one pending id; True when some waiter's LAST missing
+        id just resolved (only then is a wake worth its GIL cost)."""
+        self._pending.pop(ob, None)
+        cells = self._waiters.pop(ob, None)
+        wake = False
+        if cells:
+            for cell in cells:
+                cell[0] -= 1
+                if cell[0] <= 0:
+                    wake = True
+        return wake
+
+    def on_result_fwd(self, payload: dict) -> None:
+        """RESULT_FWD from the head: cache forwarded locations; a None
+        loc demotes the id to the head-request path (lost/freed)."""
+        wake = False
+        with self._cond:
+            for oid, loc in payload.get("entries", ()):
+                ob = oid.binary()
+                if self._resolve_pending_locked(ob):
+                    wake = True
+                if loc is not None:
+                    self._cache_put_locked(ob, loc)
+            if wake:
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # get(): local-first location resolution
+    # ------------------------------------------------------------------
+    def get_locations(self, object_ids, timeout=None,
+                      notify_blocked: bool = True) -> List:
+        """Resolve locations local-first: direct results and forwarded
+        nested results come out of the local cache (waiting on the
+        channel/forward signal when still in flight); everything else
+        falls through to one head GET_LOCATIONS request. While a local
+        wait actually blocks, the head is told via oneway
+        WORKER_BLOCKED/WORKER_UNBLOCKED so lease release and
+        queued-task recall behave exactly like the old blocking
+        round trip. `notify_blocked=False` for waits OFF the
+        task-execution path (the pump thread): the executor is still
+        running at full speed, and releasing the lease would let the
+        scheduler oversubscribe the worker's CPU slot."""
+        _bump()
+        w = self._worker
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: Dict[bytes, Tuple] = {}
+        need_head: List = []
+        blocked = False
+        wait_t0 = None
+        try:
+            with self._cond:
+                # Incremental resolution: each wake rescans only the
+                # still-unresolved tail, not the whole id list (a burst
+                # of N results would otherwise cost O(N^2) lookups).
+                pend: List[Tuple[Any, bytes]] = []
+                for oid in object_ids:
+                    ob = oid.binary()
+                    loc = self._results.get(ob)
+                    if loc is not None:
+                        out[ob] = loc
+                    elif ob in self._pending:
+                        pend.append((oid, ob))
+                    else:
+                        need_head.append(oid)
+                if pend:
+                    # Countdown cell: resolution paths wake this wait
+                    # only when its LAST missing id lands (bulk gets
+                    # wake once, not once per result frame).
+                    cell = [len(pend)]
+                    for _oid, ob in pend:
+                        self._waiters.setdefault(ob, []).append(cell)
+                while pend:
+                    now = time.monotonic()
+                    if wait_t0 is None:
+                        wait_t0 = now
+                    elif now - wait_t0 > _FWD_RESYNC_S:
+                        # Forward-pending ids the head already knows:
+                        # stop trusting the push and ask (a missed
+                        # forward must degrade, not hang). Direct ids
+                        # stay — their signal is the channel itself.
+                        # Demoted ids route to the head pull NOW:
+                        # nothing will ever notify this wait for a
+                        # missed forward, so sleeping another cond
+                        # interval first would just pad the documented
+                        # one-pull degrade by up to a second.
+                        still = []
+                        for oid, ob in pend:
+                            if self._pending.get(ob) != PENDING_FWD:
+                                still.append((oid, ob))
+                                continue
+                            self._resolve_pending_locked(ob)
+                            loc = self._results.get(ob)
+                            if loc is not None:
+                                out[ob] = loc
+                            else:
+                                need_head.append(oid)
+                        pend = still
+                        if not pend:
+                            break
+                    if deadline is not None and now >= deadline:
+                        raise GetTimeoutError(
+                            "Get timed out waiting for direct-call "
+                            "results")
+                    if not blocked and notify_blocked:
+                        blocked = True
+                        try:
+                            w.send_lazy(P.WORKER_BLOCKED, {})
+                        except Exception:  # lint: broad-except-ok blocked-notify is advisory; a dead head pipe fails the wait itself
+                            pass
+                    remaining = None if deadline is None \
+                        else deadline - now
+                    self._cond.wait(
+                        timeout=min(remaining, 1.0)
+                        if remaining is not None else 1.0)
+                    still: List[Tuple[Any, bytes]] = []
+                    for oid, ob in pend:
+                        loc = self._results.get(ob)
+                        if loc is not None:
+                            out[ob] = loc
+                        elif ob in self._pending:
+                            still.append((oid, ob))
+                        else:
+                            need_head.append(oid)
+                    pend = still
+        finally:
+            if blocked:
+                try:
+                    w.send_lazy(P.WORKER_UNBLOCKED, {})
+                except Exception:  # lint: broad-except-ok unblock-notify is advisory, same as the blocked-notify above
+                    pass
+        if need_head:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            locs = w.request(P.GET_LOCATIONS, {
+                "object_ids": need_head,
+                "timeout": remaining if timeout is not None else None})
+            for oid, loc in zip(need_head, locs):
+                out[oid.binary()] = loc
+        return [out[oid.binary()] for oid in object_ids]
+
+    # ------------------------------------------------------------------
+    # caller side: submit
+    # ------------------------------------------------------------------
+    def submit_actor_call(self, spec) -> bool:
+        """Ship one actor method call on the direct channel. False =>
+        the caller must take the head path (no channel, channel dead,
+        plane fell back for this actor)."""
+        if spec.streaming:
+            # Streaming generators are head-routed end to end: items
+            # flow as head-registered GEN_ITEMs and the stream end is
+            # signaled by the head's TASK_DONE processing — neither
+            # exists on the channel wire (the reconcile path skips
+            # streaming specs for the same reason).
+            return False
+        if spec.retry_exceptions:
+            # User-exception retries are a HEAD decision (TASK_DONE's
+            # resubmit-on-error branch): on the channel the callee's
+            # error blob would retire terminally at the caller with
+            # zero retries — flag-on/flag-off behavior must not
+            # diverge, so these rare opt-in calls stay head-routed.
+            return False
+        _bump()
+        chan = self._channel_for(spec.actor_id)
+        if chan is None:
+            return False
+        try:
+            return self._submit_on_channel(chan, spec)
+        except Exception:
+            logger.debug("direct submit failed; falling back",
+                         exc_info=True)
+            return False
+
+    def _channel_for(self, actor_id) -> Optional[_DirectChannel]:
+        ab = actor_id.binary()
+        chan = self._chans.get(ab)
+        if chan is _FALLBACK:
+            return None
+        if chan is not None and chan.alive:
+            return chan
+        with self._estab_lock:
+            chan = self._chans.get(ab)
+            if chan is _FALLBACK:
+                return None
+            if chan is not None and chan.alive:
+                return chan
+            try:
+                chan = self._establish(actor_id)
+            except _TransientEstablish as e:
+                # Callee pending/restarting: head path for THIS call,
+                # but the pair stays unpinned so the next call retries
+                # the channel once the actor is up. A first burst
+                # racing the actor's construction must not cost the
+                # pair its direct plane forever.
+                logger.debug("direct channel to actor %s not ready: "
+                             "%r (head path, will retry)",
+                             actor_id.hex()[:8], e)
+                if telemetry.enabled:
+                    telemetry.record_direct_fallback("pending")
+                with self._cond:
+                    self._chans.pop(ab, None)
+                return None
+            except Exception as e:
+                logger.debug("direct channel to actor %s unavailable: "
+                             "%r (head path)", actor_id.hex()[:8], e)
+                if telemetry.enabled:
+                    telemetry.record_direct_fallback("connect")
+                chan = None
+            with self._cond:
+                self._chans[ab] = chan if chan is not None else _FALLBACK
+            return chan
+
+    def _establish(self, actor_id) -> _DirectChannel:
+        """One-time broker round trip + dial (reference: the actor
+        handle resolving the callee's RPC address from the GCS once,
+        then submitting directly)."""
+        from .config import ray_config
+        rep = self._worker.request(P.CHANNEL_REQ, {"actor_id": actor_id})
+        if not isinstance(rep, dict) or not rep.get("ok"):
+            if isinstance(rep, dict) and rep.get("transient"):
+                raise _TransientEstablish(rep.get("reason") or "pending")
+            raise RuntimeError(
+                f"channel broker refused: "
+                f"{rep.get('reason') if isinstance(rep, dict) else rep}")
+        if fault.enabled:
+            fault.fire("direct.connect", actor=actor_id.hex()[:8])
+        key = bytes.fromhex(rep["key"])
+        my_node = self._worker.config.node_id_hex
+        dial_budget = float(ray_config.direct_channel_timeout_s)
+        conn = None
+        if rep.get("unix") and (not rep.get("callee_node")
+                                or rep["callee_node"] == my_node
+                                or my_node is None):
+            conn = self._dial(rep["unix"], "AF_UNIX", key, dial_budget)
+        elif rep.get("tcp"):
+            host, port = rep["tcp"]
+            conn = self._dial((host, int(port)), "AF_INET", key,
+                              dial_budget)
+            from .netcomm import tune_control_socket
+            tune_control_socket(conn.fileno())
+        else:
+            raise RuntimeError("broker reply carries no dialable address")
+        return _DirectChannel(self, actor_id, conn,
+                              callee_wid=rep.get("callee_worker"))
+
+    @staticmethod
+    def _dial(address, family: str, key: bytes, timeout: float):
+        """Bounded channel dial. `multiprocessing.connection.Client`
+        has no timeout, and _establish runs under _estab_lock — a
+        wedged callee (SIGSTOPped mid-accept) would otherwise hang this
+        dial forever AND every other channel establishment in the
+        worker behind the lock, with no fallback to the head path. The
+        watchdog thread is abandoned on timeout (dials are once per
+        (caller, actor) pair; a late connect is closed by GC and the
+        callee's listener sees plain EOF)."""
+        from multiprocessing.connection import Client
+        box: List = []
+        gave_up = []
+        box_lock = threading.Lock()
+
+        def _run():
+            try:
+                c = Client(address, family=family, authkey=key)
+            except BaseException as e:  # lint: broad-except-ok shipped to the dialing thread below verbatim
+                box.append(("err", e))
+                return
+            # Handoff under the lock: either the dialer takes the
+            # connection from box, or it already gave up and this
+            # thread owns the close — no window where neither side
+            # closes a late connect.
+            with box_lock:
+                if not gave_up:
+                    box.append(("ok", c))
+                    return
+            try:
+                c.close()
+            except OSError:
+                pass
+
+        t = threading.Thread(target=_run, daemon=True,
+                             name="direct-dial")
+        t.start()
+        t.join(timeout)
+        with box_lock:
+            if not box:
+                gave_up.append(True)
+                raise TimeoutError(
+                    f"direct channel dial to {address!r} timed out "
+                    f"after {timeout}s")
+            kind, val = box[0]
+        if kind == "err":
+            raise val
+        return val
+
+    def _pin_args(self, spec, delta: int) -> None:
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a.kind == "ref" and a.object_id is not None:
+                self.ref_delta(a.object_id, delta)
+            for nid in a.nested_ids:
+                self.ref_delta(nid, delta)
+
+    def _unpin_once(self, spec) -> None:
+        """Release the caller-side arg pin exactly once (set.remove is
+        atomic under the GIL: one unwind path wins, the rest no-op)."""
+        try:
+            self._pinned.remove(spec.task_id.binary())
+        except KeyError:
+            return
+        self._pin_args(spec, -1)
+
+    def _fill_known_locations(self, spec) -> bool:
+        """Fill ref-arg locations from the local cache; True when every
+        ref arg now carries a location (inline fast path)."""
+        ok = True
+        with self._cond:
+            for a in list(spec.args) + list(spec.kwargs.values()):
+                if a.kind != "ref" or a.object_id is None:
+                    continue
+                if a.location is None:
+                    a.location = self._results.get(a.object_id.binary())
+                if a.location is None:
+                    ok = False
+        return ok
+
+    def _submit_on_channel(self, chan: _DirectChannel, spec) -> bool:
+        has_refs = any(a.kind == "ref" or a.nested_ids
+                       for a in spec.args) \
+            or (spec.kwargs and any(a.kind == "ref" or a.nested_ids
+                                    for a in spec.kwargs.values()))
+        tid = spec.task_id.binary()
+        if has_refs:
+            # Pin ref args for the call's lifetime (the head pins on
+            # its path; here the caller is the pinning owner). The pin
+            # must be head-VISIBLE before the call ships: the channel
+            # is not a head message, so a buffered +1 would cancel
+            # against the retire -1 and be elided — the head would
+            # never hear the pin, and a handle drop racing the callee's
+            # borrow incref (different pipe, no ordering) could free
+            # the arg under a live borrow. One oneway frame per
+            # ref-arg call; the no-arg hot path pays nothing.
+            self._pin_args(spec, 1)
+            self._pinned.add(tid)
+            self.flush_accounting()
+            resolved = self._fill_known_locations(spec)
+        else:
+            resolved = True
+        start_pump = False
+        send_now = False
+        with self._cond:
+            if not chan.alive:
+                dead = True
+            else:
+                dead = False
+                for rid in spec.return_ids:
+                    self._refs[rid.binary()] = 1
+                    self._pending[rid.binary()] = PENDING_DIRECT
+                chan.inflight[tid] = spec
+                self._n_calls += 1
+                # pump_running covers the pop-then-send window: the
+                # pump pops the last queued spec under this lock but
+                # sends it after releasing, so an empty queue alone
+                # does not mean the writer saw every prior call yet —
+                # bypassing here would let this call overtake it.
+                if chan.queue or not resolved or chan.pump_running:
+                    chan.queue.append(spec)
+                    if not chan.pump_running:
+                        chan.pump_running = True
+                        start_pump = True
+                else:
+                    send_now = True
+        if dead:
+            self._unpin_once(spec)
+            return False
+        if start_pump:
+            threading.Thread(target=self._pump, args=(chan,), daemon=True,
+                             name="direct-pump").start()
+        if send_now:
+            try:
+                self._send_call(chan, spec)
+            except Exception:
+                # Returning False resubmits via the head path, so the
+                # registration above MUST be unwound or the spec is
+                # owned twice (head submission now + channel reconcile
+                # at EOF → duplicate execution) and the orphaned local
+                # refcount absorbs every future decref for the id. The
+                # inflight pop decides ownership: losing it means a
+                # concurrent channel-down reconcile already routed the
+                # spec to the head — report success so the caller does
+                # NOT submit it again.
+                with self._cond:
+                    owned = chan.inflight.pop(tid, None) is not None
+                    if owned:
+                        self._n_calls -= 1
+                        for rid in spec.return_ids:
+                            rb = rid.binary()
+                            # Brand-new ids: no other thread has seen
+                            # them yet, so the plain pops are exact.
+                            self._refs.pop(rb, None)
+                            self._resolve_pending_locked(rb)
+                if not owned:
+                    return True
+                self._unpin_once(spec)
+                logger.debug("direct send failed; falling back",
+                             exc_info=True)
+                return False
+        return True
+
+    def _send_call(self, chan: _DirectChannel, spec) -> None:
+        if fault.enabled:
+            fault.fire("direct.call", task=spec.name)
+        if not spec.args and not spec.kwargs and not spec.streaming \
+                and spec.trace_ctx is None:
+            # Compact wire form for the no-arg fast path: raw id bytes
+            # in a tuple pickle ~2x faster than the spec's dataclass
+            # reduce (the callee rebuilds an equivalent spec).
+            chan.writer.send_message(P.ACTOR_CALL, {"c": (
+                spec.task_id.binary(), spec.actor_id.binary(),
+                spec.method_name, spec.name,
+                [r.binary() for r in spec.return_ids],
+                spec.num_returns, spec.fn_id)})
+            return
+        chan.writer.send_message(P.ACTOR_CALL, {"spec": spec})
+
+    def _pump(self, chan: _DirectChannel) -> None:
+        """Ordered drain of calls whose ref args needed location
+        resolution: one pump per channel, head-of-line blocking so
+        per-caller submission order holds exactly."""
+        while True:
+            with self._cond:
+                if not chan.queue or not chan.alive:
+                    chan.pump_running = False
+                    return
+                spec = chan.queue[0]
+            try:
+                need = [a.object_id
+                        for a in list(spec.args)
+                        + list(spec.kwargs.values())
+                        if a.kind == "ref" and a.object_id is not None
+                        and a.location is None]
+                if need:
+                    locs = self.get_locations(need, notify_blocked=False)
+                    by_id = {o.binary(): l for o, l in zip(need, locs)}
+                    for a in list(spec.args) + list(spec.kwargs.values()):
+                        if (a.kind == "ref" and a.object_id is not None
+                                and a.location is None):
+                            a.location = by_id.get(a.object_id.binary())
+            except Exception:
+                logger.debug("direct pump resolution failed for %s",
+                             getattr(spec, "name", "?"), exc_info=True)
+                # Channel-down reconcile owns the queued specs; if the
+                # channel is alive but this spec is unresolvable, fail
+                # it back through reconcile-like local error delivery.
+                with self._cond:
+                    if chan.queue and chan.queue[0] is spec:
+                        chan.queue.popleft()
+                    alive = chan.alive
+                if alive:
+                    self._fail_call_locally(chan, spec, RuntimeError(
+                        "direct-call argument resolution failed"))
+                continue
+            with self._cond:
+                if not chan.alive:
+                    chan.pump_running = False
+                    return
+                if chan.queue and chan.queue[0] is spec:
+                    chan.queue.popleft()
+            try:
+                self._send_call(chan, spec)
+            except Exception:
+                # A send failure is the channel dying under us (writer
+                # EPIPE can beat the recv loop's EOF), NOT a property of
+                # this spec: delivering a local error here would strip
+                # the call of its reconcile retry/typed-ActorDiedError
+                # semantics. The spec is still in chan.inflight — tear
+                # the channel down and let the reconcile drain it (and
+                # the rest of the queue) through the head's normal
+                # retry machinery. Idempotent vs the recv loop's own
+                # EOF handling.
+                logger.debug("direct pump send failed for %s; "
+                             "reconciling channel",
+                             getattr(spec, "name", "?"), exc_info=True)
+                with self._cond:
+                    chan.pump_running = False
+                self._on_channel_down(chan)
+                return
+
+    def _fail_call_locally(self, chan, spec, exc) -> None:
+        blob = serialization.dumps(
+            exc if isinstance(exc, BaseException) else RuntimeError(
+                str(exc)))
+        with self._cond:
+            chan.inflight.pop(spec.task_id.binary(), None)
+            self._retire_locked(spec, None, blob, None)
+            self._flush_accounting_locked()
+            self._cond.notify_all()
+        self._unpin_once(spec)
+
+    # ------------------------------------------------------------------
+    # caller side: results / reconcile
+    # ------------------------------------------------------------------
+    def _on_channel_messages(self, chan, msgs) -> None:
+        """Burst entry for one received frame: ACTOR_RESULT runs are
+        retired under ONE lock hold / ONE DIRECT_DONE accounting frame
+        (the receive-side face of the writer's coalescing)."""
+        i, n = 0, len(msgs)
+        while i < n:
+            msg_type, payload = msgs[i]
+            if msg_type == P.ACTOR_RESULT:
+                j = i + 1
+                while j < n and msgs[j][0] == P.ACTOR_RESULT:
+                    j += 1
+                self._on_actor_results(chan, [m[1] for m in msgs[i:j]])
+                i = j
+                continue
+            if msg_type == P.ACTOR_CALL:
+                j = i + 1
+                while j < n and msgs[j][0] == P.ACTOR_CALL:
+                    j += 1
+                self._on_actor_calls(chan, [m[1] for m in msgs[i:j]])
+                i = j
+                continue
+            self._handle_direct_message(chan, msg_type, payload)
+            i += 1
+
+    def _handle_direct_message(self, chan, msg_type: str,
+                               payload: dict) -> None:
+        """Route one direct-channel message (both roles share this
+        dispatcher: callee sees ACTOR_CALL, caller sees ACTOR_RESULT)."""
+        if msg_type == P.ACTOR_CALL:
+            self._on_actor_call(chan, payload)
+        elif msg_type == P.ACTOR_RESULT:
+            self._on_actor_results(chan, [payload])
+        else:
+            # Protocol skew between two workers: never silently drop.
+            logger.warning("direct channel dropping unknown message "
+                           "type %r (protocol skew?)", msg_type)
+
+    def _retire_locked(self, spec, locs, error, nested) -> None:
+        """Retire one call's return ids (caller holds self._cond): cache
+        locations and park the completion entry in the accounting
+        buffer. The local refcounts STAY in ``_refs`` — still absorbing
+        incref/decref in place — until the buffer drains at an
+        accounting barrier, where the residual deltas are popped into
+        the DIRECT_DONE entry under the same lock."""
+        if error is not None:
+            locs = [(P.LOC_ERROR, error)] * len(spec.return_ids)
+        wake = False
+        escaped_hit = False
+        for rid, loc in zip(spec.return_ids, locs or ()):
+            rb = rid.binary()
+            if rb in self._escaped:
+                # Keep the mark: the flush (not the retire) consumes it
+                # so the elision check below can also see it.
+                escaped_hit = True
+            if self._resolve_pending_locked(rb):
+                wake = True
+            self._cache_put_locked(rb, loc)
+        if wake:
+            self._cond.notify_all()
+        ent = {"oids": list(spec.return_ids), "locs": list(locs or ()),
+               "nested": nested or [], "error": error}
+        if error is None and any(
+                l and l[0] == P.LOC_SHM for l in locs or ()):
+            # SHM-backed results are the only ones a node death can
+            # lose: ship the producing spec so the head registers
+            # lineage exactly like TASK_DONE does (inline/error locs
+            # live in the directory itself and never need it).
+            ent["spec"] = spec
+        self._done_buf.append(ent)
+        if nested and any(nested):
+            # Results nesting other refs register (and nested-pin)
+            # immediately: deferral would widen the window in which the
+            # producer's own handle drop could free the nested object
+            # before the container's pin lands.
+            self._flush_accounting_locked()
+        elif escaped_hit:
+            # The id ESCAPED while its call was still in flight (nested
+            # in this worker's own task result, pinned as an arg of a
+            # head submit or put): the head — or another worker behind
+            # it — is already waiting on the entry, and an idle worker
+            # has no future barrier, so parking here would leave that
+            # wait hanging forever. Escapes AFTER retirement always
+            # pass a barrier themselves (submit/put/completion drain
+            # the buffer), so the steady-state call-and-drop burst
+            # still parks.
+            self._flush_accounting_locked()
+
+    def _on_actor_results(self, chan, payloads: List[dict]) -> None:
+        """Retire a burst of inline results in ONE critical section;
+        steady state ships the head NOTHING here — the parked entries
+        drain in batches at the next accounting barrier (or on the
+        size-threshold overflow)."""
+        finished = []
+        with self._cond:
+            for payload in payloads:
+                tid = payload["t"]
+                spec = chan.inflight.pop(tid, None) \
+                    if isinstance(chan, _DirectChannel) else None
+                if spec is None:
+                    continue  # reconciled already (channel raced down)
+                finished.append(spec)
+                self._retire_locked(
+                    spec, payload.get("results"), payload.get("error"),
+                    payload.get("nested"))
+            self._n_results += len(finished)
+            if len(self._done_buf) >= self._done_flush_n:
+                self._flush_accounting_locked()
+        for spec in finished:
+            self._unpin_once(spec)
+
+    def _on_channel_down(self, chan: _DirectChannel) -> None:
+        """Channel EOF/error: drain every in-flight and queued call
+        through the head's reconciliation (retry-ledger bumped attempt
+        accounting; requeue-or-typed-error), then pin this (caller,
+        actor) pair to the head path."""
+        if not isinstance(chan, _DirectChannel):
+            return
+        w = self._worker
+        # Reply slot allocated up front so the RECONCILE send can happen
+        # INSIDE the _cond critical section that retires the local
+        # refcounts (the ordering invariant: later decrefs for these ids
+        # must enqueue after the accounting that transfers them).
+        with w._req_lock:
+            w._req_counter += 1
+            req_id = w._req_counter
+        fut: Future = Future()
+        w._pending[req_id] = fut
+        with self._cond:
+            if not chan.alive:
+                w._pending.pop(req_id, None)
+                return
+            chan.alive = False
+            # Parked completion accounting registers head-side BEFORE
+            # the reconcile is processed (same FIFO pipe), so the
+            # head's already-landed idempotence check can see it.
+            self._flush_accounting_locked()
+            ab = chan.actor_id.binary()
+            self._chans[ab] = _FALLBACK
+            specs = list(chan.inflight.values())
+            sent = set(id(s) for s in specs)
+            for s in chan.queue:
+                if id(s) not in sent:
+                    specs.append(s)
+            chan.inflight.clear()
+            chan.queue.clear()
+            deltas = []
+            for spec in specs:
+                ds = []
+                for rid in spec.return_ids:
+                    rb = rid.binary()
+                    self._escaped.discard(rb)  # head takes ownership
+                    ds.append(self._refs.pop(rb, 0))
+                deltas.append(ds)
+            if specs:
+                try:
+                    w.send(P.DIRECT_RECONCILE, {
+                        "actor_id": chan.actor_id, "specs": specs,
+                        "deltas": deltas, "req_id": req_id,
+                        "callee_wid": chan.callee_wid})
+                except Exception:
+                    fut.set_result(None)
+        chan.close()
+        if telemetry.enabled:
+            telemetry.record_direct_fallback("channel_down")
+        if not specs:
+            w._pending.pop(req_id, None)
+            return
+        try:
+            out = fut.result(timeout=60.0)
+        except Exception:
+            out = None
+        if isinstance(out, dict) and out.get("__error__") is not None:
+            out = None
+        with self._cond:
+            for i, spec in enumerate(specs):
+                res = out[i] if (isinstance(out, list)
+                                 and i < len(out)) else None
+                status = (res or {}).get("status")
+                for rid in spec.return_ids:
+                    rb = rid.binary()
+                    self._resolve_pending_locked(rb)
+                    if status in ("requeued", "done"):
+                        continue  # head owns it now: resolve via head
+                    blob = (res or {}).get("error") \
+                        or serialization.dumps(ActorDiedError(
+                            f"Actor {chan.actor_id.hex()} became "
+                            f"unreachable with direct calls in flight"))
+                    self._cache_put_locked(rb, (P.LOC_ERROR, blob))
+            self._cond.notify_all()
+        for spec in specs:
+            self._unpin_once(spec)
+
+    # ------------------------------------------------------------------
+    # callee side
+    # ------------------------------------------------------------------
+    def on_channel_open(self, payload: dict) -> None:
+        """CHANNEL_OPEN from the head: make sure the listener exists and
+        report its endpoints (oneway CHANNEL_ADDR, matched by token)."""
+        try:
+            info = self._ensure_listener()
+            reply = dict(info)
+            reply["token"] = payload.get("token")
+            reply["error"] = None
+        except Exception as e:
+            reply = {"token": payload.get("token"), "error": repr(e)}
+        try:
+            self._worker.send_lazy(P.CHANNEL_ADDR, reply)
+        except Exception:  # lint: broad-except-ok head pipe dead: broker times out and refuses the channel
+            pass
+
+    def _ensure_listener(self) -> dict:
+        with self._listen_lock:
+            if self._listener_info is not None:
+                return self._listener_info
+            from multiprocessing.connection import Listener
+            from .config import ray_config
+            key = os.urandom(16)
+            wid = self._worker.config.worker_id.hex()
+            path = os.path.join(self._worker.config.session_dir,
+                                f"d_{wid[:16]}.sock")
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            unix_l = Listener(path, family="AF_UNIX", authkey=key)
+            self._listeners.append(unix_l)
+            threading.Thread(target=self._accept_loop, args=(unix_l,),
+                             daemon=True, name="direct-accept-unix").start()
+            tcp = None
+            try:
+                host = str(ray_config.node_host)
+                tcp_l = Listener((host, 0), family="AF_INET", authkey=key)
+                self._listeners.append(tcp_l)
+                tcp = tcp_l.address
+                threading.Thread(target=self._accept_loop, args=(tcp_l,),
+                                 daemon=True,
+                                 name="direct-accept-tcp").start()
+            except OSError:
+                tcp = None  # UNIX-only host: same-node callers only
+            self._listener_info = {
+                "unix": path, "tcp": tcp, "key": key.hex(),
+                "worker_id": wid,
+                "node": self._worker.config.node_id_hex}
+            return self._listener_info
+
+    def _accept_loop(self, listener) -> None:
+        while True:
+            try:
+                conn = listener.accept()
+            except (OSError, EOFError):
+                return
+            except Exception:
+                # A failed auth handshake must not kill the acceptor.
+                logger.debug("direct accept failed", exc_info=True)
+                continue
+            try:
+                from .netcomm import tune_control_socket
+                tune_control_socket(conn.fileno())
+            except Exception:  # lint: broad-except-ok socket tuning is best-effort on non-TCP conns (same as netcomm)
+                pass
+            _ServeConn(self, conn)
+
+    @staticmethod
+    def _wire_spec(payload: dict):
+        spec = payload.get("spec")
+        if spec is not None:
+            return spec
+        tb, ab, mn, name, rids, nr, fid = payload["c"]
+        from .ids import ActorID, ObjectID, TaskID
+        return P.TaskSpec(
+            task_id=TaskID(tb), fn_id=fid, fn_blob=None,
+            return_ids=[ObjectID(b) for b in rids], num_returns=nr,
+            name=name, actor_id=ActorID(ab), method_name=mn)
+
+    def _on_actor_call(self, chan, payload: dict) -> None:
+        """One ACTOR_CALL landed on the callee: route it through the
+        actor's normal (ordered / concurrency-grouped) executors with
+        the result bound back to this channel."""
+        self._on_actor_calls(chan, [payload])
+
+    def _on_actor_calls(self, chan, payloads: List[dict]) -> None:
+        """A burst of calls from one caller. The common shape —
+        max_concurrency=1 actor, no concurrency groups, no trace
+        context — runs the whole run as ONE lean executor item
+        (worker_proc._execute_direct_batch), amortizing the
+        submit/Future machinery the head path pays per task; anything
+        else takes the full _execute path per spec."""
+        w = self._worker
+        specs = [self._wire_spec(p) for p in payloads]
+        if w._actor_instance is None or w._actor_executor is None:
+            blob = serialization.dumps(ActorDiedError(
+                "direct call reached a worker that hosts no live actor"))
+            for spec in specs:
+                self.send_result(chan, {
+                    "task_id": spec.task_id, "results": None,
+                    "error": blob, "actor_id": spec.actor_id,
+                    "return_oids": list(spec.return_ids)})
+            return
+        aspec = w._actor_spec
+        if (aspec is not None and aspec.max_concurrency == 1
+                and not w._cg_executors
+                and all(s.trace_ctx is None and not s.streaming
+                        and s.method_name != "__adag_exec_loop__"
+                        for s in specs)):
+            w._actor_executor.submit(w._execute_direct_batch, chan, specs)
+            return
+        for spec in specs:
+            spec.__dict__["_direct_chan"] = chan
+            w._handle_exec(spec)
+
+    def _tag_locs(self, locs):
+        node = self._worker.config.node_id_hex
+        if not node or not locs:
+            return locs
+        return [(P.LOC_SHM, l[1], node)
+                if (l and l[0] == P.LOC_SHM and len(l) < 3) else l
+                for l in locs]
+
+    def send_result(self, chan, payload: dict) -> None:
+        """Ship one completed direct call's result back to the caller;
+        if the caller is gone, fall back to head accounting so ids that
+        escaped the caller still resolve (DIRECT_DONE, zero residual)."""
+        locs = self._tag_locs(payload.get("results"))
+        payload["results"] = locs
+        try:
+            chan.writer.send_message(P.ACTOR_RESULT, {
+                "t": payload["task_id"].binary(), "results": locs,
+                "error": payload.get("error"),
+                "nested": payload.get("nested")})
+            return
+        except Exception:  # lint: broad-except-ok caller gone: fall through to head-accounting fallback below
+            pass
+        entry = {"task_id": payload["task_id"],
+                 "actor_id": payload.get("actor_id"),
+                 "oids": list(payload.get("return_oids") or ()),
+                 "locs": list(payload.get("results") or ()),
+                 "nested": payload.get("nested") or [],
+                 "deltas": [0] * len(payload.get("return_oids") or ()),
+                 "error": payload.get("error"),
+                 "name": payload.get("name", "")}
+        if payload.get("error") is None and payload.get("spec") \
+                is not None and any(l and l[0] == P.LOC_SHM
+                                    for l in locs or ()):
+            # Same invariant as the caller-side flush: SHM results
+            # carry their producing spec so escaped refs survive node
+            # loss via lineage even when the caller itself is gone.
+            entry["spec"] = payload["spec"]
+        try:
+            self._worker.send_lazy(P.DIRECT_DONE, {"entries": [entry]})
+        except Exception:  # lint: broad-except-ok head pipe dead too: the process is exiting, nothing left to tell
+            pass

@@ -22,6 +22,8 @@ Sites threaded through the runtime (see docs/FAULT_INJECTION.md):
     store.pull               one admission-controlled object pull
     store.spill              one escalated spill pass
     collective.rendezvous    one collective rendezvous KV round
+    direct.connect           a caller dialing a direct worker channel
+    direct.call              one ACTOR_CALL shipped on a direct channel
 
 Usage — the hot-path gate is a single module-attribute truthiness
 check, so disabled runs pay one dict lookup per site:
@@ -94,6 +96,7 @@ SITES = (
     "worker.exec", "worker.start",
     "gcs.op", "store.pull", "store.spill",
     "collective.rendezvous",
+    "direct.connect", "direct.call",
 )
 
 _EXCEPTIONS = {

@@ -224,6 +224,20 @@ class ObjectDirectory:
             e = self._entries.setdefault(oid, ObjectEntry())
             e.refcount += 1
 
+    def apply_delta(self, oid: ObjectID, delta: int):
+        """Apply one batched refcount delta from a worker's coalesced
+        accounting (REF_DELTAS bursts; DIRECT_DONE residual transfers).
+        Positive deltas may create the entry (borrow-before-
+        registration, like incref); zero/negative deltas run the free
+        logic so a fully-dropped direct result is reclaimed as soon as
+        its accounting lands."""
+        if delta > 0:
+            with self._lock:
+                e = self._entries.setdefault(oid, ObjectEntry())
+                e.refcount += delta
+        else:
+            self.decref(oid, -delta)
+
     def decref(self, oid: ObjectID, delta: int = 1):
         freed = None
         nested = None

@@ -30,6 +30,8 @@ CANCEL_TASK = "cancel"           # raise TaskCancelledError in the exec thread
 RELEASE_OBJECTS = "release"      # drop cached shm mappings
 SHUTDOWN = "shutdown"            # clean exit
 REPLY = "reply"                  # response to a worker-originated request
+CHANNEL_OPEN = "chan_open"       # start (or report) the direct-call listener
+RESULT_FWD = "result_fwd"        # oneway: nested-submission result locations
 
 # Message types: worker -> driver
 REF_COUNT = "ref_count"          # oneway borrow incref/decref from a worker
@@ -51,6 +53,23 @@ GCS_REQUEST = "gcs_request"      # generic metadata op (KV, named actors, ...)
 PULL_OBJECT = "pull_object"      # worker asks its node to localize an object
 TASK_EVENTS = "task_evts"        # oneway: drained TaskEventBuffer batch
 METRICS_PUSH = "metrics_push"    # oneway: worker metrics-registry snapshot
+CHANNEL_REQ = "chan_req"         # broker a direct channel to an actor's worker
+CHANNEL_ADDR = "chan_addr"       # oneway: callee reports its listener endpoint
+DIRECT_DONE = "direct_done"      # oneway: batched direct-call completion accounting
+DIRECT_RECONCILE = "direct_rec"  # drain in-flight direct calls of a dead callee
+REF_DELTAS = "ref_deltas"        # oneway: coalesced per-burst refcount deltas
+WORKER_BLOCKED = "wkr_blocked"   # oneway: current task parked in a local wait
+WORKER_UNBLOCKED = "wkr_unblocked"  # oneway: local wait finished
+
+# ---------------------------------------------------------------------------
+# Message types: worker <-> worker (the direct call plane). Steady-state
+# actor calls ship caller -> callee on a head-brokered channel and the
+# inline result returns callee -> caller on the same channel; the head
+# sees only batched accounting (reference: the direct actor transport,
+# core_worker/transport/direct_actor_task_submitter + task_receiver —
+# callers submit straight to the callee worker).
+ACTOR_CALL = "actor_call"        # worker <-> worker: one actor method call
+ACTOR_RESULT = "actor_result"    # worker <-> worker: its inline result
 
 # ---------------------------------------------------------------------------
 # Message types: per-host daemon <-> head control service (TCP). The daemon

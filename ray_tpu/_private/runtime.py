@@ -36,12 +36,18 @@ from . import lockdep
 from . import protocol as P
 from . import serialization
 from . import telemetry
-from .ids import ActorID, NodeID, ObjectID, TaskID
+from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import ObjectStore, create_store, inline_threshold
 from .resources import detect_node_resources
 from .scheduler import ResourceManager, Scheduler, WorkerHandle, WorkerPool
 
 logger = logging.getLogger(__name__)
+
+# Per-thread forward batch scope (see Node._forward_results): while a
+# recv thread drains one coalesced completion frame, nested-submission
+# result forwards buffer here and flush as one RESULT_FWD per submitter
+# at scope exit — per-frame batching instead of per-completion messages.
+_fwd_scope = threading.local()
 
 
 def _gc_stale_sessions(max_age_s: Optional[float] = None):
@@ -204,6 +210,13 @@ class Node:
             max_workers=32, thread_name_prefix="handler")
         self._fn_registry: Dict[str, bytes] = {}
         self._retries_used: Dict[bytes, int] = {}
+        # task_id bytes -> worker_id bytes: reconcile-requeued direct
+        # calls whose granted attempt would die with that incarnation.
+        # Kept OFF the spec (a dynamic attr would demote its dispatch
+        # pickle off the slim fast path and leak a head-internal marker
+        # to the worker). Entries are one-shot: popped by the death
+        # drain or at normal completion.
+        self._direct_prepaid: Dict[bytes, bytes] = {}
         self._recovery_lock = lockdep.lock("runtime.recovery")
         self._cancel_requested: Set[bytes] = set()
         self._actors: Dict[ActorID, _ActorState] = {}
@@ -264,6 +277,21 @@ class Node:
             self, self.cluster_token,
             host=str(ray_config.node_host),
             port=int(ray_config.head_port))
+        # -- direct worker<->worker call plane (direct.py; reference:
+        # transport/direct_actor_task_submitter): the head only BROKERS
+        # channels (CHANNEL_REQ/OPEN/ADDR) and ingests batched
+        # accounting; steady-state calls bypass it entirely.
+        self._direct_on = bool(ray_config.direct_calls_enabled)
+        self._fwd_on = self._direct_on and bool(
+            ray_config.direct_result_forwarding)
+        self._chan_waiters: Dict[int, Any] = {}
+        self._chan_lock = lockdep.lock("runtime.chan_broker")
+        self._chan_token = 0
+        # Nested-submission result forwarding: per-submitter buffers
+        # with group-commit flush (one RESULT_FWD frame per burst).
+        self._fwd_lock = lockdep.lock("runtime.result_fwd")
+        self._fwd_bufs: Dict[bytes, list] = {}
+        self._fwd_flushing: Set[bytes] = set()
         self._shutdown = False
         atexit.register(self.shutdown)
 
@@ -769,6 +797,18 @@ class Node:
     def _node_hex_of(self, worker) -> str:
         return getattr(worker, "node_id_hex", None) or self.node_id.hex()
 
+    def _register_error_returns(self, spec, blob: bytes) -> None:
+        """Register a terminal error on every return id AND push it to
+        a nested spec's submitter — every failure path that ends a
+        worker-submitted task must unblock its submitter's local wait
+        (the forwarding analogue of "errors surface on the ref")."""
+        for rid in spec.return_ids:
+            self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+        if self._fwd_on and getattr(spec, "_submitter_wid", None) \
+                is not None:
+            self._forward_spec_results(
+                spec, [(P.LOC_ERROR, blob)] * len(spec.return_ids))
+
     def _dispatch(self, spec, worker: Optional[WorkerHandle]):
         """Scheduler callback: ship a ready task/actor-creation to a worker."""
         # The submit-time stamp must not ride the spec onto the wire (a
@@ -788,8 +828,7 @@ class Node:
                     f"exceeds cluster totals "
                     f"{self.node_registry.aggregate()[0]}")
             blob = serialization.dumps(err)
-            for rid in spec.return_ids:
-                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._register_error_returns(spec, blob)
             self._unpin_task_args(spec)
             return
         self._resolve_arg_locations(spec)
@@ -850,16 +889,11 @@ class Node:
 
         task_id: TaskID = payload["task_id"]
         oid = object_id_for_return(task_id, payload["index"])
-        loc = payload["loc"]
-        size = loc[1] if loc[0] == P.LOC_SHM else len(loc[1])
-        if loc[0] == P.LOC_SHM and self._loc_is_local(loc):
-            self.store.adopt(oid, size)
         # Lineage: the producing spec (from the worker's running table)
         # makes items cancellable/recoverable like normal returns.
         spec = handle.running.get(task_id.binary())
-        self.gcs.objects.register_ready(
-            oid, self._tag_local_loc(loc), size, lineage=spec,
-            nested_ids=payload.get("nested") or [])
+        self._register_result_loc(oid, payload["loc"], spec,
+                                  payload.get("nested") or [])
         with self._gen_lock:
             st = self._gen_stream_state(task_id)
             st["count"] = max(st["count"], payload["index"] + 1)
@@ -1000,6 +1034,11 @@ class Node:
     def _on_task_done(self, handle: WorkerHandle, payload: dict):
         task_id: TaskID = payload["task_id"]
         spec = handle.running.pop(task_id.binary(), None)
+        # A reconcile-requeued direct call that ran to completion keeps
+        # its normal accounting: drop the (rare) prepaid marker so it
+        # cannot linger and grant a later death an uncharged attempt.
+        if self._direct_prepaid:
+            self._direct_prepaid.pop(task_id.binary(), None)
         is_actor_task = payload.get("actor_id") is not None
         if spec is not None and not is_actor_task:
             if self.scheduler.note_task_finished(spec, handle):
@@ -1036,20 +1075,19 @@ class Node:
                 self._resubmit(spec)
                 return
             self._unpin_task_args(spec)
-            for rid in spec.return_ids:
-                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, error))
+            self._register_error_returns(spec, error)
         else:
             self._unpin_task_args(spec)
             nested_lists = payload.get("nested") or [[]] * len(
                 spec.return_ids)
+            fwd_locs = []
             for rid, loc, nested in zip(spec.return_ids,
                                         payload["results"], nested_lists):
-                size = loc[1] if loc[0] == P.LOC_SHM else len(loc[1])
-                if loc[0] == P.LOC_SHM and self._loc_is_local(loc):
-                    self.store.adopt(rid, size)
-                self.gcs.objects.register_ready(
-                    rid, self._tag_local_loc(loc), size, lineage=spec,
-                    nested_ids=nested)
+                fwd_locs.append(self._register_result_loc(
+                    rid, loc, spec, nested))
+            if self._fwd_on and getattr(spec, "_submitter_wid", None) \
+                    is not None:
+                self._forward_spec_results(spec, fwd_locs)
         self.gcs.record_task_event({
             "task_id": task_id.hex(), "name": spec.name,
             "state": "FAILED" if error is not None else "FINISHED",
@@ -1106,9 +1144,7 @@ class Node:
                 blob = serialization.dumps(ActorDiedError(
                     f"Actor {spec.actor_id.hex()} died before task "
                     f"{spec.task_id.hex()} could be retried"))
-                for rid in spec.return_ids:
-                    self.gcs.objects.register_ready(
-                        rid, (P.LOC_ERROR, blob))
+                self._register_error_returns(spec, blob)
                 self._unpin_task_args(spec)
                 return
             self._enqueue_actor_task(st, spec)
@@ -1270,9 +1306,7 @@ class Node:
         for item in pending:
             if item[0].streaming:
                 self._finish_gen_stream(item[0].task_id, None, error_blob)
-            for rid in item[0].return_ids:
-                self.gcs.objects.register_ready(
-                    rid, (P.LOC_ERROR, error_blob))
+            self._register_error_returns(item[0], error_blob)
             self._unpin_task_args(item[0])
 
     def submit_actor_task(self, spec: P.TaskSpec):
@@ -1288,8 +1322,7 @@ class Node:
                                f"({entry.death_cause})"))
             if spec.streaming:
                 self._finish_gen_stream(spec.task_id, None, blob)
-            for rid in spec.return_ids:
-                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._register_error_returns(spec, blob)
             return
         if spec.max_retries == -2:
             # Per-call budget unset: inherit the actor's max_task_retries
@@ -1387,9 +1420,7 @@ class Node:
                         if spec.streaming:
                             self._finish_gen_stream(
                                 spec.task_id, None, blob)
-                        for rid in spec.return_ids:
-                            self.gcs.objects.register_ready(
-                                rid, (P.LOC_ERROR, blob))
+                        self._register_error_returns(spec, blob)
                         self._unpin_task_args(spec)
                     elif refetch:
                         self._flush_actor_queue(st)
@@ -1439,7 +1470,8 @@ class Node:
                 break
             running[k] = v
         if aid is not None:
-            self._on_actor_worker_death(aid, running)
+            self._on_actor_worker_death(aid, running,
+                                        handle.worker_id.binary())
             return
         for spec in running.values():
             self.scheduler.release_task_resources(spec)
@@ -1452,8 +1484,7 @@ class Node:
                 TaskCancelledError(spec.task_id.hex()))
             if spec.streaming:
                 self._finish_gen_stream(spec.task_id, None, blob)
-            for rid in spec.return_ids:
-                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._register_error_returns(spec, blob)
             self._unpin_task_args(spec)
             return
         # Streaming tasks are not retryable (consumed items can't be
@@ -1477,12 +1508,12 @@ class Node:
                 f"The worker running task {spec.name} died ({reason})."))
             if spec.streaming:
                 self._finish_gen_stream(spec.task_id, None, blob)
-            for rid in spec.return_ids:
-                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._register_error_returns(spec, blob)
             self._unpin_task_args(spec)
 
     def _on_actor_worker_death(self, actor_id: ActorID,
-                               running: Dict[bytes, P.TaskSpec]):
+                               running: Dict[bytes, P.TaskSpec],
+                               dead_wid: Optional[bytes] = None):
         st = self._actors.get(actor_id)
         entry = self.gcs.actors.get(actor_id)
         if st is None or entry is None:
@@ -1501,9 +1532,17 @@ class Node:
         # tasks never retry (consumed items can't be replayed).
         retry_specs = []
         for spec in running.values():
+            # A spec the direct-reconcile path requeued onto THIS dying
+            # incarnation already paid for its retry there and never
+            # ran (the channel EOF and this death are the same event) —
+            # requeue it again without a second ledger charge. The
+            # marker is one-shot and incarnation-scoped: a spec that
+            # genuinely ran on a later worker charges normally.
+            prepaid = (dead_wid is not None and self._direct_prepaid.pop(
+                spec.task_id.binary(), None) == dead_wid)
             if (will_restart and not spec.streaming
                     and spec.task_id.binary() not in self._cancel_requested
-                    and self._retry_budget(spec)):
+                    and (prepaid or self._retry_budget(spec))):
                 retry_specs.append(spec)
                 continue
             if spec.streaming:
@@ -1550,8 +1589,7 @@ class Node:
         self._cancel_requested.add(task_id.binary())
         if self.scheduler.try_cancel(task_id):
             blob = serialization.dumps(TaskCancelledError(task_id.hex()))
-            for rid in spec.return_ids:
-                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            self._register_error_returns(spec, blob)
             self._unpin_task_args(spec)
             return
         for h in self._all_worker_handles():
@@ -1585,20 +1623,25 @@ class Node:
         while everything else routes in arrival order (a REF_COUNT
         decref between two submits MUST stay between them: reordering
         it ahead of a submit's arg pin frees the arg early)."""
-        i, n = 0, len(msgs)
-        while i < n:
-            msg_type, payload = msgs[i]
-            if msg_type == P.SUBMIT_TASK:
-                j = i + 1
-                while j < n and msgs[j][0] == P.SUBMIT_TASK:
-                    j += 1
-                if j - i > 1:
-                    self._submit_task_run(
-                        handle, [m[1] for m in msgs[i:j]])
-                    i = j
-                    continue
-            self._on_worker_message(handle, msg_type, payload)
-            i += 1
+        scoped = self._fwd_on and self._fwd_scope_begin()
+        try:
+            i, n = 0, len(msgs)
+            while i < n:
+                msg_type, payload = msgs[i]
+                if msg_type == P.SUBMIT_TASK:
+                    j = i + 1
+                    while j < n and msgs[j][0] == P.SUBMIT_TASK:
+                        j += 1
+                    if j - i > 1:
+                        self._submit_task_run(
+                            handle, [m[1] for m in msgs[i:j]])
+                        i = j
+                        continue
+                self._on_worker_message(handle, msg_type, payload)
+                i += 1
+        finally:
+            if scoped:
+                self._fwd_scope_end()
 
     def _submit_task_run(self, handle: WorkerHandle, payloads) -> None:
         """Batched worker-originated submissions: per-spec registration
@@ -1642,6 +1685,317 @@ class Node:
                                     dropped=payload.get("dropped", 0),
                                     from_worker=True)
 
+    # ------------------------------------------------------------------
+    # direct worker<->worker call plane (head side: broker + accounting)
+    # ------------------------------------------------------------------
+    def _broker_channel(self, handle: WorkerHandle, payload: dict):
+        """CHANNEL_REQ: hand the caller a dialable endpoint of the
+        actor's worker. The head validates liveness, asks the callee to
+        stand its listener up (CHANNEL_OPEN -> CHANNEL_ADDR), and fixes
+        the cross-node host up from its registration view. One round
+        trip per (caller, actor) pair — steady-state calls then bypass
+        the head entirely."""
+        from concurrent.futures import Future as _Future
+        req_id = payload.get("req_id")
+        actor_id = payload["actor_id"]
+
+        def refuse(reason: str):
+            self._reply(handle, req_id, {"ok": False, "reason": reason})
+
+        if not self._direct_on:
+            refuse("direct_calls_enabled is off")
+            return
+        st = self._actors.get(actor_id)
+        entry = self.gcs.actors.get(actor_id)
+        if (st is None or entry is None or st.dead
+                or entry.state == gcs_mod.ACTOR_DEAD):
+            refuse("actor is not alive")
+            return
+        if (entry.state != gcs_mod.ACTOR_ALIVE or st.worker is None
+                or not st.worker.alive):
+            # PENDING/RESTARTING: the callee will usually be dialable
+            # in a moment. Marked transient so the caller routes THIS
+            # call through the head but does NOT pin the pair to the
+            # fallback path — a first burst racing the actor's
+            # construction would otherwise lose the direct plane for
+            # the pair's whole lifetime.
+            self._reply(handle, req_id, {
+                "ok": False, "transient": True,
+                "reason": "actor is not ready yet"})
+            return
+        callee = st.worker
+        with self._chan_lock:
+            self._chan_token += 1
+            token = self._chan_token
+            fut: "_Future" = _Future()
+            self._chan_waiters[token] = fut
+        try:
+            callee.send(P.CHANNEL_OPEN, {"token": token})
+            from .config import ray_config
+            info = fut.result(
+                timeout=float(ray_config.direct_channel_timeout_s))
+        except Exception:
+            refuse("callee listener unavailable")
+            return
+        finally:
+            with self._chan_lock:
+                self._chan_waiters.pop(token, None)
+        if not isinstance(info, dict) or info.get("error"):
+            refuse(f"callee listener failed: {info.get('error')}")
+            return
+        callee_node = self._node_hex_of(callee)
+        caller_node = self._node_hex_of(handle)
+        tcp = info.get("tcp")
+        if tcp is not None and caller_node != callee_node:
+            # The callee bound its node-local host; cross-node callers
+            # dial the node's head-registered reachable address.
+            addr = self.transfer_addr_of(callee_node)
+            if addr is not None:
+                tcp = (addr[0], tcp[1])
+        self._reply(handle, req_id, {
+            "ok": True,
+            "unix": info.get("unix") if caller_node == callee_node
+            else None,
+            "tcp": tcp, "key": info["key"], "callee_node": callee_node,
+            "callee_worker": info.get("worker_id")})
+
+    def _on_channel_addr(self, payload: dict):
+        with self._chan_lock:
+            fut = self._chan_waiters.pop(payload.get("token"), None)
+        if fut is not None:
+            fut.set_result(payload)
+
+    def _note_blocked_and_recall(self, handle: WorkerHandle) -> None:
+        """Blocked worker (a blocking get/wait request, or the oneway
+        WORKER_BLOCKED from a local direct/forwarded-result wait): hand
+        the lease's grant back so dependency tasks can schedule
+        (reference: blocked workers release their CPU), and evacuate
+        any tasks queued behind the blocked one — they may BE its
+        dependencies (sequential executor). Counter managed under the
+        scheduler lock (pipeline-dispatch race)."""
+        if (self.scheduler.note_worker_blocked(handle)
+                and getattr(handle, "inflight", 0) > 1):
+            try:
+                handle.send(P.RECALL_QUEUED, {})
+            except Exception:  # lint: broad-except-ok dying worker pipe; its death callback requeues the tasks
+                pass
+
+    def _register_result_loc(self, rid, loc, lineage, nested):
+        """One completed return id into the object directory: shm
+        adoption, node tagging, size, lineage. THE shared registration
+        for the head path (TASK_DONE) and the direct plane
+        (DIRECT_DONE) — direct results must stay byte-equivalent to
+        head-path results, so there is exactly one copy of this
+        sequence. Returns the tagged location (the forward push ships
+        it)."""
+        size = loc[1] if loc[0] == P.LOC_SHM else len(loc[1])
+        if loc[0] == P.LOC_SHM and self._loc_is_local(loc):
+            self.store.adopt(rid, size)
+        loc = self._tag_local_loc(loc)
+        self.gcs.objects.register_ready(
+            rid, loc, size, lineage=lineage, nested_ids=nested)
+        return loc
+
+    def _on_direct_done(self, handle: WorkerHandle, payload: dict):
+        """Batched completion accounting for direct calls: register the
+        results in the object directory (shm adoption + location
+        tagging, exactly like TASK_DONE) and absorb the caller's
+        residual local refcounts."""
+        for ent in payload.get("entries", ()):
+            error = ent.get("error")
+            oids = ent.get("oids") or ()
+            locs = ent.get("locs") or ()
+            nested = ent.get("nested") or ()
+            deltas = ent.get("deltas") or ()
+            for i, oid in enumerate(oids):
+                if error is not None:
+                    loc = (P.LOC_ERROR, error)
+                else:
+                    loc = locs[i] if i < len(locs) else None
+                    if loc is None:
+                        continue
+                nst = list(nested[i]) if i < len(nested) and nested[i] \
+                    else []
+                self._register_result_loc(oid, loc, ent.get("spec"), nst)
+                self.gcs.objects.apply_delta(
+                    oid, deltas[i] if i < len(deltas) else 0)
+
+    def _on_ref_deltas(self, payload: dict):
+        """Coalesced per-burst refcount deltas from a worker. Positive
+        deltas apply first so a burst can never dip an object's count
+        through zero transiently."""
+        items = payload.get("deltas") or ()
+        for oid, d in items:
+            if d > 0:
+                self.gcs.objects.apply_delta(oid, d)
+        for oid, d in items:
+            if d < 0:
+                self.gcs.objects.apply_delta(oid, d)
+
+    def _on_direct_reconcile(self, handle: WorkerHandle, payload: dict):
+        """A caller's direct channel died with calls in flight: route
+        every drained spec through the normal retry machinery — the
+        ledger-bumped `attempt` accounting, requeue onto a restarting
+        actor when budget remains, typed ActorDiedError otherwise —
+        and absorb the caller's local refcounts either way."""
+        req_id = payload.get("req_id")
+        actor_id = payload["actor_id"]
+        specs = payload.get("specs") or []
+        deltas = payload.get("deltas") or []
+        chan_wid = payload.get("callee_wid")
+        st = self._actors.get(actor_id)
+        entry = self.gcs.actors.get(actor_id)
+        out = []
+        for i, spec in enumerate(specs):
+            ds = deltas[i] if i < len(deltas) else [0] * len(
+                spec.return_ids)
+            entries = [self.gcs.objects.entry(rid)
+                       for rid in spec.return_ids]
+            if entries and all(e is not None and e.event.is_set()
+                               and e.state != gcs_mod.LOST
+                               for e in entries):
+                # The callee's result landed (DIRECT_DONE / fallback)
+                # before the channel tore down: nothing to redo.
+                for rid, d in zip(spec.return_ids, ds):
+                    self.gcs.objects.apply_delta(rid, d)
+                out.append({"status": "done"})
+                continue
+            if spec.max_retries == -2:
+                spec.max_retries = int(
+                    getattr(st.spec, "max_task_retries", 0) or 0) \
+                    if st is not None else 0
+            self.gcs.objects.register_submitted(spec.return_ids, spec,
+                                                incref_delta=0)
+            for rid, d in zip(spec.return_ids, ds):
+                self.gcs.objects.apply_delta(rid, d)
+            alive = (st is not None and entry is not None and not st.dead
+                     and entry.state != gcs_mod.ACTOR_DEAD)
+            if alive and not spec.streaming and self._retry_budget(spec):
+                self.gcs.record_task_event({
+                    "task_id": spec.task_id.hex(), "name": spec.name,
+                    "state": "PENDING_SCHEDULING",
+                    "attempt": self._attempt_of(spec), "ts": time.time()})
+                self._pin_task_args(spec)
+                with st.lock:
+                    w = st.worker
+                if w is not None and chan_wid is not None \
+                        and w.worker_id.hex() == chan_wid:
+                    # The channel EOF that triggered this reconcile is
+                    # usually the callee worker's own death racing ahead
+                    # of the head's WORKER_DIED processing (different
+                    # connection, no cross-pipe ordering). If this
+                    # requeue dispatches into that dying incarnation,
+                    # the attempt just granted never runs — mark it
+                    # prepaid so the death drain requeues it once more
+                    # without charging the ledger a second time. The
+                    # guard matters when the orderings flip: a requeue
+                    # onto an already-restarted incarnation genuinely
+                    # RUNS there, and stamping it would hand out one
+                    # uncharged attempt past max_task_retries if that
+                    # incarnation later died mid-run.
+                    self._direct_prepaid[spec.task_id.binary()] = \
+                        w.worker_id.binary()
+                self._enqueue_actor_task(st, spec)
+                out.append({"status": "requeued"})
+            else:
+                blob = (entry.creation_error if entry is not None
+                        else None) or serialization.dumps(ActorDiedError(
+                            f"Actor {actor_id.hex()} died with direct "
+                            f"call {spec.name} in flight"))
+                self.gcs.record_task_event({
+                    "task_id": spec.task_id.hex(), "name": spec.name,
+                    "state": "FAILED",
+                    "attempt": self._attempt_of(spec), "ts": time.time()})
+                for rid in spec.return_ids:
+                    self.gcs.objects.register_ready(
+                        rid, (P.LOC_ERROR, blob))
+                out.append({"status": "failed", "error": blob})
+        self._reply(handle, req_id, out)
+
+    def _submitter_handle(self, spec):
+        """The live handle of a nested spec's submitting worker, or
+        None (dead/unknown — its local waiters died with it)."""
+        wid = getattr(spec, "_submitter_wid", None)
+        if wid is None:
+            return None
+        h = self.pool.workers.get(WorkerID(wid))
+        if h is not None:
+            return h if h.alive else None
+        for p in self.head_server.all_proxies():
+            if p.worker_id.binary() == wid:
+                return p if p.alive else None
+        return None
+
+    def _forward_spec_results(self, spec, locs) -> None:
+        """Inline forwarding at a registration chokepoint: push the
+        just-registered locations of a worker-submitted task straight
+        to its submitter (one buffer append per rid inside a forward
+        scope; the scope flush ships one RESULT_FWD per submitter per
+        completion frame). Paths that bypass the chokepoints (lost-
+        object recovery) are covered by the worker's resync fallback.
+        `locs` aligns with spec.return_ids; a None loc demotes that id
+        to the head-request path."""
+        handle = self._submitter_handle(spec)
+        if handle is None:
+            return
+        for rid, loc in zip(spec.return_ids, locs):
+            self._forward_results(handle, rid, loc)
+
+    def _forward_results(self, handle: WorkerHandle, rid, loc) -> None:
+        """Forward one registered location to its submitter. Inside a
+        forward scope (a recv thread draining a coalesced completion
+        frame — see _fwd_scope) entries buffer per submitter and flush
+        as ONE RESULT_FWD when the frame's processing ends; outside a
+        scope (handler-pool error paths, dispatch-thread failures) the
+        per-submitter group-commit flush runs immediately."""
+        scope = getattr(_fwd_scope, "bufs", None)
+        if scope is not None:
+            scope.setdefault(handle, []).append((rid, loc))
+            return
+        wid = handle.worker_id.binary()
+        with self._fwd_lock:
+            self._fwd_bufs.setdefault(wid, []).append((rid, loc))
+            if wid in self._fwd_flushing:
+                return
+            self._fwd_flushing.add(wid)
+        while True:
+            with self._fwd_lock:
+                batch = self._fwd_bufs.get(wid) or []
+                self._fwd_bufs[wid] = []
+                if not batch:
+                    self._fwd_flushing.discard(wid)
+                    self._fwd_bufs.pop(wid, None)
+                    return
+            if telemetry.enabled:
+                telemetry.record_result_forward(len(batch))
+            try:
+                handle.send(P.RESULT_FWD, {"entries": batch})
+            except Exception:
+                # Dead submitter: its local waiters die with it.
+                with self._fwd_lock:
+                    self._fwd_bufs.pop(wid, None)
+                    self._fwd_flushing.discard(wid)
+                return
+
+    def _fwd_scope_begin(self):
+        """Enter a forward batch scope on this thread (returns False if
+        one is already active — nested scopes join the outer one)."""
+        if getattr(_fwd_scope, "bufs", None) is not None:
+            return False
+        _fwd_scope.bufs = {}
+        return True
+
+    def _fwd_scope_end(self):
+        bufs, _fwd_scope.bufs = _fwd_scope.bufs, None
+        for handle, entries in bufs.items():
+            if telemetry.enabled:
+                telemetry.record_result_forward(len(entries))
+            try:
+                handle.send(P.RESULT_FWD, {"entries": entries})
+            except Exception:  # lint: broad-except-ok dead submitter: its local waiters die with it
+                logger.debug("dropping result forward to dead worker",
+                             exc_info=True)
+
     def _on_worker_message(self, handle: WorkerHandle, msg_type: str,
                            payload: dict):
         if msg_type == P.REF_COUNT:
@@ -1653,9 +2007,16 @@ class Node:
         elif msg_type == P.TASK_DONE:
             self._on_task_done(handle, payload)
         elif msg_type == P.TASKS_DONE:
-            # Coalesced completions from a pipelined worker burst.
-            for done in payload["batch"]:
-                self._on_task_done(handle, done)
+            # Coalesced completions from a pipelined worker burst; the
+            # forward scope turns their per-completion result forwards
+            # into one RESULT_FWD per submitter for the whole batch.
+            scoped = self._fwd_on and self._fwd_scope_begin()
+            try:
+                for done in payload["batch"]:
+                    self._on_task_done(handle, done)
+            finally:
+                if scoped:
+                    self._fwd_scope_end()
         elif msg_type == P.TASKS_RECALLED:
             self._on_tasks_recalled(handle, payload["task_ids"])
         elif msg_type == P.GEN_ITEM:
@@ -1671,8 +2032,22 @@ class Node:
                 ts=payload.get("ts"))
         elif msg_type == P.ACTOR_READY:
             self._on_actor_ready(handle, payload)
+        elif msg_type == P.DIRECT_DONE:
+            self._on_direct_done(handle, payload)
+        elif msg_type == P.REF_DELTAS:
+            self._on_ref_deltas(payload)
+        elif msg_type == P.CHANNEL_ADDR:
+            self._on_channel_addr(payload)
+        elif msg_type == P.WORKER_BLOCKED:
+            # A worker parked in a LOCAL direct/forwarded-result wait:
+            # same lease-release + queued-task-recall semantics the
+            # blocking GET_LOCATIONS round trip used to carry.
+            self._note_blocked_and_recall(handle)
+        elif msg_type == P.WORKER_UNBLOCKED:
+            self.scheduler.note_worker_unblocked(handle)
         elif msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS, P.GCS_REQUEST,
-                          P.PULL_OBJECT):
+                          P.PULL_OBJECT, P.CHANNEL_REQ,
+                          P.DIRECT_RECONCILE):
             # GCS requests may block (placement-group waits, cross-node
             # pulls), so they run on the handler pool, never the
             # per-worker recv thread.
@@ -1697,23 +2072,16 @@ class Node:
         # blocked one would wait with it.
         mark = msg_type in (P.GET_LOCATIONS, P.WAIT_OBJECTS)
         if mark:
-            # Blocked in get/wait: hand the lease's grant back so
-            # dependency tasks can schedule (reference: blocked
-            # workers release their CPU), and evacuate any tasks
-            # queued behind the blocked one — they may BE its
-            # dependencies (sequential executor). Counter managed
-            # under the scheduler lock (pipeline-dispatch race).
-            if (self.scheduler.note_worker_blocked(handle)
-                    and getattr(handle, "inflight", 0) > 1):
-                try:
-                    handle.send(P.RECALL_QUEUED, {})
-                except Exception:
-                    pass
+            self._note_blocked_and_recall(handle)
         try:
             if msg_type == P.GET_LOCATIONS:
                 locs = self.get_locations(payload["object_ids"],
                                           payload.get("timeout"))
                 self._reply(handle, req_id, locs)
+            elif msg_type == P.CHANNEL_REQ:
+                self._broker_channel(handle, payload)
+            elif msg_type == P.DIRECT_RECONCILE:
+                self._on_direct_reconcile(handle, payload)
             elif msg_type == P.PULL_OBJECT:
                 oid = payload["object_id"]
                 self._ensure_local(oid, payload["node"])
@@ -1752,8 +2120,8 @@ class Node:
             blob = serialization.dumps(
                 exc if isinstance(exc, TaskError)
                 else TaskError(f"{type(exc).__name__}: {exc}"))
-            for rid in getattr(spec, "return_ids", ()) or ():
-                self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
+            if getattr(spec, "return_ids", None):
+                self._register_error_returns(spec, blob)
         except Exception:
             pass
 
@@ -1820,7 +2188,18 @@ class Node:
                 self._worker_submit(handle, spec, req_id,
                                     self.submit_task)
             elif msg_type == P.SUBMIT_ACTOR_TASK:
-                self._worker_submit(handle, payload["spec"], req_id,
+                spec = payload["spec"]
+                # Head-routed (fallback) actor calls marked their
+                # return ids forward-pending caller-side; without the
+                # submitter the RESULT_FWD push never fires and every
+                # get() pays the full resync delay. Gated on _fwd_on:
+                # with forwarding off no worker marks results pending
+                # (env coherence), and the dynamic attr would demote
+                # the spec off the slim-pickle fast path on every
+                # dispatch — the flag-off contract is zero extra work.
+                if self._fwd_on:
+                    spec._submitter_wid = handle.worker_id.binary()
+                self._worker_submit(handle, spec, req_id,
                                     self.submit_actor_task)
             elif msg_type == P.CREATE_ACTOR_REQ:
                 self.create_actor(payload["spec"])

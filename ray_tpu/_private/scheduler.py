@@ -952,6 +952,16 @@ class WorkerPool:
         # sitecustomize force-registers a TPU backend in every interpreter.
         env.setdefault("JAX_PLATFORMS", "cpu")
         env.setdefault("PALLAS_AXON_POOL_IPS", "")
+        # Direct-call plane coherence: workers must agree with the HEAD
+        # about the flag (a programmatic ray_config.set in the driver
+        # would otherwise diverge from the env the worker reads) — a
+        # worker that marks results forward-pending while the head never
+        # forwards would stall its local waits.
+        from .config import ray_config as _rc
+        env["RAY_TPU_DIRECT_CALLS_ENABLED"] = \
+            "1" if _rc.direct_calls_enabled else "0"
+        env["RAY_TPU_DIRECT_RESULT_FORWARDING"] = \
+            "1" if _rc.direct_result_forwarding else "0"
         # Never inherit the DRIVER's chip visibility: a cpu-pool worker
         # with no chips assigned must not report the driver's
         # TPU_VISIBLE_CHIPS through get_tpu_ids().

@@ -168,7 +168,17 @@ class SerializedObject:
         return bytes(out[:n])
 
 
+# Exact types stdlib pickle handles identically to cloudpickle AND
+# by value (no by-reference module lookup that could dangle across
+# processes). The common small task results (None, numbers, strings)
+# skip cloudpickle's dispatch machinery — measured ~15x faster dumps
+# for None, a visible slice of per-call cost on nop-shaped workloads.
+_FAST_TYPES = (type(None), bool, int, float, str, bytes)
+
+
 def serialize(obj: Any) -> SerializedObject:
+    if obj is None or type(obj) in _FAST_TYPES:
+        return SerializedObject(pickle.dumps(obj, protocol=5), [])
     buffers: List[memoryview] = []
 
     def _cb(pb: pickle.PickleBuffer):

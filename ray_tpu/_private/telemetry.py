@@ -201,9 +201,57 @@ def record_node_stats(store_used: int, num_workers: int,
             "Unassigned TPU chips on this node").set(free_chips)
 
 
+# -- direct worker<->worker call plane --------------------------------------
+def record_direct_calls(n: int) -> None:
+    """Actor calls shipped on direct channels (batched at the plane's
+    accounting flush — a per-call Metric.inc would tax the exact hot
+    path the plane exists to strip)."""
+    global _ops
+    _ops += 1
+    if n > 0:
+        _metric("direct_calls_total", "counter",
+                "Actor calls shipped caller->callee on direct channels"
+                ).inc(n)
+
+
+def record_direct_results(n: int) -> None:
+    """Inline results delivered callee->caller (batched, as above)."""
+    global _ops
+    _ops += 1
+    if n > 0:
+        _metric("direct_results_total", "counter",
+                "Inline results delivered on direct channels").inc(n)
+
+
+def record_direct_fallback(reason: str) -> None:
+    """A call (or channel) fell back to the head-routed path."""
+    global _ops
+    _ops += 1
+    _metric("direct_fallbacks_total", "counter",
+            "Direct-path calls/channels that fell back to the head path",
+            tag_keys=("reason",)).inc(tags={"reason": reason})
+
+
+def record_result_forward(n: int) -> None:
+    """Nested-submission result locations forwarded head->submitter."""
+    global _ops
+    _ops += 1
+    if n > 0:
+        _metric("nested_results_forwarded_total", "counter",
+                "Result locations pushed head->submitting worker").inc(n)
+
+
 # -- serve plane ------------------------------------------------------------
+# Request-path gauge writes are DEFERRED: the per-request hot path only
+# touches a plain dict under one lock and marks the deployment dirty;
+# the Metric objects sync at sample time (flush_serve_gauges — called
+# by the head's scrape refresh and by the worker metrics push). Profiled
+# on the serve bench: per-request tagged Metric.set calls were a
+# measurable slice of the r4->r5 throughput regression.
 _serve_inflight_lock = threading.Lock()
 _serve_inflight: Dict[str, int] = {}
+_serve_ongoing: Dict[str, float] = {}
+_serve_dirty: set = set()
 
 
 def serve_inflight(deployment: str, delta: int) -> None:
@@ -212,10 +260,32 @@ def serve_inflight(deployment: str, delta: int) -> None:
     with _serve_inflight_lock:
         n = _serve_inflight.get(deployment, 0) + delta
         _serve_inflight[deployment] = max(n, 0)
-    _metric("serve_inflight_requests", "gauge",
-            "In-flight HTTP requests per deployment",
-            tag_keys=("deployment",)).set(
-                max(n, 0), tags={"deployment": deployment})
+        _serve_dirty.add(deployment)
+
+
+def flush_serve_gauges() -> None:
+    """Sync deferred serve gauges into the metric registry (sample
+    time: head scrape refresh / worker METRICS_PUSH)."""
+    global _ops
+    _ops += 1
+    with _serve_inflight_lock:
+        if not _serve_dirty:
+            return
+        dirty = list(_serve_dirty)
+        _serve_dirty.clear()
+        inflight = {d: _serve_inflight.get(d) for d in dirty}
+        ongoing = {d: _serve_ongoing.get(d) for d in dirty}
+    for d in dirty:
+        if inflight[d] is not None:
+            _metric("serve_inflight_requests", "gauge",
+                    "In-flight HTTP requests per deployment",
+                    tag_keys=("deployment",)).set(
+                        float(inflight[d]), tags={"deployment": d})
+        if ongoing[d] is not None:
+            _metric("serve_replica_ongoing_requests", "gauge",
+                    "Requests currently executing in this replica",
+                    tag_keys=("deployment",)).set(
+                        float(ongoing[d]), tags={"deployment": d})
 
 
 def serve_request(deployment: str, dt: float) -> None:
@@ -239,10 +309,9 @@ def serve_replica_request(deployment: str, dt: float) -> None:
 def serve_replica_ongoing(deployment: str, n: int) -> None:
     global _ops
     _ops += 1
-    _metric("serve_replica_ongoing_requests", "gauge",
-            "Requests currently executing in this replica",
-            tag_keys=("deployment",)).set(
-                float(n), tags={"deployment": deployment})
+    with _serve_inflight_lock:
+        _serve_ongoing[deployment] = float(n)
+        _serve_dirty.add(deployment)
 
 
 # ---------------------------------------------------------------------------
@@ -424,6 +493,10 @@ def _refresh_head_gauges(node) -> None:
     """Point-in-time head gauges set at exposition time — zero hot-path
     cost: nothing is tracked continuously, the values are read off the
     live runtime when someone actually scrapes."""
+    try:
+        flush_serve_gauges()  # deferred serve request-path gauges
+    except Exception:  # lint: broad-except-ok scrape-time gauge on a live runtime mid-teardown; exposition must not 500
+        logger.debug("serve gauge flush failed", exc_info=True)
     try:
         record_queue_depth(node.scheduler.queue_depth())
     except Exception:  # lint: broad-except-ok scrape-time gauge on a live runtime mid-teardown; exposition must not 500

@@ -84,18 +84,28 @@ class WorkerClient:
         return self._worker.request(msg_type, payload)
 
     # -- borrow refcounting (oneway; pipe ordering guarantees the incref
-    # from arg deserialization lands before this task's TASK_DONE unpin) --
+    # from arg deserialization lands before this task's TASK_DONE unpin —
+    # on the direct plane the deltas coalesce per burst and _emit_done
+    # drains the buffer before every completion send, preserving it) --
     def incref(self, object_id: ObjectID):
         try:
-            self._worker.send_lazy(P.REF_COUNT,
-                                   {"object_id": object_id, "delta": 1})
+            w = self._worker
+            if w._direct_on:
+                w.direct.ref_delta(object_id, 1)
+            else:
+                w.send_lazy(P.REF_COUNT,
+                            {"object_id": object_id, "delta": 1})
         except Exception:
             pass
 
     def decref(self, object_id: ObjectID):
         try:
-            self._worker.send_lazy(P.REF_COUNT,
-                                   {"object_id": object_id, "delta": -1})
+            w = self._worker
+            if w._direct_on:
+                w.direct.ref_delta(object_id, -1)
+            else:
+                w.send_lazy(P.REF_COUNT,
+                            {"object_id": object_id, "delta": -1})
         except Exception:
             pass
 
@@ -109,8 +119,20 @@ class WorkerClient:
         # surface as LOC_ERROR on the id, not at the put() call
         # (reference: plasma put errors surface on get).
         oid = ObjectID.from_random()
+        w = self._worker
         with serialization.collect_object_refs() as nested:
             sobj = serialization.serialize(value)
+        if w._direct_on:
+            # Mark BEFORE the barrier: a direct result retiring during
+            # serialize() parks unmarked, and a flush that ran before
+            # the marking would strand it (head-side waiter, idle
+            # worker). Marked first, the barrier below ships anything
+            # already parked, and later retirements flush themselves.
+            if nested:
+                w.direct.note_escaped([list(nested)])
+            # The put value may nest direct-owned ids: their accounting
+            # must reach the head before this registration pins them.
+            w.direct.flush_accounting()
         if sobj.total_size <= inline_threshold():
             self._worker.send_lazy(P.OWNED_PUT,
                                    {"object_id": oid,
@@ -124,6 +146,12 @@ class WorkerClient:
         return oid
 
     def get_locations(self, object_ids: List[ObjectID], timeout=None) -> List:
+        w = self._worker
+        if w._direct_on:
+            # Local-first: direct-call results and forwarded nested
+            # results resolve from the worker's cache (waiting on the
+            # channel/forward signal), only the rest round-trips.
+            return w.direct.get_locations(object_ids, timeout)
         return self._request(
             P.GET_LOCATIONS, {"object_ids": object_ids, "timeout": timeout})
 
@@ -147,10 +175,27 @@ class WorkerClient:
         # blocking on the raylet either; errors surface on the returned
         # ref). Head-side failures are registered as LOC_ERROR on the
         # return ids.
-        self._worker.send_lazy(P.SUBMIT_TASK, {"spec": spec})
+        w = self._worker
+        if w._direct_on:
+            # Accounting barrier first (args may reference direct-owned
+            # ids the head must know before it pins them), then mark the
+            # return ids forward-pending: result delivery rides
+            # head->submitter RESULT_FWD frames and get() resolves
+            # locally, no pull round trip.
+            w.direct.note_spec_escapes(spec)
+            w.direct.flush_accounting()
+            w.direct.note_nested_submission(spec)
+        w.send_lazy(P.SUBMIT_TASK, {"spec": spec})
 
     def submit_actor_task(self, spec: P.TaskSpec):
-        self._worker.send_lazy(P.SUBMIT_ACTOR_TASK, {"spec": spec})
+        w = self._worker
+        if w._direct_on:
+            if w.direct.submit_actor_call(spec):
+                return  # shipped caller->callee; head sees accounting only
+            w.direct.note_spec_escapes(spec)
+            w.direct.flush_accounting()
+            w.direct.note_nested_submission(spec)
+        w.send_lazy(P.SUBMIT_ACTOR_TASK, {"spec": spec})
 
     def create_actor(self, spec: P.ActorSpec):
         self._request(P.CREATE_ACTOR_REQ, {"spec": spec})
@@ -229,6 +274,13 @@ class Worker:
         self._done_lock = lockdep.lock("worker.done")
         self._done_buf: list = []
         self._done_flushing = False
+        # Direct worker<->worker call plane (direct.py): caller-side
+        # channels + local result cache + coalesced head accounting.
+        # _direct_on is the per-op falsy gate — with the flag off the
+        # submit/complete paths do zero additional work.
+        from . import direct as direct_mod
+        self.direct = direct_mod.DirectPlane(self)
+        self._direct_on = self.direct.enabled
         # Telemetry plane: bounded lifecycle-event buffer, drained as a
         # TASK_EVENTS message enqueued right before each completion so
         # both ride ONE writer wakeup / vectored write (telemetry.py).
@@ -258,6 +310,11 @@ class Worker:
     send_lazy = send
 
     def request(self, msg_type: str, payload: dict) -> Any:
+        if self._direct_on:
+            # Any blocking request may reference direct-owned ids
+            # (get/wait/gcs ops): their accounting must precede it on
+            # the pipe.
+            self.direct.flush_accounting()
         with self._req_lock:
             self._req_counter += 1
             req_id = self._req_counter
@@ -406,6 +463,7 @@ class Worker:
                     >= float(ray_config.worker_metrics_push_interval_s)):
                 self._metrics_last_push = now
                 from ..util import metrics as M
+                telemetry.flush_serve_gauges()  # lint: ungated-instrumentation-ok _flush_telemetry is only reached from telemetry.enabled-gated call sites
                 groups = M.registry_samples()
                 if groups:
                     self.send(P.METRICS_PUSH, {
@@ -415,13 +473,43 @@ class Worker:
         except Exception:
             pass
 
-    def _emit_done(self, payload: dict):
+    def _emit_done(self, payload: dict, direct_chan=None):
         """Ship one task's completion with group-commit coalescing:
         every completion flushes immediately UNLESS another thread is
         mid-flush, in which case it parks in the buffer and the flusher
         drains it in the same TASKS_DONE frame. Batching emerges only
         under genuine completion bursts — a lone task (or a fast task
-        next to slow siblings) never waits."""
+        next to slow siblings) never waits.
+
+        Direct calls (`direct_chan` set) return the inline result
+        straight to the CALLER on the brokered channel; only telemetry
+        piggybacks to the head (the caller ships the batched completion
+        accounting)."""
+        if self._direct_on:
+            # Results nesting still-IN-FLIGHT direct ids hand the head
+            # a waiter this worker must satisfy: mark them so their
+            # retirement flushes instead of parking (idle workers have
+            # no later barrier).
+            self.direct.note_escaped(payload.get("nested"))
+            # Accounting barrier: parked direct-call completions and
+            # borrow deltas buffered by this task must be on the head
+            # pipe BEFORE its completion can unpin args or ship results
+            # that nest direct-owned ids.
+            self.direct.flush_accounting()
+        if direct_chan is not None:
+            # Direct completions don't touch the head, so the telemetry
+            # piggyback has no frame to ride — flush event batches on a
+            # size threshold instead of per completion (the drop-oldest
+            # buffer bound still holds; state-API freshness for direct
+            # calls trails by up to one batch).
+            if telemetry.enabled and (len(self._task_events) >= 256
+                                      or self._task_events.dropped):
+                self._flush_telemetry()
+            self.direct.send_result(direct_chan, payload)
+            return
+        # Head path: the head resolves the spec from its own running
+        # table — shipping it would just fatten the TASK_DONE frame.
+        payload.pop("spec", None)
         if telemetry.enabled:
             self._flush_telemetry()
         with self._done_lock:
@@ -469,6 +557,10 @@ class Worker:
 
     def _execute(self, spec: P.TaskSpec):
         tid = spec.task_id.binary()
+        # Direct calls bind their result back to the caller's channel;
+        # popped so the spec keeps the slim-pickle fast path if it ever
+        # rides a wire again (reconcile resubmission).
+        direct_chan = spec.__dict__.pop("_direct_chan", None)
         with self._running_lock:
             self._queued_futures.pop(tid, None)
             self._queued_meta.pop(tid, None)
@@ -539,7 +631,8 @@ class Worker:
                                             start_ts=run_ts)
                 self._emit_done({
                     "task_id": spec.task_id, "results": [], "error": None,
-                    "streamed": n_items, "actor_id": spec.actor_id})
+                    "streamed": n_items, "actor_id": spec.actor_id},
+                    direct_chan)
             else:
                 locs, nested = self._package_returns(spec, result)
                 if telemetry.enabled:
@@ -551,7 +644,11 @@ class Worker:
                     "actor_id": spec.actor_id,
                     # Node daemons need the ids to account shm segments
                     # their workers created (head adopts via the spec).
-                    "return_oids": list(spec.return_ids)})
+                    "return_oids": list(spec.return_ids),
+                    # For the direct caller-death fallback only: shm
+                    # results keep their lineage (stripped before any
+                    # head TASK_DONE frame — the head holds the spec).
+                    "spec": spec}, direct_chan)
         except BaseException as e:  # noqa: BLE001 — all errors ship to owner
             if exec_span is not None:
                 # Close the span WITH the failure so traces show failed
@@ -577,7 +674,8 @@ class Worker:
                                         start_ts=run_ts)
             self._emit_done({
                 "task_id": spec.task_id, "results": None, "error": blob,
-                "actor_id": spec.actor_id})
+                "actor_id": spec.actor_id,
+                "return_oids": list(spec.return_ids)}, direct_chan)
         finally:
             if trace_token is not None:
                 from ..util import tracing
@@ -591,6 +689,56 @@ class Worker:
             _task_ctx_var.reset(ctx_token)
             with self._running_lock:
                 self._running.pop(tid, None)
+
+    def _execute_direct_batch(self, chan, specs: List[P.TaskSpec]):
+        """Lean exec loop for a burst of direct actor calls on a
+        max_concurrency=1 actor: ONE executor item runs the whole run
+        (executor submit/Future cost amortized over the burst), with
+        the cancellation/recall bookkeeping direct calls can't use
+        stripped. Per-spec failure semantics match _execute exactly:
+        errors ship as typed blobs on that call's result."""
+        for spec in specs:
+            run_ts = None
+            if telemetry.enabled:
+                run_ts = time.time()
+                self._record_task_event(spec, "RUNNING", run_ts)
+            ctx_token = _task_ctx_var.set(spec)
+            try:
+                if fault.enabled:
+                    fault.fire("worker.exec", task=spec.name)
+                args = [self.resolve_arg(a) for a in spec.args]
+                kwargs = {k: self.resolve_arg(a)
+                          for k, a in spec.kwargs.items()}
+                method = getattr(self._actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = self._run_coroutine(result)
+                locs, nested = self._package_returns(spec, result)
+                if telemetry.enabled:
+                    self._record_task_event(spec, "FINISHED", time.time(),
+                                            start_ts=run_ts)
+                payload = {"task_id": spec.task_id, "results": locs,
+                           "error": None, "nested": nested,
+                           "actor_id": spec.actor_id,
+                           "return_oids": list(spec.return_ids),
+                           "spec": spec}
+            except BaseException as e:  # noqa: BLE001 — ships to caller
+                err = TaskError(e, task_repr=spec.name,
+                                remote_tb=traceback.format_exc())
+                try:
+                    blob = serialization.dumps(err)
+                except Exception:
+                    blob = serialization.dumps(TaskError(
+                        RuntimeError(repr(e)), task_repr=spec.name))
+                if telemetry.enabled:
+                    self._record_task_event(spec, "FAILED", time.time(),
+                                            start_ts=run_ts)
+                payload = {"task_id": spec.task_id, "results": None,
+                           "error": blob, "actor_id": spec.actor_id,
+                           "return_oids": list(spec.return_ids)}
+            finally:
+                _task_ctx_var.reset(ctx_token)
+            self._emit_done(payload, chan)
 
     def _run_coroutine(self, coro):
         loop = self._ensure_actor_loop()
@@ -635,10 +783,19 @@ class Worker:
                     max_workers=max(1, int(cap)),
                     thread_name_prefix=f"actor-cg-{name}")
                 for name, cap in spec.concurrency_groups.items()}
+            if self._direct_on:
+                # Accounting barrier BEFORE the readiness signal: borrow
+                # increfs from ctor-arg deserialization must be on the
+                # head pipe before ACTOR_READY lets the head unpin the
+                # creation args (the same contract _emit_done enforces
+                # for task completions).
+                self.direct.flush_accounting()
             self.send(P.ACTOR_READY, {"actor_id": spec.actor_id, "error": None})
         except BaseException as e:  # noqa: BLE001
             err = TaskError(e, task_repr=f"{spec.cls_id}.__init__",
                             remote_tb=traceback.format_exc())
+            if self._direct_on:
+                self.direct.flush_accounting()
             self.send(P.ACTOR_READY, {"actor_id": spec.actor_id,
                                       "error": serialization.dumps(err)})
 
@@ -760,6 +917,12 @@ class Worker:
         elif msg_type == P.RELEASE_OBJECTS:
             for oid in payload["object_ids"]:
                 self.store.release(oid)
+        elif msg_type == P.CHANNEL_OPEN:
+            # Head-brokered direct channel: make sure the listener is
+            # up and report its endpoints (direct.py).
+            self.direct.on_channel_open(payload)
+        elif msg_type == P.RESULT_FWD:
+            self.direct.on_result_fwd(payload)
         elif msg_type == P.SHUTDOWN:
             return True
         else:

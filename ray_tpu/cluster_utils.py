@@ -94,6 +94,16 @@ class Cluster:
         host, port = rt.head_server.address
         env = dict(os.environ)
         env["RAY_TPU_CLUSTER_TOKEN_HEX"] = rt.cluster_token.hex()
+        # Direct-call plane coherence across nodes: the daemon's workers
+        # read these from THEIR environment, so a programmatic
+        # ray_config.set on the driver must override whatever the
+        # operator's shell exported or the daemon would diverge (workers
+        # marking results forward-pending that the head never forwards).
+        from ray_tpu._private.config import ray_config as _rc
+        env["RAY_TPU_DIRECT_CALLS_ENABLED"] = \
+            "1" if _rc.direct_calls_enabled else "0"
+        env["RAY_TPU_DIRECT_RESULT_FORWARDING"] = \
+            "1" if _rc.direct_result_forwarding else "0"
         argv = [sys.executable, "-m", "ray_tpu._private.daemon",
                 "--address", f"{host}:{port}",
                 "--num-cpus", str(num_cpus)]

@@ -32,6 +32,7 @@ _SECTION_RE = re.compile(r"^#\s*Message types:\s*(?P<rest>.*)")
 _SEPARATOR_RE = re.compile(r"^#\s*-{10,}")
 
 _DIRECTIONS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("direct", (r"worker\s*<->\s*worker",)),
     ("to_worker", (r"(driver|owner|head|daemon)\s*->\s*worker",)),
     ("from_worker", (r"worker\s*->\s*(driver|owner|head|daemon)",)),
     ("head_to_daemon", (r"head\s*->\s*daemon",)),
@@ -60,7 +61,8 @@ def parse_planes(sf: SourceFile) -> Tuple[Dict[str, Set[str]],
     section that cannot be classified is a violation."""
     planes: Dict[str, Set[str]] = {
         "to_worker": set(), "from_worker": set(),
-        "head_to_daemon": set(), "daemon_to_head": set()}
+        "head_to_daemon": set(), "daemon_to_head": set(),
+        "direct": set()}
     violations: List[Violation] = []
 
     # line -> section default plane ("" = inside a message section with
@@ -238,6 +240,76 @@ def check_fallthrough(sf: SourceFile, qualname: str,
 
 
 # ---------------------------------------------------------------------------
+# unregistered-recv-loop detection
+# ---------------------------------------------------------------------------
+def _covered_by(qual: str, registered: Set[str]) -> bool:
+    """True when `qual` is a registered function or nested inside one
+    (inner defs of a registered dispatcher are part of its span)."""
+    return any(qual == r or qual.startswith(r + ".")
+               for r in registered)
+
+
+def detect_unregistered_loops(tree: LintTree,
+                              all_constants: Set[str]) -> List[Violation]:
+    """A function that dispatches over protocol message constants but is
+    absent from registry.RECV_LOOPS is a coverage HOLE, not a skip: a
+    new recv loop (e.g. a direct-channel handler) must be registered so
+    the plane-coverage invariant applies to it. Legitimate non-loop
+    dispatchers carry a reasoned registry.NON_LOOP_DISPATCHERS entry."""
+    registered_by_file: Dict[str, Set[str]] = {}
+    for loop in registry.RECV_LOOPS.values():
+        registered_by_file.setdefault(loop["file"], set()).update(
+            loop["functions"])
+    out: List[Violation] = []
+    threshold = registry.RECV_LOOP_DETECT_MIN
+    for sf in tree.iter_files():
+        if sf.relpath == PROTOCOL_FILE:
+            continue
+        registered = registered_by_file.get(sf.relpath, set())
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qual = sf.scope_of(fn)
+            if _covered_by(qual, registered):
+                continue
+            allow = registry.NON_LOOP_DISPATCHERS.get(
+                (sf.relpath, qual))
+            if allow:
+                continue
+            per_var: Dict[str, Set[str]] = {}
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Compare):
+                    continue
+                if isinstance(node.left, ast.Name):
+                    var = node.left.id
+                    consts = [c for comp in node.comparators
+                              for c in _const_names(comp)]
+                else:
+                    vars_ = [c.id for c in node.comparators
+                             if isinstance(c, ast.Name)]
+                    if not vars_:
+                        continue
+                    var = vars_[0]
+                    consts = _const_names(node.left)
+                hits = {c for c in consts if c in all_constants}
+                if hits:
+                    per_var.setdefault(var, set()).update(hits)
+            for var, consts in per_var.items():
+                if len(consts) >= threshold:
+                    out.append(Violation(
+                        PASS, sf.relpath, fn.lineno,
+                        f"{qual} dispatches {len(consts)} protocol "
+                        f"message constants over {var!r} but is not in "
+                        f"devtools/lint/registry.py RECV_LOOPS — an "
+                        f"unregistered recv loop dodges plane coverage; "
+                        f"register it (or add a reasoned "
+                        f"NON_LOOP_DISPATCHERS entry)",
+                        scope=qual, key=f"unregistered-loop:{qual}"))
+                    break  # one violation per function is enough
+    return out
+
+
+# ---------------------------------------------------------------------------
 # the pass
 # ---------------------------------------------------------------------------
 def run(tree: LintTree) -> List[Violation]:
@@ -246,6 +318,7 @@ def run(tree: LintTree) -> List[Violation]:
         return []  # fixture tree without a protocol module
     planes, out = parse_planes(proto)
     all_constants = set().union(*planes.values())
+    out.extend(detect_unregistered_loops(tree, all_constants))
 
     for loop_name, loop in registry.RECV_LOOPS.items():
         sf = tree.get(loop["file"])

@@ -95,7 +95,30 @@ RECV_LOOPS = {
         "relay": False,
         "exempt": {},
     },
+    "worker.direct": {
+        # The direct worker<->worker channel recv loop (direct.py):
+        # both roles share one dispatcher — callees see ACTOR_CALL,
+        # callers see ACTOR_RESULT on the same channel.
+        "file": "_private/direct.py",
+        "functions": ("DirectPlane._on_channel_messages",
+                      "DirectPlane._handle_direct_message"),
+        "plane": "direct",
+        "dispatch_vars": ("msg_type",),
+        "fallthrough": "DirectPlane._handle_direct_message",
+        "relay": False,
+        "exempt": {},
+    },
 }
+
+# A function dispatching >= this many protocol message constants over
+# one variable is a recv loop and must be registered above (or carry a
+# reasoned NON_LOOP_DISPATCHERS entry) — unregistered loops FAIL rather
+# than silently dodge plane coverage.
+RECV_LOOP_DETECT_MIN = 2
+
+# (file, qualname) -> reason: functions that legitimately compare
+# several protocol constants without BEING a recv dispatch loop.
+NON_LOOP_DISPATCHERS = {}
 
 # Calls that count as "handling" a fallthrough (vs silently dropping):
 # logging, a metrics/counter bump, an error reply, a relay send, raise.

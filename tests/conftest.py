@@ -57,7 +57,8 @@ def pytest_configure(config):
 # whole control plane through failure paths — both must come out with
 # ZERO potential-ABBA cycles. Assertion per test so a report is
 # attributable to the test that produced it.
-_LOCKDEP_SUITES = {"test_transport_framing", "test_fault_injection"}
+_LOCKDEP_SUITES = {"test_transport_framing", "test_fault_injection",
+                   "test_direct_calls"}
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,524 @@
+"""Direct worker<->worker call plane (_private/direct.py).
+
+Covers the tentpole's failure-semantics contract: callee death with
+open channels (kill() and raw SIGKILL) drains in-flight direct calls
+into typed errors with correct retry `attempt` accounting; seeded
+`direct.connect` drops fall back to the head path deterministically;
+and the falsy `direct_calls_enabled` flag routes everything through the
+head path with ZERO additional work (counter-based perf_smoke guard).
+
+The whole module runs under the runtime lock-order tracker
+(RAY_TPU_LOCKDEP=1 via the conftest guard) — any potential ABBA cycle
+recorded by the new channel/accounting locks fails the test.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import ray_config
+
+
+@pytest.fixture(autouse=True)
+def _force_direct_plane():
+    """These tests exercise the direct plane itself: force it on even
+    when the surrounding suite runs with RAY_TPU_DIRECT_CALLS_ENABLED=0
+    (the flag-off acceptance sweep). Clearing the env override is
+    enough — the scheduler propagates the driver's live config value
+    into worker environments. test_disabled_flag_zero_direct_work
+    manages its own (stricter) override on top of this."""
+    prev_env = os.environ.pop("RAY_TPU_DIRECT_CALLS_ENABLED", None)
+    prev_cfg = ray_config.direct_calls_enabled
+    ray_config.set("direct_calls_enabled", True)
+    yield
+    ray_config.set("direct_calls_enabled", prev_cfg)
+    if prev_env is not None:
+        os.environ["RAY_TPU_DIRECT_CALLS_ENABLED"] = prev_env
+
+
+@pytest.fixture
+def fresh():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Echo:
+    def echo(self, x):
+        return x
+
+    def pid(self):
+        return os.getpid()
+
+    def pair(self, x):
+        return x, x + 1
+
+    def boom(self):
+        raise ValueError("boom from callee")
+
+    def sleepy(self, s=1.0):
+        time.sleep(s)
+        return "ok"
+
+    def big(self, n):
+        return b"x" * n
+
+
+@ray_tpu.remote
+class Via:
+    """Worker-side caller: every method drives the callee over the
+    direct channel (the caller is a worker, the callee is alive)."""
+
+    def __init__(self, callee):
+        self.callee = callee
+
+    def call(self, x):
+        return ray_tpu.get(self.callee.echo.remote(x))
+
+    def call_pair(self, x):
+        a, b = self.callee.pair.options(num_returns=2).remote(x)
+        return ray_tpu.get([a, b])
+
+    def call_ref(self, ref):
+        return ray_tpu.get(self.callee.echo.remote(ref))
+
+    def call_boom(self):
+        return ray_tpu.get(self.callee.boom.remote())
+
+    def call_big(self, n):
+        return len(ray_tpu.get(self.callee.big.remote(n)))
+
+    def drive(self, n):
+        return ray_tpu.get(
+            [self.callee.echo.remote(i) for i in range(n)])
+
+    def slow_roundtrip(self, s=1.0, retries=0):
+        return ray_tpu.get(self.callee.sleepy.options(
+            max_task_retries=retries).remote(s))
+
+    def channel_state(self):
+        """(direct ops so far, #live channels, #fallback pins)."""
+        from ray_tpu._private import direct, state
+        plane = state._worker.direct
+        live = fall = 0
+        for v in plane._chans.values():
+            if v is direct._FALLBACK:
+                fall += 1
+            else:
+                live += 1
+        return direct.direct_ops(), live, fall
+
+    def fault_log(self):
+        from ray_tpu._private import fault
+        return fault.injection_log()
+
+
+def test_direct_calls_basic(fresh):
+    callee = Echo.remote()
+    via = Via.remote(callee)
+    assert ray_tpu.get(via.call.remote(41)) == 41
+    assert ray_tpu.get(via.call_pair.remote(1)) == [1, 2]
+    # Ref args resolve through the caller-supplied location / head.
+    ref = ray_tpu.put({"k": 7})
+    assert ray_tpu.get(via.call_ref.remote(ref)) == {"k": 7}
+    # Errors surface typed at the caller's get.
+    with pytest.raises(Exception, match="boom from callee"):
+        ray_tpu.get(via.call_boom.remote())
+    # The channel survives an error and keeps serving.
+    assert ray_tpu.get(via.call.remote("again")) == "again"
+    # Shm-backed (above inline threshold) results flow through the
+    # shared store with head accounting for the segment.
+    assert ray_tpu.get(via.call_big.remote(512 * 1024)) == 512 * 1024
+    ops, live, fall = ray_tpu.get(via.channel_state.remote())
+    assert live == 1 and fall == 0
+    assert ops > 0  # the calls above actually took the direct path
+
+
+def test_direct_calls_preserve_order(fresh):
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def add(self, i):
+            self.items.append(i)
+
+        def items_(self):
+            return list(self.items)
+
+    @ray_tpu.remote
+    class Driver:
+        def __init__(self, log):
+            self.log = log
+
+        def run(self, n):
+            refs = [self.log.add.remote(i) for i in range(n)]
+            ray_tpu.get(refs)
+            return ray_tpu.get(self.log.items_.remote())
+
+    log = Log.remote()
+    drv = Driver.remote(log)
+    assert ray_tpu.get(drv.run.remote(200)) == list(range(200))
+
+
+def test_kill_callee_with_open_channel(fresh):
+    callee = Echo.remote()
+    via = Via.remote(callee)
+    assert ray_tpu.get(via.call.remote(1)) == 1  # channel established
+    ray_tpu.kill(callee)
+    with pytest.raises(Exception, match="ActorDied|Actor"):
+        ray_tpu.get(via.call.remote(2), timeout=30)
+    # The caller worker survives and serves fresh channels.
+    callee2 = Echo.remote()
+    via2 = Via.remote(callee2)
+    assert ray_tpu.get(via2.call.remote(3)) == 3
+
+
+def test_sigkill_callee_inflight_drains_typed(fresh):
+    callee = Echo.remote()
+    via = Via.remote(callee)
+    pid = ray_tpu.get(via.call.remote(0)) or ray_tpu.get(
+        callee.pid.remote())
+    fut = via.slow_roundtrip.remote(2.0)
+    time.sleep(0.5)  # the direct call is in flight on the callee
+    os.kill(pid, signal.SIGKILL)
+    # No retries budgeted: the reconcile must surface ActorDiedError
+    # through the caller's local wait, not hang it.
+    with pytest.raises(Exception, match="ActorDied|died"):
+        ray_tpu.get(fut, timeout=30)
+
+
+def test_sigkill_restart_retries_with_attempt_accounting():
+    ray_tpu.init(num_cpus=4)
+    try:
+        callee = Echo.options(max_restarts=1).remote()
+        via = Via.remote(callee)
+        pid = ray_tpu.get(callee.pid.remote())
+        assert ray_tpu.get(via.call.remote(1)) == 1
+        fut = via.slow_roundtrip.remote(2.0, 1)  # max_task_retries=1
+        time.sleep(0.5)
+        os.kill(pid, signal.SIGKILL)
+        # The reconcile requeues the in-flight spec onto the restarted
+        # actor; the caller's local wait demotes to the head path and
+        # resolves when the retry lands.
+        assert ray_tpu.get(fut, timeout=60) == "ok"
+        from ray_tpu._private import state
+        node = state.get_node()
+        attempts = [ev.get("attempt") for ev in
+                    node.gcs.telemetry.events()
+                    if "sleepy" in (ev.get("name") or "")]
+        assert any((a or 0) >= 2 for a in attempts), attempts
+    finally:
+        ray_tpu.shutdown()
+
+
+def _run_with_connect_drops(seed):
+    ray_tpu.init(num_cpus=4, fault_config={
+        "seed": seed,
+        "rules": [{"site": "direct.connect", "action": "drop",
+                   "prob": 1.0}]})
+    try:
+        callee = Echo.remote()
+        via = Via.remote(callee)
+        # Every channel dial is dropped: calls MUST fall back to the
+        # head-routed path and still succeed.
+        assert ray_tpu.get(via.drive.remote(20)) == list(range(20))
+        _ops, live, fall = ray_tpu.get(via.channel_state.remote())
+        log = ray_tpu.get(via.fault_log.remote())
+        assert live == 0 and fall == 1
+        return log
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_fault_direct_connect_drop_falls_back_deterministically():
+    log1 = _run_with_connect_drops(11)
+    log2 = _run_with_connect_drops(11)
+    assert log1, "direct.connect never fired under the fault plane"
+    assert all(site == "direct.connect" and action == "drop"
+               for site, _seq, action in log1)
+    # Same seed, same per-site firing counts => identical schedules.
+    assert log1 == log2
+
+
+@pytest.mark.perf_smoke
+def test_disabled_flag_zero_direct_work():
+    """With direct_calls_enabled=false the submit/complete paths do ZERO
+    direct-plane work (counter-based, wall-clock-free — the telemetry/
+    lockdep guard style) and everything rides the head path."""
+    prev_env = os.environ.get("RAY_TPU_DIRECT_CALLS_ENABLED")
+    ray_config.set("direct_calls_enabled", False)
+    try:
+        ray_tpu.init(num_cpus=4)
+        try:
+            callee = Echo.remote()
+            via = Via.remote(callee)
+            assert ray_tpu.get(via.drive.remote(50)) == list(range(50))
+            assert ray_tpu.get(via.call_pair.remote(5)) == [5, 6]
+            with pytest.raises(Exception, match="boom"):
+                ray_tpu.get(via.call_boom.remote())
+            ops, live, fall = ray_tpu.get(via.channel_state.remote())
+            assert ops == 0, f"direct plane did {ops} ops while disabled"
+            assert live == 0 and fall == 0
+            # Head side took the classic path end to end.
+            from ray_tpu._private import direct, state
+            node = state.get_node()
+            assert node._direct_on is False
+            assert direct.direct_ops() == 0  # driver-side plane untouched
+            # kill() semantics are intact on the fallback path.
+            ray_tpu.kill(callee)
+            with pytest.raises(Exception, match="ActorDied|Actor"):
+                ray_tpu.get(via.call.remote(1), timeout=30)
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        ray_config.set("direct_calls_enabled", True)
+        if prev_env is None:
+            os.environ.pop("RAY_TPU_DIRECT_CALLS_ENABLED", None)
+        else:
+            os.environ["RAY_TPU_DIRECT_CALLS_ENABLED"] = prev_env
+
+
+def test_dial_while_serving_channel_open(fresh):
+    """n:n topology (acyclic): worker A DIALS out to B while another
+    worker C dials A, so A's recv loop must serve the inbound
+    CHANNEL_OPEN while A's own outbound _establish is blocked in a
+    broker request — listener creation must never contend on the
+    establishment lock, or the REPLY that completes the dial can
+    never be processed and A's whole control plane wedges."""
+
+    @ray_tpu.remote
+    class Peer:
+        def ping(self, x):
+            return x
+
+        def relay(self, other, x):
+            return ray_tpu.get(other.ping.remote(x))
+
+    a = Peer.remote()
+    b = Peer.remote()
+    c = Peer.remote()
+    # Warm nothing: the FIRST a.relay dial (a->b) and the first
+    # c.relay dial (c->a, landing CHANNEL_OPEN on a's recv loop)
+    # race by construction. The call graph is acyclic (c->a->b), so
+    # any hang is a plane bug, not actor-reentrancy blocking.
+    refs = [a.relay.remote(b, i) for i in range(10)] \
+        + [c.relay.remote(a, 100 + i) for i in range(10)]
+    assert ray_tpu.get(refs, timeout=60) == \
+        list(range(10)) + [100 + i for i in range(10)]
+
+
+def test_fault_direct_call_drop_falls_back():
+    """Seeded `direct.call` drops (the send raises AFTER the call is
+    registered in-flight) must unwind the registration and fall back
+    to the head path — no duplicate execution, no absorbed-ref leak."""
+    ray_tpu.init(num_cpus=4, fault_config={
+        "seed": 23,
+        "rules": [{"site": "direct.call", "action": "drop",
+                   "prob": 1.0}]})
+    try:
+        @ray_tpu.remote
+        class Count:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        callee = Count.remote()
+
+        @ray_tpu.remote
+        class Drv:
+            def __init__(self, c):
+                self.c = c
+
+            def run(self, k):
+                return [ray_tpu.get(self.c.bump.remote())
+                        for _ in range(k)]
+
+        d = Drv.remote(callee)
+        # Exactly-once execution proves the dropped sends rolled back
+        # (a double-owned spec would bump twice or hang the get).
+        assert ray_tpu.get(d.run.remote(10), timeout=60) == \
+            list(range(1, 11))
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_nested_submission_result_forwarding(fresh):
+    """Nested plain-task results resolve through the head->submitter
+    push (RESULT_FWD) with no pull round trip; errors forward too."""
+
+    @ray_tpu.remote
+    def ok(i):
+        return i * 2
+
+    @ray_tpu.remote
+    def bad():
+        raise RuntimeError("nested boom")
+
+    @ray_tpu.remote
+    class Sub:
+        def batch(self, n):
+            return ray_tpu.get([ok.remote(i) for i in range(n)])
+
+        def fail(self):
+            try:
+                ray_tpu.get(bad.remote(), timeout=30)
+                return "no error"
+            except Exception as e:
+                return f"caught: {e}"
+
+    s = Sub.remote()
+    assert ray_tpu.get(s.batch.remote(100)) == [i * 2 for i in range(100)]
+    assert "nested boom" in ray_tpu.get(s.fail.remote())
+
+
+def test_escaped_inflight_ref_resolves_on_idle_caller(fresh):
+    """A direct-call ref that ESCAPES the caller (returned inside its
+    own task result) while the call is still in flight hands the head
+    a waiter; the caller then goes idle. The retirement must flush the
+    completion entry — and the flush must not elide it just because
+    the caller's local residual netted zero — or the driver's get on
+    the escaped ref hangs forever (regression: it did)."""
+
+    @ray_tpu.remote
+    class Maker:
+        def __init__(self, callee):
+            self.callee = callee
+
+        def spawn(self):
+            ray_tpu.get(self.callee.echo.remote(0))  # warm the channel
+            return self.callee.sleepy.remote(1.0)  # escapes in flight
+
+        def spawn_done(self):
+            r = self.callee.echo.remote(7)
+            ray_tpu.get(r)  # retired (parked) before it escapes
+            return r
+
+    callee = Echo.remote()
+    mk = Maker.remote(callee)
+    inner = ray_tpu.get(mk.spawn.remote())
+    assert ray_tpu.get(inner, timeout=30) == "ok"
+    inner2 = ray_tpu.get(mk.spawn_done.remote())
+    assert ray_tpu.get(inner2, timeout=30) == 7
+
+
+def test_retry_exceptions_calls_stay_head_routed(fresh):
+    """retry_exceptions is a HEAD decision (TASK_DONE's resubmit
+    branch): on the channel the error blob would retire terminally at
+    the caller with zero retries, so such calls must not ship direct —
+    flag-on and flag-off behavior stays identical."""
+
+    @ray_tpu.remote
+    class Flaky:
+        def __init__(self):
+            self.n = 0
+
+        def once(self):
+            self.n += 1
+            if self.n == 1:
+                raise ValueError("transient boom")
+            return self.n
+
+    @ray_tpu.remote
+    class Drv:
+        def __init__(self, c):
+            self.c = c
+
+        def run(self):
+            return ray_tpu.get(self.c.once.options(
+                retry_exceptions=True, max_task_retries=2).remote(),
+                timeout=30)
+
+    f = Flaky.remote()
+    d = Drv.remote(f)
+    assert ray_tpu.get(d.run.remote(), timeout=60) == 2
+
+
+def test_pending_callee_does_not_pin_fallback(fresh):
+    """A first call racing the callee's construction gets a TRANSIENT
+    broker refusal: it rides the head path, but the pair must not be
+    pinned to _FALLBACK — once the actor is up, the next call
+    establishes the channel. (Regression: under load the warm-up race
+    permanently cost the pair its direct plane.)"""
+
+    @ray_tpu.remote
+    class SlowEcho:
+        def __init__(self):
+            time.sleep(1.5)
+
+        def echo(self, x):
+            return x
+
+    callee = SlowEcho.remote()
+    via = Via.remote(callee)
+    # Submitted while the callee is still in __init__: the broker
+    # replies transient, the call completes head-routed.
+    assert ray_tpu.get(via.call.remote(1)) == 1
+    assert ray_tpu.get(via.call.remote(2)) == 2
+    ops, live, fall = ray_tpu.get(via.channel_state.remote())
+    assert fall == 0, "pending callee wrongly pinned the fallback path"
+    assert live == 1
+
+
+def test_config_set_overrides_exported_env_in_workers():
+    """A programmatic ray_config.set on the driver must reach worker
+    environments even when the operator's shell exported the opposite
+    value — a worker marking results forward-pending while the head
+    never forwards would stall every nested get 5s (the resync
+    deadline) before degrading to a pull."""
+    prev_env = os.environ.get("RAY_TPU_DIRECT_RESULT_FORWARDING")
+    os.environ["RAY_TPU_DIRECT_RESULT_FORWARDING"] = "1"
+    prev_cfg = ray_config.direct_result_forwarding
+    ray_config.set("direct_result_forwarding", False)
+    try:
+        ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote
+            def worker_env(k):
+                return os.environ.get(k)
+
+            assert ray_tpu.get(worker_env.remote(
+                "RAY_TPU_DIRECT_RESULT_FORWARDING")) == "0"
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        ray_config.set("direct_result_forwarding", prev_cfg)
+        if prev_env is None:
+            os.environ.pop("RAY_TPU_DIRECT_RESULT_FORWARDING", None)
+        else:
+            os.environ["RAY_TPU_DIRECT_RESULT_FORWARDING"] = prev_env
+
+
+def test_direct_shm_result_registers_lineage(fresh):
+    """SHM-backed direct-call results carry their producing spec to the
+    head inside the DIRECT_DONE entry, so the object directory holds
+    lineage exactly like the head-routed TASK_DONE path — losing the
+    backing node must leave the object reconstructable, not dead."""
+
+    @ray_tpu.remote
+    class Maker:
+        def __init__(self, callee):
+            self.callee = callee
+
+        def make(self, n):
+            ref = self.callee.big.remote(n)
+            ray_tpu.get(ref)  # retire caller-side (entry parks)
+            return [ref]
+
+    callee = Echo.remote()
+    mk = Maker.remote(callee)
+    (ref,) = ray_tpu.get(mk.make.remote(512 * 1024))
+    from ray_tpu._private import state
+    node = state.get_node()
+    entry = node.gcs.objects.entry(ref.id)
+    assert entry is not None and entry.event.is_set()
+    assert entry.lineage is not None, \
+        "direct SHM result registered without lineage"
+    assert entry.lineage.method_name == "big"
