@@ -190,7 +190,53 @@ def bench_core(extras):
         ray_tpu.get([c.drive.remote(per) for c in callers])
         return len(callers) * per / (time.perf_counter() - t0)
     nn_actor_rate = best_of(2, _nn_actor, key="nn_actor")
-    for a in subs + callers + callees:
+
+    # streaming generators, caller-observed items/s: a worker caller
+    # consumes channel streams (GEN_ITEM frames ride the direct channel
+    # caller<-callee; the head hears ONE terminal accounting entry per
+    # stream). The headpath row is the driver consuming the same
+    # generator through the head-registered GEN_ITEM path — the new
+    # channel transport should meet or beat it.
+    @ray_tpu.remote
+    class GenActor:
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    @ray_tpu.remote
+    class StreamConsumer:
+        def __init__(self, g):
+            self.g = g
+
+        def consume(self, n):
+            got = 0
+            for _ref in self.g.stream.options(
+                    num_returns="streaming").remote(n):
+                got += 1
+            return got
+
+    gen_a = GenActor.remote()
+    cons = StreamConsumer.remote(gen_a)
+    ray_tpu.get(cons.consume.remote(50))  # warm: channel established
+
+    def _stream_items():
+        per = 1000
+        t0 = time.perf_counter()
+        assert ray_tpu.get(cons.consume.remote(per)) == per
+        return per / (time.perf_counter() - t0)
+    stream_rate = best_of(2, _stream_items, key="streaming_gen")
+
+    def _stream_items_head():
+        per = 1000
+        t0 = time.perf_counter()
+        got = sum(1 for _ref in gen_a.stream.options(
+            num_returns="streaming").remote(per))
+        assert got == per
+        return per / (time.perf_counter() - t0)
+    stream_head_rate = best_of(2, _stream_items_head,
+                               key="streaming_gen_head")
+
+    for a in subs + callers + callees + [gen_a, cons]:
         ray_tpu.kill(a)
 
     # compiled DAG round trip (reference microbench: compiled DAG vs
@@ -238,6 +284,8 @@ def bench_core(extras):
         "multi_client_put_gb_per_s": round(mc_put_gbps, 2),
         "multi_client_tasks_async_per_s": round(mc_tasks_rate, 1),
         "nn_actor_calls_async_per_s": round(nn_actor_rate, 1),
+        "streaming_gen_items_per_s": round(stream_rate, 1),
+        "streaming_gen_items_per_s_headpath": round(stream_head_rate, 1),
         "baseline_tasks_async_per_s": 8032.4,
         "baseline_actor_sync_per_s": 1985.8,
         "baseline_put_gb_per_s": 18.52,
@@ -1015,11 +1063,49 @@ def _focus_nn_actor(ray_tpu):
     return measure
 
 
+def _focus_streaming_gen(ray_tpu):
+    """Caller-observed streaming-generator throughput: a worker caller
+    consumes channel streams from a callee actor (since the direct
+    plane carries streams this rides GEN_ITEM frames caller<-callee;
+    with direct_calls_enabled=0 workers cannot consume streams, so the
+    head-path comparison point is the driver consuming the same
+    generator)."""
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    @ray_tpu.remote
+    class Consumer:
+        def __init__(self, g):
+            self.g = g
+
+        def consume(self, n):
+            got = 0
+            for _ref in self.g.stream.options(
+                    num_returns="streaming").remote(n):
+                got += 1
+            return got
+
+    g = Gen.remote()
+    c = Consumer.remote(g)
+    ray_tpu.get(c.consume.remote(50))  # warm (channel established)
+
+    def measure():
+        per = 1000
+        t0 = time.perf_counter()
+        assert ray_tpu.get(c.consume.remote(per)) == per
+        return per / (time.perf_counter() - t0)
+    return measure
+
+
 FOCUS_METRICS = {
     "tasks_async_per_s": _focus_tasks_async,
     "put_get_per_s": _focus_put_get,
     "multi_client_tasks_async_per_s": _focus_mc_tasks,
     "nn_actor_calls_async_per_s": _focus_nn_actor,
+    "streaming_gen_items_per_s": _focus_streaming_gen,
 }
 
 

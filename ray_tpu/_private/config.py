@@ -155,6 +155,21 @@ class RayConfig:
         # (evictable — the head's directory is authoritative once the
         # batched accounting lands).
         "direct_result_cache_size": 8192,
+        # After a channel death the (caller, actor) pair is allowed to
+        # re-dial once this cooldown elapses (exponential per attempt),
+        # up to max_attempts — one transient TCP reset must not cost
+        # the pair its fast path for the process lifetime. 0 attempts
+        # restores the old permanent pin.
+        "direct_redial_backoff_s": 1.0,
+        "direct_redial_max_attempts": 3,
+        # Callee-side cross-plane merge gate: out-of-order arrivals per
+        # caller held until their predecessors execute. Past the cap
+        # (or the hold timeout) the oldest held call is force-admitted
+        # with a warning — liveness backstop, never the exact path
+        # (reference: the actor scheduling queue's bounded reorder
+        # wait).
+        "direct_seq_reorder_cap": 1024,
+        "direct_seq_hold_timeout_s": 30.0,
         # Tasks dispatched onto one (head-local) worker under a single
         # resource grant before completions must drain it (reference:
         # max_tasks_in_flight_per_worker=10, direct task transport

@@ -80,15 +80,46 @@ def _bump() -> None:
     _ops += 1
 
 
-# Sentinel: this (caller, actor) pair is pinned to the head path —
-# establishment failed, the channel died, or the plane is disabled.
-_FALLBACK = object()
+class _Fallback:
+    """This (caller, actor) pair is pinned to the head path. Permanent
+    pins (actor dead, plane disabled, redial budget exhausted) never
+    retry; transient pins (channel death, dial failure) may re-dial
+    after a backoff cooldown — one TCP reset must not cost the pair its
+    fast path for the process lifetime."""
+
+    __slots__ = ("permanent", "attempts", "pinned_at")
+
+    def __init__(self, permanent: bool = False, attempts: int = 0):
+        self.permanent = permanent
+        self.attempts = attempts
+        self.pinned_at = time.monotonic()
+
+    def redial_due(self) -> bool:
+        if self.permanent:
+            return False
+        from .config import ray_config
+        if self.attempts >= int(ray_config.direct_redial_max_attempts):
+            return False
+        backoff = float(ray_config.direct_redial_backoff_s) \
+            * (2 ** max(0, self.attempts - 1))
+        return time.monotonic() - self.pinned_at >= backoff
+
+
+# Sentinel: permanently pinned to the head path — establishment was
+# refused for a dead actor, or the plane is disabled.
+_FALLBACK = _Fallback(permanent=True)
 
 
 class _TransientEstablish(Exception):
     """The channel cannot be brokered YET (callee still constructing /
     restarting): the current call takes the head path, but the pair is
     NOT pinned to _FALLBACK — the next call retries establishment."""
+
+
+class _RefusedEstablish(Exception):
+    """The broker refused terminally (actor dead, plane off head-side):
+    the pair pins to the head path permanently — re-dialing would only
+    repeat the refusal round trip."""
 
 # A "fwd"-pending local wait falls back to head GET_LOCATIONS after this
 # long without a RESULT_FWD — the head's directory is authoritative for
@@ -106,13 +137,17 @@ class _DirectChannel:
 
     __slots__ = ("plane", "actor_id", "conn", "writer", "alive",
                  "inflight", "queue", "pump_running", "_recv_thread",
-                 "callee_wid")
+                 "callee_wid", "seq_st")
 
     def __init__(self, plane: "DirectPlane", actor_id, conn,
                  callee_wid: Optional[str] = None):
         self.plane = plane
         self.actor_id = actor_id
         self.conn = conn
+        # The (caller, actor) sequencing state, cached so the per-call
+        # stamp/settle fast paths skip the registry lookup.
+        with plane._cond:
+            self.seq_st = plane._seq_state_locked(actor_id.binary())
         # Worker-id hex of the incarnation this channel dialed: the
         # reconcile payload carries it so the head can tell "requeued
         # onto the incarnation this EOF implicates" (prepaid retry)
@@ -202,6 +237,7 @@ class DirectPlane:
 
     def __init__(self, worker):
         self._worker = worker
+        self._wid = worker.config.worker_id.binary()
         from .config import ray_config
         self.enabled = bool(ray_config.direct_calls_enabled)
         self.forwarding = self.enabled and bool(
@@ -247,6 +283,29 @@ class DirectPlane:
         self._done_buf: List[dict] = []
         self._done_flush_n = 1024
         self._ref_flush_n = 1024
+        # -- cross-plane call sequencing (caller side). Per actor:
+        #   next   dense per-(this caller, actor) sequence counter
+        #   d / h  UNSETTLED seqs by plane: in flight on the channel
+        #          ("d") vs owned by the head ("h": fallback/streaming/
+        #          retry_exceptions submissions and reconcile-requeued
+        #          calls); stamping happens AT routing, so there is no
+        #          undecided state
+        #   hi     settled seqs at/above the min-unsettled watermark
+        #          (shipped to the head at the reconcile/re-dial
+        #          chokepoints so a fresh callee incarnation's merge
+        #          gate can resolve stale predecessor references)
+        #   ts     tid bytes -> submit wallclock (telemetry only)
+        # All guarded by _cond.
+        self._seq: Dict[bytes, dict] = {}
+        # Streaming generator calls riding the channel: tid bytes ->
+        # {count, finished, error, abandoned, items, nested, cbs}
+        # (caller-side mirror of the head's _gen_streams). Guarded by
+        # _cond; waiters ride the plane condition.
+        self._streams: Dict[bytes, dict] = {}
+        # Staged SUBMITTED tuples (task_id, name, ts, callee_wid_hex)
+        # for stamped calls, drained into event dicts by the worker's
+        # telemetry flush. Guarded by _cond.
+        self._sub_evts: List = []
         # task_id bytes of calls whose ref args this caller pinned —
         # kept OFF the spec: a dynamic attr would demote the full-spec
         # ACTOR_CALL pickle to the slow extra-dict reduce and ship a
@@ -366,8 +425,11 @@ class DirectPlane:
                 # own message passes this barrier, which would have
                 # kept the residual positive (or marked them escaped).
                 # The head never needs to hear about them; steady-state
-                # call-and-drop bursts cost it zero registrations.
+                # call-and-drop bursts cost it zero registrations
+                # (submission-side task events ride the caller's OWN
+                # event buffer instead — see _mark_routed_locked).
                 if (not escaped
+                        and "gen" not in ent
                         and all(d <= 0 for d in deltas)
                         and not any(ln for ln in ent["nested"])
                         and all(l[0] != P.LOC_SHM for l in ent["locs"])):
@@ -399,6 +461,142 @@ class DirectPlane:
                 telemetry.record_direct_calls(n_calls)
             if n_results:
                 telemetry.record_direct_results(n_results)
+
+    # ------------------------------------------------------------------
+    # cross-plane call sequencing (caller side)
+    #
+    # Every actor call this worker submits is stamped with a dense
+    # per-(caller, actor) sequence number BEFORE routing, plus the list
+    # of its still-unsettled OTHER-plane predecessors — the callee's
+    # merge gate (worker_proc.SequenceGate) holds out-of-order arrivals
+    # until those predecessors execute there or the head settles them.
+    # Same-plane predecessors need no list: the channel is FIFO and the
+    # head's per-actor queue dispatches one caller's calls in seq order.
+    # ------------------------------------------------------------------
+    def _seq_state_locked(self, ab: bytes) -> dict:
+        st = self._seq.get(ab)
+        if st is None:
+            # next: dense counter. d/h/p: UNSETTLED seqs by plane
+            # (direct / head-owned / pending-routing). w: contiguous
+            # settled watermark (every seq < w settled); hi: settled
+            # seqs >= w (sparse holes while an older call is in
+            # flight). All hot-path transitions are O(1) amortized —
+            # the per-call scans must never touch the in-flight window
+            # (burst cost would go quadratic).
+            st = self._seq[ab] = {"next": 0, "d": set(), "h": set(),
+                                  "w": 0, "hi": set()}
+        return st
+
+    def _mark_routed_locked(self, spec, plane: str, chan=None) -> None:
+        """Assign the call's sequence slot on FIRST routing (sequence
+        order is defined by registration order under _cond — no second
+        lock round trip per call) and snapshot its cross-plane
+        predecessors. `plane` is "d" or "h". The steady-state direct
+        path scans only the head-owned + pending sets (near-empty),
+        never the in-flight direct window."""
+        st = self._seq_state_locked(spec.actor_id.binary())
+        seq = spec.caller_seq
+        if seq < 0:
+            seq = st["next"]
+            st["next"] = seq + 1
+            spec.caller_seq = seq
+            spec.caller_id = self._wid
+            if telemetry.enabled:
+                # SUBMITTED staged as a bare tuple under the lock we
+                # already hold; the telemetry flush ships the batch
+                # raw and the HEAD converts to event dicts at ingest
+                # (riding existing frames — zero per-call head
+                # messages), closing the direct-call state-API
+                # submission gap.
+                self._sub_evts.append(
+                    (spec.task_id.binary(), spec.name, time.time(),
+                     getattr(chan, "callee_wid", None)))
+        else:
+            # Rerouted (channel send unwound -> head path): leave the
+            # old plane set.
+            st["d"].discard(seq)
+            st["h"].discard(seq)
+        if plane == "d":
+            other = st["h"] if st["h"] else ()
+            st["d"].add(seq)
+        else:
+            other = st["d"] if st["d"] else ()
+            st["h"].add(seq)
+        spec.seq_preds = tuple(sorted(
+            s for s in other if s < seq)) if other else ()
+
+    def mark_head_routed(self, spec) -> None:
+        """The call takes the head path (fallback, streaming before a
+        channel exists, retry_exceptions, unwound channel send): stamp
+        it (first routing) and snapshot the in-flight channel calls as
+        its predecessors."""
+        _bump()
+        with self._cond:
+            self._mark_routed_locked(spec, "h")
+
+    def _settle_seq_locked(self, ab: bytes, seq: int) -> None:
+        """This call is terminally settled caller-side (result or error
+        delivered locally, or ownership confirmed done by the head): it
+        can never again be anyone's missing predecessor on a FUTURE
+        incarnation, so it joins the settled set shipped to the head at
+        the reconcile/re-dial chokepoints. Contiguous settlement (the
+        steady state) compacts into the watermark, amortized O(1)."""
+        if seq < 0:
+            return
+        st = self._seq.get(ab)
+        if st is None:
+            return
+        st["d"].discard(seq)
+        st["h"].discard(seq)
+        if seq == st["w"] and not st["hi"]:
+            st["w"] = seq + 1  # contiguous settlement fast path
+            return
+        if seq < st["w"] or seq in st["hi"]:
+            return
+        st["hi"].add(seq)
+        hi = st["hi"]
+        while st["w"] in hi:
+            hi.discard(st["w"])
+            st["w"] += 1
+
+    def _seq_snapshot_locked(self, ab: bytes):
+        """(settled_below, settled_set) for the head's settlement store
+        (caller holds _cond): every seq < settled_below is settled;
+        settled_set are the settled ones above it (holes exist while an
+        older call is still unsettled)."""
+        st = self._seq.get(ab)
+        if st is None:
+            return None
+        return st["w"], sorted(st["hi"])
+
+    def drain_submitted(self) -> List:
+        """Staged SUBMITTED tuples (task_id_bytes, name, ts,
+        callee_wid), shipped raw inside the TASK_EVENTS frame — the
+        HEAD converts to event dicts at ingest, so the hot path and
+        the worker-side drain pay tuple appends and one pickle each,
+        nothing more."""
+        if not self._sub_evts:
+            return []
+        with self._cond:
+            staged, self._sub_evts = self._sub_evts, []
+        return staged
+
+    def on_seq_settled(self, payload: dict) -> None:
+        """SEQ_SETTLED from the head. Two independent, idempotent
+        halves: as a CALLER, prune the listed slots from the unsettled
+        map (they were settled head-side without this worker seeing a
+        result frame — typed reconcile errors, dead-actor failures); as
+        a CALLEE, release merge-gate holds waiting on them."""
+        ab = payload.get("actor_id")
+        seqs = payload.get("seqs") or ()
+        if ab is not None:
+            with self._cond:
+                for s in seqs:
+                    self._settle_seq_locked(ab, s)
+        caller = payload.get("caller_id")
+        if caller is not None:
+            self._worker.seq_gate_settled(caller, seqs,
+                                          all_=bool(payload.get("all")))
 
     # ------------------------------------------------------------------
     # local result cache / pending markers
@@ -580,13 +778,6 @@ class DirectPlane:
         """Ship one actor method call on the direct channel. False =>
         the caller must take the head path (no channel, channel dead,
         plane fell back for this actor)."""
-        if spec.streaming:
-            # Streaming generators are head-routed end to end: items
-            # flow as head-registered GEN_ITEMs and the stream end is
-            # signaled by the head's TASK_DONE processing — neither
-            # exists on the channel wire (the reconcile path skips
-            # streaming specs for the same reason).
-            return False
         if spec.retry_exceptions:
             # User-exception retries are a HEAD decision (TASK_DONE's
             # resubmit-on-error branch): on the channel the callee's
@@ -608,18 +799,27 @@ class DirectPlane:
     def _channel_for(self, actor_id) -> Optional[_DirectChannel]:
         ab = actor_id.binary()
         chan = self._chans.get(ab)
-        if chan is _FALLBACK:
-            return None
-        if chan is not None and chan.alive:
+        if isinstance(chan, _Fallback):
+            # Transient pins (channel death, dial failure) re-dial once
+            # the backoff cooldown elapses, bounded by
+            # direct_redial_max_attempts; permanent pins never do.
+            if not chan.redial_due():
+                return None
+        elif chan is not None and chan.alive:
             return chan
         with self._estab_lock:
             chan = self._chans.get(ab)
-            if chan is _FALLBACK:
-                return None
-            if chan is not None and chan.alive:
+            prior = None
+            if isinstance(chan, _Fallback):
+                if not chan.redial_due():
+                    return None
+                prior = chan
+            elif chan is not None and chan.alive:
                 return chan
             try:
                 chan = self._establish(actor_id)
+                if prior is not None and telemetry.enabled:
+                    telemetry.record_direct_fallback("redial")
             except _TransientEstablish as e:
                 # Callee pending/restarting: head path for THIS call,
                 # but the pair stays unpinned so the next call retries
@@ -634,6 +834,14 @@ class DirectPlane:
                 with self._cond:
                     self._chans.pop(ab, None)
                 return None
+            except _RefusedEstablish as e:
+                logger.debug("direct channel to actor %s refused: %r "
+                             "(head path, pinned)", actor_id.hex()[:8], e)
+                if telemetry.enabled:
+                    telemetry.record_direct_fallback("refused")
+                with self._cond:
+                    self._chans[ab] = _FALLBACK
+                return None
             except Exception as e:
                 logger.debug("direct channel to actor %s unavailable: "
                              "%r (head path)", actor_id.hex()[:8], e)
@@ -641,7 +849,14 @@ class DirectPlane:
                     telemetry.record_direct_fallback("connect")
                 chan = None
             with self._cond:
-                self._chans[ab] = chan if chan is not None else _FALLBACK
+                if chan is not None:
+                    self._chans[ab] = chan
+                else:
+                    # A dead-actor broker refusal pins permanently; a
+                    # connect/dial failure is re-dialable after backoff.
+                    self._chans[ab] = _Fallback(
+                        attempts=(prior.attempts + 1) if prior is not None
+                        else 1)
             return chan
 
     def _establish(self, actor_id) -> _DirectChannel:
@@ -649,11 +864,22 @@ class DirectPlane:
         handle resolving the callee's RPC address from the GCS once,
         then submitting directly)."""
         from .config import ray_config
-        rep = self._worker.request(P.CHANNEL_REQ, {"actor_id": actor_id})
+        # Ship the caller's settlement snapshot with the dial: a fresh
+        # callee incarnation's merge gate may hold arrivals on stale
+        # predecessor references (calls settled on a previous
+        # incarnation that the head never heard about — elided
+        # accounting); the head folds this into its settlement store so
+        # the gate's resync can release them.
+        with self._cond:
+            snap = self._seq_snapshot_locked(actor_id.binary())
+        req = {"actor_id": actor_id}
+        if snap is not None:
+            req["settled_below"], req["settled_set"] = snap
+        rep = self._worker.request(P.CHANNEL_REQ, req)
         if not isinstance(rep, dict) or not rep.get("ok"):
             if isinstance(rep, dict) and rep.get("transient"):
                 raise _TransientEstablish(rep.get("reason") or "pending")
-            raise RuntimeError(
+            raise _RefusedEstablish(
                 f"channel broker refused: "
                 f"{rep.get('reason') if isinstance(rep, dict) else rep}")
         if fault.enabled:
@@ -785,6 +1011,33 @@ class DirectPlane:
                 dead = True
             else:
                 dead = False
+                # Stamp + plane fixed at registration: the sequence
+                # slot, the cross-plane predecessor snapshot, and the
+                # channel-FIFO send order are all decided under ONE
+                # lock hold. Inlined steady-state fast path (fresh
+                # stamp, no cross-plane predecessors).
+                sq = chan.seq_st
+                if spec.caller_seq < 0 and not sq["h"]:
+                    seq = sq["next"]
+                    sq["next"] = seq + 1
+                    spec.caller_seq = seq
+                    spec.caller_id = self._wid
+                    spec.seq_preds = ()
+                    sq["d"].add(seq)
+                    if telemetry.enabled:
+                        self._sub_evts.append(
+                            (spec.task_id.binary(), spec.name,
+                             time.time(), chan.callee_wid))
+                else:
+                    self._mark_routed_locked(spec, "d", chan)
+                if spec.streaming:
+                    # Items stream back as GEN_ITEM frames on this
+                    # channel; the caller-side stream state mirrors the
+                    # head's _gen_streams (count/finished/error).
+                    self._streams[tid] = {
+                        "count": 0, "finished": False, "error": None,
+                        "abandoned": False, "items": [], "cbs": [],
+                        "actor": spec.actor_id}
                 for rid in spec.return_ids:
                     self._refs[rid.binary()] = 1
                     self._pending[rid.binary()] = PENDING_DIRECT
@@ -825,6 +1078,7 @@ class DirectPlane:
                     owned = chan.inflight.pop(tid, None) is not None
                     if owned:
                         self._n_calls -= 1
+                        self._streams.pop(tid, None)
                         for rid in spec.return_ids:
                             rb = rid.binary()
                             # Brand-new ids: no other thread has seen
@@ -846,12 +1100,14 @@ class DirectPlane:
                 and spec.trace_ctx is None:
             # Compact wire form for the no-arg fast path: raw id bytes
             # in a tuple pickle ~2x faster than the spec's dataclass
-            # reduce (the callee rebuilds an equivalent spec).
+            # reduce (the callee rebuilds an equivalent spec). The
+            # sequencing triple rides as three tail slots.
             chan.writer.send_message(P.ACTOR_CALL, {"c": (
                 spec.task_id.binary(), spec.actor_id.binary(),
                 spec.method_name, spec.name,
                 [r.binary() for r in spec.return_ids],
-                spec.num_returns, spec.fn_id)})
+                spec.num_returns, spec.fn_id,
+                spec.caller_id, spec.caller_seq, spec.seq_preds)})
             return
         chan.writer.send_message(P.ACTOR_CALL, {"spec": spec})
 
@@ -922,12 +1178,21 @@ class DirectPlane:
         blob = serialization.dumps(
             exc if isinstance(exc, BaseException) else RuntimeError(
                 str(exc)))
+        cbs = []
         with self._cond:
             chan.inflight.pop(spec.task_id.binary(), None)
-            self._retire_locked(spec, None, blob, None)
+            if spec.streaming:
+                cbs = self._retire_stream_locked(spec, 0, blob)
+            else:
+                self._retire_locked(spec, None, blob, None)
             self._flush_accounting_locked()
             self._cond.notify_all()
         self._unpin_once(spec)
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:  # lint: broad-except-ok user stream-done callback; failure delivery must complete
+                logger.debug("stream done-callback raised", exc_info=True)
 
     # ------------------------------------------------------------------
     # caller side: results / reconcile
@@ -953,17 +1218,27 @@ class DirectPlane:
                 self._on_actor_calls(chan, [m[1] for m in msgs[i:j]])
                 i = j
                 continue
+            if msg_type == P.GEN_ITEM:
+                j = i + 1
+                while j < n and msgs[j][0] == P.GEN_ITEM:
+                    j += 1
+                self._on_gen_items(chan, [m[1] for m in msgs[i:j]])
+                i = j
+                continue
             self._handle_direct_message(chan, msg_type, payload)
             i += 1
 
     def _handle_direct_message(self, chan, msg_type: str,
                                payload: dict) -> None:
         """Route one direct-channel message (both roles share this
-        dispatcher: callee sees ACTOR_CALL, caller sees ACTOR_RESULT)."""
+        dispatcher: callee sees ACTOR_CALL, caller sees ACTOR_RESULT
+        and streamed GEN_ITEM frames)."""
         if msg_type == P.ACTOR_CALL:
             self._on_actor_call(chan, payload)
         elif msg_type == P.ACTOR_RESULT:
             self._on_actor_results(chan, [payload])
+        elif msg_type == P.GEN_ITEM:
+            self._on_gen_items(chan, [payload])
         else:
             # Protocol skew between two workers: never silently drop.
             logger.warning("direct channel dropping unknown message "
@@ -993,6 +1268,12 @@ class DirectPlane:
             self._cond.notify_all()
         ent = {"oids": list(spec.return_ids), "locs": list(locs or ()),
                "nested": nested or [], "error": error}
+        if spec.caller_seq >= 0:
+            # Settlement accounting rides the entry: the head keeps a
+            # per-(actor, caller) settled store for merge-gate resyncs.
+            ent["aseq"] = (spec.actor_id.binary(), spec.caller_seq)
+            self._settle_seq_locked(spec.actor_id.binary(),
+                                    spec.caller_seq)
         if error is None and any(
                 l and l[0] == P.LOC_SHM for l in locs or ()):
             # SHM-backed results are the only ones a node death can
@@ -1025,6 +1306,8 @@ class DirectPlane:
         drain in batches at the next accounting barrier (or on the
         size-threshold overflow)."""
         finished = []
+        cbs = []
+        cwid = getattr(chan, "callee_wid", None)
         with self._cond:
             for payload in payloads:
                 tid = payload["t"]
@@ -1033,20 +1316,200 @@ class DirectPlane:
                 if spec is None:
                     continue  # reconciled already (channel raced down)
                 finished.append(spec)
-                self._retire_locked(
-                    spec, payload.get("results"), payload.get("error"),
-                    payload.get("nested"))
+                if spec.streaming:
+                    cbs.extend(self._retire_stream_locked(
+                        spec, payload.get("streamed") or 0,
+                        payload.get("error"), cwid))
+                else:
+                    self._retire_locked(
+                        spec, payload.get("results"),
+                        payload.get("error"), payload.get("nested"))
             self._n_results += len(finished)
             if len(self._done_buf) >= self._done_flush_n:
                 self._flush_accounting_locked()
         for spec in finished:
             self._unpin_once(spec)
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:  # lint: broad-except-ok user stream-done callback; completion must reach every waiter
+                logger.debug("stream done-callback raised", exc_info=True)
+
+    # ------------------------------------------------------------------
+    # caller side: streaming generators on the channel
+    # ------------------------------------------------------------------
+    def _on_gen_items(self, chan, payloads: List[dict]) -> None:
+        """A burst of streamed items from the callee: cache each item's
+        location locally (channel FIFO ⇒ index order ⇒ no lost or
+        duplicated items), count it caller-locally, wake waiters ONCE.
+        The head hears nothing here — accounting ships in one entry at
+        terminal registration."""
+        from .ids import TaskID, object_id_for_return
+        wake = False
+        with self._cond:
+            for p in payloads:
+                tb = p["t"]
+                st = self._streams.get(tb)
+                if st is None:
+                    continue  # stream reconciled/released already
+                oid = object_id_for_return(TaskID(tb), p["i"])
+                ob = oid.binary()
+                self._cache_put_locked(ob, p["loc"])
+                self._refs[ob] = 1
+                st["items"].append((oid, p["loc"],
+                                    list(p.get("nested") or ())))
+                st["count"] = max(st["count"], p["i"] + 1)
+                wake = True
+            if wake:
+                self._cond.notify_all()
+
+    def _retire_stream_locked(self, spec, streamed: int, error,
+                              callee_wid=None) -> List:
+        """Terminal registration of one channel stream (caller holds
+        _cond): ONE accounting entry covering every arrived item (locs,
+        nested ids, residual refcounts popped at flush — "head-side
+        accounting only at terminal registration"), stream state
+        flipped finished, done-callbacks returned for the caller to run
+        outside the lock. Items yielded before a failure stay readable;
+        the error surfaces once the consumer passes them (head-path
+        semantics)."""
+        tb = spec.task_id.binary()
+        st = self._streams.get(tb)
+        items = st["items"] if st is not None else []
+        ent = {"oids": [it[0] for it in items],
+               "locs": [it[1] for it in items],
+               "nested": [it[2] for it in items], "error": None,
+               # Head-side stream closure: the head folds this into its
+               # own _gen_streams so a generator handle passed to the
+               # driver (or another worker) resolves there too — its
+               # foreign gen_wait terminates instead of hanging.
+               "gen": (spec.task_id, st["count"] if st else 0),
+               "stream_error": error}
+        if spec.caller_seq >= 0:
+            ent["aseq"] = (spec.actor_id.binary(), spec.caller_seq)
+            self._settle_seq_locked(spec.actor_id.binary(),
+                                    spec.caller_seq)
+        if error is None and any(
+                l and l[0] == P.LOC_SHM for l in ent["locs"]):
+            # Same invariant as _retire_locked: SHM-backed items carry
+            # their producing spec so the head registers lineage and a
+            # node loss leaves them reconstructable, not dead.
+            ent["spec"] = spec
+        if telemetry.enabled and error is not None:
+            # Mid-stream death: the callee may never report a terminal
+            # event for this stream — record the caller-side FAILED so
+            # the state row terminates (successful terminals flow as
+            # the callee's own worker events).
+            self._worker.record_stream_failed_event(spec, callee_wid)
+        if st is not None and st.get("abandoned"):
+            # Consumer already dropped the generator: balance the
+            # unconsumed items' arrival counts BEFORE the flush pops
+            # residuals — they net zero (or register-then-free for SHM
+            # backing) in THIS flush, instead of parking a -1 in the
+            # delta buffer with no later barrier on an idle worker.
+            released = st.get("released_at", 0)
+            for oid, _loc, _n in items[released:]:
+                ob = oid.binary()
+                if ob in self._refs:
+                    self._refs[ob] -= 1
+                else:
+                    ent2 = self._ref_buf.get(ob)
+                    if ent2 is None:
+                        self._ref_buf[ob] = [oid, -1]
+                    else:
+                        ent2[1] -= 1
+        self._done_buf.append(ent)
+        # Items escaped nothing mid-stream (they resolve locally), but
+        # the head must register them promptly: a generator consumed on
+        # another worker via a passed ref, or abandoned items needing
+        # the freed-path, both route through the head's directory.
+        self._flush_accounting_locked()
+        cbs: List = []
+        if st is not None:
+            st["finished"] = True
+            if error is not None:
+                st["error"] = error
+            cbs, st["cbs"] = list(st.get("cbs", ())), []
+            if st.get("abandoned"):
+                self._streams.pop(tb, None)
+        self._cond.notify_all()
+        return cbs
+
+    def gen_wait(self, task_id, index: int, timeout=None):
+        """Caller-side mirror of Node.gen_wait for channel streams:
+        (available, finished_count, error_blob). Returns None when the
+        task is not a channel stream (the caller falls back to the
+        head's stream state)."""
+        _bump()
+        tb = task_id.binary()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                st = self._streams.get(tb)
+                if st is None:
+                    return None
+                if index < st["count"]:
+                    return True, None, None
+                if st["error"] is not None:
+                    return False, st["count"], st["error"]
+                if st["finished"]:
+                    return False, st["count"], None
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"Timed out waiting for streamed item {index} "
+                        f"of task {task_id.hex()}")
+                self._cond.wait(timeout=min(remaining, 1.0)
+                                if remaining is not None else 1.0)
+
+    def gen_release(self, task_id, consumed: int) -> bool:
+        """Consumer dropped its generator: drop unconsumed arrived
+        items (their arrival count is the only count they carry) and
+        mark a still-running stream abandoned so the terminal entry
+        releases the rest. True when the task was a channel stream."""
+        tb = task_id.binary()
+        drop = []
+        with self._cond:
+            st = self._streams.get(tb)
+            if st is None:
+                return False
+            st["released_at"] = consumed
+            if st["finished"]:
+                drop = [it[0] for it in st["items"][consumed:]]
+                self._streams.pop(tb, None)
+            else:
+                st["abandoned"] = True
+        for oid in drop:
+            self.ref_delta(oid, -1)
+        if drop:
+            self.flush_accounting()
+        return True
+
+    def gen_add_done_callback(self, task_id, cb) -> bool:
+        """cb() when the channel stream finishes (now if already done).
+        False when the task is not a channel stream."""
+        tb = task_id.binary()
+        with self._cond:
+            st = self._streams.get(tb)
+            if st is None:
+                return False
+            if not st["finished"]:
+                st["cbs"].append(cb)
+                return True
+        cb()
+        return True
 
     def _on_channel_down(self, chan: _DirectChannel) -> None:
         """Channel EOF/error: drain every in-flight and queued call
         through the head's reconciliation (retry-ledger bumped attempt
         accounting; requeue-or-typed-error), then pin this (caller,
-        actor) pair to the head path."""
+        actor) pair to the head path (re-dialable after a backoff
+        cooldown — see _Fallback). Streaming calls terminate HERE with
+        a typed error (streams are never retryable; items already
+        arrived stay readable) while their specs still ride the
+        reconcile so the head records settlement and releases any merge
+        gate holds referencing them."""
         if not isinstance(chan, _DirectChannel):
             return
         w = self._worker
@@ -1059,6 +1522,7 @@ class DirectPlane:
             req_id = w._req_counter
         fut: Future = Future()
         w._pending[req_id] = fut
+        stream_cbs: List = []
         with self._cond:
             if not chan.alive:
                 w._pending.pop(req_id, None)
@@ -1069,7 +1533,10 @@ class DirectPlane:
             # head's already-landed idempotence check can see it.
             self._flush_accounting_locked()
             ab = chan.actor_id.binary()
-            self._chans[ab] = _FALLBACK
+            prior = self._chans.get(ab)
+            self._chans[ab] = _Fallback(
+                attempts=(prior.attempts if isinstance(prior, _Fallback)
+                          else 0))
             specs = list(chan.inflight.values())
             sent = set(id(s) for s in specs)
             for s in chan.queue:
@@ -1077,6 +1544,7 @@ class DirectPlane:
                     specs.append(s)
             chan.inflight.clear()
             chan.queue.clear()
+            dead_blob = None
             deltas = []
             for spec in specs:
                 ds = []
@@ -1085,17 +1553,38 @@ class DirectPlane:
                     self._escaped.discard(rb)  # head takes ownership
                     ds.append(self._refs.pop(rb, 0))
                 deltas.append(ds)
+                if spec.streaming:
+                    # Mid-stream EOF: terminate now with the typed
+                    # error (no return ids — the stream state IS the
+                    # delivery surface), shipping the arrived items'
+                    # accounting in the same critical section.
+                    if dead_blob is None:
+                        dead_blob = serialization.dumps(ActorDiedError(
+                            f"Actor {chan.actor_id.hex()} became "
+                            f"unreachable mid-stream"))
+                    stream_cbs.extend(self._retire_stream_locked(
+                        spec, 0, dead_blob, chan.callee_wid))
+            snap = self._seq_snapshot_locked(ab)
             if specs:
+                payload = {
+                    "actor_id": chan.actor_id, "specs": specs,
+                    "deltas": deltas, "req_id": req_id,
+                    "callee_wid": chan.callee_wid}
+                if snap is not None:
+                    payload["settled_below"], payload["settled_set"] = \
+                        snap
                 try:
-                    w.send(P.DIRECT_RECONCILE, {
-                        "actor_id": chan.actor_id, "specs": specs,
-                        "deltas": deltas, "req_id": req_id,
-                        "callee_wid": chan.callee_wid})
+                    w.send(P.DIRECT_RECONCILE, payload)
                 except Exception:
                     fut.set_result(None)
         chan.close()
         if telemetry.enabled:
             telemetry.record_direct_fallback("channel_down")
+        for cb in stream_cbs:
+            try:
+                cb()
+            except Exception:  # lint: broad-except-ok user stream-done callback; reconcile must proceed
+                logger.debug("stream done-callback raised", exc_info=True)
         if not specs:
             w._pending.pop(req_id, None)
             return
@@ -1110,6 +1599,21 @@ class DirectPlane:
                 res = out[i] if (isinstance(out, list)
                                  and i < len(out)) else None
                 status = (res or {}).get("status")
+                if spec.caller_seq >= 0:
+                    if status == "requeued":
+                        # Ownership moved to the head: later calls list
+                        # it as a cross-plane predecessor until its
+                        # retry lands.
+                        sq = self._seq_state_locked(ab)
+                        s = spec.caller_seq
+                        if s in sq["d"]:
+                            sq["d"].discard(s)
+                            sq["h"].add(s)
+                    else:
+                        # done/failed/unknown: terminally settled (the
+                        # result or error is registered head-side, or
+                        # delivered locally right below).
+                        self._settle_seq_locked(ab, spec.caller_seq)
                 for rid in spec.return_ids:
                     rb = rid.binary()
                     self._resolve_pending_locked(rb)
@@ -1199,12 +1703,13 @@ class DirectPlane:
         spec = payload.get("spec")
         if spec is not None:
             return spec
-        tb, ab, mn, name, rids, nr, fid = payload["c"]
+        tb, ab, mn, name, rids, nr, fid, cid, cseq, preds = payload["c"]
         from .ids import ActorID, ObjectID, TaskID
         return P.TaskSpec(
             task_id=TaskID(tb), fn_id=fid, fn_blob=None,
             return_ids=[ObjectID(b) for b in rids], num_returns=nr,
-            name=name, actor_id=ActorID(ab), method_name=mn)
+            name=name, actor_id=ActorID(ab), method_name=mn,
+            caller_id=cid, caller_seq=cseq, seq_preds=preds)
 
     def _on_actor_call(self, chan, payload: dict) -> None:
         """One ACTOR_CALL landed on the callee: route it through the
@@ -1236,7 +1741,13 @@ class DirectPlane:
                 and all(s.trace_ctx is None and not s.streaming
                         and s.method_name != "__adag_exec_loop__"
                         for s in specs)):
-            w._actor_executor.submit(w._execute_direct_batch, chan, specs)
+            # The merge gate sequences stamped bursts against head-path
+            # arrivals from the same caller; contiguous admissible runs
+            # still ship as ONE lean executor item.
+            w.seq_gate_admit_burst(
+                specs,
+                lambda batch: w._actor_executor.submit(
+                    w._execute_direct_batch, chan, batch))
             return
         for spec in specs:
             spec.__dict__["_direct_chan"] = chan
@@ -1250,6 +1761,16 @@ class DirectPlane:
                 if (l and l[0] == P.LOC_SHM and len(l) < 3) else l
                 for l in locs]
 
+    def send_gen_item(self, chan, task_id, index: int, loc,
+                      nested) -> None:
+        """Ship one streamed item callee->caller on the channel (node-
+        tagged like inline results, so cross-node callers can pull the
+        SHM backing). Send failures propagate: the caller is gone and
+        the executing generator aborts into the error path."""
+        chan.writer.send_message(P.GEN_ITEM, {
+            "t": task_id.binary(), "i": index,
+            "loc": self._tag_locs([loc])[0], "nested": nested})
+
     def send_result(self, chan, payload: dict) -> None:
         """Ship one completed direct call's result back to the caller;
         if the caller is gone, fall back to head accounting so ids that
@@ -1257,10 +1778,14 @@ class DirectPlane:
         locs = self._tag_locs(payload.get("results"))
         payload["results"] = locs
         try:
-            chan.writer.send_message(P.ACTOR_RESULT, {
-                "t": payload["task_id"].binary(), "results": locs,
-                "error": payload.get("error"),
-                "nested": payload.get("nested")})
+            msg = {"t": payload["task_id"].binary(), "results": locs,
+                   "error": payload.get("error"),
+                   "nested": payload.get("nested")}
+            if payload.get("streamed") is not None:
+                # Terminal frame of a channel stream: the caller
+                # registers the arrived items with the head here.
+                msg["streamed"] = payload["streamed"]
+            chan.writer.send_message(P.ACTOR_RESULT, msg)
             return
         except Exception:  # lint: broad-except-ok caller gone: fall through to head-accounting fallback below
             pass

@@ -32,6 +32,10 @@ SHUTDOWN = "shutdown"            # clean exit
 REPLY = "reply"                  # response to a worker-originated request
 CHANNEL_OPEN = "chan_open"       # start (or report) the direct-call listener
 RESULT_FWD = "result_fwd"        # oneway: nested-submission result locations
+SEQ_SETTLED = "seq_settled"      # oneway: (caller, actor) sequence slots the
+                                 # head settled without delivery — callers
+                                 # prune their unsettled maps, callee merge
+                                 # gates release held out-of-order arrivals
 
 # Message types: worker -> driver
 REF_COUNT = "ref_count"          # oneway borrow incref/decref from a worker
@@ -384,6 +388,22 @@ class TaskSpec:
     # one GEN_ITEM message each (reference: streaming generator execution,
     # _raylet.pyx:1348 + core_worker TaskManager dynamic returns).
     streaming: bool = False
+    # -- cross-plane call sequencing (reference: the per-caller
+    # sequence_no stamped by direct_actor_task_submitter and merged by
+    # the callee's ActorSchedulingQueue). Worker callers with the
+    # direct plane on stamp every actor call at submission so the
+    # callee executes per-caller submission order EXACTLY no matter
+    # which transport carried each call (channel vs head). Unstamped
+    # (caller_seq == -1: driver calls, flag-off) bypasses the merge
+    # gate entirely.
+    caller_id: Optional[bytes] = None   # submitting worker's id bytes
+    caller_seq: int = -1                # dense per-(caller, actor) counter
+    # Seqs of this caller's calls that were IN FLIGHT ON THE OTHER
+    # PLANE (or still routing) when this call was submitted: the callee
+    # merge gate holds this call until each has executed here or been
+    # settled/released by the head (same-plane predecessors need no
+    # list — each plane delivers one caller's calls in seq order).
+    seq_preds: Optional[Tuple[int, ...]] = None
 
 
 

@@ -139,7 +139,7 @@ class _ActorState:
     SequentialActorSubmitQueue, transport/actor_task_submitter.cc:158)."""
 
     __slots__ = ("spec", "worker", "ready", "dead", "queue", "lock",
-                 "in_flight")
+                 "in_flight", "seq_settled")
 
     def __init__(self, spec: P.ActorSpec):
         self.spec = spec
@@ -150,6 +150,15 @@ class _ActorState:
         # Ordered pending (spec, unresolved_deps) items.
         self.queue: collections.deque = collections.deque()
         self.in_flight: Set[bytes] = set()
+        # Cross-plane sequencing settlement store, per caller worker:
+        # caller_id bytes -> [below, set] — every stamped seq < below
+        # plus those in the set is terminally settled (executed
+        # somewhere, or typed-errored). Fed by terminal registrations,
+        # DIRECT_DONE entries, and caller snapshots at reconcile /
+        # re-dial; consulted by callee merge-gate resync queries so a
+        # fresh incarnation never wedges on a predecessor that already
+        # settled against an earlier one. Guarded by `lock`.
+        self.seq_settled: Dict[bytes, list] = {}
 
 
 class Node:
@@ -908,6 +917,12 @@ class Node:
             task_id.binary(), {"count": 0, "finished": False,
                                "error": None, "callbacks": []})
 
+    def supports_streaming(self) -> bool:
+        """The driver consumes streams from its own stream state; the
+        worker-side counterpart (WorkerClient) requires the direct
+        plane (channel streams, head-routed fallback via gcs ops)."""
+        return True
+
     def gen_wait(self, task_id: TaskID, index: int,
                  timeout: Optional[float] = None):
         """Block until item `index` of a streaming task exists or the
@@ -1063,6 +1078,7 @@ class Node:
             self._unpin_task_args(spec)
             self._finish_gen_stream(task_id, payload.get("streamed"),
                                     error)
+            self._note_seq_settled(spec)
             self.gcs.record_task_event({
                 "task_id": task_id.hex(), "name": spec.name,
                 "state": "FAILED" if error is not None else "FINISHED",
@@ -1076,8 +1092,10 @@ class Node:
                 return
             self._unpin_task_args(spec)
             self._register_error_returns(spec, error)
+            self._note_seq_settled(spec)
         else:
             self._unpin_task_args(spec)
+            self._note_seq_settled(spec)
             nested_lists = payload.get("nested") or [[]] * len(
                 spec.return_ids)
             fwd_locs = []
@@ -1308,6 +1326,7 @@ class Node:
                 self._finish_gen_stream(item[0].task_id, None, error_blob)
             self._register_error_returns(item[0], error_blob)
             self._unpin_task_args(item[0])
+            self._note_seq_settled(item[0])
 
     def submit_actor_task(self, spec: P.TaskSpec):
         st = self._actors.get(spec.actor_id)
@@ -1323,6 +1342,7 @@ class Node:
             if spec.streaming:
                 self._finish_gen_stream(spec.task_id, None, blob)
             self._register_error_returns(spec, blob)
+            self._note_seq_settled(spec)
             return
         if spec.max_retries == -2:
             # Per-call budget unset: inherit the actor's max_task_retries
@@ -1339,11 +1359,31 @@ class Node:
         """Queue an (already-pinned) actor task and flush when its deps
         resolve — shared by first submission and retries. `front` puts
         retried in-flight tasks BEFORE already-queued ones so the
-        restarted actor preserves per-actor submission order."""
+        restarted actor preserves per-actor submission order. STAMPED
+        specs (cross-plane sequencing) requeue by ORDERED INSERT
+        instead: a reconcile- or restart-requeued call lands before any
+        queued call from the same caller with a higher sequence number,
+        so the head pipe delivers one caller's calls in seq order and
+        the callee merge gate only ever waits on cross-plane arrivals."""
         unresolved = self._unresolved_deps(spec)
         item = [spec, unresolved]
+        stamped = getattr(spec, "caller_seq", -1) >= 0 \
+            and getattr(spec, "caller_id", None) is not None
         with st.lock:
-            if front:
+            if stamped and (front or any(
+                    it[0].caller_id == spec.caller_id
+                    for it in st.queue)):
+                idx = None
+                for i, it in enumerate(st.queue):
+                    if (it[0].caller_id == spec.caller_id
+                            and it[0].caller_seq > spec.caller_seq):
+                        idx = i
+                        break
+                if idx is None:
+                    st.queue.append(item)
+                else:
+                    st.queue.insert(idx, item)
+            elif front:
                 st.queue.appendleft(item)
             else:
                 st.queue.append(item)
@@ -1458,6 +1498,21 @@ class Node:
         # Stop re-exporting the dead worker's pushed metrics snapshot
         # (worker churn must not grow the store or pin stale gauges).
         self.gcs.telemetry.forget_worker(handle.worker_id.hex())
+        # A dead CALLER's unsettled sequence slots (channel sends that
+        # died in its outbound queue) could wedge callee merge gates
+        # forever: release its whole sequencing domain at every live
+        # actor worker.
+        dead_wid_b = handle.worker_id.binary()
+        for st_a in list(self._actors.values()):
+            with st_a.lock:
+                w_a = st_a.worker
+            if w_a is not None and w_a is not handle and w_a.alive:
+                try:
+                    w_a.send(P.SEQ_SETTLED, {
+                        "caller_id": dead_wid_b, "seqs": (),
+                        "all": True})
+                except Exception:  # lint: broad-except-ok dying callee pipe; its gate dies with it
+                    pass
         aid = handle.dedicated_actor
         # Drain via atomic popitem: a concurrent send-failure branch in
         # _dispatch also pops, and each spec must be owned by exactly
@@ -1550,6 +1605,9 @@ class Node:
             for rid in spec.return_ids:
                 self.gcs.objects.register_ready(rid, (P.LOC_ERROR, blob))
             self._unpin_task_args(spec)
+            # Dropped at the death drain (stream / no budget): the NEXT
+            # incarnation's merge gate must not wait for this slot.
+            self._note_seq_settled(spec, release_to_callee=True)
         if already_dead:
             return
         if will_restart:
@@ -1681,9 +1739,119 @@ class Node:
                         bytes.fromhex(ev["task_id"]), 0) + 1
                 except (KeyError, ValueError, TypeError):
                     ev["attempt"] = 1
+        sub = payload.get("sub")
+        if sub:
+            # Raw SUBMITTED tuples for stamped direct calls (caller
+            # ships (task_id_bytes, name, ts, callee_wid) — the dict
+            # build happens HERE, off the worker's call hot path), so
+            # state.list_tasks rows for direct calls carry
+            # submission-side state like head-path calls.
+            node_hex = self._node_hex_of(handle)
+            events = list(events) + [
+                {"task_id": tb.hex(), "name": name, "state": "SUBMITTED",
+                 "ts": ts, "src": "worker", "node_id": node_hex,
+                 "worker_id": cwid,
+                 "attempt": self._retries_used.get(tb, 0) + 1}
+                for tb, name, ts, cwid in sub]
         self.gcs.record_task_events(events,
                                     dropped=payload.get("dropped", 0),
                                     from_worker=True)
+
+    # ------------------------------------------------------------------
+    # cross-plane call sequencing (head side: settlement authority)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _seq_record(st: "_ActorState", caller: bytes, seq: int) -> None:
+        """Record one settled (caller, seq) slot (caller holds
+        st.lock). Contiguous slots compact into the `below` watermark;
+        past the sparse cap the OLDEST entries drop — a resync may then
+        answer "unsettled" for ancient slots (bounded hold-timeout
+        backstop), never "settled" for a live one."""
+        store = st.seq_settled.setdefault(caller, [0, set()])
+        if seq < store[0]:
+            return
+        store[1].add(seq)
+        while store[0] in store[1]:
+            store[1].discard(store[0])
+            store[0] += 1
+        if len(store[1]) > 8192:
+            for s in sorted(store[1])[:4096]:
+                store[1].discard(s)
+
+    @staticmethod
+    def _seq_merge(st: "_ActorState", caller: bytes, below: int,
+                   extra) -> None:
+        """Fold a caller's settlement snapshot in (caller holds
+        st.lock) — the reconcile/re-dial chokepoints ship (min-
+        unsettled watermark, settled set above it)."""
+        store = st.seq_settled.setdefault(caller, [0, set()])
+        if below > store[0]:
+            store[0] = below
+        store[1].update(extra or ())
+        store[1] = {s for s in store[1] if s >= store[0]}
+        while store[0] in store[1]:
+            store[1].discard(store[0])
+            store[0] += 1
+
+    @staticmethod
+    def _seq_is_settled(st: "_ActorState", caller: bytes,
+                        seq: int) -> bool:
+        store = st.seq_settled.get(caller)
+        return store is not None and (seq < store[0] or seq in store[1])
+
+    def _worker_handle_by_wid(self, wid: bytes):
+        """The live handle of a worker by id bytes (head-local or
+        daemon proxy), or None."""
+        h = self.pool.workers.get(WorkerID(wid))
+        if h is not None:
+            return h if h.alive else None
+        for p in self.head_server.all_proxies():
+            if p.worker_id.binary() == wid:
+                return p if p.alive else None
+        return None
+
+    def _note_seq_settled(self, spec, push_caller: bool = True,
+                          release_to_callee: bool = False) -> None:
+        """A stamped actor call reached TERMINAL registration here:
+        record the slot in the actor's settlement store, tell the
+        caller (so its unsettled map — the source of future calls'
+        predecessor lists — shrinks), and, when the slot was settled
+        WITHOUT delivery (typed reconcile errors, drops at death
+        drains), release any merge-gate hold at the live incarnation
+        waiting on it — a dead plane must never wedge the live one."""
+        seq = getattr(spec, "caller_seq", -1)
+        caller = getattr(spec, "caller_id", None)
+        if seq is None or seq < 0 or caller is None \
+                or spec.actor_id is None:
+            return
+        st = self._actors.get(spec.actor_id)
+        callee = None
+        if st is not None:
+            with st.lock:
+                self._seq_record(st, caller, seq)
+                if release_to_callee:
+                    callee = st.worker
+        # Split payloads: the CALLER half keys on actor_id (prune its
+        # unsettled map), the CALLEE half on caller_id (release gate
+        # holds). Sending both keys to both would cross-contaminate a
+        # worker that both hosts the actor AND calls it — the release
+        # for caller C's slot must never settle the host's own
+        # same-numbered slot toward that actor.
+        if push_caller:
+            h = self._worker_handle_by_wid(caller)
+            if h is not None:
+                try:
+                    h.send(P.SEQ_SETTLED, {
+                        "actor_id": spec.actor_id.binary(),
+                        "seqs": [seq]})
+                except Exception:  # lint: broad-except-ok dying caller pipe; its death releases its whole domain
+                    pass
+        if callee is not None and callee.alive:
+            try:
+                callee.send(P.SEQ_SETTLED, {
+                    "caller_id": caller, "seqs": [seq]})
+            except Exception:  # lint: broad-except-ok dying callee pipe; its gate dies with it
+                pass
 
     # ------------------------------------------------------------------
     # direct worker<->worker call plane (head side: broker + accounting)
@@ -1707,6 +1875,16 @@ class Node:
             return
         st = self._actors.get(actor_id)
         entry = self.gcs.actors.get(actor_id)
+        if st is not None and payload.get("settled_below") is not None:
+            # Re-dial chokepoint: the caller ships its settlement
+            # snapshot so a fresh incarnation's merge gate can resolve
+            # predecessor references to calls that settled against an
+            # earlier incarnation (elided accounting the head never
+            # heard otherwise).
+            with st.lock:
+                self._seq_merge(st, handle.worker_id.binary(),
+                                int(payload["settled_below"]),
+                                payload.get("settled_set"))
         if (st is None or entry is None or st.dead
                 or entry.state == gcs_mod.ACTOR_DEAD):
             refuse("actor is not alive")
@@ -1801,6 +1979,7 @@ class Node:
         results in the object directory (shm adoption + location
         tagging, exactly like TASK_DONE) and absorb the caller's
         residual local refcounts."""
+        caller_wid = handle.worker_id.binary()
         for ent in payload.get("entries", ()):
             error = ent.get("error")
             oids = ent.get("oids") or ()
@@ -1819,6 +1998,23 @@ class Node:
                 self._register_result_loc(oid, loc, ent.get("spec"), nst)
                 self.gcs.objects.apply_delta(
                     oid, deltas[i] if i < len(deltas) else 0)
+            aseq = ent.get("aseq")
+            if aseq is not None:
+                # Caller-settled slot: feed the sequencing settlement
+                # store (merge-gate resyncs on later incarnations).
+                st = self._actors.get(ActorID(aseq[0]))
+                if st is not None:
+                    with st.lock:
+                        self._seq_record(st, caller_wid, aseq[1])
+            gen = ent.get("gen")
+            if gen is not None:
+                # Channel-stream terminal: close the head's stream
+                # state too, so a generator handle passed beyond the
+                # submitting worker (driver, other workers) resolves
+                # against the just-registered items instead of hanging
+                # on an empty stream.
+                self._finish_gen_stream(gen[0], gen[1],
+                                        ent.get("stream_error"))
 
     def _on_ref_deltas(self, payload: dict):
         """Coalesced per-burst refcount deltas from a worker. Positive
@@ -1845,6 +2041,15 @@ class Node:
         chan_wid = payload.get("callee_wid")
         st = self._actors.get(actor_id)
         entry = self.gcs.actors.get(actor_id)
+        if st is not None and payload.get("settled_below") is not None:
+            # Channel-death chokepoint: fold the caller's settlement
+            # snapshot in (covers direct calls whose elided accounting
+            # the head never saw — a later incarnation's merge gate
+            # resolves stale predecessor references against it).
+            with st.lock:
+                self._seq_merge(st, handle.worker_id.binary(),
+                                int(payload["settled_below"]),
+                                payload.get("settled_set"))
         out = []
         for i, spec in enumerate(specs):
             ds = deltas[i] if i < len(deltas) else [0] * len(
@@ -1858,6 +2063,7 @@ class Node:
                 # before the channel tore down: nothing to redo.
                 for rid, d in zip(spec.return_ids, ds):
                     self.gcs.objects.apply_delta(rid, d)
+                self._note_seq_settled(spec, push_caller=False)
                 out.append({"status": "done"})
                 continue
             if spec.max_retries == -2:
@@ -1909,6 +2115,12 @@ class Node:
                 for rid in spec.return_ids:
                     self.gcs.objects.register_ready(
                         rid, (P.LOC_ERROR, blob))
+                # Typed-errored WITHOUT delivery: the caller settles it
+                # from this reply, but a merge-gate hold at the (still
+                # live, or next) incarnation must be released by the
+                # head — a dead plane never wedges the live one.
+                self._note_seq_settled(spec, push_caller=False,
+                                       release_to_callee=True)
                 out.append({"status": "failed", "error": blob})
         self._reply(handle, req_id, out)
 
@@ -1918,13 +2130,7 @@ class Node:
         wid = getattr(spec, "_submitter_wid", None)
         if wid is None:
             return None
-        h = self.pool.workers.get(WorkerID(wid))
-        if h is not None:
-            return h if h.alive else None
-        for p in self.head_server.all_proxies():
-            if p.worker_id.binary() == wid:
-                return p if p.alive else None
-        return None
+        return self._worker_handle_by_wid(wid)
 
     def _forward_spec_results(self, spec, locs) -> None:
         """Inline forwarding at a registration chokepoint: push the
@@ -2250,6 +2456,26 @@ class Node:
             return telemetry.federated_prometheus_text(self)
         if op == "telemetry_dropped":
             return self.gcs.telemetry.dropped_counts()
+        if op == "direct_seq_settled":
+            # Callee merge-gate resync: which of these (caller, seq)
+            # slots are terminally settled? Unknown actor state means
+            # no ordering obligations remain — release everything.
+            st = self._actors.get(ActorID(kwargs["actor_id"]))
+            seqs = list(kwargs.get("seqs") or ())
+            if st is None:
+                return seqs
+            caller = kwargs["caller_id"]
+            with st.lock:
+                return [s for s in seqs
+                        if self._seq_is_settled(st, caller, s)]
+        if op == "gen_wait":
+            # Worker-side consumption of a HEAD-routed stream (the
+            # direct-plane fallback): blocks in the head's stream state.
+            return self.gen_wait(kwargs["task_id"], kwargs["index"],
+                                 kwargs.get("timeout"))
+        if op == "gen_release":
+            return self.gen_release(kwargs["task_id"],
+                                    int(kwargs.get("consumed", 0)))
         if op == "record_spans":
             return self.gcs.record_spans(**kwargs)
         if op == "get_spans":

@@ -962,6 +962,18 @@ class WorkerPool:
             "1" if _rc.direct_calls_enabled else "0"
         env["RAY_TPU_DIRECT_RESULT_FORWARDING"] = \
             "1" if _rc.direct_result_forwarding else "0"
+        # Sequencing + re-dial knobs follow the same coherence rule:
+        # the merge gate and redial backoff run IN workers, so a
+        # programmatic ray_config.set on the driver must win over
+        # whatever the operator's shell exported.
+        env["RAY_TPU_DIRECT_REDIAL_BACKOFF_S"] = \
+            str(_rc.direct_redial_backoff_s)
+        env["RAY_TPU_DIRECT_REDIAL_MAX_ATTEMPTS"] = \
+            str(int(_rc.direct_redial_max_attempts))
+        env["RAY_TPU_DIRECT_SEQ_REORDER_CAP"] = \
+            str(int(_rc.direct_seq_reorder_cap))
+        env["RAY_TPU_DIRECT_SEQ_HOLD_TIMEOUT_S"] = \
+            str(_rc.direct_seq_hold_timeout_s)
         # Never inherit the DRIVER's chip visibility: a cpu-pool worker
         # with no chips assigned must not report the driver's
         # TPU_VISIBLE_CHIPS through get_tpu_ids().

@@ -65,6 +65,268 @@ def current_task_spec() -> Optional[P.TaskSpec]:
     return _task_ctx_var.get()
 
 
+class SequenceGate:
+    """Callee-side cross-plane merge point (reference: the actor
+    scheduling queue's per-caller seq_no ordering + client_processed_up_to
+    fast-forwarding in core_worker/transport/task_receiver).
+
+    Both arrival paths — head-dispatched EXEC_TASK(S) and channel
+    ACTOR_CALL bursts — route stamped actor calls through here before
+    touching an executor, so one caller's calls execute in EXACT
+    submission order no matter which transport carried each one. Within
+    a plane arrivals are already per-caller FIFO (channel socket; head
+    pipe + seq-ordered per-actor queue), so an arrival only waits on
+    its stamped CROSS-plane predecessors (spec.seq_preds) and on any
+    older same-caller arrival already held.
+
+    A held slot is released by: its predecessor executing here, the
+    head settling the predecessor (SEQ_SETTLED push, or the resync
+    query against the head's per-(actor, caller) settlement store —
+    covers calls settled on a previous incarnation this gate never
+    saw), or — liveness backstop only, never the exact path — the
+    bounded reorder cap / hold timeout force-admitting the oldest slot
+    with a warning."""
+
+    _GRACE_S = 1.0      # hold age before the first resync query
+    _REQUERY_S = 2.0    # between resync queries for one slot
+
+    def __init__(self, worker: "Worker"):
+        self._worker = worker
+        self._lock = lockdep.lock("worker.seq_gate")
+        # caller_id -> {"lo": int|None, "hi": set, "held": {seq: slot}}
+        # lo/hi: all seqs < lo plus those in hi are admitted-or-settled
+        # (lo initializes to the first observed seq: anything below it
+        # predates this incarnation's gate and can only be a replay).
+        # held slot: [runner, preds_tuple, held_since, last_query_ts]
+        self._callers: Dict[bytes, dict] = {}
+        self._resync_running = False
+
+    # -- state helpers (caller holds self._lock) -----------------------
+    def _caller_locked(self, cid: bytes) -> dict:
+        st = self._callers.get(cid)
+        if st is None:
+            st = self._callers[cid] = {"lo": None, "hi": set(),
+                                       "held": {}}
+        return st
+
+    @staticmethod
+    def _covered(st: dict, seq: int) -> bool:
+        lo = st["lo"]
+        return lo is not None and (seq < lo or seq in st["hi"])
+
+    @staticmethod
+    def _mark_locked(st: dict, seq: int) -> None:
+        if st["lo"] is None:
+            st["lo"] = seq
+        if seq < st["lo"]:
+            return
+        st["hi"].add(seq)
+        while st["lo"] in st["hi"]:
+            st["hi"].discard(st["lo"])
+            st["lo"] += 1
+
+    def _admissible_locked(self, st: dict, seq: int, preds) -> bool:
+        if st["held"] and min(st["held"]) < seq:
+            return False  # an older same-caller arrival is parked
+        return all(self._covered(st, p) for p in preds or ())
+
+    def _hold_locked(self, st: dict, seq: int, preds, runner) -> List:
+        from .config import ray_config
+        st["held"][seq] = [runner, tuple(preds or ()),
+                           time.monotonic(), 0.0]
+        self._ensure_resync_locked()
+        if len(st["held"]) > int(ray_config.direct_seq_reorder_cap):
+            logger.warning(
+                "sequence gate reorder buffer overflow (cap %s): "
+                "force-admitting the oldest held call",
+                ray_config.direct_seq_reorder_cap)
+            return self._force_oldest_locked(st)
+        return []
+
+    def _drain_locked(self, st: dict) -> List:
+        """Pop newly-admissible held slots IN SEQ ORDER; returns their
+        runners (the caller invokes them, still under the gate lock,
+        to keep executor-submission order exact)."""
+        out: List = []
+        while st["held"]:
+            s = min(st["held"])
+            slot = st["held"][s]
+            if not all(self._covered(st, p) for p in slot[1]):
+                break
+            del st["held"][s]
+            self._mark_locked(st, s)
+            out.append(slot[0])
+        return out
+
+    def _force_oldest_locked(self, st: dict) -> List:
+        s = min(st["held"])
+        slot = st["held"].pop(s)
+        self._mark_locked(st, s)
+        return [slot[0]] + self._drain_locked(st)
+
+    @staticmethod
+    def _run(runner) -> None:
+        try:
+            runner()
+        except Exception:
+            logger.exception("sequence-gate admission runner failed")
+
+    # -- arrival entry points ------------------------------------------
+    def admit(self, spec, runner) -> None:
+        """One stamped arrival: run now (in order) or hold until its
+        predecessors execute/settle. Runners only enqueue to the
+        actor's executors (cheap, non-blocking), so they run under the
+        gate lock — admission order IS executor order."""
+        with self._lock:
+            st = self._caller_locked(spec.caller_id)
+            seq = spec.caller_seq
+            if self._covered(st, seq):
+                to_run = [runner]  # replay of an executed/settled slot
+            elif self._admissible_locked(st, seq, spec.seq_preds):
+                self._mark_locked(st, seq)
+                to_run = [runner] + self._drain_locked(st)
+            else:
+                to_run = self._hold_locked(st, seq, spec.seq_preds,
+                                           runner)
+            for r in to_run:
+                self._run(r)
+
+    def admit_burst(self, specs: List, batch_runner) -> None:
+        """A channel burst from one caller: contiguous admissible runs
+        still ship as one batch item; a held slot splits the run (its
+        successors hold behind it via the older-held rule), and drained
+        cross-plane slots are interleaved at their seq position."""
+        with self._lock:
+            ready: List = []
+
+            def flush():
+                nonlocal ready
+                if ready:
+                    batch = ready
+                    ready = []
+                    self._run(lambda: batch_runner(batch))
+
+            callers = self._callers
+            for spec in specs:
+                seq = spec.caller_seq
+                if seq < 0 or spec.caller_id is None:
+                    ready.append(spec)
+                    continue
+                st = callers.get(spec.caller_id)
+                # Steady-state fast path: next contiguous slot, nothing
+                # held, no cross-plane predecessors — one dict probe +
+                # one increment.
+                if st is not None and st["lo"] == seq \
+                        and not spec.seq_preds and not st["held"]:
+                    # (the compaction invariant keeps lo out of hi, so
+                    # lo == seq implies seq is unmarked)
+                    st["lo"] = seq + 1
+                    while st["lo"] in st["hi"]:
+                        st["hi"].discard(st["lo"])
+                        st["lo"] += 1
+                    ready.append(spec)
+                    continue
+                if st is None:
+                    st = self._caller_locked(spec.caller_id)
+                if self._covered(st, seq):
+                    ready.append(spec)
+                    continue
+                if self._admissible_locked(st, seq, spec.seq_preds):
+                    self._mark_locked(st, seq)
+                    ready.append(spec)
+                    drained = self._drain_locked(st)
+                    if drained:
+                        flush()
+                        for r in drained:
+                            self._run(r)
+                else:
+                    drained = self._hold_locked(
+                        st, seq, spec.seq_preds,
+                        lambda s=spec: batch_runner([s]))
+                    flush()
+                    for r in drained:
+                        self._run(r)
+            flush()
+
+    def on_settled(self, caller_id: bytes, seqs, all_: bool = False
+                   ) -> None:
+        """The head settled these slots without delivering them here
+        (typed reconcile errors, dead-caller cleanup): release holds."""
+        with self._lock:
+            st = self._callers.get(caller_id)
+            if st is None:
+                return
+            if all_:
+                runs = [st["held"][s][0] for s in sorted(st["held"])]
+                self._callers.pop(caller_id, None)
+            else:
+                for s in seqs or ():
+                    self._mark_locked(st, s)
+                runs = self._drain_locked(st)
+            for r in runs:
+                self._run(r)
+
+    # -- resync: ask the head about stale predecessors ------------------
+    def _ensure_resync_locked(self) -> None:
+        if self._resync_running:
+            return
+        self._resync_running = True
+        threading.Thread(target=self._resync_loop, daemon=True,
+                         name="seq-gate-resync").start()
+
+    def _resync_loop(self) -> None:
+        """While holds exist: query the head's settlement store for
+        uncovered predecessors past the grace period (catches slots
+        settled on a previous incarnation / elided accounting), and
+        force-admit slots past the hold timeout. Exits when empty."""
+        from .config import ray_config
+        while True:
+            time.sleep(0.5)
+            queries: Dict[bytes, List[int]] = {}
+            with self._lock:
+                now = time.monotonic()
+                hold_to = float(ray_config.direct_seq_hold_timeout_s)
+                any_held = False
+                for cid, st in list(self._callers.items()):
+                    if not st["held"]:
+                        continue
+                    any_held = True
+                    oldest = min(st["held"])
+                    if now - st["held"][oldest][2] > hold_to:
+                        logger.warning(
+                            "sequence gate hold timeout (%.0fs): "
+                            "force-admitting seq %s", hold_to, oldest)
+                        for r in self._force_oldest_locked(st):
+                            self._run(r)
+                        continue
+                    want = set()
+                    for s, slot in st["held"].items():
+                        if now - slot[2] < self._GRACE_S \
+                                or now - slot[3] < self._REQUERY_S:
+                            continue
+                        slot[3] = now
+                        want.update(p for p in slot[1]
+                                    if not self._covered(st, p))
+                    if want:
+                        queries[cid] = sorted(want)
+                if not any_held:
+                    self._resync_running = False
+                    return
+            aspec = self._worker._actor_spec
+            if aspec is None:
+                continue
+            for cid, seqs in queries.items():
+                try:
+                    settled = self._worker.client.gcs_request(
+                        "direct_seq_settled",
+                        actor_id=aspec.actor_id.binary(),
+                        caller_id=cid, seqs=seqs)
+                except Exception:
+                    settled = None
+                if settled:
+                    self.on_settled(cid, settled)
+
+
 class WorkerClient:
     """Worker-side client for the driver's GCS/scheduler services.
 
@@ -190,12 +452,77 @@ class WorkerClient:
     def submit_actor_task(self, spec: P.TaskSpec):
         w = self._worker
         if w._direct_on:
+            # The per-(caller, actor) sequence slot is stamped at
+            # routing (inside the channel registration, or right here
+            # for the head path) so the callee's merge gate replays
+            # exact submission order on whichever plane carries it.
             if w.direct.submit_actor_call(spec):
                 return  # shipped caller->callee; head sees accounting only
+            # Head path owns the slot (fallback, streaming without a
+            # channel, retry_exceptions): stamp + snapshot its
+            # in-flight channel predecessors for the callee gate.
+            w.direct.mark_head_routed(spec)
             w.direct.note_spec_escapes(spec)
             w.direct.flush_accounting()
             w.direct.note_nested_submission(spec)
         w.send_lazy(P.SUBMIT_ACTOR_TASK, {"spec": spec})
+
+    # -- streaming generators (worker-side consumption) -------------------
+    # Channel streams resolve from the DirectPlane's local stream state;
+    # head-routed streams (fallback/warm-up) degrade to blocking GCS
+    # round trips against the head's stream state. Requires the direct
+    # plane: with it off, workers keep the historical "driver only"
+    # refusal (api.py gates on supports_streaming()).
+    def supports_streaming(self) -> bool:
+        return self._worker._direct_on
+
+    def gen_wait(self, task_id, index: int, timeout=None):
+        w = self._worker
+        if w._direct_on:
+            out = w.direct.gen_wait(task_id, index, timeout)
+            if out is not None:
+                return out
+        return self.gcs_request("gen_wait", task_id=task_id,
+                                index=index, timeout=timeout)
+
+    def gen_release(self, task_id, consumed: int) -> None:
+        w = self._worker
+        if w._direct_on and w.direct.gen_release(task_id, consumed):
+            return
+        try:
+            self.gcs_request("gen_release", task_id=task_id,
+                             consumed=consumed)
+        except Exception:  # lint: broad-except-ok generator GC path; release is best-effort on a dying head pipe
+            pass
+
+    def gen_add_done_callback(self, task_id, cb) -> None:
+        w = self._worker
+        if w._direct_on and w.direct.gen_add_done_callback(task_id, cb):
+            return
+
+        def _watch():
+            from ..exceptions import GetTimeoutError
+            while True:
+                try:
+                    # Short-poll the head's stream state (index far
+                    # past any real stream => returns at stream end):
+                    # each poll occupies a head handler-pool thread for
+                    # at most the timeout, instead of parking one for
+                    # the stream's whole lifetime.
+                    self.gcs_request("gen_wait", task_id=task_id,
+                                     index=1 << 60, timeout=2.0)
+                    break
+                except GetTimeoutError:
+                    continue
+                except Exception:  # lint: broad-except-ok stream-end watcher; cb still fires below
+                    break
+            try:
+                cb()
+            except Exception:  # lint: broad-except-ok user callback; watcher thread must exit clean
+                pass
+
+        threading.Thread(target=_watch, daemon=True,
+                         name="gen-done-watch").start()
 
     def create_actor(self, spec: P.ActorSpec):
         self._request(P.CREATE_ACTOR_REQ, {"spec": spec})
@@ -281,6 +608,10 @@ class Worker:
         from . import direct as direct_mod
         self.direct = direct_mod.DirectPlane(self)
         self._direct_on = self.direct.enabled
+        # Cross-plane merge gate (created lazily on the first STAMPED
+        # arrival: unstamped traffic — flag-off, driver calls — pays
+        # nothing).
+        self._seq_gate: Optional[SequenceGate] = None
         # Telemetry plane: bounded lifecycle-event buffer, drained as a
         # TASK_EVENTS message enqueued right before each completion so
         # both ride ONE writer wakeup / vectored write (telemetry.py).
@@ -407,11 +738,15 @@ class Worker:
                 locs.append((P.LOC_SHM, size))
         return locs, nested_per_return
 
-    def _stream_generator(self, spec: P.TaskSpec, gen) -> int:
+    def _stream_generator(self, spec: P.TaskSpec, gen,
+                          direct_chan=None) -> int:
         """Ship each yielded item as its own object, one GEN_ITEM message
         per item (reference: streaming generator execution,
         _raylet.pyx:1348 — dynamic return objects created as the
-        generator runs, not buffered until completion)."""
+        generator runs, not buffered until completion). Channel streams
+        (`direct_chan` set) ship items callee->caller on the brokered
+        channel — the head hears about them only in the caller's
+        terminal accounting entry."""
         from .ids import object_id_for_return
 
         if not inspect.isgenerator(gen) and not hasattr(gen, "__next__"):
@@ -426,11 +761,24 @@ class Worker:
             else:
                 size = self.store.put_serialized(oid, sobj)
                 loc = (P.LOC_SHM, size)
-            self.send(P.GEN_ITEM, {
-                "task_id": spec.task_id, "index": index, "loc": loc,
-                "nested": list(nested), "actor_id": spec.actor_id})
+            if direct_chan is not None:
+                self.direct.send_gen_item(direct_chan, spec.task_id,
+                                          index, loc, list(nested))
+            else:
+                self.send(P.GEN_ITEM, {
+                    "task_id": spec.task_id, "index": index, "loc": loc,
+                    "nested": list(nested), "actor_id": spec.actor_id})
             index += 1
         return index
+
+    def record_stream_failed_event(self, spec: P.TaskSpec,
+                                   callee_wid=None) -> None:
+        """Terminal FAILED for a channel stream that died with its
+        callee — the callee may never flush one itself."""
+        self._task_events.record(
+            task_id=spec.task_id.hex(), name=spec.name, state="FAILED",
+            ts=time.time(), src="worker",
+            node_id=self.config.node_id_hex, worker_id=callee_wid)
 
     def _record_task_event(self, spec: P.TaskSpec, state: str, ts: float,
                            start_ts: Optional[float] = None):
@@ -454,9 +802,15 @@ class Worker:
         break completion delivery."""
         try:
             events, dropped = self._task_events.drain()
-            if events or dropped:
-                self.send(P.TASK_EVENTS,
-                          {"events": events, "dropped": dropped})
+            sub = self.direct.drain_submitted() if self._direct_on \
+                else []
+            if events or dropped or sub:
+                payload = {"events": events, "dropped": dropped}
+                if sub:
+                    # Raw SUBMITTED tuples for stamped direct calls;
+                    # the head converts at ingest.
+                    payload["sub"] = sub
+                self.send(P.TASK_EVENTS, payload)
             from .config import ray_config
             now = time.monotonic()
             if (now - self._metrics_last_push
@@ -502,8 +856,10 @@ class Worker:
             # size threshold instead of per completion (the drop-oldest
             # buffer bound still holds; state-API freshness for direct
             # calls trails by up to one batch).
-            if telemetry.enabled and (len(self._task_events) >= 256
-                                      or self._task_events.dropped):
+            if telemetry.enabled and (
+                    len(self._task_events)
+                    + len(self.direct._sub_evts) >= 256
+                    or self._task_events.dropped):
                 self._flush_telemetry()
             self.direct.send_result(direct_chan, payload)
             return
@@ -625,7 +981,8 @@ class Worker:
                 if inspect.iscoroutine(result):
                     result = asyncio.run(result)
             if spec.streaming:
-                n_items = self._stream_generator(spec, result)
+                n_items = self._stream_generator(spec, result,
+                                                 direct_chan)
                 if telemetry.enabled:
                     self._record_task_event(spec, "FINISHED", time.time(),
                                             start_ts=run_ts)
@@ -841,10 +1198,50 @@ class Worker:
                 "actor_id": actor_id})
         # else: already finished — the real completion won the race.
 
+    def _seq_gate_for(self) -> SequenceGate:
+        gate = self._seq_gate
+        if gate is None:
+            gate = self._seq_gate = SequenceGate(self)
+        return gate
+
+    def seq_gate_admit_burst(self, specs: List[P.TaskSpec],
+                             batch_runner) -> None:
+        """Channel-burst entry into the merge gate (direct.py's lean
+        path); unstamped bursts bypass it wholesale."""
+        if all(s.caller_seq < 0 for s in specs):
+            batch_runner(specs)
+            return
+        self._seq_gate_for().admit_burst(specs, batch_runner)
+
+    def seq_gate_settled(self, caller_id, seqs, all_: bool = False
+                         ) -> None:
+        gate = self._seq_gate
+        if gate is not None and caller_id is not None:
+            gate.on_settled(caller_id, seqs, all_=all_)
+
     def _handle_exec(self, spec: P.TaskSpec):
         if (spec.fn_blob is not None
                 and spec.fn_id not in self._fn_cache):
             self._fn_blobs[spec.fn_id] = spec.fn_blob
+        if spec.actor_id is not None and spec.caller_seq >= 0 \
+                and spec.caller_id is not None:
+            # Stamped actor call: the merge gate decides when it may
+            # reach an executor (exact per-caller submission order
+            # across BOTH planes). Runners only enqueue, so admission
+            # order is executor order. Register the queued-meta FIRST
+            # so a CANCEL_TASK landing while the call is held reports
+            # through the normal queued-cancel path instead of being
+            # silently dropped (the admission runner's _execute then
+            # consumes the _cancelled_pending marker and skips).
+            with self._running_lock:
+                self._queued_meta[spec.task_id.binary()] = \
+                    (spec.actor_id, spec.fn_id)
+            self._seq_gate_for().admit(
+                spec, lambda: self._dispatch_exec(spec))
+            return
+        self._dispatch_exec(spec)
+
+    def _dispatch_exec(self, spec: P.TaskSpec):
         with self._running_lock:
             self._queued_meta[spec.task_id.binary()] = \
                 (spec.actor_id, spec.fn_id)
@@ -923,6 +1320,10 @@ class Worker:
             self.direct.on_channel_open(payload)
         elif msg_type == P.RESULT_FWD:
             self.direct.on_result_fwd(payload)
+        elif msg_type == P.SEQ_SETTLED:
+            # Head settled sequence slots without delivery: prune the
+            # caller-side unsettled map and release merge-gate holds.
+            self.direct.on_seq_settled(payload)
         elif msg_type == P.SHUTDOWN:
             return True
         else:

@@ -410,6 +410,17 @@ def _apply_placement(opts: Dict, resources: Dict[str, float]):
 # ---------------------------------------------------------------------------
 # remote functions
 # ---------------------------------------------------------------------------
+def _supports_streaming(rt) -> bool:
+    """Can this runtime context consume a streaming generator? The
+    driver always can; workers can via the direct plane (channel
+    streams + head-routed GCS fallback); other contexts keep the
+    historical gen_wait capability check."""
+    sup = getattr(rt, "supports_streaming", None)
+    if sup is not None:
+        return bool(sup())
+    return hasattr(rt, "gen_wait")
+
+
 class ObjectRefGenerator:
     """Iterator over a streaming generator task's yielded items
     (reference: ObjectRefGenerator / DynamicObjectRefGenerator —
@@ -564,12 +575,13 @@ class RemoteFunction:
         rt = state.current()
         opts = self._opts
         streaming = self._streaming
-        if streaming and not hasattr(rt, "gen_wait"):
-            # GEN_ITEM messages route to the owner (driver); a worker
-            # could submit but never consume the stream.
+        if streaming and not _supports_streaming(rt):
+            # Streams need a consumption surface: the driver's stream
+            # state, or (in workers) the direct plane's channel/GCS
+            # stream machinery.
             raise ValueError(
-                'num_returns="streaming" is only supported from the '
-                "driver process in this build")
+                'num_returns="streaming" requires the driver process '
+                "or a worker with direct_calls_enabled in this build")
         num_returns = self._num_returns
         task_id = TaskID.from_random()
         return_ids = [object_id_for_return(task_id, i)
@@ -661,10 +673,10 @@ class ActorHandle:
         meta = self._method_meta.get(method_name, {})
         nr_opt = opts.get("num_returns", meta.get("num_returns", 1))
         streaming = nr_opt == "streaming"
-        if streaming and not hasattr(rt, "gen_wait"):
+        if streaming and not _supports_streaming(rt):
             raise ValueError(
-                'num_returns="streaming" is only supported from the '
-                "driver process in this build")
+                'num_returns="streaming" requires the driver process '
+                "or a worker with direct_calls_enabled in this build")
         num_returns = 0 if streaming else int(nr_opt)
         task_id = TaskID.from_random()
         return_ids = [object_id_for_return(task_id, i)

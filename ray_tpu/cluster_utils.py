@@ -104,6 +104,14 @@ class Cluster:
             "1" if _rc.direct_calls_enabled else "0"
         env["RAY_TPU_DIRECT_RESULT_FORWARDING"] = \
             "1" if _rc.direct_result_forwarding else "0"
+        env["RAY_TPU_DIRECT_REDIAL_BACKOFF_S"] = \
+            str(_rc.direct_redial_backoff_s)
+        env["RAY_TPU_DIRECT_REDIAL_MAX_ATTEMPTS"] = \
+            str(int(_rc.direct_redial_max_attempts))
+        env["RAY_TPU_DIRECT_SEQ_REORDER_CAP"] = \
+            str(int(_rc.direct_seq_reorder_cap))
+        env["RAY_TPU_DIRECT_SEQ_HOLD_TIMEOUT_S"] = \
+            str(_rc.direct_seq_hold_timeout_s)
         argv = [sys.executable, "-m", "ray_tpu._private.daemon",
                 "--address", f"{host}:{port}",
                 "--num-cpus", str(num_cpus)]

@@ -58,7 +58,7 @@ def pytest_configure(config):
 # ZERO potential-ABBA cycles. Assertion per test so a report is
 # attributable to the test that produced it.
 _LOCKDEP_SUITES = {"test_transport_framing", "test_fault_injection",
-                   "test_direct_calls"}
+                   "test_direct_calls", "test_cross_plane_ordering"}
 
 
 @pytest.fixture(autouse=True)

@@ -106,7 +106,7 @@ class Via:
         plane = state._worker.direct
         live = fall = 0
         for v in plane._chans.values():
-            if v is direct._FALLBACK:
+            if isinstance(v, direct._Fallback):
                 fall += 1
             else:
                 live += 1
