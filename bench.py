@@ -447,41 +447,74 @@ def bench_envelope(extras):
             pass
 
 
+def _serve_http_setup(warm_reqs: int = 50):
+    """Shared scaffold of the serve HTTP rows (the full-bench section
+    AND the `--focus serve_http_req_per_s` metric measure the same
+    thing): deploy the nop app, return (mkconn, run_load) where
+    run_load(seconds, threads) drives the 16-way closed loop and
+    returns (latencies, elapsed). Caller owns serve/runtime teardown."""
+    import http.client
+    import threading
+
+    from ray_tpu import serve
+
+    serve.start()
+
+    @serve.deployment(max_ongoing_requests=64, num_replicas=2)
+    def nop(request):
+        return "ok"
+
+    serve.run(nop.bind(), name="bench", route_prefix="/nop")
+    host, port = serve.proxy_address().replace("http://", "").split(":")
+
+    def mkconn():
+        c = http.client.HTTPConnection(host, int(port))
+        c.connect()
+        return c
+
+    warm = mkconn()
+    for _ in range(warm_reqs):
+        warm.request("POST", "/nop", body=b"{}")
+        warm.getresponse().read()
+
+    def run_load(seconds: float = 4.0, nthreads: int = 16):
+        lat = []
+        stop_at = time.time() + seconds
+
+        def worker():
+            conn = mkconn()
+            while time.time() < stop_at:
+                t0 = time.perf_counter()
+                conn.request("POST", "/nop", body=b"{}")
+                conn.getresponse().read()
+                lat.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(nthreads)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lat, time.time() - t0
+
+    return mkconn, run_load
+
+
 def bench_serve(extras):
     """HTTP data-plane micro-bench (VERDICT r1 #9: nop deployment
     req/s + p50 through the async proxy)."""
     try:
-        import http.client
-        import statistics
-        import threading
-
         import ray_tpu
         from ray_tpu import serve
 
         ray_tpu.init(num_cpus=min(os.cpu_count() or 4, 16))
-        serve.start()
-
-        @serve.deployment(max_ongoing_requests=64, num_replicas=2)
-        def nop(request):
-            return "ok"
-
-        serve.run(nop.bind(), name="bench", route_prefix="/nop")
-        host, port = serve.proxy_address().replace(
-            "http://", "").split(":")
-
-        def mkconn():
-            c = http.client.HTTPConnection(host, int(port))
-            c.connect()
-            return c
-
-        warm = mkconn()
-        for _ in range(50):
-            warm.request("POST", "/nop", body=b"{}")
-            warm.getresponse().read()
+        mkconn, run_load = _serve_http_setup()
 
         # Serial p50: request latency without client-side queueing (the
         # 16-way p50 below measures queue depth on small boxes, not the
         # proxy).
+        warm = mkconn()
         slat = []
         stop_serial = time.time() + 2.0
         while time.time() < stop_serial:
@@ -493,25 +526,7 @@ def bench_serve(extras):
         extras["serve_http_p50_serial_ms"] = round(
             1000 * slat[len(slat) // 2], 2) if slat else None
 
-        lat, count = [], [0]
-        stop_at = time.time() + 4.0
-
-        def worker():
-            conn = mkconn()
-            while time.time() < stop_at:
-                t0 = time.perf_counter()
-                conn.request("POST", "/nop", body=b"{}")
-                conn.getresponse().read()
-                lat.append(time.perf_counter() - t0)
-                count[0] += 1
-
-        threads = [threading.Thread(target=worker) for _ in range(16)]
-        t0 = time.time()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        el = time.time() - t0
+        lat, el = run_load()
         lat.sort()
         extras["serve_http_req_per_s"] = round(len(lat) / el, 1)
         extras["serve_http_p50_ms"] = round(
@@ -1100,12 +1115,25 @@ def _focus_streaming_gen(ray_tpu):
     return measure
 
 
+def _focus_serve_http(ray_tpu):
+    """Proxy req/s (the bench_serve 16-thread row as a focus metric, so
+    serve changes prove themselves with `--ab serve_http_req_per_s`
+    instead of a full bench run). Same scaffold as bench_serve."""
+    _mkconn, run_load = _serve_http_setup(warm_reqs=100)
+
+    def measure():
+        lat, el = run_load()
+        return len(lat) / el
+    return measure
+
+
 FOCUS_METRICS = {
     "tasks_async_per_s": _focus_tasks_async,
     "put_get_per_s": _focus_put_get,
     "multi_client_tasks_async_per_s": _focus_mc_tasks,
     "nn_actor_calls_async_per_s": _focus_nn_actor,
     "streaming_gen_items_per_s": _focus_streaming_gen,
+    "serve_http_req_per_s": _focus_serve_http,
 }
 
 

@@ -42,8 +42,15 @@ class RayConfig:
         "worker_register_timeout_s": 60.0,
         # task event log cap (reference: task_events_max_num... family)
         "max_task_events": 10_000,
-        # tracing span store cap
+        # tracing span store cap (global, across all per-trace rings:
+        # the oldest trace is evicted whole past this)
         "max_spans": 20_000,
+        # per-trace span ring capacity in the head store (drop-oldest
+        # with an exact per-trace counter)
+        "max_spans_per_trace": 4096,
+        # per-process bounded span buffer (drained onto TASK_EVENTS
+        # frames / the driver's in-process flush; drop-oldest beyond)
+        "span_buffer_size": 2048,
         # default task max_retries (reference: task_retry defaults)
         "default_task_max_retries": 3,
         # freed-object release broadcast coalescing window

@@ -27,6 +27,7 @@ import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from ..util import tracing
 from . import fault
 from . import lockdep
 from . import protocol as P
@@ -301,6 +302,18 @@ class NodeDaemon:
                         pass
                     self._hb_sent_mono = time.monotonic()
                 self._send(P.NODE_PING, payload)
+                if telemetry.enabled or tracing.enabled:
+                    # Idle-drain nudge to THIS node's workers on the
+                    # same heartbeat tick (no new thread): trailing
+                    # direct-call events/spans flush without waiting
+                    # for the 256-event threshold or the next
+                    # head-bound frame.
+                    for h in list(self.pool.workers.values()):
+                        if h.alive:
+                            try:
+                                h.send(P.TELEMETRY_DRAIN, {})
+                            except Exception:  # lint: broad-except-ok dying worker pipe; WORKER_DIED owns it
+                                pass
             except Exception:
                 if int(ray_config.head_reconnect_attempts) > 0:
                     # Reconnect mode: the run() loop owns rejoining;

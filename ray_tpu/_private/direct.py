@@ -1096,18 +1096,21 @@ class DirectPlane:
     def _send_call(self, chan: _DirectChannel, spec) -> None:
         if fault.enabled:
             fault.fire("direct.call", task=spec.name)
-        if not spec.args and not spec.kwargs and not spec.streaming \
-                and spec.trace_ctx is None:
+        if not spec.args and not spec.kwargs and not spec.streaming:
             # Compact wire form for the no-arg fast path: raw id bytes
             # in a tuple pickle ~2x faster than the spec's dataclass
             # reduce (the callee rebuilds an equivalent spec). The
-            # sequencing triple rides as three tail slots.
+            # sequencing triple and the trace context ride as tail
+            # slots — traced calls keep the compact form instead of
+            # silently demoting to the full-spec pickle (the slot is
+            # None on the untraced steady state: ~1 byte).
             chan.writer.send_message(P.ACTOR_CALL, {"c": (
                 spec.task_id.binary(), spec.actor_id.binary(),
                 spec.method_name, spec.name,
                 [r.binary() for r in spec.return_ids],
                 spec.num_returns, spec.fn_id,
-                spec.caller_id, spec.caller_seq, spec.seq_preds)})
+                spec.caller_id, spec.caller_seq, spec.seq_preds,
+                spec.trace_ctx)})
             return
         chan.writer.send_message(P.ACTOR_CALL, {"spec": spec})
 
@@ -1703,13 +1706,15 @@ class DirectPlane:
         spec = payload.get("spec")
         if spec is not None:
             return spec
-        tb, ab, mn, name, rids, nr, fid, cid, cseq, preds = payload["c"]
+        tb, ab, mn, name, rids, nr, fid, cid, cseq, preds, tctx = \
+            payload["c"]
         from .ids import ActorID, ObjectID, TaskID
         return P.TaskSpec(
             task_id=TaskID(tb), fn_id=fid, fn_blob=None,
             return_ids=[ObjectID(b) for b in rids], num_returns=nr,
             name=name, actor_id=ActorID(ab), method_name=mn,
-            caller_id=cid, caller_seq=cseq, seq_preds=preds)
+            caller_id=cid, caller_seq=cseq, seq_preds=preds,
+            trace_ctx=tctx)
 
     def _on_actor_call(self, chan, payload: dict) -> None:
         """One ACTOR_CALL landed on the callee: route it through the
@@ -1738,9 +1743,11 @@ class DirectPlane:
         aspec = w._actor_spec
         if (aspec is not None and aspec.max_concurrency == 1
                 and not w._cg_executors
-                and all(s.trace_ctx is None and not s.streaming
+                and all(not s.streaming
                         and s.method_name != "__adag_exec_loop__"
                         for s in specs)):
+            # Traced calls stay on this lean path too — the batch
+            # executor adopts each spec's trace context itself.
             # The merge gate sequences stamped bursts against head-path
             # arrivals from the same caller; contiguous admissible runs
             # still ship as ONE lean executor item.

@@ -487,10 +487,6 @@ class Gcs:
         self._task_events_lock = threading.Lock()
         self.max_task_events = int(ray_config.max_task_events)
         self.telemetry = TelemetryStore(self.max_task_events)
-        # Tracing spans (reference: OpenTelemetry spans buffered per core
-        # worker, flushed to the GCS task-event store; SURVEY.md §5)
-        self._spans: List[dict] = []
-        self.max_spans = int(ray_config.max_spans)
 
     def record_task_event(self, event: dict):
         self.telemetry.record_events((event,))
@@ -500,15 +496,19 @@ class Gcs:
         self.telemetry.record_events(events, dropped,
                                      from_worker=from_worker)
 
-    def record_spans(self, spans: List[dict]):
-        with self._task_events_lock:
-            self._spans.extend(spans)
-            if len(self._spans) > self.max_spans:
-                del self._spans[: len(self._spans) // 2]
+    def record_spans(self, spans: List[dict], dropped: int = 0,
+                     node_id: Optional[str] = None,
+                     worker_id: Optional[str] = None):
+        """Tracing spans land in the telemetry store's bounded
+        per-trace rings (reference: spans aggregated beside task events
+        in the GCS task manager; SURVEY.md §5). Replaces the old
+        unbounded ``Gcs._spans`` list + blocking record_spans flush."""
+        self.telemetry.record_spans(spans, dropped=dropped,
+                                    node_id=node_id or self.node_id_hex,
+                                    worker_id=worker_id)
 
-    def spans(self) -> List[dict]:
-        with self._task_events_lock:
-            return list(self._spans)
+    def spans(self, trace_id: Optional[str] = None) -> List[dict]:
+        return self.telemetry.spans(trace_id)
 
     def task_events(self) -> List[dict]:
         return self.telemetry.events()

@@ -264,6 +264,12 @@ class SerialExecutor:
             self._q.append((fn, args))
             self._cond.notify()
 
+    def qsize(self) -> int:
+        """Queued (not yet executing) items — the head's loop-depth
+        gauge reads this at exposition time (len() is GIL-atomic on a
+        deque; no lock, no hot-path cost)."""
+        return len(self._q)
+
     def _loop(self):
         while True:
             with self._cond:
@@ -396,6 +402,11 @@ class ConnectionWriter:
             self._thread = threading.Thread(
                 target=self._loop, daemon=True, name=self._name)
             self._thread.start()
+
+    def queued_bytes(self) -> int:
+        """Bytes currently queued behind this writer (exposition-time
+        gauge; a plain int read, no lock)."""
+        return self._q_bytes
 
     # -- enqueue -------------------------------------------------------
     def send_message(self, msg_type: str, payload: dict):

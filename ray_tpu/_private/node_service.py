@@ -21,9 +21,11 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import collections
 
+from ..util import tracing
 from . import fault
 from . import lockdep
 from . import protocol as P
+from . import telemetry
 from .ids import WorkerID
 
 logger = logging.getLogger(__name__)
@@ -260,9 +262,30 @@ class HeadServer:
 
     def _heartbeat_monitor(self):
         from .config import ray_config
+        last_drain = 0.0
         while not self._stop_event.is_set():
             interval = float(ray_config.node_heartbeat_s)
             self._stop_event.wait(min(max(interval / 2, 0.05), 1.0))
+            now_mono = time.monotonic()
+            if ((telemetry.enabled or tracing.enabled)
+                    and now_mono - last_drain >= interval):
+                # Idle-drain nudge to HEAD-ATTACHED workers on the
+                # heartbeat cadence (daemons nudge their own workers
+                # from their heartbeat loop): flushes trailing
+                # direct-call events/spans with no completion frame to
+                # ride, without any new thread. The nudge is a oneway
+                # enqueue on each worker pipe — a dead pipe is the
+                # death path's problem, not this loop's.
+                last_drain = now_mono
+                try:
+                    for h in list(self._node.pool.workers.values()):
+                        if h.alive:
+                            try:
+                                h.send(P.TELEMETRY_DRAIN, {})
+                            except Exception:  # lint: broad-except-ok dying worker pipe; the death callback owns it
+                                pass
+                except Exception:  # lint: broad-except-ok pool mutating mid-teardown; the nudge is best-effort
+                    pass
             limit = float(ray_config.node_heartbeat_miss_limit)
             if limit <= 0:
                 continue
@@ -448,6 +471,11 @@ class HeadServer:
         # (task-done bookkeeping, death handling) drain off-thread in
         # arrival order (WORKER_DIED must never overtake the worker's
         # final TASK_DONE).
+        if telemetry.enabled:
+            # Daemon-plane half of the head's per-type ingest counters
+            # (relayed worker messages count again at the worker mux —
+            # the two planes are separate loops with separate budgets).
+            telemetry.count_msg(msg_type)
         if msg_type == P.FROM_WORKER:
             handle._route_exec.submit(self._route_from_worker, handle,
                                       payload)

@@ -36,6 +36,10 @@ SEQ_SETTLED = "seq_settled"      # oneway: (caller, actor) sequence slots the
                                  # head settled without delivery — callers
                                  # prune their unsettled maps, callee merge
                                  # gates release held out-of-order arrivals
+TELEMETRY_DRAIN = "tele_drain"   # oneway nudge riding the heartbeat cadence:
+                                 # flush buffered task events/spans from an
+                                 # idle worker (direct-call completions have
+                                 # no head frame to piggyback on)
 
 # Message types: worker -> driver
 REF_COUNT = "ref_count"          # oneway borrow incref/decref from a worker

@@ -1705,6 +1705,9 @@ class Node:
         """Batched worker-originated submissions: per-spec registration
         still runs in order, but the scheduler absorbs the whole run
         through submit_batch (one queue lock + one dispatch wake)."""
+        if telemetry.enabled:
+            # These bypass _on_worker_message's per-type counter.
+            telemetry.count_msg(P.SUBMIT_TASK, len(payloads))
         items = []
         for p in payloads:
             spec = p["spec"]
@@ -1756,6 +1759,15 @@ class Node:
         self.gcs.record_task_events(events,
                                     dropped=payload.get("dropped", 0),
                                     from_worker=True)
+        spans = payload.get("spans")
+        if spans or payload.get("span_drops"):
+            # Tracing spans ride the same frame; the head stamps the
+            # reporting node/worker so the per-span hot path never
+            # builds those strings (the chrome export's pid/tid keys).
+            self.gcs.record_spans(
+                spans or (), dropped=payload.get("span_drops", 0),
+                node_id=self._node_hex_of(handle),
+                worker_id=handle.worker_id.hex())
 
     # ------------------------------------------------------------------
     # cross-plane call sequencing (head side: settlement authority)
@@ -2204,6 +2216,11 @@ class Node:
 
     def _on_worker_message(self, handle: WorkerHandle, msg_type: str,
                            payload: dict):
+        if telemetry.enabled:
+            # Head self-instrumentation: per-type ingest counters (the
+            # scale harness's msgs/s signal), exported as gauges at
+            # exposition time. One dict bump per message.
+            telemetry.count_msg(msg_type)
         if msg_type == P.REF_COUNT:
             # Oneway borrow count from a worker (no reply).
             if payload["delta"] > 0:
@@ -2479,7 +2496,12 @@ class Node:
         if op == "record_spans":
             return self.gcs.record_spans(**kwargs)
         if op == "get_spans":
-            return self.gcs.spans()
+            return self.gcs.spans(kwargs.get("trace_id"))
+        if op == "get_trace":
+            from ..util.tracing import build_trace
+            return build_trace(self.gcs.spans(kwargs["trace_id"]))
+        if op == "span_dropped":
+            return self.gcs.telemetry.span_drop_counts()
         if op == "object_stats":
             return self.gcs.objects.stats()
         if op == "local_node_view":

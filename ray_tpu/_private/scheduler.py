@@ -634,6 +634,20 @@ class _RecvMux:
                                       on_batch))
         self._wake()
 
+    def backlog_bytes(self) -> int:
+        """Bytes buffered mid-frame across the mux's connections
+        (exposition-time head self-gauge; best-effort racy reads of
+        each parser's buffer length under the GIL)."""
+        total = 0
+        try:
+            for key in list(self._sel.get_map().values()):
+                state = key.data
+                if state is not None:
+                    total += len(state.parser.buf)
+        except (RuntimeError, OSError):
+            pass  # selector mutating mid-iteration: scrape-time only
+        return total
+
     def _wake(self):
         try:
             os.write(self._wr, b"x")

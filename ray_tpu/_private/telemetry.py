@@ -90,6 +90,31 @@ def instrument_ops() -> int:
 
 
 # ---------------------------------------------------------------------------
+# head self-instrumentation: per-message-type ingest counters
+# ---------------------------------------------------------------------------
+# Dict bumped on the head's recv paths (gated at the call sites);
+# exported as gauges at exposition time. A Metric.inc per message would
+# tax the exact hot path ROADMAP item 2's scale harness measures; the
+# small lock keeps concurrent recv threads (worker mux + one per
+# daemon) from losing increments of the same type.
+_msg_counts: Dict[str, int] = {}
+_msg_counts_lock = threading.Lock()
+
+
+def count_msg(msg_type: str, n: int = 1) -> None:
+    """One ingested control message (head recv muxes; callers gate)."""
+    global _ops
+    _ops += 1
+    with _msg_counts_lock:
+        _msg_counts[msg_type] = _msg_counts.get(msg_type, 0) + n
+
+
+def message_counts() -> Dict[str, int]:
+    with _msg_counts_lock:
+        return dict(_msg_counts)
+
+
+# ---------------------------------------------------------------------------
 # metric helpers (process-local util/metrics registry, lazily created so
 # a disabled process never materializes a single Metric object)
 # ---------------------------------------------------------------------------
@@ -288,22 +313,46 @@ def flush_serve_gauges() -> None:
                         float(ongoing[d]), tags={"deployment": d})
 
 
+# Per-deployment histogram HANDLES, resolved once and cached: the
+# per-request path pays a dict probe + a sharded-bin observe instead of
+# the full tag merge/validate/sort + single-lock observe (profiled on
+# the serve bench: the two per-request latency histograms were the bulk
+# of the remaining telemetry-on gap, docs/OBSERVABILITY.md).
+_serve_hist_handles: Dict[Tuple[str, str], Any] = {}
+_clear_hook_installed = False
+
+
+def _serve_handle(name: str, desc: str, deployment: str):
+    h = _serve_hist_handles.get((name, deployment))
+    if h is None:
+        global _clear_hook_installed
+        from ..util import metrics as M
+        if not _clear_hook_installed:
+            # clear_registry() must invalidate this cache too, or the
+            # handles keep feeding orphaned unregistered metrics.
+            _clear_hook_installed = True
+            M.on_clear_registry(_serve_hist_handles.clear)
+        h = _metric(name, "histogram", desc,
+                    tag_keys=("deployment",)).handle(
+                        {"deployment": deployment})
+        _serve_hist_handles[(name, deployment)] = h
+    return h
+
+
 def serve_request(deployment: str, dt: float) -> None:
     global _ops
     _ops += 1
-    _metric("serve_request_latency_s", "histogram",
-            "End-to-end proxy request latency per deployment",
-            tag_keys=("deployment",)).observe(
-                max(dt, 1e-9), tags={"deployment": deployment})
+    _serve_handle("serve_request_latency_s",
+                  "End-to-end proxy request latency per deployment",
+                  deployment).observe(max(dt, 1e-9))
 
 
 def serve_replica_request(deployment: str, dt: float) -> None:
     global _ops
     _ops += 1
-    _metric("serve_replica_latency_s", "histogram",
-            "Replica-side request handling latency per deployment",
-            tag_keys=("deployment",)).observe(
-                max(dt, 1e-9), tags={"deployment": deployment})
+    _serve_handle("serve_replica_latency_s",
+                  "Replica-side request handling latency per deployment",
+                  deployment).observe(max(dt, 1e-9))
 
 
 def serve_replica_ongoing(deployment: str, n: int) -> None:
@@ -367,17 +416,37 @@ class TelemetryStore:
     per-job ring buffers, gcs_task_manager.cc; the dashboard's metrics
     federation)."""
 
-    def __init__(self, max_events_per_job: int = 10_000):
+    def __init__(self, max_events_per_job: int = 10_000,
+                 max_spans_total: Optional[int] = None,
+                 max_spans_per_trace: Optional[int] = None):
+        from .config import ray_config
         self.max_events_per_job = max(1, int(max_events_per_job))
+        self.max_spans_total = int(
+            max_spans_total if max_spans_total is not None
+            else ray_config.max_spans)
+        self.max_spans_per_trace = max(1, int(
+            max_spans_per_trace if max_spans_per_trace is not None
+            else ray_config.max_spans_per_trace))
         self._lock = threading.Lock()
         self._rings: Dict[str, collections.deque] = {}
         self._dropped: Dict[str, int] = {}
         # ("node"|"worker", key_hex) -> snapshot dict
         self._metrics: Dict[Tuple[str, str], dict] = {}
+        # Tracing spans: bounded per-trace rings, LRU-ordered so the
+        # global cap evicts the coldest trace whole (reference: the GCS
+        # task manager's bounded per-job rings, applied to spans).
+        self._traces: "collections.OrderedDict[str, collections.deque]" \
+            = collections.OrderedDict()
+        self._span_total = 0
+        self._span_dropped: Dict[str, int] = {}
         # Exact counts for the drop/ingest accounting tests + /metrics.
         self.events_ingested = 0
         self.events_ingested_from_workers = 0
         self.worker_reported_dropped = 0
+        self.spans_ingested = 0
+        self.worker_reported_span_dropped = 0
+        self.traces_evicted = 0
+        self.spans_evicted = 0
 
     # -- task events ---------------------------------------------------
     def record_events(self, events, dropped: int = 0,
@@ -414,6 +483,68 @@ class TelemetryStore:
         with self._lock:
             out = dict(self._dropped)
         out["_worker_buffers"] = self.worker_reported_dropped
+        return out
+
+    # -- tracing spans -------------------------------------------------
+    def record_spans(self, spans, dropped: int = 0,
+                     node_id: Optional[str] = None,
+                     worker_id: Optional[str] = None) -> None:
+        """Ingest a drained span batch into bounded per-trace rings.
+        ``node_id``/``worker_id`` stamp spans that don't carry them (the
+        head knows the reporting connection; the worker hot path never
+        builds those strings per span). Drop-oldest per trace with an
+        exact counter; past the global cap the LRU trace evicts whole."""
+        with self._lock:
+            for s in spans:
+                if not isinstance(s, dict):
+                    continue
+                if node_id and not s.get("node_id"):
+                    s["node_id"] = node_id
+                if worker_id and not s.get("worker_id"):
+                    s["worker_id"] = worker_id
+                t = s.get("trace_id") or "_untraced"
+                ring = self._traces.get(t)
+                if ring is None:
+                    ring = self._traces[t] = collections.deque()
+                self._traces.move_to_end(t)
+                if len(ring) >= self.max_spans_per_trace:
+                    ring.popleft()
+                    self._span_dropped[t] = \
+                        self._span_dropped.get(t, 0) + 1
+                else:
+                    self._span_total += 1
+                ring.append(s)
+                self.spans_ingested += 1
+            while (self._span_total > self.max_spans_total
+                   and len(self._traces) > 1):
+                _t, old = self._traces.popitem(last=False)
+                self._span_total -= len(old)
+                # Exact span-unit accounting survives the eviction: the
+                # evicted trace's resident spans AND its earlier ring
+                # drops fold into the evicted-span counter.
+                self.spans_evicted += len(old) + \
+                    self._span_dropped.pop(_t, 0)
+                self.traces_evicted += 1
+            if dropped:
+                self.worker_reported_span_dropped += int(dropped)
+
+    def spans(self, trace_id: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            if trace_id is not None:
+                return list(self._traces.get(trace_id, ()))
+            rings = [list(r) for r in self._traces.values()]
+        out = [s for ring in rings for s in ring]
+        out.sort(key=lambda s: s.get("start") or 0.0)
+        return out
+
+    def span_drop_counts(self) -> Dict[str, int]:
+        """Span-unit drop counts (per live trace ring, worker buffers,
+        evicted traces) — every value is a number of SPANS, so the
+        summed gauge stays exact across whole-trace evictions."""
+        with self._lock:
+            out = dict(self._span_dropped)
+        out["_worker_buffers"] = self.worker_reported_span_dropped
+        out["_evicted"] = self.spans_evicted
         return out
 
     # -- metrics snapshots ---------------------------------------------
@@ -516,8 +647,67 @@ def _refresh_head_gauges(node) -> None:
         _metric("task_events_dropped", "gauge",
                 "Task events dropped across rings and worker buffers"
                 ).set(sum(tstore.dropped_counts().values()))
+        if tstore.spans_ingested:
+            _metric("trace_spans_ingested_total_gauge", "gauge",
+                    "Tracing spans aggregated on the head"
+                    ).set(tstore.spans_ingested)
+            _metric("trace_spans_dropped", "gauge",
+                    "Spans dropped across trace rings and process buffers"
+                    ).set(sum(tstore.span_drop_counts().values()))
     except Exception:  # lint: broad-except-ok scrape-time gauge on a live runtime mid-teardown; exposition must not 500
         logger.debug("task-event gauge refresh failed", exc_info=True)
+    _refresh_head_self_gauges(node)
+
+
+def _refresh_head_self_gauges(node) -> None:
+    """Head SELF-instrumentation, read point-in-time at exposition
+    (the measurement contract for ROADMAP item 2's virtual-scale
+    harness): per-message-type ingest counters, routing-loop queue
+    depths, handler-pool utilization, outbound writer queue bytes.
+    Everything here reads live structures at scrape time — the only
+    hot-path cost is the per-frame count_msg/count_msgs bump."""
+    if _msg_counts:
+        m = _metric("head_ingest_messages", "gauge",
+                    "Control messages ingested by the head since "
+                    "start, by type", tag_keys=("msg_type",))
+        for t, n in list(_msg_counts.items()):
+            m.set(float(n), tags={"msg_type": t})
+    writer_bytes = 0
+    try:
+        depth_m = _metric("head_loop_queue_depth", "gauge",
+                          "Queued messages per head routing loop",
+                          tag_keys=("loop",))
+        for d in node.head_server.all_daemons():
+            depth_m.set(float(d._route_exec.qsize()),
+                        tags={"loop": f"daemon-route-"
+                              f"{d.node_id_hex[:8]}"})
+            writer_bytes += int(d._writer.queued_bytes())
+    except Exception:  # lint: broad-except-ok daemons may tear down mid-scrape; exposition must not 500
+        logger.debug("loop-depth gauge refresh failed", exc_info=True)
+    try:
+        mux = getattr(node.pool, "_mux", None)
+        backlog = getattr(mux, "backlog_bytes", None)
+        if backlog is not None:
+            _metric("head_recv_mux_backlog_bytes", "gauge",
+                    "Bytes buffered mid-frame in the worker recv mux"
+                    ).set(float(backlog()))
+    except Exception:  # lint: broad-except-ok mux may be native/absent; exposition must not 500
+        logger.debug("recv-mux gauge refresh failed", exc_info=True)
+    try:
+        pool = node._handler_pool
+        _metric("head_handler_pool_queue_depth", "gauge",
+                "Blocking-request items queued for the handler pool"
+                ).set(float(pool._work_queue.qsize()))
+        nthreads = len(pool._threads)
+        idle = getattr(pool._idle_semaphore, "_value", 0)
+        _metric("head_handler_pool_active", "gauge",
+                "Handler-pool threads currently executing a request"
+                ).set(float(max(0, nthreads - idle)))
+    except Exception:  # lint: broad-except-ok stdlib executor internals; exposition must not 500
+        logger.debug("handler-pool gauge refresh failed", exc_info=True)
+    _metric("head_writer_queue_bytes", "gauge",
+            "Bytes queued on the head's outbound connection writers"
+            ).set(float(writer_bytes))
 
 
 def federated_prometheus_text(node) -> str:

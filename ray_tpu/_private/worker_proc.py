@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional
 import cloudpickle
 
 from ..exceptions import TaskCancelledError, TaskError
+from ..util import tracing
 from . import fault
 from . import lockdep
 from . import protocol as P
@@ -669,27 +670,37 @@ class Worker:
                     and not self.store.contains(oid)):
                 # Object lives on another node: ask our node (daemon or
                 # head) to localize it before the shm read (reference:
-                # raylet-mediated plasma fetch via PullManager).
-                res = self.client._request(P.PULL_OBJECT,
-                                           {"object_id": oid,
-                                            "node": loc[2]})
-                adopt = (res.get("adopt")
-                         if isinstance(res, dict) else None)
-                if adopt is not None and hasattr(self.store,
-                                                 "adopt_native"):
-                    # The node holds it zero-copy in ANOTHER node's
-                    # arena: map the same slot (unpinned — the node's
-                    # pin + the owner's task-arg refs cover the read).
-                    try:
-                        self.store.adopt_native(oid, *adopt, pin=False)
-                    except Exception:
-                        # Mapping unusable in THIS process (owner's
-                        # arena vanished or unreadable): have the node
-                        # materialize a real local copy instead.
-                        self.client._request(P.PULL_OBJECT,
-                                             {"object_id": oid,
-                                              "node": loc[2],
-                                              "materialize": True})
+                # raylet-mediated plasma fetch via PullManager). Pull
+                # waits join the trace tree — the slow half of a traced
+                # task is usually this fetch, not the compute — and the
+                # span cm itself records a failed fetch as failed.
+                import contextlib
+                cm = tracing.span(  # lint: ungated-instrumentation-ok gated by is_enabled (adopted-context gate; only traced tasks reach it)
+                    "pull", object_id=oid.hex(), source=loc[2][:8]) \
+                    if tracing.is_enabled() else contextlib.nullcontext()
+                with cm:
+                    res = self.client._request(P.PULL_OBJECT,
+                                               {"object_id": oid,
+                                                "node": loc[2]})
+                    adopt = (res.get("adopt")
+                             if isinstance(res, dict) else None)
+                    if adopt is not None and hasattr(self.store,
+                                                     "adopt_native"):
+                        # The node holds it zero-copy in ANOTHER node's
+                        # arena: map the same slot (unpinned — the
+                        # node's pin + the owner's task-arg refs cover
+                        # the read).
+                        try:
+                            self.store.adopt_native(oid, *adopt,
+                                                    pin=False)
+                        except Exception:
+                            # Mapping unusable in THIS process (owner's
+                            # arena vanished or unreadable): have the
+                            # node materialize a real local copy.
+                            self.client._request(P.PULL_OBJECT,
+                                                 {"object_id": oid,
+                                                  "node": loc[2],
+                                                  "materialize": True})
             value = self.store.get(oid)
         elif kind == P.LOC_ERROR:
             raise serialization.deserialize(loc[1])
@@ -795,29 +806,38 @@ class Worker:
         self._task_events.record(**ev)
 
     def _flush_telemetry(self):
-        """Drain buffered events (+ a throttled metrics snapshot) onto
-        the writer queue. Called right before a completion send, so the
-        frames coalesce into the SAME vectored write — the piggyback
-        that makes enabled-mode flushing syscall-free. Failures never
-        break completion delivery."""
+        """Drain buffered events AND tracing spans (+ a throttled
+        metrics snapshot) onto the writer queue. Called right before a
+        completion send, so the frames coalesce into the SAME vectored
+        write — the piggyback that makes enabled-mode flushing
+        syscall-free; spans ride the TASK_EVENTS frame instead of the
+        old blocking record_spans round trip. Failures never break
+        completion delivery."""
         try:
             events, dropped = self._task_events.drain()
             sub = self.direct.drain_submitted() if self._direct_on \
                 else []
-            if events or dropped or sub:
+            spans, sdropped = tracing.drain_spans() \
+                if (tracing._buffer or tracing._dropped) else ([], 0)
+            if events or dropped or sub or spans or sdropped:
                 payload = {"events": events, "dropped": dropped}
                 if sub:
                     # Raw SUBMITTED tuples for stamped direct calls;
                     # the head converts at ingest.
                     payload["sub"] = sub
+                if spans or sdropped:
+                    payload["spans"] = spans
+                    payload["span_drops"] = sdropped
                 self.send(P.TASK_EVENTS, payload)
+            if not telemetry.enabled:
+                return  # tracing-only flush: no metrics machinery
             from .config import ray_config
             now = time.monotonic()
             if (now - self._metrics_last_push
                     >= float(ray_config.worker_metrics_push_interval_s)):
                 self._metrics_last_push = now
                 from ..util import metrics as M
-                telemetry.flush_serve_gauges()  # lint: ungated-instrumentation-ok _flush_telemetry is only reached from telemetry.enabled-gated call sites
+                telemetry.flush_serve_gauges()  # lint: ungated-instrumentation-ok the telemetry.enabled early return above gates this
                 groups = M.registry_samples()
                 if groups:
                     self.send(P.METRICS_PUSH, {
@@ -852,21 +872,28 @@ class Worker:
             self.direct.flush_accounting()
         if direct_chan is not None:
             # Direct completions don't touch the head, so the telemetry
-            # piggyback has no frame to ride — flush event batches on a
-            # size threshold instead of per completion (the drop-oldest
-            # buffer bound still holds; state-API freshness for direct
-            # calls trails by up to one batch).
-            if telemetry.enabled and (
+            # piggyback has no frame to ride — flush event/span batches
+            # on a size threshold instead of per completion (the
+            # drop-oldest buffer bounds still hold; freshness for idle
+            # workers comes from the TELEMETRY_DRAIN heartbeat nudge).
+            # ADOPTED-context spans (process tracing flag off — e.g. a
+            # traceparent request on an otherwise untraced cluster)
+            # flush per completion instead: no head/daemon sends the
+            # nudge when its own flags are off, and such spans are
+            # per-traced-request rare.
+            nspans = len(tracing._buffer)
+            if (telemetry.enabled and (
                     len(self._task_events)
-                    + len(self.direct._sub_evts) >= 256
-                    or self._task_events.dropped):
+                    + len(self.direct._sub_evts) + nspans >= 256
+                    or self._task_events.dropped)) or nspans >= 256 \
+                    or (nspans and not tracing.enabled):
                 self._flush_telemetry()
             self.direct.send_result(direct_chan, payload)
             return
         # Head path: the head resolves the spec from its own running
         # table — shipping it would just fatten the TASK_DONE frame.
         payload.pop("spec", None)
-        if telemetry.enabled:
+        if telemetry.enabled or tracing._buffer:
             self._flush_telemetry()
         with self._done_lock:
             self._done_buf.append(payload)
@@ -930,27 +957,9 @@ class Worker:
             run_ts = time.time()
             self._record_task_event(spec, "RUNNING", run_ts)
         ctx_token = _task_ctx_var.set(spec)
-        trace_token = None
-        exec_span = None
+        trace_token = exec_span = None
         if spec.trace_ctx:
-            # Adopt the caller's span context so spans opened by user
-            # code (and nested submissions) join the distributed trace
-            # (reference: context extracted from the task spec,
-            # tracing_helper.py). Tracing failures must never fail the
-            # task itself.
-            try:
-                from ..util import tracing
-                if tracing._flush_fn is None:
-                    tracing._flush_fn = \
-                        lambda spans: self.client.gcs_request(
-                            "record_spans", spans=spans)
-                trace_token = tracing.activate_context(spec.trace_ctx)
-                exec_span = tracing.span(
-                    f"task:{spec.name}", task_id=spec.task_id.hex(),
-                    worker_id=self.config.worker_id.hex())
-                exec_span.__enter__()
-            except Exception:
-                trace_token, exec_span = None, None
+            trace_token, exec_span = self._trace_enter(spec)
         try:
             if fault.enabled:
                 # raise => the task fails (retry_exceptions path);
@@ -986,6 +995,11 @@ class Worker:
                 if telemetry.enabled:
                     self._record_task_event(spec, "FINISHED", time.time(),
                                             start_ts=run_ts)
+                # Close the span BEFORE the completion send so it rides
+                # the same TASK_EVENTS piggyback as the FINISHED event.
+                if exec_span is not None:
+                    trace_token = self._trace_exit(trace_token, exec_span)
+                    exec_span = None
                 self._emit_done({
                     "task_id": spec.task_id, "results": [], "error": None,
                     "streamed": n_items, "actor_id": spec.actor_id},
@@ -995,6 +1009,9 @@ class Worker:
                 if telemetry.enabled:
                     self._record_task_event(spec, "FINISHED", time.time(),
                                             start_ts=run_ts)
+                if exec_span is not None:
+                    trace_token = self._trace_exit(trace_token, exec_span)
+                    exec_span = None
                 self._emit_done({
                     "task_id": spec.task_id, "results": locs,
                     "error": None, "nested": nested,
@@ -1009,12 +1026,8 @@ class Worker:
         except BaseException as e:  # noqa: BLE001 — all errors ship to owner
             if exec_span is not None:
                 # Close the span WITH the failure so traces show failed
-                # tasks as failed (contextmanager __exit__ re-raising the
-                # same exception returns False, no propagation).
-                try:
-                    exec_span.__exit__(type(e), e, e.__traceback__)
-                except BaseException:
-                    pass
+                # tasks as failed.
+                trace_token = self._trace_exit(trace_token, exec_span, e)
                 exec_span = None
             if isinstance(e, TaskCancelledError):
                 err = e
@@ -1034,18 +1047,45 @@ class Worker:
                 "actor_id": spec.actor_id,
                 "return_oids": list(spec.return_ids)}, direct_chan)
         finally:
-            if trace_token is not None:
-                from ..util import tracing
-                try:
-                    if exec_span is not None:
-                        exec_span.__exit__(None, None, None)
-                    tracing.deactivate_context(trace_token)
-                    tracing.flush()
-                except Exception:
-                    pass
+            if exec_span is not None or trace_token is not None:
+                self._trace_exit(trace_token, exec_span)
             _task_ctx_var.reset(ctx_token)
             with self._running_lock:
                 self._running.pop(tid, None)
+
+    def _trace_enter(self, spec: P.TaskSpec):
+        """Adopt the caller's propagated span context and open the
+        execution span — shared by BOTH call planes (reference: context
+        extracted from the task spec, tracing_helper.py). Tracing
+        failures must never fail the task; returns (token, span_cm) or
+        (None, None)."""
+        try:
+            token = tracing.activate_context(spec.trace_ctx)  # lint: ungated-instrumentation-ok gated by the spec.trace_ctx check at every call site
+            cm = tracing.span(  # lint: ungated-instrumentation-ok same spec.trace_ctx gate
+                f"task:{spec.name}", task_id=spec.task_id.hex(),
+                worker_id=self.config.worker_id.hex())
+            cm.__enter__()
+            return token, cm
+        except Exception:
+            return None, None
+
+    def _trace_exit(self, token, cm, exc: Optional[BaseException] = None):
+        """Close the execution span (with the failure, when there was
+        one — traces show failed tasks as failed) and drop the adopted
+        context. Returns None so callers can clear their token."""
+        try:
+            if cm is not None:
+                if exc is not None:
+                    cm.__exit__(type(exc), exc, exc.__traceback__)
+                else:
+                    cm.__exit__(None, None, None)
+        except BaseException:  # lint: broad-except-ok tracing must never fail the task; the span is simply lost
+            pass
+        try:
+            tracing.deactivate_context(token)
+        except Exception:  # lint: broad-except-ok same contract: context cleanup is best-effort
+            pass
+        return None
 
     def _execute_direct_batch(self, chan, specs: List[P.TaskSpec]):
         """Lean exec loop for a burst of direct actor calls on a
@@ -1060,6 +1100,12 @@ class Worker:
                 run_ts = time.time()
                 self._record_task_event(spec, "RUNNING", run_ts)
             ctx_token = _task_ctx_var.set(spec)
+            trace_token = exec_span = None
+            if spec.trace_ctx:
+                # Traced calls keep the lean batch path: adopting the
+                # context + opening the exec span is the only extra
+                # work, and only for specs that actually carry one.
+                trace_token, exec_span = self._trace_enter(spec)
             try:
                 if fault.enabled:
                     fault.fire("worker.exec", task=spec.name)
@@ -1074,6 +1120,9 @@ class Worker:
                 if telemetry.enabled:
                     self._record_task_event(spec, "FINISHED", time.time(),
                                             start_ts=run_ts)
+                if exec_span is not None:
+                    trace_token = self._trace_exit(trace_token, exec_span)
+                    exec_span = None
                 payload = {"task_id": spec.task_id, "results": locs,
                            "error": None, "nested": nested,
                            "actor_id": spec.actor_id,
@@ -1090,10 +1139,16 @@ class Worker:
                 if telemetry.enabled:
                     self._record_task_event(spec, "FAILED", time.time(),
                                             start_ts=run_ts)
+                if exec_span is not None:
+                    trace_token = self._trace_exit(trace_token,
+                                                   exec_span, e)
+                    exec_span = None
                 payload = {"task_id": spec.task_id, "results": None,
                            "error": blob, "actor_id": spec.actor_id,
                            "return_oids": list(spec.return_ids)}
             finally:
+                if exec_span is not None or trace_token is not None:
+                    self._trace_exit(trace_token, exec_span)
                 _task_ctx_var.reset(ctx_token)
             self._emit_done(payload, chan)
 
@@ -1324,6 +1379,16 @@ class Worker:
             # Head settled sequence slots without delivery: prune the
             # caller-side unsettled map and release merge-gate holds.
             self.direct.on_seq_settled(payload)
+        elif msg_type == P.TELEMETRY_DRAIN:
+            # Idle-drain nudge riding the heartbeat cadence: direct-call
+            # completions have no head frame to piggyback on, so an idle
+            # callee's trailing FINISHED events/spans flush here instead
+            # of waiting for the 256-event threshold (closes the
+            # PR 6 residual deviation in docs/PERF.md).
+            if (len(self._task_events) or self._task_events.dropped
+                    or tracing._buffer or tracing._dropped
+                    or (self._direct_on and self.direct._sub_evts)):
+                self._flush_telemetry()
         elif msg_type == P.SHUTDOWN:
             return True
         else:

@@ -879,6 +879,12 @@ def put(value: Any) -> ObjectRef:
     if not state.is_initialized():
         init(ignore_reinit_error=True)
     rt = state.current()
+    tr = _tracing()
+    if tr is not None and tr.is_enabled():
+        # Object spans join the trace tree (reference: tracing_helper
+        # wraps put/get the same way it wraps submission).
+        with tr.span("put"):
+            return ObjectRef(rt.put(value))
     return ObjectRef(rt.put(value))
 
 

@@ -32,7 +32,6 @@ PASS = "gate-discipline"
 RULE_UNGATED = "ungated-instrumentation"
 
 FAULT_FILE = "_private/fault.py"
-TELEMETRY_FILE = "_private/telemetry.py"
 
 _METRIC_CTORS = {"Counter": "counter", "Gauge": "gauge",
                  "Histogram": "histogram"}
@@ -121,8 +120,13 @@ def run(tree: LintTree) -> List[Violation]:
     out: List[Violation] = []
     fault_sf = tree.get(FAULT_FILE)
     sites = parse_fault_sites(fault_sf) if fault_sf else set()
-    telemetry_sf = tree.get(TELEMETRY_FILE)
-    helpers = parse_gated_helpers(telemetry_sf) if telemetry_sf else set()
+    # Per-module helper sets parsed from each plane's impl file (the
+    # `_ops`-bumping functions — exactly the ones that must be gated).
+    module_helpers: Dict[str, Set[str]] = {}
+    for module, relpath in registry.GATED_HELPER_FILES.items():
+        sf = tree.get(relpath)
+        if sf is not None:
+            module_helpers[module] = parse_gated_helpers(sf)
 
     metric_defs: Dict[str, List[Tuple[str, int, str]]] = {}
 
@@ -163,20 +167,21 @@ def run(tree: LintTree) -> List[Violation]:
                             scope=sf.scope_of(node),
                             key="ungated:fault.fire"))
 
-            # -- telemetry helper gating -------------------------------
-            helper = _plane_call(node, "telemetry", helpers) \
-                if helpers else None
-            if helper and not impl_file \
-                    and not _is_gated(sf, node, "telemetry") \
-                    and not sf.suppressed(RULE_UNGATED, node.lineno):
-                out.append(Violation(
-                    PASS, sf.relpath, node.lineno,
-                    f"telemetry.{helper}() outside an "
-                    f"`if telemetry.enabled` guard (annotate "
-                    f"`# lint: {RULE_UNGATED}-ok <why>` when gated "
-                    f"indirectly)",
-                    scope=sf.scope_of(node),
-                    key=f"ungated:telemetry.{helper}"))
+            # -- gated-plane helper gating (telemetry, tracing) --------
+            for module, helpers in module_helpers.items():
+                helper = _plane_call(node, module, helpers) \
+                    if helpers else None
+                if helper and not impl_file \
+                        and not _is_gated(sf, node, module) \
+                        and not sf.suppressed(RULE_UNGATED, node.lineno):
+                    out.append(Violation(
+                        PASS, sf.relpath, node.lineno,
+                        f"{module}.{helper}() outside an "
+                        f"`if {module}.enabled` guard (annotate "
+                        f"`# lint: {RULE_UNGATED}-ok <why>` when gated "
+                        f"indirectly)",
+                        scope=sf.scope_of(node),
+                        key=f"ungated:{module}.{helper}"))
 
             # -- metric definitions ------------------------------------
             kind = None

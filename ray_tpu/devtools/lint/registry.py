@@ -178,10 +178,21 @@ BLOCKING_MODULES = frozenset({"subprocess", "shutil"})
 # ---------------------------------------------------------------------------
 # Module aliases whose `.enabled` truthiness is THE gate; instrumentation
 # helper calls must sit under an `if <alias>.enabled` (any depth).
-GATED_MODULES = ("telemetry", "fault")
+# "tracing" joined in PR 7: span-recording hot-path sites must sit under
+# the tracing gate (or annotate the indirect gate — e.g. the
+# spec.trace_ctx check on the execution paths, the is_enabled()
+# adopted-context check on pull spans).
+GATED_MODULES = ("telemetry", "fault", "tracing")
 # Files that implement the planes themselves (helpers live here; their
 # internal calls are exempt from the gating requirement).
-GATE_IMPL_FILES = ("_private/telemetry.py", "_private/fault.py")
+GATE_IMPL_FILES = ("_private/telemetry.py", "_private/fault.py",
+                   "util/tracing.py")
+# Where each gated module's ``_ops``-bumping helpers are parsed from
+# (the functions that MUST be gated at call sites).
+GATED_HELPER_FILES = {
+    "telemetry": "_private/telemetry.py",
+    "tracing": "util/tracing.py",
+}
 
 # ---------------------------------------------------------------------------
 # broad-except: scope — only the runtime core is held to the standard.

@@ -175,6 +175,37 @@ def cmd_timeline(args):
     return 0
 
 
+def cmd_trace(args):
+    """Print one trace's cross-node span tree + critical path, or
+    export the span-merged chrome trace with --chrome (pid=node,
+    tid=worker — the `ray_tpu timeline` layout plus spans)."""
+    call = _backend(args)
+    if args.chrome:
+        events = call("export_chrome_trace",
+                      trace_id=args.trace_id or None)
+        out = args.output or f"trace_{int(time.time())}.json"
+        with open(out, "w") as f:
+            json.dump(events, f)
+        print(f"wrote span-merged Chrome trace to {out} "
+              f"(open in ui.perfetto.dev)")
+        return 0
+    if not args.trace_id:
+        print("error: trace <trace_id> (32-hex, from a span / the "
+              "serve traceparent response header), or --chrome for "
+              "the merged timeline export")
+        return 1
+    trace = call("get_trace", args.trace_id)
+    if not trace.get("span_count"):
+        print(f"no spans recorded for trace {args.trace_id}")
+        return 1
+    if args.json:
+        print(json.dumps(trace, indent=2, default=str))
+        return 0
+    from ray_tpu.util.tracing import format_trace
+    print(format_trace(trace))
+    return 0
+
+
 def cmd_job(args):
     call = _backend(args)
     if args.job_cmd == "submit":
@@ -351,6 +382,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output", default=None)
     add_address(sp)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("trace", help="print one trace's cross-node "
+                        "span tree (+ critical path), or --chrome for "
+                        "the span-merged timeline export")
+    sp.add_argument("trace_id", nargs="?", default=None)
+    sp.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the tree rendering")
+    sp.add_argument("--chrome", action="store_true",
+                    help="write the span-merged Chrome trace JSON")
+    sp.add_argument("-o", "--output", default=None)
+    add_address(sp)
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("job", help="job submission")
     add_address(sp)

@@ -18,6 +18,7 @@ import time
 from typing import Dict, Optional
 
 from ray_tpu._private import telemetry
+from ray_tpu.util import tracing
 from .long_poll import LongPollClient
 
 
@@ -102,6 +103,15 @@ class _ProxyState:
 
     def stop(self):
         self._long_poll.stop()
+
+
+def _in_executor(loop, fn):
+    """run_in_executor carrying the caller's contextvars: the active
+    trace span must reach the submit path (stdlib run_in_executor does
+    not propagate context by itself)."""
+    import contextvars
+    ctx = contextvars.copy_context()
+    return loop.run_in_executor(None, lambda: ctx.run(fn))
 
 
 def _to_web_response(result):
@@ -196,7 +206,38 @@ class HTTPProxy:
         self._started.set()
 
     async def _handle(self, request):
-        """Instrumented entry: request-latency histogram + in-flight
+        """Tracing entry: when tracing is on (or the client sent a W3C
+        ``traceparent``), the request runs under a ``serve.request``
+        span whose context the replica dispatch inherits through the
+        task spec — proxy → replica → nested-task spans form ONE tree —
+        and the response echoes the span's ``traceparent`` back
+        (reference: the reference proxy's OTel middleware). One module
+        attr + one header probe when tracing is off."""
+        tp = request.headers.get("traceparent")
+        if not tracing.enabled and tp is None:
+            return await self._handle_instrumented(request)
+        token = None
+        try:
+            ctx = tracing.parse_traceparent(tp)
+            token = tracing.activate_context(ctx)  # lint: ungated-instrumentation-ok gated by the tracing.enabled-or-traceparent check above
+            cur = None
+            with tracing.span("serve.request", method=request.method,  # lint: ungated-instrumentation-ok same gate
+                              path=request.path):
+                cur = tracing.current_context()
+                resp = await self._handle_instrumented(request)
+            if cur is not None:
+                try:
+                    resp.headers["traceparent"] = \
+                        tracing.format_traceparent(
+                            cur["trace_id"], cur["parent_span_id"])
+                except Exception:
+                    pass  # prepared/streaming response: headers sent
+            return resp
+        finally:
+            tracing.deactivate_context(token)
+
+    async def _handle_instrumented(self, request):
+        """Telemetry entry: request-latency histogram + in-flight
         gauge per deployment from the telemetry plane (reference:
         serve_num_http_requests / processing-latency metrics on the
         proxy). One falsy-flag check when telemetry is off; the route
@@ -300,8 +341,8 @@ class HTTPProxy:
                     # callback-based either way.
                     resp = handle._remote_fast(req)
                     if resp is None:
-                        resp = await loop.run_in_executor(
-                            None, lambda: handle.remote(req))
+                        resp = await _in_executor(
+                            loop, lambda: handle.remote(req))
                     result = await resp
                     # ALWAYS refresh from the response (not just when
                     # unknown): a same-name redeploy swapping the
@@ -329,8 +370,8 @@ class HTTPProxy:
                     return web.json_response({"error": str(e)},
                                              status=500)
         try:
-            rg = await loop.run_in_executor(
-                None, lambda: handle.options(stream=True).remote(req))
+            rg = await _in_executor(
+                loop, lambda: handle.options(stream=True).remote(req))
             # is_stream blocks on the first generator item; keep the
             # event loop free.
             is_stream = await loop.run_in_executor(

@@ -35,6 +35,10 @@ def registry() -> Dict[str, Callable[..., Any]]:
         "summarize_objects": state.summarize_objects,
         "timeline": lambda: state.timeline(filename=None),
         "cluster_metrics": _cluster_metrics,
+        # Tracing consumers (PR 7): cross-node trace tree + the merged
+        # chrome export, served from the head's span store.
+        "get_trace": _get_trace,
+        "export_chrome_trace": _export_chrome_trace,
         "job_submit": lambda **kw: job_client().submit_job(**kw),
         "job_status": lambda job_id: job_client().get_job_status(job_id),
         "job_logs": lambda job_id: job_client().get_job_logs(job_id),
@@ -46,6 +50,16 @@ def registry() -> Dict[str, Callable[..., Any]]:
         "serve_status": _serve_status,
         "serve_shutdown": _serve_shutdown,
     }
+
+
+def _get_trace(trace_id: str) -> dict:
+    from ray_tpu.util import tracing
+    return tracing.get_trace(trace_id)
+
+
+def _export_chrome_trace(trace_id=None) -> list:
+    from ray_tpu.util import tracing
+    return tracing.export_chrome_trace(filename=None, trace_id=trace_id)
 
 
 def _cluster_metrics() -> str:

@@ -84,6 +84,55 @@ class Gauge(Metric):
             self._values[key] = float(value)
 
 
+class _HistogramHandle:
+    """Precomputed tag handle of one Histogram series: the per-request
+    hot path skips the tag-merge/validate/sort of `observe()` and bins
+    with a bisect into per-shard counters (shard picked by thread id,
+    so concurrent request threads never contend on one lock). Shards
+    merge at sample time — the exposition output is identical to the
+    classic path."""
+
+    __slots__ = ("_bounds", "_shards", "_locks")
+
+    _N_SHARDS = 4
+
+    def __init__(self, bounds: List[float]):
+        self._bounds = bounds
+        nb = len(bounds)
+        # shard := [bucket_0..bucket_n-1, sum, total]
+        self._shards = [[0.0] * (nb + 2) for _ in range(self._N_SHARDS)]
+        self._locks = [threading.Lock() for _ in range(self._N_SHARDS)]
+
+    def observe(self, value: float) -> None:
+        from bisect import bisect_left
+        # >> 12: on Linux CPython get_ident() is the pthread stack
+        # address, aligned well past 4 KiB — a bare modulo would map
+        # EVERY thread to shard 0 and resurrect the single-lock
+        # contention this handle exists to remove.
+        i = (threading.get_ident() >> 12) % self._N_SHARDS
+        shard = self._shards[i]
+        b = bisect_left(self._bounds, value)
+        with self._locks[i]:
+            if b < len(self._bounds):
+                shard[b] += 1
+            shard[-2] += value
+            shard[-1] += 1
+
+    def _merged_totals(self):
+        nb = len(self._bounds)
+        counts = [0.0] * nb
+        total = 0.0
+        vsum = 0.0
+        for i, shard in enumerate(self._shards):
+            with self._locks[i]:
+                snap = list(shard)
+            for j in range(nb):
+                counts[j] += snap[j]
+            vsum += snap[-2]
+            total += snap[-1]
+        return counts, vsum, total
+
+
 class Histogram(Metric):
     TYPE = "histogram"
 
@@ -100,6 +149,7 @@ class Histogram(Metric):
         self._counts: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = {}
         self._totals: Dict[Tuple, int] = {}
+        self._handles: Dict[Tuple, _HistogramHandle] = {}
 
     def observe(self, value: float, tags: Optional[Dict] = None):
         key = _tag_key(self._merged(tags))
@@ -112,22 +162,45 @@ class Histogram(Metric):
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def handle(self, tags: Optional[Dict] = None) -> _HistogramHandle:
+        """Resolve one tag combination ONCE; the returned handle's
+        `observe(value)` is the cheap per-request form (no tag dict, no
+        merge/sort, sharded bins). Cache the handle at the call site."""
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            h = self._handles.get(key)
+            if h is None:
+                h = self._handles[key] = _HistogramHandle(self._bounds)
+        return h
+
     def _samples(self):
         out = []
         with self._lock:
+            series: Dict[Tuple, Tuple[List[float], float, float]] = {}
             for key, counts in self._counts.items():
-                tags = dict(key)
-                cum = 0
-                for b, c in zip(self._bounds, counts):
-                    cum += c
-                    out.append((f"{self._name}_bucket",
-                                {**tags, "le": str(b)}, float(cum)))
+                series[key] = ([float(c) for c in counts],
+                               self._sums.get(key, 0.0),
+                               float(self._totals.get(key, 0)))
+            handles = list(self._handles.items())
+        for key, h in handles:
+            counts, vsum, total = h._merged_totals()
+            if key in series:
+                base = series[key]
+                series[key] = ([a + b for a, b in zip(base[0], counts)],
+                               base[1] + vsum, base[2] + total)
+            else:
+                series[key] = (counts, vsum, total)
+        for key, (counts, vsum, total) in series.items():
+            tags = dict(key)
+            cum = 0.0
+            for b, c in zip(self._bounds, counts):
+                cum += c
                 out.append((f"{self._name}_bucket",
-                            {**tags, "le": "+Inf"},
-                            float(self._totals[key])))
-                out.append((f"{self._name}_sum", tags, self._sums[key]))
-                out.append((f"{self._name}_count", tags,
-                            float(self._totals[key])))
+                            {**tags, "le": str(b)}, float(cum)))
+            out.append((f"{self._name}_bucket",
+                        {**tags, "le": "+Inf"}, float(total)))
+            out.append((f"{self._name}_sum", tags, vsum))
+            out.append((f"{self._name}_count", tags, float(total)))
         return out
 
 
@@ -223,9 +296,24 @@ def stop_metrics_server():
         _server = None
 
 
+# Callbacks run when the registry is cleared, so caches holding
+# per-metric handles (telemetry's serve histogram handles) drop them
+# instead of observing into orphaned, unregistered metrics forever.
+_on_clear: List = []
+
+
+def on_clear_registry(cb) -> None:
+    _on_clear.append(cb)
+
+
 def clear_registry():
     with _REG_LOCK:
         _REGISTRY.clear()
+    for cb in list(_on_clear):
+        try:
+            cb()
+        except Exception:
+            pass
 
 
 __all__ = ["Counter", "Gauge", "Histogram", "Metric", "clear_registry",

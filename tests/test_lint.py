@@ -261,6 +261,47 @@ def test_gate_discipline_polarity_branch_and_plane(tmp_path):
     assert scopes == ["inverted", "wrong_branch", "wrong_plane"]
 
 
+def test_gate_discipline_tracing_helpers(tmp_path):
+    """PR 7: tracing joined the gated planes — span-recording hot-path
+    sites must sit under `if tracing.enabled` (or annotate an indirect
+    gate like the spec.trace_ctx check), parsed from util/tracing.py's
+    `_ops`-bumping helpers exactly like telemetry's."""
+    root = _tree(tmp_path, {
+        "util/tracing.py": """\
+            enabled = False
+            _ops = 0
+
+            def span(name):
+                global _ops
+                _ops += 1
+
+            def drain_spans():
+                return [], 0
+        """,
+        "_private/stuff.py": """\
+            from ..util import tracing
+
+            def ungated():
+                tracing.span("x")
+
+            def gated():
+                if tracing.enabled:
+                    tracing.span("x")
+
+            def annotated(spec):
+                if spec.trace_ctx:
+                    tracing.span("x")  # lint: ungated-instrumentation-ok gated by spec.trace_ctx
+
+            def ungated_helper_free():
+                tracing.drain_spans()  # not an _ops helper: no gate needed
+        """,
+    })
+    vs = [v for v in _run(root, ["gate-discipline"])
+          if v.key.startswith("ungated:tracing.")]
+    assert [v.scope for v in vs] == ["ungated"]
+    assert vs[0].key == "ungated:tracing.span"
+
+
 def test_protocol_coverage_checks_every_dispatch_chain(tmp_path):
     """A silent-drop chain that is not the LAST chain in the function
     is still flagged: here the per-message loop chain drops unmatched
