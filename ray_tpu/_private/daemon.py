@@ -671,31 +671,31 @@ class NodeDaemon:
         self._stopped.set()
         try:
             self.pool.shutdown()
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         try:
             self.transfer.stop()
             self.pull_mgr.shutdown()
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         try:
             self.store.shutdown()
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         import shutil
         shutil.rmtree(self.session_dir, ignore_errors=True)
         try:
             self._route_exec.close(drain_timeout=0.5)
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         try:
             if self._writer is not None:
                 self._writer.close(flush_timeout=0.5)
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         try:
             self.conn.close()
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
 
 

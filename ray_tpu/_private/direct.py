@@ -59,6 +59,7 @@ from ..exceptions import ActorDiedError, GetTimeoutError
 from . import fault
 from . import lockdep
 from . import protocol as P
+from . import refdebug
 from . import serialization
 from . import telemetry
 
@@ -341,12 +342,16 @@ class DirectPlane:
         with self._cond:
             if ob in self._refs:
                 self._refs[ob] += delta
+                if refdebug.enabled:
+                    refdebug.absorb("direct.ref_delta", object_id, delta)
                 return
             ent = self._ref_buf.get(ob)
             if ent is None:
                 self._ref_buf[ob] = [object_id, delta]
             else:
                 ent[1] += delta
+            if refdebug.enabled:
+                refdebug.park("direct.ref_delta", object_id, delta)
             overflow = len(self._ref_buf) >= self._ref_flush_n
         if overflow:
             self.flush_accounting()
@@ -360,6 +365,7 @@ class DirectPlane:
         if not nested_lists or not any(nested_lists):
             return
         with self._cond:
+            marked = [] if refdebug.enabled else None
             for ids in nested_lists:
                 for nid in ids or ():
                     ob = nid.binary() if hasattr(nid, "binary") else nid
@@ -370,6 +376,10 @@ class DirectPlane:
                     if (self._pending.get(ob) == PENDING_DIRECT
                             or ob in self._refs):
                         self._escaped.add(ob)
+                        if marked is not None:
+                            marked.append(ob)
+            if refdebug.enabled and marked:
+                refdebug.escape(marked)
 
     def note_spec_escapes(self, spec) -> None:
         """Head-submitted spec: its ref args (and their nested ids)
@@ -404,11 +414,14 @@ class DirectPlane:
 
     def _flush_accounting_locked(self) -> None:
         """Caller holds self._cond."""
+        settled = [] if refdebug.enabled else None
         if self._done_buf:
             entries, self._done_buf = self._done_buf, []
             ship = []
             for ent in entries:
                 obs = [oid.binary() for oid in ent["oids"]]
+                if settled is not None:
+                    settled.extend(ob for ob in obs if ob in self._refs)
                 deltas = [self._refs.pop(ob, 0) for ob in obs]
                 # Escaped ids (nested into a head-bound message while
                 # locally owned) can net a ZERO local residual — the
@@ -444,6 +457,8 @@ class DirectPlane:
                     pass
         if self._ref_buf:
             buf, self._ref_buf = self._ref_buf, {}
+            if settled is not None:
+                settled.extend(buf.keys())
             items = [(oid, d) for oid, d in buf.values() if d]
             if items:
                 try:
@@ -456,6 +471,8 @@ class DirectPlane:
         # first direct call.
         n_calls, self._n_calls = self._n_calls, 0
         n_results, self._n_results = self._n_results, 0
+        if refdebug.enabled:
+            refdebug.barrier(settled or [])
         if telemetry.enabled:
             if n_calls:
                 telemetry.record_direct_calls(n_calls)
@@ -1041,6 +1058,8 @@ class DirectPlane:
                 for rid in spec.return_ids:
                     self._refs[rid.binary()] = 1
                     self._pending[rid.binary()] = PENDING_DIRECT
+                    if refdebug.enabled:
+                        refdebug.borrow("direct.submit", rid)
                 chan.inflight[tid] = spec
                 self._n_calls += 1
                 # pump_running covers the pop-then-send window: the
@@ -1242,6 +1261,14 @@ class DirectPlane:
             self._on_actor_results(chan, [payload])
         elif msg_type == P.GEN_ITEM:
             self._on_gen_items(chan, [payload])
+        elif msg_type == P.GEN_CANCEL:
+            # Caller dropped its channel-stream generator mid-iteration:
+            # stop the producing generator here (the head-routed path
+            # cancels via CANCEL_TASK; this is the channel mirror). The
+            # async-exc raise lands in the executing thread's `for item
+            # in gen:` loop; already-finished tasks are a no-op.
+            from .ids import TaskID
+            self._worker._cancel(TaskID(payload["t"]))
         else:
             # Protocol skew between two workers: never silently drop.
             logger.warning("direct channel dropping unknown message "
@@ -1359,6 +1386,8 @@ class DirectPlane:
                 ob = oid.binary()
                 self._cache_put_locked(ob, p["loc"])
                 self._refs[ob] = 1
+                if refdebug.enabled:
+                    refdebug.borrow("direct.gen_item", oid)
                 st["items"].append((oid, p["loc"],
                                     list(p.get("nested") or ())))
                 st["count"] = max(st["count"], p["i"] + 1)
@@ -1415,12 +1444,17 @@ class DirectPlane:
                 ob = oid.binary()
                 if ob in self._refs:
                     self._refs[ob] -= 1
+                    if refdebug.enabled:
+                        refdebug.absorb("direct.stream_abandoned",
+                                        oid, -1)
                 else:
                     ent2 = self._ref_buf.get(ob)
                     if ent2 is None:
                         self._ref_buf[ob] = [oid, -1]
                     else:
                         ent2[1] -= 1
+                    if refdebug.enabled:
+                        refdebug.park("direct.stream_abandoned", oid, -1)
         self._done_buf.append(ent)
         # Items escaped nothing mid-stream (they resolve locally), but
         # the head must register them promptly: a generator consumed on
@@ -1473,6 +1507,7 @@ class DirectPlane:
         releases the rest. True when the task was a channel stream."""
         tb = task_id.binary()
         drop = []
+        cancel_chan = None
         with self._cond:
             st = self._streams.get(tb)
             if st is None:
@@ -1483,6 +1518,18 @@ class DirectPlane:
                 self._streams.pop(tb, None)
             else:
                 st["abandoned"] = True
+                # Still producing: tell the callee to stop. Items
+                # already in flight when the cancel lands still arrive
+                # and are balanced at terminal registration (the
+                # abandoned-item path in _retire_stream_locked).
+                chan = self._chans.get(st["actor"].binary())
+                if isinstance(chan, _DirectChannel) and chan.alive:
+                    cancel_chan = chan
+        if cancel_chan is not None:
+            try:
+                cancel_chan.writer.send_message(P.GEN_CANCEL, {"t": tb})
+            except Exception:  # lint: broad-except-ok channel died under the cancel: reconcile terminates the stream anyway
+                pass
         for oid in drop:
             self.ref_delta(oid, -1)
         if drop:
@@ -1554,6 +1601,8 @@ class DirectPlane:
                 for rid in spec.return_ids:
                     rb = rid.binary()
                     self._escaped.discard(rb)  # head takes ownership
+                    if refdebug.enabled and rb in self._refs:
+                        refdebug.settle("direct.reconcile", rid)
                     ds.append(self._refs.pop(rb, 0))
                 deltas.append(ds)
                 if spec.streaming:
@@ -1796,14 +1845,11 @@ class DirectPlane:
             return
         except Exception:  # lint: broad-except-ok caller gone: fall through to head-accounting fallback below
             pass
-        entry = {"task_id": payload["task_id"],
-                 "actor_id": payload.get("actor_id"),
-                 "oids": list(payload.get("return_oids") or ()),
+        entry = {"oids": list(payload.get("return_oids") or ()),
                  "locs": list(payload.get("results") or ()),
                  "nested": payload.get("nested") or [],
                  "deltas": [0] * len(payload.get("return_oids") or ()),
-                 "error": payload.get("error"),
-                 "name": payload.get("name", "")}
+                 "error": payload.get("error")}
         if payload.get("error") is None and payload.get("spec") \
                 is not None and any(l and l[0] == P.LOC_SHM
                                     for l in locs or ()):

@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..exceptions import ObjectLostError
 from . import fault
 from . import protocol as P
+from . import refdebug
 from .ids import ActorID, ObjectID, TaskID, WorkerID
 
 # Object lifecycle states (reference: object directory + reference_count.h)
@@ -104,6 +105,9 @@ class ObjectDirectory:
                 e.lineage = lineage
                 e.event.clear()
                 e.refcount += incref_delta
+                if refdebug.enabled and incref_delta:
+                    refdebug.head_delta("gcs.register_submitted", oid,
+                                        incref_delta)
 
     def register_ready(self, oid: ObjectID, location: Tuple, size: int = 0,
                        lineage: Optional[P.TaskSpec] = None,
@@ -223,6 +227,8 @@ class ObjectDirectory:
         with self._lock:
             e = self._entries.setdefault(oid, ObjectEntry())
             e.refcount += 1
+        if refdebug.enabled:
+            refdebug.head_delta("gcs.incref", oid, 1)
 
     def apply_delta(self, oid: ObjectID, delta: int):
         """Apply one batched refcount delta from a worker's coalesced
@@ -235,6 +241,8 @@ class ObjectDirectory:
             with self._lock:
                 e = self._entries.setdefault(oid, ObjectEntry())
                 e.refcount += delta
+            if refdebug.enabled:
+                refdebug.head_delta("gcs.apply_delta", oid, delta)
         else:
             self.decref(oid, -delta)
 
@@ -258,12 +266,24 @@ class ObjectDirectory:
                     freed = [(oid,
                               e.location[0] if e.location else None)]
                     nested = e.nested_ids
+        if refdebug.enabled:
+            refdebug.head_delta("gcs.decref", oid, -delta)
+            if freed:
+                refdebug.free(oid)
         if freed:
             for cb in self._on_free:
                 cb(freed)
         if nested:
             for nid in nested:
                 self.decref(nid)
+
+    def live_counts(self) -> Dict[bytes, int]:
+        """Still-referenced ids and their counts (the refdebug shutdown
+        snapshot: every id here is a deliberately-held leak; everything
+        else must have net-zeroed)."""
+        with self._lock:
+            return {oid.binary(): e.refcount
+                    for oid, e in self._entries.items() if e.refcount > 0}
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -92,5 +92,5 @@ class LogMonitor:
         if self._started:
             try:
                 self.poll_once(final=True)
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
                 pass

@@ -597,7 +597,7 @@ class HeadServer:
         self._stop_event.set()
         try:
             self._sock.close()
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         with self._lock:
             daemons = list(self.daemons.values())
@@ -605,14 +605,14 @@ class HeadServer:
         for d in daemons:
             try:
                 d.send(P.SHUTDOWN_NODE, {})
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
                 pass
             try:
                 d._writer.flush(0.5)
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
                 pass
             d.close_link()
             try:
                 d.conn.close()
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
                 pass

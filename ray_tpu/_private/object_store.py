@@ -1103,12 +1103,12 @@ class ArenaObjectStore:
                 if h is not None:
                     try:
                         h.release(oid)
-                    except Exception:
+                    except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
                         pass
         for h in foreign.values():
             try:
                 h.close(unlink=False)
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
                 pass
         self._store.close(unlink=self._owner)
         if self._owner:

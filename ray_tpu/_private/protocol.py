@@ -78,6 +78,8 @@ WORKER_UNBLOCKED = "wkr_unblocked"  # oneway: local wait finished
 # callers submit straight to the callee worker).
 ACTOR_CALL = "actor_call"        # worker <-> worker: one actor method call
 ACTOR_RESULT = "actor_result"    # worker <-> worker: its inline result
+GEN_CANCEL = "gen_cancel"        # worker <-> worker: caller dropped a
+                                 # channel stream; stop the producer
 
 # ---------------------------------------------------------------------------
 # Message types: per-host daemon <-> head control service (TCP). The daemon

@@ -1,0 +1,413 @@
+"""Refcount-conservation shadow ledger ("refdebug").
+
+The dynamic half of the ref-discipline plane (static passes:
+``devtools/lint/ref_discipline.py`` / ``barrier_coverage.py``), built
+on the lockdep pattern: a falsy module flag, env-propagated into every
+spawned process, zero instrumentation work when off (asserted by the
+counter-based perf_smoke guard in tests/test_refdebug.py).
+
+Enabled (``RAY_TPU_REFDEBUG=1`` or :func:`configure`), every process
+journals its refcount events — head-view mutations, caller-local
+borrows, parked/absorbed deltas, accounting barriers, escapes, exits —
+as JSON lines appended (and flushed) at record time to a per-process
+file in ``RAY_TPU_REFDEBUG_DIR``. SIGKILL-safe by construction: there
+is no atexit step; whatever a process managed to journal before dying
+is what the checker sees.
+
+:func:`check_journals` replays the merged journals and asserts the
+conservation invariants the PR 5 review rounds converged on:
+
+  negative-count       the head-view count of an object never dips
+                       below zero at any prefix of the head's journal
+  snapshot-mismatch /  at shutdown the replayed per-object count
+  snapshot-missing     equals the directory's live snapshot (net zero
+                       for every id the snapshot does not list as a
+                       still-held leak)
+  free-under-live-borrow
+                       no free event for an id while a cleanly-exited
+                       worker's journaled borrow of it was never
+                       settled through a barrier
+  parked-at-exit /     no parked delta without a subsequent barrier on
+  park-without-barrier that process (the idle-worker hang shape: a
+                       parked delta nobody will ever drain)
+
+Journal line schema (all events carry ``ev`` and ``pid``; object ids
+are hex strings)::
+
+    {"ev": "boot"}                          head process (re)started
+    {"ev": "head", "site": s, "oid": h, "d": n}   directory mutation
+    {"ev": "free", "oid": h}                directory entry freed
+    {"ev": "borrow", "site": s, "oid": h}   caller-local count taken
+    {"ev": "park", "site": s, "oid": h, "d": n, "bseq": n}
+    {"ev": "absorb", "site": s, "oid": h, "d": n}
+    {"ev": "barrier", "bseq": n, "settled": [h, ...]}
+    {"ev": "settle", "site": s, "oid": h}   borrow drained off-barrier
+    {"ev": "escape", "oids": [h, ...]}
+    {"ev": "exit", "parked": n}             clean worker shutdown
+    {"ev": "snapshot", "live": {h: n}}      head directory at shutdown
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_ENV_VAR = "RAY_TPU_REFDEBUG"
+# Where journals land (inherited by spawned daemons/workers). Unset
+# means enabled processes keep no journal — the checker has nothing to
+# read, but the gating/propagation machinery still exercises.
+_DUMP_ENV_VAR = "RAY_TPU_REFDEBUG_DIR"
+
+_JOURNAL_PREFIX = "refdebug-journal-"
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(_ENV_VAR, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+# Falsy-flag gate (fault.py / lockdep discipline): module attribute,
+# one dict lookup at each hook site; disabled processes never format a
+# single event.
+enabled = _env_enabled()
+
+# Instrumentation-work counter: every record below bumps it, so the
+# perf_smoke guard can assert the disabled path did ZERO refdebug work.
+_ops = 0
+
+
+def configure(on: bool, propagate_env: bool = True) -> None:
+    """Flip journaling for events recorded FROM NOW ON in this process;
+    with ``propagate_env`` the setting rides into spawned daemons and
+    workers (their hooks read the flag at boot, after env inheritance)."""
+    global enabled
+    enabled = bool(on)
+    if propagate_env:
+        if on:
+            os.environ[_ENV_VAR] = "1"
+        else:
+            os.environ.pop(_ENV_VAR, None)
+
+
+def instrument_ops() -> int:
+    """Recording operations performed so far (perf_smoke guard)."""
+    return _ops
+
+
+# ---------------------------------------------------------------------------
+# journal writer (process-local; reopened after fork/spawn)
+# ---------------------------------------------------------------------------
+_journal_lock = threading.Lock()
+_journal_fh = None
+_journal_pid: Optional[int] = None
+_bseq = 0  # per-process accounting-barrier sequence
+
+
+def reset() -> None:
+    """Drop process-local writer state (test isolation)."""
+    global _journal_fh, _journal_pid, _bseq
+    with _journal_lock:
+        if _journal_fh is not None:
+            try:
+                _journal_fh.close()
+            except OSError:
+                pass
+        _journal_fh = None
+        _journal_pid = None
+        _bseq = 0
+
+
+def _hex(oid: Any) -> str:
+    if isinstance(oid, bytes):
+        return oid.hex()
+    if hasattr(oid, "binary"):
+        return oid.binary().hex()
+    return str(oid)
+
+
+def _write(event: Dict[str, Any]) -> None:
+    """Append one event line, flushed immediately (SIGKILL-safe: a
+    dying process loses at most the event it was mid-write on). Caller
+    holds _journal_lock. Never raises into the runtime."""
+    global _journal_fh, _journal_pid
+    dump_dir = os.environ.get(_DUMP_ENV_VAR)
+    if not dump_dir:
+        return
+    pid = os.getpid()
+    try:
+        if _journal_fh is None or _journal_pid != pid:
+            # First event in this process (or post-fork): open our own
+            # journal; an inherited handle would interleave with the
+            # parent's.
+            path = os.path.join(dump_dir, f"{_JOURNAL_PREFIX}{pid}.jsonl")
+            _journal_fh = open(path, "a", encoding="utf-8")
+            _journal_pid = pid
+        import json
+        event["pid"] = pid
+        _journal_fh.write(json.dumps(event) + "\n")
+        _journal_fh.flush()
+    except OSError:
+        logger.debug("refdebug journal write failed", exc_info=True)
+
+
+def _record(event: Dict[str, Any]) -> None:
+    with _journal_lock:
+        _write(event)
+
+
+# ---------------------------------------------------------------------------
+# record hooks — each call site sits under `if refdebug.enabled`
+# (enforced by the gate-discipline pass; this module is registered in
+# GATED_HELPER_FILES so every `global _ops` function below is a helper)
+# ---------------------------------------------------------------------------
+def boot() -> None:
+    """Head process (re)started: the checker resets its replay here."""
+    global _ops
+    _ops += 1
+    _record({"ev": "boot"})
+
+
+def head_delta(site: str, oid: Any, delta: int) -> None:
+    """One head-view (ObjectDirectory) refcount mutation."""
+    global _ops
+    _ops += 1
+    _record({"ev": "head", "site": site, "oid": _hex(oid), "d": delta})
+
+
+def free(oid: Any) -> None:
+    global _ops
+    _ops += 1
+    _record({"ev": "free", "oid": _hex(oid)})
+
+
+def borrow(site: str, oid: Any) -> None:
+    """A caller-local count was taken (``_refs[ob] = 1``) — live until
+    a barrier's settled list (or an explicit settle) drains it."""
+    global _ops
+    _ops += 1
+    _record({"ev": "borrow", "site": site, "oid": _hex(oid)})
+
+
+def park(site: str, oid: Any, delta: int) -> None:
+    """A delta was parked in the coalescing buffer; only a subsequent
+    barrier on this process ships it."""
+    global _ops
+    _ops += 1
+    _record({"ev": "park", "site": site, "oid": _hex(oid), "d": delta,
+             "bseq": _bseq})
+
+
+def absorb(site: str, oid: Any, delta: int) -> None:
+    """A delta was absorbed into a live caller-local count."""
+    global _ops
+    _ops += 1
+    _record({"ev": "absorb", "site": site, "oid": _hex(oid), "d": delta})
+
+
+def barrier(settled: List[Any]) -> None:
+    """One accounting-barrier drain; `settled` lists every object id
+    whose caller-local residual or parked delta shipped in it."""
+    global _ops, _bseq
+    _ops += 1
+    with _journal_lock:
+        _bseq += 1
+        _write({"ev": "barrier", "bseq": _bseq,
+                "settled": [_hex(o) for o in settled]})
+
+
+def settle(site: str, oid: Any) -> None:
+    """A borrow drained outside a barrier (channel-death reconcile
+    ships the residual itself)."""
+    global _ops
+    _ops += 1
+    _record({"ev": "settle", "site": site, "oid": _hex(oid)})
+
+
+def escape(oids: List[Any]) -> None:
+    global _ops
+    _ops += 1
+    _record({"ev": "escape", "oids": [_hex(o) for o in oids]})
+
+
+def exit_event(parked: int) -> None:
+    """Clean worker shutdown; `parked` counts deltas still buffered
+    (must be zero — the exit path flushes first)."""
+    global _ops
+    _ops += 1
+    _record({"ev": "exit", "parked": parked})
+
+
+def snapshot(live: Dict[Any, int]) -> None:
+    """Head directory state at shutdown: still-referenced (leaked —
+    i.e. deliberately held) ids and their counts."""
+    global _ops
+    _ops += 1
+    _record({"ev": "snapshot",
+             "live": {_hex(o): int(n) for o, n in live.items()}})
+
+
+# ---------------------------------------------------------------------------
+# checker: replay merged journals, assert conservation
+# ---------------------------------------------------------------------------
+def collect_journals(dump_dir: str) -> Dict[int, List[dict]]:
+    """pid -> its journaled events, in write order. Tolerates torn
+    final lines (the process died mid-write)."""
+    import glob
+    import json
+    out: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(
+            os.path.join(dump_dir, f"{_JOURNAL_PREFIX}*.jsonl"))):
+        events: List[dict] = []
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail line
+        except OSError:
+            continue
+        if events:
+            pid = events[0].get("pid", 0)
+            out.setdefault(pid, []).extend(events)
+    return out
+
+
+def check_journals(dump_dir: str) -> List[dict]:
+    """Replay every journal under `dump_dir`; return the list of
+    conservation violations (empty == the run conserved refcounts)."""
+    journals = collect_journals(dump_dir)
+    violations: List[dict] = []
+    freed: set = set()
+
+    # Pass 1 — head journals: never-negative replay + snapshot match.
+    for pid, evs in sorted(journals.items()):
+        counts: Dict[str, int] = {}
+        for i, ev in enumerate(evs):
+            kind = ev.get("ev")
+            if kind == "boot":
+                counts.clear()
+            elif kind == "head":
+                oid = ev["oid"]
+                counts[oid] = counts.get(oid, 0) + ev["d"]
+                if counts[oid] < 0:
+                    violations.append({
+                        "kind": "negative-count", "pid": pid, "oid": oid,
+                        "count": counts[oid], "site": ev.get("site"),
+                        "index": i})
+            elif kind == "free":
+                freed.add(ev["oid"])
+                counts.pop(ev["oid"], None)
+            elif kind == "snapshot":
+                live = ev.get("live", {})
+                for oid, want in live.items():
+                    got = counts.get(oid, 0)
+                    if got != want:
+                        violations.append({
+                            "kind": "snapshot-mismatch", "pid": pid,
+                            "oid": oid, "replayed": got,
+                            "snapshot": want, "index": i})
+                for oid, got in sorted(counts.items()):
+                    if got != 0 and oid not in live:
+                        violations.append({
+                            "kind": "snapshot-missing", "pid": pid,
+                            "oid": oid, "replayed": got, "index": i})
+
+    # Pass 2 — worker journals: live borrows + undrained parks. Only
+    # CLEAN exits are held to the standard: a SIGKILLed worker (fault
+    # injection) legitimately dies with unsettled state — the head's
+    # channel-death reconcile re-derives it.
+    for pid, evs in sorted(journals.items()):
+        borrows: Dict[str, int] = {}
+        settles: Dict[str, int] = {}
+        parks_since_barrier: List[dict] = []
+        exited: Optional[dict] = None
+        for ev in evs:
+            kind = ev.get("ev")
+            if kind == "borrow":
+                borrows[ev["oid"]] = borrows.get(ev["oid"], 0) + 1
+            elif kind == "settle":
+                settles[ev["oid"]] = settles.get(ev["oid"], 0) + 1
+            elif kind == "barrier":
+                for oid in ev.get("settled", ()):
+                    settles[oid] = settles.get(oid, 0) + 1
+                parks_since_barrier = []
+            elif kind == "park":
+                parks_since_barrier.append(ev)
+            elif kind == "exit":
+                exited = ev
+        if exited is None:
+            continue
+        if exited.get("parked", 0) > 0:
+            violations.append({
+                "kind": "parked-at-exit", "pid": pid,
+                "parked": exited["parked"]})
+        for ev in parks_since_barrier:
+            violations.append({
+                "kind": "park-without-barrier", "pid": pid,
+                "oid": ev["oid"], "d": ev.get("d"),
+                "site": ev.get("site")})
+        for oid, n in sorted(borrows.items()):
+            if oid in freed and n > settles.get(oid, 0):
+                violations.append({
+                    "kind": "free-under-live-borrow", "pid": pid,
+                    "oid": oid, "borrows": n,
+                    "settled": settles.get(oid, 0)})
+    return violations
+
+
+def format_report(violations: List[dict]) -> str:
+    """Human-readable conservation report (what the conftest fixture
+    prints on failure; how to read it: docs/STATIC_ANALYSIS.md)."""
+    out: List[str] = []
+    for v in violations:
+        out.append("=" * 70)
+        kind = v.get("kind")
+        if kind == "negative-count":
+            out.append(
+                f"NEGATIVE HEAD COUNT: object {v['oid']} dropped to "
+                f"{v['count']} at {v.get('site')} (pid {v['pid']}, "
+                f"event #{v['index']}) — more decrefs reached the "
+                f"directory than increfs; an out-of-order delta or a "
+                f"double-free")
+        elif kind == "snapshot-mismatch":
+            out.append(
+                f"SNAPSHOT MISMATCH: object {v['oid']} replays to "
+                f"{v['replayed']} but the directory held "
+                f"{v['snapshot']} at shutdown (pid {v['pid']}) — a "
+                f"journaled mutation the directory never saw, or vice "
+                f"versa")
+        elif kind == "snapshot-missing":
+            out.append(
+                f"NONZERO AT SHUTDOWN: object {v['oid']} replays to "
+                f"{v['replayed']} but the directory no longer lists it "
+                f"(pid {v['pid']}) — accounting for a freed id never "
+                f"net zeroed")
+        elif kind == "parked-at-exit":
+            out.append(
+                f"PARKED DELTAS AT CLEAN EXIT: pid {v['pid']} exited "
+                f"with {v['parked']} coalesced delta(s) still buffered "
+                f"— no barrier will ever ship them (the idle-worker "
+                f"hang shape)")
+        elif kind == "park-without-barrier":
+            out.append(
+                f"PARK WITHOUT BARRIER: pid {v['pid']} parked delta "
+                f"{v.get('d')} for object {v['oid']} at "
+                f"{v.get('site')} and exited with no subsequent "
+                f"accounting barrier")
+        elif kind == "free-under-live-borrow":
+            out.append(
+                f"FREE UNDER LIVE BORROW: object {v['oid']} was freed "
+                f"while pid {v['pid']} (clean exit) held "
+                f"{v['borrows']} journaled borrow(s) with only "
+                f"{v['settled']} settled")
+        else:
+            out.append(f"UNKNOWN VIOLATION: {v!r}")
+    return "\n".join(out)

@@ -34,6 +34,7 @@ from ..exceptions import (
 from . import gcs as gcs_mod
 from . import lockdep
 from . import protocol as P
+from . import refdebug
 from . import serialization
 from . import telemetry
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
@@ -302,6 +303,8 @@ class Node:
         self._fwd_bufs: Dict[bytes, list] = {}
         self._fwd_flushing: Set[bytes] = set()
         self._shutdown = False
+        if refdebug.enabled:
+            refdebug.boot()
         atexit.register(self.shutdown)
 
     def _on_memory_pressure(self, fraction: float):
@@ -2637,31 +2640,40 @@ class Node:
         self._shutdown = True
         try:
             self.memory_monitor.stop()
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         try:
             self.log_monitor.stop()
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         try:
             self.head_server.stop()
             self.transfer_server.stop()
             self.pull_mgr.shutdown()
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         try:
             self.pg_manager.shutdown()
             self.scheduler.stop()
             self.pool.shutdown()
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
+            pass
+        if refdebug.enabled:
+            # After the pool drains (workers' final accounting frames
+            # are processed before their handles close) but before the
+            # store dies: whatever the directory still holds is the
+            # deliberately-leaked set the checker reconciles against.
+            refdebug.snapshot(self.gcs.objects.live_counts())
+        try:
             self.store.shutdown()
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         close_kv = getattr(self.gcs.kv, "close", None)
         if close_kv is not None:
             close_kv()
         try:
             sys.setswitchinterval(self._prev_switch_interval)
-        except Exception:
+        except Exception:  # lint: broad-except-ok best-effort teardown: interpreter may be finalizing under atexit
             pass
         import shutil
         shutil.rmtree(self.session_dir, ignore_errors=True)

@@ -1177,12 +1177,12 @@ class WorkerPool:
             h.death_handled = True  # suppress failure handling at shutdown
             try:
                 h.send(P.SHUTDOWN, {})
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
                 pass
         for h in handles:
             try:
                 h.proc.wait(timeout=0.5)
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
                 pass
             if h.proc.poll() is None:
                 h.kill()

@@ -42,6 +42,7 @@ from ..util import tracing
 from . import fault
 from . import lockdep
 from . import protocol as P
+from . import refdebug
 from . import serialization
 from . import telemetry
 from .ids import ActorID, ObjectID, TaskID
@@ -358,7 +359,7 @@ class WorkerClient:
             else:
                 w.send_lazy(P.REF_COUNT,
                             {"object_id": object_id, "delta": 1})
-        except Exception:
+        except Exception:  # lint: broad-except-ok pipe died: head reconciles this worker's refs on disconnect
             pass
 
     def decref(self, object_id: ObjectID):
@@ -369,7 +370,7 @@ class WorkerClient:
             else:
                 w.send_lazy(P.REF_COUNT,
                             {"object_id": object_id, "delta": -1})
-        except Exception:
+        except Exception:  # lint: broad-except-ok pipe died: head reconciles this worker's refs on disconnect
             pass
 
     # -- objects ----------------------------------------------------------
@@ -778,7 +779,7 @@ class Worker:
             else:
                 self.send(P.GEN_ITEM, {
                     "task_id": spec.task_id, "index": index, "loc": loc,
-                    "nested": list(nested), "actor_id": spec.actor_id})
+                    "nested": list(nested)})
             index += 1
         return index
 
@@ -1332,13 +1333,26 @@ class Worker:
             if callable(term):
                 try:
                     term()
-                except Exception:
+                except Exception:  # lint: broad-except-ok user exit hook: its failure must not block worker teardown
                     pass
+        # Clean exit is a worker's LAST accounting barrier: deltas
+        # parked past this point would strand head-side waiters forever
+        # (the refdebug parked-at-exit invariant).
+        if self._direct_on:
+            try:
+                self.direct.flush_accounting()
+            except Exception:  # lint: broad-except-ok head pipe dead: the process is exiting, accounting dies with it
+                pass
+            if refdebug.enabled:
+                refdebug.exit_event(len(self.direct._ref_buf)
+                                    + len(self.direct._done_buf))
+        elif refdebug.enabled:
+            refdebug.exit_event(0)
         # Ship anything still queued (TASK_DONEs racing shutdown)
         # before the hard exit tears the pipe down.
         try:
             self._writer.flush(2.0)
-        except Exception:
+        except Exception:  # lint: broad-except-ok head pipe dead: the process is exiting, nothing left to ship
             pass
         os._exit(0)
 

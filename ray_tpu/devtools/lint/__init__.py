@@ -23,6 +23,14 @@ Passes (see docs/STATIC_ANALYSIS.md for the full catalog):
                         falsy-flag gate; metric names are globally unique
     broad-except        no silent ``except Exception: pass`` in _private/
     config-keys         every ray_config key read has a declared default
+    ref-discipline      refcount-mutation helpers are registered, parked
+                        accounting is lexically paired with a drain
+                        barrier, flush elisions consult escape-marked
+                        state, and residual-transfer payload fields are
+                        conserved producer -> consumer
+    barrier-coverage    every head-bound send chokepoint flushes the
+                        accounting barrier first or carries a reasoned
+                        exemption
 
 Pre-existing violations are ratcheted in ``baseline.json``: the suite is
 green on day one, any NEW violation fails tier-1 (tests/test_lint.py),
@@ -46,4 +54,6 @@ PASS_NAMES = (
     "gate-discipline",
     "broad-except",
     "config-keys",
+    "ref-discipline",
+    "barrier-coverage",
 )

@@ -12,6 +12,7 @@ Modes:
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import List, Optional
@@ -44,6 +45,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--passes", nargs="*", choices=PASS_NAMES,
                         default=None, metavar="PASS",
                         help="subset of passes to run")
+    parser.add_argument("--format", choices=("text", "json", "github"),
+                        default="text", dest="fmt",
+                        help="output format: human text (default), a "
+                             "machine-readable JSON report, or GitHub "
+                             "workflow ::error annotation lines")
     parser.add_argument("-q", "--quiet", action="store_true")
     args = parser.parse_args(argv)
 
@@ -89,6 +95,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     if baseline_path and not args.no_baseline:
         baseline = load_baseline(baseline_path)
     res = apply_baseline(violations, baseline)
+
+    if args.fmt == "json":
+        new_set = {id(v) for v in res.new}
+        report = {
+            "total": len(violations),
+            "new": len(res.new),
+            "baselined": len(violations) - len(res.new),
+            "per_pass": {k: per_pass.get(k, 0) for k in PASS_NAMES},
+            "stale_fingerprints": sorted(res.fixed),
+            "violations": [
+                {"file": v.file, "line": v.line, "pass": v.pass_name,
+                 "message": v.message, "scope": v.scope,
+                 "fingerprint": v.fingerprint, "new": id(v) in new_set}
+                for v in violations],
+        }
+        print(json.dumps(report, indent=1))
+        return 1 if res.new else 0
+
+    if args.fmt == "github":
+        # Workflow-annotation lines: one ::error per NEW violation so
+        # the PR diff view pins each regression to its source line.
+        for v in res.new:
+            print(f"::error file={v.file},line={v.line},"
+                  f"title=raylint {v.pass_name}::{v.message}")
+        for fp in sorted(res.fixed):
+            print(f"::notice title=raylint stale baseline::{fp} no "
+                  f"longer fires; refresh with --update-baseline")
+        return 1 if res.new else 0
 
     if not args.quiet:
         for v in res.new:

@@ -163,14 +163,16 @@ class LintTree:
 # ---------------------------------------------------------------------------
 def run_passes(tree: LintTree,
                passes: Optional[Iterable[str]] = None) -> List[Violation]:
-    from . import broad_except, config_keys, gate_discipline, \
-        lock_discipline, protocol_coverage
+    from . import barrier_coverage, broad_except, config_keys, \
+        gate_discipline, lock_discipline, protocol_coverage, ref_discipline
     table = {
         "protocol-coverage": protocol_coverage.run,
         "lock-discipline": lock_discipline.run,
         "gate-discipline": gate_discipline.run,
         "broad-except": broad_except.run,
         "config-keys": config_keys.run,
+        "ref-discipline": ref_discipline.run,
+        "barrier-coverage": barrier_coverage.run,
     }
     names = list(passes) if passes is not None else list(table)
     out: List[Violation] = list(tree.parse_errors)

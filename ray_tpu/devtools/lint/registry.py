@@ -182,19 +182,179 @@ BLOCKING_MODULES = frozenset({"subprocess", "shutil"})
 # the tracing gate (or annotate the indirect gate — e.g. the
 # spec.trace_ctx check on the execution paths, the is_enabled()
 # adopted-context check on pull spans).
-GATED_MODULES = ("telemetry", "fault", "tracing")
+# "refdebug" joined in PR 9: the shadow-ledger journal hooks sit on the
+# refcount hot paths (every incref/decref/park/flush) and must be
+# zero-work when RAY_TPU_REFDEBUG is off.
+GATED_MODULES = ("telemetry", "fault", "tracing", "refdebug")
 # Files that implement the planes themselves (helpers live here; their
 # internal calls are exempt from the gating requirement).
 GATE_IMPL_FILES = ("_private/telemetry.py", "_private/fault.py",
-                   "util/tracing.py")
+                   "util/tracing.py", "_private/refdebug.py")
 # Where each gated module's ``_ops``-bumping helpers are parsed from
 # (the functions that MUST be gated at call sites).
 GATED_HELPER_FILES = {
     "telemetry": "_private/telemetry.py",
     "tracing": "util/tracing.py",
+    "refdebug": "_private/refdebug.py",
 }
 
 # ---------------------------------------------------------------------------
 # broad-except: scope — only the runtime core is held to the standard.
 # ---------------------------------------------------------------------------
 BROAD_EXCEPT_PREFIX = "_private/"
+
+# ---------------------------------------------------------------------------
+# ref-discipline: the ownership/refcount conservation surface.
+#
+# The direct-call plane re-derives the reference's "no object freed
+# while any node holds a live reference" invariant from buffered
+# accounting (REF_DELTAS / DIRECT_DONE residual transfers drained at
+# flush_accounting barriers). The pass pins four mechanical properties
+# of that surface; each registry block below is one of them.
+# ---------------------------------------------------------------------------
+# Files that make up the refcounting surface (mutation-helper inventory
+# scope).
+REF_FILES = ("_private/gcs.py", "_private/direct.py",
+             "_private/worker_proc.py", "_private/runtime.py",
+             "_private/object_store.py")
+
+# Method names that mutate a refcount wherever they are defined. A def
+# with one of these names inside REF_FILES must appear in
+# REF_MUTATION_HELPERS (and every entry there must still exist) — a new
+# mutation helper is a new conservation obligation and must be declared.
+REF_MUTATION_METHOD_NAMES = frozenset({
+    "incref", "decref", "apply_delta", "ref_delta"})
+REF_MUTATION_HELPERS = {
+    ("_private/gcs.py", "ObjectDirectory.incref"),
+    ("_private/gcs.py", "ObjectDirectory.decref"),
+    ("_private/gcs.py", "ObjectDirectory.apply_delta"),
+    ("_private/direct.py", "DirectPlane.ref_delta"),
+    ("_private/worker_proc.py", "WorkerClient.incref"),
+    ("_private/worker_proc.py", "WorkerClient.decref"),
+    ("_private/runtime.py", "Node.incref"),
+    ("_private/runtime.py", "Node.decref"),
+}
+
+# Park sites: caller-side buffers that hold UNSHIPPED accounting
+# (coalesced deltas, retired-but-unflushed completion entries, local
+# in-flight counts). A function writing into one (subscript store,
+# augmented subscript store, or .append) must lexically contain a call
+# to a drain barrier, be a barrier itself, carry a REF_PARK_DEFERRED
+# entry naming where it drains, or annotate the park line with
+# `# lint: ref-park-ok <reason>`.
+REF_PARK_FILES = ("_private/direct.py",)
+REF_PARK_ATTRS = frozenset({"_ref_buf", "_done_buf", "_refs"})
+REF_BARRIER_FUNCS = frozenset({"flush_accounting",
+                               "_flush_accounting_locked"})
+# (file, qualname) -> reason the drain barrier lives elsewhere.
+REF_PARK_DEFERRED = {
+    ("_private/direct.py", "DirectPlane._on_gen_items"):
+        "streamed items carry only their arrival count; the stream's "
+        "terminal registration (_retire_stream_locked) pops the "
+        "residuals and flushes in the same critical section",
+}
+
+# Escape-marked state: ids referenced by a head-bound message while
+# still locally owned. Any elision (a `continue`-only guard skipping an
+# accounting entry) inside REF_ELISION_FUNCS must reference this state
+# — directly or through a local derived from it — so an entry the head
+# is waiting on can never be silently dropped (the PR 5 elision bug).
+REF_ESCAPE_STATE = frozenset({"_escaped"})
+REF_ELISION_FUNCS = {
+    ("_private/direct.py", "DirectPlane._flush_accounting_locked"),
+}
+
+# Residual-transfer payload conservation: every field a producer writes
+# into one of these payloads must be read by its registered consumer
+# (orphan fields rot into silent accounting loss), and the consumer
+# must not read fields nothing produces (phantoms mask producer
+# regressions). Key discovery: dict literals passed to a send call
+# whose first argument is P.<send_const>, dict literals assigned to an
+# `entry_vars` name inside a producer function, and string-subscript
+# stores on those names. Consumer reads come off `payload_vars` only.
+# A payload is skipped when the fixture tree lacks its files; a present
+# file missing a registered function is a violation (registry rot).
+REF_PAYLOADS = {
+    "DIRECT_DONE": {
+        "send_const": "DIRECT_DONE",
+        "producer_file": "_private/direct.py",
+        "producers": ("DirectPlane._retire_locked",
+                      "DirectPlane._retire_stream_locked",
+                      "DirectPlane._flush_accounting_locked",
+                      "DirectPlane.send_result"),
+        "entry_vars": ("ent", "entry"),
+        "consumer_file": "_private/runtime.py",
+        "consumers": ("Node._on_direct_done",),
+        "payload_vars": ("payload", "ent"),
+        "exempt": {},
+    },
+    "REF_DELTAS": {
+        "send_const": "REF_DELTAS",
+        "producer_file": "_private/direct.py",
+        "producers": ("DirectPlane._flush_accounting_locked",),
+        "entry_vars": (),
+        "consumer_file": "_private/runtime.py",
+        "consumers": ("Node._on_ref_deltas",),
+        "payload_vars": ("payload",),
+        "exempt": {},
+    },
+    "GEN_ITEM(channel)": {
+        "send_const": "GEN_ITEM",
+        "producer_file": "_private/direct.py",
+        "producers": ("DirectPlane.send_gen_item",),
+        "entry_vars": (),
+        "consumer_file": "_private/direct.py",
+        "consumers": ("DirectPlane._on_gen_items",),
+        "payload_vars": ("p",),
+        "exempt": {},
+    },
+    "GEN_ITEM(head)": {
+        "send_const": "GEN_ITEM",
+        "producer_file": "_private/worker_proc.py",
+        "producers": ("Worker._stream_generator",),
+        "entry_vars": (),
+        "consumer_file": "_private/runtime.py",
+        "consumers": ("Node._on_gen_item",),
+        "payload_vars": ("payload",),
+        "exempt": {},
+    },
+}
+
+# ---------------------------------------------------------------------------
+# barrier-coverage: head-bound send chokepoints (the PR 5 round-7/8
+# hang shape as a lint rule). Every send of a P.<CONST> message to the
+# head from worker-side code must be preceded — lexically, in the same
+# function — by a call to the accounting barrier, unless the constant
+# is in the reasoned exemption list below or the send line carries
+# `# lint: barrier-ok <reason>`. Sends routed through the covered
+# wrappers (Worker.request flushes first, by construction) are exempt;
+# the pass verifies the wrappers themselves contain the barrier.
+# ---------------------------------------------------------------------------
+BARRIER_SEND_FILES = ("_private/worker_proc.py", "_private/direct.py")
+BARRIER_SEND_ATTRS = frozenset({"send", "send_lazy"})
+BARRIER_WRAPPER_ATTRS = frozenset({"request", "_request"})
+# The covered wrappers: these functions must themselves call the
+# barrier before their send (verified), which is what makes every
+# call THROUGH them barrier-covered.
+BARRIER_WRAPPERS = {
+    ("_private/worker_proc.py", "Worker.request"),
+}
+BARRIER_EXEMPT = {
+    "DIRECT_DONE": "this send IS the accounting barrier's own drain",
+    "REF_DELTAS": "this send IS the accounting barrier's own drain",
+    "DIRECT_RECONCILE": "channel-death chokepoint: ships the drained "
+                        "residuals itself under the plane lock",
+    "REF_COUNT": "oneway fallback when the direct plane is off — "
+                 "nothing is ever buffered to order against",
+    "CHANNEL_ADDR": "listener advertisement; references no object ids",
+    "GEN_ITEM": "head-path stream items reference only ids created by "
+                "this statement; the producing task's arg accounting "
+                "flushed at submission",
+    "TASK_EVENTS": "telemetry plane: events reference ids by hex "
+                   "string only, never as refcount state",
+    "METRICS_PUSH": "telemetry plane: numeric gauges only",
+    "WORKER_BLOCKED": "advisory scheduler hint; no object references",
+    "WORKER_UNBLOCKED": "advisory scheduler hint; no object references",
+    "TASKS_RECALLED": "recalled specs never executed here: no local "
+                      "accounting exists for their returns yet",
+}

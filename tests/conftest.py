@@ -103,6 +103,51 @@ def _lockdep_guard(request, tmp_path_factory):
             os.environ["RAY_TPU_LOCKDEP_DIR"] = prev_dir
 
 
+# Suites that run under the refcount-conservation shadow ledger
+# (_private/refdebug.py): the direct-call and cross-plane tiers
+# exercise the buffered-accounting surface (parks, barriers, borrows,
+# escapes) and the chaos tier kills processes mid-accounting — every
+# test must replay to a clean conservation report. Per-test journal
+# dir so a violation is attributable to the test that produced it
+# (these suites all build per-test clusters).
+_REFDEBUG_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
+                    "test_fault_injection"}
+
+
+@pytest.fixture(autouse=True)
+def _refdebug_guard(request, tmp_path_factory):
+    name = getattr(request.module, "__name__", "")
+    if name.rpartition(".")[2] not in _REFDEBUG_SUITES:
+        yield
+        return
+    from ray_tpu._private import refdebug
+    refdebug.reset()
+    prev = refdebug.enabled
+    # Journal dir: every process of the run (head, daemons, workers —
+    # which inherit RAY_TPU_REFDEBUG=1) appends its refcount events
+    # here at record time, SIGKILL-safe; the checker replays the merged
+    # journals on teardown.
+    dump_dir = str(tmp_path_factory.mktemp("refdebug"))
+    prev_dir = os.environ.get("RAY_TPU_REFDEBUG_DIR")
+    os.environ["RAY_TPU_REFDEBUG_DIR"] = dump_dir
+    refdebug.configure(True)
+    try:
+        yield
+        refdebug.reset()  # close our journal handle before replaying
+        violations = refdebug.check_journals(dump_dir)
+        if violations:
+            pytest.fail(
+                f"refdebug: {len(violations)} refcount-conservation "
+                f"violation(s) recorded during this test:\n"
+                + refdebug.format_report(violations))
+    finally:
+        refdebug.configure(prev)
+        if prev_dir is None:
+            os.environ.pop("RAY_TPU_REFDEBUG_DIR", None)
+        else:
+            os.environ["RAY_TPU_REFDEBUG_DIR"] = prev_dir
+
+
 @pytest.fixture(scope="module")
 def ray_start_shared():
     """Module-shared cluster (reference: ray_start_regular_shared)."""

@@ -522,3 +522,55 @@ def test_direct_shm_result_registers_lineage(fresh):
     assert entry.lineage is not None, \
         "direct SHM result registered without lineage"
     assert entry.lineage.method_name == "big"
+
+
+def test_gen_cancel_stops_producer_on_release(fresh):
+    """Dropping a channel-stream generator mid-iteration ships a
+    GEN_CANCEL frame over the channel: the callee's producing
+    generator is interrupted instead of running (and shipping items
+    into the abandoned stream) to completion — closing the PERF.md
+    deviation where only the head-routed path cancelled. The module's
+    refdebug guard additionally holds the cancel path to a clean
+    conservation replay (in-flight items balance at terminal)."""
+
+    @ray_tpu.remote
+    class Producer:
+        def __init__(self):
+            self.produced = 0
+
+        def stream(self, n):
+            for i in range(n):
+                self.produced += 1
+                time.sleep(0.05)
+                yield i
+
+        def count(self):
+            return self.produced
+
+    @ray_tpu.remote
+    class Consumer:
+        def __init__(self, producer):
+            self.producer = producer
+
+        def take_two(self):
+            gen = self.producer.stream.options(
+                num_returns="streaming").remote(200)
+            it = iter(gen)
+            out = [ray_tpu.get(next(it)), ray_tpu.get(next(it))]
+            del it, gen  # mid-iteration drop -> gen_release -> cancel
+            return out
+
+    producer = Producer.remote()
+    consumer = Consumer.remote(producer)
+    assert ray_tpu.get(consumer.take_two.remote(), timeout=60) == [0, 1]
+    # The producer must stop well short of n: poll until its yield
+    # count stabilizes (the cancel lands asynchronously).
+    last, deadline = -1, time.monotonic() + 30
+    while time.monotonic() < deadline:
+        cur = ray_tpu.get(producer.count.remote(), timeout=30)
+        if cur == last:
+            break
+        last = cur
+        time.sleep(0.3)
+    assert last < 150, \
+        f"producer yielded {last}/200 items after the stream was dropped"

@@ -9,13 +9,15 @@ are a handful of files each. No cluster is started anywhere here.
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
-from ray_tpu.devtools.lint import cli, core
+from ray_tpu.devtools.lint import cli, core, registry
 
 pytestmark = pytest.mark.lint
 
@@ -38,7 +40,7 @@ def _run(root, passes=None):
 # the live tree
 # ---------------------------------------------------------------------------
 def test_live_tree_zero_unbaselined_violations():
-    """All five passes over the real package: nothing beyond the
+    """All seven passes over the real package: nothing beyond the
     checked-in baseline (the ratchet contract — any NEW violation
     fails tier-1 right here)."""
     rc = cli.main(["-q"])
@@ -50,7 +52,7 @@ def test_live_tree_zero_unbaselined_violations():
 
 def test_live_tree_baseline_is_broad_except_only():
     """The baseline holds ONLY pre-existing broad-except swallows: the
-    other four passes are clean at zero and must stay there (they have
+    other six passes are clean at zero and must stay there (they have
     no burn-down debt to hide behind)."""
     baseline = core.load_baseline(cli.DEFAULT_BASELINE)
     assert baseline, "checked-in baseline missing or empty"
@@ -414,6 +416,280 @@ def test_config_keys_typo_flagged(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# ref-discipline: ownership/refcount conservation (PR 9)
+# ---------------------------------------------------------------------------
+# A conservation-clean mini direct plane: the one registered mutation
+# helper parks and drains in the same function, the flush elision
+# consults the escape mark through a derived local, and the channel
+# GEN_ITEM payload is field-conserved (producer writes o/i, consumer
+# reads both).
+_REF_DIRECT = """\
+    class DirectPlane:
+        def ref_delta(self, object_id, delta):
+            ob = object_id
+            if self._absorb:
+                self._refs[ob] = self._refs.get(ob, 0) + delta
+            else:
+                self._ref_buf[ob] = self._ref_buf.get(ob, 0) + delta
+            self.flush_accounting()
+
+        def flush_accounting(self):
+            with self._lock:
+                self._flush_accounting_locked()
+
+        def _flush_accounting_locked(self):
+            escaped = bool(self._escaped)
+            for ent in self._done_buf:
+                if not escaped and ent["deltas"] == 0:
+                    continue
+                self._send(P.DIRECT_DONE, ent)
+            self._done_buf = []
+
+        def send_gen_item(self, oid, index):
+            self._send(P.GEN_ITEM, {"o": oid, "i": index})
+
+        def _on_gen_items(self, p):
+            return (p["o"], p.get("i"))
+"""
+
+
+def test_ref_discipline_clean_fixture(tmp_path):
+    root = _tree(tmp_path, {"_private/direct.py": _REF_DIRECT})
+    assert _run(root, ["ref-discipline"]) == []
+
+
+def test_ref_discipline_elision_bug(tmp_path):
+    """The seeded PR 5 elision bug: the flush elision stops consulting
+    the escape mark, so an escaped id netting zero residual is silently
+    dropped while the head holds a waiter on it."""
+    src = _REF_DIRECT.replace('if not escaped and ent["deltas"] == 0:',
+                              'if ent["deltas"] == 0:')
+    assert src != _REF_DIRECT
+    root = _tree(tmp_path, {"_private/direct.py": src})
+    vs = _run(root, ["ref-discipline"])
+    assert [v.key for v in vs] == ["unguarded-elision"]
+    assert vs[0].scope == "DirectPlane._flush_accounting_locked"
+
+
+def test_ref_discipline_elision_bug_on_real_tree(tmp_path):
+    """Re-introduce the PR 5 bug into a COPY of the live package:
+    delete the `not escaped` consult from the real flush elision —
+    the pass must flag exactly that guard."""
+    import ray_tpu
+    pkg = os.path.dirname(ray_tpu.__file__)
+    dst = str(tmp_path / "ray_tpu")
+    shutil.copytree(pkg, dst,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    p = os.path.join(dst, "_private", "direct.py")
+    with open(p) as f:
+        src = f.read()
+    seeded = src.replace("if (not escaped\n                        and ",
+                         "if (")
+    assert seeded != src, "live elision guard moved; update this test"
+    with open(p, "w") as f:
+        f.write(seeded)
+    keys = [v.key for v in _run(dst, ["ref-discipline"])]
+    assert keys == ["unguarded-elision"]
+    # The pristine copy is clean (the live tree stays at zero).
+    with open(p, "w") as f:
+        f.write(src)
+    assert _run(dst, ["ref-discipline"]) == []
+
+
+def test_ref_discipline_unpaired_park_and_annotation(tmp_path):
+    src = _REF_DIRECT + """\
+
+        def park_only(self, ob):
+            self._ref_buf[ob] = 1
+
+        def park_annotated(self, ob):
+            self._refs[ob] = 1  # lint: ref-park-ok caller holds the plane lock and flushes before releasing it
+    """
+    root = _tree(tmp_path, {"_private/direct.py": src})
+    vs = _run(root, ["ref-discipline"])
+    assert [(v.scope, v.key) for v in vs] == [
+        ("DirectPlane.park_only", "unpaired-park:_ref_buf")]
+
+
+def test_ref_discipline_unregistered_mutation_helper(tmp_path):
+    src = _REF_DIRECT + """\
+
+        def decref(self, ob):
+            pass
+    """
+    root = _tree(tmp_path, {"_private/direct.py": src})
+    keys = {v.key for v in _run(root, ["ref-discipline"])}
+    assert keys == {"unregistered-mutation-helper:DirectPlane.decref"}
+
+
+def test_ref_discipline_registry_rot(tmp_path):
+    """A registered helper that vanished from the tree is flagged: the
+    registry must not rot into describing code that no longer exists."""
+    src = _REF_DIRECT.replace("def ref_delta", "def renamed_delta")
+    root = _tree(tmp_path, {"_private/direct.py": src})
+    keys = {v.key for v in _run(root, ["ref-discipline"])}
+    assert keys == {"stale-mutation-helper:DirectPlane.ref_delta"}
+
+
+def test_ref_discipline_payload_conservation(tmp_path):
+    """Orphan (produced, never read) and phantom (read, never produced)
+    payload fields are both flagged on the channel GEN_ITEM payload."""
+    src = _REF_DIRECT.replace(
+        '{"o": oid, "i": index}',
+        '{"o": oid, "i": index, "x": 0}').replace(
+        '(p["o"], p.get("i"))',
+        '(p["o"], p.get("i"), p["z"])')
+    root = _tree(tmp_path, {"_private/direct.py": src})
+    keys = {v.key for v in _run(root, ["ref-discipline"])}
+    assert keys == {"orphan-field:GEN_ITEM(channel):x",
+                    "phantom-field:GEN_ITEM(channel):z"}
+
+
+# ---------------------------------------------------------------------------
+# barrier-coverage: head-bound sends ordered after the barrier (PR 9)
+# ---------------------------------------------------------------------------
+_BARRIER_WP = """\
+    from . import protocol as P
+
+    class Worker:
+        def request(self, msg_type, payload):
+            self.direct._flush_accounting_locked()
+            self._writer.send(msg_type, payload)
+            return None
+
+        def good(self, spec):
+            self.direct.flush_accounting()
+            self._writer.send(P.SUBMIT_TASK, {"spec": spec})
+
+        def exempt_send(self):
+            self._writer.send_lazy(P.REF_COUNT, {"delta": 1})
+"""
+
+
+def test_barrier_coverage_clean_fixture(tmp_path):
+    root = _tree(tmp_path, {"_private/worker_proc.py": _BARRIER_WP})
+    assert _run(root, ["barrier-coverage"]) == []
+
+
+def test_barrier_coverage_unflushed_send_and_annotation(tmp_path):
+    src = _BARRIER_WP + """\
+
+        def bad(self, spec):
+            self._writer.send(P.SUBMIT_TASK, {"spec": spec})
+
+        def annotated(self, spec):
+            self._writer.send(P.SUBMIT_TASK, {"spec": spec})  # lint: barrier-ok spec references only head-owned ids
+    """
+    root = _tree(tmp_path, {"_private/worker_proc.py": src})
+    vs = _run(root, ["barrier-coverage"])
+    assert [(v.scope, v.key) for v in vs] == [
+        ("Worker.bad", "unflushed-send:SUBMIT_TASK")]
+
+
+def test_barrier_coverage_wrapper_must_flush(tmp_path):
+    """The covered wrapper (Worker.request) losing its barrier is worse
+    than one bad site — every send routed through it loses coverage."""
+    src = _BARRIER_WP.replace(
+        "            self.direct._flush_accounting_locked()\n", "")
+    root = _tree(tmp_path, {"_private/worker_proc.py": src})
+    keys = {v.key for v in _run(root, ["barrier-coverage"])}
+    assert keys == {"unflushed-wrapper:Worker.request"}
+    # Wrapper deleted outright -> registry rot.
+    src2 = _BARRIER_WP.replace("def request", "def renamed_request")
+    root2 = _tree(tmp_path / "rot", {"_private/worker_proc.py": src2})
+    keys2 = {v.key for v in _run(root2, ["barrier-coverage"])}
+    assert keys2 == {"stale-wrapper:Worker.request"}
+
+
+def test_barrier_coverage_stale_exempt_registry_rot(tmp_path):
+    """With BOTH chokepoint files in scope and no P.<CONST> sends,
+    every exemption is provably unused and flagged as registry rot
+    (fixture subsets skip this check)."""
+    root = _tree(tmp_path, {
+        "_private/worker_proc.py": """\
+            class Worker:
+                def request(self, m, p):
+                    self.direct.flush_accounting()
+                    self._writer.send(m, p)
+        """,
+        "_private/direct.py": "class DirectPlane:\n    pass\n",
+    })
+    keys = {v.key for v in _run(root, ["barrier-coverage"])}
+    assert keys == {f"stale-exempt:{c}" for c in registry.BARRIER_EXEMPT}
+
+
+# ---------------------------------------------------------------------------
+# machine-readable output (--format json / github)
+# ---------------------------------------------------------------------------
+_SWALLOW = {
+    "_private/x.py": """\
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+    """,
+}
+
+
+def test_cli_format_json(tmp_path, capsys):
+    root = _tree(tmp_path, _SWALLOW)
+    rc = cli.main(["--root", root, "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["total"] == 1 and data["new"] == 1
+    assert data["baselined"] == 0 and data["stale_fingerprints"] == []
+    assert data["per_pass"]["broad-except"] == 1
+    (v,) = data["violations"]
+    assert v["pass"] == "broad-except" and v["new"] is True
+    assert v["file"] == "_private/x.py" and v["scope"] == "f"
+    assert v["fingerprint"].startswith("broad-except:_private/x.py:f:")
+    # Clean tree: rc 0, empty violation list, still valid JSON.
+    clean = _tree(tmp_path / "clean", {"_private/x.py": "A = 1\n"})
+    rc = cli.main(["--root", clean, "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["total"] == 0 and data["violations"] == []
+
+
+def test_cli_format_github(tmp_path, capsys):
+    root = _tree(tmp_path, _SWALLOW)
+    rc = cli.main(["--root", root, "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert out.startswith("::error file=_private/x.py,line=")
+    assert "title=raylint broad-except" in out
+    # Baselined violations are silent; a fixed one surfaces as a
+    # ::notice nudging the baseline refresh.
+    bl = str(tmp_path / "bl.json")
+    assert cli.main(["--root", root, "--update-baseline",
+                     "--baseline", bl]) == 0
+    capsys.readouterr()
+    assert cli.main(["--root", root, "--format", "github",
+                     "--baseline", bl]) == 0
+    assert capsys.readouterr().out == ""
+    (tmp_path / "_private/x.py").write_text("def f():\n    pass\n")
+    assert cli.main(["--root", root, "--format", "github",
+                     "--baseline", bl]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("::notice title=raylint stale baseline::")
+    assert "--update-baseline" in out
+
+
+# ---------------------------------------------------------------------------
+# budget: the full seven-pass live-tree run must stay interactive
+# ---------------------------------------------------------------------------
+def test_full_tree_wall_clock():
+    """The whole suite (parse once + seven passes) gates tier-1 and the
+    pre-push loop: pin it under 5s so it never becomes a tax anyone is
+    tempted to skip."""
+    root = os.path.join(REPO, "ray_tpu")
+    t0 = time.perf_counter()
+    core.run_passes(core.LintTree(root))
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0, f"raylint full-tree run took {elapsed:.2f}s"
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet semantics
 # ---------------------------------------------------------------------------
 def test_baseline_ratchet_counts(tmp_path):
@@ -555,6 +831,15 @@ _VIOLATION_FIXTURES = {
             def f():
                 return ray_config.alhpa
         """,
+    },
+    "ref-discipline": {
+        "_private/direct.py": _REF_DIRECT.replace(
+            'if not escaped and ent["deltas"] == 0:',
+            'if ent["deltas"] == 0:'),
+    },
+    "barrier-coverage": {
+        "_private/worker_proc.py": _BARRIER_WP.replace(
+            "            self.direct.flush_accounting()\n", ""),
     },
 }
 

@@ -1,0 +1,276 @@
+"""Refcount-conservation shadow ledger (_private/refdebug.py).
+
+Checker unit tests replay SYNTHETIC journals (each invariant violated
+in isolation, plus the clean shapes that must stay silent); the seeded
+tests write the exact journal a PR 5-buggy worker would produce
+through the real recording API; the perf_smoke guard is the standard
+counter-based zero-work assertion for the disabled path (fault.py /
+lockdep / telemetry discipline — never wall-clock).
+
+The INTEGRATION coverage — whole suites replayed to a clean
+conservation report — lives in the conftest autouse guard over
+test_direct_calls / test_cross_plane_ordering / test_fault_injection;
+here one small live-cluster test pins the plumbing (env propagation
+into workers, journals written, checker green) explicitly.
+"""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import refdebug
+
+OID_A = "aa" * 14
+OID_B = "bb" * 14
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    """These tests drive configure() directly: restore the module flag
+    and env afterwards so they compose with any surrounding sweep."""
+    prev = refdebug.enabled
+    prev_env = os.environ.get("RAY_TPU_REFDEBUG")
+    prev_dir = os.environ.get("RAY_TPU_REFDEBUG_DIR")
+    refdebug.reset()
+    yield
+    refdebug.reset()
+    refdebug.configure(prev, propagate_env=False)
+    for var, val in (("RAY_TPU_REFDEBUG", prev_env),
+                     ("RAY_TPU_REFDEBUG_DIR", prev_dir)):
+        if val is None:
+            os.environ.pop(var, None)
+        else:
+            os.environ[var] = val
+
+
+def _journal(tmp_path, pid, events):
+    path = tmp_path / f"refdebug-journal-{pid}.jsonl"
+    with open(path, "a", encoding="utf-8") as f:
+        for ev in events:
+            f.write(json.dumps(dict(ev, pid=pid)) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# checker unit tests (synthetic journals)
+# ---------------------------------------------------------------------------
+def test_clean_journals_pass(tmp_path):
+    _journal(tmp_path, 100, [
+        {"ev": "boot"},
+        {"ev": "head", "site": "gcs.incref", "oid": OID_A, "d": 1},
+        {"ev": "head", "site": "gcs.decref", "oid": OID_A, "d": -1},
+        {"ev": "free", "oid": OID_A},
+        {"ev": "snapshot", "live": {}},
+    ])
+    _journal(tmp_path, 200, [
+        {"ev": "borrow", "site": "direct.submit", "oid": OID_B},
+        {"ev": "park", "site": "direct.ref_delta", "oid": OID_B,
+         "d": -1, "bseq": 0},
+        {"ev": "barrier", "bseq": 1, "settled": [OID_B]},
+        {"ev": "exit", "parked": 0},
+    ])
+    assert refdebug.check_journals(str(tmp_path)) == []
+
+
+def test_negative_count_flagged(tmp_path):
+    _journal(tmp_path, 100, [
+        {"ev": "boot"},
+        {"ev": "head", "site": "gcs.apply_delta", "oid": OID_A, "d": -1},
+    ])
+    (v,) = refdebug.check_journals(str(tmp_path))
+    assert v["kind"] == "negative-count"
+    assert v["oid"] == OID_A and v["count"] == -1
+    assert "NEGATIVE HEAD COUNT" in refdebug.format_report([v])
+
+
+def test_snapshot_mismatch_and_missing(tmp_path):
+    _journal(tmp_path, 100, [
+        {"ev": "boot"},
+        {"ev": "head", "site": "gcs.incref", "oid": OID_A, "d": 2},
+        {"ev": "head", "site": "gcs.incref", "oid": OID_B, "d": 1},
+        # Directory says A is held once (journal replays 2) and has no
+        # idea about B (journal replays 1, never freed).
+        {"ev": "snapshot", "live": {OID_A: 1}},
+    ])
+    kinds = {v["kind"] for v in refdebug.check_journals(str(tmp_path))}
+    assert kinds == {"snapshot-mismatch", "snapshot-missing"}
+
+
+def test_boot_resets_replay(tmp_path):
+    """A head restart (PR 8 surface) starts a fresh ledger: counts
+    journaled before the boot event must not leak into the replay."""
+    _journal(tmp_path, 100, [
+        {"ev": "boot"},
+        {"ev": "head", "site": "gcs.incref", "oid": OID_A, "d": 3},
+        {"ev": "boot"},
+        {"ev": "head", "site": "gcs.incref", "oid": OID_A, "d": 1},
+        {"ev": "head", "site": "gcs.decref", "oid": OID_A, "d": -1},
+        {"ev": "free", "oid": OID_A},
+        {"ev": "snapshot", "live": {}},
+    ])
+    assert refdebug.check_journals(str(tmp_path)) == []
+
+
+def test_free_under_live_borrow_flagged(tmp_path):
+    _journal(tmp_path, 100, [
+        {"ev": "boot"},
+        {"ev": "head", "site": "gcs.incref", "oid": OID_A, "d": 1},
+        {"ev": "head", "site": "gcs.decref", "oid": OID_A, "d": -1},
+        {"ev": "free", "oid": OID_A},
+        {"ev": "snapshot", "live": {}},
+    ])
+    _journal(tmp_path, 200, [
+        {"ev": "borrow", "site": "direct.submit", "oid": OID_A},
+        {"ev": "exit", "parked": 0},
+    ])
+    (v,) = refdebug.check_journals(str(tmp_path))
+    assert v["kind"] == "free-under-live-borrow"
+    assert v["oid"] == OID_A and v["borrows"] == 1 and v["settled"] == 0
+
+
+def test_settled_borrow_is_not_a_violation(tmp_path):
+    _journal(tmp_path, 100, [
+        {"ev": "boot"},
+        {"ev": "free", "oid": OID_A},
+    ])
+    _journal(tmp_path, 200, [
+        {"ev": "borrow", "site": "direct.submit", "oid": OID_A},
+        {"ev": "settle", "site": "direct.reconcile", "oid": OID_A},
+        {"ev": "exit", "parked": 0},
+    ])
+    assert refdebug.check_journals(str(tmp_path)) == []
+
+
+def test_sigkilled_worker_is_excused(tmp_path):
+    """No exit event == the worker was killed: unsettled borrows and
+    undrained parks are the head reconcile's job, not a violation
+    (fault-injection suites must stay green)."""
+    _journal(tmp_path, 100, [
+        {"ev": "boot"},
+        {"ev": "free", "oid": OID_A},
+    ])
+    _journal(tmp_path, 200, [
+        {"ev": "borrow", "site": "direct.submit", "oid": OID_A},
+        {"ev": "park", "site": "direct.ref_delta", "oid": OID_B,
+         "d": -1, "bseq": 0},
+        # no exit: SIGKILL
+    ])
+    assert refdebug.check_journals(str(tmp_path)) == []
+
+
+def test_torn_tail_line_tolerated(tmp_path):
+    path = _journal(tmp_path, 200, [
+        {"ev": "borrow", "site": "direct.submit", "oid": OID_A},
+        {"ev": "exit", "parked": 0},
+    ])
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"ev": "park", "site": "direct.ref_de')  # died mid-write
+    journals = refdebug.collect_journals(str(tmp_path))
+    assert len(journals[200]) == 2
+    assert refdebug.check_journals(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# seeded parked-delta bug (the PR 5 idle-worker hang shape), recorded
+# through the REAL writer API
+# ---------------------------------------------------------------------------
+def test_seeded_parked_delta_bug_caught(tmp_path):
+    """A worker parks a coalesced delta after its last barrier and
+    exits "cleanly" without flushing — exactly what a regression that
+    drops the exit-path flush_accounting would journal. Both parked-
+    delta invariants must fire."""
+    os.environ["RAY_TPU_REFDEBUG_DIR"] = str(tmp_path)
+    refdebug.configure(True, propagate_env=False)
+    refdebug.park("direct.ref_delta", bytes.fromhex(OID_A), -1)
+    refdebug.exit_event(1)
+    refdebug.reset()
+    kinds = {v["kind"] for v in refdebug.check_journals(str(tmp_path))}
+    assert kinds == {"parked-at-exit", "park-without-barrier"}
+    report = refdebug.format_report(
+        refdebug.check_journals(str(tmp_path)))
+    assert "PARKED DELTAS AT CLEAN EXIT" in report
+    assert "PARK WITHOUT BARRIER" in report
+
+
+def test_seeded_bug_fixed_by_exit_barrier(tmp_path):
+    """The same trace with the exit-path flush in place (barrier after
+    the park, zero parked at exit) replays clean — the checker flags
+    the bug, not the park mechanism."""
+    os.environ["RAY_TPU_REFDEBUG_DIR"] = str(tmp_path)
+    refdebug.configure(True, propagate_env=False)
+    refdebug.park("direct.ref_delta", bytes.fromhex(OID_A), -1)
+    refdebug.barrier([bytes.fromhex(OID_A)])
+    refdebug.exit_event(0)
+    refdebug.reset()
+    assert refdebug.check_journals(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# gating, env propagation, zero-work disabled path
+# ---------------------------------------------------------------------------
+def test_configure_propagates_env():
+    refdebug.configure(True)
+    assert os.environ.get("RAY_TPU_REFDEBUG") == "1"
+    refdebug.configure(False)
+    assert "RAY_TPU_REFDEBUG" not in os.environ
+
+
+def test_enabled_without_dump_dir_writes_nothing(tmp_path):
+    """RAY_TPU_REFDEBUG without RAY_TPU_REFDEBUG_DIR: hooks run (ops
+    counted) but no journal is kept anywhere."""
+    os.environ.pop("RAY_TPU_REFDEBUG_DIR", None)
+    refdebug.configure(True, propagate_env=False)
+    before = refdebug.instrument_ops()
+    refdebug.head_delta("gcs.incref", bytes.fromhex(OID_A), 1)
+    assert refdebug.instrument_ops() == before + 1
+    assert refdebug.collect_journals(str(tmp_path)) == {}
+
+
+@pytest.mark.perf_smoke
+def test_disabled_path_does_zero_refdebug_work(shutdown_only):
+    """Counter-based zero-work guard: with refdebug OFF, a full
+    init/submit/get/shutdown lifecycle — every instrumented surface:
+    directory increfs/decrefs, direct-plane accounting, worker exits,
+    the shutdown snapshot — performs ZERO recording operations in this
+    (head) process."""
+    refdebug.configure(False, propagate_env=False)
+    before = refdebug.instrument_ops()
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def bump(x):
+        return x + 1
+
+    assert ray_tpu.get([bump.remote(i) for i in range(16)],
+                       timeout=60) == list(range(1, 17))
+    ray_tpu.shutdown()
+    assert refdebug.instrument_ops() == before
+
+
+# ---------------------------------------------------------------------------
+# live-cluster plumbing: env rides into workers, journals land, clean
+# ---------------------------------------------------------------------------
+def test_live_cluster_journals_and_replays_clean(tmp_path, shutdown_only):
+    os.environ["RAY_TPU_REFDEBUG_DIR"] = str(tmp_path)
+    refdebug.configure(True)  # propagate_env: workers journal too
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        assert ray_tpu.get([double.remote(i) for i in range(8)],
+                           timeout=60) == [i * 2 for i in range(8)]
+        ray_tpu.shutdown()
+    finally:
+        refdebug.configure(False)
+    refdebug.reset()  # close the head journal before replaying
+    journals = refdebug.collect_journals(str(tmp_path))
+    assert journals, "no refdebug journals were written"
+    kinds = {e["ev"] for evs in journals.values() for e in evs}
+    assert "boot" in kinds, kinds      # head booted its ledger
+    assert "snapshot" in kinds, kinds  # and snapshotted at shutdown
+    assert refdebug.check_journals(str(tmp_path)) == []
