@@ -209,6 +209,16 @@ class RayConfig:
         "head_reconnect_attempts": 0,
         # Initial reconnect backoff; doubles per attempt, capped at 5s.
         "head_reconnect_backoff_s": 0.5,
+        # -- graceful node drain (reference: gcs_node_manager DrainNode +
+        # autoscaler-v2 drain requests; docs/DRAIN.md) --------------------
+        # Budget for one node drain: running tasks finish, serve replicas
+        # empty, sole-copy objects re-home. Expiry degrades to the hard
+        # node-death path (the pre-drain semantics).
+        "drain_deadline_s": 30.0,
+        # A node must stay *continuously* idle this long past the
+        # autoscaler idle timeout before scale-down picks it — bursty
+        # load that goes idle for milliseconds must not flap nodes.
+        "scale_down_idle_grace_s": 5.0,
     }
 
     def __init__(self):

@@ -107,6 +107,10 @@ class NodeDaemon:
         self._pending: Dict[int, Future] = {}
         self._transfer_addrs: Dict[str, Tuple[str, int]] = {}
         self._stopped = threading.Event()
+        # Graceful-drain flag (DRAIN_NODE): informational daemon-side —
+        # the head owns drain orchestration; workers keep running until
+        # migrated or SHUTDOWN_NODE lands.
+        self._draining = False
 
         self._address = tuple(address)
         self._token = token
@@ -398,6 +402,21 @@ class NodeDaemon:
                 fut = self._pending.pop(payload["req_id"], None)
             if fut is not None:
                 fut.set_result(payload.get("result"))
+        elif msg_type == P.DRAIN_NODE:
+            # Graceful drain notice: the HEAD coordinates the drain
+            # (placement stop, migration, object re-homing) — daemon-
+            # side this only acks and flips the local flag so the
+            # heartbeat keeps flowing while work evacuates. The fault
+            # site lets chaos tests race a drain against SIGKILL.
+            if fault.enabled:
+                fault.fire("daemon.drain", node=self.node_hex[:8])
+            self._draining = True
+            try:
+                self._send(P.DRAIN_STATUS,
+                           {"node_id": self.node_hex,
+                            "state": "DRAINING", "ts": time.time()})
+            except Exception:  # lint: broad-except-ok head link dying; loss path owns it
+                pass
         elif msg_type == P.SHUTDOWN_NODE:
             self._stopped.set()
         else:

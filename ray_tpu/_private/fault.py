@@ -24,6 +24,7 @@ Sites threaded through the runtime (see docs/FAULT_INJECTION.md):
     collective.rendezvous    one collective rendezvous KV round
     direct.connect           a caller dialing a direct worker channel
     direct.call              one ACTOR_CALL shipped on a direct channel
+    daemon.drain             a daemon receiving a graceful-drain request
 
 Usage — the hot-path gate is a single module-attribute truthiness
 check, so disabled runs pay one dict lookup per site:
@@ -97,6 +98,7 @@ SITES = (
     "gcs.op", "store.pull", "store.spill",
     "collective.rendezvous",
     "direct.connect", "direct.call",
+    "daemon.drain",
 )
 
 _EXCEPTIONS = {

@@ -203,6 +203,40 @@ class ObjectDirectory:
                 pass
         return lost
 
+    def primaries_on_node(self, node_id_hex: str
+                          ) -> List[Tuple[ObjectID, int]]:
+        """(oid, size) for every READY object whose primary (only
+        directory-known) copy lives on `node_id_hex` — the drain
+        re-homing worklist (reference: DrainNode's object-manager
+        eviction of primary copies before release)."""
+        out: List[Tuple[ObjectID, int]] = []
+        with self._lock:
+            for oid, e in self._entries.items():
+                loc = e.location
+                if (e.state == READY and loc is not None
+                        and loc[0] == P.LOC_SHM and len(loc) > 2
+                        and loc[2] == node_id_hex):
+                    out.append((oid, e.size))
+        return out
+
+    def relocate(self, oid: ObjectID, expected_node_hex: str,
+                 new_location: Tuple) -> bool:
+        """Swap a READY entry's primary location off a draining node
+        after its bytes were copied to `new_location`. No-op (False)
+        unless the entry is still READY on `expected_node_hex` — a
+        concurrent free/loss wins the race."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                return False
+            loc = e.location
+            if (e.state == READY and loc is not None
+                    and loc[0] == P.LOC_SHM and len(loc) > 2
+                    and loc[2] == expected_node_hex):
+                e.location = new_location
+                return True
+        return False
+
     def entry(self, oid: ObjectID) -> Optional[ObjectEntry]:
         with self._lock:
             return self._entries.get(oid)
@@ -349,11 +383,16 @@ class ActorDirectory:
             e.worker_id = worker_id
             e.ready_event.set()
 
-    def set_restarting(self, actor_id: ActorID):
+    def set_restarting(self, actor_id: ActorID, charge: bool = True):
+        """charge=False: a drain-driven migration restart — the cluster
+        chose to move the actor, so its max_restarts budget is not
+        burned (reference: DrainNode restarts don't count against
+        max_restarts)."""
         with self._lock:
             e = self._actors[actor_id]
             e.state = ACTOR_RESTARTING
-            e.restarts_used += 1
+            if charge:
+                e.restarts_used += 1
             e.ready_event.clear()
 
     def set_dead(self, actor_id: ActorID, cause: str = "",

@@ -80,7 +80,7 @@ class RemoteWorkerProxy:
         try:
             self.daemon.send(P.KILL_WORKER,
                              {"worker": self.worker_id.binary()})
-        except Exception:
+        except Exception:  # lint: broad-except-ok dying daemon link; node-loss path owns its workers
             pass
 
 
@@ -325,7 +325,7 @@ class HeadServer:
                         s.shutdown(_socket.SHUT_RDWR)
                     finally:
                         s.close()
-                except Exception:
+                except Exception:  # lint: broad-except-ok fd already closed by the recv loop's finally; either path ends the link
                     pass
 
     def _accept_loop(self):
@@ -367,7 +367,7 @@ class HeadServer:
         try:
             try:
                 conn = self._handshake(sock)
-            except Exception:
+            except Exception:  # lint: broad-except-ok unauthenticated/garbage dialer; drop the socket, nothing registered yet
                 try:
                     sock.close()
                 except OSError:
@@ -398,7 +398,7 @@ class HeadServer:
                 s = _s.fromfd(conn.fileno(), _s.AF_INET, _s.SOCK_STREAM)
                 peer_host = s.getpeername()[0]
                 s.close()
-            except Exception:
+            except Exception:  # lint: broad-except-ok peer address is cosmetic; loopback default stands
                 pass
             handle = DaemonHandle(
                 conn, payload["node_id_hex"], payload["resources"],
@@ -427,7 +427,7 @@ class HeadServer:
                     self._route(handle, msg_type, payload)
         except (EOFError, OSError):
             pass
-        except Exception:
+        except Exception:  # lint: broad-except-ok malformed frame from a skewed daemon; finally runs the one true loss path
             pass
         finally:
             if handle is not None:
@@ -462,7 +462,7 @@ class HeadServer:
                         self._node._on_daemon_lost(handle)
             try:
                 conn.close()
-            except Exception:
+            except Exception:  # lint: broad-except-ok conn may never have opened; teardown is idempotent
                 pass
 
     def _route(self, handle: DaemonHandle, msg_type: str, payload: dict):
@@ -498,7 +498,7 @@ class HeadServer:
                         scope="node", node_id=handle.node_id_hex,
                         worker_id=None, groups=snap,
                         ts=payload.get("metrics_ts"))
-                except Exception:
+                except Exception:  # lint: broad-except-ok malformed metrics snapshot must not kill the ping route
                     pass
             # Bidirectional sync (reference: ray_syncer.h — raylets and
             # the GCS gossip per-node resource views over a stream):
@@ -518,13 +518,16 @@ class HeadServer:
                     self._sync_cache = cached
                 handle.send(P.NODE_SYNC, {"ts": cached[0],
                                           "view": cached[1]})
-            except Exception:
-                pass  # dying conn: the heartbeat monitor handles it
+            except Exception:  # lint: broad-except-ok dying conn: the heartbeat monitor handles it
+                pass
         elif msg_type == P.NODE_REPLY:
             handle.resolve_reply(payload)
         elif msg_type == P.NODE_REQUEST:
             self._node._handler_pool.submit(
                 self._handle_node_request, handle, payload)
+        elif msg_type == P.DRAIN_STATUS:
+            # Draining daemon's ack/progress for the head coordinator.
+            self._node._on_drain_status(payload)
         else:
             # Unknown daemon->head type: log, never drop silently — a
             # daemon running newer protocol code would otherwise lose
@@ -567,7 +570,7 @@ class HeadServer:
             result = {"__error__": e}
         try:
             handle.send(P.NODE_REPLY, {"req_id": req_id, "result": result})
-        except Exception:
+        except Exception:  # lint: broad-except-ok requester's conn died; its daemon retries or the loss path runs
             pass
 
     def broadcast(self, msg_type: str, payload: dict):
@@ -577,7 +580,7 @@ class HeadServer:
             if d.alive:
                 try:
                     d.send(msg_type, payload)
-                except Exception:
+                except Exception:  # lint: broad-except-ok one dead daemon must not stop the broadcast; its loss path runs separately
                     pass
 
     def all_daemons(self) -> List[DaemonHandle]:

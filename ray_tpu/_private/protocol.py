@@ -104,6 +104,8 @@ WORKER_DEDICATED = "worker_dedicated"  # head -> daemon: pooled worker became an
 WORKER_DIED = "worker_died"      # daemon -> head: a worker process exited
 SHUTDOWN_NODE = "shutdown_node"  # head -> daemon: drain and exit
 LOCALIZE_OBJECT = "localize_obj"  # head -> daemon: pull object from a node
+DRAIN_NODE = "drain_node"        # head -> daemon: begin graceful drain
+DRAIN_STATUS = "drain_status"    # daemon -> head: drain progress/ack
 
 # Object location kinds
 LOC_INLINE = "inline"            # bytes travel in the message

@@ -25,6 +25,7 @@ from ..exceptions import (
     ActorDiedError,
     ActorError,
     GetTimeoutError,
+    NodeDrainedError,
     ObjectLostError,
     TaskCancelledError,
     TaskError,
@@ -227,6 +228,17 @@ class Node:
         # to the worker). Entries are one-shot: popped by the death
         # drain or at normal completion.
         self._direct_prepaid: Dict[bytes, bytes] = {}
+        # -- graceful drain (docs/DRAIN.md; reference: gcs_node_manager
+        # DrainNode). node_id_hex set: deaths on these nodes are the
+        # CLUSTER's fault — migration must not charge max_restarts /
+        # max_task_retries and terminal errors are NodeDrainedError.
+        # Empty set ⇒ every drain check is one falsy `in` test (the
+        # steady-state zero-cost guarantee).
+        self._draining_nodes: Set[str] = set()
+        # node_id_hex -> mutable status dict (state/progress gauges);
+        # the coordinator thread owns writes, readers copy.
+        self._drains: Dict[str, dict] = {}
+        self._drain_lock = lockdep.lock("runtime.drain")
         self._recovery_lock = lockdep.lock("runtime.recovery")
         self._cancel_requested: Set[bytes] = set()
         self._actors: Dict[ActorID, _ActorState] = {}
@@ -466,6 +478,16 @@ class Node:
         getters trigger lineage reconstruction (reference: node failure
         handling in GcsNodeManager + ObjectRecoveryManager)."""
         self.node_registry.remove_node(handle.node_id_hex)
+        # A node that dies MID-drain degrades to plain node-death
+        # semantics: drop the drain attribution first so the worker
+        # deaths below charge budgets exactly like an unplanned loss,
+        # and settle the drain status for observers.
+        if handle.node_id_hex in self._draining_nodes:
+            self._draining_nodes.discard(handle.node_id_hex)
+            with self._drain_lock:
+                dst = self._drains.get(handle.node_id_hex)
+                if dst is not None and dst["state"] == "DRAINING":
+                    dst["state"] = "NODE_DIED"
         self.gcs.pubsub.publish("node", {
             "event": "dead", "node_id": handle.node_id_hex})
         # Stop re-exporting the dead node's last metrics snapshot.
@@ -543,6 +565,242 @@ class Node:
                 except Exception:
                     pass  # target died mid-broadcast: skip it
         return len(holders)
+
+    # ------------------------------------------------------------------
+    # graceful node drain (docs/DRAIN.md; reference: gcs_node_manager
+    # DrainNode + autoscaler-v2 drain requests)
+    # ------------------------------------------------------------------
+    def drain_node(self, node_id_hex: str,
+                   deadline_s: Optional[float] = None,
+                   wait: bool = False) -> dict:
+        """Begin (or observe) a graceful drain of one node: stop new
+        placement immediately, then — on a coordinator thread — drain
+        serve replicas out of routing, let running tasks finish,
+        migrate dedicated actors without charging restart budgets, and
+        re-home sole-copy objects, all under `deadline_s`. Returns a
+        status snapshot; with wait=True, blocks until the drain settles
+        (DRAINED / DEADLINE_EXCEEDED / NODE_DIED)."""
+        from .config import ray_config
+        if deadline_s is None:
+            deadline_s = float(ray_config.drain_deadline_s)
+        entry = self.node_registry.get(node_id_hex)
+        if entry is None:
+            raise ValueError(f"unknown node {node_id_hex[:16]}")
+        if entry.is_head:
+            raise ValueError("cannot drain the head node")
+        with self._drain_lock:
+            st = self._drains.get(node_id_hex)
+            if st is None or st["state"] != "DRAINING":
+                st = {"node_id": node_id_hex, "state": "DRAINING",
+                      "started_at": time.time(),
+                      "deadline_s": float(deadline_s),
+                      "daemon_ack": False, "objects_remaining": -1,
+                      "tasks_remaining": -1, "replicas_drained": 0,
+                      "error": None}
+                thread = threading.Thread(
+                    target=self._drain_worker, args=(node_id_hex, st),
+                    daemon=True, name=f"drain-{node_id_hex[:8]}")
+                st["_thread"] = thread
+                self._drains[node_id_hex] = st
+                # Placement stops BEFORE the coordinator starts: from
+                # here every death on the node is drain-attributed.
+                self._draining_nodes.add(node_id_hex)
+                self.node_registry.set_draining(node_id_hex, True)
+                thread.start()
+        thread = st.get("_thread")
+        if wait and thread is not None:
+            thread.join(float(deadline_s) + 10.0)
+        return self.drain_status(node_id_hex)
+
+    def drain_status(self, node_id_hex: Optional[str] = None):
+        """Snapshot of one drain (dict or None) or all drains keyed by
+        node id."""
+        def _pub(st):
+            return {k: v for k, v in st.items()
+                    if not k.startswith("_")}
+        with self._drain_lock:
+            if node_id_hex is not None:
+                st = self._drains.get(node_id_hex)
+                return _pub(st) if st is not None else None
+            return {n: _pub(st) for n, st in self._drains.items()}
+
+    def _on_drain_status(self, payload: dict):
+        """DRAIN_STATUS from the draining daemon (ack/progress)."""
+        node = payload.get("node_id")
+        with self._drain_lock:
+            st = self._drains.get(node)
+            if st is not None:
+                st["daemon_ack"] = True
+
+    def _drain_worker(self, node_hex: str, st: dict):
+        deadline = time.monotonic() + float(st["deadline_s"])
+
+        def remaining() -> float:
+            return deadline - time.monotonic()
+
+        ok = True
+        try:
+            # Phase 1 — daemon notice (oneway; its DRAIN_STATUS reply
+            # flips daemon_ack). A daemon that dies right here (the
+            # drain-vs-SIGKILL race) degrades to node-death semantics
+            # via _on_daemon_lost.
+            handle = self.head_server.daemons.get(node_hex)
+            if handle is not None and handle.alive:
+                try:
+                    handle.send(P.DRAIN_NODE, {
+                        "node_id": node_hex,
+                        "deadline_s": st["deadline_s"]})
+                except Exception:  # lint: broad-except-ok dying daemon pipe; loss path owns it
+                    pass
+            # Phase 2 — serve replicas: out of routing first, in-flight
+            # requests complete, then stop (zero failed requests).
+            ok = self._drain_serve_replicas(node_hex, st, remaining) \
+                and ok
+            # Phase 3 — running (non-actor) tasks finish; no new ones
+            # can land (placement already filtered).
+            ok = self._drain_wait_tasks(node_hex, st, remaining) and ok
+            # Phase 4 — migrate dedicated actors: kill their workers;
+            # the drain-aware death path restarts them elsewhere
+            # without charging max_restarts, and in-flight calls (both
+            # planes) requeue uncharged.
+            ok = self._drain_migrate_actors(node_hex, st, remaining) \
+                and ok
+            # Phase 5 — re-home primary object copies (last: nothing
+            # produces on the node anymore).
+            ok = self._drain_rehome_objects(node_hex, st, remaining) \
+                and ok
+        except Exception as e:  # lint: broad-except-ok coordinator thread must always settle the status
+            ok = False
+            st["error"] = repr(e)
+        entry = self.node_registry.get(node_hex)
+        if entry is None or not entry.alive:
+            st["state"] = "NODE_DIED"
+        elif ok:
+            st["state"] = "DRAINED"
+        else:
+            st["state"] = "DEADLINE_EXCEEDED"
+        if telemetry.enabled:
+            telemetry.record_drain_progress(
+                node_hex, max(0, st["objects_remaining"]),
+                max(0, st["tasks_remaining"]), 0)
+
+    def _drain_serve_replicas(self, node_hex: str, st: dict,
+                              remaining) -> bool:
+        """Ask the serve controller (if any) to drain the node's
+        replicas: long-poll routing update first, queues empty, then
+        stop; the controller's reconcile starts replacements off-node."""
+        from ..api import get, get_actor
+        try:
+            ctrl = get_actor("SERVE_CONTROLLER")
+        except Exception:  # lint: broad-except-ok no controller registered == serve not running; nothing to drain
+            return True
+        try:
+            budget = max(1.0, remaining())
+            drained = get(ctrl.drain_node.remote(node_hex),
+                          timeout=budget)
+            st["replicas_drained"] = int(drained or 0)
+            return True
+        except Exception as e:  # lint: broad-except-ok controller may be mid-teardown; drain degrades
+            st["error"] = f"serve drain: {e!r}"
+            return remaining() > 0
+
+    def _drain_wait_tasks(self, node_hex: str, st: dict,
+                          remaining) -> bool:
+        """Wait for the node's running plain tasks to finish under the
+        budget (dedicated actors migrate in the next phase)."""
+        while True:
+            handle = self.head_server.daemons.get(node_hex)
+            if handle is None or not handle.alive:
+                return False
+            n = sum(len(p.running) for p in list(handle.proxies.values())
+                    if p.alive and p.dedicated_actor is None)
+            st["tasks_remaining"] = n
+            if telemetry.enabled:
+                telemetry.record_drain_progress(
+                    node_hex, max(0, st["objects_remaining"]), n, 0)
+            if n == 0:
+                return True
+            if remaining() <= 0:
+                return False
+            time.sleep(0.05)
+
+    def _drain_migrate_actors(self, node_hex: str, st: dict,
+                              remaining) -> bool:
+        """Kill the node's dedicated-actor workers; the drain-attributed
+        death path reschedules each actor off-node without charging its
+        restart budget. Waits until the deaths are processed."""
+        handle = self.head_server.daemons.get(node_hex)
+        if handle is None or not handle.alive:
+            return False
+        victims = [p for p in list(handle.proxies.values())
+                   if p.alive and p.dedicated_actor is not None]
+        for p in victims:
+            try:
+                p.kill()
+            except Exception:  # lint: broad-except-ok worker already gone; death path owns it
+                pass
+        # Wait for death_handled, NOT `alive`: kill() flips alive
+        # optimistically at send time, but the drain-attributed restart
+        # only runs once the daemon reports WORKER_DIED. If the daemon
+        # was SIGKILLed instead (the drain-vs-kill race), that report
+        # never comes — the node-loss path eventually fails the proxies
+        # (charged, NODE_DIED), which is exactly the degradation the
+        # protocol promises.
+        while not all(p.death_handled for p in victims):
+            if remaining() <= 0:
+                return False
+            time.sleep(0.05)
+        return True
+
+    def _drain_rehome_objects(self, node_hex: str, st: dict,
+                              remaining) -> bool:
+        """Re-home every primary copy whose only location is the
+        draining node: push to a live peer daemon (LOCALIZE_OBJECT)
+        when one exists, else pull into the head store, then swap the
+        directory location. Loops until the node holds no primaries.
+        Each object is incref-pinned for the copy so a concurrent free
+        can't race the transfer (symmetric decref keeps the refdebug
+        ledger conserved)."""
+        head_hex = self.node_id.hex()
+        while True:
+            prim = self.gcs.objects.primaries_on_node(node_hex)
+            st["objects_remaining"] = len(prim)
+            if telemetry.enabled:
+                telemetry.record_drain_progress(
+                    node_hex, len(prim), max(0, st["tasks_remaining"]),
+                    0)
+            if not prim:
+                return True
+            if remaining() <= 0:
+                return False
+            peers = [h for h in self.head_server.all_daemons()
+                     if h.alive
+                     and h.node_id_hex not in self._draining_nodes]
+            for i, (oid, size) in enumerate(prim):
+                if remaining() <= 0:
+                    return False
+                self.gcs.objects.incref(oid)
+                try:
+                    new_loc = None
+                    if peers:
+                        peer = peers[i % len(peers)]
+                        try:
+                            peer.request(
+                                P.LOCALIZE_OBJECT,
+                                {"object_id": oid, "node": node_hex},
+                                timeout=max(1.0, remaining()))
+                            new_loc = (P.LOC_SHM, size,
+                                       peer.node_id_hex)
+                        except Exception:  # lint: broad-except-ok peer push failed; head pull below
+                            new_loc = None
+                    if new_loc is None:
+                        self._ensure_local(oid, node_hex)
+                        new_loc = (P.LOC_SHM, size, head_hex)
+                    self.gcs.objects.relocate(oid, node_hex, new_loc)
+                except Exception:  # lint: broad-except-ok freed/lost mid-copy; next pass re-checks
+                    pass
+                finally:
+                    self.gcs.objects.decref(oid)
 
     def _all_worker_handles(self):
         handles = list(self.pool.workers.values())
@@ -1517,6 +1775,11 @@ class Node:
                 except Exception:  # lint: broad-except-ok dying callee pipe; its gate dies with it
                     pass
         aid = handle.dedicated_actor
+        # Planned removal: a death on a DRAINING node is the cluster's
+        # fault — downstream failure paths migrate without charging
+        # retry budgets (empty set ⇒ one falsy check).
+        drain = bool(self._draining_nodes) and (
+            getattr(handle, "node_id_hex", None) in self._draining_nodes)
         # Drain via atomic popitem: a concurrent send-failure branch in
         # _dispatch also pops, and each spec must be owned by exactly
         # one failure path.
@@ -1529,14 +1792,16 @@ class Node:
             running[k] = v
         if aid is not None:
             self._on_actor_worker_death(aid, running,
-                                        handle.worker_id.binary())
+                                        handle.worker_id.binary(),
+                                        drain=drain)
             return
         for spec in running.values():
             self.scheduler.release_task_resources(spec)
-            self._handle_worker_failure_for_task(spec)
+            self._handle_worker_failure_for_task(spec, drain=drain)
         self.scheduler.notify_worker_free()
 
-    def _handle_worker_failure_for_task(self, spec: P.TaskSpec):
+    def _handle_worker_failure_for_task(self, spec: P.TaskSpec,
+                                        drain: bool = False):
         if spec.task_id.binary() in self._cancel_requested:
             blob = serialization.dumps(
                 TaskCancelledError(spec.task_id.hex()))
@@ -1547,7 +1812,9 @@ class Node:
             return
         # Streaming tasks are not retryable (consumed items can't be
         # replayed coherently) — their worker death ends the stream.
-        if not spec.streaming and self._retry_budget(spec):
+        # Drain-driven deaths resubmit WITHOUT consulting (or charging)
+        # the retry ledger: the node was leaving, not the task failing.
+        if not spec.streaming and (drain or self._retry_budget(spec)):
             self._resubmit(spec)
         else:
             reason = "streams are not retryable" if spec.streaming \
@@ -1562,8 +1829,14 @@ class Node:
                 "task_id": spec.task_id.hex(), "name": spec.name,
                 "state": "FAILED", "attempt": self._attempt_of(spec),
                 "ts": time.time()})
-            blob = serialization.dumps(WorkerCrashedError(
-                f"The worker running task {spec.name} died ({reason})."))
+            if drain:
+                err: Exception = NodeDrainedError(
+                    message=f"The node running task {spec.name} was "
+                    f"drained and the task could not migrate ({reason}).")
+            else:
+                err = WorkerCrashedError(
+                    f"The worker running task {spec.name} died ({reason}).")
+            blob = serialization.dumps(err)
             if spec.streaming:
                 self._finish_gen_stream(spec.task_id, None, blob)
             self._register_error_returns(spec, blob)
@@ -1571,18 +1844,28 @@ class Node:
 
     def _on_actor_worker_death(self, actor_id: ActorID,
                                running: Dict[bytes, P.TaskSpec],
-                               dead_wid: Optional[bytes] = None):
+                               dead_wid: Optional[bytes] = None,
+                               drain: bool = False):
         st = self._actors.get(actor_id)
         entry = self.gcs.actors.get(actor_id)
         if st is None or entry is None:
             return
         self.scheduler.release_task_resources(st.spec)
-        blob = serialization.dumps(ActorDiedError(
-            f"Actor {actor_id.hex()}'s worker process died."))
+        if drain:
+            blob = serialization.dumps(NodeDrainedError(
+                message=f"Actor {actor_id.hex()}'s node was drained "
+                "and the actor could not migrate."))
+        else:
+            blob = serialization.dumps(ActorDiedError(
+                f"Actor {actor_id.hex()}'s worker process died."))
         with st.lock:
             already_dead = st.dead
+        # A drain migration restarts regardless of (and without
+        # charging) the max_restarts budget — planned removal is the
+        # cluster's fault, not the actor's.
         will_restart = (not already_dead
-                        and entry.restarts_used < st.spec.max_restarts)
+                        and (drain or entry.restarts_used
+                             < st.spec.max_restarts))
         # In-flight tasks with retry budget survive a restart: they
         # re-queue on the actor and run after the creation replay
         # (reference: max_task_retries — TaskManager resubmits actor
@@ -1600,7 +1883,7 @@ class Node:
                 spec.task_id.binary(), None) == dead_wid)
             if (will_restart and not spec.streaming
                     and spec.task_id.binary() not in self._cancel_requested
-                    and (prepaid or self._retry_budget(spec))):
+                    and (prepaid or drain or self._retry_budget(spec))):
                 retry_specs.append(spec)
                 continue
             if spec.streaming:
@@ -1617,7 +1900,7 @@ class Node:
             # Elastic restart: replay the creation spec on a fresh worker
             # (reference: GcsActorManager restart path; state transitions in
             # gcs.proto ActorTableData).
-            self.gcs.actors.set_restarting(actor_id)
+            self.gcs.actors.set_restarting(actor_id, charge=not drain)
             with st.lock:
                 st.ready = False
                 st.worker = None
@@ -2091,7 +2374,14 @@ class Node:
                 self.gcs.objects.apply_delta(rid, d)
             alive = (st is not None and entry is not None and not st.dead
                      and entry.state != gcs_mod.ACTOR_DEAD)
-            if alive and not spec.streaming and self._retry_budget(spec):
+            # Channel death caused by a node DRAIN: requeue without
+            # charging the ledger (same no-fault rule as the worker
+            # death paths).
+            drain = bool(self._draining_nodes) and st is not None and (
+                self.scheduler.node_of_task(st.spec)
+                in self._draining_nodes)
+            if alive and not spec.streaming and (
+                    drain or self._retry_budget(spec)):
                 self.gcs.record_task_event({
                     "task_id": spec.task_id.hex(), "name": spec.name,
                     "state": "PENDING_SCHEDULING",
@@ -2119,10 +2409,21 @@ class Node:
                 self._enqueue_actor_task(st, spec)
                 out.append({"status": "requeued"})
             else:
+                if drain and entry is not None \
+                        and entry.creation_error is None:
+                    # Typed drain reason on the direct plane: the caller
+                    # prefers this reply blob over its local
+                    # ActorDiedError (the PR 6 settlement path).
+                    fallback = serialization.dumps(NodeDrainedError(
+                        message=f"Actor {actor_id.hex()}'s node was "
+                        f"drained with direct call {spec.name} in "
+                        "flight and the call could not migrate"))
+                else:
+                    fallback = serialization.dumps(ActorDiedError(
+                        f"Actor {actor_id.hex()} died with direct "
+                        f"call {spec.name} in flight"))
                 blob = (entry.creation_error if entry is not None
-                        else None) or serialization.dumps(ActorDiedError(
-                            f"Actor {actor_id.hex()} died with direct "
-                            f"call {spec.name} in flight"))
+                        else None) or fallback
                 self.gcs.record_task_event({
                     "task_id": spec.task_id.hex(), "name": spec.name,
                     "state": "FAILED",
@@ -2468,7 +2769,9 @@ class Node:
         if op == "list_actors":
             return [{"actor_id": e.spec.actor_id.hex(),
                      "class_name": e.spec.cls_id.split(":")[0],
-                     "state": e.state, "name": e.spec.name}
+                     "state": e.state, "name": e.spec.name,
+                     "node_id": self.scheduler.node_of_task(e.spec),
+                     "restarts_used": e.restarts_used}
                     for e in self.gcs.actors.list()]
         if op == "task_events":
             return self.gcs.task_events()
@@ -2550,6 +2853,12 @@ class Node:
             return {"demands": demands, "placement_groups": pending_pgs}
         if op == "list_nodes":
             return self.node_registry.snapshot()
+        if op == "drain_node":
+            return self.drain_node(kwargs["node_id"],
+                                   deadline_s=kwargs.get("deadline_s"),
+                                   wait=bool(kwargs.get("wait", False)))
+        if op == "drain_status":
+            return self.drain_status(kwargs.get("node_id"))
         if op == "pg_create":
             e = self.pg_manager.create(
                 kwargs["pg_id_hex"], kwargs["bundles"], kwargs["strategy"],

@@ -113,8 +113,8 @@ class ResourceManager:
 
 
 class NodeEntry:
-    __slots__ = ("node_id_hex", "rm", "alive", "start_time", "is_head",
-                 "daemon", "labels")
+    __slots__ = ("node_id_hex", "rm", "alive", "draining", "start_time",
+                 "is_head", "daemon", "labels")
 
     def __init__(self, node_id_hex: str, rm: ResourceManager,
                  is_head: bool = False, daemon=None,
@@ -123,6 +123,11 @@ class NodeEntry:
         self.node_id_hex = node_id_hex
         self.rm = rm
         self.alive = True
+        # DRAINING: the node is alive but leaving (planned removal).
+        # No NEW placement lands on it; running work finishes or
+        # migrates (reference: gcs_node_manager DrainNode — a drained
+        # node keeps serving until its lease budget expires).
+        self.draining = False
         self.start_time = time.time()
         self.is_head = is_head
         # Real per-host daemon backing this node (node_service.DaemonHandle);
@@ -134,6 +139,13 @@ class NodeEntry:
         # "ray.io/node_id" label always resolves.
         self.labels = dict(labels or {})
         self.labels.setdefault("ray.io/node_id", node_id_hex)
+
+    @property
+    def schedulable(self) -> bool:
+        """New placement may land here: alive and not draining.
+        Liveness-facing paths (release, aggregate, heartbeats) keep
+        using `alive` — a draining node still runs what it has."""
+        return self.alive and not self.draining
 
 
 from ..util.scheduling_strategies import (DoesNotExist, Exists, In,
@@ -204,6 +216,18 @@ class NodeRegistry:
         with self._lock:
             return self._nodes.get(node_id_hex)
 
+    def set_draining(self, node_id_hex: str,
+                     draining: bool = True) -> bool:
+        """Flip a node's DRAINING flag (planned removal). Placement
+        filters exclude draining nodes immediately; `alive` is
+        untouched so running work keeps its resource accounting."""
+        with self._lock:
+            entry = self._nodes.get(node_id_hex)
+            if entry is None or entry.is_head:
+                return False
+            entry.draining = bool(draining)
+            return True
+
     def remove_node(self, node_id_hex: str) -> Optional[NodeEntry]:
         with self._lock:
             entry = self._nodes.get(node_id_hex)
@@ -259,7 +283,7 @@ class NodeRegistry:
         if not self._multi_node:
             # Single node: nothing to score (the sync-task hot path).
             return [self.head] if self.head.alive else []
-        alive = [e for e in self.entries() if e.alive]
+        alive = [e for e in self.entries() if e.schedulable]
         if len(alive) <= 1:
             return alive
         from .config import ray_config
@@ -314,17 +338,17 @@ class NodeRegistry:
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             with self._lock:
                 target = self._nodes.get(strategy.node_id)
-            if target is not None and target.alive:
+            if target is not None and target.schedulable:
                 if strategy.soft or strategy._spill_on_unavailable:
                     rest = [e for e in self.entries()
-                            if e.alive and e is not target]
+                            if e.schedulable and e is not target]
                     return [target] + rest
                 return [target]
             if strategy.soft:
-                return [e for e in self.entries() if e.alive]
+                return [e for e in self.entries() if e.schedulable]
             return []
         if isinstance(strategy, NodeLabelSchedulingStrategy):
-            alive = [e for e in self.entries() if e.alive]
+            alive = [e for e in self.entries() if e.schedulable]
             hard = [e for e in alive
                     if _labels_match(e.labels, strategy.hard)]
             if not strategy.soft:
@@ -339,7 +363,7 @@ class NodeRegistry:
             # SUCCESSFUL dispatch only (note_spread_grant) — a grant
             # that fails for lack of a worker must not burn the node's
             # turn, or fast-path/slow-path aliasing can starve a node.
-            alive = [e for e in self.entries() if e.alive]
+            alive = [e for e in self.entries() if e.schedulable]
             if not alive:
                 return []
             start = self._spread_rr % len(alive)
@@ -350,7 +374,7 @@ class NodeRegistry:
     def note_spread_grant(self, node_id_hex: str):
         """A SPREAD task was dispatched onto `node_id_hex`: rotate the
         round-robin cursor past it."""
-        alive = [e for e in self.entries() if e.alive]
+        alive = [e for e in self.entries() if e.schedulable]
         for i, e in enumerate(alive):
             if e.node_id_hex == node_id_hex:
                 with self._lock:
@@ -369,14 +393,19 @@ class NodeRegistry:
                 return None
             with self._lock:
                 target = self._nodes.get(strategy.node_id)
-            if target is None or not target.alive:
+            if target is None or not target.schedulable:
+                if target is None:
+                    what = "unknown"
+                elif not target.alive:
+                    what = "dead"
+                else:
+                    what = "draining"
                 return (f"NodeAffinitySchedulingStrategy: node "
-                        f"{strategy.node_id[:16]} is "
-                        f"{'dead' if target is not None else 'unknown'} "
+                        f"{strategy.node_id[:16]} is {what} "
                         f"and soft=False")
         if isinstance(strategy, NodeLabelSchedulingStrategy):
             if not any(_labels_match(e.labels, strategy.hard)
-                       for e in self.entries() if e.alive):
+                       for e in self.entries() if e.schedulable):
                 return ("NodeLabelSchedulingStrategy: no alive node "
                         f"matches hard labels {strategy.hard!r}")
         return None
@@ -388,7 +417,9 @@ class NodeRegistry:
             entry.rm.release(demand)
 
     def feasible(self, demand: Dict[str, float]) -> bool:
-        return any(e.alive and e.rm.feasible(demand)
+        # Draining nodes are about to leave — demand only they could
+        # satisfy must park (autoscaler grace) or fail fast, not land.
+        return any(e.schedulable and e.rm.feasible(demand)
                    for e in self.entries())
 
     def aggregate(self) -> Tuple[Dict[str, float], Dict[str, float]]:
@@ -409,6 +440,7 @@ class NodeRegistry:
         for e in self.entries():
             t, a = e.rm.snapshot()
             row = {"node_id": e.node_id_hex, "alive": e.alive,
+                   "draining": e.draining,
                    "is_head": e.is_head, "resources_total": t,
                    "resources_available": a,
                    "start_time": e.start_time}

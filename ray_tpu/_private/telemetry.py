@@ -226,6 +226,27 @@ def record_node_stats(store_used: int, num_workers: int,
             "Unassigned TPU chips on this node").set(free_chips)
 
 
+def record_drain_progress(node_id_hex: str, objects_remaining: int,
+                          tasks_remaining: int,
+                          replicas_remaining: int) -> None:
+    """Drain-progress gauges for one draining node (docs/DRAIN.md):
+    how much work still pins the node. All zero ⇒ safe to terminate.
+    Only emitted while a drain is active — steady state never touches
+    these."""
+    global _ops
+    _ops += 1
+    tags = {"node_id": node_id_hex[:16]}
+    _metric("drain_objects_remaining", "gauge",
+            "Primary object copies still to re-home off a draining node",
+            tag_keys=("node_id",)).set(objects_remaining, tags=tags)
+    _metric("drain_tasks_remaining", "gauge",
+            "Running tasks still finishing on a draining node",
+            tag_keys=("node_id",)).set(tasks_remaining, tags=tags)
+    _metric("drain_replicas_remaining", "gauge",
+            "Serve replicas still draining on a draining node",
+            tag_keys=("node_id",)).set(replicas_remaining, tags=tags)
+
+
 # -- direct worker<->worker call plane --------------------------------------
 def record_direct_calls(n: int) -> None:
     """Actor calls shipped on direct channels (batched at the plane's

@@ -79,6 +79,7 @@ class Instance:
     node_id_hex: Optional[str] = None
     created_at: float = field(default_factory=time.time)
     updated_at: float = field(default_factory=time.time)
+    idle_since: Optional[float] = None  # first idle-past-timeout sighting
     handle: Optional[object] = None  # provider-private
 
     def transition(self, status: str):
@@ -265,6 +266,22 @@ class InstanceManager:
             except Exception:
                 inst.transition(TERMINATED)
         for inst in drains:
+            # Graceful first: ask the head to drain the node — stop new
+            # placement, let running tasks finish, migrate actors
+            # without charging restart budgets, re-home sole object
+            # copies, pull serve replicas out of routing (docs/DRAIN.md)
+            # — and only then release the machine. A failed or
+            # deadline-expired drain falls back to plain termination;
+            # the ordinary node-death paths own cleanup from there.
+            if inst.node_id_hex:
+                try:
+                    from .._private.config import ray_config
+                    self._rt.gcs_request(
+                        "drain_node", node_id=inst.node_id_hex,
+                        deadline_s=float(ray_config.drain_deadline_s),
+                        wait=True)
+                except Exception:  # lint: broad-except-ok drain is best-effort; terminate below regardless
+                    pass
             try:
                 self.provider.terminate(inst)
             finally:
@@ -297,12 +314,13 @@ class InstanceManager:
                       > self.ALLOCATE_TIMEOUT_S):
                     # Machine up but never registered (bad address,
                     # network): stop counting it toward capacity so a
-                    # replacement can launch.
-                    try:
-                        self.provider.terminate(inst)
-                    except Exception:
-                        pass
+                    # replacement can launch. The provider call
+                    # (process kill + wait, potentially seconds) must
+                    # NOT run here — callers hold the lock — so release
+                    # the machine via the dead list, exactly like
+                    # externally-died daemons below.
                     inst.transition(TERMINATED)
+                    self._pending_dead_terminations.append(inst)
             elif inst.status == RAY_RUNNING:
                 # Instance whose daemon died externally: reconcile out.
                 # The machine itself still needs releasing — for cloud
@@ -336,8 +354,20 @@ class InstanceManager:
                 continue
             if self._node_busy(inst.node_id_hex):
                 inst.updated_at = now
+                inst.idle_since = None
                 continue
             if now - inst.updated_at < self.idle_timeout_s:
+                continue
+            # Idle past the timeout: require it to STAY idle for a
+            # further grace window before draining, so an oscillating
+            # workload whose gaps straddle the timeout doesn't churn
+            # nodes (terminate, relaunch seconds later).
+            from .._private.config import ray_config
+            if inst.idle_since is None:
+                inst.idle_since = now
+                continue
+            if now - inst.idle_since < float(
+                    ray_config.scale_down_idle_grace_s):
                 continue
             nt = self._config.node_types.get(inst.instance_type)
             floor = nt.min_workers if nt else 0
@@ -369,14 +399,19 @@ class InstanceManager:
         if self._grace_cell[0]:
             self._grace_cell[0] = False
             _grace_release()
+        victims: List[Instance] = []
         with self._lock:
             for inst in self._live_instances():
                 if inst.status in (ALLOCATED, RAY_RUNNING, RAY_STOPPING):
-                    try:
-                        self.provider.terminate(inst)
-                    except Exception:
-                        pass
+                    victims.append(inst)
                 inst.transition(TERMINATED)
+        # Provider calls (SHUTDOWN_NODE + process wait, seconds each)
+        # run OUTSIDE the lock — same discipline as reconcile().
+        for inst in victims:
+            try:
+                self.provider.terminate(inst)
+            except Exception:  # lint: broad-except-ok best-effort machine release at shutdown
+                pass
 
 
 def _maybe_release(cell):

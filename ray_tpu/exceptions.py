@@ -106,6 +106,20 @@ class NodeDiedError(RayError):
             "to it were aborted.")
 
 
+class NodeDrainedError(NodeDiedError):
+    """A cluster node was removed by a *planned* drain (autoscaler
+    scale-down or `ray_tpu drain`). Work that could not migrate within
+    the drain deadline fails with this instead of the unplanned-death
+    errors; retry budgets are never charged for drain-driven migration
+    (reference: gcs_node_manager DrainNode + autoscaler-v2 drain)."""
+
+    def __init__(self, node_id_hex: str = "", message: str | None = None):
+        super().__init__(
+            node_id_hex,
+            message or f"Node {node_id_hex[:8]} was drained; operations "
+            "still bound to it were aborted.")
+
+
 class ObjectStoreFullError(RayError):
     """The object store is out of memory and eviction could not make room."""
 

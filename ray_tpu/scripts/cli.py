@@ -144,6 +144,26 @@ def cmd_list(args):
     return 0
 
 
+def cmd_drain(args):
+    """Gracefully drain a node (docs/DRAIN.md): stop new placement, let
+    running work finish/migrate without charging retry budgets, re-home
+    sole object copies, pull serve replicas out of routing — then print
+    the final drain status. `--status` only inspects."""
+    call = _backend(args)
+    if args.status:
+        st = call("drain_status", node_id=args.node_id or None)
+        print(json.dumps(st, indent=2, default=str))
+        return 0
+    if not args.node_id:
+        print("error: drain requires a node id (or --status)",
+              file=sys.stderr)
+        return 2
+    st = call("drain_node", node_id=args.node_id,
+              deadline_s=args.deadline, wait=not args.no_wait)
+    print(json.dumps(st, indent=2, default=str))
+    return 0 if st.get("state") in ("DRAINING", "DRAINED") else 1
+
+
 def cmd_summary(args):
     call = _backend(args)
     print(json.dumps({
@@ -372,6 +392,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("summary", help="task/actor/object summaries")
     add_address(sp)
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("drain", help="gracefully drain a node "
+                        "(zero-loss scale-down; see docs/DRAIN.md)")
+    sp.add_argument("node_id", nargs="?", default=None,
+                    help="hex node id (see `ray_tpu list nodes`)")
+    sp.add_argument("--deadline", type=float, default=None,
+                    help="seconds before falling back to hard removal "
+                    "(default: drain_deadline_s)")
+    sp.add_argument("--no-wait", action="store_true",
+                    help="start the drain and return immediately")
+    sp.add_argument("--status", action="store_true",
+                    help="print drain status instead of draining")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("metrics", help="federated cluster metrics "
                         "(Prometheus text, node_id/worker_id tagged)")

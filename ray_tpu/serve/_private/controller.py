@@ -146,6 +146,60 @@ class ServeController:
             for name, st in self._deployments.items()
         }
 
+    async def drain_node(self, node_id_hex: str) -> int:
+        """Pull every replica living on `node_id_hex` out of routing,
+        wait for their in-flight requests to finish, then stop them.
+
+        Order matters for the zero-failed-requests guarantee: routers
+        learn the shrunken membership over long-poll *before* any
+        replica dies, so no new request is dispatched to a victim, and
+        victims are only killed once their queue reports empty.
+        Replacement replicas come back via the ordinary reconcile loop
+        (the scheduler refuses draining nodes, so they land elsewhere).
+        """
+        self._ensure_loop_task()
+        loop = asyncio.get_event_loop()
+        try:
+            from ray_tpu.util.state import list_actors
+            rows = await loop.run_in_executor(None, list_actors)
+        except Exception:  # lint: broad-except-ok state API unreachable -> nothing to map, drain 0
+            rows = []
+        on_node = {r["actor_id"] for r in rows
+                   if r.get("node_id") == node_id_hex}
+        victims = []
+        for name, st in self._deployments.items():
+            keep = [r for r in st.replicas
+                    if r._actor_id.hex() not in on_node]
+            drop = [r for r in st.replicas
+                    if r._actor_id.hex() in on_node]
+            if drop:
+                st.replicas = keep
+                self._long_poll.notify_changed(
+                    f"replicas::{name}", list(st.replicas))
+                victims.extend(drop)
+        drained = 0
+        for v in victims:
+            # Wait until the replica is idle, then require one more
+            # empty reading after a short settle so a request that a
+            # router dispatched just before it saw the long-poll update
+            # is not raced by the kill.
+            try:
+                while True:
+                    if await v.get_queue_len.remote() == 0:
+                        await asyncio.sleep(0.2)
+                        if await v.get_queue_len.remote() == 0:
+                            break
+                    else:
+                        await asyncio.sleep(0.05)
+            except Exception:  # lint: broad-except-ok replica already dead: nothing in flight
+                pass
+            try:
+                ray_tpu.kill(v)
+            except Exception:  # lint: broad-except-ok racing actor death; kill is idempotent
+                pass
+            drained += 1
+        return drained
+
     # -- reconciliation ----------------------------------------------------
     async def _stop_deployment(self, name: str):
         st = self._deployments.get(name)
@@ -267,7 +321,8 @@ class ServeController:
             self._proxy_errors["_list_nodes"] = traceback.format_exc()
             return
         rows = [n for n in nodes
-                if n.get("alive", True) and not n.get("is_head")]
+                if n.get("alive", True) and not n.get("is_head")
+                and not n.get("draining")]
         alive = {n["node_id"] for n in rows}
         # The head records each daemon's reachable peer IP at
         # registration; a proxy bound to 0.0.0.0 must be advertised at

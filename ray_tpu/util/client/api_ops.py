@@ -30,6 +30,10 @@ def registry() -> Dict[str, Callable[..., Any]]:
         "list_objects": state.list_objects,
         "list_workers": state.list_workers,
         "list_placement_groups": state.list_placement_groups,
+        # Graceful drain (docs/DRAIN.md): runs ON the head — the CLI can
+        # fire-and-poll a drain against a remote cluster.
+        "drain_node": state.drain_node,
+        "drain_status": state.drain_status,
         "summarize_tasks": state.summarize_tasks,
         "summarize_actors": state.summarize_actors,
         "summarize_objects": state.summarize_objects,

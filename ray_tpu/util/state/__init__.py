@@ -115,6 +115,26 @@ def list_placement_groups(filters=None,
     return rows[:limit]
 
 
+# -- node drain (reference: the `ray drain-node` CLI / DrainNode RPC in
+#    gcs_node_manager.cc; here the head's drain coordinator owns the
+#    protocol — see docs/DRAIN.md) ---------------------------------------
+def drain_node(node_id: str, deadline_s: Optional[float] = None,
+               wait: bool = True) -> Dict[str, Any]:
+    """Begin a graceful drain of `node_id`: stop new placement, let
+    running tasks finish, migrate actors without charging restart
+    budgets, re-home sole object copies, pull serve replicas out of
+    routing. Returns the drain-status dict (state DRAINING / DRAINED /
+    DEADLINE_EXCEEDED / NODE_DIED); with wait=True it reflects the
+    final state."""
+    return _gcs("drain_node", node_id=node_id, deadline_s=deadline_s,
+                wait=wait)
+
+
+def drain_status(node_id: Optional[str] = None):
+    """Status dict for one drain, or all drains when node_id is None."""
+    return _gcs("drain_status", node_id=node_id)
+
+
 # -- summaries (reference: state/api.py summarize_*) ------------------------
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
     by_name: Dict[str, Dict[str, int]] = defaultdict(
@@ -191,7 +211,7 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     return trace
 
 
-__all__ = ["list_actors", "list_nodes", "list_objects",
-           "list_placement_groups", "list_tasks", "list_workers",
-           "summarize_actors", "summarize_objects", "summarize_tasks",
-           "timeline"]
+__all__ = ["drain_node", "drain_status", "list_actors", "list_nodes",
+           "list_objects", "list_placement_groups", "list_tasks",
+           "list_workers", "summarize_actors", "summarize_objects",
+           "summarize_tasks", "timeline"]

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Fast CI gate (<60s): the static passes plus the dynamic zero-cost
+# guards. Catches the cheap-to-catch regressions (new lint violations,
+# disabled-plane overhead, gate-discipline drift) before the full
+# tier-1 run. See docs/STATIC_ANALYSIS.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== raylint (github annotations) =="
+python -m ray_tpu.devtools.lint --format github
+
+echo "== perf_smoke + lint-marked tests =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'perf_smoke or lint' \
+    -p no:cacheprovider -p no:xdist -p no:randomly

@@ -111,7 +111,7 @@ def _lockdep_guard(request, tmp_path_factory):
 # dir so a violation is attributable to the test that produced it
 # (these suites all build per-test clusters).
 _REFDEBUG_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
-                    "test_fault_injection"}
+                    "test_fault_injection", "test_drain"}
 
 
 @pytest.fixture(autouse=True)

@@ -267,6 +267,8 @@ class TestAutoscalerV2:
             InstanceManager,
         )
 
+        from ray_tpu._private.config import ray_config
+
         ray_tpu.init(num_cpus=1)
         # idle_timeout_s must comfortably exceed the get()->snapshot
         # window below: with 1.0s a final background reconcile could
@@ -276,6 +278,11 @@ class TestAutoscalerV2:
             node_types={"accel": {"resources": {"CPU": 1, "accel": 1},
                                   "max_workers": 2}},
             max_workers=2, idle_timeout_s=5.0)
+        # The production idle-grace default (5s) would just add dead
+        # wait to the scale-down leg; the grace window has its own
+        # dedicated test below.
+        saved_grace = ray_config.scale_down_idle_grace_s
+        ray_config.set("scale_down_idle_grace_s", 0.3)
         try:
             @ray_tpu.remote(resources={"accel": 1})
             def probe():
@@ -324,4 +331,148 @@ class TestAutoscalerV2:
                        for i in mgr.instances.values()), \
                 mgr.status_counts()
         finally:
+            ray_config.set("scale_down_idle_grace_s", saved_grace)
+            mgr.shutdown()
+
+    def test_allocate_timeout_terminates_outside_lock(self,
+                                                      shutdown_only):
+        """Regression: the ALLOCATE-timeout path must release the
+        machine OUTSIDE the manager lock — a slow provider.terminate
+        (cloud API, process wait) must not block every concurrent
+        launch decision."""
+        import threading
+
+        import ray_tpu
+        from ray_tpu.autoscaler.v2 import (
+            TERMINATED,
+            InstanceManager,
+            InstanceProvider,
+        )
+
+        class SlowTerminateProvider(InstanceProvider):
+            def __init__(self):
+                self.in_terminate = threading.Event()
+                self.release = threading.Event()
+
+            def allocate(self, instance, node_type_config):
+                instance.handle = {}
+
+            def running_node_id(self, instance):
+                return None  # never registers -> ALLOCATE timeout
+
+            def terminate(self, instance):
+                self.in_terminate.set()
+                self.release.wait(timeout=10)
+
+        ray_tpu.init(num_cpus=1)
+        provider = SlowTerminateProvider()
+        mgr = InstanceManager(
+            node_types={"w": {"resources": {"CPU": 1},
+                              "min_workers": 1, "max_workers": 1}},
+            provider=provider, max_workers=1, idle_timeout_s=60.0)
+        try:
+            mgr.reconcile()  # min_workers floor: queue -> ALLOCATED
+            inst = next(iter(mgr.instances.values()))
+            assert inst.status == "ALLOCATED"
+            inst.created_at -= mgr.ALLOCATE_TIMEOUT_S + 1
+            t = threading.Thread(target=mgr.reconcile, daemon=True)
+            t.start()
+            assert provider.in_terminate.wait(timeout=10)
+            # The slow provider call is in flight: the lock must be
+            # free for other launch decisions.
+            got = mgr._lock.acquire(timeout=0.5)
+            try:
+                assert got, ("reconcile held the manager lock across "
+                             "provider.terminate()")
+            finally:
+                if got:
+                    mgr._lock.release()
+            provider.release.set()
+            t.join(timeout=10)
+            assert inst.status == TERMINATED
+        finally:
+            provider.release.set()
+            mgr.shutdown()
+
+    def test_idle_grace_survives_oscillating_workload(self,
+                                                      shutdown_only):
+        """An instance idle past idle_timeout_s is NOT terminated until
+        it also stays idle for scale_down_idle_grace_s; any burst of
+        work fully re-arms both clocks."""
+        import time
+
+        import ray_tpu
+        from ray_tpu._private.config import ray_config
+        from ray_tpu.autoscaler.v2 import (
+            RAY_RUNNING,
+            TERMINATED,
+            InstanceManager,
+            InstanceProvider,
+        )
+
+        fake_hex = "ab" * 32
+
+        class FakeProvider(InstanceProvider):
+            def allocate(self, instance, node_type_config):
+                instance.handle = {}
+
+            def running_node_id(self, instance):
+                return fake_hex
+
+            def terminate(self, instance):
+                pass
+
+        class FakeRT:
+            """Just enough runtime surface for the reconcile loop."""
+            class _HS:
+                daemons = {fake_hex: object()}
+            head_server = _HS()
+
+            def gcs_request(self, op, **kw):
+                if op == "resource_demands":
+                    return {"demands": [], "placement_groups": []}
+                raise ValueError(op)  # drain of a fake node: degrade
+
+        ray_tpu.init(num_cpus=1)
+        saved = float(ray_config.scale_down_idle_grace_s)
+        ray_config.set("scale_down_idle_grace_s", 0.5)
+        busy = {"v": True}
+        mgr = InstanceManager(
+            node_types={"w": {"resources": {"CPU": 1},
+                              "max_workers": 1}},
+            provider=FakeProvider(), max_workers=1, idle_timeout_s=0.1)
+        mgr._rt = FakeRT()
+        mgr._node_busy = lambda node_hex: busy["v"]
+        try:
+            mgr._queue_instance("w")
+            mgr.reconcile()  # QUEUED -> ALLOCATED
+            mgr.reconcile()  # ALLOCATED -> RAY_RUNNING
+            inst = next(iter(mgr.instances.values()))
+            assert inst.status == RAY_RUNNING
+
+            # (1) idle past idle_timeout: grace arms, nothing dies.
+            busy["v"] = False
+            inst.updated_at = time.time() - 10
+            mgr.reconcile()
+            assert inst.status == RAY_RUNNING
+            assert inst.idle_since is not None
+
+            # (2) a burst before the grace expires resets everything.
+            busy["v"] = True
+            mgr.reconcile()
+            assert inst.idle_since is None
+            assert inst.status == RAY_RUNNING
+
+            # (3) idle again: a FRESH grace window holds it.
+            busy["v"] = False
+            inst.updated_at = time.time() - 10
+            mgr.reconcile()
+            assert inst.status == RAY_RUNNING
+
+            # (4) continuously idle past the grace: now it goes.
+            time.sleep(0.6)
+            mgr.reconcile()
+            assert inst.status == TERMINATED
+        finally:
+            ray_config.set("scale_down_idle_grace_s", saved)
             mgr.shutdown()

@@ -1,0 +1,288 @@
+"""Graceful node drain & decommission (docs/DRAIN.md).
+
+Tier-1: draining stops new placement, re-homes sole-copy primary
+objects, migrates dedicated actors WITHOUT charging restart budgets,
+and costs nothing when no drain is active. Chaos tier (slow): zero-loss
+scale-down under live serve + object load, and the drain-vs-SIGKILL
+race degrading to ordinary (charged) node-death semantics.
+
+Reference: the `ray drain-node` / DrainNode flow (gcs_node_manager.cc)
+the autoscaler uses for graceful scale-down.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu._private import fault
+from ray_tpu._private import state as _state
+from ray_tpu._private import telemetry
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+from ray_tpu.util.state import (drain_node, drain_status, list_actors,
+                                list_nodes)
+
+
+@pytest.fixture
+def clean_drain():
+    yield
+    fault.configure(None)
+    ray.shutdown()
+
+
+def test_drain_rehomes_sole_copy_objects(clean_drain):
+    """Objects whose ONLY primary copy lives on the draining node are
+    re-homed before the drain settles; a subsequent hard node removal
+    loses nothing."""
+    ray.init(num_cpus=1)
+    cluster = Cluster()
+    node = cluster.add_node(num_cpus=2, resources={"spot": 4},
+                            daemon=True)
+    try:
+        @ray.remote(resources={"spot": 1})
+        def make(i):
+            return np.full(50_000, float(i), dtype=np.float64)
+
+        refs = [make.remote(i) for i in range(4)]
+        ready, _ = ray.wait(refs, num_returns=4, timeout=60)
+        assert len(ready) == 4
+
+        st = drain_node(node.node_id, wait=True)
+        assert st["state"] == "DRAINED", st
+        assert st["objects_remaining"] == 0, st
+
+        # The machine leaves for real (SIGTERM, no graceful shutdown):
+        # the primaries were already re-homed, so every value survives.
+        cluster.remove_node(node, allow_graceful=False)
+        vals = ray.get(refs, timeout=60)
+        for i, v in enumerate(vals):
+            assert v.shape == (50_000,) and float(v[0]) == float(i)
+    finally:
+        cluster.shutdown()
+
+
+def test_drain_migrates_actor_without_charging_budget(clean_drain):
+    """A dedicated actor on the draining node restarts elsewhere with
+    `restarts_used` untouched — scale-down is not a fault."""
+    ray.init(num_cpus=0)
+    cluster = Cluster()
+    a = cluster.add_node(num_cpus=2, daemon=True)
+    b = cluster.add_node(num_cpus=2, daemon=True)
+    try:
+        @ray.remote(num_cpus=1, max_restarts=1, max_task_retries=2)
+        class Holder:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        h = Holder.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=a.node_id, soft=True)).remote()
+        assert ray.get(h.bump.remote(), timeout=60) == 1
+        row = next(r for r in list_actors()
+                   if r["class_name"].endswith("Holder"))
+        assert row["node_id"] == a.node_id
+        assert row["restarts_used"] == 0
+
+        st = drain_node(a.node_id, wait=True)
+        assert st["state"] == "DRAINED", st
+
+        # The soft affinity spills to the survivor; state reset is the
+        # ordinary restart contract, but the budget was NOT charged.
+        assert ray.get(h.bump.remote(), timeout=60) >= 1
+        row = next(r for r in list_actors()
+                   if r["actor_id"] == row["actor_id"])
+        assert row["node_id"] == b.node_id
+        assert row["restarts_used"] == 0
+    finally:
+        cluster.shutdown()
+
+
+def test_drain_stops_new_placement_and_is_visible(clean_drain):
+    """A DRAINED node stays alive but takes no new work — everything
+    lands on the survivor — and the state API exposes the drain."""
+    ray.init(num_cpus=0)
+    cluster = Cluster()
+    a = cluster.add_node(num_cpus=2, daemon=True)
+    b = cluster.add_node(num_cpus=2, daemon=True)
+    try:
+        st = drain_node(a.node_id, wait=True)
+        assert st["state"] == "DRAINED", st
+        # The daemon's DRAIN_STATUS ack travels async on the node link;
+        # an empty node settles faster than the ack lands.
+        deadline = time.monotonic() + 5
+        while (not drain_status(a.node_id)["daemon_ack"]
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert drain_status(a.node_id)["daemon_ack"] is True
+
+        rows = {r["node_id"]: r for r in list_nodes()}
+        assert rows[a.node_id]["draining"] is True
+        assert rows[a.node_id]["alive"] is True  # drained, not dead
+        assert rows[b.node_id]["draining"] is False
+
+        @ray.remote(num_cpus=1)
+        def f(i):
+            time.sleep(0.05)
+            return i
+
+        out = ray.get([f.remote(i) for i in range(6)], timeout=60)
+        assert out == list(range(6))
+        from ray_tpu.util.state import list_tasks
+        nodes_used = {r["node_id"] for r in list_tasks()}
+        assert a.node_id not in nodes_used
+        assert b.node_id in nodes_used
+
+        # Hard affinity to a draining node is permanently unplaceable:
+        # fail fast with the typed reason, not a silent park.
+        from ray_tpu.exceptions import TaskUnschedulableError
+        ref = f.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=a.node_id, soft=False)).remote(0)
+        with pytest.raises(TaskUnschedulableError, match="draining"):
+            ray.get(ref, timeout=30)
+
+        assert drain_status(a.node_id)["state"] == "DRAINED"
+        assert a.node_id in drain_status()
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.perf_smoke
+def test_no_drain_cost_when_inactive(clean_drain):
+    """Steady state pays nothing for the drain plane: no drain messages
+    on the wire, no coordinator state, after a normal workload."""
+    ray.init(num_cpus=2)
+    before = dict(telemetry.message_counts())  # process-global counters
+
+    @ray.remote
+    def f(x):
+        return x + 1
+
+    out = ray.get([f.remote(i) for i in range(50)], timeout=60)
+    assert out == list(range(1, 51))
+    rt = _state.current()
+    assert rt._drains == {}
+    assert not rt._draining_nodes
+    after = telemetry.message_counts()
+    for k in set(after) | set(before):
+        if "drain" in k:
+            assert after.get(k, 0) == before.get(k, 0), (k, before,
+                                                         after)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_scale_down_under_load_zero_loss(clean_drain):
+    """The acceptance run: drain a node hosting serve replicas and
+    sole-copy objects while requests keep flowing. Zero failed
+    requests, zero lost objects, zero charged restarts."""
+    from ray_tpu import serve
+    ray.init(num_cpus=1)
+    cluster = Cluster()
+    a = cluster.add_node(num_cpus=2, resources={"obj": 2}, daemon=True)
+    b = cluster.add_node(num_cpus=2, resources={"obj": 2}, daemon=True)
+    try:
+        @serve.deployment(num_replicas=3, max_ongoing_requests=8,
+                          ray_actor_options={"num_cpus": 1})
+        def app(x):
+            time.sleep(0.01)
+            return x * 2
+
+        handle = serve.run(app.bind(), name="drain_app",
+                           route_prefix="/drain")
+        assert handle.remote(1).result(timeout_s=60) == 2
+
+        @ray.remote(num_cpus=0, resources={"obj": 1})
+        def make(i):
+            return np.full(20_000, float(i), dtype=np.float64)
+
+        refs = [make.remote(i) for i in range(6)]
+        ready, _ = ray.wait(refs, num_returns=6, timeout=60)
+        assert len(ready) == 6
+
+        # Drain a daemon node that actually hosts a replica if any
+        # does (0-CPU head can't: replicas need 1 CPU there too).
+        replica_nodes = {r["node_id"] for r in list_actors()
+                         if "SERVE_REPLICA" in (r["name"] or "")
+                         and r["state"] not in ("DEAD",)}
+        victim = a if a.node_id in replica_nodes else (
+            b if b.node_id in replica_nodes else a)
+
+        st = drain_node(victim.node_id, wait=False)
+        assert st["state"] == "DRAINING", st
+        # Requests keep flowing THROUGH the drain; every one succeeds.
+        served = 0
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            assert handle.remote(served).result(timeout_s=60) == served * 2
+            served += 1
+            cur = drain_status(victim.node_id)
+            if cur["state"] != "DRAINING":
+                break
+        final = drain_status(victim.node_id)
+        assert final["state"] == "DRAINED", final
+        assert served > 0
+
+        # The machine leaves for real; traffic and data both survive.
+        cluster.remove_node(victim, allow_graceful=False)
+        for i in range(10):
+            assert handle.remote(i).result(timeout_s=60) == i * 2
+        vals = ray.get(refs, timeout=60)
+        for i, v in enumerate(vals):
+            assert float(v[0]) == float(i)
+        # Nothing charged a restart budget: replica replacement is
+        # target-count reconciliation, actor migration is uncharged.
+        assert all(r["restarts_used"] == 0 for r in list_actors())
+        serve.shutdown()
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_drain_vs_sigkill_race_degrades_to_node_death(clean_drain):
+    """A daemon SIGKILLed at the instant it receives the drain request
+    (seeded daemon.drain fault) settles the drain as NODE_DIED and
+    falls back to ORDINARY node-death semantics: the actor restart IS
+    charged."""
+    os.environ["RAY_TPU_NODE_HEARTBEAT_S"] = "0.5"
+    try:
+        ray.init(num_cpus=1, fault_config={
+            "seed": 7,
+            "rules": [{"site": "daemon.drain", "action": "kill",
+                       "at": [0], "scope": "drain-victim"}]})
+        cluster = Cluster()
+        os.environ["RAY_TPU_FAULT_SCOPE"] = "drain-victim"
+        try:
+            victim = cluster.add_node(num_cpus=2, resources={"V": 2},
+                                      daemon=True)
+        finally:
+            del os.environ["RAY_TPU_FAULT_SCOPE"]
+        try:
+            @ray.remote(resources={"V": 1}, max_restarts=1)
+            class A:
+                def ping(self):
+                    return "up"
+
+            h = A.remote()
+            assert ray.get(h.ping.remote(), timeout=60) == "up"
+
+            st = drain_node(victim.node_id, wait=True)
+            assert st["state"] == "NODE_DIED", st
+
+            row = next(r for r in list_actors()
+                       if r["class_name"].endswith(".A"))
+            # Node DEATH (unlike drain) charges the budget.
+            assert row["restarts_used"] == 1, row
+            rows = {r["node_id"]: r for r in list_nodes()}
+            assert not rows.get(victim.node_id, {}).get("alive", False)
+        finally:
+            cluster.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_NODE_HEARTBEAT_S", None)
