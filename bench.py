@@ -1127,6 +1127,67 @@ def _focus_serve_http(ray_tpu):
     return measure
 
 
+def _focus_serve_http_multi(ray_tpu):
+    """Aggregate req/s through N proxies x M replicas (the scale shape
+    of the direct serve data plane: every proxy holds its OWN brokered
+    channels to every replica, so extra proxies add ingress capacity
+    without any per-request head involvement). 3 proxies x 4 replicas,
+    6 closed-loop client threads per proxy."""
+    import http.client
+    import threading
+
+    from ray_tpu import serve
+
+    controller = serve.start()
+
+    @serve.deployment(max_ongoing_requests=64, num_replicas=4)
+    def nop(request):
+        return "ok"
+
+    serve.run(nop.bind(), name="bench_multi", route_prefix="/nop")
+    from ray_tpu.serve._private.proxy import HTTPProxy
+
+    # serve.start()'s driver proxy plus two more in-driver proxies;
+    # each runs its own router, admission counters, and direct
+    # channels (leaked at exit like _focus_serve_http's scaffold —
+    # run_focus tears the whole process down right after).
+    proxies = [serve._proxy] + [HTTPProxy(controller, "127.0.0.1", 0)
+                                for _ in range(2)]
+    addrs = [(p.host, p.port) for p in proxies]
+
+    for host, port in addrs:  # warm: channels + verdicts per proxy
+        c = http.client.HTTPConnection(host, int(port))
+        c.connect()
+        for _ in range(50):
+            c.request("POST", "/nop", body=b"{}")
+            c.getresponse().read()
+        c.close()
+
+    def measure():
+        lat = []
+        stop_at = time.time() + 4.0
+
+        def worker(host, port):
+            conn = http.client.HTTPConnection(host, int(port))
+            conn.connect()
+            while time.time() < stop_at:
+                t0 = time.perf_counter()
+                conn.request("POST", "/nop", body=b"{}")
+                conn.getresponse().read()
+                lat.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=worker,
+                                    args=addrs[i % len(addrs)])
+                   for i in range(18)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return len(lat) / (time.time() - t0)
+    return measure
+
+
 FOCUS_METRICS = {
     "tasks_async_per_s": _focus_tasks_async,
     "put_get_per_s": _focus_put_get,
@@ -1134,6 +1195,7 @@ FOCUS_METRICS = {
     "nn_actor_calls_async_per_s": _focus_nn_actor,
     "streaming_gen_items_per_s": _focus_streaming_gen,
     "serve_http_req_per_s": _focus_serve_http,
+    "serve_http_multi": _focus_serve_http_multi,
 }
 
 

@@ -184,6 +184,23 @@ class RayConfig:
         # the resource contract holds; the grant releases when the
         # pipeline drains. TPU tasks never pipeline (chip exclusivity).
         "max_tasks_in_flight_per_worker": 16,
+        # -- serve data plane on the direct call plane (reference: the
+        # proxy's replica scheduler submitting via the direct actor
+        # transport — steady-state serve requests never touch a central
+        # process). Falsy => every proxy request takes the classic
+        # head-routed handle path unchanged, and the serve-direct
+        # client does zero work (counter-guarded in ci_fast).
+        "serve_direct_enabled": True,
+        # Request/response bodies above this many serialized bytes move
+        # zero-copy through the shared same-node arena (pinned-view
+        # reads) instead of being pickled into the channel frame.
+        # 0 disables the arena body path (always inline).
+        "serve_direct_body_threshold": 64 * 1024,
+        # Proxy-side admission control: when EVERY replica of a
+        # deployment has at least this many proxy-tracked in-flight
+        # requests, new requests shed with 503 instead of queueing
+        # into a wedged replica pool. 0 disables shedding.
+        "serve_max_queue_per_replica": 128,
         # -- hybrid scheduling policy (reference: scheduler_spread_threshold,
         # hybrid_scheduling_policy.cc:48 — prefer the local/preferred node
         # while its critical-resource utilization stays below this, then

@@ -1261,6 +1261,10 @@ class DirectPlane:
             self._on_actor_results(chan, [payload])
         elif msg_type == P.GEN_ITEM:
             self._on_gen_items(chan, [payload])
+        elif msg_type == P.SERVE_REQ:
+            self._on_serve_req(chan, payload)
+        elif msg_type == P.SERVE_BODY_FREE:
+            self._on_serve_body_free(payload)
         elif msg_type == P.GEN_CANCEL:
             # Caller dropped its channel-stream generator mid-iteration:
             # stop the producing generator here (the head-routed path
@@ -1861,3 +1865,178 @@ class DirectPlane:
             self._worker.send_lazy(P.DIRECT_DONE, {"entries": [entry]})
         except Exception:  # lint: broad-except-ok head pipe dead too: the process is exiting, nothing left to tell
             pass
+
+    # ------------------------------------------------------------------
+    # serve data plane (callee side): SERVE_REQ in, SERVE_RESP out.
+    # Ownership-free by construction — no task id, no return-object
+    # registration, no sequencing: the proxy is the only consumer and
+    # the channel the only route, so the head hears NOTHING per request
+    # (cheaper than even the batched DIRECT_DONE accounting actor calls
+    # pay). Bodies above serve_direct_body_threshold move through the
+    # shared same-node arena instead of the frame (serve_encode_body).
+    # ------------------------------------------------------------------
+    def _on_serve_req(self, chan, payload: dict) -> None:
+        """One serve request from a proxy landed on this replica's
+        worker: run it on the actor's executor pool with the response
+        bound back to this channel."""
+        _bump()
+        w = self._worker
+        if w._actor_instance is None or w._actor_executor is None:
+            blob = serialization.dumps(ActorDiedError(
+                "serve request reached a worker that hosts no live actor"))
+            try:
+                chan.writer.send_message(
+                    P.SERVE_RESP, {"r": payload.get("r"), "e": blob})
+            except Exception:  # lint: broad-except-ok proxy hung up: its channel EOF fails the request typed
+                pass
+            return
+        w._actor_executor.submit(self._serve_exec, chan, payload)
+
+    def _serve_exec(self, chan, payload: dict) -> None:
+        """Executor-side runner for one SERVE_REQ (the relevant slice
+        of worker_proc._execute: trace adoption, coroutine bridging,
+        TaskError packaging — same failure semantics as the head path
+        so the proxy's error handling cannot tell the planes apart)."""
+        import inspect
+        import traceback
+
+        from ..exceptions import TaskError
+        from ..util import tracing
+        w = self._worker
+        msg: Dict[str, Any] = {"r": payload.get("r")}
+        trace_token = exec_span = None
+        if payload.get("tr"):
+            try:
+                trace_token = tracing.activate_context(payload["tr"])  # lint: ungated-instrumentation-ok gated by the payload trace-ctx check
+                exec_span = tracing.span(  # lint: ungated-instrumentation-ok same payload trace-ctx gate
+                    "serve:direct_exec",
+                    worker_id=w.config.worker_id.hex())
+                exec_span.__enter__()
+            except Exception:
+                trace_token = exec_span = None
+        try:
+            (args, kwargs), free_ob = serve_decode_body(
+                w.store, payload["b"])
+            if free_ob is not None:
+                # Request body was arena-staged by the proxy: ack so it
+                # can release the slot (oneway, coalesces with the
+                # response frame on the writer).
+                chan.writer.send_message(P.SERVE_BODY_FREE,
+                                         {"o": free_ob})
+            method = getattr(w._actor_instance,
+                             payload.get("m") or "handle_request")
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = w._run_coroutine(result)
+            msg["v"] = serve_encode_body(w.store, result,
+                                         bool(payload.get("sn")))
+            if exec_span is not None:
+                trace_token = w._trace_exit(trace_token, exec_span)
+                exec_span = None
+        except BaseException as e:  # noqa: BLE001 — ships to the proxy
+            err = TaskError(e, task_repr=f"serve:{payload.get('m')}",
+                            remote_tb=traceback.format_exc())
+            try:
+                msg["e"] = serialization.dumps(err)
+            except Exception:
+                msg["e"] = serialization.dumps(TaskError(
+                    RuntimeError(repr(e)), task_repr="serve"))
+            if exec_span is not None:
+                trace_token = w._trace_exit(trace_token, exec_span, e)
+                exec_span = None
+        finally:
+            if exec_span is not None or trace_token is not None:
+                w._trace_exit(trace_token, exec_span)
+        try:
+            chan.writer.send_message(P.SERVE_RESP, msg)
+        except Exception:  # lint: broad-except-ok proxy gone: reclaim the staged body, nothing else to tell
+            enc = msg.get("v")
+            if enc is not None and enc[0] == "o":
+                from .ids import ObjectID
+                try:
+                    w.store.free(ObjectID(enc[1]))
+                except Exception:  # lint: broad-except-ok teardown race; the arena dies with the session anyway
+                    pass
+
+    def _on_serve_body_free(self, payload: dict) -> None:
+        """Oneway: the peer finished decoding an arena-staged body this
+        process produced — release the slot (the arena delete retries
+        behind live reader pins, so free-while-read stays safe)."""
+        _bump()
+        from .ids import ObjectID
+        try:
+            self._worker.store.free(ObjectID(payload["o"]))
+        except Exception:  # lint: broad-except-ok double-free after teardown is harmless
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Serve body codec, shared by BOTH ends of the serve data plane (the
+# callee above and serve/_private/direct_client.py): one encoding policy
+# so the planes cannot diverge.
+def _serve_stage_path(store):
+    """This process's same-node staging identity: the shared arena file
+    for ArenaObjectStore, the shm segment dir for the file-per-object
+    store (ObjectStore._path is a method, the arena's is a str). Path
+    equality on the consumer side means 'I can map the producer's
+    bytes in place'."""
+    p = getattr(store, "_path", None)
+    if isinstance(p, str):
+        return p
+    return getattr(store, "_dir", None)
+
+
+def serve_encode_body(store, value, same_node: bool):
+    """Encode one serve request/response payload for a channel frame.
+
+    Small payloads pickle inline (("i", bytes)). Payloads above
+    serve_direct_body_threshold between same-node processes stage in
+    the node store (("o", oid, path, size)): the producer writes once,
+    the consumer maps the same bytes read-only — the body never enters
+    the frame, and never pickles twice. The consumer acks with
+    SERVE_BODY_FREE and the producer frees its slot. Any staging
+    failure degrades to inline (always correct)."""
+    sobj = serialization.serialize(value)
+    from .config import ray_config
+    thr = int(ray_config.serve_direct_body_threshold)
+    spath = _serve_stage_path(store) if same_node else None
+    if spath and thr > 0 and sobj.total_size > thr:
+        from .ids import ObjectID
+        oid = ObjectID.from_random()
+        try:
+            store.put_serialized(oid, sobj)
+            return ("o", oid.binary(), spath, sobj.total_size)
+        except Exception:  # lint: broad-except-ok store full/contended: inline is always correct
+            pass
+    return ("i", sobj.to_bytes())
+
+
+def serve_decode_body(store, enc):
+    """Decode one frame body; returns (value, free_oid_bytes). A
+    non-None free oid means the body was store-staged: the caller must
+    ship SERVE_BODY_FREE back to the producer once decoded. Arena
+    same-path consumers read the shared arena under a per-read pin;
+    file-store consumers map the segment by its deterministic path and
+    release their reader mapping after decode (live zero-copy views
+    park the mapping in the graveyard); a same-host consumer with its
+    OWN arena adopts the producer slot in place for the read."""
+    if enc[0] == "i":
+        return serialization.deserialize(enc[1]), None
+    _kind, ob, path, size = enc
+    from .ids import ObjectID
+    oid = ObjectID(ob)
+    if getattr(store, "_path", None) == path:
+        value = serialization.deserialize(store.get_raw(oid))
+        return value, ob
+    if getattr(store, "_dir", None) == path:
+        try:
+            value = serialization.deserialize(store.get_raw(oid))
+        finally:
+            store.release(oid)
+        return value, ob
+    store.adopt_native(oid, path, 0, size, pin=True)
+    try:
+        value = serialization.deserialize(store.get_raw(oid))
+    finally:
+        store.free_external_entry(oid)
+    return value, ob

@@ -80,6 +80,13 @@ ACTOR_CALL = "actor_call"        # worker <-> worker: one actor method call
 ACTOR_RESULT = "actor_result"    # worker <-> worker: its inline result
 GEN_CANCEL = "gen_cancel"        # worker <-> worker: caller dropped a
                                  # channel stream; stop the producer
+SERVE_REQ = "serve_req"          # proxy -> replica: one serve request
+                                 # (ownership-free: no task id, no
+                                 # return-object registration)
+SERVE_RESP = "serve_resp"        # replica -> proxy: its response
+SERVE_BODY_FREE = "serve_free"   # worker <-> worker oneway: consumer
+                                 # finished reading a store-staged
+                                 # body; producer frees the slot
 
 # ---------------------------------------------------------------------------
 # Message types: per-host daemon <-> head control service (TCP). The daemon

@@ -2161,18 +2161,13 @@ class Node:
         the cross-node host up from its registration view. One round
         trip per (caller, actor) pair — steady-state calls then bypass
         the head entirely."""
-        from concurrent.futures import Future as _Future
         req_id = payload.get("req_id")
         actor_id = payload["actor_id"]
-
-        def refuse(reason: str):
-            self._reply(handle, req_id, {"ok": False, "reason": reason})
-
         if not self._direct_on:
-            refuse("direct_calls_enabled is off")
+            self._reply(handle, req_id, {
+                "ok": False, "reason": "direct_calls_enabled is off"})
             return
         st = self._actors.get(actor_id)
-        entry = self.gcs.actors.get(actor_id)
         if st is not None and payload.get("settled_below") is not None:
             # Re-dial chokepoint: the caller ships its settlement
             # snapshot so a fresh incarnation's merge gate can resolve
@@ -2183,10 +2178,21 @@ class Node:
                 self._seq_merge(st, handle.worker_id.binary(),
                                 int(payload["settled_below"]),
                                 payload.get("settled_set"))
+        caller_node = self._node_hex_of(handle)
+        self._reply(handle, req_id,
+                    self._broker_channel_info(actor_id, caller_node))
+
+    def _broker_channel_info(self, actor_id, caller_node: str) -> dict:
+        """Broker core shared by worker callers (CHANNEL_REQ) and the
+        driver-process serve proxy (broker_serve_channel): validate the
+        actor, stand the callee listener up, fix the cross-node host.
+        Returns the reply dict ({"ok": True, ...} or a refusal)."""
+        from concurrent.futures import Future as _Future
+        st = self._actors.get(actor_id)
+        entry = self.gcs.actors.get(actor_id)
         if (st is None or entry is None or st.dead
                 or entry.state == gcs_mod.ACTOR_DEAD):
-            refuse("actor is not alive")
-            return
+            return {"ok": False, "reason": "actor is not alive"}
         if (entry.state != gcs_mod.ACTOR_ALIVE or st.worker is None
                 or not st.worker.alive):
             # PENDING/RESTARTING: the callee will usually be dialable
@@ -2195,10 +2201,8 @@ class Node:
             # fallback path — a first burst racing the actor's
             # construction would otherwise lose the direct plane for
             # the pair's whole lifetime.
-            self._reply(handle, req_id, {
-                "ok": False, "transient": True,
-                "reason": "actor is not ready yet"})
-            return
+            return {"ok": False, "transient": True,
+                    "reason": "actor is not ready yet"}
         callee = st.worker
         with self._chan_lock:
             self._chan_token += 1
@@ -2211,16 +2215,14 @@ class Node:
             info = fut.result(
                 timeout=float(ray_config.direct_channel_timeout_s))
         except Exception:
-            refuse("callee listener unavailable")
-            return
+            return {"ok": False, "reason": "callee listener unavailable"}
         finally:
             with self._chan_lock:
                 self._chan_waiters.pop(token, None)
         if not isinstance(info, dict) or info.get("error"):
-            refuse(f"callee listener failed: {info.get('error')}")
-            return
+            return {"ok": False,
+                    "reason": f"callee listener failed: {info.get('error')}"}
         callee_node = self._node_hex_of(callee)
-        caller_node = self._node_hex_of(handle)
         tcp = info.get("tcp")
         if tcp is not None and caller_node != callee_node:
             # The callee bound its node-local host; cross-node callers
@@ -2228,12 +2230,21 @@ class Node:
             addr = self.transfer_addr_of(callee_node)
             if addr is not None:
                 tcp = (addr[0], tcp[1])
-        self._reply(handle, req_id, {
+        return {
             "ok": True,
             "unix": info.get("unix") if caller_node == callee_node
             else None,
             "tcp": tcp, "key": info["key"], "callee_node": callee_node,
-            "callee_worker": info.get("worker_id")})
+            "callee_worker": info.get("worker_id")}
+
+    def broker_serve_channel(self, actor_id) -> dict:
+        """Driver-process entry to the channel broker: the serve proxy
+        runs in the head process (no WorkerHandle, no request pipe), so
+        it asks in-process for a dialable endpoint of a replica's
+        worker. Same reply shape as CHANNEL_REQ."""
+        if not self._direct_on:
+            return {"ok": False, "reason": "direct_calls_enabled is off"}
+        return self._broker_channel_info(actor_id, self.node_id.hex())
 
     def _on_channel_addr(self, payload: dict):
         with self._chan_lock:

@@ -297,6 +297,7 @@ def record_result_forward(n: int) -> None:
 _serve_inflight_lock = threading.Lock()
 _serve_inflight: Dict[str, int] = {}
 _serve_ongoing: Dict[str, float] = {}
+_serve_qdepth: Dict[str, float] = {}
 _serve_dirty: set = set()
 
 
@@ -321,6 +322,7 @@ def flush_serve_gauges() -> None:
         _serve_dirty.clear()
         inflight = {d: _serve_inflight.get(d) for d in dirty}
         ongoing = {d: _serve_ongoing.get(d) for d in dirty}
+        qdepth = {d: _serve_qdepth.get(d) for d in dirty}
     for d in dirty:
         if inflight[d] is not None:
             _metric("serve_inflight_requests", "gauge",
@@ -332,6 +334,12 @@ def flush_serve_gauges() -> None:
                     "Requests currently executing in this replica",
                     tag_keys=("deployment",)).set(
                         float(ongoing[d]), tags={"deployment": d})
+        if qdepth[d] is not None:
+            _metric("serve_proxy_queue_depth", "gauge",
+                    "Proxy-tracked in-flight requests across a "
+                    "deployment's replicas (admission-control view)",
+                    tag_keys=("deployment",)).set(
+                        float(qdepth[d]), tags={"deployment": d})
 
 
 # Per-deployment histogram HANDLES, resolved once and cached: the
@@ -382,6 +390,38 @@ def serve_replica_ongoing(deployment: str, n: int) -> None:
     with _serve_inflight_lock:
         _serve_ongoing[deployment] = float(n)
         _serve_dirty.add(deployment)
+
+
+def serve_direct_request(deployment: str) -> None:
+    """One request dispatched on the direct serve data plane."""
+    global _ops
+    _ops += 1
+    _metric("serve_direct_requests_total", "counter",
+            "Serve requests shipped proxy->replica on direct channels",
+            tag_keys=("deployment",)).inc(
+                tags={"deployment": deployment})
+
+
+def serve_queue_depth(deployment: str, depth: int) -> None:
+    """Proxy-tracked in-flight depth across a deployment's replicas
+    (deferred like the other serve gauges: hot path touches a dict,
+    the Metric syncs at sample time)."""
+    global _ops
+    _ops += 1
+    with _serve_inflight_lock:
+        _serve_qdepth[deployment] = float(depth)
+        _serve_dirty.add(deployment)
+
+
+def serve_shed(deployment: str) -> None:
+    """One request shed with 503: every replica's queue was at
+    serve_max_queue_per_replica."""
+    global _ops
+    _ops += 1
+    _metric("serve_shed_requests_total", "counter",
+            "Requests shed 503 by proxy-side admission control",
+            tag_keys=("deployment",)).inc(
+                tags={"deployment": deployment})
 
 
 # ---------------------------------------------------------------------------

@@ -268,6 +268,16 @@ def shutdown():
     global _fault_installed_by_init
     rt = state.get_node()
     if rt is not None:
+        try:
+            # Serve-direct channels dial this runtime's workers; close
+            # them before the workers die so their EOFs don't fan typed
+            # errors into the next cluster this process starts.
+            import sys
+            dc = sys.modules.get("ray_tpu.serve._private.direct_client")
+            if dc is not None:
+                dc.reset_client()
+        except Exception:
+            pass
         rt.shutdown()
     state.set_node(None)
     state.set_local_runtime(None)

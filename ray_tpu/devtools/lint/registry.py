@@ -106,7 +106,35 @@ RECV_LOOPS = {
         "dispatch_vars": ("msg_type",),
         "fallthrough": "DirectPlane._handle_direct_message",
         "relay": False,
-        "exempt": {},
+        "exempt": {
+            "SERVE_RESP": "responses return on the serve client's OWN "
+                          "dedicated connection and are consumed by its "
+                          "recv loop (serve.client below); the plane's "
+                          "shared dispatcher never sees one",
+        },
+    },
+    "serve.client": {
+        # The serve data plane's caller side: the proxy process holds a
+        # dedicated brokered connection per replica worker and this
+        # loop completes rid-keyed response futures on it. The channel
+        # is serve-only by construction — the actor-call constants ride
+        # DirectPlane connections, never this one.
+        "file": "serve/_private/direct_client.py",
+        "functions": ("_ServeChannel._recv_loop",),
+        "plane": "direct",
+        "dispatch_vars": ("msg_type",),
+        "fallthrough": "_ServeChannel._recv_loop",
+        "relay": False,
+        "exempt": {
+            "ACTOR_CALL": "caller-only serve connection: actor calls "
+                          "ride DirectPlane channels, not this one",
+            "ACTOR_RESULT": "caller-only serve connection: inline "
+                            "results ride DirectPlane channels",
+            "GEN_CANCEL": "the serve data plane is unary-only; streams "
+                          "stay on the actor-call plane",
+            "SERVE_REQ": "this end SENDS requests; only the replica "
+                         "worker's DirectPlane dispatcher receives them",
+        },
     },
 }
 

@@ -251,6 +251,14 @@ def shutdown():
     except Exception:
         pass
     try:
+        # Close the direct serve channels: replica workers are about to
+        # die, and their EOFs must not fan typed errors into the NEXT
+        # cluster this process starts.
+        from ._private.direct_client import reset_client
+        reset_client()
+    except Exception:
+        pass
+    try:
         from ._private.controller import CONTROLLER_NAME
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.graceful_shutdown.remote())

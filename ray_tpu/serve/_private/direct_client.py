@@ -1,0 +1,372 @@
+"""Caller-side serve data plane: the proxy's direct channels to
+replica workers.
+
+The serve request path used to route proxy -> head -> replica as a
+head-brokered handle call per request. Here the proxy process (the
+driver, or a worker hosting a ProxyReplica) holds ONE brokered channel
+per replica worker (same-node UNIX, cross-node TCP — the PR 5
+`_private/direct.py` listener accepts any number of peers) and ships
+SERVE_REQ/SERVE_RESP frames on it: steady-state requests are pure
+channel hops and the head hears NOTHING per request. Bodies above
+``serve_direct_body_threshold`` move zero-copy through the shared
+same-node arena (direct.serve_encode_body / serve_decode_body).
+
+Failure semantics: channel EOF fails every in-flight request with a
+typed ReplicaUnavailableError — the proxy surfaces 503, never a hang
+(replica SIGKILL mid-request is the test). Establishment is fully
+non-blocking: ``channel_for()`` returns None until a background thread
+has brokered + dialed, and early requests ride the classic head path
+meanwhile (exactly the transient-establish behavior of the actor-call
+plane).
+
+Flag-off discipline (``serve_direct_enabled=false``): the dispatch
+helper returns before calling into this module, and the counter below
+proves it — the same guarded-counter pattern as ``direct.direct_ops``
+(scripts/ci_fast.sh runs the guard standalone).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional, Set
+
+from ray_tpu._private import protocol as P
+from ray_tpu._private import serialization
+from ray_tpu._private import state as _state
+from ray_tpu._private import telemetry
+from ray_tpu._private.direct import (DirectPlane, serve_decode_body,
+                                     serve_encode_body)
+
+logger = logging.getLogger(__name__)
+
+# Counter of serve-direct operations in THIS process — the perf_smoke
+# guard's counter-based proxy for "the disabled path did no
+# serve-direct work".
+_ops = 0
+
+
+def serve_direct_ops() -> int:
+    """Serve-direct operations performed so far (perf_smoke guard)."""
+    return _ops
+
+
+def _bump() -> None:
+    global _ops
+    _ops += 1
+
+
+class ReplicaUnavailableError(Exception):
+    """The replica's channel died with the request in flight (worker
+    SIGKILL, node loss): the proxy surfaces 503, never a hang."""
+
+
+class ReplicaQueueFullError(Exception):
+    """Every replica's proxy-tracked queue is at
+    ``serve_max_queue_per_replica``: shed with 503 at the edge."""
+
+
+def _env():
+    """(store, node_id_hex) of THIS process, or None before init."""
+    w = _state._worker
+    if w is not None:
+        return w.store, w.config.node_id_hex
+    node = _state.get_node()
+    if node is not None:
+        return node.store, node.node_id.hex()
+    return None
+
+
+def _broker(actor_id) -> dict:
+    """One CHANNEL_REQ round trip from whichever process we are in: the
+    driver asks its in-process broker, a worker asks over its head pipe
+    (same reply shape either way)."""
+    w = _state._worker
+    if w is not None:
+        rep = w.request(P.CHANNEL_REQ, {"actor_id": actor_id})
+        return rep if isinstance(rep, dict) else {
+            "ok": False, "reason": repr(rep)}
+    node = _state.get_node()
+    if node is not None:
+        return node.broker_serve_channel(actor_id)
+    return {"ok": False, "reason": "runtime not initialized"}
+
+
+class _ServeChannel:
+    """One live channel to one replica worker: a coalescing writer, a
+    recv thread completing rid-keyed futures, and EOF fan-out of every
+    in-flight request to a typed error."""
+
+    __slots__ = ("client", "actor_ab", "conn", "writer", "store",
+                 "same_node", "alive", "_lock", "_rid", "_inflight")
+
+    def __init__(self, client: "ServeDirectClient", actor_ab: bytes,
+                 conn, store, same_node: bool):
+        self.client = client
+        self.actor_ab = actor_ab
+        self.conn = conn
+        self.store = store
+        self.same_node = same_node
+        self.alive = True
+        self._lock = threading.Lock()
+        self._rid = 0
+        self._inflight: Dict[int, Future] = {}
+        from ray_tpu._private.netcomm import ConnectionWriter
+        self.writer = ConnectionWriter(conn, name="serve-direct-w")
+        threading.Thread(target=self._recv_loop, daemon=True,
+                         name="serve-direct-recv").start()
+
+    def call(self, method: str, args: tuple, kwargs: dict,
+             trace_ctx=None) -> Future:
+        """Ship one request; the returned Future resolves to the
+        decoded response value or raises the replica's typed error."""
+        _bump()
+        body = serve_encode_body(self.store, (args, kwargs),
+                                 self.same_node)
+        fut: Future = Future()
+        with self._lock:
+            if not self.alive:
+                self._reclaim_body(body)
+                raise ReplicaUnavailableError(
+                    "replica channel is down")
+            self._rid += 1
+            rid = self._rid
+            self._inflight[rid] = fut
+        msg = {"r": rid, "m": method, "b": body, "sn": self.same_node}
+        if trace_ctx:
+            msg["tr"] = trace_ctx
+        try:
+            self.writer.send_message(P.SERVE_REQ, msg)
+        except Exception:
+            with self._lock:
+                self._inflight.pop(rid, None)
+            self._reclaim_body(body)
+            raise ReplicaUnavailableError(
+                "replica channel send failed") from None
+        return fut
+
+    def _reclaim_body(self, body) -> None:
+        """A request body we arena-staged never reached the replica:
+        free the slot ourselves (we are its producer)."""
+        if body is not None and body[0] == "o":
+            from ray_tpu._private.ids import ObjectID
+            try:
+                self.store.free(ObjectID(body[1]))
+            except Exception:  # lint: broad-except-ok teardown race; the arena dies with the session
+                pass
+
+    def _recv_loop(self):
+        while True:
+            try:
+                data = self.conn.recv_bytes()
+            except (EOFError, OSError):
+                break
+            try:
+                for msg_type, payload in P.load_messages(data):
+                    if msg_type == P.SERVE_RESP:
+                        self._on_resp(payload)
+                    elif msg_type == P.SERVE_BODY_FREE:
+                        self._on_body_free(payload)
+                    else:
+                        logger.warning(
+                            "serve channel dropping unknown message "
+                            "type %r (protocol skew?)", msg_type)
+            except Exception:
+                logger.exception("serve channel handler failed")
+        self._down()
+
+    def _on_resp(self, payload: dict) -> None:
+        _bump()
+        with self._lock:
+            fut = self._inflight.pop(payload.get("r"), None)
+        if fut is None:
+            return  # channel raced down; the EOF fan-out beat us
+        blob = payload.get("e")
+        if blob is not None:
+            try:
+                err = serialization.deserialize(blob)
+            except Exception as e:  # lint: broad-except-ok undecodable error blob still fails the request typed
+                err = e
+            fut.set_exception(err)
+            return
+        try:
+            value, free_ob = serve_decode_body(self.store, payload["v"])
+            if free_ob is not None:
+                # Response body was arena-staged by the replica: ack so
+                # it releases the slot (reader pins keep our decoded
+                # views safe across the free).
+                self.writer.send_message(P.SERVE_BODY_FREE,
+                                         {"o": free_ob})
+            fut.set_result(value)
+        except BaseException as e:  # noqa: BLE001 — ships to the waiter
+            fut.set_exception(e)
+
+    def _on_body_free(self, payload: dict) -> None:
+        """The replica finished decoding a request body we staged."""
+        _bump()
+        from ray_tpu._private.ids import ObjectID
+        try:
+            self.store.free(ObjectID(payload["o"]))
+        except Exception:  # lint: broad-except-ok double-free after teardown is harmless
+            pass
+
+    def _down(self) -> None:
+        with self._lock:
+            if not self.alive:
+                return
+            self.alive = False
+            pending = list(self._inflight.values())
+            self._inflight.clear()
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(ReplicaUnavailableError(
+                    "replica channel closed with the request in "
+                    "flight (replica died or is being torn down)"))
+        try:
+            self.writer.close(flush_timeout=0.0)
+        except Exception:  # lint: broad-except-ok writer already dead with the channel
+            pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+        self.client._forget(self.actor_ab, self)
+
+    def close(self) -> None:
+        try:
+            self.conn.close()  # recv loop EOF runs the _down fan-out
+        except OSError:
+            pass
+
+
+class ServeDirectClient:
+    """Per-process registry of serve channels, keyed by replica actor
+    id. ``channel_for()`` NEVER blocks: establishment (broker round
+    trip + dial) runs on a background thread and requests fall back to
+    the classic path until the channel is live. Failed establishment
+    backs off ``direct_redial_backoff_s`` before retrying, mirroring
+    the actor-call plane's redial discipline."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._chans: Dict[bytes, _ServeChannel] = {}
+        self._pending: Set[bytes] = set()
+        self._failed_at: Dict[bytes, float] = {}
+
+    def channel_for(self, replica) -> Optional[_ServeChannel]:
+        actor_id = getattr(replica, "_actor_id", None)
+        if actor_id is None:
+            return None
+        ab = actor_id.binary()
+        with self._lock:
+            ch = self._chans.get(ab)
+            if ch is not None and ch.alive:
+                return ch
+            if ch is not None:
+                self._chans.pop(ab, None)
+            if ab in self._pending:
+                return None
+            from ray_tpu._private.config import ray_config
+            ts = self._failed_at.get(ab)
+            if ts is not None and time.monotonic() - ts < float(
+                    ray_config.direct_redial_backoff_s):
+                return None
+            self._pending.add(ab)
+        _bump()
+        threading.Thread(target=self._establish, args=(actor_id,),
+                         daemon=True, name="serve-direct-dial").start()
+        return None
+
+    def _establish(self, actor_id) -> None:
+        ab = actor_id.binary()
+        try:
+            env = _env()
+            if env is None:
+                raise RuntimeError("runtime not initialized")
+            store, my_node = env
+            rep = _broker(actor_id)
+            if not rep.get("ok"):
+                raise RuntimeError(rep.get("reason") or "broker refused")
+            from ray_tpu._private.config import ray_config
+            key = bytes.fromhex(rep["key"])
+            budget = float(ray_config.direct_channel_timeout_s)
+            if rep.get("unix") and (not rep.get("callee_node")
+                                    or rep["callee_node"] == my_node
+                                    or my_node is None):
+                conn = DirectPlane._dial(rep["unix"], "AF_UNIX", key,
+                                         budget)
+                same_node = True
+            elif rep.get("tcp"):
+                host, port = rep["tcp"]
+                conn = DirectPlane._dial((host, int(port)), "AF_INET",
+                                         key, budget)
+                from ray_tpu._private.netcomm import tune_control_socket
+                tune_control_socket(conn.fileno())
+                same_node = rep.get("callee_node") == my_node
+            else:
+                raise RuntimeError(
+                    "broker reply carries no dialable address")
+            ch = _ServeChannel(self, ab, conn, store, same_node)
+        except Exception as e:  # lint: broad-except-ok any establish failure degrades to the head path
+            logger.debug("serve direct channel to %s unavailable: %r "
+                         "(head path)", actor_id.hex()[:8], e)
+            if telemetry.enabled:
+                telemetry.record_direct_fallback("serve_connect")
+            with self._lock:
+                self._failed_at[ab] = time.monotonic()
+                self._pending.discard(ab)
+            return
+        with self._lock:
+            self._pending.discard(ab)
+            self._failed_at.pop(ab, None)
+            self._chans[ab] = ch
+
+    def _forget(self, ab: bytes, ch: _ServeChannel) -> None:
+        with self._lock:
+            if self._chans.get(ab) is ch:
+                del self._chans[ab]
+
+    def close(self) -> None:
+        with self._lock:
+            chans = list(self._chans.values())
+            self._chans.clear()
+            self._pending.clear()
+            self._failed_at.clear()
+        for ch in chans:
+            ch.close()
+
+
+_client_lock = threading.Lock()
+_client: Optional[ServeDirectClient] = None
+_client_owner = None
+
+
+def get_client() -> Optional[ServeDirectClient]:
+    """The process-wide client, rebuilt when the runtime identity
+    changes — tests init/shutdown clusters repeatedly in one process,
+    and channels to a dead cluster's workers must not survive into the
+    next one."""
+    global _client, _client_owner
+    cur = _state.current_or_none()
+    if cur is None:
+        return None
+    old = None
+    with _client_lock:
+        if _client is None or _client_owner is not cur:
+            old, _client = _client, ServeDirectClient()
+            _client_owner = cur
+        client = _client
+    if old is not None:
+        old.close()
+    return client
+
+
+def reset_client() -> None:
+    """Close every channel (serve.shutdown / runtime teardown)."""
+    global _client, _client_owner
+    with _client_lock:
+        old, _client, _client_owner = _client, None, None
+    if old is not None:
+        old.close()

@@ -1,0 +1,88 @@
+"""Shared serve dispatch: HTTP and gRPC ingress both route unary
+requests through ``try_direct`` so the direct-plane fast path, the
+load-aware routing, and the shed-with-503 admission control cannot
+fork per protocol.
+
+Order of attempts per request (HTTPProxy._handle_inner / GRPCProxy):
+
+  1. ``try_direct`` — least-loaded replica claim + SERVE_REQ on the
+     brokered channel (this module); None means "not available yet"
+     (flag off, router unbuilt, channel still establishing) and the
+     caller falls back to
+  2. the classic DeploymentHandle path (head-brokered handle call).
+
+``ReplicaQueueFullError`` propagates: admission control applies to the
+request itself, not to the direct plane — a full queue must NOT
+quietly retry through the head path (that queue is the wedged pool the
+backpressure exists to protect).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ray_tpu._private import telemetry
+from ray_tpu.util import tracing
+
+
+class DirectResponse:
+    """Future-like result of a direct-plane dispatch: awaitable on the
+    proxy's event loop AND blocking for the gRPC thread pool — the
+    same dual surface DeploymentResponse offers both callers."""
+
+    __slots__ = ("_fut",)
+
+    def __init__(self, fut):
+        self._fut = fut
+
+    def result(self, timeout_s: Optional[float] = None):
+        return self._fut.result(timeout=timeout_s)
+
+    def __await__(self):
+        return asyncio.wrap_future(self._fut).__await__()
+
+
+def try_direct(handle, args: tuple, kwargs: dict
+               ) -> Optional[DirectResponse]:
+    """One direct-plane dispatch attempt for a unary request. Returns a
+    DirectResponse, or None to take the classic handle path; raises
+    ReplicaQueueFullError when admission control sheds.
+
+    Flag-off (``serve_direct_enabled=false``) returns None BEFORE
+    touching any serve-direct state — the zero-work discipline the
+    counter guard in tests/test_serve_direct.py proves."""
+    from ray_tpu._private.config import ray_config
+    if not bool(ray_config.serve_direct_enabled):
+        return None
+    if handle._stream:
+        return None
+    router = handle._router
+    if router is None:
+        return None
+    claim = router.try_claim_direct(handle._model_id)  # may shed
+    if claim is None:
+        return None
+    idx, replica, release = claim
+    from . import direct_client as _dc
+    client = _dc.get_client()
+    chan = client.channel_for(replica) if client is not None else None
+    if chan is None:
+        release()
+        return None
+    trace_ctx = tracing.current_context() if tracing.is_enabled() \
+        else None
+    try:
+        fut = chan.call(
+            "handle_request",
+            (handle._method, args, kwargs, handle._model_id), {},
+            trace_ctx)
+    except _dc.ReplicaUnavailableError:
+        release()
+        return None  # channel died under us: this request heads back
+    fut.add_done_callback(lambda _f: release())
+    if telemetry.enabled:
+        telemetry.serve_direct_request(handle.deployment_name)
+        telemetry.serve_queue_depth(handle.deployment_name,
+                                    router.total_inflight())
+    return DirectResponse(fut)

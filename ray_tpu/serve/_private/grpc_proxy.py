@@ -23,6 +23,9 @@ import threading
 import time
 from typing import Any, Optional, Tuple
 
+from . import dispatch as _dispatch
+from .direct_client import ReplicaQueueFullError, ReplicaUnavailableError
+
 _HANDLE_TTL_S = 5.0    # re-resolve app handles (delete/redeploy safety)
 _MISS_TTL_S = 1.0      # negative cache: throttle route-miss controller RPCs
 
@@ -101,14 +104,26 @@ class GRPCProxy:
                         payload = pickle.loads(request) if request else {}
                         handle = proxy._handle_for(app, method or
                                                    "__call__")
-                        resp = handle.remote(
-                            *payload.get("args", ()),
-                            **payload.get("kwargs", {}))
+                        args = tuple(payload.get("args", ()))
+                        kwargs = payload.get("kwargs", {})
+                        # Same dispatch helper as the HTTP proxy: the
+                        # direct data plane, the load-aware claim, and
+                        # the shed decision must not fork per protocol.
+                        resp = _dispatch.try_direct(handle, args,
+                                                    kwargs)
+                        if resp is None:
+                            resp = handle.remote(*args, **kwargs)
                         value = resp.result(timeout_s=proxy._timeout_s)
                         return pickle.dumps(value)
                     except GRPCProxy._RouteMiss:
                         context.abort(grpc.StatusCode.NOT_FOUND,
                                       f"no application named {app!r}")
+                    except ReplicaQueueFullError as e:
+                        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                      repr(e))
+                    except ReplicaUnavailableError as e:
+                        context.abort(grpc.StatusCode.UNAVAILABLE,
+                                      repr(e))
                     except Exception as e:  # noqa: BLE001 — map to status
                         context.abort(grpc.StatusCode.INTERNAL, repr(e))
 

@@ -19,6 +19,8 @@ from typing import Dict, Optional
 
 from ray_tpu._private import telemetry
 from ray_tpu.util import tracing
+from . import dispatch as _dispatch
+from .direct_client import ReplicaQueueFullError, ReplicaUnavailableError
 from .long_poll import LongPollClient
 
 
@@ -333,13 +335,28 @@ class HTTPProxy:
             # errors are NOT retried (requests may be non-idempotent).
             for attempt in (0, 1):
                 try:
+                    # Direct data plane first: least-loaded claim +
+                    # SERVE_REQ on the replica's brokered channel (the
+                    # head never sees the request). None = not
+                    # available yet (flag off, channel establishing):
+                    # fall through to the classic handle path. A full
+                    # queue sheds 503 HERE — admission control must
+                    # not quietly retry through the head.
+                    try:
+                        resp = _dispatch.try_direct(handle, (req,), {})
+                    except ReplicaQueueFullError as e:
+                        if telemetry.enabled:
+                            telemetry.serve_shed(deployment)  # lint: ungated-instrumentation-ok gated by the telemetry.enabled check above
+                        return web.json_response({"error": str(e)},
+                                                 status=503)
                     # Fast path: when replicas are ready and probes
                     # fresh, assignment cannot block — submit inline and
                     # skip the executor hop. Otherwise assign_request
                     # can block (replica ready-wait, queue probes): keep
                     # it off the event loop. The response await is
                     # callback-based either way.
-                    resp = handle._remote_fast(req)
+                    if resp is None:
+                        resp = handle._remote_fast(req)
                     if resp is None:
                         resp = await _in_executor(
                             loop, lambda: handle.remote(req))
@@ -367,6 +384,13 @@ class HTTPProxy:
                         self._asgi.pop(mode_key, None)
                         req = _build_req(None)
                         continue
+                    if isinstance(e, ReplicaUnavailableError):
+                        # Channel died mid-request (replica SIGKILL):
+                        # typed 503, never a hang — the controller will
+                        # restart the replica and the next request
+                        # re-establishes.
+                        return web.json_response({"error": str(e)},
+                                                 status=503)
                     return web.json_response({"error": str(e)},
                                              status=500)
         try:

@@ -345,6 +345,76 @@ class _Router:
             self._note_model_location(model_id, idx)
         return idx, replica
 
+    def try_claim_direct(self, model_id: str = ""):
+        """Non-blocking claim for the direct serve data plane: pick the
+        LEAST-LOADED replica across the whole set (a channel hop is too
+        cheap for pow-2 sampling to pay for itself here, and the full
+        scan is what makes the shed decision exact), increment its
+        in-flight count, and return (idx, replica, release). Returns
+        None when replicas aren't ready (the caller falls back to the
+        classic path); raises ReplicaQueueFullError when EVERY
+        replica's proxy-tracked queue is at serve_max_queue_per_replica
+        — backpressure at the edge instead of a wedged replica pool."""
+        if not self._ready.is_set():
+            return None
+        import time as _time
+
+        from ray_tpu._private.config import ray_config
+        from ._private.direct_client import ReplicaQueueFullError
+        cap = int(ray_config.serve_max_queue_per_replica)
+        now = _time.monotonic()
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                return None
+            if cap > 0 and all(self._inflight.get(i, 0) >= cap
+                               for i in range(n)):
+                raise ReplicaQueueFullError(
+                    f"all {n} replica(s) of '{self._deployment}' have "
+                    f">= {cap} requests in flight")
+            idx = min(range(n),
+                      key=lambda i: self._replica_score(i, now))
+            if model_id:
+                # Model-aware preference with the same spill rule as
+                # _pick: a warm holder wins until it is loaded well
+                # past the least-loaded replica.
+                locs = getattr(self, "_model_locations", {}).get(
+                    model_id)
+                holders = [i for i in (locs or ()) if i < n]
+                if holders:
+                    best = min(holders, key=lambda i:
+                               self._replica_score(i, now))
+                    if self._replica_score(best, now) < \
+                            self._replica_score(idx, now) + \
+                            self._MUX_SPILL_QLEN:
+                        idx = best
+            if cap > 0 and self._inflight.get(idx, 0) >= cap:
+                # Probe-biased scores can land on a replica already at
+                # cap while another sits below it (the all() check
+                # above guarantees one exists): spill to the least
+                # raw-inflight replica.
+                idx = min(range(n),
+                          key=lambda i: self._inflight.get(i, 0))
+            replica = self._replicas[idx]
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            self._note_model_location(model_id, idx)
+        released = []
+
+        def release():
+            with self._lock:
+                if released:
+                    return
+                released.append(True)
+                if idx in self._inflight and self._inflight[idx] > 0:
+                    self._inflight[idx] -= 1
+        return idx, replica, release
+
+    def total_inflight(self) -> int:
+        """Proxy-tracked in-flight requests across all replicas (the
+        queue-depth gauge's source)."""
+        with self._lock:
+            return sum(self._inflight.values())
+
     def pick_sticky(self, timeout_s: float = 30.0):
         """Pick ONE replica for a long-lived connection (websockets):
         returns (replica_actor, release). The connection counts as

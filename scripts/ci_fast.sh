@@ -9,6 +9,14 @@ cd "$(dirname "$0")/.."
 echo "== raylint (github annotations) =="
 python -m ray_tpu.devtools.lint --format github
 
+echo "== serve-direct flag-off zero-work guard =="
+# serve_direct_enabled=false must do ZERO serve-direct work — not
+# "cheap", zero, proven by the serve_direct_ops() counter (the serve
+# analogue of the direct-plane disabled guard).
+env JAX_PLATFORMS=cpu python -m pytest tests/test_serve_direct.py -q \
+    -m perf_smoke \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== perf_smoke + lint-marked tests =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'perf_smoke or lint' \

@@ -58,7 +58,8 @@ def pytest_configure(config):
 # ZERO potential-ABBA cycles. Assertion per test so a report is
 # attributable to the test that produced it.
 _LOCKDEP_SUITES = {"test_transport_framing", "test_fault_injection",
-                   "test_direct_calls", "test_cross_plane_ordering"}
+                   "test_direct_calls", "test_cross_plane_ordering",
+                   "test_serve_direct"}
 
 
 @pytest.fixture(autouse=True)
@@ -111,7 +112,8 @@ def _lockdep_guard(request, tmp_path_factory):
 # dir so a violation is attributable to the test that produced it
 # (these suites all build per-test clusters).
 _REFDEBUG_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
-                    "test_fault_injection", "test_drain"}
+                    "test_fault_injection", "test_drain",
+                    "test_serve_direct"}
 
 
 @pytest.fixture(autouse=True)
