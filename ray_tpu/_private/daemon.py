@@ -32,6 +32,7 @@ from . import fault
 from . import lockdep
 from . import protocol as P
 from . import telemetry
+from . import wiretap
 from .config import ray_config
 from .ids import NodeID, WorkerID
 from .netcomm import PullManager, TransferServer, store_paths_factory
@@ -134,14 +135,18 @@ class NodeDaemon:
         # on every control connection (the daemon side used to set
         # neither).
         tune_control_socket(conn.fileno())
-        register = P.dump_message(P.REGISTER_NODE, {
+        reg_payload = {
             "node_id_hex": self.node_hex,
             "resources": dict(self.totals),
             "transfer_port": self.transfer.port,
             "hostname": os.uname().nodename,
             "pid": os.getpid(),
             "labels": self.labels,
-        })
+        }
+        register = P.dump_message(P.REGISTER_NODE, reg_payload)
+        if wiretap.enabled:
+            wiretap.frame("daemon", "daemon", id(conn), "send",
+                          P.REGISTER_NODE, reg_payload)
         # REGISTER_NODE is enqueued on the FRESH writer before it is
         # published: the long-lived heartbeat thread can only reach the
         # new connection through self._writer, and the writer queue is
@@ -162,6 +167,9 @@ class NodeDaemon:
             except Exception:
                 pass
         msg_type, payload = self._recv()
+        if wiretap.enabled:
+            wiretap.frame("daemon", "daemon", id(conn), "recv",
+                          msg_type, payload)
         if msg_type != P.NODE_ACK:
             raise RuntimeError(f"head rejected registration: {msg_type}")
         self.head_node_hex = payload["head_node_id_hex"]
@@ -348,6 +356,9 @@ class NodeDaemon:
             self.shutdown()
 
     def _route(self, msg_type: str, payload: dict):
+        if wiretap.enabled:
+            wiretap.frame("daemon", "daemon", id(self.conn), "recv",
+                          msg_type, payload)
         if msg_type == P.NODE_SYNC:
             # Heartbeat ACK carrying the head's cluster resource view
             # (reference: ray_syncer bidirectional gossip). Kept fresh
@@ -546,6 +557,9 @@ class NodeDaemon:
     # -- worker messages -----------------------------------------------
     def _on_worker_message(self, handle: WorkerHandle, msg_type: str,
                            payload: dict):
+        if wiretap.enabled:
+            wiretap.frame("worker", "head", id(handle), "recv",
+                          msg_type, payload)
         if msg_type == P.PULL_OBJECT:
             self._exec.submit(self._handle_pull, handle, payload)
             return

@@ -62,6 +62,7 @@ from . import protocol as P
 from . import refdebug
 from . import serialization
 from . import telemetry
+from . import wiretap
 
 logger = logging.getLogger(__name__)
 
@@ -1123,15 +1124,23 @@ class DirectPlane:
             # slots — traced calls keep the compact form instead of
             # silently demoting to the full-spec pickle (the slot is
             # None on the untraced steady state: ~1 byte).
-            chan.writer.send_message(P.ACTOR_CALL, {"c": (
+            payload = {"c": (
                 spec.task_id.binary(), spec.actor_id.binary(),
                 spec.method_name, spec.name,
                 [r.binary() for r in spec.return_ids],
                 spec.num_returns, spec.fn_id,
                 spec.caller_id, spec.caller_seq, spec.seq_preds,
-                spec.trace_ctx)})
+                spec.trace_ctx)}
+            if wiretap.enabled:
+                wiretap.frame("direct", "caller", id(chan), "send",
+                              P.ACTOR_CALL, payload)
+            chan.writer.send_message(P.ACTOR_CALL, payload)
             return
-        chan.writer.send_message(P.ACTOR_CALL, {"spec": spec})
+        payload = {"spec": spec}
+        if wiretap.enabled:
+            wiretap.frame("direct", "caller", id(chan), "send",
+                          P.ACTOR_CALL, payload)
+        chan.writer.send_message(P.ACTOR_CALL, payload)
 
     def _pump(self, chan: _DirectChannel) -> None:
         """Ordered drain of calls whose ref args needed location
@@ -1223,6 +1232,11 @@ class DirectPlane:
         """Burst entry for one received frame: ACTOR_RESULT runs are
         retired under ONE lock hold / ONE DIRECT_DONE accounting frame
         (the receive-side face of the writer's coalescing)."""
+        if wiretap.enabled:
+            wiretap.frames(
+                "direct",
+                "caller" if isinstance(chan, _DirectChannel) else "callee",
+                id(chan), "recv", msgs)
         i, n = 0, len(msgs)
         while i < n:
             msg_type, payload = msgs[i]
@@ -1530,6 +1544,9 @@ class DirectPlane:
                 if isinstance(chan, _DirectChannel) and chan.alive:
                     cancel_chan = chan
         if cancel_chan is not None:
+            if wiretap.enabled:
+                wiretap.frame("direct", "caller", id(cancel_chan),
+                              "send", P.GEN_CANCEL, {"t": tb})
             try:
                 cancel_chan.writer.send_message(P.GEN_CANCEL, {"t": tb})
             except Exception:  # lint: broad-except-ok channel died under the cancel: reconcile terminates the stream anyway
@@ -1629,6 +1646,10 @@ class DirectPlane:
                 if snap is not None:
                     payload["settled_below"], payload["settled_set"] = \
                         snap
+                if wiretap.enabled:
+                    wiretap.frame("direct", "caller", id(chan), "send",
+                                  P.DIRECT_RECONCILE, payload)
+                    wiretap.request_sent(P.DIRECT_RECONCILE, req_id)
                 try:
                     w.send(P.DIRECT_RECONCILE, payload)
                 except Exception:
@@ -1827,9 +1848,13 @@ class DirectPlane:
         tagged like inline results, so cross-node callers can pull the
         SHM backing). Send failures propagate: the caller is gone and
         the executing generator aborts into the error path."""
-        chan.writer.send_message(P.GEN_ITEM, {
+        payload = {
             "t": task_id.binary(), "i": index,
-            "loc": self._tag_locs([loc])[0], "nested": nested})
+            "loc": self._tag_locs([loc])[0], "nested": nested}
+        if wiretap.enabled:
+            wiretap.frame("direct", "callee", id(chan), "send",
+                          P.GEN_ITEM, payload)
+        chan.writer.send_message(P.GEN_ITEM, payload)
 
     def send_result(self, chan, payload: dict) -> None:
         """Ship one completed direct call's result back to the caller;
@@ -1845,6 +1870,9 @@ class DirectPlane:
                 # Terminal frame of a channel stream: the caller
                 # registers the arrived items with the head here.
                 msg["streamed"] = payload["streamed"]
+            if wiretap.enabled:
+                wiretap.frame("direct", "callee", id(chan), "send",
+                              P.ACTOR_RESULT, msg)
             chan.writer.send_message(P.ACTOR_RESULT, msg)
             return
         except Exception:  # lint: broad-except-ok caller gone: fall through to head-accounting fallback below
@@ -1884,9 +1912,12 @@ class DirectPlane:
         if w._actor_instance is None or w._actor_executor is None:
             blob = serialization.dumps(ActorDiedError(
                 "serve request reached a worker that hosts no live actor"))
+            resp = {"r": payload.get("r"), "e": blob}
+            if wiretap.enabled:
+                wiretap.frame("direct", "callee", id(chan), "send",
+                              P.SERVE_RESP, resp)
             try:
-                chan.writer.send_message(
-                    P.SERVE_RESP, {"r": payload.get("r"), "e": blob})
+                chan.writer.send_message(P.SERVE_RESP, resp)
             except Exception:  # lint: broad-except-ok proxy hung up: its channel EOF fails the request typed
                 pass
             return
@@ -1921,6 +1952,9 @@ class DirectPlane:
                 # Request body was arena-staged by the proxy: ack so it
                 # can release the slot (oneway, coalesces with the
                 # response frame on the writer).
+                if wiretap.enabled:
+                    wiretap.frame("direct", "callee", id(chan), "send",
+                                  P.SERVE_BODY_FREE, {"o": free_ob})
                 chan.writer.send_message(P.SERVE_BODY_FREE,
                                          {"o": free_ob})
             method = getattr(w._actor_instance,
@@ -1947,6 +1981,9 @@ class DirectPlane:
         finally:
             if exec_span is not None or trace_token is not None:
                 w._trace_exit(trace_token, exec_span)
+        if wiretap.enabled:
+            wiretap.frame("direct", "callee", id(chan), "send",
+                          P.SERVE_RESP, msg)
         try:
             chan.writer.send_message(P.SERVE_RESP, msg)
         except Exception:  # lint: broad-except-ok proxy gone: reclaim the staged body, nothing else to tell

@@ -500,7 +500,7 @@ class SqliteKvStore(KvStore):
         with self._lock:
             try:
                 self._conn.close()
-            except Exception:
+            except Exception:  # lint: broad-except-ok close on an already-broken sqlite handle; shutdown is best-effort
                 pass
 
 

@@ -26,6 +26,7 @@ from . import fault
 from . import lockdep
 from . import protocol as P
 from . import telemetry
+from . import wiretap
 from .ids import WorkerID
 
 logger = logging.getLogger(__name__)
@@ -405,12 +406,19 @@ class HeadServer:
                 (peer_host, payload["transfer_port"]),
                 payload.get("hostname", ""), payload.get("pid", 0),
                 labels=payload.get("labels"))
+            if wiretap.enabled:
+                wiretap.frame("daemon", "head", id(handle), "recv",
+                              P.REGISTER_NODE, payload)
             # ACK strictly FIRST: registration wakes the scheduler, which
             # may dispatch START_WORKER to this daemon immediately — the
             # daemon's handshake must not see that before the ack.
-            handle.send(P.NODE_ACK, {
+            ack = {
                 "head_node_id_hex": self._node.node_id.hex(),
-                "head_transfer_port": self._node.transfer_port})
+                "head_transfer_port": self._node.transfer_port}
+            if wiretap.enabled:
+                wiretap.frame("daemon", "head", id(handle), "send",
+                              P.NODE_ACK, ack)
+            handle.send(P.NODE_ACK, ack)
             self._node._on_daemon_registered(handle)
             with self._lock:
                 self.daemons[handle.node_id_hex] = handle
@@ -476,6 +484,9 @@ class HeadServer:
             # (relayed worker messages count again at the worker mux —
             # the two planes are separate loops with separate budgets).
             telemetry.count_msg(msg_type)
+        if wiretap.enabled:
+            wiretap.frame("daemon", "head", id(handle), "recv",
+                          msg_type, payload)
         if msg_type == P.FROM_WORKER:
             handle._route_exec.submit(self._route_from_worker, handle,
                                       payload)

@@ -597,7 +597,7 @@ class _SpillTarget:
                 os.unlink(os.path.join(self.local_dir, oid_hex))
             else:
                 self._fs.delete_file(self._key(oid_hex))
-        except Exception:
+        except Exception:  # lint: broad-except-ok spill file already gone (double-delete race) costs nothing
             pass
 
     def cleanup(self) -> None:
@@ -606,7 +606,7 @@ class _SpillTarget:
         if self._fs is not None:
             try:
                 self._fs.delete_dir(self._base)
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort removal of the remote spill dir at shutdown
                 pass
 
 
@@ -637,7 +637,7 @@ class _ArenaPin:
             try:
                 self._view.release()
                 self._native.release(self._key)
-            except Exception:
+            except Exception:  # lint: broad-except-ok destructor: interpreter teardown may have reaped the arena already
                 pass
 
 

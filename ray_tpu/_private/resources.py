@@ -63,7 +63,7 @@ class TPUAcceleratorManager:
                 headers={"Metadata-Flavor": "Google"})
             with urllib.request.urlopen(req, timeout=0.5) as r:
                 return r.read().decode().strip()
-        except Exception:
+        except Exception:  # lint: broad-except-ok off-GCP the metadata server does not exist; detection degrades to None
             return None
 
     @staticmethod

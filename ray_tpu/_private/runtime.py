@@ -38,6 +38,7 @@ from . import protocol as P
 from . import refdebug
 from . import serialization
 from . import telemetry
+from . import wiretap
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import ObjectStore, create_store, inline_threshold
 from .resources import detect_node_resources
@@ -132,7 +133,7 @@ def _proc_start_time(pid: int):
         fields = stat.rsplit(")", 1)[1].split()
         ticks = int(fields[19])  # fields[0] is state, so 22-3=19
         return btime + ticks / os.sysconf("SC_CLK_TCK")
-    except Exception:
+    except Exception:  # lint: broad-except-ok /proc parse on a racing or non-Linux pid; None means unknown
         return None
 
 
@@ -1994,6 +1995,9 @@ class Node:
         if telemetry.enabled:
             # These bypass _on_worker_message's per-type counter.
             telemetry.count_msg(P.SUBMIT_TASK, len(payloads))
+        if wiretap.enabled:
+            wiretap.frames("worker", "head", id(handle), "recv",
+                           [(P.SUBMIT_TASK, p) for p in payloads])
         items = []
         for p in payloads:
             spec = p["spec"]
@@ -2536,6 +2540,13 @@ class Node:
             # scale harness's msgs/s signal), exported as gauges at
             # exposition time. One dict bump per message.
             telemetry.count_msg(msg_type)
+        if wiretap.enabled:
+            # Per-message chokepoint: both mux dispatch shapes (single
+            # frames and coalesced bursts) and daemon-relayed proxies
+            # funnel through here; SUBMIT_TASK runs are fed in
+            # _submit_task_run.
+            wiretap.frame("worker", "head", id(handle), "recv",
+                          msg_type, payload)
         if msg_type == P.REF_COUNT:
             # Oneway borrow count from a worker (no reply).
             if payload["delta"] > 0:

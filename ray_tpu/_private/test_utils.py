@@ -48,7 +48,7 @@ class ResourceKiller:
                 try:
                     self._kill(victim)
                     self.killed.append(victim)
-                except Exception:
+                except Exception:  # lint: broad-except-ok chaos kill racing natural process death; retry next tick
                     pass
             self._stop.wait(self.kill_interval_s)
 

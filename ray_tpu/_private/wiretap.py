@@ -1,0 +1,307 @@
+"""Wire-protocol conformance tap ("wiretap").
+
+The dynamic half of the protocol model (static passes:
+``devtools/lint/protocol_order.py`` / ``payload_schema.py``), built on
+the lockdep/refdebug pattern: a falsy module flag, env-propagated into
+every spawned process, zero instrumentation work when off (asserted by
+the counter-based perf_smoke guard in tests/test_wiretap.py).
+
+Enabled (``RAY_TPU_WIRETAP=1`` or :func:`configure`), every process
+replays the frames crossing its recv muxes — the worker pipe's both
+ends, the daemon/head routing loops, and the direct/serve channel recv
+loops — through per-connection
+:class:`~ray_tpu.devtools.lint.protocol_model.SessionDFA` interpreters
+built from the SAME declarative model the static passes check. A frame
+that breaks the session contract (response without a request, stream
+item after its terminal entry, body-free without a staged body, frame
+after teardown, unbalanced block counters, ...) is journaled as one
+JSON line, appended and flushed at record time to a per-process file in
+``RAY_TPU_WIRETAP_DIR`` — SIGKILL-safe by construction: no atexit step,
+whatever a process managed to journal before dying is what the checker
+sees. Each violation record carries the connection's recent-frame ring,
+so a report shows BOTH endpoints' context: what this process saw
+arriving and what it had just sent.
+
+The conftest autouse guard (tests/conftest.py::_wiretap_guard) runs the
+protocol-heavy suites under the tap and fails any test whose processes
+recorded a nonconforming sequence. How to read a report:
+docs/STATIC_ANALYSIS.md#the-protocol-model.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_ENV_VAR = "RAY_TPU_WIRETAP"
+# Where violation journals land (inherited by spawned daemons and
+# workers). Unset means enabled processes validate in memory only —
+# the in-process `violations()` list still fills, nothing hits disk.
+_DUMP_ENV_VAR = "RAY_TPU_WIRETAP_DIR"
+
+_JOURNAL_PREFIX = "wiretap-journal-"
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(_ENV_VAR, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+# Falsy-flag gate (fault.py / lockdep / refdebug discipline): module
+# attribute, one dict lookup at each hook site; disabled processes
+# never touch the model, never build a DFA, never format a frame.
+enabled = _env_enabled()
+
+# Instrumentation-work counter: every record below bumps it, so the
+# perf_smoke guard can assert the disabled path did ZERO wiretap work.
+_ops = 0
+
+
+def configure(on: bool, propagate_env: bool = True) -> None:
+    """Flip frame validation for frames seen FROM NOW ON in this
+    process; with ``propagate_env`` the setting rides into spawned
+    daemons and workers (their hooks read the flag at boot, after env
+    inheritance)."""
+    global enabled
+    enabled = bool(on)
+    if propagate_env:
+        if on:
+            os.environ[_ENV_VAR] = "1"
+        else:
+            os.environ.pop(_ENV_VAR, None)
+
+
+def instrument_ops() -> int:
+    """Recording operations performed so far (perf_smoke guard)."""
+    return _ops
+
+
+# ---------------------------------------------------------------------------
+# model plumbing (loaded lazily — only enabled processes pay for it)
+# ---------------------------------------------------------------------------
+_lock = threading.Lock()
+_dfas: Dict[Tuple[str, Any], Any] = {}    # (session, conn key) -> DFA
+_violations: List[dict] = []
+_names: Optional[Dict[Any, str]] = None   # wire value -> constant name
+_extractors: Optional[Dict[str, Any]] = None
+
+
+def _serve_stage(body: Any) -> Optional[Any]:
+    # serve bodies are ("i", payload) inline or ("o", oid_bytes) staged
+    try:
+        if body and body[0] == "o":
+            return body[1]
+    except (TypeError, IndexError, KeyError):
+        pass
+    return None
+
+
+def _load_model() -> None:
+    """Build the value->name map and the payload extractors. Keyed
+    lookups only — the tap must not become a recv loop itself."""
+    global _names, _extractors
+    from ..devtools.lint import protocol_model
+    from . import protocol as P
+    names: Dict[Any, str] = {}
+    for name in protocol_model.all_modeled_constants():
+        try:
+            names[getattr(P, name)] = name
+        except AttributeError:
+            continue  # model/protocol drift: protocol-order flags it
+    _extractors = {
+        "REPLY": lambda p: {"key": p.get("req_id")},
+        # every call opens a (possibly empty) stream; its terminal
+        # entry or a cancel closes it. Both wire shapes carry the task
+        # id: compact slot 0, or the full spec's task_id.
+        "ACTOR_CALL": lambda p: (
+            {"key": p["c"][0], "streaming": True} if p.get("c")
+            else {"key": p["spec"].task_id.binary(), "streaming": True}
+            if p.get("spec") is not None else {}),
+        "ACTOR_RESULT": lambda p: {"key": p.get("t"),
+                                   "streamed": p.get("streamed")},
+        "GEN_ITEM": lambda p: {"key": p.get("t"), "index": p.get("i")},
+        "GEN_CANCEL": lambda p: {"key": p.get("t")},
+        "SERVE_REQ": lambda p: {"key": p.get("r"),
+                                "stage": _serve_stage(p.get("b"))},
+        "SERVE_RESP": lambda p: {"key": p.get("r"),
+                                 "stage": _serve_stage(p.get("v"))},
+        "SERVE_BODY_FREE": lambda p: {"key": p.get("o")},
+    }
+    _names = names
+
+
+def _dfa(session: str, role: str, ckey: Any):
+    """The per-connection DFA, created on first frame. Caller holds
+    _lock."""
+    dfa = _dfas.get((session, ckey))
+    if dfa is None:
+        from ..devtools.lint import protocol_model
+        dfa = protocol_model.SessionDFA(session, role, repr(ckey),
+                                        extractors=_extractors)
+        _dfas[(session, ckey)] = dfa
+    return dfa
+
+
+def reset() -> None:
+    """Drop process-local DFA/journal state (test isolation)."""
+    global _journal_fh, _journal_pid
+    with _lock:
+        _dfas.clear()
+        _violations.clear()
+    with _journal_lock:
+        if _journal_fh is not None:
+            try:
+                _journal_fh.close()
+            except OSError:
+                pass
+        _journal_fh = None
+        _journal_pid = None
+
+
+def violations() -> List[dict]:
+    """In-process violations recorded so far (unit tests)."""
+    with _lock:
+        return list(_violations)
+
+
+# ---------------------------------------------------------------------------
+# journal writer (process-local; reopened after fork/spawn)
+# ---------------------------------------------------------------------------
+_journal_lock = threading.Lock()
+_journal_fh = None
+_journal_pid: Optional[int] = None
+
+
+def _write(event: Dict[str, Any]) -> None:
+    """Append one violation line, flushed immediately (SIGKILL-safe: a
+    dying process loses at most the line it was mid-write on). Never
+    raises into the runtime."""
+    global _journal_fh, _journal_pid
+    dump_dir = os.environ.get(_DUMP_ENV_VAR)
+    if not dump_dir:
+        return
+    pid = os.getpid()
+    with _journal_lock:
+        try:
+            if _journal_fh is None or _journal_pid != pid:
+                # First violation in this process (or post-fork): open
+                # our own journal; an inherited handle would interleave
+                # with the parent's.
+                path = os.path.join(dump_dir,
+                                    f"{_JOURNAL_PREFIX}{pid}.jsonl")
+                _journal_fh = open(path, "a", encoding="utf-8")
+                _journal_pid = pid
+            import json
+            event["pid"] = pid
+            _journal_fh.write(json.dumps(event, default=repr) + "\n")
+            _journal_fh.flush()
+        except OSError:
+            logger.debug("wiretap journal write failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# record hooks — each call site sits under `if wiretap.enabled`
+# (enforced by the gate-discipline pass; this module is registered in
+# GATED_HELPER_FILES so every `global _ops` function below is a helper)
+# ---------------------------------------------------------------------------
+def frame(session: str, role: str, ckey: Any, direction: str,
+          msg_type: Any, payload: Any) -> None:
+    """Feed one frame through the connection's session DFA. `ckey`
+    identifies the connection within this process (a channel key, a
+    handle id — anything stable for the connection's lifetime)."""
+    global _ops
+    _ops += 1
+    try:
+        with _lock:
+            if _names is None:
+                _load_model()
+            const = _names.get(msg_type)
+            if const is None:
+                return  # not a modeled constant: coverage's problem
+            found = _dfa(session, role, ckey).feed(direction, const,
+                                                   payload)
+            if found:
+                _violations.extend(found)
+        for v in found or ():
+            _write(dict(v))
+    except Exception:
+        logger.debug("wiretap frame hook failed", exc_info=True)
+
+
+def frames(session: str, role: str, ckey: Any, direction: str,
+           msgs: Any) -> None:
+    """Burst-entry variant: `msgs` is an iterable of (msg_type,
+    payload) pairs (the recv muxes' batch shape)."""
+    global _ops
+    _ops += 1
+    for msg_type, payload in msgs:
+        frame(session, role, ckey, direction, msg_type, payload)
+
+
+def request_sent(msg_type: Any, req_id: Any,
+                 ckey: Any = "head") -> None:
+    """Register an outstanding rid-keyed request on this process's
+    worker-session pipe (the Worker.request chokepoint injects req_id
+    and calls this; a REPLY for an unknown rid is then a violation)."""
+    global _ops
+    _ops += 1
+    try:
+        with _lock:
+            if _names is None:
+                _load_model()
+            _dfa("worker", "worker", ckey).note_request(req_id)
+    except Exception:
+        logger.debug("wiretap request hook failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# checker: merge journals (what the conftest guard reads)
+# ---------------------------------------------------------------------------
+def collect_violations(dump_dir: str) -> List[dict]:
+    """Every violation journaled under `dump_dir`, in per-file write
+    order. Tolerates torn final lines (the process died mid-write)."""
+    import glob
+    import json
+    out: List[dict] = []
+    for path in sorted(glob.glob(
+            os.path.join(dump_dir, f"{_JOURNAL_PREFIX}*.jsonl"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail line
+        except OSError:
+            continue
+    return out
+
+
+def format_report(violations: List[dict]) -> str:
+    """Human-readable conformance report (what the conftest fixture
+    prints on failure). The ``recent`` ring shows the connection's last
+    frames from THIS endpoint's perspective — `send` entries are what
+    it put on the wire, `recv` entries what the peer did."""
+    out: List[str] = []
+    for v in violations:
+        out.append("=" * 70)
+        ring = ", ".join(f"{d}:{c}" for d, c in v.get("recent", ()))
+        out.append(
+            f"PROTOCOL VIOLATION [{v.get('kind')}]: {v.get('const')} "
+            f"({v.get('dir')}) on {v.get('session')} session "
+            f"{v.get('conn')} (role {v.get('role')}, state "
+            f"{v.get('state')}, pid {v.get('pid', '?')})")
+        detail = {k: val for k, val in v.items()
+                  if k not in ("kind", "const", "dir", "session", "conn",
+                               "role", "state", "pid", "recent")}
+        if detail:
+            out.append(f"  detail: {detail}")
+        out.append(f"  recent frames: [{ring}]")
+    return "\n".join(out)

@@ -45,6 +45,7 @@ from . import protocol as P
 from . import refdebug
 from . import serialization
 from . import telemetry
+from . import wiretap
 from .ids import ActorID, ObjectID, TaskID
 from .object_store import ObjectStore, create_store, inline_threshold
 
@@ -655,6 +656,8 @@ class Worker:
         self._pending[req_id] = fut
         payload = dict(payload)
         payload["req_id"] = req_id
+        if wiretap.enabled:
+            wiretap.request_sent(msg_type, req_id)
         self.send(msg_type, payload)
         result = fut.result()
         if isinstance(result, dict) and result.get("__error__") is not None:
@@ -845,7 +848,7 @@ class Worker:
                         "worker_id": self.config.worker_id.hex(),
                         "node_id": self.config.node_id_hex,
                         "groups": groups, "ts": time.time()})
-        except Exception:
+        except Exception:  # lint: broad-except-ok telemetry flush must never break completion delivery (docstring contract)
             pass
 
     def _emit_done(self, payload: dict, direct_chan=None):
@@ -1359,6 +1362,9 @@ class Worker:
     def _handle_message(self, msg_type: str, payload: dict) -> bool:
         """Route one decoded message; returns True on SHUTDOWN."""
         import pickle
+        if wiretap.enabled:
+            wiretap.frame("worker", "worker", "head", "recv", msg_type,
+                          payload)
         if msg_type == P.EXEC_TASK:
             self._handle_exec(payload["spec"])
         elif msg_type == P.EXEC_TASKS:

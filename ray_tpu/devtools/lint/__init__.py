@@ -31,6 +31,17 @@ Passes (see docs/STATIC_ANALYSIS.md for the full catalog):
     barrier-coverage    every head-bound send chokepoint flushes the
                         accounting barrier first or carries a reasoned
                         exemption
+    protocol-order      every send site's constant is a legal transition
+                        of its registered session DFA, every request has
+                        a verified response path, and no send follows
+                        its connection's teardown (protocol_model.py)
+    payload-schema      send-site payload shapes match the per-constant
+                        schema (orphan keys, phantom consumer reads,
+                        compact-tuple arity drift, dead model keys)
+
+The protocol model has a dynamic half too: ``_private/wiretap.py``
+replays live frame sequences through the same session DFAs when
+RAY_TPU_WIRETAP=1 (see docs/STATIC_ANALYSIS.md#the-protocol-model).
 
 Pre-existing violations are ratcheted in ``baseline.json``: the suite is
 green on day one, any NEW violation fails tier-1 (tests/test_lint.py),
@@ -56,4 +67,6 @@ PASS_NAMES = (
     "config-keys",
     "ref-discipline",
     "barrier-coverage",
+    "protocol-order",
+    "payload-schema",
 )

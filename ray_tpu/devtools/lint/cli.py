@@ -7,6 +7,13 @@ Modes:
     --update-baseline    rewrite baseline.json from the current tree
     --root DIR           analyze a different tree (fixtures/tests); the
                          baseline defaults to empty then
+    --since REV          incremental gate: passes still run on the FULL
+                         tree (the cross-file checks need it), but only
+                         violations in files changed since REV (plus
+                         untracked files) are reported/failed — the
+                         fast-CI shape. Stale-fingerprint burndown is
+                         skipped (unchanged files are out of scope), and
+                         --update-baseline refuses a narrowed run.
 """
 
 from __future__ import annotations
@@ -14,8 +21,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from . import PASS_NAMES
 from .core import (LintTree, apply_baseline, fingerprint_counts,
@@ -24,6 +32,32 @@ from .core import (LintTree, apply_baseline, fingerprint_counts,
 _LINT_DIR = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_ROOT = os.path.dirname(os.path.dirname(_LINT_DIR))  # ray_tpu/
 DEFAULT_BASELINE = os.path.join(_LINT_DIR, "baseline.json")
+
+
+def changed_files(root: str, rev: str) -> Set[str]:
+    """Lint-root-relative paths changed since `rev` (committed diffs,
+    staged/unstaged edits, and untracked files). Raises
+    ``subprocess.CalledProcessError`` on an unknown rev and
+    ``FileNotFoundError`` when git is absent."""
+    top = subprocess.run(
+        ["git", "-C", root, "rev-parse", "--show-toplevel"],
+        check=True, capture_output=True, text=True).stdout.strip()
+    out: Set[str] = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", rev, "--"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        res = subprocess.run(cmd, check=True, capture_output=True,
+                             text=True)
+        for line in res.stdout.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            # git paths are repo-root-relative; violations are
+            # lint-root-relative.
+            rel = os.path.relpath(os.path.join(top, line), root)
+            if not rel.startswith(".."):
+                out.add(rel)
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -45,6 +79,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--passes", nargs="*", choices=PASS_NAMES,
                         default=None, metavar="PASS",
                         help="subset of passes to run")
+    parser.add_argument("--since", default=None, metavar="REV",
+                        help="report only violations in files changed "
+                             "since REV (full-tree analysis, narrowed "
+                             "reporting — the incremental CI gate)")
     parser.add_argument("--format", choices=("text", "json", "github"),
                         default="text", dest="fmt",
                         help="output format: human text (default), a "
@@ -84,6 +122,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "checked-in baseline with another tree's results)",
                   file=sys.stderr)
             return 2
+        if args.since is not None:
+            print("raylint: refusing --update-baseline with --since "
+                  "(the ratchet must be rewritten from a full run, "
+                  "never a changed-files slice)", file=sys.stderr)
+            return 2
         path = baseline_path or DEFAULT_BASELINE
         save_baseline(path, violations)
         print(f"raylint: baseline updated: {path} "
@@ -95,6 +138,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if baseline_path and not args.no_baseline:
         baseline = load_baseline(baseline_path)
     res = apply_baseline(violations, baseline)
+
+    if args.since is not None:
+        try:
+            scope = changed_files(root, args.since)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            print(f"raylint: --since {args.since}: cannot resolve "
+                  f"changed files: {detail.strip()}", file=sys.stderr)
+            return 2
+        res.new = [v for v in res.new if v.file in scope]
+        # Unchanged files are out of scope: a fingerprint that stopped
+        # firing there is the FULL run's burndown signal, not this one's.
+        res.fixed = []
+        if not args.quiet and args.fmt == "text":
+            print(f"raylint: --since {args.since}: narrowed to "
+                  f"{len(scope)} changed file(s)")
 
     if args.fmt == "json":
         new_set = {id(v) for v in res.new}

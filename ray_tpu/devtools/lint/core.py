@@ -164,7 +164,8 @@ class LintTree:
 def run_passes(tree: LintTree,
                passes: Optional[Iterable[str]] = None) -> List[Violation]:
     from . import barrier_coverage, broad_except, config_keys, \
-        gate_discipline, lock_discipline, protocol_coverage, ref_discipline
+        gate_discipline, lock_discipline, payload_schema, \
+        protocol_coverage, protocol_order, ref_discipline
     table = {
         "protocol-coverage": protocol_coverage.run,
         "lock-discipline": lock_discipline.run,
@@ -173,6 +174,8 @@ def run_passes(tree: LintTree,
         "config-keys": config_keys.run,
         "ref-discipline": ref_discipline.run,
         "barrier-coverage": barrier_coverage.run,
+        "protocol-order": protocol_order.run,
+        "payload-schema": payload_schema.run,
     }
     names = list(passes) if passes is not None else list(table)
     out: List[Violation] = list(tree.parse_errors)

@@ -213,17 +213,22 @@ BLOCKING_MODULES = frozenset({"subprocess", "shutil"})
 # "refdebug" joined in PR 9: the shadow-ledger journal hooks sit on the
 # refcount hot paths (every incref/decref/park/flush) and must be
 # zero-work when RAY_TPU_REFDEBUG is off.
-GATED_MODULES = ("telemetry", "fault", "tracing", "refdebug")
+# "wiretap" joined in PR 14: the protocol-conformance tap's frame hooks
+# sit on every recv mux and send chokepoint and must be zero-work when
+# RAY_TPU_WIRETAP is off.
+GATED_MODULES = ("telemetry", "fault", "tracing", "refdebug", "wiretap")
 # Files that implement the planes themselves (helpers live here; their
 # internal calls are exempt from the gating requirement).
 GATE_IMPL_FILES = ("_private/telemetry.py", "_private/fault.py",
-                   "util/tracing.py", "_private/refdebug.py")
+                   "util/tracing.py", "_private/refdebug.py",
+                   "_private/wiretap.py")
 # Where each gated module's ``_ops``-bumping helpers are parsed from
 # (the functions that MUST be gated at call sites).
 GATED_HELPER_FILES = {
     "telemetry": "_private/telemetry.py",
     "tracing": "util/tracing.py",
     "refdebug": "_private/refdebug.py",
+    "wiretap": "_private/wiretap.py",
 }
 
 # ---------------------------------------------------------------------------
@@ -330,7 +335,9 @@ REF_PAYLOADS = {
         "send_const": "GEN_ITEM",
         "producer_file": "_private/direct.py",
         "producers": ("DirectPlane.send_gen_item",),
-        "entry_vars": (),
+        # The payload literal is bound to a local first (the wiretap
+        # hook records the same object the writer ships).
+        "entry_vars": ("payload",),
         "consumer_file": "_private/direct.py",
         "consumers": ("DirectPlane._on_gen_items",),
         "payload_vars": ("p",),
@@ -385,4 +392,236 @@ BARRIER_EXEMPT = {
     "WORKER_UNBLOCKED": "advisory scheduler hint; no object references",
     "TASKS_RECALLED": "recalled specs never executed here: no local "
                       "accounting exists for their returns yet",
+}
+
+# ---------------------------------------------------------------------------
+# protocol-order: the send-site registry (the RECV_LOOPS dual).
+#
+# (file, qualname) -> tuple of (session, role, states) entries from
+# protocol_model.SESSIONS: the session conversations this function is
+# registered to speak in and the DFA states it may run in. A send
+# site's constant must be a legal send for AT LEAST ONE entry (const in
+# that session/role's send table, with overlapping states). A send of a
+# protocol constant from an unregistered function fails — like an
+# unregistered recv loop, it would dodge the ordering contract.
+# Nested defs (NodeDaemon._route._localize) inherit the enclosing
+# registered function's entries. Escape hatch on the send line:
+# `# lint: protocol-order-ok <reason>` (stale annotations are flagged).
+#
+# A handful of functions speak in TWO sessions at once: the direct
+# channel's handshake/teardown constants (CHANNEL_REQ, CHANNEL_ADDR,
+# DIRECT_RECONCILE) ride the worker pipe, so their senders carry both
+# the direct-session entry (the conversation they advance) and the
+# worker-session entry (the transport they ride).
+# ---------------------------------------------------------------------------
+PROTOCOL_SEND_FUNCS = {
+    # -- head side of the worker pipe ----------------------------------
+    ("_private/runtime.py", "Node._broadcast_releases"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._dispatch"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._dispatch_actor_creation"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._flush_actor_queue"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._cancel_running_task"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node.cancel"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._on_worker_death"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._reply"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._note_seq_settled"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._broker_channel_info"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._note_blocked_and_recall"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._forward_results"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/runtime.py", "Node._fwd_scope_end"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/scheduler.py", "WorkerHandle._flush_coalesced_locked"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/scheduler.py", "WorkerPool.shutdown"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/scheduler.py", "Scheduler._try_pipeline"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/scheduler.py", "Scheduler._reclaim_idle_tpu_workers"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/node_service.py", "HeadServer._heartbeat_monitor"):
+        (("worker", "head", ("OPEN",)),),
+    # The daemon answers node-local worker-plane requests (spill, pull,
+    # view) in the head role of the worker session, and relays the rest.
+    ("_private/daemon.py", "NodeDaemon._heartbeat_loop"):
+        (("daemon", "daemon", ("REGISTERED",)),
+         ("worker", "head", ("OPEN",))),
+    ("_private/daemon.py", "NodeDaemon._on_worker_message"):
+        (("daemon", "daemon", ("REGISTERED",)),
+         ("worker", "head", ("OPEN",))),
+    ("_private/daemon.py", "NodeDaemon._handle_pull"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/daemon.py", "NodeDaemon._route_worker_plane"):
+        (("worker", "head", ("OPEN",)),),
+    ("_private/daemon.py", "NodeDaemon._reclaim_idle_tpu_workers"):
+        (("worker", "head", ("OPEN",)),),
+    # -- worker side of the worker pipe --------------------------------
+    ("_private/worker_proc.py", "WorkerClient.incref"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.decref"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.put"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.get_locations"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.wait"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.submit_task"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.submit_actor_task"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.create_actor"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.get_actor"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.kill_actor"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "WorkerClient.gcs_request"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "Worker.read_location"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "Worker._stream_generator"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "Worker._flush_telemetry"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "Worker._emit_done"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "Worker._recall_queued"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/worker_proc.py", "Worker._create_actor"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/direct.py", "DirectPlane._flush_accounting_locked"):
+        (("worker", "worker", ("OPEN",)),),
+    ("_private/direct.py", "DirectPlane.get_locations"):
+        (("worker", "worker", ("OPEN",)),),
+    # -- direct channel (handshake constants ride the worker pipe) -----
+    ("_private/direct.py", "DirectPlane._establish"):
+        (("direct", "caller", ("ESTABLISHING",)),
+         ("worker", "worker", ("OPEN",))),
+    ("_private/direct.py", "DirectPlane.on_channel_open"):
+        (("direct", "callee", ("ESTABLISHING",)),
+         ("worker", "worker", ("OPEN",))),
+    ("_private/direct.py", "DirectPlane._send_call"):
+        (("direct", "caller", ("OPEN",)),),
+    ("_private/direct.py", "DirectPlane.gen_release"):
+        (("direct", "caller", ("OPEN",)),),
+    ("_private/direct.py", "DirectPlane._on_channel_down"):
+        (("direct", "caller", ("DRAINING",)),
+         ("worker", "worker", ("OPEN",))),
+    ("_private/direct.py", "DirectPlane.send_gen_item"):
+        (("direct", "callee", ("OPEN", "DRAINING")),),
+    ("_private/direct.py", "DirectPlane.send_result"):
+        (("direct", "callee", ("OPEN", "DRAINING")),
+         ("worker", "worker", ("OPEN",))),
+    ("_private/direct.py", "DirectPlane._on_serve_req"):
+        (("direct", "callee", ("OPEN", "DRAINING")),),
+    ("_private/direct.py", "DirectPlane._serve_exec"):
+        (("direct", "callee", ("OPEN", "DRAINING")),),
+    ("serve/_private/direct_client.py", "_broker"):
+        (("direct", "caller", ("ESTABLISHING",)),
+         ("worker", "worker", ("OPEN",))),
+    ("serve/_private/direct_client.py", "_ServeChannel.call"):
+        (("direct", "caller", ("OPEN",)),),
+    ("serve/_private/direct_client.py", "_ServeChannel._on_resp"):
+        (("direct", "caller", ("OPEN",)),),
+    # -- head side of the daemon link ----------------------------------
+    ("_private/node_service.py", "RemoteWorkerProxy.send"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("_private/node_service.py", "RemoteWorkerProxy.kill"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("_private/node_service.py", "DaemonHandle.start_worker"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("_private/node_service.py", "HeadServer._serve_daemon"):
+        (("daemon", "head", ("NEW",)),),
+    ("_private/node_service.py", "HeadServer._route"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("_private/node_service.py", "HeadServer._handle_node_request"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("_private/node_service.py", "HeadServer.stop"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("_private/runtime.py", "Node._drain_worker"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("_private/runtime.py", "Node._drain_rehome_objects"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("_private/scheduler.py", "Scheduler._try_dispatch"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("cluster_utils.py", "Cluster.remove_node"):
+        (("daemon", "head", ("REGISTERED",)),),
+    ("autoscaler/v2.py", "DaemonInstanceProvider.terminate"):
+        (("daemon", "head", ("REGISTERED",)),),
+    # -- daemon side of the daemon link --------------------------------
+    ("_private/daemon.py", "NodeDaemon._connect_head"):
+        (("daemon", "daemon", ("NEW",)),),
+    ("_private/daemon.py", "NodeDaemon._request"):
+        (("daemon", "daemon", ("REGISTERED",)),),
+    ("_private/daemon.py", "NodeDaemon._route"):
+        (("daemon", "daemon", ("REGISTERED",)),),
+    ("_private/daemon.py", "NodeDaemon._start_worker"):
+        (("daemon", "daemon", ("REGISTERED",)),),
+    ("_private/daemon.py", "NodeDaemon._on_worker_death"):
+        (("daemon", "daemon", ("REGISTERED",)),),
+}
+
+# Attribute names that move a protocol frame toward a transport: the
+# protocol-order/payload-schema passes treat a call of one of these
+# with a P.<CONST> first argument as a send site.
+PROTOCOL_SEND_ATTRS = frozenset({
+    "send", "send_lazy", "send_message", "request", "_request", "_send",
+    "broadcast", "dump_message",
+})
+
+# Attribute names that tear a connection down; a send on the same
+# receiver lexically after one of these (same function) is flagged.
+PROTOCOL_CLOSE_ATTRS = frozenset({"close"})
+
+# ---------------------------------------------------------------------------
+# payload-schema: registered consumers whose reads are diffed against
+# protocol_model.PAYLOADS (the phantom-field direction; producers are
+# discovered from send sites). Each entry: the payload dict goes by
+# `payload_vars` inside `functions` of `file`. A consumer read of a key
+# no schema variant declares is a phantom (masks producer regressions).
+# ---------------------------------------------------------------------------
+PAYLOAD_CONSUMERS = {
+    "ACTOR_CALL": (
+        {"file": "_private/direct.py",
+         "functions": ("DirectPlane._wire_spec",),
+         "payload_vars": ("payload",)},
+    ),
+    "SERVE_REQ": (
+        {"file": "_private/direct.py",
+         "functions": ("DirectPlane._serve_exec",
+                       "DirectPlane._on_serve_req"),
+         "payload_vars": ("payload",)},
+    ),
+    "SERVE_RESP": (
+        {"file": "serve/_private/direct_client.py",
+         "functions": ("_ServeChannel._on_resp",),
+         "payload_vars": ("payload",)},
+    ),
+    "SERVE_BODY_FREE": (
+        {"file": "_private/direct.py",
+         "functions": ("DirectPlane._on_serve_body_free",),
+         "payload_vars": ("payload",)},
+    ),
+    "GEN_CANCEL": (
+        {"file": "_private/direct.py",
+         "functions": ("DirectPlane._handle_direct_message",),
+         "payload_vars": ("payload",)},
+    ),
+    "REGISTER_NODE": (
+        {"file": "_private/node_service.py",
+         "functions": ("HeadServer._serve_daemon",),
+         "payload_vars": ("payload",)},
+    ),
 }

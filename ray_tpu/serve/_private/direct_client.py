@@ -37,6 +37,7 @@ from ray_tpu._private import protocol as P
 from ray_tpu._private import serialization
 from ray_tpu._private import state as _state
 from ray_tpu._private import telemetry
+from ray_tpu._private import wiretap
 from ray_tpu._private.direct import (DirectPlane, serve_decode_body,
                                      serve_encode_body)
 
@@ -137,6 +138,9 @@ class _ServeChannel:
         msg = {"r": rid, "m": method, "b": body, "sn": self.same_node}
         if trace_ctx:
             msg["tr"] = trace_ctx
+        if wiretap.enabled:
+            wiretap.frame("direct", "caller", id(self), "send",
+                          P.SERVE_REQ, msg)
         try:
             self.writer.send_message(P.SERVE_REQ, msg)
         except Exception:
@@ -165,6 +169,9 @@ class _ServeChannel:
                 break
             try:
                 for msg_type, payload in P.load_messages(data):
+                    if wiretap.enabled:
+                        wiretap.frame("direct", "caller", id(self),
+                                      "recv", msg_type, payload)
                     if msg_type == P.SERVE_RESP:
                         self._on_resp(payload)
                     elif msg_type == P.SERVE_BODY_FREE:
@@ -197,6 +204,9 @@ class _ServeChannel:
                 # Response body was arena-staged by the replica: ack so
                 # it releases the slot (reader pins keep our decoded
                 # views safe across the free).
+                if wiretap.enabled:
+                    wiretap.frame("direct", "caller", id(self), "send",
+                                  P.SERVE_BODY_FREE, {"o": free_ob})
                 self.writer.send_message(P.SERVE_BODY_FREE,
                                          {"o": free_ob})
             fut.set_result(value)

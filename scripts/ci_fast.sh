@@ -7,7 +7,17 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== raylint (github annotations) =="
-python -m ray_tpu.devtools.lint --format github
+# RAYLINT_SINCE=<rev> narrows the gate to files changed since <rev>
+# (analysis still runs full-tree; only the reporting is scoped).
+python -m ray_tpu.devtools.lint --format github \
+    ${RAYLINT_SINCE:+--since "$RAYLINT_SINCE"}
+
+echo "== wiretap conformance smoke (protocol DFAs under the tap) =="
+# One protocol-heavy suite under RAY_TPU_WIRETAP=1: the conftest guard
+# fails any test whose processes journal a nonconforming frame
+# sequence, plus the tap's own unit suite (zero-work guard included).
+env JAX_PLATFORMS=cpu python -m pytest tests/test_wiretap.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== serve-direct flag-off zero-work guard =="
 # serve_direct_enabled=false must do ZERO serve-direct work — not

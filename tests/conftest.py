@@ -150,6 +150,48 @@ def _refdebug_guard(request, tmp_path_factory):
             os.environ["RAY_TPU_REFDEBUG_DIR"] = prev_dir
 
 
+# Suites that run under the wire-protocol conformance tap
+# (_private/wiretap.py): the protocol-heavy tiers replay every frame
+# crossing a recv mux through the session DFAs of
+# devtools/lint/protocol_model.py — the dynamic half of the
+# protocol-order/payload-schema static passes. Per-test journal dir so
+# a nonconforming sequence is attributable to the test that produced
+# it (every process of the run appends violations at record time,
+# SIGKILL-safe).
+_WIRETAP_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
+                   "test_serve_direct"}
+
+
+@pytest.fixture(autouse=True)
+def _wiretap_guard(request, tmp_path_factory):
+    name = getattr(request.module, "__name__", "")
+    if name.rpartition(".")[2] not in _WIRETAP_SUITES:
+        yield
+        return
+    from ray_tpu._private import wiretap
+    wiretap.reset()
+    prev = wiretap.enabled
+    dump_dir = str(tmp_path_factory.mktemp("wiretap"))
+    prev_dir = os.environ.get("RAY_TPU_WIRETAP_DIR")
+    os.environ["RAY_TPU_WIRETAP_DIR"] = dump_dir
+    wiretap.configure(True)
+    try:
+        yield
+        wiretap.reset()  # close our journal handle before replaying
+        violations = wiretap.collect_violations(dump_dir)
+        if violations:
+            pytest.fail(
+                f"wiretap: {len(violations)} wire-protocol "
+                f"violation(s) recorded during this test:\n"
+                + wiretap.format_report(violations))
+    finally:
+        wiretap.configure(prev)
+        if prev_dir is None:
+            os.environ.pop("RAY_TPU_WIRETAP_DIR", None)
+        else:
+            os.environ["RAY_TPU_WIRETAP_DIR"] = prev_dir
+
+
 @pytest.fixture(scope="module")
 def ray_start_shared():
     """Module-shared cluster (reference: ray_start_regular_shared)."""

@@ -40,7 +40,7 @@ def _run(root, passes=None):
 # the live tree
 # ---------------------------------------------------------------------------
 def test_live_tree_zero_unbaselined_violations():
-    """All seven passes over the real package: nothing beyond the
+    """All nine passes over the real package: nothing beyond the
     checked-in baseline (the ratchet contract — any NEW violation
     fails tier-1 right here)."""
     rc = cli.main(["-q"])
@@ -676,10 +676,10 @@ def test_cli_format_github(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
-# budget: the full seven-pass live-tree run must stay interactive
+# budget: the full nine-pass live-tree run must stay interactive
 # ---------------------------------------------------------------------------
 def test_full_tree_wall_clock():
-    """The whole suite (parse once + seven passes) gates tier-1 and the
+    """The whole suite (parse once + nine passes) gates tier-1 and the
     pre-push loop: pin it under 5s so it never becomes a tax anyone is
     tempted to skip."""
     root = os.path.join(REPO, "ray_tpu")
@@ -772,6 +772,25 @@ def test_cli_module_entry_point_exits_nonzero(tmp_path):
         assert proc.returncode == want, proc.stdout + proc.stderr
 
 
+
+# A miniature protocol.py for the protocol-order / payload-schema
+# fixtures: real plane headers, real constant names (so the registry
+# and the model line up), fixture wire values.
+_PO_PROTO = '''\
+# Message types: driver -> worker
+EXEC_TASK = "exec_task"
+
+# Message types: worker -> driver
+METRICS_PUSH = "metrics_push"
+TASK_DONE = "task_done"
+WORKER_BLOCKED = "wkr_blocked"
+GET_LOCATIONS = "get_locations"
+
+# Message types: worker <-> worker (the direct call plane)
+ACTOR_CALL = "actor_call"
+'''
+
+
 _VIOLATION_FIXTURES = {
     "protocol-coverage": {
         "_private/protocol.py": _PROTO,
@@ -841,6 +860,26 @@ _VIOLATION_FIXTURES = {
         "_private/worker_proc.py": _BARRIER_WP.replace(
             "            self.direct.flush_accounting()\n", ""),
     },
+    "protocol-order": {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class Mux:
+                def rogue(self):
+                    self.writer.send_message(P.EXEC_TASK, {"spec": 1})
+        """,
+    },
+    "payload-schema": {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class W:
+                def blocked(self):
+                    self.w.send(P.WORKER_BLOCKED, {"extra": 1})
+        """,
+    },
 }
 
 
@@ -888,3 +927,210 @@ def test_update_baseline_refuses_narrowed_scope(tmp_path):
     assert cli.main(["--root", root, "--update-baseline",
                      "--baseline", bl]) == 0
     assert os.path.exists(bl)
+
+
+# ---------------------------------------------------------------------------
+# protocol-order: seeded-violation fixtures (the live tree's
+# cleanliness is covered by test_live_tree_zero_unbaselined_violations)
+# ---------------------------------------------------------------------------
+def _po_keys(root):
+    return {v.key for v in _run(root, ["protocol-order"])}
+
+
+def test_protocol_order_unregistered_send(tmp_path):
+    """A send site in a function with no PROTOCOL_SEND_FUNCS entry
+    dodges the ordering contract — flagged by name."""
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class Mux:
+                def rogue(self):
+                    self.writer.send_message(P.EXEC_TASK, {"spec": 1})
+        """,
+    })
+    assert "unregistered-send:EXEC_TASK" in _po_keys(root)
+
+
+def test_protocol_order_out_of_order_send(tmp_path):
+    """EXEC_TASK is a head->worker frame; WorkerClient.incref is
+    registered as a worker-role OPEN-state sender, so shipping it from
+    there is a wrong-role/out-of-order frame."""
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class WorkerClient:
+                def incref(self):
+                    self.w.send(P.EXEC_TASK, {"spec": 1})
+        """,
+    })
+    keys = _po_keys(root)
+    assert "illegal-send:EXEC_TASK" in keys
+    assert "unregistered-send:EXEC_TASK" not in keys
+
+
+def test_protocol_order_request_without_response_path(tmp_path):
+    """A constant shipped through a request wrapper but absent from
+    protocol_model.REQUESTS has no verified response path."""
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class WorkerClient:
+                def incref(self):
+                    return self.w.request(P.METRICS_PUSH, {
+                        "worker_id": 1, "node_id": 2,
+                        "groups": (), "ts": 0.0})
+        """,
+    })
+    keys = _po_keys(root)
+    assert "no-response-path:METRICS_PUSH" in keys
+    assert "illegal-send:METRICS_PUSH" not in keys  # legal worker send
+
+
+def test_protocol_order_send_after_close(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class WorkerClient:
+                def incref(self):
+                    self.conn.close()
+                    self.conn.send(P.TASK_DONE, {})
+        """,
+    })
+    assert "send-after-teardown:TASK_DONE" in _po_keys(root)
+
+
+def test_protocol_order_annotation_suppresses_and_rots(tmp_path):
+    """The escape hatch silences exactly the annotated send; an
+    annotation suppressing nothing is itself flagged (rot)."""
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class WorkerClient:
+                def incref(self):
+                    self.w.send(P.EXEC_TASK, {"spec": 1})  # lint: protocol-order-ok fixture wrong-role
+        """,
+    })
+    keys = _po_keys(root)
+    assert "illegal-send:EXEC_TASK" not in keys
+    assert "stale-annotation" not in keys
+    root2 = _tree(tmp_path / "rot", {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class WorkerClient:
+                def incref(self):
+                    self.w.send(P.TASK_DONE, {})  # lint: protocol-order-ok nothing wrong here
+        """,
+    })
+    assert "stale-annotation" in _po_keys(root2)
+
+
+# ---------------------------------------------------------------------------
+# payload-schema: seeded-violation fixtures
+# ---------------------------------------------------------------------------
+def _ps_keys(root):
+    return {v.key for v in _run(root, ["payload-schema"])}
+
+
+def test_payload_schema_undeclared_and_missing_keys(tmp_path):
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/worker_proc.py": """\
+            from . import protocol as P
+
+            class W:
+                def blocked(self):
+                    self.w.send(P.WORKER_BLOCKED, {"extra": 1})
+
+                def locate(self):
+                    return self.w.request(P.GET_LOCATIONS,
+                                          {"timeout": 1.0})
+        """,
+    })
+    keys = _ps_keys(root)
+    assert "undeclared-key:WORKER_BLOCKED:extra" in keys
+    assert "missing-key:GET_LOCATIONS:object_ids" in keys
+
+
+def test_payload_schema_arity_drift_and_phantom_field(tmp_path):
+    """Producer side: ACTOR_CALL's compact tuple is declared 11 slots —
+    shipping 3 breaks every peer's unpack. Consumer side: a registered
+    consumer (DirectPlane._wire_spec) reading a key no variant declares
+    is a phantom field."""
+    root = _tree(tmp_path, {
+        "_private/protocol.py": _PO_PROTO,
+        "_private/direct.py": """\
+            from . import protocol as P
+
+            class DirectPlane:
+                def _send_call(self, chan):
+                    payload = {"c": (1, 2, 3)}
+                    chan.writer.send_message(P.ACTOR_CALL, payload)
+
+                def _wire_spec(self, payload):
+                    return payload["bogus"]
+        """,
+    })
+    keys = _ps_keys(root)
+    assert "arity-drift:ACTOR_CALL:c" in keys
+    assert "phantom-field:ACTOR_CALL:bogus" in keys
+
+
+# ---------------------------------------------------------------------------
+# --since: the incremental CI gate
+# ---------------------------------------------------------------------------
+def _git(root, *a):
+    subprocess.run(["git", "-C", root, "-c", "user.email=t@t",
+                    "-c", "user.name=t"] + list(a),
+                   check=True, capture_output=True)
+
+
+def test_cli_since_narrows_reporting(tmp_path, capsys):
+    root = _tree(tmp_path, {
+        "_private/x.py": """\
+            def f():
+                try:
+                    pass
+                except Exception:
+                    pass
+        """,
+    })
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-q", "-m", "seed")
+    # Committed violations are out of an incremental gate's scope.
+    assert cli.main(["--root", root, "--since", "HEAD"]) == 0
+    capsys.readouterr()
+    # A new (untracked) violating file IS in scope — and is the only
+    # thing reported.
+    (tmp_path / "_private" / "y.py").write_text(
+        "def g():\n    try:\n        pass\n    except Exception:\n"
+        "        pass\n")
+    assert cli.main(["--root", root, "--since", "HEAD"]) == 1
+    out = capsys.readouterr().out
+    assert "y.py" in out
+    assert "x.py" not in out
+    # Unknown revs are an explicit usage error, not a silent full run.
+    assert cli.main(["--root", root, "--since", "no-such-rev-xyz"]) == 2
+
+
+def test_cli_since_refuses_update_baseline(tmp_path):
+    """The ratchet must be rewritten from a full run, never from a
+    changed-files slice."""
+    root = _tree(tmp_path, {"_private/x.py": "def f():\n    pass\n"})
+    _git(root, "init", "-q")
+    bl = str(tmp_path / "bl.json")
+    assert cli.main(["--root", root, "--update-baseline",
+                     "--baseline", bl, "--since", "HEAD"]) == 2
+    assert not os.path.exists(bl)

@@ -1,0 +1,225 @@
+"""Wire-protocol conformance tap (_private/wiretap.py).
+
+Unit half: per-connection SessionDFA interpreters fed synthetic frames
+— legal sequences come out clean, injected out-of-order frames are
+flagged with both endpoints' recent-frame context, and a
+SIGKILL-truncated journal is tolerated by the checker. Dynamic half:
+a small cluster under RAY_TPU_WIRETAP=1 journals zero violations (the
+protocol-heavy suites run under the conftest guard; this is the
+in-file smoke), and the disabled path does ZERO instrumentation work,
+proven by the ops counter (the lockdep/refdebug perf_smoke pattern).
+"""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol as P
+from ray_tpu._private import wiretap
+
+# An 11-slot compact ACTOR_CALL tuple (slot 0 is the task id — the
+# pairing/stream key the extractor pulls).
+_CALL = lambda tid: {"c": (tid,) + (None,) * 10}  # noqa: E731
+
+
+@pytest.fixture
+def tap():
+    """The tap enabled in-process only: no env propagation, no journal
+    dir — violations land in the in-memory list."""
+    prev = wiretap.enabled
+    prev_dir = os.environ.pop("RAY_TPU_WIRETAP_DIR", None)
+    wiretap.reset()
+    wiretap.configure(True, propagate_env=False)
+    yield wiretap
+    wiretap.reset()
+    wiretap.configure(prev, propagate_env=False)
+    if prev_dir is not None:
+        os.environ["RAY_TPU_WIRETAP_DIR"] = prev_dir
+
+
+# ---------------------------------------------------------------------------
+# DFA unit tests (synthetic frames, no cluster)
+# ---------------------------------------------------------------------------
+def test_legal_direct_sequence_is_clean(tap):
+    """call -> result (and a staged serve body freed after use) is the
+    contract; the tap must not cry wolf on it."""
+    tap.frame("direct", "caller", "c1", "send", P.ACTOR_CALL,
+              _CALL(b"t1"))
+    tap.frame("direct", "caller", "c1", "recv", P.ACTOR_RESULT,
+              {"t": b"t1"})
+    tap.frame("direct", "caller", "c1", "send", P.SERVE_REQ,
+              {"r": b"r1", "b": ("o", b"oid1")})
+    tap.frame("direct", "caller", "c1", "recv", P.SERVE_RESP,
+              {"r": b"r1", "v": ("i", b"inline")})
+    tap.frame("direct", "caller", "c1", "recv", P.SERVE_BODY_FREE,
+              {"o": b"oid1"})
+    assert tap.violations() == []
+
+
+def test_out_of_order_result_flagged_with_context(tap):
+    """An ACTOR_RESULT for a task never called is a
+    response-without-request; the violation record carries the
+    connection's recent-frame ring so a report shows what this
+    endpoint sent AND what the peer did."""
+    tap.frame("direct", "caller", "c1", "send", P.ACTOR_CALL,
+              _CALL(b"t1"))
+    tap.frame("direct", "caller", "c1", "recv", P.ACTOR_RESULT,
+              {"t": b"t1"})
+    tap.frame("direct", "caller", "c1", "recv", P.ACTOR_RESULT,
+              {"t": b"t-never-called"})
+    vs = tap.violations()
+    assert [v["kind"] for v in vs] == ["response-without-request"]
+    v = vs[0]
+    assert v["const"] == "ACTOR_RESULT" and v["dir"] == "recv"
+    assert v["session"] == "direct" and v["role"] == "caller"
+    # Both endpoints' context: our send, the peer's legal reply.
+    assert ("send", "ACTOR_CALL") in v["recent"]
+    assert ("recv", "ACTOR_RESULT") in v["recent"]
+
+
+def test_reply_for_unknown_rid_flagged(tap):
+    """The worker pipe's rid-keyed request wrapper: a REPLY whose
+    req_id was never registered via request_sent() is a response
+    without a request."""
+    tap.request_sent(P.GET_LOCATIONS, 7)
+    tap.frame("worker", "worker", "head", "recv", P.REPLY,
+              {"req_id": 7, "result": None})
+    assert tap.violations() == []
+    tap.frame("worker", "worker", "head", "recv", P.REPLY,
+              {"req_id": 8, "result": None})
+    kinds = [v["kind"] for v in tap.violations()]
+    assert kinds == ["response-without-request"]
+
+
+def test_stream_item_and_gap_rules(tap):
+    tap.frame("direct", "caller", "c1", "send", P.ACTOR_CALL,
+              _CALL(b"g1"))
+    tap.frame("direct", "caller", "c1", "recv", P.GEN_ITEM,
+              {"t": b"g1", "i": 0})
+    # Index 2 after 0: a dropped frame, not reordering tolerance.
+    tap.frame("direct", "caller", "c1", "recv", P.GEN_ITEM,
+              {"t": b"g1", "i": 2})
+    # An item for a stream never opened.
+    tap.frame("direct", "caller", "c1", "recv", P.GEN_ITEM,
+              {"t": b"g-unknown", "i": 0})
+    kinds = [v["kind"] for v in tap.violations()]
+    assert kinds == ["stream-gap", "stream-item-without-call"]
+
+
+def test_frame_after_teardown_flagged(tap):
+    tap.frame("worker", "head", "h1", "send", P.SHUTDOWN, {})
+    tap.frame("worker", "head", "h1", "send", P.EXEC_TASK,
+              {"spec": None})
+    kinds = [v["kind"] for v in tap.violations()]
+    assert "frame-after-teardown" in kinds
+
+
+def test_wrong_plane_frame_flagged(tap):
+    """A worker-pipe constant on a daemon connection is a mux bug."""
+    tap.frame("daemon", "daemon", "d1", "send", P.REGISTER_NODE, {})
+    tap.frame("daemon", "daemon", "d1", "recv", P.NODE_ACK, {})
+    tap.frame("daemon", "daemon", "d1", "recv", P.EXEC_TASK,
+              {"spec": None})
+    kinds = [v["kind"] for v in tap.violations()]
+    assert kinds == ["wrong-plane"]
+
+
+def test_unmodeled_wire_value_ignored(tap):
+    """A msg_type outside the model must be skipped (coverage's
+    problem), never crash the hook or spam violations."""
+    tap.frame("worker", "head", "h1", "recv", "no-such-wire-value",
+              {"x": 1})
+    assert tap.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# journal: SIGKILL-safe writes, torn-tail tolerance, report rendering
+# ---------------------------------------------------------------------------
+def test_journal_written_and_torn_tail_tolerated(tap, tmp_path):
+    os.environ["RAY_TPU_WIRETAP_DIR"] = str(tmp_path)
+    try:
+        tap.frame("direct", "caller", "c1", "send", P.ACTOR_CALL,
+                  _CALL(b"t1"))
+        tap.frame("direct", "caller", "c1", "recv", P.ACTOR_RESULT,
+                  {"t": b"orphan"})
+    finally:
+        os.environ.pop("RAY_TPU_WIRETAP_DIR", None)
+    tap.reset()  # close the journal handle before reading it back
+    vs = tap.collect_violations(str(tmp_path))
+    assert len(vs) == 1 and vs[0]["kind"] == "response-without-request"
+    assert vs[0]["pid"] == os.getpid()
+    # A process SIGKILLed mid-write leaves a torn final line; the
+    # checker keeps everything before it.
+    torn = tmp_path / "wiretap-journal-99999.jsonl"
+    torn.write_text(json.dumps({"kind": "stream-gap", "const":
+                                "GEN_ITEM", "recent": []}) + "\n"
+                    + '{"kind": "frame-after-tear')
+    vs = tap.collect_violations(str(tmp_path))
+    assert sorted(v["kind"] for v in vs) == ["response-without-request",
+                                             "stream-gap"]
+    report = tap.format_report(vs)
+    assert "PROTOCOL VIOLATION [response-without-request]" in report
+    assert "send:ACTOR_CALL" in report  # the ring renders dir:const
+
+
+# ---------------------------------------------------------------------------
+# zero-work guard + end-to-end smoke
+# ---------------------------------------------------------------------------
+@pytest.mark.perf_smoke
+def test_wiretap_off_does_zero_work(shutdown_only):
+    """Disabled means ZERO instrumentation work — not 'cheap', zero:
+    every record path bumps the ops counter, so a whole init/call/
+    shutdown cycle with the tap off must leave it untouched."""
+    prev = wiretap.enabled
+    wiretap.configure(False)
+    try:
+        base = wiretap.instrument_ops()
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(1)) == 2
+        ray_tpu.shutdown()
+        assert wiretap.instrument_ops() == base
+    finally:
+        wiretap.configure(prev)
+
+
+def test_cluster_smoke_under_tap(shutdown_only, tmp_path):
+    """A real init/actor-call/shutdown cycle under RAY_TPU_WIRETAP=1
+    journals zero violations (the protocol-heavy suites run under the
+    conftest guard; this is the standalone smoke ci_fast.sh runs)."""
+    prev = wiretap.enabled
+    prev_dir = os.environ.get("RAY_TPU_WIRETAP_DIR")
+    wiretap.reset()
+    os.environ["RAY_TPU_WIRETAP_DIR"] = str(tmp_path)
+    wiretap.configure(True)
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get([c.incr.remote() for _ in range(3)]) \
+            == [1, 2, 3]
+        ray_tpu.shutdown()
+        wiretap.reset()
+        vs = wiretap.collect_violations(str(tmp_path))
+        assert vs == [], wiretap.format_report(vs)
+    finally:
+        wiretap.configure(prev)
+        if prev_dir is None:
+            os.environ.pop("RAY_TPU_WIRETAP_DIR", None)
+        else:
+            os.environ["RAY_TPU_WIRETAP_DIR"] = prev_dir
