@@ -645,6 +645,79 @@ def bench_broadcast(extras):
                 pass
 
 
+def bench_pull(extras):
+    """Worker-to-worker object pulls through real daemon nodes: the
+    direct transfer plane (PULL_DIRECT chunk streams over brokered
+    channels) vs the daemon-relayed path, measured consumer-side on
+    the same cluster in the same run (reference: object manager
+    Push/Pull chunked transfers, object_manager.cc)."""
+    try:
+        import ray_tpu
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+        cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
+        cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
+
+        @ray_tpu.remote(resources={"A": 1})
+        class Producer:
+            def make(self, nbytes, i):
+                import numpy as np
+                return np.full(nbytes, i % 251, dtype=np.uint8)
+
+            def ping(self):
+                return True
+
+        @ray_tpu.remote(resources={"B": 1})
+        class Consumer:
+            def set_direct(self, on):
+                from ray_tpu._private.config import ray_config
+                ray_config.set("direct_object_transfer_enabled",
+                               bool(on))
+                return True
+
+            def pull(self, producer, n_objs, nbytes):
+                # Production excluded from the clock: the actor runs
+                # its makes serially, so the ping barrier means every
+                # object is sealed before timing starts.
+                refs = [producer.make.remote(nbytes, i)
+                        for i in range(n_objs)]
+                ray_tpu.get(producer.ping.remote())
+                t0 = time.perf_counter()
+                total = 0
+                for r in refs:
+                    total += ray_tpu.get(r).nbytes
+                return total / (time.perf_counter() - t0) / 1e9
+
+        prod = Producer.remote()
+        cons = Consumer.remote()
+        # Warm: brokers the direct channel + faults in both stores.
+        ray_tpu.get(cons.pull.remote(prod, 1, 1 << 20))
+
+        size, n_objs = 64 << 20, 4
+        direct = max(ray_tpu.get(cons.pull.remote(prod, n_objs, size))
+                     for _ in range(3))
+        ray_tpu.get(cons.set_direct.remote(False))
+        daemon_path = max(
+            ray_tpu.get(cons.pull.remote(prod, n_objs, size))
+            for _ in range(3))
+        ray_tpu.get(cons.set_direct.remote(True))
+        extras["pull_gb_per_s"] = round(direct, 2)
+        extras["pull_gb_per_s_daemon_path"] = round(daemon_path, 2)
+        cluster.shutdown()
+    except Exception as e:
+        extras["pull_bench_error"] = f"{type(e).__name__}: {e}"
+        try:
+            cluster.shutdown()
+        except Exception:
+            try:
+                import ray_tpu
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+
 def bench_resnet(extras):
     """ResNet-50 batch inference through Data map_batches actor pools
     (BASELINE config #3). Runs BEFORE the driver touches the TPU so the
@@ -1029,6 +1102,97 @@ def _focus_put_get(ray_tpu):
     return measure
 
 
+def _focus_put_gb(ray_tpu):
+    import numpy as np
+    big = np.zeros((1 << 28,), dtype=np.uint8)  # 256 MB
+    ref = ray_tpu.put(big)  # warm: fault in source pages, prime store
+    del ref
+
+    def measure():
+        iters = 4
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ref = ray_tpu.put(big)
+            del ref
+        return iters * big.nbytes / (time.perf_counter() - t0) / 1e9
+    return measure
+
+
+def _focus_mc_put_gb(ray_tpu):
+    """Concurrent store clients: 4 driver-side client threads, each
+    putting (and dropping) a 120 MB buffer in a loop against the
+    node-shared store — the contention row for the put write path
+    (segment recycling, lock hold times). Source pages are faulted in
+    before timing so the rounds measure the store, not the source."""
+    import numpy as np
+    import threading
+
+    data = np.zeros(120 << 20, dtype=np.uint8)
+    data[::4096] = 1
+
+    def client(iters):
+        for _ in range(iters):
+            ref = ray_tpu.put(data)
+            del ref
+
+    def round_(iters):
+        threads = [threading.Thread(target=client, args=(iters,))
+                   for _ in range(4)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return 4 * iters * data.nbytes / (time.perf_counter() - t0) / 1e9
+
+    round_(2)  # warm: pages faulted, store primed
+
+    def measure():
+        return round_(4)
+    return measure
+
+
+def _focus_pull_gb(ray_tpu):
+    """Consumer-observed cross-node pull bandwidth (the bench_pull
+    direct-plane row as a focus metric; on a tree without the transfer
+    plane the same scaffold measures the daemon-relayed path, so
+    `--ab pull_gb_per_s` is the plane's speedup)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()  # run_focus already init'd the head
+    cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
+    cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
+
+    @ray_tpu.remote(resources={"A": 1})
+    class Producer:
+        def make(self, nbytes, i):
+            import numpy as np
+            return np.full(nbytes, i % 251, dtype=np.uint8)
+
+        def ping(self):
+            return True
+
+    @ray_tpu.remote(resources={"B": 1})
+    class Consumer:
+        def pull(self, producer, n_objs, nbytes):
+            refs = [producer.make.remote(nbytes, i)
+                    for i in range(n_objs)]
+            ray_tpu.get(producer.ping.remote())
+            t0 = time.perf_counter()
+            total = 0
+            for r in refs:
+                total += ray_tpu.get(r).nbytes
+            return total / (time.perf_counter() - t0) / 1e9
+
+    prod = Producer.remote()
+    cons = Consumer.remote()
+    ray_tpu.get(cons.pull.remote(prod, 1, 1 << 20))  # warm channel
+
+    def measure():
+        return ray_tpu.get(cons.pull.remote(prod, 4, 64 << 20))
+    return measure
+
+
 def _focus_mc_tasks(ray_tpu):
     @ray_tpu.remote
     def nop():
@@ -1191,6 +1355,9 @@ def _focus_serve_http_multi(ray_tpu):
 FOCUS_METRICS = {
     "tasks_async_per_s": _focus_tasks_async,
     "put_get_per_s": _focus_put_get,
+    "put_gb_per_s": _focus_put_gb,
+    "multi_client_put_gb_per_s": _focus_mc_put_gb,
+    "pull_gb_per_s": _focus_pull_gb,
     "multi_client_tasks_async_per_s": _focus_mc_tasks,
     "nn_actor_calls_async_per_s": _focus_nn_actor,
     "streaming_gen_items_per_s": _focus_streaming_gen,
@@ -1286,6 +1453,7 @@ def main():
     sync_rate = bench_core(extras)
     bench_serve(extras)
     bench_broadcast(extras)
+    bench_pull(extras)
     # The resnet PIPELINE bench must precede the driver's own jax TPU
     # init (its pool actor owns the chip), but it is also the most
     # expensive section — budget-gated inside. The GPT/MFU numbers in

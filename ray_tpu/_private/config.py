@@ -196,6 +196,39 @@ class RayConfig:
         # reads) instead of being pickled into the channel frame.
         # 0 disables the arena body path (always inline).
         "serve_direct_body_threshold": 64 * 1024,
+        # -- direct object transfer plane (reference: the object
+        # manager's worker-to-worker pulls, object_manager/object_
+        # manager.cc Push/Pull — chunked transfers between the owners'
+        # processes, never through a central broker). Falsy => every
+        # remote-object read takes the daemon-relayed PULL_OBJECT path
+        # unchanged and the transfer client does zero work
+        # (counter-guarded in ci_fast).
+        "direct_object_transfer_enabled": True,
+        # One OBJ_CHUNK frame's payload size. Chunks ride the channel
+        # as pickle-5 out-of-band buffers (separate iovecs, no payload
+        # pickling); sized to amortize framing without head-of-line
+        # blocking actor results behind a multi-second write.
+        "direct_transfer_chunk_mb": 8.0,
+        # Objects at or below this many bytes skip the channel plane:
+        # the daemon round trip is already ~free for small objects and
+        # the inline-location path never reaches a pull at all.
+        "direct_transfer_min_bytes": 0,
+        # Per-worker cap on concurrently SERVED direct pulls; excess
+        # requests are refused with a typed busy marker and the caller
+        # falls back to the daemon path (admission control so bulk
+        # pulls cannot starve the executor serving actor calls).
+        "direct_transfer_max_serving": 4,
+        # -- file-store segment recycling (the file-per-object store's
+        # answer to the arena's pre-faulted pages: freed segments are
+        # renamed into a pool and re-claimed by size-compatible
+        # reserves, so hot put loops reuse already-faulted tmpfs pages
+        # instead of paying kernel page allocation per put). Pooled
+        # bytes stay accounted and are reclaimed before any spill.
+        # 0 disables pooling (every free unlinks immediately).
+        "store_segment_pool_mb": 512.0,
+        # Only segments at least this large are pooled; tiny files
+        # gain nothing from page reuse and would churn the pool.
+        "store_segment_pool_min_bytes": 1 << 20,
         # Proxy-side admission control: when EVERY replica of a
         # deployment has at least this many proxy-tracked in-flight
         # requests, new requests shed with 503 instead of queueing
@@ -209,6 +242,11 @@ class RayConfig:
         # Top-k randomization among equally-good spread candidates, as a
         # fraction of alive nodes (reference: kSchedulerTopKFraction).
         "scheduler_top_k_fraction": 0.2,
+        # A node whose workers report this many concurrent direct object
+        # transfers (summed transfer_inflight gauges) loses its hybrid
+        # tiebreak: its link is saturated and co-scheduling data-hungry
+        # work onto it serializes both transfers.
+        "scheduler_transfer_busy_threshold": 4,
         # Infeasible tasks fail fast by default; an active autoscaler
         # raises this so demand can park while capacity is launched
         # (reference: infeasible queue + autoscaler demand satisfaction).

@@ -593,13 +593,13 @@ class NodeDaemon:
                     reclaimed = escalated_spill(
                         self.store,
                         payload.get("kwargs", {}).get("need", 0))
-                except Exception:
+                except Exception:  # lint: broad-except-ok best-effort escalated spill: 0 reclaimed tells the requesting worker to fail its own reserve with the real ObjectStoreFullError
                     reclaimed = 0
                 try:
                     handle.send(P.REPLY,
                                 {"req_id": payload.get("req_id"),
                                  "result": reclaimed})
-                except Exception:
+                except Exception:  # lint: broad-except-ok dying worker pipe: the spill reply has nowhere to go and WORKER_DIED owns the cleanup
                     pass
             self._exec.submit(_spill)
             return
@@ -627,7 +627,7 @@ class NodeDaemon:
             self._send(P.FROM_WORKER, {
                 "worker": handle.worker_id.binary(),
                 "frame": P.dump_message(msg_type, payload)})
-        except Exception:
+        except Exception:  # lint: broad-except-ok head link down mid-relay: the reconnect loop owns recovery and the worker's own request timeout surfaces the lost frame
             pass
 
     def _tag_done(self, done: dict) -> dict:
@@ -675,7 +675,7 @@ class NodeDaemon:
             result = {"__error__": e}
         try:
             handle.send(P.REPLY, {"req_id": req_id, "result": result})
-        except Exception:
+        except Exception:  # lint: broad-except-ok dying worker pipe: the pull reply has nowhere to go and WORKER_DIED owns the cleanup
             pass
 
     def localize(self, object_id, source_node_hex: str):

@@ -82,6 +82,18 @@ def _bump() -> None:
     _ops += 1
 
 
+# Separate counter for the object-transfer fast path: pull_object bumps
+# it past every disable gate, so the flag-off perf_smoke guard can
+# window it without catching unrelated plane traffic (ref-delta
+# batches, DIRECT_DONE receipts) that stays live while pulls are off.
+_pull_ops = 0
+
+
+def pull_ops() -> int:
+    """Direct pull-plane operations so far (perf_smoke guard)."""
+    return _pull_ops
+
+
 class _Fallback:
     """This (caller, actor) pair is pinned to the head path. Permanent
     pins (actor dead, plane disabled, redial budget exhausted) never
@@ -139,13 +151,18 @@ class _DirectChannel:
 
     __slots__ = ("plane", "actor_id", "conn", "writer", "alive",
                  "inflight", "queue", "pump_running", "_recv_thread",
-                 "callee_wid", "seq_st")
+                 "callee_wid", "seq_st", "node_hex")
 
     def __init__(self, plane: "DirectPlane", actor_id, conn,
-                 callee_wid: Optional[str] = None):
+                 callee_wid: Optional[str] = None,
+                 node_hex: Optional[str] = None):
         self.plane = plane
         self.actor_id = actor_id
         self.conn = conn
+        # Node identity of the callee (brokered with the listener
+        # address): the object-transfer plane routes node-scoped pulls
+        # over any live channel to a worker on the owning node.
+        self.node_hex = node_hex
         # The (caller, actor) sequencing state, cached so the per-call
         # stamp/settle fast paths skip the registry lookup.
         with plane._cond:
@@ -328,6 +345,22 @@ class DirectPlane:
         # Callee listener state (created lazily on CHANNEL_OPEN).
         self._listener_info: Optional[dict] = None
         self._listeners: List = []
+        # -- direct object transfer plane (PULL_DIRECT / OBJ_CHUNK /
+        # OBJ_EOF). Pull client state rides its OWN small lock, never
+        # _cond: the chunk handler memcpys megabytes per frame on the
+        # channel recv thread and must not hold THE plane lock while
+        # it does. rid (int) -> pull state dict.
+        self._pull_lock = lockdep.lock("direct.pulls")
+        self._pulls: Dict[int, dict] = {}
+        self._pull_seq = 0
+        # Callee-side admission: concurrently served pulls (guarded by
+        # _pull_lock); excess requests refuse typed and the caller
+        # falls back to the daemon path.
+        self._serving_pulls = 0
+        # Lazy transfer thread pool — bulk pulls never queue behind a
+        # long-running actor method on the actor executor (or vice
+        # versa).
+        self._xfer_exec = None
 
     # ------------------------------------------------------------------
     # refcounting: local-table interception + per-burst delta coalescing
@@ -919,7 +952,8 @@ class DirectPlane:
         else:
             raise RuntimeError("broker reply carries no dialable address")
         return _DirectChannel(self, actor_id, conn,
-                              callee_wid=rep.get("callee_worker"))
+                              callee_wid=rep.get("callee_worker"),
+                              node_hex=rep.get("callee_node"))
 
     @staticmethod
     def _dial(address, family: str, key: bytes, timeout: float):
@@ -1279,6 +1313,12 @@ class DirectPlane:
             self._on_serve_req(chan, payload)
         elif msg_type == P.SERVE_BODY_FREE:
             self._on_serve_body_free(payload)
+        elif msg_type == P.OBJ_CHUNK:
+            self._on_obj_chunk(chan, payload)
+        elif msg_type == P.OBJ_EOF:
+            self._on_obj_eof(chan, payload)
+        elif msg_type == P.PULL_DIRECT:
+            self._on_pull_direct(chan, payload)
         elif msg_type == P.GEN_CANCEL:
             # Caller dropped its channel-stream generator mid-iteration:
             # stop the producing generator here (the head-routed path
@@ -1655,6 +1695,16 @@ class DirectPlane:
                 except Exception:
                     fut.set_result(None)
         chan.close()
+        # Outstanding object pulls riding this channel fail NOW (typed
+        # "channel_down" -> daemon-path fallback) instead of waiting out
+        # the full pull deadline on a dead socket.
+        with self._pull_lock:
+            dead_pulls = [st for st in self._pulls.values()
+                          if st.get("chan") is chan]
+        for st in dead_pulls:
+            if st["err"] is None:
+                st["err"] = "channel_down"
+            st["evt"].set()
         if telemetry.enabled:
             telemetry.record_direct_fallback("channel_down")
         for cb in stream_cbs:
@@ -2005,6 +2055,275 @@ class DirectPlane:
             self._worker.store.free(ObjectID(payload["o"]))
         except Exception:  # lint: broad-except-ok double-free after teardown is harmless
             pass
+
+    # ------------------------------------------------------------------
+    # direct object transfer plane: worker<->worker pulls over the
+    # brokered channels (reference: the object manager's Push/Pull
+    # chunked transfers between the owning processes,
+    # object_manager/object_manager.cc — never through a central
+    # broker). A PULL_DIRECT on the (caller, owner-node worker) channel
+    # is answered by ranged OBJ_CHUNK frames whose payload bytes ride
+    # as pickle-5 OUT-OF-BAND views of the sealed store segment
+    # (separate iovecs of the writer's vectored write — no pickling of
+    # payload bytes, no intermediate buffer), terminated by OBJ_EOF.
+    # Ownership-free: a pull replicates sealed bytes, no refcounts
+    # move. EVERY failure path returns the caller to the daemon-relayed
+    # PULL_OBJECT route unchanged.
+    # ------------------------------------------------------------------
+    def _channel_to_node(self, node_hex: str):
+        """Any live channel to a worker on `node_hex`: object locations
+        are node-scoped (every worker maps the node-shared store), so
+        any direct peer on the owning node can serve the bytes."""
+        with self._cond:
+            for chan in self._chans.values():
+                if isinstance(chan, _DirectChannel) and chan.alive \
+                        and chan.node_hex == node_hex:
+                    return chan
+        return None
+
+    def pull_object(self, object_id, node_hex: str,
+                    size_hint: int = 0) -> bool:
+        """Pull one remote object worker-to-worker over an already-
+        brokered direct channel (the object-transfer fast path). True
+        => the object arrived sealed in the local store. ANY failure —
+        no channel to the owning node, channel death mid-transfer,
+        gapped chunks, owner-side miss, deadline — returns False and
+        the caller takes the daemon PULL_OBJECT path unchanged. With
+        direct_object_transfer_enabled off this returns before ANY
+        work, counter-proven by the flag-off perf_smoke guard."""
+        from .config import ray_config
+        if not self.enabled or not bool(
+                ray_config.direct_object_transfer_enabled):
+            return False
+        if size_hint and size_hint < int(
+                ray_config.direct_transfer_min_bytes):
+            return False
+        chan = self._channel_to_node(node_hex)
+        if chan is None:
+            return False
+        _bump()
+        global _pull_ops
+        _pull_ops += 1
+        st = {"evt": threading.Event(), "oid": object_id, "chan": chan,
+              "view": None, "next": 0, "got": 0, "total": None,
+              "err": None, "ok": False}
+        with self._pull_lock:
+            self._pull_seq += 1
+            rid = self._pull_seq
+            self._pulls[rid] = st
+        if telemetry.enabled:
+            telemetry.record_transfer_inflight(1)
+        try:
+            # Inside the try: an injected fault falls back to the
+            # daemon path like any real transfer failure would.
+            if fault.enabled:
+                fault.fire("direct.pull", obj=object_id.hex()[:8])
+            req = {"r": rid, "o": object_id.binary()}
+            if wiretap.enabled:
+                wiretap.frame("direct", "caller", id(chan), "send",
+                              P.PULL_DIRECT, req)
+            chan.writer.send_message(P.PULL_DIRECT, req)
+            deadline = float(ray_config.pull_deadline_s)
+            if not st["evt"].wait(deadline if deadline > 0 else None):
+                st["err"] = st["err"] or "deadline"
+        except Exception:
+            logger.debug("direct pull request failed", exc_info=True)
+            st["err"] = st["err"] or "send"
+        finally:
+            with self._pull_lock:
+                self._pulls.pop(rid, None)
+            if telemetry.enabled:
+                telemetry.record_transfer_inflight(-1)
+        ok = bool(st["ok"]) and st["err"] is None
+        if not ok:
+            self._abort_pull_state(st)
+            if telemetry.enabled:
+                telemetry.record_direct_fallback(
+                    f"pull:{st['err'] or 'error'}")
+            logger.debug("direct pull of %s from node %s failed (%s); "
+                         "falling back to the daemon path",
+                         object_id.hex()[:8], (node_hex or "?")[:8],
+                         st["err"])
+        elif telemetry.enabled and st["total"]:
+            telemetry.record_transfer_bytes(st["total"])
+        return ok
+
+    def _abort_pull_state(self, st: dict) -> None:
+        """Unwind a failed pull's partially written segment so the
+        daemon-path fallback starts from a clean store."""
+        if st.get("view") is None:
+            return
+        try:
+            st["view"].release()
+        except Exception:  # lint: broad-except-ok view already released by the failing writer path
+            pass
+        st["view"] = None
+        try:
+            self._worker.store.free(st["oid"])
+        except Exception:  # lint: broad-except-ok partial-segment cleanup; the daemon path re-creates the id
+            pass
+
+    def _on_obj_chunk(self, chan, payload: dict) -> None:
+        """One ranged chunk of an in-flight pull (channel recv thread):
+        copy the out-of-band payload view straight into the
+        preallocated store segment. Chunks must arrive gapless and
+        in order — the channel is FIFO, so a gap means protocol skew
+        and fails the pull typed."""
+        rid, idx, off, total, data = payload["c"]
+        with self._pull_lock:
+            st = self._pulls.get(rid)
+        if st is None or st["err"] is not None:
+            return  # abandoned pull (deadline/channel down): drop
+        try:
+            if idx != st["next"] or off != st["got"]:
+                raise RuntimeError(
+                    f"gapped chunk {idx}@{off} (expected "
+                    f"{st['next']}@{st['got']})")
+            if st["view"] is None:
+                if idx != 0:
+                    raise RuntimeError("stream started mid-object")
+                st["total"] = int(total)
+                st["view"] = self._worker.store.create(
+                    st["oid"], int(total))
+            n = data.nbytes if isinstance(data, memoryview) \
+                else len(data)
+            st["view"][off:off + n] = data
+            st["got"] += n
+            st["next"] = idx + 1
+        except Exception as e:  # lint: broad-except-ok any receive-side failure (store full, id collision, skew) fails the pull typed; the daemon path remains
+            logger.debug("direct pull chunk failed", exc_info=True)
+            st["err"] = repr(e)
+            st["evt"].set()
+
+    def _on_obj_eof(self, chan, payload: dict) -> None:
+        """Pull terminal frame: seal on a complete byte count, fail
+        typed otherwise (owner refusal, short stream)."""
+        with self._pull_lock:
+            st = self._pulls.get(payload.get("r"))
+        if st is None:
+            return
+        if payload.get("ok") and st["err"] is None \
+                and st["total"] is not None \
+                and st["got"] == st["total"]:
+            try:
+                if st["view"] is not None:
+                    st["view"].release()
+                    st["view"] = None
+                self._worker.store.seal(st["oid"])
+                st["ok"] = True
+            except Exception as e:  # lint: broad-except-ok seal failure downgrades to the daemon path, never raises on the recv thread
+                st["err"] = repr(e)
+        elif st["err"] is None:
+            st["err"] = payload.get("e") or "incomplete"
+        st["evt"].set()
+
+    # -- callee (serving) side ----------------------------------------
+    def _transfer_executor(self):
+        exec_ = self._xfer_exec
+        if exec_ is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from .config import ray_config
+            with self._pull_lock:
+                if self._xfer_exec is None:
+                    self._xfer_exec = ThreadPoolExecutor(
+                        max_workers=max(1, int(
+                            ray_config.direct_transfer_max_serving)),
+                        thread_name_prefix="direct-xfer")
+                exec_ = self._xfer_exec
+        return exec_
+
+    def _send_pull_eof(self, chan, rid, ok: bool,
+                       err: Optional[str] = None) -> None:
+        msg: Dict[str, Any] = {"r": rid, "ok": bool(ok)}
+        if err is not None:
+            msg["e"] = err
+        if wiretap.enabled:
+            wiretap.frame("direct", "callee", id(chan), "send",
+                          P.OBJ_EOF, msg)
+        try:
+            chan.writer.send_message(P.OBJ_EOF, msg)
+        except Exception:  # lint: broad-except-ok puller hung up: its channel EOF fails the pull client-side
+            pass
+
+    def _on_pull_direct(self, chan, payload: dict) -> None:
+        """One PULL_DIRECT landed on this worker: serve the bytes back
+        as ranged OBJ_CHUNK frames off the dedicated transfer pool.
+        Admission past direct_transfer_max_serving refuses typed (the
+        caller falls back to the daemon path) so bulk pulls cannot
+        starve each other or the channel."""
+        _bump()
+        from .config import ray_config
+        with self._pull_lock:
+            admitted = self._serving_pulls < max(
+                1, int(ray_config.direct_transfer_max_serving))
+            if admitted:
+                self._serving_pulls += 1
+        if not admitted:
+            self._send_pull_eof(chan, payload.get("r"), ok=False,
+                                err="busy")
+            return
+        try:
+            self._transfer_executor().submit(
+                self._pull_serve_exec, chan, payload)
+        except BaseException:
+            with self._pull_lock:
+                self._serving_pulls -= 1
+            self._send_pull_eof(chan, payload.get("r"), ok=False,
+                                err="submit")
+            raise
+
+    def _pull_serve_exec(self, chan, payload: dict) -> None:
+        """Transfer-pool runner for one PULL_DIRECT: ranged OBJ_CHUNK
+        frames whose payload bytes are out-of-band views of the sealed
+        segment mapping (or of its spill-file mapping — a cold object
+        streams straight from the spill file without re-admission).
+        The writer's byte-bounded backpressure is the flow control:
+        enqueueing blocks once 64 MB is in flight, so a slow puller
+        throttles the serve instead of ballooning this process."""
+        import pickle as _pickle
+
+        from .config import ray_config
+        from .ids import ObjectID
+        rid = payload.get("r")
+        w = self._worker
+        if telemetry.enabled:
+            telemetry.record_transfer_inflight(1)
+        try:
+            try:
+                view = w.store.get_raw(ObjectID(payload["o"]))
+            except Exception:  # lint: broad-except-ok any store miss (freed, foreign backend) refuses typed; the caller falls back to the daemon path
+                self._send_pull_eof(chan, rid, ok=False, err="miss")
+                return
+            total = view.nbytes
+            if total <= 0:
+                self._send_pull_eof(chan, rid, ok=False, err="empty")
+                return
+            chunk = max(1 << 16, int(float(
+                ray_config.direct_transfer_chunk_mb) * (1 << 20)))
+            off = 0
+            idx = 0
+            try:
+                while off < total:
+                    n = min(chunk, total - off)
+                    body = {"c": (rid, idx, off, total,
+                                  _pickle.PickleBuffer(
+                                      view[off:off + n]))}
+                    if wiretap.enabled:
+                        wiretap.frame("direct", "callee", id(chan),
+                                      "send", P.OBJ_CHUNK, body)
+                    chan.writer.send_message(P.OBJ_CHUNK, body)
+                    off += n
+                    idx += 1
+            except Exception:  # lint: broad-except-ok puller hung up mid-stream: its channel EOF fails the pull client-side; nothing to unwind here
+                logger.debug("direct pull serve aborted", exc_info=True)
+                return
+            self._send_pull_eof(chan, rid, ok=True)
+        finally:
+            with self._pull_lock:
+                self._serving_pulls -= 1
+            if telemetry.enabled:
+                telemetry.record_transfer_inflight(-1)
 
 
 # ---------------------------------------------------------------------------

@@ -97,7 +97,7 @@ SITES = (
     "worker.exec", "worker.start",
     "gcs.op", "store.pull", "store.spill",
     "collective.rendezvous",
-    "direct.connect", "direct.call",
+    "direct.connect", "direct.call", "direct.pull",
     "daemon.drain",
 )
 

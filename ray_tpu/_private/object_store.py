@@ -85,7 +85,7 @@ def _default_capacity() -> int:
 
 class _Segment:
     __slots__ = ("path", "mm", "size", "file_exists", "sealed",
-                 "counted", "last_access")
+                 "counted", "last_access", "spilling")
 
     def __init__(self, path: str, mm: mmap.mmap, size: int,
                  sealed: bool = False, counted: bool = True):
@@ -96,6 +96,7 @@ class _Segment:
         self.sealed = sealed          # writer done; safe to spill
         self.counted = counted        # participates in capacity accounting
         self.last_access = 0          # LRU clock tick for spill ordering
+        self.spilling = False         # staged remote-spill write in flight
 
 
 class ObjectStore:
@@ -130,6 +131,16 @@ class ObjectStore:
         # Refcounted (not a set): two concurrent free()s of one id must
         # keep the tombstone until BOTH unlocked deletes finish.
         self._freeing: Dict[ObjectID, int] = {}
+        # Segment pool: freed sealed segments are RENAMED here (size-
+        # encoded names) and re-claimed by _reserve, so hot put loops
+        # write into already-faulted tmpfs pages instead of paying
+        # kernel shmem page allocation per put (the arena backend gets
+        # the same effect from its slab recycler). The dir is shared by
+        # every process of the node; claims are atomic renames.
+        self._pool_dir = session_dir.rstrip("/") + "_pool"
+        self._pool_cache = []   # [(size, filename)] claimable candidates
+        self._pool_bytes = 0    # refreshed from the dir on rescans
+        self._pool_seq = 0
 
     # -- paths -------------------------------------------------------------
     def _path(self, object_id: ObjectID) -> str:
@@ -146,29 +157,162 @@ class ObjectStore:
     def capacity(self) -> int:
         return self._capacity
 
+    # -- segment pool ------------------------------------------------------
+    def _pool_limit(self) -> int:
+        return int(float(ray_config.store_segment_pool_mb) * (1 << 20))
+
+    def _pool_put_locked(self, seg: _Segment) -> bool:
+        """Move a freed segment's file into the pool instead of
+        unlinking it (caller holds _lock and has popped the segment).
+        False => the caller unlinks as before."""
+        if seg.size < int(ray_config.store_segment_pool_min_bytes):
+            return False
+        limit = self._pool_limit()
+        if limit <= 0 or self._pool_bytes + seg.size > limit:
+            return False
+        self._pool_seq += 1
+        name = f"{seg.size}-{os.getpid()}-{self._pool_seq}"
+        try:
+            os.makedirs(self._pool_dir, exist_ok=True)
+            os.rename(seg.path, os.path.join(self._pool_dir, name))
+        except OSError:
+            return False
+        self._pool_bytes += seg.size
+        self._pool_cache.append((seg.size, name))
+        return True
+
+    def _rescan_pool_locked(self) -> bool:
+        """Refresh the claimable-file cache from the shared pool dir —
+        a sibling process (the owner freeing this worker's returns) may
+        have pooled files this instance never saw."""
+        try:
+            names = os.listdir(self._pool_dir)
+        except OSError:
+            self._pool_cache = []
+            self._pool_bytes = 0
+            return False
+        cache = []
+        total = 0
+        for name in names:
+            try:
+                sz = int(name.split("-", 1)[0])
+            except ValueError:
+                continue
+            cache.append((sz, name))
+            total += sz
+        self._pool_cache = cache
+        self._pool_bytes = total
+        return bool(cache)
+
+    def _pool_claim_locked(self, size: int, dst_path: str):
+        """Claim a pooled file of at least `size` bytes by renaming it
+        onto the new object's path (atomic — a lost cross-process race
+        is ENOENT and the next candidate is tried). Returns an open fd
+        truncated to exactly `size`, or None for a fresh create."""
+        if self._pool_limit() <= 0 \
+                or size < int(ray_config.store_segment_pool_min_bytes):
+            return None
+        for attempt in (0, 1):
+            while True:
+                best = None
+                for ent in self._pool_cache:
+                    if ent[0] >= size and (best is None
+                                           or ent[0] < best[0]):
+                        best = ent
+                if best is None:
+                    break
+                self._pool_cache.remove(best)
+                self._pool_bytes -= best[0]
+                src = os.path.join(self._pool_dir, best[1])
+                try:
+                    os.rename(src, dst_path)
+                except OSError:
+                    continue  # lost the claim race; next candidate
+                try:
+                    fd = os.open(dst_path, os.O_RDWR)
+                    os.ftruncate(fd, size)
+                    return fd
+                except OSError:
+                    try:
+                        os.unlink(dst_path)
+                    except OSError:
+                        pass
+                    return None
+            if attempt == 0 and not self._rescan_pool_locked():
+                return None
+        return None
+
+    def _drain_pool_locked(self, need_bytes: int) -> int:
+        """Capacity pressure reclaims pooled bytes BEFORE touching live
+        objects — pool files are pure cache."""
+        self._rescan_pool_locked()
+        freed = 0
+        while self._pool_cache and freed < need_bytes:
+            sz, name = self._pool_cache.pop()
+            self._pool_bytes -= sz
+            try:
+                os.unlink(os.path.join(self._pool_dir, name))
+            except OSError:
+                continue
+            freed += sz
+        return freed
+
     # -- write path --------------------------------------------------------
     def _reserve(self, object_id: ObjectID, size: int) -> int:
-        """Capacity-check (evict graveyard, spill LRU), create the shm
-        file, and register an unsealed segment. Returns the open fd;
-        callers write then seal (or _abort_reserve on failure)."""
-        with self._lock:
-            if self._used + size > self._capacity:
-                self._collect_graveyard()
+        """Capacity-check (drain pool, evict graveyard, spill LRU),
+        create or pool-claim the shm file, and register an unsealed
+        segment. Returns the open fd; callers write then seal (or
+        _abort_reserve on failure). Remote spills needed to make room
+        are staged OUTSIDE the lock — a multi-second object-storage
+        write must not freeze every concurrent store op — and their
+        bookkeeping CASes back in before the capacity re-check."""
+        staged = None
+        orphans: list = []
+        while True:
+            fd = None
+            with self._lock:
+                if staged is not None:
+                    self._commit_staged_spill_locked(staged, orphans)
+                    staged = None
+                if self._used + self._pool_bytes + size > self._capacity:
+                    self._drain_pool_locked(
+                        self._used + self._pool_bytes + size
+                        - self._capacity)
                 if self._used + size > self._capacity:
-                    self._spill_locked(self._used + size - self._capacity)
-                if self._used + size > self._capacity:
-                    raise ObjectStoreFullError(
-                        f"Object of {size} bytes does not fit: "
-                        f"{self._used}/{self._capacity} bytes used "
-                        f"({self._spilled_bytes} spilled)."
-                    )
-            path = self._path(object_id)
-            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
-            # mm attaches lazily on first read (_open handles mm=None).
-            self._segments[object_id] = _Segment(
-                path, None, size)  # type: ignore[arg-type]
-            self._used += size
-            return fd
+                    self._collect_graveyard()
+                    if self._used + size > self._capacity:
+                        self._spill_locked(
+                            self._used + size - self._capacity)
+                    if self._used + size > self._capacity:
+                        staged = self._stage_remote_spill_locked(
+                            self._used + size - self._capacity)
+                        if staged is None:
+                            raise ObjectStoreFullError(
+                                f"Object of {size} bytes does not fit: "
+                                f"{self._used}/{self._capacity} bytes "
+                                f"used ({self._spilled_bytes} spilled)."
+                            )
+                if staged is None:
+                    path = self._path(object_id)
+                    fd = self._pool_claim_locked(size, path)
+                    if fd is None:
+                        fd = os.open(
+                            path, os.O_CREAT | os.O_RDWR | os.O_EXCL,
+                            0o600)
+                    # mm attaches lazily on first read (_open handles
+                    # mm=None).
+                    self._segments[object_id] = _Segment(
+                        path, None, size)  # type: ignore[arg-type]
+                    self._used += size
+            if orphans:
+                # Spill copies of objects freed mid-write: delete
+                # outside the lock (remote round trips).
+                for oid_hex in orphans:
+                    self._spill.delete(oid_hex)
+                orphans = []
+            if fd is not None:
+                return fd
+            self._write_staged_spill(staged)
 
     def _abort_reserve(self, object_id: ObjectID):
         """Roll back a failed write: no partial file may remain, or a
@@ -246,38 +390,30 @@ class ObjectStore:
         from .config import ray_config
         if not bool(ray_config.object_spilling_enabled):
             return 0
-        candidates = [
-            (seg.last_access, oid, seg)
-            for oid, seg in self._segments.items()
-            if seg.sealed and seg.counted and seg.file_exists
-            and seg.size >= int(ray_config.min_spilling_size)
-        ]
-        candidates.sort(key=lambda t: t[0])
+        if self._spill.remote:
+            # Remote spill I/O never runs under the store lock: callers
+            # stage candidates (_stage_remote_spill_locked), write
+            # outside, and CAS the bookkeeping back in.
+            return 0
+        candidates = self._spill_candidates_locked()
         reclaimed = 0
         os.makedirs(self._spill_dir, exist_ok=True)
         for _, oid, seg in candidates:
             if reclaimed >= need_bytes:
                 break
             try:
-                if self._spill.remote:
-                    # NOTE: remote spill I/O currently runs under the
-                    # store lock (like the local copy it replaces);
-                    # streamed in chunks so no whole-object heap copy
-                    # happens at the moment of memory pressure.
-                    self._spill.write_file(oid.hex(), seg.path)
-                else:
-                    dst = self._spill_path(oid)
-                    tmp = dst + ".tmp"
+                dst = self._spill_path(oid)
+                tmp = dst + ".tmp"
+                try:
+                    import shutil
+                    shutil.copyfile(seg.path, tmp)
+                    os.rename(tmp, dst)
+                except OSError:
                     try:
-                        import shutil
-                        shutil.copyfile(seg.path, tmp)
-                        os.rename(tmp, dst)
+                        os.unlink(tmp)
                     except OSError:
-                        try:
-                            os.unlink(tmp)
-                        except OSError:
-                            pass
-                        raise
+                        pass
+                    raise
                 os.unlink(seg.path)
             except Exception:
                 continue
@@ -294,14 +430,104 @@ class ObjectStore:
                     self._graveyard.append(seg.mm)
         return reclaimed
 
+    def _spill_candidates_locked(self):
+        from .config import ray_config
+        candidates = [
+            (seg.last_access, oid, seg)
+            for oid, seg in self._segments.items()
+            if seg.sealed and seg.counted and seg.file_exists
+            and not seg.spilling
+            and seg.size >= int(ray_config.min_spilling_size)
+        ]
+        candidates.sort(key=lambda t: t[0])
+        return candidates
+
+    def _stage_remote_spill_locked(self, need_bytes: int):
+        """Pick remote-spill candidates and mark them in flight; the
+        object-storage writes run OUTSIDE the lock
+        (_write_staged_spill) and the bookkeeping CASes back in
+        (_commit_staged_spill_locked). None => no progress possible."""
+        from .config import ray_config
+        if not self._spill.remote \
+                or not bool(ray_config.object_spilling_enabled):
+            return None
+        staged = []
+        picked = 0
+        for _, oid, seg in self._spill_candidates_locked():
+            if picked >= need_bytes:
+                break
+            seg.spilling = True
+            staged.append({"oid": oid, "seg": seg, "ok": False})
+            picked += seg.size
+        return staged or None
+
+    def _write_staged_spill(self, staged) -> None:
+        """The unlocked half of a staged remote spill: stream each
+        candidate's shm file to the spill target. A concurrent free()
+        is safe — it unlinks the path but our open fd keeps the inode,
+        and the commit detects the popped segment and drops the orphan
+        spill copy."""
+        for ent in staged:
+            try:
+                self._spill.write_file(ent["oid"].hex(),
+                                       ent["seg"].path)
+                ent["ok"] = True
+            except Exception:  # lint: broad-except-ok staged spill write failed (target down, file freed): the commit skips it and capacity pressure re-resolves
+                pass
+
+    def _commit_staged_spill_locked(self, staged, orphans) -> int:
+        """CAS the staged writes' bookkeeping back under the lock. A
+        segment freed (or already replaced) while its write was in
+        flight contributes an orphan spill key for the caller to
+        delete OUTSIDE the lock. Returns bytes reclaimed."""
+        reclaimed = 0
+        for ent in staged:
+            oid, seg = ent["oid"], ent["seg"]
+            seg.spilling = False
+            if not ent["ok"]:
+                continue
+            if self._segments.get(oid) is not seg or not seg.file_exists:
+                orphans.append(oid.hex())
+                continue
+            try:
+                os.unlink(seg.path)
+            except OSError:
+                pass
+            seg.file_exists = False
+            self._segments.pop(oid, None)
+            if seg.counted:
+                self._used -= seg.size
+            self._spilled_bytes += seg.size
+            self._spilled_count += 1
+            reclaimed += seg.size
+            if seg.mm is not None:
+                try:
+                    seg.mm.close()
+                except BufferError:
+                    self._graveyard.append(seg.mm)
+        return reclaimed
+
     def spill_objects(self, target_bytes: int) -> int:
         """Spill until shm usage is at or below `target_bytes` (called by
         the memory monitor under host memory pressure — /dev/shm pages
         count as RAM). Returns bytes reclaimed."""
+        staged = None
         with self._lock:
             if self._used <= target_bytes:
                 return 0
-            return self._spill_locked(self._used - target_bytes)
+            reclaimed = self._spill_locked(self._used - target_bytes)
+            if self._used > target_bytes:
+                staged = self._stage_remote_spill_locked(
+                    self._used - target_bytes)
+        if staged:
+            orphans: list = []
+            self._write_staged_spill(staged)
+            with self._lock:
+                reclaimed += self._commit_staged_spill_locked(
+                    staged, orphans)
+            for oid_hex in orphans:
+                self._spill.delete(oid_hex)
+        return reclaimed
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -309,6 +535,7 @@ class ObjectStore:
                     "spilled_bytes": self._spilled_bytes,
                     "spilled_count": self._spilled_count,
                     "restored_count": self._restored_count,
+                    "pool_bytes": self._pool_bytes,
                     "num_objects": len(self._segments)}
 
     # -- read path ---------------------------------------------------------
@@ -347,10 +574,11 @@ class ObjectStore:
                 # accounting either way.
                 from_spill = True
                 if self._spill.remote:
-                    data = self._spill.read_view(object_id.hex())
-                    size = len(data)
-                    mm = mmap.mmap(-1, max(1, size))
-                    mm[0:size] = data
+                    # Rare under-lock fallback: the staged restore
+                    # (_restore_remote_unlocked) normally lands the
+                    # mapping before _open_view takes the lock.
+                    mm = self._spill.read_mmap(object_id.hex())
+                    size = len(mm)
                     path = self._spill_path(object_id)
                     fd = None
                 else:
@@ -378,11 +606,50 @@ class ObjectStore:
             seg.last_access = self._access_clock
             return seg
 
+    def _restore_remote_unlocked(self, object_id: ObjectID) -> None:
+        """Stage a REMOTE spill restore OUTSIDE the store lock: the
+        chunked object-storage read of a cold multi-GB object must not
+        serialize every concurrent store op behind it (the owner-side
+        LRU would otherwise freeze for the restore's duration). The
+        streamed mapping CASes into the segment table; losing the race
+        to a concurrent restore or free just drops it."""
+        with self._lock:
+            seg = self._segments.get(object_id)
+            if (seg is not None and seg.mm is not None) \
+                    or object_id in self._freeing \
+                    or os.path.exists(self._path(object_id)):
+                return
+        try:
+            mm = self._spill.read_mmap(object_id.hex())
+        except OSError:
+            return  # not spilled after all; _open re-resolves
+        with self._lock:
+            seg = self._segments.get(object_id)
+            if object_id in self._freeing \
+                    or (seg is not None and seg.mm is not None):
+                mm.close()
+                return
+            counted = seg is not None
+            if seg is None:
+                seg = _Segment(self._spill_path(object_id), mm,
+                               len(mm), sealed=True, counted=False)
+                self._segments[object_id] = seg
+            else:
+                if counted and seg.counted:
+                    # The shm copy is gone; stop counting it.
+                    self._used -= seg.size
+                seg.counted = False
+                seg.mm = mm
+                seg.path = self._spill_path(object_id)
+            self._restored_count += 1
+
     def _open_view(self, object_id: ObjectID) -> memoryview:
         """Open + export a view atomically: the view must be created
         under the lock, so a concurrent spill's mm.close() hits
         BufferError (→ graveyard) instead of invalidating a mapping a
         reader is about to touch."""
+        if self._spill.remote:
+            self._restore_remote_unlocked(object_id)
         with self._lock:
             return memoryview(self._open(object_id).mm)
 
@@ -417,14 +684,11 @@ class ObjectStore:
             # contract.
             self._freeing[object_id] = self._freeing.get(object_id, 0) + 1
             seg = self._segments.pop(object_id, None)
-            try:
-                os.unlink(self._path(object_id))
-            except OSError:
-                pass
+            pooled = False
             if seg is not None:
-                seg.file_exists = False
                 if seg.counted:
                     self._used -= seg.size
+                live_views = False
                 if seg.mm is not None:
                     try:
                         seg.mm.close()
@@ -433,6 +697,20 @@ class ObjectStore:
                         # keeps pages until the map closes. Retry on
                         # future allocations.
                         self._graveyard.append(seg.mm)
+                        live_views = True
+                # Pool the backing file instead of unlinking — UNLESS
+                # views still alias the mapping (a re-claimed inode
+                # would rewrite the pages under them: corruption, not
+                # just a stale read) or a staged spill is mid-read.
+                if seg.file_exists and seg.sealed and not live_views \
+                        and not seg.spilling:
+                    pooled = self._pool_put_locked(seg)
+                seg.file_exists = False
+            if not pooled:
+                try:
+                    os.unlink(self._path(object_id))
+                except OSError:
+                    pass
         # Spill delete OUTSIDE the store lock: with a remote
         # object_spilling_path this is a filesystem/HTTP round trip, and
         # holding the lock across it would stall every concurrent
@@ -475,6 +753,7 @@ class ObjectStore:
             # Files written by workers that never reported back (crashes)
             # are not in _segments; sweep the whole session dir.
             shutil.rmtree(self._dir, ignore_errors=True)
+            shutil.rmtree(self._pool_dir, ignore_errors=True)
             self._spill.cleanup()
 
 
@@ -535,10 +814,10 @@ class _SpillTarget:
             with self._fs.open_output_stream(tmp) as f:
                 f.write(view)
             self._fs.move(tmp, self._key(oid_hex))
-        except Exception:
+        except Exception:  # lint: broad-except-ok any backend failure (fs driver raises are untyped) must clean the temp key; re-raised below
             try:
                 self._fs.delete_file(tmp)
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort temp cleanup; the original write error (re-raised) is the signal
                 pass
             raise
 
@@ -547,7 +826,21 @@ class _SpillTarget:
         """Stream a local file to the target in chunks (no whole-object
         heap copy — spilling happens under memory pressure)."""
         if self._fs is None:
-            self.write(oid_hex, open(src_path, "rb").read())
+            os.makedirs(self.local_dir, exist_ok=True)
+            dst = os.path.join(self.local_dir, oid_hex)
+            tmp = dst + ".tmp"
+            try:
+                import shutil
+                # copyfile streams (sendfile where the kernel allows);
+                # the old path read the whole object onto the heap.
+                shutil.copyfile(src_path, tmp)
+                os.rename(tmp, dst)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
             return
         if not self._base_made:
             self._fs.create_dir(self._base, recursive=True)
@@ -562,10 +855,10 @@ class _SpillTarget:
                         break
                     dst.write(buf)
             self._fs.move(tmp, self._key(oid_hex))
-        except Exception:
+        except Exception:  # lint: broad-except-ok any backend failure (fs driver raises are untyped) must clean the temp key; re-raised below
             try:
                 self._fs.delete_file(tmp)
-            except Exception:
+            except Exception:  # lint: broad-except-ok best-effort temp cleanup; the original write error (re-raised) is the signal
                 pass
             raise
 
@@ -575,6 +868,43 @@ class _SpillTarget:
         import pyarrow.fs as pafs
         info = self._fs.get_file_info(self._key(oid_hex))
         return info.type != pafs.FileType.NotFound
+
+    def read_mmap(self, oid_hex: str, chunk: int = 8 << 20):
+        """Restore into a mapping: local targets mmap the spill file
+        off the page cache; remote targets stream CHUNKED into an
+        anonymous mapping (the pipelined-restore entry point — callers
+        run this outside the store lock). Raises OSError when the key
+        is missing."""
+        import mmap as _mmap
+        if self._fs is None:
+            path = os.path.join(self.local_dir, oid_hex)
+            fd = os.open(path, os.O_RDWR)
+            try:
+                return _mmap.mmap(fd, os.path.getsize(path))
+            finally:
+                os.close(fd)
+        import pyarrow.fs as pafs
+        info = self._fs.get_file_info(self._key(oid_hex))
+        if info.type == pafs.FileType.NotFound:
+            raise FileNotFoundError(oid_hex)
+        size = int(info.size or 0)
+        mm = _mmap.mmap(-1, max(1, size))
+        off = 0
+        try:
+            with self._fs.open_input_stream(self._key(oid_hex)) as f:
+                while off < size:
+                    buf = f.read(min(chunk, size - off))
+                    if not buf:
+                        break
+                    mm[off:off + len(buf)] = buf
+                    off += len(buf)
+        except Exception:
+            mm.close()
+            raise
+        if off != size:
+            mm.close()
+            raise OSError(f"short restore for {oid_hex}: {off}/{size}")
+        return mm
 
     def read_view(self, oid_hex: str):
         """Zero-copy-ish read: local spills mmap (pagecache); remote

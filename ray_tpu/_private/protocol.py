@@ -87,6 +87,14 @@ SERVE_RESP = "serve_resp"        # replica -> proxy: its response
 SERVE_BODY_FREE = "serve_free"   # worker <-> worker oneway: consumer
                                  # finished reading a store-staged
                                  # body; producer frees the slot
+PULL_DIRECT = "pull_direct"      # worker -> worker: ranged object pull
+                                 # request on a brokered channel
+OBJ_CHUNK = "obj_chunk"          # worker -> worker: one ranged chunk of
+                                 # the pulled object's bytes (out-of-band
+                                 # buffer — never pickled payload)
+OBJ_EOF = "obj_eof"              # worker -> worker: pull terminal frame
+                                 # (ok with digest-free completion, or a
+                                 # typed refusal -> daemon-path fallback)
 
 # ---------------------------------------------------------------------------
 # Message types: per-host daemon <-> head control service (TCP). The daemon

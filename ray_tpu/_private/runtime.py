@@ -1760,6 +1760,12 @@ class Node:
         # Stop re-exporting the dead worker's pushed metrics snapshot
         # (worker churn must not grow the store or pin stale gauges).
         self.gcs.telemetry.forget_worker(handle.worker_id.hex())
+        # A dead worker's transfer-inflight gauge must not pin its node
+        # "link-saturated" in the hybrid policy forever (the handle does
+        # not carry a node id: scan the few node entries).
+        wid_hex = handle.worker_id.hex()
+        for entry in self.node_registry.entries():
+            entry.xfer_inflight.pop(wid_hex, None)
         # A dead CALLER's unsettled sequence slots (channel sends that
         # died in its outbound queue) could wedge callee merge gates
         # forever: release its whole sequencing domain at every live
@@ -2573,12 +2579,23 @@ class Node:
         elif msg_type == P.TASK_EVENTS:
             self._ingest_task_events(handle, payload)
         elif msg_type == P.METRICS_PUSH:
+            groups = payload.get("groups") or []
             self.gcs.telemetry.metrics_put(
                 scope="worker",
                 node_id=payload.get("node_id") or self.node_id.hex(),
                 worker_id=payload.get("worker_id"),
-                groups=payload.get("groups") or [],
+                groups=groups,
                 ts=payload.get("ts"))
+            # Feed the worker's transfer-inflight gauge back into the
+            # scheduler's node view: the hybrid policy deprioritizes
+            # nodes whose links are saturated with bulk object pulls.
+            for g in groups:
+                if g.get("name") == "transfer_inflight":
+                    for _n, _t, v in g.get("samples") or ():
+                        self.node_registry.note_transfer_inflight(
+                            payload.get("node_id") or self.node_id.hex(),
+                            payload.get("worker_id"), int(v))
+                    break
         elif msg_type == P.ACTOR_READY:
             self._on_actor_ready(handle, payload)
         elif msg_type == P.DIRECT_DONE:

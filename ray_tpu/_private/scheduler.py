@@ -114,7 +114,7 @@ class ResourceManager:
 
 class NodeEntry:
     __slots__ = ("node_id_hex", "rm", "alive", "draining", "start_time",
-                 "is_head", "daemon", "labels")
+                 "is_head", "daemon", "labels", "xfer_inflight")
 
     def __init__(self, node_id_hex: str, rm: ResourceManager,
                  is_head: bool = False, daemon=None,
@@ -139,6 +139,17 @@ class NodeEntry:
         # "ray.io/node_id" label always resolves.
         self.labels = dict(labels or {})
         self.labels.setdefault("ray.io/node_id", node_id_hex)
+        # worker_id_hex -> in-flight direct object transfers reported by
+        # that worker's METRICS_PUSH (telemetry.record_transfer_inflight).
+        # The hybrid policy sums it per node to deprioritize nodes whose
+        # links are saturated with bulk pulls. Plain dict: single-writer
+        # (the head ingest loop), racy reads only cost one stale decision.
+        self.xfer_inflight: Dict[str, int] = {}
+
+    def transfer_load(self) -> int:
+        """In-flight direct object transfers summed over this node's
+        workers (0 when telemetry is off — the policy term vanishes)."""
+        return sum(self.xfer_inflight.values())
 
     @property
     def schedulable(self) -> bool:
@@ -215,6 +226,19 @@ class NodeRegistry:
     def get(self, node_id_hex: str) -> Optional[NodeEntry]:
         with self._lock:
             return self._nodes.get(node_id_hex)
+
+    def note_transfer_inflight(self, node_id_hex: str,
+                               worker_id_hex: Optional[str],
+                               value: int) -> None:
+        """Ingest one worker's transfer-inflight gauge (METRICS_PUSH):
+        the per-link load signal the hybrid policy reads back."""
+        entry = self.get(node_id_hex)
+        if entry is None or not worker_id_hex:
+            return
+        if value > 0:
+            entry.xfer_inflight[worker_id_hex] = int(value)
+        else:
+            entry.xfer_inflight.pop(worker_id_hex, None)
 
     def set_draining(self, node_id_hex: str,
                      draining: bool = True) -> bool:
@@ -300,11 +324,21 @@ class NodeRegistry:
             pref = self.head if self.head.alive else None
         util = {e.node_id_hex: self._utilization(e, demand)
                 for e in alive}
+        # Per-link transfer saturation (workers' transfer_inflight
+        # gauges, summed per node): a node mid multi-GB object pulls
+        # loses its tiebreak — co-scheduling more data-hungry work onto
+        # a saturated link serializes both transfers. Zero everywhere
+        # when telemetry is off, so the term vanishes.
+        busy_at = max(1, int(ray_config.scheduler_transfer_busy_threshold))
+        xbusy = {e.node_id_hex: e.transfer_load() >= busy_at
+                 for e in alive}
         loc = locality or {}
-        if pref is not None and util[pref.node_id_hex] < threshold:
+        if pref is not None and util[pref.node_id_hex] < threshold \
+                and not xbusy[pref.node_id_hex]:
             rest = sorted(
                 (e for e in alive if e is not pref),
                 key=lambda e: (util[e.node_id_hex] >= threshold,
+                               xbusy[e.node_id_hex],
                                -loc.get(e.node_id_hex, 0),
                                util[e.node_id_hex]))
             return [pref] + rest
@@ -315,6 +349,7 @@ class NodeRegistry:
         ordered = sorted(
             alive,
             key=lambda e: (util[e.node_id_hex] >= threshold,
+                           xbusy[e.node_id_hex],
                            -loc.get(e.node_id_hex, 0),
                            util[e.node_id_hex]))
         k = max(1, int(len(ordered)
@@ -1480,7 +1515,7 @@ class Scheduler:
             # second recall is idempotent and cheap.
             try:
                 worker.send(P.RECALL_QUEUED, {})
-            except Exception:
+            except Exception:  # lint: broad-except-ok dead worker pipe: the recall is a lost-wakeup patch and WORKER_DIED requeues the task anyway
                 pass
         return True
 
@@ -1580,7 +1615,7 @@ class Scheduler:
             return None
         try:
             return self._locality_fn(spec)
-        except Exception:
+        except Exception:  # lint: broad-except-ok locality is advisory: a failing user-supplied or stale locality fn degrades to "no preference", never blocks placement
             return None
 
     @staticmethod

@@ -287,6 +287,35 @@ def record_result_forward(n: int) -> None:
                 "Result locations pushed head->submitting worker").inc(n)
 
 
+# -- direct object transfer plane -------------------------------------------
+# Per-process running count of in-flight direct object transfers (pulls
+# this process is waiting on + pulls it is serving). Published as the
+# `transfer_inflight` gauge so the worker METRICS_PUSH carries it to the
+# head, where the scheduler's hybrid policy reads it back per node and
+# stops co-scheduling onto saturated links.
+_transfer_lock = threading.Lock()
+_transfer_inflight = 0
+
+
+def record_transfer_inflight(delta: int) -> None:
+    global _ops, _transfer_inflight
+    _ops += 1
+    with _transfer_lock:
+        _transfer_inflight = max(0, _transfer_inflight + int(delta))
+        n = _transfer_inflight
+    _metric("transfer_inflight", "gauge",
+            "In-flight direct object transfers in this process").set(n)
+
+
+def record_transfer_bytes(n: int) -> None:
+    """Bytes moved worker->worker on the direct transfer plane."""
+    global _ops
+    _ops += 1
+    if n > 0:
+        _metric("direct_transfer_bytes_total", "counter",
+                "Object bytes pulled over direct channels").inc(n)
+
+
 # -- serve plane ------------------------------------------------------------
 # Request-path gauge writes are DEFERRED: the per-request hot path only
 # touches a plain dict under one lock and marks the deployment dirty;

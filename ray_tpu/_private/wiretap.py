@@ -130,6 +130,11 @@ def _load_model() -> None:
         "SERVE_RESP": lambda p: {"key": p.get("r"),
                                  "stage": _serve_stage(p.get("v"))},
         "SERVE_BODY_FREE": lambda p: {"key": p.get("o")},
+        # object-transfer plane: every pull opens a chunk stream keyed
+        # by its rid; chunks carry the dense index in compact slot 1.
+        "PULL_DIRECT": lambda p: {"key": p.get("r"), "streaming": True},
+        "OBJ_CHUNK": lambda p: {"key": p["c"][0], "index": p["c"][1]},
+        "OBJ_EOF": lambda p: {"key": p.get("r")},
     }
     _names = names
 

@@ -683,6 +683,16 @@ class Worker:
                     "pull", object_id=oid.hex(), source=loc[2][:8]) \
                     if tracing.is_enabled() else contextlib.nullcontext()
                 with cm:
+                    # Object-transfer fast path: pull worker->worker
+                    # over an already-brokered direct channel to the
+                    # owning node (no daemon routing, no extra copy).
+                    # Any failure inside returns False and the daemon
+                    # PULL_OBJECT path below runs unchanged.
+                    if (self._direct_on
+                            and self.direct.pull_object(
+                                oid, loc[2],
+                                loc[1] if len(loc) > 1 else 0)):
+                        return self._finish_read(self.store.get(oid))
                     res = self.client._request(P.PULL_OBJECT,
                                                {"object_id": oid,
                                                 "node": loc[2]})
@@ -710,6 +720,10 @@ class Worker:
             raise serialization.deserialize(loc[1])
         else:
             raise RuntimeError(f"unresolvable location {kind} for {oid}")
+        return self._finish_read(value)
+
+    @staticmethod
+    def _finish_read(value: Any) -> Any:
         if isinstance(value, TaskError):
             raise value
         return value

@@ -121,6 +121,9 @@ SESSIONS = {
                     "LOCALIZE_OBJECT": ("REGISTERED",),
                     "DRAIN_NODE": ("REGISTERED",),
                     "NODE_REPLY": ("REGISTERED",),
+                    # release broadcasts fan out to daemons too (each
+                    # relays to its own workers and frees its store)
+                    "RELEASE_OBJECTS": ("REGISTERED",),
                 },
             },
             "daemon": {
@@ -165,6 +168,7 @@ SESSIONS = {
                     "GEN_CANCEL": ("OPEN",),
                     "SERVE_REQ": ("OPEN",),
                     "SERVE_BODY_FREE": ("OPEN",),
+                    "PULL_DIRECT": ("OPEN",),
                     "DIRECT_RECONCILE": ("DRAINING",),
                 },
             },
@@ -175,21 +179,31 @@ SESSIONS = {
                     "GEN_ITEM": ("OPEN", "DRAINING"),
                     "SERVE_RESP": ("OPEN", "DRAINING"),
                     "SERVE_BODY_FREE": ("OPEN", "DRAINING"),
+                    "OBJ_CHUNK": ("OPEN", "DRAINING"),
+                    "OBJ_EOF": ("OPEN", "DRAINING"),
                 },
             },
         },
         "rid_resp": None,
         "counters": (),
         # Every ACTOR_CALL pairs with exactly one ACTOR_RESULT (or the
-        # reconcile drain); SERVE_REQ rid-pairs with SERVE_RESP.
+        # reconcile drain); SERVE_REQ rid-pairs with SERVE_RESP;
+        # PULL_DIRECT rid-pairs with its OBJ_EOF terminal.
         "pairs": ({"req": "ACTOR_CALL", "resp": "ACTOR_RESULT"},
-                  {"req": "SERVE_REQ", "resp": "SERVE_RESP"}),
-        # GEN_ITEM streams: dense per-call index, items only between
-        # the opening (streaming) ACTOR_CALL and its terminal
-        # ACTOR_RESULT; GEN_CANCEL moves the stream to a draining set
-        # where late in-flight items are legal.
-        "streams": {"item": "GEN_ITEM", "cancel": "GEN_CANCEL",
-                    "opener": "ACTOR_CALL", "terminal": "ACTOR_RESULT"},
+                  {"req": "SERVE_REQ", "resp": "SERVE_RESP"},
+                  {"req": "PULL_DIRECT", "resp": "OBJ_EOF"}),
+        # Stream specs (one or many): GEN_ITEM streams carry a dense
+        # per-call index between the opening (streaming) ACTOR_CALL and
+        # its terminal ACTOR_RESULT, with GEN_CANCEL moving the stream
+        # to a draining set where late in-flight items stay legal.
+        # OBJ_CHUNK streams are the object-transfer plane's ranged
+        # chunks: gapless dense indexes between the opening PULL_DIRECT
+        # and its OBJ_EOF terminal (no cancel — a dropped pull just
+        # abandons the rid and the chunks drop on arrival).
+        "streams": ({"item": "GEN_ITEM", "cancel": "GEN_CANCEL",
+                     "opener": "ACTOR_CALL", "terminal": "ACTOR_RESULT"},
+                    {"item": "OBJ_CHUNK", "cancel": None,
+                     "opener": "PULL_DIRECT", "terminal": "OBJ_EOF"}),
         # SERVE_BODY_FREE only for a body the peer actually staged.
         "frees": {"free": "SERVE_BODY_FREE",
                   "stagers": ("SERVE_REQ", "SERVE_RESP")},
@@ -226,6 +240,7 @@ REQUESTS = {
                   "matching NODE_ACK recv-loop exemption"},
     "SERVE_REQ": {"response": "SERVE_RESP", "loop": "serve.client"},
     "ACTOR_CALL": {"response": "ACTOR_RESULT", "loop": "worker.direct"},
+    "PULL_DIRECT": {"response": "OBJ_EOF", "loop": "worker.direct"},
 }
 
 # ---------------------------------------------------------------------------
@@ -339,6 +354,16 @@ PAYLOADS = {
     "SERVE_RESP": {"variants": (
         {"required": ("r",), "optional": ("v", "e")},)},
     "SERVE_BODY_FREE": {"variants": ({"required": ("o",), "optional": ()},)},
+    # -- direct object transfer --------------------------------------------
+    "PULL_DIRECT": {"variants": (
+        {"required": ("r", "o"), "optional": ()},)},
+    # compact chunk tuple (rid, index, offset, total, oob-bytes) — the
+    # bytes slot is a pickle-5 out-of-band view of the sealed segment,
+    # never a pickled copy; arity drift breaks the chunk unpack.
+    "OBJ_CHUNK": {"variants": (
+        {"required": ("c",), "optional": (), "arity": {"c": 5}},)},
+    "OBJ_EOF": {"variants": (
+        {"required": ("r", "ok"), "optional": ("e",)},)},
     # -- head -> daemon ----------------------------------------------------
     "NODE_ACK": {"variants": (
         {"required": ("head_node_id_hex", "head_transfer_port"),
@@ -521,8 +546,12 @@ class SessionDFA:
                         key=repr(rid)))
 
         # streams -------------------------------------------------------
-        streams = sess["streams"]
-        if streams is not None:
+        # One session may carry several stream kinds (generator items,
+        # object-transfer chunks); a bare dict is the one-stream form.
+        specs = sess["streams"]
+        if isinstance(specs, dict):
+            specs = (specs,)
+        for streams in specs or ():
             key = ext.get("key")
             if const == streams["item"] and key is not None:
                 idx = ext.get("index")
@@ -547,7 +576,6 @@ class SessionDFA:
                         "stream-item-without-call", const, direction,
                         key=repr(key)))
             elif const == streams["terminal"]:
-                key = ext.get("key")
                 if key is not None and (key in self.streams
                                         or key in self.cancelled):
                     self.streams.pop(key, None)
@@ -555,8 +583,8 @@ class SessionDFA:
                     self._terminate(key)
                 elif key is not None and ext.get("streamed"):
                     self._terminate(key)
-            elif const == streams["cancel"]:
-                key = ext.get("key")
+            elif streams["cancel"] is not None \
+                    and const == streams["cancel"]:
                 # Cancel of an unknown/finished stream is a legal race.
                 if key is not None and key in self.streams:
                     del self.streams[key]

@@ -134,6 +134,13 @@ RECV_LOOPS = {
                           "stay on the actor-call plane",
             "SERVE_REQ": "this end SENDS requests; only the replica "
                          "worker's DirectPlane dispatcher receives them",
+            "PULL_DIRECT": "object pulls ride DirectPlane channels; the "
+                           "serve connection is unary request/response "
+                           "by construction",
+            "OBJ_CHUNK": "object-transfer chunks ride DirectPlane "
+                         "channels, never the serve connection",
+            "OBJ_EOF": "object-transfer terminals ride DirectPlane "
+                       "channels, never the serve connection",
         },
     },
 }
@@ -417,7 +424,8 @@ BARRIER_EXEMPT = {
 PROTOCOL_SEND_FUNCS = {
     # -- head side of the worker pipe ----------------------------------
     ("_private/runtime.py", "Node._broadcast_releases"):
-        (("worker", "head", ("OPEN",)),),
+        (("worker", "head", ("OPEN",)),
+         ("daemon", "head", ("REGISTERED",))),
     ("_private/runtime.py", "Node._dispatch"):
         (("worker", "head", ("OPEN",)),),
     ("_private/runtime.py", "Node._dispatch_actor_creation"):
@@ -528,6 +536,13 @@ PROTOCOL_SEND_FUNCS = {
         (("direct", "callee", ("OPEN", "DRAINING")),),
     ("_private/direct.py", "DirectPlane._serve_exec"):
         (("direct", "callee", ("OPEN", "DRAINING")),),
+    # -- direct object transfer plane ----------------------------------
+    ("_private/direct.py", "DirectPlane.pull_object"):
+        (("direct", "caller", ("OPEN",)),),
+    ("_private/direct.py", "DirectPlane._send_pull_eof"):
+        (("direct", "callee", ("OPEN", "DRAINING")),),
+    ("_private/direct.py", "DirectPlane._pull_serve_exec"):
+        (("direct", "callee", ("OPEN", "DRAINING")),),
     ("serve/_private/direct_client.py", "_broker"):
         (("direct", "caller", ("ESTABLISHING",)),
          ("worker", "worker", ("OPEN",))),
@@ -617,6 +632,22 @@ PAYLOAD_CONSUMERS = {
     "GEN_CANCEL": (
         {"file": "_private/direct.py",
          "functions": ("DirectPlane._handle_direct_message",),
+         "payload_vars": ("payload",)},
+    ),
+    "PULL_DIRECT": (
+        {"file": "_private/direct.py",
+         "functions": ("DirectPlane._on_pull_direct",
+                       "DirectPlane._pull_serve_exec"),
+         "payload_vars": ("payload",)},
+    ),
+    "OBJ_CHUNK": (
+        {"file": "_private/direct.py",
+         "functions": ("DirectPlane._on_obj_chunk",),
+         "payload_vars": ("payload",)},
+    ),
+    "OBJ_EOF": (
+        {"file": "_private/direct.py",
+         "functions": ("DirectPlane._on_obj_eof",),
          "payload_vars": ("payload",)},
     ),
     "REGISTER_NODE": (

@@ -13,10 +13,13 @@ python -m ray_tpu.devtools.lint --format github \
     ${RAYLINT_SINCE:+--since "$RAYLINT_SINCE"}
 
 echo "== wiretap conformance smoke (protocol DFAs under the tap) =="
-# One protocol-heavy suite under RAY_TPU_WIRETAP=1: the conftest guard
+# Protocol-heavy suites under RAY_TPU_WIRETAP=1: the conftest guard
 # fails any test whose processes journal a nonconforming frame
 # sequence, plus the tap's own unit suite (zero-work guard included).
-env JAX_PLATFORMS=cpu python -m pytest tests/test_wiretap.py -q \
+# test_transfer drives the PULL_DIRECT/OBJ_CHUNK/OBJ_EOF stream DFA
+# (including its chaos fallbacks) under the tap.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_wiretap.py \
+    tests/test_transfer.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== serve-direct flag-off zero-work guard =="

@@ -113,7 +113,7 @@ def _lockdep_guard(request, tmp_path_factory):
 # (these suites all build per-test clusters).
 _REFDEBUG_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
                     "test_fault_injection", "test_drain",
-                    "test_serve_direct"}
+                    "test_serve_direct", "test_transfer"}
 
 
 @pytest.fixture(autouse=True)
@@ -159,7 +159,7 @@ def _refdebug_guard(request, tmp_path_factory):
 # it (every process of the run appends violations at record time,
 # SIGKILL-safe).
 _WIRETAP_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
-                   "test_serve_direct"}
+                   "test_serve_direct", "test_transfer"}
 
 
 @pytest.fixture(autouse=True)

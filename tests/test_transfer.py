@@ -1,20 +1,39 @@
-"""Cross-node transfer: parallel range-pulls and the broadcast tree.
+"""Cross-node transfer: parallel range-pulls, the broadcast tree, and
+seeded chaos over the direct object-transfer plane.
 
 Reference strategy: object manager transfer tests
 (src/ray/object_manager/test/object_manager_test.cc chunked transfers;
 push_manager.h push scheduling; the 1 GiB broadcast scalability
-benchmark in release/benchmarks)."""
+benchmark in release/benchmarks). The chaos tier drives the worker-to-
+worker pull fast path (_private/direct.py pull_object) through seeded
+injected failures and asserts the daemon-relayed fallback delivers
+bit-exact bytes — the test_chaos.py discipline applied to the object
+plane. This module runs under BOTH conftest guards (refdebug +
+wiretap): every chaos run must also replay to a clean refcount ledger
+and a conforming wire-protocol journal."""
+
+import hashlib
+import os
+import random
+import signal
+import time
 
 import numpy as np
 import pytest
 
 import ray_tpu as ray
+from ray_tpu._private import fault
+from ray_tpu._private import state as _state
+from ray_tpu._private.test_utils import wait_for_condition
 from ray_tpu.cluster_utils import Cluster
 from ray_tpu.experimental import broadcast_object
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture
 def transfer_cluster():
+    # Function-scoped on purpose: the autouse refdebug/wiretap guards
+    # are per-test, and a cluster outliving them would hand the head
+    # DFAs mid-connection (handshake unseen -> spurious violations).
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
     a = cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
     b = cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
@@ -66,3 +85,236 @@ def test_broadcast_object_tree(transfer_cluster):
 def test_broadcast_inline_object_noop(transfer_cluster):
     ref = ray.put(42)  # inline: rides control messages
     assert broadcast_object(ref) == 1
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos over the direct object-transfer plane
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def chaos_cluster():
+    """Per-test cluster slot: the chaos tests need fault configs wired
+    in at init, so they cannot share the module cluster (which an
+    earlier test may have left up — bring it down first). The tier
+    tests the transfer plane itself, so the flag is forced on for the
+    spawned nodes regardless of the outer environment — a flag-off
+    conformance run must not turn these into vacuous passes (or spurious
+    failures on the injection asserts)."""
+    ray.shutdown()
+    prev = os.environ.get("RAY_TPU_DIRECT_OBJECT_TRANSFER_ENABLED")
+    os.environ["RAY_TPU_DIRECT_OBJECT_TRANSFER_ENABLED"] = "1"
+    yield
+    if prev is None:
+        os.environ.pop("RAY_TPU_DIRECT_OBJECT_TRANSFER_ENABLED", None)
+    else:
+        os.environ["RAY_TPU_DIRECT_OBJECT_TRANSFER_ENABLED"] = prev
+    fault.configure(None)
+    ray.shutdown()
+
+
+PULL_CHAOS_SEED = 4242
+PULL_CHAOS_CONFIG = {
+    "seed": PULL_CHAOS_SEED,
+    "rules": [
+        # Half the direct-plane pull requests die at the request step:
+        # the caller must fall back to the daemon PULL_OBJECT path with
+        # bytes intact, invisibly to the reading task.
+        {"site": "direct.pull", "action": "raise", "prob": 0.5,
+         "exc": "ConnectionError"},
+        # A quarter of direct channel dials are dropped — some pulls
+        # never even find a channel and go straight to the daemon path.
+        {"site": "direct.connect", "action": "drop", "prob": 0.25},
+        # The first admission-controlled daemon-path pull in every
+        # process fails once: guaranteed retry/backoff coverage on the
+        # fallback path itself.
+        {"site": "store.pull", "action": "raise", "at": [0],
+         "exc": "ConnectionError"},
+    ],
+}
+
+
+def test_chaos_seeded_pull_drops_fall_back_bytes_intact(chaos_cluster):
+    """Seeded direct-pull and channel-dial failures mid-workload: every
+    cross-node read still returns bit-exact bytes (the daemon-relayed
+    fallback served the pulls the direct plane dropped), and the
+    injections each process performed match the pure (seed, site, seq)
+    schedule exactly — the run replays."""
+    ray.init(num_cpus=2, fault_config=PULL_CHAOS_CONFIG)
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
+    cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
+
+    @ray.remote(resources={"A": 1})
+    class Producer:
+        def make(self, n, seed):
+            rng = np.random.default_rng(seed)
+            return rng.integers(0, 255, size=n, dtype=np.uint8)
+
+    @ray.remote(resources={"B": 1})
+    class Consumer:
+        def pull_digest(self, producer, n, seed):
+            # The nested actor call both produces the object on the
+            # remote node AND brokers the direct channel the pull fast
+            # path rides.
+            ref = producer.make.remote(n, seed)
+            arr = ray.get(ref, timeout=120)
+            return hashlib.sha256(np.ascontiguousarray(arr)).hexdigest()
+
+        def fault_report(self):
+            return (fault.injection_log(), fault.site_counts())
+
+    prod = Producer.remote()
+    cons = Consumer.remote()
+    size = 20 << 20  # 20 MB: spans multiple 8 MB chunks
+    for seed in range(6):
+        got = ray.get(cons.pull_digest.remote(prod, size + seed, seed),
+                      timeout=180)
+        rng = np.random.default_rng(seed)
+        expect = hashlib.sha256(np.ascontiguousarray(
+            rng.integers(0, 255, size=size + seed,
+                         dtype=np.uint8))).hexdigest()
+        assert got == expect, f"pull {seed} returned corrupt bytes"
+
+    # Determinism: every injection the consumer worker logged is
+    # exactly what the pure (seed, site, seq) schedule dictates.
+    log, counts = ray.get(cons.fault_report.remote(), timeout=60)
+    for site, seq, action in log:
+        rule = next(r for r in PULL_CHAOS_CONFIG["rules"]
+                    if r["site"] == site)
+        if "at" in rule:
+            assert seq in rule["at"]
+        else:
+            draw = random.Random(
+                f"{PULL_CHAOS_SEED}:{site}:{seq}").random()
+            assert draw < rule["prob"]
+    # The fast path was genuinely exercised AND genuinely injected:
+    # pulls fired the site, and at least one died there (so at least
+    # one of the bit-exact reads above was served by the fallback).
+    assert dict(counts).get("direct.pull", 0) >= 1, counts
+    assert any(site == "direct.pull" for site, _seq, _a in log), log
+    cluster.shutdown()
+
+
+@pytest.mark.perf_smoke
+def test_transfer_disabled_flag_zero_pull_work(chaos_cluster):
+    """direct_object_transfer_enabled=false must do ZERO pull-plane
+    work — not "cheap", zero: pull_object returns before its op-counter
+    bump, proven by a pull_ops() window around a cross-node read (the
+    counter-based guard style of test_direct_calls / test_serve_direct).
+    The same window with the flag back on counts at least one op, so
+    the zero is the flag's doing, not a dead measurement window."""
+    ray.init(num_cpus=2)
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
+    cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
+
+    @ray.remote(resources={"A": 1})
+    class Producer:
+        def make(self, n):
+            return np.full(n, 7, dtype=np.uint8)
+
+    @ray.remote(resources={"B": 1})
+    class Consumer:
+        def set_transfer(self, on):
+            from ray_tpu._private.config import ray_config
+            ray_config.set("direct_object_transfer_enabled", bool(on))
+
+        def warm(self, producer):
+            # Brokers the direct channel to the producer's node (the
+            # fast path only rides already-brokered channels).
+            return int(ray.get(producer.make.remote(8), timeout=60)[0])
+
+        def read_window(self, refs):
+            from ray_tpu._private import direct
+            before = direct.pull_ops()
+            arr = ray.get(refs[0], timeout=120)
+            return (direct.pull_ops() - before, int(arr[0]),
+                    int(arr.nbytes))
+
+    prod = Producer.remote()
+    cons = Consumer.remote()
+    assert ray.get(cons.warm.remote(prod), timeout=120) == 7
+
+    size = 4 << 20
+    # Flag off: the cross-node read performs zero direct-plane ops.
+    ray.get(cons.set_transfer.remote(False), timeout=60)
+    ref_off = prod.make.remote(size)
+    ray.wait([ref_off], timeout=120)  # produced + location registered
+    ops, first, nbytes = ray.get(cons.read_window.remote([ref_off]),
+                                 timeout=120)
+    assert (first, nbytes) == (7, size)
+    assert ops == 0, f"pull plane did {ops} ops while disabled"
+
+    # Same window, flag on: a fresh cross-node read takes the direct
+    # pull, so the counter window demonstrably catches real pulls.
+    ray.get(cons.set_transfer.remote(True), timeout=60)
+    ref_on = prod.make.remote(size)
+    ray.wait([ref_on], timeout=120)
+    ops, first, nbytes = ray.get(cons.read_window.remote([ref_on]),
+                                 timeout=120)
+    assert (first, nbytes) == (7, size)
+    assert ops >= 1, "direct pull never engaged with the flag on"
+    cluster.shutdown()
+
+
+def test_owner_node_sigkill_mid_pull_typed_object_lost(chaos_cluster):
+    """The owning node SIGKILLed while a direct pull is in flight (a
+    seeded delay holds the pull at its request step across the kill):
+    the read surfaces a typed loss error — not a hang, not a raw socket
+    error — after the direct attempt and the daemon fallback both find
+    the node gone."""
+    ray.init(num_cpus=2, fault_config={
+        "seed": 7,
+        "rules": [
+            # Hold every direct pull at the request step for 2s — the
+            # window in which the driver kills the owning node.
+            {"site": "direct.pull", "action": "delay", "prob": 1.0,
+             "delay_s": 2.0},
+        ],
+    })
+    cluster = Cluster()
+    a = cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
+    cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
+
+    @ray.remote(resources={"A": 1})
+    class Producer:
+        def make(self, n):
+            return np.ones(n, dtype=np.uint8)
+
+    @ray.remote(resources={"B": 1})
+    class Consumer:
+        def warm(self, producer):
+            # Broker the direct channel to the producer's node.
+            return int(ray.get(producer.make.remote(1024),
+                               timeout=60)[0])
+
+        def read(self, refs):
+            from ray_tpu.exceptions import RayError
+            try:
+                arr = ray.get(refs[0], timeout=90)
+                return ("ok", int(arr.nbytes))
+            except RayError as e:
+                return (type(e).__name__, str(e)[:200])
+
+    prod = Producer.remote()
+    cons = Consumer.remote()
+    assert ray.get(cons.warm.remote(prod), timeout=120) == 1
+    big = prod.make.remote(64 << 20)
+    ray.wait([big], timeout=120)  # produced + location registered
+
+    # Start the read (it parks in the injected delay with the pull
+    # outstanding), then SIGKILL the owning node under it.
+    fut = cons.read.remote([big])
+    time.sleep(0.5)
+    os.kill(a.proc.pid, signal.SIGKILL)
+    wait_for_condition(lambda: a.proc.poll() is not None, timeout=30)
+    rt = _state.current()
+    wait_for_condition(
+        lambda: a.node_id not in rt.head_server.daemons, timeout=30)
+
+    t0 = time.monotonic()
+    kind, detail = ray.get(fut, timeout=180)
+    assert kind in ("ObjectLostError", "NodeDiedError"), (kind, detail)
+    # Deadline-bounded: the dead channel fails fast (channel_down),
+    # it does not wait out the full pull deadline.
+    assert time.monotonic() - t0 < 120
+    cluster.shutdown()
