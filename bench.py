@@ -1118,6 +1118,33 @@ def _focus_put_gb(ray_tpu):
     return measure
 
 
+def _focus_put_latency(ray_tpu):
+    """Per-put latency of SMALL store puts (4 KiB), in MICROSECONDS —
+    lower is better (run_ab's ratio reads inverted for this row: a
+    worktree/head ratio under 1.0 is a win). The inline threshold is
+    dropped so the puts actually traverse the store write path — this
+    row exists to watch the small-put fixed costs the zero-copy path
+    targets: segment reservation (pool stripe claim vs fresh
+    create+ftruncate) and gate bypass (below host_copy_gate_min_bytes
+    no HostCopyGate ticket is taken; tests/test_put_path.py proves the
+    zero-ticket contract with a counter)."""
+    from ray_tpu._private.config import ray_config
+    ray_config.set("inline_object_max_bytes", 0)
+    payload = b"\xa5" * 4096
+    for _ in range(50):  # warm: pool stripe, serializer, id paths
+        ref = ray_tpu.put(payload)
+        del ref
+
+    def measure():
+        iters = 500
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ref = ray_tpu.put(payload)
+            del ref
+        return (time.perf_counter() - t0) / iters * 1e6
+    return measure
+
+
 def _focus_mc_put_gb(ray_tpu):
     """Concurrent store clients: 4 driver-side client threads, each
     putting (and dropping) a 120 MB buffer in a loop against the
@@ -1356,6 +1383,7 @@ FOCUS_METRICS = {
     "tasks_async_per_s": _focus_tasks_async,
     "put_get_per_s": _focus_put_get,
     "put_gb_per_s": _focus_put_gb,
+    "put_latency_us": _focus_put_latency,
     "multi_client_put_gb_per_s": _focus_mc_put_gb,
     "pull_gb_per_s": _focus_pull_gb,
     "multi_client_tasks_async_per_s": _focus_mc_tasks,

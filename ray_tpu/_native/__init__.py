@@ -16,7 +16,8 @@ from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = [os.path.join(_DIR, "src", f)
-        for f in ("store.cpp", "transfer.cpp", "dispatch.cpp")]
+        for f in ("store.cpp", "transfer.cpp", "dispatch.cpp",
+                  "memcopy.cpp")]
 _SO = os.path.join(_DIR, "libray_tpu.so")
 _lock = threading.Lock()
 _lib = None
@@ -113,6 +114,9 @@ def _load():
         qlib.disp_send.restype = ctypes.c_int
         qlib.disp_send.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                    ctypes.c_char_p, ctypes.c_uint64]
+        lib.rt_nt_copy.restype = None
+        lib.rt_nt_copy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_uint64]
         lib._qlib = qlib
         _lib = lib
         return _lib
@@ -162,6 +166,40 @@ class NativeDispatcher:
 
 def available() -> bool:
     return _load() is not None
+
+
+def _buf_addr_len(view: memoryview):
+    """(address, nbytes) of a contiguous 1-D byte view via numpy's
+    buffer introspection (works on read-only exporters, unlike
+    ``ctypes.from_buffer``). The returned address is only valid while
+    `view` itself is alive — callers must keep the view referenced
+    across the native call and drop the array before closing any
+    backing mmap (the frombuffer array holds a buffer export)."""
+    import numpy as np
+    arr = np.frombuffer(view, dtype=np.uint8)
+    return arr, arr.ctypes.data, arr.nbytes
+
+
+def nt_copy(dst: memoryview, src) -> bool:
+    """Copy `src` into `dst` with non-temporal stores (memcopy.cpp),
+    bypassing the write-allocate penalty glibc memcpy pays below its
+    NT threshold — the put path's single copy into a store segment.
+    Returns False (caller falls back to a plain slice copy) when the
+    native lib is unavailable; lengths must already match."""
+    lib = _load()
+    if lib is None:
+        return False
+    sview = src if isinstance(src, memoryview) else memoryview(src)
+    if sview.format != "B" or sview.ndim != 1:
+        sview = sview.cast("B")
+    da, daddr, dlen = _buf_addr_len(dst)
+    sa, saddr, slen = _buf_addr_len(sview)
+    if dlen != slen:
+        raise ValueError(f"nt_copy length mismatch: {dlen} != {slen}")
+    if dlen:
+        lib.rt_nt_copy(daddr, saddr, dlen)
+    del da, sa  # release the buffer exports before returning
+    return True
 
 
 def build_error() -> Optional[str]:

@@ -229,6 +229,23 @@ class RayConfig:
         # Only segments at least this large are pooled; tiny files
         # gain nothing from page reuse and would churn the pool.
         "store_segment_pool_min_bytes": 1 << 20,
+        # -- zero-copy put path (ISSUE 17: serialize directly into the
+        # reserved segment). On: put() sizes the payload out-of-band,
+        # reserves the segment (striped pool claim, kept-hot mmaps),
+        # writes the header in place and NT-copies each buffer exactly
+        # once to its final offset. Off: the staging write path
+        # (write_to_fd / write_into through the gate) runs unchanged.
+        "store_zero_copy_put_enabled": True,
+        # Puts below this size never acquire a HostCopyGate ticket,
+        # whatever the gate threshold is tuned to: small copies can't
+        # meaningfully overlap page-allocation storms, and a ticket
+        # round trip would dominate their latency.
+        "host_copy_gate_min_bytes": 256 << 10,
+        # Stripe count for per-client segment-pool reservation: each
+        # writer thread claims from its own stripe of pooled slots
+        # (falling back to stealing), so concurrent writers on
+        # different segments never serialize on one pool lock.
+        "store_put_stripes": 8,
         # Proxy-side admission control: when EVERY replica of a
         # deployment has at least this many proxy-tracked in-flight
         # requests, new requests shed with 503 instead of queueing

@@ -307,6 +307,10 @@ class NodeDaemon:
                             int(payload["store_used"] or 0),
                             payload["num_workers"],
                             payload["free_chips"])
+                        telemetry.record_pool_reclaimed(
+                            self.node_hex,
+                            int(getattr(self.store,
+                                        "pool_reclaimed_bytes", 0)))
                         from ..util import metrics as M
                         payload["metrics"] = M.registry_samples()
                         payload["metrics_ts"] = payload["ts"]

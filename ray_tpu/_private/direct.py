@@ -57,6 +57,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..exceptions import ActorDiedError, GetTimeoutError
 from . import fault
+from . import object_store
 from . import lockdep
 from . import protocol as P
 from . import refdebug
@@ -2105,8 +2106,8 @@ class DirectPlane:
         global _pull_ops
         _pull_ops += 1
         st = {"evt": threading.Event(), "oid": object_id, "chan": chan,
-              "view": None, "next": 0, "got": 0, "total": None,
-              "err": None, "ok": False}
+              "view": None, "res": None, "next": 0, "got": 0,
+              "total": None, "err": None, "ok": False}
         with self._pull_lock:
             self._pull_seq += 1
             rid = self._pull_seq
@@ -2158,8 +2159,15 @@ class DirectPlane:
         except Exception:  # lint: broad-except-ok view already released by the failing writer path
             pass
         st["view"] = None
+        res, st["res"] = st.get("res"), None
         try:
-            self._worker.store.free(st["oid"])
+            if res is not None:
+                # Reservation abort: pops the segment and unlinks the
+                # partial file with no spill round trip — tighter than
+                # free() for a never-sealed object.
+                res.abort()
+            else:
+                self._worker.store.free(st["oid"])
         except Exception:  # lint: broad-except-ok partial-segment cleanup; the daemon path re-creates the id
             pass
 
@@ -2183,11 +2191,17 @@ class DirectPlane:
                 if idx != 0:
                     raise RuntimeError("stream started mid-object")
                 st["total"] = int(total)
-                st["view"] = self._worker.store.create(
+                # Same reserve/seal protocol as the local put path
+                # (object_store.reserve): pool-recycled segments land
+                # pulls into pre-faulted pages too.
+                st["res"] = self._worker.store.reserve(
                     st["oid"], int(total))
-            n = data.nbytes if isinstance(data, memoryview) \
-                else len(data)
-            st["view"][off:off + n] = data
+                st["view"] = st["res"].view()
+            # NT-store copy (object_store.copy_into): a pulled object
+            # is written once here and read by the task later, often
+            # from another process — the same no-write-allocate
+            # argument as the put path.
+            n = object_store.copy_into(st["view"], off, data)
             st["got"] += n
             st["next"] = idx + 1
         except Exception as e:  # lint: broad-except-ok any receive-side failure (store full, id collision, skew) fails the pull typed; the daemon path remains
@@ -2209,7 +2223,11 @@ class DirectPlane:
                 if st["view"] is not None:
                     st["view"].release()
                     st["view"] = None
-                self._worker.store.seal(st["oid"])
+                res, st["res"] = st.get("res"), None
+                if res is not None:
+                    res.seal()
+                else:
+                    self._worker.store.seal(st["oid"])
                 st["ok"] = True
             except Exception as e:  # lint: broad-except-ok seal failure downgrades to the daemon path, never raises on the recv thread
                 st["err"] = repr(e)

@@ -95,7 +95,7 @@ SITES = (
     "netcomm.connect", "netcomm.recv", "netcomm.serve",
     "daemon.connect", "daemon.heartbeat",
     "worker.exec", "worker.start",
-    "gcs.op", "store.pull", "store.spill",
+    "gcs.op", "store.pull", "store.spill", "store.put",
     "collective.rendezvous",
     "direct.connect", "direct.call", "direct.pull",
     "daemon.drain",

@@ -122,6 +122,8 @@ class HostCopyGate:
     def acquire(self) -> bool:
         """Admit this thread (True) or time out to an ungated copy
         (False). FIFO: earlier waiters are always admitted first."""
+        global _gate_ops
+        _gate_ops += 1
         import time as _t
         t0 = _t.monotonic() if telemetry.enabled else None
         width = self.width
@@ -225,6 +227,17 @@ class HostCopyGate:
 # Backwards-compatible name: object_store and the pull paths gate
 # through this instance.
 _host_copy_gate = HostCopyGate()
+
+# Ticket-acquisition counter (always on — one integer add per GATED
+# copy, which is already a large-transfer slow path): the perf_smoke
+# guard for the small-put bypass asserts this does not move across a
+# batch of sub-threshold puts (tests/test_put_path.py).
+_gate_ops = 0
+
+
+def gate_ops() -> int:
+    """Process-wide count of HostCopyGate ticket acquisitions."""
+    return _gate_ops
 
 
 class SerialExecutor:

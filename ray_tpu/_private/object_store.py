@@ -20,9 +20,11 @@ from __future__ import annotations
 import mmap
 import os
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 from ..exceptions import ObjectStoreFullError
+from ..util import tracing
 from . import fault
 from . import lockdep
 from . import serialization
@@ -53,7 +55,7 @@ def escalated_spill(store, need: int) -> int:
     return store.spill_objects(max(0, used - 2 * int(need)))
 
 
-def _put_gate(size: int):
+def _put_gate(size: int, prefaulted: bool = False):
     """Host-wide admission gate for big puts, shared by BOTH store
     backends: concurrent first-touch of fresh tmpfs pages from multiple
     processes collapses superlinearly on small hosts (kernel shmem
@@ -61,8 +63,18 @@ def _put_gate(size: int):
     netcomm's bandwidth-aware HostCopyGate — up to gate-width copies
     overlap (multi-core hosts), excess waiters admit FIFO (the old
     exclusive lock serialized EVERY multi-client put; the old ungated
-    file-store path thrashed instead)."""
+    file-store path thrashed instead).
+
+    Two bypasses keep the gate metering ONLY genuinely overlapping
+    page-allocation storms: writes into `prefaulted` (pool-recycled)
+    segments touch no fresh pages and run ungated whatever their size,
+    and puts under ``host_copy_gate_min_bytes`` skip ticket
+    acquisition entirely — a ticket round trip would dominate a small
+    copy (the counter-proven small-put contract, tests/test_put_path)."""
     from .config import ray_config
+    if prefaulted or size < int(ray_config.host_copy_gate_min_bytes):
+        from .netcomm import _NullGate
+        return _NullGate()
     thresh = float(ray_config.transfer_serialize_threshold_mb)
     if thresh > 0 and size >= thresh * (1 << 20):
         from .netcomm import _host_copy_gate
@@ -81,6 +93,193 @@ def _default_capacity() -> int:
                    * float(ray_config.object_store_memory_fraction))
     except OSError:
         return 2 << 30
+
+
+# ---------------------------------------------------------------------------
+# zero-copy put path (ISSUE 17): reserve -> write-in-place -> seal.
+# ---------------------------------------------------------------------------
+
+# Always-on op counter for the flag-off zero-work guard: with
+# store_zero_copy_put_enabled=false this must never move (the staging
+# path does not touch the in-place machinery at all).
+_inplace_puts = 0
+
+
+def inplace_put_ops() -> int:
+    """Process-wide count of puts that took the in-place (zero-copy)
+    write path."""
+    return _inplace_puts
+
+
+_nt_copy = None  # tri-state: None = unresolved, False = unavailable
+
+
+def _nt(dst: memoryview, src) -> bool:
+    """Native NT-store copy with graceful degradation (callers fall
+    back to a plain slice copy on False)."""
+    global _nt_copy
+    if _nt_copy is None:
+        try:
+            from .. import _native
+            _nt_copy = _native.nt_copy if _native.available() else False
+        except Exception:  # lint: broad-except-ok native build absent/broken: the pure-Python copy is always correct
+            _nt_copy = False
+    return _nt_copy(dst, src) if _nt_copy else False
+
+
+def copy_into(dst: memoryview, off: int, data) -> int:
+    """Copy one payload into `dst` at `off` with non-temporal stores
+    when the native primitive is available (a put destination is
+    written once and read much later from another process — caching
+    the lines is pure write-allocate waste below glibc's NT
+    threshold). Returns the bytes copied. Shared by the put path and
+    the transfer-plane chunk receiver."""
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    n = mv.nbytes
+    dst_slice = dst[off:off + n]
+    try:
+        if not _nt(dst_slice, mv):
+            dst_slice[:] = mv
+    finally:
+        dst_slice.release()
+    return n
+
+
+class _Reservation:
+    """One reserved file-store segment: the caller writes through
+    ``view()`` then calls exactly one of ``seal()`` / ``abort()``
+    (ref-discipline: reserve/seal helpers are registered conservation
+    obligations — devtools/lint/registry.py RESERVE_SEAL_METHODS)."""
+
+    __slots__ = ("_store", "object_id", "size", "_mm", "prefaulted")
+
+    def __init__(self, store, object_id: ObjectID, size: int, mm,
+                 prefaulted: bool):
+        self._store = store
+        self.object_id = object_id
+        self.size = size
+        self._mm = mm
+        # True => every page of the segment is already faulted (pool
+        # recycle): the write can skip HostCopyGate admission.
+        self.prefaulted = prefaulted
+
+    def view(self) -> memoryview:
+        return memoryview(self._mm)
+
+    def seal(self) -> None:
+        self._store.seal(self.object_id)
+
+    def abort(self) -> None:
+        self._store._abort_reserve(self.object_id)
+
+
+class _ArenaReservation:
+    """Arena-backend reservation: wraps the two-phase create view.
+    Arena slots may recycle already-faulted pages, but the shared
+    header gives no way to know — so arena writes keep today's gate
+    policy (prefaulted=False)."""
+
+    __slots__ = ("_store", "object_id", "size", "_view", "prefaulted")
+
+    def __init__(self, store, object_id: ObjectID, size: int, view):
+        self._store = store
+        self.object_id = object_id
+        self.size = size
+        self._view = view
+        self.prefaulted = False
+
+    def view(self) -> memoryview:
+        return self._view
+
+    def seal(self) -> None:
+        self._store.seal(self.object_id)
+
+    def abort(self) -> None:
+        self._store._abort_reserve(self.object_id)
+
+
+def put_in_place(store, object_id: ObjectID,
+                 sobj: serialization.SerializedObject) -> int:
+    """The zero-copy put shared by both backends: size the payload
+    (already done by the pickle-5 out-of-band pass in serialize()),
+    reserve the segment FIRST, write the header in place, then land
+    each out-of-band buffer at its final offset with exactly one
+    NT-store copy — no intermediate bytes object, no staging buffer,
+    and no gate ticket unless the write actually faults fresh pages.
+
+    The ``store:put`` span records where a slow put spent its time
+    (reserve vs copy vs seal) — the phases dict is captured by
+    reference, so the values recorded in the finally-block are the
+    final ones."""
+    size = sobj.total_size
+    phases: Dict[str, float] = {}
+    timed = tracing.enabled
+    cm = tracing.span("store:put", nbytes=size, phases=phases) \
+        if tracing.enabled else None
+    with cm if cm is not None else _null_cm():
+        t0 = time.perf_counter() if timed else 0.0
+        res = store.reserve(object_id, size)
+        t1 = time.perf_counter() if timed else 0.0
+        try:
+            with _put_gate(size, prefaulted=res.prefaulted):
+                if fault.enabled:
+                    fault.fire("store.put",
+                               object_id=object_id.hex(), size=size)
+                view = res.view()
+                try:
+                    for (off, _blen), b in zip(
+                            sobj.write_header_into(view), sobj.buffers):
+                        copy_into(view, off, b)
+                finally:
+                    view.release()
+        except BaseException:
+            res.abort()
+            raise
+        t2 = time.perf_counter() if timed else 0.0
+        res.seal()
+        if timed:
+            t3 = time.perf_counter()
+            phases["reserve_us"] = round((t1 - t0) * 1e6, 1)
+            phases["copy_us"] = round((t2 - t1) * 1e6, 1)
+            phases["seal_us"] = round((t3 - t2) * 1e6, 1)
+    global _inplace_puts
+    _inplace_puts += 1
+    if telemetry.enabled:
+        telemetry.record_put_bytes(size)
+    return size
+
+
+class _null_cm:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _PoolStripe:
+    """One stripe of the segment pool. Writers hash to a stripe by
+    thread id, so N concurrent put() threads claim recycled segments
+    from N disjoint free lists under N independent locks — the store
+    lock is never held across the claim's rename/open/mmap syscalls.
+    Stripe locks are LEAF locks: a thread holds at most one stripe
+    lock at a time (steal scans visit stripes sequentially), and the
+    only compound order is store._lock -> stripe (free() pooling),
+    never the reverse."""
+
+    __slots__ = ("lock", "cache", "bytes")
+
+    def __init__(self):
+        self.lock = lockdep.lock("object_store.pool_stripe")
+        # Entries [size, filename, mm_or_None]: mm is a kept-hot
+        # mapping (pages faulted AND page-table entries live) when the
+        # segment was freed with no exported views; None means the
+        # claimer re-opens/mmaps (pages still faulted in tmpfs — only
+        # the PTEs are rebuilt, which is minor-fault cheap).
+        self.cache: List[list] = []
+        self.bytes = 0
 
 
 class _Segment:
@@ -137,10 +336,17 @@ class ObjectStore:
         # kernel shmem page allocation per put (the arena backend gets
         # the same effect from its slab recycler). The dir is shared by
         # every process of the node; claims are atomic renames.
+        # Striped per-client reservation (ISSUE 17): the free list is
+        # split into store_put_stripes independent stripes so parallel
+        # writers never serialize on one pool lock.
         self._pool_dir = session_dir.rstrip("/") + "_pool"
-        self._pool_cache = []   # [(size, filename)] claimable candidates
-        self._pool_bytes = 0    # refreshed from the dir on rescans
+        self._stripes = tuple(
+            _PoolStripe()
+            for _ in range(max(1, int(ray_config.store_put_stripes))))
         self._pool_seq = 0
+        self._pool_hits = 0
+        self._pool_misses = 0
+        self._pool_reclaimed = 0
 
     # -- paths -------------------------------------------------------------
     def _path(self, object_id: ObjectID) -> str:
@@ -161,115 +367,193 @@ class ObjectStore:
     def _pool_limit(self) -> int:
         return int(float(ray_config.store_segment_pool_mb) * (1 << 20))
 
-    def _pool_put_locked(self, seg: _Segment) -> bool:
+    def _stripe(self) -> _PoolStripe:
+        return self._stripes[threading.get_ident() % len(self._stripes)]
+
+    @property
+    def _pool_bytes(self) -> int:
+        # Torn reads across stripes are fine: this feeds capacity
+        # heuristics, and each stripe's int is GIL-consistent.
+        return sum(st.bytes for st in self._stripes)
+
+    @property
+    def pool_reclaimed_bytes(self) -> int:
+        """Lifetime bytes reclaimed FROM the pool under capacity
+        pressure (exported as a node-tagged gauge, telemetry.py)."""
+        return self._pool_reclaimed
+
+    def _pool_put(self, seg: _Segment, mm=None) -> bool:
         """Move a freed segment's file into the pool instead of
-        unlinking it (caller holds _lock and has popped the segment).
-        False => the caller unlinks as before."""
+        unlinking it (the caller has popped the segment). `mm` is a
+        still-open mapping to keep hot — reused wholesale on an
+        exact-size claim so the next put of this shape pays zero
+        faults. False => the caller unlinks (and closes mm) as
+        before."""
         if seg.size < int(ray_config.store_segment_pool_min_bytes):
             return False
         limit = self._pool_limit()
         if limit <= 0 or self._pool_bytes + seg.size > limit:
             return False
-        self._pool_seq += 1
-        name = f"{seg.size}-{os.getpid()}-{self._pool_seq}"
+        with self._lock:
+            self._pool_seq += 1
+            seq = self._pool_seq
+        name = f"{seg.size}-{os.getpid()}-{seq}"
         try:
             os.makedirs(self._pool_dir, exist_ok=True)
             os.rename(seg.path, os.path.join(self._pool_dir, name))
         except OSError:
             return False
-        self._pool_bytes += seg.size
-        self._pool_cache.append((seg.size, name))
+        st = self._stripe()
+        with st.lock:
+            st.cache.append([seg.size, name, mm])
+            st.bytes += seg.size
         return True
 
-    def _rescan_pool_locked(self) -> bool:
-        """Refresh the claimable-file cache from the shared pool dir —
-        a sibling process (the owner freeing this worker's returns) may
-        have pooled files this instance never saw."""
+    def _rescan_pool(self) -> bool:
+        """Reconcile every stripe against the shared pool dir — a
+        sibling process (the owner freeing this worker's returns) may
+        have pooled files this instance never saw, or claimed files a
+        stripe still lists. Locks ONE stripe at a time (no compound
+        stripe-stripe hold)."""
         try:
             names = os.listdir(self._pool_dir)
         except OSError:
-            self._pool_cache = []
-            self._pool_bytes = 0
-            return False
-        cache = []
-        total = 0
-        for name in names:
+            names = []
+        nameset = set(names)
+        found = False
+        n = len(self._stripes)
+        for st in self._stripes:
+            with st.lock:
+                keep = []
+                total = 0
+                for ent in st.cache:
+                    if ent[1] in nameset:
+                        nameset.discard(ent[1])
+                        keep.append(ent)
+                        total += ent[0]
+                    elif ent[2] is not None:
+                        # Claimed out from under us by a sibling: the
+                        # inode now backs THEIR object. A kept mapping
+                        # has no exports (free() probed), so close
+                        # cannot raise.
+                        ent[2].close()
+                st.cache = keep
+                st.bytes = total
+                found = found or bool(keep)
+        for name in nameset:
             try:
                 sz = int(name.split("-", 1)[0])
             except ValueError:
                 continue
-            cache.append((sz, name))
-            total += sz
-        self._pool_cache = cache
-        self._pool_bytes = total
-        return bool(cache)
+            st = self._stripes[hash(name) % n]
+            with st.lock:
+                st.cache.append([sz, name, None])
+                st.bytes += sz
+            found = True
+        return found
 
-    def _pool_claim_locked(self, size: int, dst_path: str):
-        """Claim a pooled file of at least `size` bytes by renaming it
-        onto the new object's path (atomic — a lost cross-process race
-        is ENOENT and the next candidate is tried). Returns an open fd
-        truncated to exactly `size`, or None for a fresh create."""
-        if self._pool_limit() <= 0 \
-                or size < int(ray_config.store_segment_pool_min_bytes):
-            return None
-        for attempt in (0, 1):
+    def _claim_from_stripe(self, st: _PoolStripe, size: int,
+                           dst_path: str, want_mm: bool):
+        """Best-fit claim from one stripe: rename the pooled file onto
+        the new object's path (atomic — a lost cross-process race is
+        ENOENT and the next candidate is tried). Returns ("hot", mm)
+        for an exact-size kept-hot mapping (want_mm only), ("fd", fd)
+        with the fd truncated to `size`, or None."""
+        with st.lock:
             while True:
                 best = None
-                for ent in self._pool_cache:
+                for ent in st.cache:
                     if ent[0] >= size and (best is None
                                            or ent[0] < best[0]):
                         best = ent
                 if best is None:
-                    break
-                self._pool_cache.remove(best)
-                self._pool_bytes -= best[0]
-                src = os.path.join(self._pool_dir, best[1])
+                    return None
+                st.cache.remove(best)
+                st.bytes -= best[0]
+                bsize, name, mm = best
+                src = os.path.join(self._pool_dir, name)
                 try:
                     os.rename(src, dst_path)
                 except OSError:
+                    if mm is not None:
+                        mm.close()
                     continue  # lost the claim race; next candidate
+                if mm is not None:
+                    if want_mm and bsize == size:
+                        return ("hot", mm)
+                    mm.close()
                 try:
                     fd = os.open(dst_path, os.O_RDWR)
                     os.ftruncate(fd, size)
-                    return fd
+                    return ("fd", fd)
                 except OSError:
                     try:
                         os.unlink(dst_path)
                     except OSError:
                         pass
                     return None
-            if attempt == 0 and not self._rescan_pool_locked():
+
+    def _pool_claim(self, size: int, dst_path: str,
+                    want_mm: bool = False):
+        """Claim a pooled segment: own stripe first (the hot loop —
+        a put/free cycle on one thread stays on one free list), then
+        steal from the others, then rescan the shared dir once and
+        retry. Never holds two stripe locks at once."""
+        if self._pool_limit() <= 0 \
+                or size < int(ray_config.store_segment_pool_min_bytes):
+            return None
+        n = len(self._stripes)
+        me = threading.get_ident() % n
+        for attempt in (0, 1):
+            for i in range(n):
+                got = self._claim_from_stripe(
+                    self._stripes[(me + i) % n], size, dst_path, want_mm)
+                if got is not None:
+                    return got
+            if attempt == 0 and not self._rescan_pool():
                 return None
         return None
 
     def _drain_pool_locked(self, need_bytes: int) -> int:
         """Capacity pressure reclaims pooled bytes BEFORE touching live
-        objects — pool files are pure cache."""
-        self._rescan_pool_locked()
+        objects — pool files are pure cache. Caller holds _lock
+        (lock order _lock -> stripe)."""
+        self._rescan_pool()
         freed = 0
-        while self._pool_cache and freed < need_bytes:
-            sz, name = self._pool_cache.pop()
-            self._pool_bytes -= sz
-            try:
-                os.unlink(os.path.join(self._pool_dir, name))
-            except OSError:
-                continue
-            freed += sz
+        for st in self._stripes:
+            if freed >= need_bytes:
+                break
+            with st.lock:
+                while st.cache and freed < need_bytes:
+                    sz, name, mm = st.cache.pop()
+                    st.bytes -= sz
+                    if mm is not None:
+                        mm.close()
+                    try:
+                        os.unlink(os.path.join(self._pool_dir, name))
+                    except OSError:
+                        continue
+                    freed += sz
+        if freed:
+            self._pool_reclaimed += freed
         return freed
 
     # -- write path --------------------------------------------------------
-    def _reserve(self, object_id: ObjectID, size: int) -> int:
-        """Capacity-check (drain pool, evict graveyard, spill LRU),
-        create or pool-claim the shm file, and register an unsealed
-        segment. Returns the open fd; callers write then seal (or
-        _abort_reserve on failure). Remote spills needed to make room
+    def _admit(self, object_id: ObjectID, size: int) -> None:
+        """Capacity admission only: drain pool, evict graveyard, spill
+        LRU until `size` fits, then register the unsealed segment and
+        charge the accounting. This is the ONLY part of a reservation
+        that needs the store lock — the file create / pool claim /
+        mmap syscalls run outside it on a per-stripe lock, so N
+        writers admit in N short critical sections instead of
+        serializing their syscalls. Remote spills needed to make room
         are staged OUTSIDE the lock — a multi-second object-storage
         write must not freeze every concurrent store op — and their
         bookkeeping CASes back in before the capacity re-check."""
         staged = None
         orphans: list = []
         while True:
-            fd = None
+            admitted = False
             with self._lock:
                 if staged is not None:
                     self._commit_staged_spill_locked(staged, orphans)
@@ -293,34 +577,101 @@ class ObjectStore:
                                 f"used ({self._spilled_bytes} spilled)."
                             )
                 if staged is None:
-                    path = self._path(object_id)
-                    fd = self._pool_claim_locked(size, path)
-                    if fd is None:
-                        fd = os.open(
-                            path, os.O_CREAT | os.O_RDWR | os.O_EXCL,
-                            0o600)
                     # mm attaches lazily on first read (_open handles
                     # mm=None).
                     self._segments[object_id] = _Segment(
-                        path, None, size)  # type: ignore[arg-type]
+                        self._path(object_id), None,  # type: ignore[arg-type]
+                        size)
                     self._used += size
+                    admitted = True
             if orphans:
                 # Spill copies of objects freed mid-write: delete
                 # outside the lock (remote round trips).
                 for oid_hex in orphans:
                     self._spill.delete(oid_hex)
                 orphans = []
-            if fd is not None:
-                return fd
+            if admitted:
+                return
             self._write_staged_spill(staged)
+
+    def _reserve(self, object_id: ObjectID, size: int) -> int:
+        """Legacy (staging-path) reserve: admit, then pool-claim or
+        create the shm file. Returns the open fd; callers write then
+        seal (or _abort_reserve on failure)."""
+        self._admit(object_id, size)
+        try:
+            claimed = self._pool_claim(size, self._path(object_id))
+            if claimed is not None:
+                return claimed[1]
+            return os.open(self._path(object_id),
+                           os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        except BaseException:
+            self._abort_reserve(object_id)
+            raise
+
+    def reserve(self, object_id: ObjectID, size: int) -> _Reservation:
+        """Zero-copy put protocol, step 1 of 3 (reserve / write-in-
+        place via view() / seal-or-abort): admit under the store lock,
+        then claim a recycled segment from this thread's pool stripe —
+        hot (exact-size kept mapping: zero faults) or warm (re-mmap a
+        pooled file: minor faults only) — falling back to a fresh
+        create (major faults; the only case the HostCopyGate still
+        meters). Ref-discipline: the returned reservation carries a
+        seal-or-abort obligation (lint check_reserve_pairing)."""
+        self._admit(object_id, size)
+        hit = False
+        try:
+            mm = None
+            claimed = self._pool_claim(size, self._path(object_id),
+                                       want_mm=True)
+            if claimed is not None:
+                hit = True
+                kind, val = claimed
+                if kind == "hot":
+                    mm = val
+                else:
+                    try:
+                        mm = mmap.mmap(val, size)
+                    finally:
+                        os.close(val)
+            else:
+                fd = os.open(self._path(object_id),
+                             os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+                try:
+                    os.ftruncate(fd, size)
+                    mm = mmap.mmap(fd, size)
+                finally:
+                    os.close(fd)
+        except BaseException:
+            self._abort_reserve(object_id)
+            raise
+        with self._lock:
+            seg = self._segments.get(object_id)
+            if seg is not None:
+                seg.mm = mm
+            if hit:
+                self._pool_hits += 1
+            else:
+                self._pool_misses += 1
+        if telemetry.enabled:
+            telemetry.record_pool_claim(hit)
+        return _Reservation(self, object_id, size, mm, prefaulted=hit)
 
     def _abort_reserve(self, object_id: ObjectID):
         """Roll back a failed write: no partial file may remain, or a
-        reader would mmap truncated data as if sealed."""
+        reader would mmap truncated data as if sealed. Closes any
+        writer-side mapping the reservation attached (the failed
+        writer released its view before aborting, so exports are gone;
+        graveyard otherwise)."""
         with self._lock:
             seg = self._segments.pop(object_id, None)
             if seg is not None:
                 self._used -= seg.size
+                if seg.mm is not None:
+                    try:
+                        seg.mm.close()
+                    except BufferError:
+                        self._graveyard.append(seg.mm)
             try:
                 os.unlink(self._path(object_id))
             except OSError:
@@ -328,6 +679,8 @@ class ObjectStore:
 
     def create(self, object_id: ObjectID, size: int) -> memoryview:
         """Allocate a segment and return a writable view (then `seal`)."""
+        if bool(ray_config.store_zero_copy_put_enabled):
+            return self.reserve(object_id, size).view()
         fd = self._reserve(object_id, size)
         try:
             os.ftruncate(fd, size)
@@ -345,15 +698,19 @@ class ObjectStore:
 
     def put_serialized(self, object_id: ObjectID,
                        sobj: serialization.SerializedObject) -> int:
-        """Write path: plain write(2) into the shm file (no mmap — a
-        store-side mapping would fault a page per 4 KiB; see
-        SerializedObject.write_to_fd). Readers mmap lazily on first get.
-        Big writes go through the host copy gate: N multi-client puts
-        admitted concurrently up to the host's page-allocation
-        bandwidth instead of thrashing it (this path used to run
-        ungated — measured ~3x aggregate collapse at 4-way on a 1-core
-        box).
+        """Write path. Zero-copy (default): reserve the segment first,
+        write header + out-of-band buffers straight into the mapping —
+        one NT-store copy per buffer, no staging bytes (put_in_place).
+        Legacy (store_zero_copy_put_enabled=false): plain write(2)
+        into the shm file through write_to_fd's staging header.
+        Big fresh-page writes go through the host copy gate: N
+        multi-client puts admitted concurrently up to the host's
+        page-allocation bandwidth instead of thrashing it (this path
+        used to run ungated — measured ~3x aggregate collapse at 4-way
+        on a 1-core box).
         """
+        if bool(ray_config.store_zero_copy_put_enabled):
+            return put_in_place(self, object_id, sobj)
         size = sobj.total_size
         with _put_gate(size):
             fd = self._reserve(object_id, size)
@@ -536,6 +893,9 @@ class ObjectStore:
                     "spilled_count": self._spilled_count,
                     "restored_count": self._restored_count,
                     "pool_bytes": self._pool_bytes,
+                    "pool_hits": self._pool_hits,
+                    "pool_misses": self._pool_misses,
+                    "pool_reclaimed_bytes": self._pool_reclaimed,
                     "num_objects": len(self._segments)}
 
     # -- read path ---------------------------------------------------------
@@ -689,22 +1049,52 @@ class ObjectStore:
                 if seg.counted:
                     self._used -= seg.size
                 live_views = False
+                keep_mm = None
+                poolable = (seg.file_exists and seg.sealed
+                            and not seg.spilling)
                 if seg.mm is not None:
-                    try:
-                        seg.mm.close()
-                    except BufferError:
-                        # Live numpy views alias this mapping; the OS
-                        # keeps pages until the map closes. Retry on
-                        # future allocations.
-                        self._graveyard.append(seg.mm)
-                        live_views = True
+                    if poolable and bool(
+                            ray_config.store_zero_copy_put_enabled):
+                        # Keep-hot candidate: probe for live exported
+                        # views WITHOUT closing. mmap.resize refuses
+                        # to remap while buffer exports exist, and a
+                        # same-size resize is otherwise a no-op — so
+                        # BufferError here means exactly "views
+                        # alive". A mapping that survives the probe
+                        # goes back to the pool still open: the next
+                        # exact-size put reuses it with zero faults.
+                        try:
+                            seg.mm.resize(seg.size)
+                            keep_mm = seg.mm
+                        except BufferError:
+                            self._graveyard.append(seg.mm)
+                            live_views = True
+                        except (OSError, ValueError):
+                            # resize unsupported here (e.g. the map
+                            # outlived an ftruncate); fall back to the
+                            # plain close-or-graveyard protocol.
+                            try:
+                                seg.mm.close()
+                            except BufferError:
+                                self._graveyard.append(seg.mm)
+                                live_views = True
+                    else:
+                        try:
+                            seg.mm.close()
+                        except BufferError:
+                            # Live numpy views alias this mapping; the
+                            # OS keeps pages until the map closes.
+                            # Retry on future allocations.
+                            self._graveyard.append(seg.mm)
+                            live_views = True
                 # Pool the backing file instead of unlinking — UNLESS
                 # views still alias the mapping (a re-claimed inode
                 # would rewrite the pages under them: corruption, not
                 # just a stale read) or a staged spill is mid-read.
-                if seg.file_exists and seg.sealed and not live_views \
-                        and not seg.spilling:
-                    pooled = self._pool_put_locked(seg)
+                if poolable and not live_views:
+                    pooled = self._pool_put(seg, keep_mm)
+                if not pooled and keep_mm is not None:
+                    keep_mm.close()  # export probe passed: cannot raise
                 seg.file_exists = False
             if not pooled:
                 try:
@@ -750,6 +1140,15 @@ class ObjectStore:
             for oid in list(self._segments):
                 self.free(oid)
             self._collect_graveyard()
+            # Kept-hot pool mappings hold the tmpfs inodes alive past
+            # the rmtree below; drop them first.
+            for st in self._stripes:
+                with st.lock:
+                    for ent in st.cache:
+                        if ent[2] is not None:
+                            ent[2].close()
+                    st.cache = []
+                    st.bytes = 0
             # Files written by workers that never reported back (crashes)
             # are not in _segments; sweep the whole session dir.
             shutil.rmtree(self._dir, ignore_errors=True)
@@ -1097,6 +1496,15 @@ class ArenaObjectStore:
     def seal(self, object_id: ObjectID):
         self._store.seal(object_id)
 
+    def reserve(self, object_id: ObjectID, size: int) -> _ArenaReservation:
+        """Zero-copy put protocol over the arena: wraps the two-phase
+        create view so put_in_place drives both backends through one
+        reserve/seal contract. Ref-discipline: seal-or-abort
+        obligation, same as the file backend (lint
+        check_reserve_pairing)."""
+        return _ArenaReservation(
+            self, object_id, size, self.create(object_id, size))
+
     def _abort_reserve(self, object_id: ObjectID):
         with self._lock:
             self._meta.pop(object_id, None)
@@ -1109,6 +1517,9 @@ class ArenaObjectStore:
 
     def put_serialized(self, object_id: ObjectID,
                        sobj: serialization.SerializedObject) -> int:
+        if bool(ray_config.store_zero_copy_put_enabled):
+            # creator pin retained: owner-driven free()/spill reclaims
+            return put_in_place(self, object_id, sobj)
         size = sobj.total_size
         with _put_gate(size):
             view = self.create(object_id, size)

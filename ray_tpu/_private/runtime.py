@@ -360,6 +360,12 @@ class Node:
     # object plane (owner side)
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectID:
+        """Owner-side put. serialize() is a sizing pass (pickle-5
+        out-of-band: buffers are collected as views, not copied);
+        above the inline threshold the store reserves a segment of
+        total_size and lands each buffer in place — the value's bytes
+        are copied exactly once, serialize-to-shm (object_store
+        put_in_place)."""
         oid = ObjectID.from_random()
         sobj = serialization.serialize(value)
         if sobj.total_size <= inline_threshold():

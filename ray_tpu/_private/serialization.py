@@ -67,6 +67,14 @@ def _to_host(obj: Any) -> Any:
     Only consult jax if it is ALREADY imported: a value cannot be a jax
     array otherwise, and `import jax` costs ~2 s — it was the entire
     first-call latency of fresh actors (workers boot lean without jax).
+
+    Adopt-native landing (ISSUE 17 tentpole 3): when the array is
+    already backed by host-addressable memory (CPU backend, or a
+    committed host transfer), DLPack gives a numpy view ALIASING the
+    device buffer — the put path's single NT copy then moves those
+    bytes straight into the reserved segment with no intermediate host
+    bounce (``np.asarray`` may materialize a copy first; ``from_dlpack``
+    is zero-copy or an error).
     """
     import sys
     jax = sys.modules.get("jax")
@@ -74,7 +82,12 @@ def _to_host(obj: Any) -> Any:
         try:
             import numpy as np
             if isinstance(obj, jax.Array):
-                return np.asarray(obj)
+                try:
+                    return np.from_dlpack(obj)
+                except Exception:
+                    # Device-resident / sharded / exotic layout: the
+                    # classic host transfer is the only correct move.
+                    return np.asarray(obj)
         except Exception:
             pass
     return obj
@@ -97,28 +110,46 @@ class SerializedObject:
             size += _align(len(b))
         return size
 
-    def write_into(self, dst: memoryview) -> int:
-        """Write the wire format into `dst`; returns bytes written."""
-        meta = self.meta
+    def layout(self) -> List[Tuple[int, int]]:
+        """Final (offset, length) of each out-of-band buffer inside the
+        wire format — the size-then-write-in-place contract: a caller
+        reserves ``total_size`` bytes first, writes the header with
+        ``write_header_into``, then lands each buffer at its offset
+        with exactly one copy."""
         nbuf = len(self.buffers)
-        header = 16 + 16 * nbuf
-        # Buffer payloads start after the aligned header+meta region.
-        offset = _align(header + len(meta))
+        offset = _align(16 + 16 * nbuf + len(self.meta))
         offsets: List[Tuple[int, int]] = []
         for b in self.buffers:
             blen = len(b)
             offsets.append((offset, blen))
             offset += _align(blen)
+        return offsets
+
+    def write_header_into(self, dst: memoryview) -> List[Tuple[int, int]]:
+        """Write the header + meta region in place and return the
+        buffer layout; the caller copies each buffer to its offset
+        (object_store uses the native NT-store copy there). No
+        intermediate ``bytes`` object is built anywhere on this path."""
+        meta = self.meta
+        offsets = self.layout()
         pos = 0
         dst[pos:pos + 8] = _U64.pack(len(meta)); pos += 8
-        dst[pos:pos + 8] = _U64.pack(nbuf); pos += 8
+        dst[pos:pos + 8] = _U64.pack(len(self.buffers)); pos += 8
         for off, blen in offsets:
             dst[pos:pos + 8] = _U64.pack(off); pos += 8
             dst[pos:pos + 8] = _U64.pack(blen); pos += 8
         dst[pos:pos + len(meta)] = meta
+        return offsets
+
+    def write_into(self, dst: memoryview) -> int:
+        """Write the wire format into `dst`; returns bytes written."""
+        offsets = self.write_header_into(dst)
         for (off, blen), b in zip(offsets, self.buffers):
-            dst[off:off + blen] = b if isinstance(b, (bytes, bytearray, memoryview)) else memoryview(b)
-        return offset
+            dst[off:off + blen] = b if isinstance(
+                b, (bytes, bytearray, memoryview)) else memoryview(b)
+        if offsets:
+            return offsets[-1][0] + _align(offsets[-1][1])
+        return _align(16 + len(self.meta))
 
     def write_to_fd(self, fd: int) -> int:
         """Stream the wire format to a file descriptor with plain
@@ -175,9 +206,63 @@ class SerializedObject:
 # for None, a visible slice of per-call cost on nop-shaped workloads.
 _FAST_TYPES = (type(None), bool, int, float, str, bytes)
 
+# Already-serialized payloads (serve body staging, transfer-plane
+# writes, user-level framing) at or above this size skip pickle
+# entirely: the meta pickles a PickleBuffer marker and the payload view
+# itself rides OUT-OF-BAND, so the store's in-place put writes the
+# caller's bytes straight into the reserved segment — one copy, no
+# pickled duplicate of the payload. Below it, embedding in the meta is
+# cheaper than a second wire-format buffer slot.
+_RAW_OOB_MIN = 4096
+
+
+class _RawView:
+    """Reduction shim: pickles as ``ctor(<out-of-band buffer>)`` so the
+    payload bytes ride out-of-band (written once, straight into the
+    reserved segment) while deserialization still hands back the
+    caller's type — bytes for read-only payloads, bytearray for
+    writable ones (an out-of-band PickleBuffer would otherwise load as
+    the raw store view)."""
+
+    __slots__ = ("obj", "ctor")
+
+    def __init__(self, obj, ctor):
+        self.obj = obj
+        self.ctor = ctor
+
+    def __reduce_ex__(self, protocol):
+        return (self.ctor, (pickle.PickleBuffer(self.obj),))
+
+
+def _serialize_raw(obj) -> SerializedObject:
+    """bytes/bytearray/memoryview as a single out-of-band buffer: the
+    meta pickles only the type reconstructor; the payload view never
+    passes through pickle."""
+    buffers: List[memoryview] = []
+
+    def _cb(pb: pickle.PickleBuffer):
+        buffers.append(pb.raw())
+        return False  # out-of-band
+
+    writable = isinstance(obj, bytearray) or (
+        isinstance(obj, memoryview) and not obj.readonly)
+    meta = pickle.dumps(
+        _RawView(obj, bytearray if writable else bytes),
+        protocol=5, buffer_callback=_cb)
+    return SerializedObject(meta, buffers)
+
 
 def serialize(obj: Any) -> SerializedObject:
-    if obj is None or type(obj) in _FAST_TYPES:
+    t = type(obj)
+    if t in (bytes, bytearray, memoryview):
+        try:
+            if memoryview(obj).nbytes >= _RAW_OOB_MIN:
+                from .config import ray_config
+                if bool(ray_config.store_zero_copy_put_enabled):
+                    return _serialize_raw(obj)
+        except (TypeError, ValueError, BufferError):
+            pass  # non-contiguous view: the generic path handles it
+    if obj is None or t in _FAST_TYPES:
         return SerializedObject(pickle.dumps(obj, protocol=5), [])
     buffers: List[memoryview] = []
 

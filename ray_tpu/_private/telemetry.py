@@ -197,6 +197,34 @@ def record_get_bytes(n: int) -> None:
                 "Bytes read from the local object store").inc(n)
 
 
+def record_pool_claim(hit: bool) -> None:
+    """Segment-pool observability (zero-copy put path): did a reserve
+    land on a recycled, already-faulted segment (hit) or pay a fresh
+    create (miss)? A falling hit rate under a steady put workload means
+    the pool limit or stripe count is mis-tuned (docs/PERF.md, "Layer:
+    put path")."""
+    global _ops
+    _ops += 1
+    name = ("store_pool_hits_total" if hit
+            else "store_pool_misses_total")
+    desc = ("Reserves served from the segment pool (pre-faulted pages)"
+            if hit else
+            "Reserves that created a fresh segment (pool empty/miss)")
+    _metric(name, "counter", desc).inc()
+
+
+def record_pool_reclaimed(node_id_hex: str, nbytes: int) -> None:
+    """Node-tagged gauge of pooled bytes reclaimed under capacity
+    pressure since store creation — sustained growth means the pool is
+    fighting the capacity budget instead of caching it."""
+    global _ops
+    _ops += 1
+    _metric("store_pool_reclaimed_bytes", "gauge",
+            "Pooled segment bytes drained for capacity on this node",
+            tag_keys=("node_id",)).set(
+                nbytes, tags={"node_id": node_id_hex[:16]})
+
+
 def record_pull_retry() -> None:
     global _ops
     _ops += 1
@@ -727,6 +755,9 @@ def _refresh_head_gauges(node) -> None:
             int(getattr(node.store, "used_bytes", 0) or 0),
             len(node.pool.workers),
             len(getattr(node.scheduler, "_free_chips", ())))
+        record_pool_reclaimed(
+            node.node_id.hex(),
+            int(getattr(node.store, "pool_reclaimed_bytes", 0)))
     except Exception:  # lint: broad-except-ok scrape-time gauge on a live runtime mid-teardown; exposition must not 500
         logger.debug("node-stats gauge refresh failed", exc_info=True)
     try:

@@ -404,6 +404,14 @@ class WorkerClient:
                                     "inline": sobj.to_bytes(),
                                     "nested": list(nested)})
         else:
+            # Client-side reserve-write-seal: put_serialized reserves
+            # the segment from this thread's pool stripe and lands the
+            # collected out-of-band views in place — the only copy of
+            # the value's payload bytes on this whole path (the
+            # serialize() above only gathered views). jax/device
+            # outputs took the dlpack adopt-native landing inside
+            # serialize (serialization._to_host), so there is no host
+            # bounce buffer either.
             size = self._worker.store.put_serialized(oid, sobj)
             self._worker.send_lazy(P.OWNED_PUT,
                                    {"object_id": oid, "size": size,

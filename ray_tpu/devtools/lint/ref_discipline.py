@@ -43,6 +43,7 @@ PASS = "ref-discipline"
 PARK_RULE = "ref-park"
 ELISION_RULE = "ref-elision"
 FIELD_RULE = "ref-field"
+RESERVE_RULE = "reserve-seal"
 
 
 # ---------------------------------------------------------------------------
@@ -395,10 +396,67 @@ def check_payload_conservation(tree: LintTree) -> List[Violation]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# check 5: reservations lexically paired with a settle (seal/abort)
+# ---------------------------------------------------------------------------
+def check_reserve_pairing(tree: LintTree) -> List[Violation]:
+    """Every function that opens a store reservation
+    (``reserve``/``_reserve`` call) must lexically settle it — a
+    ``seal``/``abort``/``_abort_reserve`` call on every path is the
+    contract, and a lexical settle is the statically checkable proxy
+    (the same shape check_park_pairing uses for drain barriers).
+    Streamed protocols that settle on a later message declare the
+    terminal in registry.RESERVE_DEFERRED."""
+    out: List[Violation] = []
+    deferred_seen: Set[Tuple[str, str]] = set()
+    for rel in registry.RESERVE_FILES:
+        sf = tree.get(rel)
+        if sf is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in registry.RESERVE_CALL_NAMES \
+                    or node.name in registry.RESERVE_SETTLE_NAMES:
+                continue  # the implementations themselves
+            calls = _function_calls(node, set(registry.RESERVE_CALL_NAMES))
+            if not calls:
+                continue
+            qual = sf.scope_of(node)
+            if (rel, qual) in registry.RESERVE_DEFERRED:
+                deferred_seen.add((rel, qual))
+                continue
+            if _function_calls(node, set(registry.RESERVE_SETTLE_NAMES)):
+                continue  # lexically paired
+            for call in calls:
+                if sf.suppressed(RESERVE_RULE, call.lineno):
+                    continue
+                out.append(Violation(
+                    PASS, rel, call.lineno,
+                    f"reservation opened in {qual} with no lexical "
+                    f"seal/abort — an unsettled reservation is charged-"
+                    f"but-unreadable capacity and a truncation hazard "
+                    f"for readers; settle it, add a reasoned "
+                    f"registry.RESERVE_DEFERRED entry, or annotate "
+                    f"`# lint: {RESERVE_RULE}-ok <reason>`",
+                    scope=qual, key=f"unsettled-reserve:{qual}"))
+    for rel, qual in sorted(registry.RESERVE_DEFERRED):
+        if tree.get(rel) is None:
+            continue
+        if (rel, qual) not in deferred_seen:
+            out.append(Violation(
+                PASS, rel, 1,
+                f"registry.RESERVE_DEFERRED names {qual} which no longer "
+                f"opens a reservation in {rel} (registry rot)",
+                scope="<module>", key=f"stale-reserve-deferred:{qual}"))
+    return out
+
+
 def run(tree: LintTree) -> List[Violation]:
     out: List[Violation] = []
     out.extend(check_mutation_inventory(tree))
     out.extend(check_park_pairing(tree))
     out.extend(check_elision_guards(tree))
     out.extend(check_payload_conservation(tree))
+    out.extend(check_reserve_pairing(tree))
     return out

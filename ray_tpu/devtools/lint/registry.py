@@ -294,6 +294,28 @@ REF_PARK_DEFERRED = {
         "residuals and flushes in the same critical section",
 }
 
+# Reserve/seal discipline (zero-copy put path): a reservation returned
+# by a store ``reserve()``/``_reserve()`` call is an open write — until
+# settled by seal (object becomes immutable/readable) or abort
+# (segment popped, partial file unlinked), the store carries charged-
+# but-unreadable capacity and readers can mmap truncated bytes as if
+# sealed. Any function in RESERVE_FILES that calls a reserve must
+# lexically call a settle, name its deferred settle in
+# RESERVE_DEFERRED (streamed protocols settle on a later message), or
+# annotate `# lint: reserve-seal-ok <reason>`. Defs NAMED like a
+# reserve/settle are the implementations and are exempt.
+RESERVE_FILES = ("_private/object_store.py", "_private/direct.py",
+                 "_private/worker_proc.py", "_private/runtime.py")
+RESERVE_CALL_NAMES = frozenset({"reserve", "_reserve"})
+RESERVE_SETTLE_NAMES = frozenset({"seal", "abort", "_abort_reserve"})
+# (file, qualname) -> reason the settle lives elsewhere.
+RESERVE_DEFERRED = {
+    ("_private/direct.py", "DirectPlane._on_obj_chunk"):
+        "streamed pull: the reservation settles at the stream terminal "
+        "(_on_obj_eof seals a complete byte count; _abort_pull_state "
+        "aborts on failure/fallback)",
+}
+
 # Escape-marked state: ids referenced by a head-bound message while
 # still locally owned. Any elision (a `continue`-only guard skipping an
 # accounting entry) inside REF_ELISION_FUNCS must reference this state

@@ -30,6 +30,15 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_serve_direct.py -q \
     -m perf_smoke \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== zero-copy put path (striped reservation, lockdep+refdebug) =="
+# The full put-path suite: 8-thread striped writer storm, seeded
+# store.put fault rollback, flag-off zero-work and gate-bypass
+# counters — the conftest guards run it under lockdep AND refdebug,
+# so an ABBA cycle between the store lock and a pool stripe fails
+# here, not in production.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_put_path.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== perf_smoke + lint-marked tests =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'perf_smoke or lint' \

@@ -59,7 +59,7 @@ def pytest_configure(config):
 # attributable to the test that produced it.
 _LOCKDEP_SUITES = {"test_transport_framing", "test_fault_injection",
                    "test_direct_calls", "test_cross_plane_ordering",
-                   "test_serve_direct"}
+                   "test_serve_direct", "test_put_path"}
 
 
 @pytest.fixture(autouse=True)
@@ -113,7 +113,8 @@ def _lockdep_guard(request, tmp_path_factory):
 # (these suites all build per-test clusters).
 _REFDEBUG_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
                     "test_fault_injection", "test_drain",
-                    "test_serve_direct", "test_transfer"}
+                    "test_serve_direct", "test_transfer",
+                    "test_put_path"}
 
 
 @pytest.fixture(autouse=True)

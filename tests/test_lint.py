@@ -450,6 +450,16 @@ _REF_DIRECT = """\
 
         def _on_gen_items(self, p):
             return (p["o"], p.get("i"))
+
+        def _on_obj_chunk(self, chan, payload):
+            st = self._pulls[payload["r"]]
+            if st["view"] is None:
+                st["res"] = self.store.reserve(st["oid"], payload["t"])
+                st["view"] = st["res"].view()
+
+        def _on_obj_eof(self, chan, payload):
+            st = self._pulls[payload["r"]]
+            st["res"].seal()
 """
 
 
@@ -529,6 +539,54 @@ def test_ref_discipline_registry_rot(tmp_path):
     root = _tree(tmp_path, {"_private/direct.py": src})
     keys = {v.key for v in _run(root, ["ref-discipline"])}
     assert keys == {"stale-mutation-helper:DirectPlane.ref_delta"}
+
+
+def test_reserve_pairing_unsettled(tmp_path):
+    """A reservation opened with no lexical seal/abort (and no
+    deferred-settle registry entry) is flagged; an annotated one is
+    not."""
+    src = _REF_DIRECT + """\
+
+        def leaky_put(self, oid, size):
+            res = self.store.reserve(oid, size)
+            return res.view()
+
+        def annotated_put(self, oid, size):
+            res = self.store.reserve(oid, size)  # lint: reserve-seal-ok settled by the caller's with-block helper
+            return res
+    """
+    root = _tree(tmp_path, {"_private/direct.py": src})
+    vs = _run(root, ["ref-discipline"])
+    assert [(v.scope, v.key) for v in vs] == [
+        ("DirectPlane.leaky_put", "unsettled-reserve:DirectPlane.leaky_put")]
+
+
+def test_reserve_pairing_lexical_settle_clean(tmp_path):
+    src = _REF_DIRECT + """\
+
+        def tidy_put(self, oid, size):
+            res = self.store.reserve(oid, size)
+            try:
+                res.view()[0:1] = b"x"
+            except BaseException:
+                res.abort()
+                raise
+            res.seal()
+    """
+    root = _tree(tmp_path, {"_private/direct.py": src})
+    assert _run(root, ["ref-discipline"]) == []
+
+
+def test_reserve_pairing_deferred_registry_rot(tmp_path):
+    """Renaming the registered deferred-settle function rots the
+    registry AND orphans the (now-undeclared) reserve call."""
+    src = _REF_DIRECT.replace("def _on_obj_chunk", "def _renamed_chunk")
+    assert src != _REF_DIRECT
+    root = _tree(tmp_path, {"_private/direct.py": src})
+    keys = {v.key for v in _run(root, ["ref-discipline"])}
+    assert keys == {
+        "stale-reserve-deferred:DirectPlane._on_obj_chunk",
+        "unsettled-reserve:DirectPlane._renamed_chunk"}
 
 
 def test_ref_discipline_payload_conservation(tmp_path):

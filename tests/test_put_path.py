@@ -1,0 +1,336 @@
+"""Zero-copy put path: reserve -> write-in-place -> seal.
+
+Covers the striped per-client reservation protocol (concurrent
+writers, byte-exact readback), the seeded store.put fault contract
+(a failed mid-write put frees its reservation and the id is cleanly
+retryable), the flag-off zero-work guard (store_zero_copy_put_enabled
+=false must take the EXACT legacy staging path), the small-put gate
+bypass (puts under host_copy_gate_min_bytes acquire zero HostCopyGate
+tickets — counter-proven, perf_smoke style), the raw-bytes fast path
+(bytes/bytearray/memoryview skip pickle and keep their type), and the
+segment-pool recycle counters.
+
+Runs under BOTH conftest guards (lockdep + refdebug): the 8-thread
+writer storm exercises the store lock against the per-stripe pool
+locks, and must come out with zero potential-ABBA cycles.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import fault
+from ray_tpu._private import netcomm
+from ray_tpu._private import object_store
+from ray_tpu._private import serialization
+from ray_tpu._private.config import ray_config
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import ObjectStore
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ObjectStore(str(tmp_path / "shm"), capacity=1 << 30)
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture
+def zero_copy_on():
+    prev = bool(ray_config.store_zero_copy_put_enabled)
+    ray_config.set("store_zero_copy_put_enabled", True)
+    yield
+    ray_config.set("store_zero_copy_put_enabled", prev)
+
+
+class TestStripedConcurrentWriters:
+    def test_eight_threads_interleaved_sizes_byte_exact(
+            self, store, zero_copy_on):
+        """8 writer threads x interleaved sizes (spanning the pool-min
+        and gate-min thresholds) put/read/free in a loop; every value
+        must read back byte-exact. This is the striped-reservation
+        storm: stripe claims, pool recycling, hot mappings, and the
+        store lock all interleave."""
+        sizes = [4 << 10, 64 << 10, 300 << 10, 1 << 20, 2 << 20]
+        errors = []
+
+        def writer(tid):
+            try:
+                for i in range(12):
+                    n = sizes[(tid + i) % len(sizes)]
+                    payload = bytes([((tid << 4) | (i & 0xF)) & 0xFF]) * n
+                    oid = ObjectID.from_random()
+                    store.put_serialized(
+                        oid, serialization.serialize(payload))
+                    out = store.get(oid)
+                    if out != payload:
+                        errors.append(
+                            f"thread {tid} iter {i}: {n}-byte payload "
+                            f"corrupted (got {len(out)} bytes, "
+                            f"first={out[:8]!r})")
+                    store.free(oid)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(f"thread {tid}: {e!r}")
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        st = store.stats()
+        assert st["used_bytes"] == 0
+        # The hot loop recycles: with 8 threads re-putting the same
+        # five sizes, the pool must have served a healthy share.
+        assert st["pool_hits"] > 0
+
+    def test_interleaved_numpy_and_raw_round_trip(
+            self, store, zero_copy_on):
+        arr = np.arange(1 << 16, dtype=np.int64)
+        raw = bytearray(os.urandom(1 << 18))
+        for payload in (arr, raw, memoryview(bytes(raw))):
+            oid = ObjectID.from_random()
+            store.put_serialized(oid, serialization.serialize(payload))
+            out = store.get(oid)
+            if isinstance(payload, np.ndarray):
+                assert np.array_equal(out, payload)
+            else:
+                assert bytes(out) == bytes(payload)
+            store.free(oid)
+
+
+class TestPutFaultInjection:
+    def test_failed_put_frees_reservation_and_retries(
+            self, store, zero_copy_on):
+        """Seeded store.put fault at the first firing: the put raises,
+        the reservation is rolled back (zero used bytes, no partial
+        file), and retrying the SAME id succeeds."""
+        fault.configure(
+            {"seed": 7,
+             "rules": [{"site": "store.put", "action": "raise",
+                        "at": [0], "exc": "OSError"}]},
+            propagate_env=False)
+        try:
+            oid = ObjectID.from_random()
+            payload = b"\xbe" * (1 << 20)
+            with pytest.raises(OSError):
+                store.put_serialized(
+                    oid, serialization.serialize(payload))
+            st = store.stats()
+            assert st["used_bytes"] == 0, \
+                "failed put leaked reservation accounting"
+            assert st["num_objects"] == 0
+            assert not os.path.exists(store._path(oid)), \
+                "failed put left a partial file (truncation hazard)"
+            # Retry of the same id (the fault schedule only fires at
+            # seq 0) lands cleanly.
+            store.put_serialized(oid, serialization.serialize(payload))
+            assert store.get(oid) == payload
+            store.free(oid)
+        finally:
+            fault.configure(None, propagate_env=False)
+
+    def test_fault_free_when_disabled(self, store, zero_copy_on):
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, serialization.serialize(b"x" * 8192))
+        assert store.get(oid) == b"x" * 8192
+        store.free(oid)
+
+
+@pytest.mark.perf_smoke
+class TestFlagOffZeroWork:
+    def test_flag_off_takes_exact_legacy_path(self, store):
+        """With the flag off, the in-place machinery must do ZERO work
+        (inplace_put_ops must not move) and round trips still hold —
+        the legacy write(2) staging path is byte-compatible."""
+        prev = bool(ray_config.store_zero_copy_put_enabled)
+        ray_config.set("store_zero_copy_put_enabled", False)
+        try:
+            before = object_store.inplace_put_ops()
+            arr = np.arange(50000, dtype=np.float64)
+            oid = ObjectID.from_random()
+            store.put_serialized(oid, serialization.serialize(arr))
+            assert np.array_equal(store.get(oid), arr)
+            store.free(oid)
+            assert object_store.inplace_put_ops() == before, \
+                "flag-off put touched the in-place path"
+        finally:
+            ray_config.set("store_zero_copy_put_enabled", prev)
+
+    def test_flag_on_counts_inplace_ops(self, store, zero_copy_on):
+        before = object_store.inplace_put_ops()
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, serialization.serialize(b"y" * 8192))
+        assert object_store.inplace_put_ops() == before + 1
+        store.free(oid)
+
+
+@pytest.mark.perf_smoke
+class TestSmallPutGateBypass:
+    def test_small_put_acquires_zero_gate_tickets(
+            self, store, zero_copy_on):
+        """perf_smoke-style counter guard: drop the gate's size
+        threshold so a 64 KiB put WOULD be gated, and prove the
+        host_copy_gate_min_bytes floor bypasses ticket acquisition
+        entirely (netcomm.gate_ops() must not move)."""
+        prev_thresh = float(ray_config.transfer_serialize_threshold_mb)
+        prev_min = int(ray_config.host_copy_gate_min_bytes)
+        ray_config.set("transfer_serialize_threshold_mb", 0.001)  # 1 KiB
+        ray_config.set("host_copy_gate_min_bytes", 256 << 10)
+        try:
+            before = netcomm.gate_ops()
+            oid = ObjectID.from_random()
+            store.put_serialized(
+                oid, serialization.serialize(b"g" * (64 << 10)))
+            assert netcomm.gate_ops() == before, \
+                "small put below host_copy_gate_min_bytes took a " \
+                "HostCopyGate ticket"
+            store.free(oid)
+        finally:
+            ray_config.set("transfer_serialize_threshold_mb", prev_thresh)
+            ray_config.set("host_copy_gate_min_bytes", prev_min)
+
+    def test_big_fresh_put_still_gated(self, store, zero_copy_on):
+        """The floor must NOT disable the gate for genuinely large
+        fresh-page writes (above both thresholds, nothing pooled)."""
+        prev_thresh = float(ray_config.transfer_serialize_threshold_mb)
+        ray_config.set("transfer_serialize_threshold_mb", 0.5)
+        try:
+            before = netcomm.gate_ops()
+            oid = ObjectID.from_random()
+            store.put_serialized(
+                oid, serialization.serialize(b"G" * (1 << 20)))
+            assert netcomm.gate_ops() == before + 1
+            store.free(oid)
+        finally:
+            ray_config.set("transfer_serialize_threshold_mb", prev_thresh)
+
+    def test_prefaulted_pool_claim_bypasses_gate(
+            self, store, zero_copy_on):
+        """A put landing in a pool-recycled (pre-faulted) segment
+        skips the gate whatever its size: it allocates no fresh
+        pages, which is the only thing the gate meters."""
+        prev_thresh = float(ray_config.transfer_serialize_threshold_mb)
+        ray_config.set("transfer_serialize_threshold_mb", 0.5)
+        payload = b"p" * (2 << 20)
+        try:
+            oid = ObjectID.from_random()
+            store.put_serialized(oid, serialization.serialize(payload))
+            store.free(oid)  # -> pool
+            before = netcomm.gate_ops()
+            oid2 = ObjectID.from_random()
+            store.put_serialized(oid2, serialization.serialize(payload))
+            assert store.stats()["pool_hits"] >= 1
+            assert netcomm.gate_ops() == before, \
+                "pool-recycled put took a gate ticket"
+            store.free(oid2)
+        finally:
+            ray_config.set("transfer_serialize_threshold_mb", prev_thresh)
+
+
+class TestRawBytesFastPath:
+    def test_types_preserved_and_payload_out_of_band(self, zero_copy_on):
+        """bytes/bytearray/memoryview above the raw threshold skip
+        pickle: the meta holds only the reconstructor, the payload
+        rides as ONE out-of-band buffer, and deserialization hands
+        back the caller's type."""
+        for payload, want_type in (
+                (b"b" * 8192, bytes),
+                (bytearray(b"a" * 8192), bytearray),
+                (memoryview(b"m" * 8192), bytes),
+                (memoryview(bytearray(b"w" * 8192)), bytearray)):
+            sobj = serialization.serialize(payload)
+            assert len(sobj.buffers) == 1, \
+                f"{type(payload).__name__} payload not out-of-band"
+            assert sobj.buffers[0].nbytes == 8192
+            out = serialization.deserialize(
+                memoryview(sobj.to_bytes()))
+            assert type(out) is want_type
+            assert bytes(out) == bytes(payload)
+
+    def test_small_bytes_stay_inline(self, zero_copy_on):
+        sobj = serialization.serialize(b"tiny")
+        assert len(sobj.buffers) == 0
+
+    def test_flag_off_raw_path_disabled(self):
+        prev = bool(ray_config.store_zero_copy_put_enabled)
+        ray_config.set("store_zero_copy_put_enabled", False)
+        try:
+            sobj = serialization.serialize(b"b" * 8192)
+            assert len(sobj.buffers) == 0, \
+                "flag-off serialize took the raw out-of-band path"
+        finally:
+            ray_config.set("store_zero_copy_put_enabled", prev)
+
+
+class TestReservationProtocol:
+    def test_reserve_seal_read_back(self, store, zero_copy_on):
+        oid = ObjectID.from_random()
+        res = store.reserve(oid, 4096)
+        view = res.view()
+        view[:5] = b"hello"
+        view.release()
+        res.seal()
+        raw = store.get_raw(oid)
+        assert bytes(raw[:5]) == b"hello"
+        raw.release()
+        store.free(oid)
+
+    def test_abort_rolls_back_accounting_and_file(
+            self, store, zero_copy_on):
+        oid = ObjectID.from_random()
+        res = store.reserve(oid, 1 << 20)
+        assert store.stats()["used_bytes"] == 1 << 20
+        res.abort()
+        st = store.stats()
+        assert st["used_bytes"] == 0
+        assert st["num_objects"] == 0
+        assert not os.path.exists(store._path(oid))
+
+    def test_pool_counters_and_reclaimed_gauge(self, tmp_path):
+        """Capacity pressure drains pooled segments and the reclaimed
+        bytes surface on the node-tagged gauge attribute the daemon /
+        head heartbeats export."""
+        s = ObjectStore(str(tmp_path / "shm2"), capacity=3 << 20)
+        prev = bool(ray_config.store_zero_copy_put_enabled)
+        ray_config.set("store_zero_copy_put_enabled", True)
+        try:
+            payload = b"r" * (2 << 20)
+            oid = ObjectID.from_random()
+            s.put_serialized(oid, serialization.serialize(payload))
+            s.free(oid)  # -> pool (2 MiB pooled, capacity 3 MiB)
+            assert s.stats()["pool_bytes"] > 0
+            # A second 2 MiB put cannot fit alongside the pooled bytes:
+            # the pool drains first.
+            oid2 = ObjectID.from_random()
+            s.put_serialized(oid2, serialization.serialize(payload))
+            assert s.pool_reclaimed_bytes > 0
+            assert s.stats()["pool_reclaimed_bytes"] > 0
+            s.free(oid2)
+        finally:
+            ray_config.set("store_zero_copy_put_enabled", prev)
+            s.shutdown()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("RAY_TPU_TEST_JAX"),
+    reason="jax adopt-native landing (set RAY_TPU_TEST_JAX=1; jax "
+           "import costs ~2s and the CPU backend is required)")
+class TestAdoptNativePut:
+    def test_cpu_jax_array_lands_without_host_bounce(self):
+        """_to_host adopts a CPU jax array via dlpack: the numpy view
+        handed to the serializer ALIASES the device buffer, so the put
+        path's single NT copy is the only movement of the bytes."""
+        import jax
+        import jax.numpy as jnp
+        arr = jnp.arange(1024, dtype=jnp.float32)
+        host = serialization._to_host(arr)
+        assert isinstance(host, np.ndarray)
+        assert np.shares_memory(
+            host, np.from_dlpack(arr)) or host.base is not None
+        sobj = serialization.serialize(arr)
+        out = serialization.deserialize(memoryview(sobj.to_bytes()))
+        assert np.array_equal(out, np.asarray(arr))
