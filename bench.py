@@ -718,6 +718,85 @@ def bench_pull(extras):
                 pass
 
 
+def bench_shuffle(extras):
+    """Streaming all-to-all exchange (data/shuffle.py: reducer actors
+    pulling shard sets over the direct transfer plane as maps land) vs
+    the bulk two-phase path (_bulk_shuffle: full map barrier, then
+    reduce tasks, every output block landed serially on the driver) —
+    same seeded random_shuffle, same 2-node daemon cluster, measured
+    end-to-end as driver-consumed output bytes per second."""
+    try:
+        import ray_tpu
+        import ray_tpu.data as rdata
+        from ray_tpu.cluster_utils import Cluster
+        from ray_tpu.data.context import DataContext
+
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+        cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
+        cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
+
+        ctx = DataContext.get_current()
+        ctx.shuffle_partitions = 8
+        rows = 24_000_000  # 183 MB of int64 through the exchange
+
+        def consume(refs):
+            total = 0
+            for ref in refs:
+                total += sum(v.nbytes
+                             for v in ray_tpu.get(ref).values())
+            return total
+
+        def run_streaming():
+            ctx.use_streaming_shuffle = True
+            ds = rdata.range(rows, override_num_blocks=16) \
+                .random_shuffle(seed=1)
+            t0 = time.perf_counter()
+            total = consume(r for r, _ in ds._iter_bundles())
+            return total / (time.perf_counter() - t0) / 1e9
+
+        def run_barrier():
+            # The exchange's predecessor on the same consumption path:
+            # the in-executor task-based shuffle operator.
+            ctx.use_streaming_shuffle = False
+            ds = rdata.range(rows, override_num_blocks=16) \
+                .random_shuffle(seed=1)
+            t0 = time.perf_counter()
+            total = consume(r for r, _ in ds._iter_bundles())
+            return total / (time.perf_counter() - t0) / 1e9
+
+        def run_bulk():
+            # plan.execute() always runs the bulk stage_fn; the flag
+            # only routes _iter_bundles.
+            ds = rdata.range(rows, override_num_blocks=16) \
+                .random_shuffle(seed=1)
+            t0 = time.perf_counter()
+            total = consume(b.ref for b in ds._plan.execute())
+            return total / (time.perf_counter() - t0) / 1e9
+
+        run_streaming()  # warm: reducer actor spawn + channel brokering
+        streaming = max(run_streaming() for _ in range(3))
+        barrier = max(run_barrier() for _ in range(3))
+        bulk = max(run_bulk() for _ in range(3))
+        extras["shuffle_gb_per_s"] = round(streaming, 3)
+        extras["shuffle_gb_per_s_barrier_path"] = round(barrier, 3)
+        extras["shuffle_gb_per_s_bulk_path"] = round(bulk, 3)
+        extras["shuffle_streaming_vs_bulk"] = round(
+            streaming / bulk, 2) if bulk else None
+        extras["shuffle_rows"] = rows
+        cluster.shutdown()
+    except Exception as e:
+        extras["shuffle_bench_error"] = f"{type(e).__name__}: {e}"
+        try:
+            cluster.shutdown()
+        except Exception:
+            try:
+                import ray_tpu
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+
 def bench_resnet(extras):
     """ResNet-50 batch inference through Data map_batches actor pools
     (BASELINE config #3). Runs BEFORE the driver touches the TPU so the
@@ -1220,6 +1299,45 @@ def _focus_pull_gb(ray_tpu):
     return measure
 
 
+def _focus_shuffle_gb(ray_tpu):
+    """End-to-end seeded random_shuffle throughput through the
+    STREAMING path (`_iter_bundles`) on a 2-node daemon cluster,
+    driver-consumed output bytes/s. Both sides of `--ab` consume the
+    same way: a tree with the exchange (data/shuffle.py present) runs
+    reducer actors pulling shard sets over the direct plane; a tree
+    without it runs its in-executor task-based shuffle operator — so
+    the AB ratio is the exchange vs the task-based path it replaced,
+    same workload, same consumption API."""
+    import ray_tpu.data as rdata
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.data.context import DataContext
+
+    cluster = Cluster()  # run_focus already init'd the head
+    cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
+    cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
+
+    ctx = DataContext.get_current()
+    try:
+        ctx.shuffle_partitions = 8
+        ctx.use_streaming_shuffle = True
+    except AttributeError:
+        pass  # pre-exchange tree: knobs absent, barrier op runs
+    rows = 24_000_000  # 183 MB: big enough that per-exchange fixed
+    # costs (reducer-pool RPCs, channel setup) stop dominating
+
+    def run():
+        ds = rdata.range(rows, override_num_blocks=16) \
+            .random_shuffle(seed=1)
+        t0 = time.perf_counter()
+        total = 0
+        for ref, _rows in ds._iter_bundles():
+            total += sum(v.nbytes for v in ray_tpu.get(ref).values())
+        return total / (time.perf_counter() - t0) / 1e9
+
+    run()  # warm: reducer spawn + channel brokering
+    return run
+
+
 def _focus_mc_tasks(ray_tpu):
     @ray_tpu.remote
     def nop():
@@ -1386,6 +1504,7 @@ FOCUS_METRICS = {
     "put_latency_us": _focus_put_latency,
     "multi_client_put_gb_per_s": _focus_mc_put_gb,
     "pull_gb_per_s": _focus_pull_gb,
+    "shuffle_gb_per_s": _focus_shuffle_gb,
     "multi_client_tasks_async_per_s": _focus_mc_tasks,
     "nn_actor_calls_async_per_s": _focus_nn_actor,
     "streaming_gen_items_per_s": _focus_streaming_gen,
@@ -1406,10 +1525,13 @@ def run_focus(name: str, reps: int = 3) -> None:
         vals = sorted(measure() for _ in range(max(1, reps)))
     finally:
         ray_tpu.shutdown()
+    # 3 decimals: GB/s-denominated metrics sit well below 1.0 on small
+    # hosts and a 1-decimal round collapses them to 0.0 (and --ab
+    # ratios computed from them to garbage).
     print(json.dumps({
-        "metric": name, "value": round(vals[-1], 1),
-        "spread": [round(vals[0], 1), round(statistics.median(vals), 1),
-                   round(vals[-1], 1)]}))
+        "metric": name, "value": round(vals[-1], 3),
+        "spread": [round(vals[0], 3), round(statistics.median(vals), 3),
+                   round(vals[-1], 3)]}))
 
 
 def run_ab(name: str, reps: int = 3) -> None:
@@ -1482,6 +1604,7 @@ def main():
     bench_serve(extras)
     bench_broadcast(extras)
     bench_pull(extras)
+    bench_shuffle(extras)
     # The resnet PIPELINE bench must precede the driver's own jax TPU
     # init (its pool actor owns the chip), but it is also the most
     # expensive section — budget-gated inside. The GPT/MFU numbers in

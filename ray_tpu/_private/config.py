@@ -218,6 +218,32 @@ class RayConfig:
         # falls back to the daemon path (admission control so bulk
         # pulls cannot starve the executor serving actor calls).
         "direct_transfer_max_serving": 4,
+        # -- streaming shuffle exchange (ISSUE 18: all-to-all on the
+        # direct transfer plane, data/shuffle.py) ------------------------
+        # Output partition count for streaming shuffles/sorts/groupbys
+        # (DataContext.shuffle_partitions seeds from this; the stream's
+        # length is unknown so the bulk n=num_blocks heuristic can't
+        # apply).
+        "shuffle_partitions": 16,
+        # CALLER-side cap on concurrent direct pulls to one peer node
+        # (per link). A shuffle reduce fans pulls at every producer
+        # node at once; without pacing a shard stampede trips the
+        # server-side direct_transfer_max_serving admission control and
+        # degrades whole shard sets to the daemon relay. Matched to
+        # that serving cap by default. 0 disables the gate.
+        "shuffle_link_inflight": 4,
+        # Max un-merged shard blocks a shuffle reducer buffers before
+        # folding the arrived prefix into its accumulator (bounds the
+        # reduce merge backlog; concat is associative so folding early
+        # is bit-identical to one terminal concat).
+        "shuffle_merge_budget": 8,
+        # How long a task return blocks for store capacity before the
+        # put fails typed. Concurrent reducers on one node each hold an
+        # UNSEALED output segment while merging; unsealed bytes cannot
+        # spill, so a store smaller than the overlap must wait for a
+        # neighbor to seal (then spill) rather than fail the task.
+        # 0 disables the wait (puts fail on first full-store miss).
+        "put_pressure_deadline_s": 30.0,
         # -- file-store segment recycling (the file-per-object store's
         # answer to the arena's pre-faulted pages: freed segments are
         # renamed into a pool and re-claimed by size-compatible

@@ -354,10 +354,18 @@ class DirectPlane:
         self._pull_lock = lockdep.lock("direct.pulls")
         self._pulls: Dict[int, dict] = {}
         self._pull_seq = 0
+        # In-flight pulls by object id: a second pull of the SAME
+        # object from this process (shuffle prefetch racing a reducer
+        # finish) must piggyback on the first, not double-reserve the
+        # id in the store. oid bytes -> Event set when the winner ends.
+        self._inflight_pulls: Dict[bytes, threading.Event] = {}
         # Callee-side admission: concurrently served pulls (guarded by
         # _pull_lock); excess requests refuse typed and the caller
         # falls back to the daemon path.
         self._serving_pulls = 0
+        # Caller-side per-peer-node link gates (shuffle_link_inflight):
+        # node_hex -> BoundedSemaphore, created lazily under _pull_lock.
+        self._link_sems: Dict[str, threading.BoundedSemaphore] = {}
         # Lazy transfer thread pool — bulk pulls never queue behind a
         # long-running actor method on the actor executor (or vice
         # versa).
@@ -2082,6 +2090,26 @@ class DirectPlane:
                     return chan
         return None
 
+    def _link_gate(self, node_hex: str):
+        """Per-peer-node semaphore bounding this process's concurrent
+        direct pulls on one link (`shuffle_link_inflight`; 0 = no
+        gate). Motivated by the shuffle exchange — a reduce that fans
+        pulls at every producer node at once would otherwise stampede
+        one peer past its direct_transfer_max_serving admission cap
+        and degrade whole shard sets to the daemon relay — but applied
+        to every direct pull: the cap is a property of the link, not
+        of who pulls. Returns the semaphore or None."""
+        from .config import ray_config
+        cap = int(ray_config.shuffle_link_inflight)
+        if cap <= 0:
+            return None
+        with self._pull_lock:
+            sem = self._link_sems.get(node_hex)
+            if sem is None:
+                sem = self._link_sems[node_hex] = \
+                    threading.BoundedSemaphore(cap)
+        return sem
+
     def pull_object(self, object_id, node_hex: str,
                     size_hint: int = 0) -> bool:
         """Pull one remote object worker-to-worker over an already-
@@ -2102,6 +2130,43 @@ class DirectPlane:
         chan = self._channel_to_node(node_hex)
         if chan is None:
             return False
+        key = object_id.binary()
+        with self._pull_lock:
+            racer = self._inflight_pulls.get(key)
+            if racer is None:
+                self._inflight_pulls[key] = threading.Event()
+        if racer is not None:
+            # Another thread of this process is already pulling this
+            # object: wait for it rather than double-reserving the id
+            # (the loser's reserve would collide on the store segment).
+            deadline = float(ray_config.pull_deadline_s)
+            racer.wait(deadline if deadline > 0 else 30.0)
+            try:
+                return self._worker.store.contains(object_id)
+            except Exception:  # lint: broad-except-ok containment probe; False falls back to the daemon path
+                return False
+        gate = self._link_gate(node_hex)
+        if gate is not None:
+            # Pace, never wedge: a gate slot outlives at most one pull
+            # deadline, so waiting that long means the link is fully
+            # saturated with pulls that will all release — and if the
+            # wait still times out, proceed ungated rather than fail
+            # (the gate is an optimization, not a correctness fence).
+            deadline = float(ray_config.pull_deadline_s)
+            if not gate.acquire(timeout=deadline if deadline > 0 else 30.0):
+                gate = None
+        try:
+            return self._pull_object_gated(object_id, node_hex, chan)
+        finally:
+            if gate is not None:
+                gate.release()
+            with self._pull_lock:
+                done = self._inflight_pulls.pop(key, None)
+            if done is not None:
+                done.set()
+
+    def _pull_object_gated(self, object_id, node_hex: str, chan) -> bool:
+        from .config import ray_config
         _bump()
         global _pull_ops
         _pull_ops += 1

@@ -261,8 +261,12 @@ class ObjectDirectory:
         with self._lock:
             e = self._entries.setdefault(oid, ObjectEntry())
             e.refcount += 1
-        if refdebug.enabled:
-            refdebug.head_delta("gcs.incref", oid, 1)
+            # Journaled under the directory lock: the replay checker
+            # asserts the journal never dips negative, which is only
+            # true if journal order == mutation order (a concurrent
+            # decref's record must not overtake this one).
+            if refdebug.enabled:
+                refdebug.head_delta("gcs.incref", oid, 1)
 
     def apply_delta(self, oid: ObjectID, delta: int):
         """Apply one batched refcount delta from a worker's coalesced
@@ -275,8 +279,8 @@ class ObjectDirectory:
             with self._lock:
                 e = self._entries.setdefault(oid, ObjectEntry())
                 e.refcount += delta
-            if refdebug.enabled:
-                refdebug.head_delta("gcs.apply_delta", oid, delta)
+                if refdebug.enabled:  # under the lock: journal order
+                    refdebug.head_delta("gcs.apply_delta", oid, delta)
         else:
             self.decref(oid, -delta)
 
@@ -300,10 +304,14 @@ class ObjectDirectory:
                     freed = [(oid,
                               e.location[0] if e.location else None)]
                     nested = e.nested_ids
-        if refdebug.enabled:
-            refdebug.head_delta("gcs.decref", oid, -delta)
-            if freed:
-                refdebug.free(oid)
+            # Journaled before the lock drops: with the record outside,
+            # a racing decref that frees the entry could journal its
+            # free BEFORE this (logically earlier) delta, and the
+            # replay would dip negative on a run that conserved fine.
+            if refdebug.enabled:
+                refdebug.head_delta("gcs.decref", oid, -delta)
+                if freed:
+                    refdebug.free(oid)
         if freed:
             for cb in self._on_free:
                 cb(freed)

@@ -347,6 +347,31 @@ class ObjectStore:
         self._pool_hits = 0
         self._pool_misses = 0
         self._pool_reclaimed = 0
+        # RAY_TPU_STORE_AUDIT=1: per-object charge ledger mirroring
+        # _used, so a full-store error can name the oids whose bytes
+        # were charged but whose segments are gone (accounting leaks).
+        self._audit: Optional[Dict[ObjectID, list]] = \
+            {} if os.environ.get("RAY_TPU_STORE_AUDIT") else None
+
+    def _charge(self, object_id: ObjectID, delta: int, tag: str) -> None:
+        if self._audit is None:
+            return
+        ent = self._audit.setdefault(object_id, [0, ""])
+        ent[0] += delta
+        ent[1] = tag
+
+    def _audit_report_locked(self) -> str:
+        if self._audit is None:
+            return ""
+        leaks: Dict[str, list] = {}
+        for oid, (net, tag) in self._audit.items():
+            if net > 0 and oid not in self._segments:
+                b = leaks.setdefault(tag, [0, 0])
+                b[0] += 1
+                b[1] += net
+        return " audit[" + " ".join(
+            f"{t}:n={n} b={b}" for t, (n, b) in sorted(leaks.items())
+        ) + "]" if leaks else " audit[clean]"
 
     # -- paths -------------------------------------------------------------
     def _path(self, object_id: ObjectID) -> str:
@@ -555,6 +580,14 @@ class ObjectStore:
         while True:
             admitted = False
             with self._lock:
+                if object_id in self._segments:
+                    # Duplicate reserve of an id this store already
+                    # holds (a racing pull/put of the same object).
+                    # Replacing the entry would orphan the original's
+                    # accounting and the caller's O_EXCL open would
+                    # abort-unlink the REAL object's file — refuse
+                    # before touching anything instead.
+                    raise FileExistsError(object_id.hex())
                 if staged is not None:
                     self._commit_staged_spill_locked(staged, orphans)
                     staged = None
@@ -574,7 +607,9 @@ class ObjectStore:
                             raise ObjectStoreFullError(
                                 f"Object of {size} bytes does not fit: "
                                 f"{self._used}/{self._capacity} bytes "
-                                f"used ({self._spilled_bytes} spilled)."
+                                f"used ({self._spilled_bytes} spilled; "
+                                f"{self._segment_census_locked()}"
+                                f"{self._audit_report_locked()})."
                             )
                 if staged is None:
                     # mm attaches lazily on first read (_open handles
@@ -583,6 +618,7 @@ class ObjectStore:
                         self._path(object_id), None,  # type: ignore[arg-type]
                         size)
                     self._used += size
+                    self._charge(object_id, size, "admit")
                     admitted = True
             if orphans:
                 # Spill copies of objects freed mid-write: delete
@@ -605,6 +641,11 @@ class ObjectStore:
                 return claimed[1]
             return os.open(self._path(object_id),
                            os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        except FileExistsError:
+            # Another process created this object between our admit and
+            # open: roll back the accounting, leave their file alone.
+            self._abort_reserve(object_id, unlink=False)
+            raise
         except BaseException:
             self._abort_reserve(object_id)
             raise
@@ -642,6 +683,11 @@ class ObjectStore:
                     mm = mmap.mmap(fd, size)
                 finally:
                     os.close(fd)
+        except FileExistsError:
+            # O_EXCL collision with another process's live object:
+            # roll back accounting only, never unlink their file.
+            self._abort_reserve(object_id, unlink=False)
+            raise
         except BaseException:
             self._abort_reserve(object_id)
             raise
@@ -657,25 +703,30 @@ class ObjectStore:
             telemetry.record_pool_claim(hit)
         return _Reservation(self, object_id, size, mm, prefaulted=hit)
 
-    def _abort_reserve(self, object_id: ObjectID):
+    def _abort_reserve(self, object_id: ObjectID,
+                       unlink: bool = True):
         """Roll back a failed write: no partial file may remain, or a
         reader would mmap truncated data as if sealed. Closes any
         writer-side mapping the reservation attached (the failed
         writer released its view before aborting, so exports are gone;
-        graveyard otherwise)."""
+        graveyard otherwise). ``unlink=False`` when the failure was an
+        O_EXCL collision with a file ANOTHER process created — that
+        file is a live object this writer must not destroy."""
         with self._lock:
             seg = self._segments.pop(object_id, None)
             if seg is not None:
                 self._used -= seg.size
+                self._charge(object_id, -seg.size, "abort")
                 if seg.mm is not None:
                     try:
                         seg.mm.close()
                     except BufferError:
                         self._graveyard.append(seg.mm)
-            try:
-                os.unlink(self._path(object_id))
-            except OSError:
-                pass
+            if unlink:
+                try:
+                    os.unlink(self._path(object_id))
+                except OSError:
+                    pass
 
     def create(self, object_id: ObjectID, size: int) -> memoryview:
         """Allocate a segment and return a writable view (then `seal`)."""
@@ -765,18 +816,46 @@ class ObjectStore:
                     import shutil
                     shutil.copyfile(seg.path, tmp)
                     os.rename(tmp, dst)
+                except FileNotFoundError:
+                    # Shm file already gone: a co-resident process
+                    # (typically the adopting owner's LRU) spilled or
+                    # freed this object and unlinked the file. The
+                    # bytes left tmpfs then — drop the stale segment
+                    # and reclaim the phantom accounting, or this
+                    # store believes it is full forever while holding
+                    # nothing (reads resolve via the spill file or the
+                    # freed-object path either way).
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    seg.file_exists = False
+                    self._segments.pop(oid, None)
+                    self._used -= seg.size
+                    self._charge(oid, -seg.size, "phantom")
+                    reclaimed += seg.size
+                    if seg.mm is not None:
+                        try:
+                            seg.mm.close()
+                        except BufferError:
+                            self._graveyard.append(seg.mm)
+                    continue
                 except OSError:
                     try:
                         os.unlink(tmp)
                     except OSError:
                         pass
                     raise
-                os.unlink(seg.path)
+                try:
+                    os.unlink(seg.path)
+                except FileNotFoundError:
+                    pass  # raced with a co-resident spill of the same id
             except Exception:
                 continue
             seg.file_exists = False
             self._segments.pop(oid, None)
             self._used -= seg.size
+            self._charge(oid, -seg.size, "spill")
             self._spilled_bytes += seg.size
             self._spilled_count += 1
             reclaimed += seg.size
@@ -786,6 +865,24 @@ class ObjectStore:
                 except BufferError:
                     self._graveyard.append(seg.mm)
         return reclaimed
+
+    def _segment_census_locked(self) -> str:
+        """Why is the store full? One line for ObjectStoreFullError:
+        bytes by segment state, so the unspillable mass is visible."""
+        buckets: Dict[str, int] = {}
+        for seg in self._segments.values():
+            if not seg.sealed:
+                k = "unsealed"
+            elif not seg.counted:
+                k = "uncounted"
+            elif not seg.file_exists:
+                k = "fileless"
+            elif seg.spilling:
+                k = "spilling"
+            else:
+                k = "spillable"
+            buckets[k] = buckets.get(k, 0) + seg.size
+        return " ".join(f"{k}={v}" for k, v in sorted(buckets.items()))
 
     def _spill_candidates_locked(self):
         from .config import ray_config
@@ -854,6 +951,7 @@ class ObjectStore:
             self._segments.pop(oid, None)
             if seg.counted:
                 self._used -= seg.size
+                self._charge(oid, -seg.size, "rspill")
             self._spilled_bytes += seg.size
             self._spilled_count += 1
             reclaimed += seg.size
@@ -961,6 +1059,7 @@ class ObjectStore:
                 if counted and seg.counted:
                     # The shm copy is gone; stop counting it.
                     self._used -= seg.size
+                    self._charge(object_id, -seg.size, "restore")
                 seg.counted = False
                 self._restored_count += 1
             seg.last_access = self._access_clock
@@ -998,6 +1097,7 @@ class ObjectStore:
                 if counted and seg.counted:
                     # The shm copy is gone; stop counting it.
                     self._used -= seg.size
+                    self._charge(object_id, -seg.size, "restore")
                 seg.counted = False
                 seg.mm = mm
                 seg.path = self._spill_path(object_id)
@@ -1028,6 +1128,7 @@ class ObjectStore:
         with self._lock:
             if object_id not in self._segments:
                 self._used += size
+                self._charge(object_id, size, "adopt")
                 # Lazily opened on first get; record a placeholder w/ size.
                 path = self._path(object_id)
                 seg = _Segment(path, None, size,  # type: ignore[arg-type]
@@ -1048,6 +1149,7 @@ class ObjectStore:
             if seg is not None:
                 if seg.counted:
                     self._used -= seg.size
+                    self._charge(object_id, -seg.size, "free")
                 live_views = False
                 keep_mm = None
                 poolable = (seg.file_exists and seg.sealed
@@ -1125,14 +1227,31 @@ class ObjectStore:
         self._graveyard = alive
 
     def release(self, object_id: ObjectID):
-        """Close a reader-side mapping without freeing the object."""
+        """Close a reader-side mapping without freeing the object.
+
+        On a segment this store CREATED (counted=True), a cluster-wide
+        RELEASE_OBJECTS is this process's only teardown signal — the
+        owner daemon free()s its own copy but creators only ever hear
+        `release`. Popping the entry without discharging the admit
+        charge leaves `_used` permanently inflated (a phantom-full
+        store that can never spill its way out), so counted segments
+        take the full free() path instead."""
+        counted = False
         with self._lock:
-            seg = self._segments.pop(object_id, None)
-            if seg is not None and seg.mm is not None:
-                try:
-                    seg.mm.close()
-                except BufferError:
-                    self._graveyard.append(seg.mm)
+            seg = self._segments.get(object_id)
+            if seg is None:
+                return
+            if seg.counted:
+                counted = True
+            else:
+                self._segments.pop(object_id, None)
+                if seg.mm is not None:
+                    try:
+                        seg.mm.close()
+                    except BufferError:
+                        self._graveyard.append(seg.mm)
+        if counted:
+            self.free(object_id)
 
     def shutdown(self):
         import shutil

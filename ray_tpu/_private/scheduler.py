@@ -1055,6 +1055,16 @@ class WorkerPool:
             str(int(_rc.direct_seq_reorder_cap))
         env["RAY_TPU_DIRECT_SEQ_HOLD_TIMEOUT_S"] = \
             str(_rc.direct_seq_hold_timeout_s)
+        # Shuffle-exchange coherence: reducer actors and partition maps
+        # run IN workers, and the per-link pull gate + merge budget are
+        # read there — a driver-side ray_config.set must win over the
+        # operator's shell env, same rule as the direct-plane knobs.
+        env["RAY_TPU_SHUFFLE_PARTITIONS"] = \
+            str(int(_rc.shuffle_partitions))
+        env["RAY_TPU_SHUFFLE_LINK_INFLIGHT"] = \
+            str(int(_rc.shuffle_link_inflight))
+        env["RAY_TPU_SHUFFLE_MERGE_BUDGET"] = \
+            str(int(_rc.shuffle_merge_budget))
         # Never inherit the DRIVER's chip visibility: a cpu-pool worker
         # with no chips assigned must not report the driver's
         # TPU_VISIBLE_CHIPS through get_tpu_ids().

@@ -344,6 +344,47 @@ def record_transfer_bytes(n: int) -> None:
                 "Object bytes pulled over direct channels").inc(n)
 
 
+# -- streaming shuffle exchange ----------------------------------------------
+# Per-process shuffle-exchange gauges/counters (data/shuffle.py). They
+# ride the same worker METRICS_PUSH as transfer_inflight, so the head's
+# federated /metrics shows each exchange's shard flow per process: how
+# many shard pulls a reducer has outstanding, the bytes it pulled per
+# producer link, and how deep its un-merged backlog runs.
+_shuffle_lock = threading.Lock()
+_shuffle_shards_inflight = 0
+
+
+def record_shuffle_shards_inflight(delta: int) -> None:
+    """Shard pulls a shuffle reducer has scheduled but not landed."""
+    global _ops, _shuffle_shards_inflight
+    _ops += 1
+    with _shuffle_lock:
+        _shuffle_shards_inflight = max(
+            0, _shuffle_shards_inflight + int(delta))
+        n = _shuffle_shards_inflight
+    _metric("shuffle_shards_inflight", "gauge",
+            "In-flight shuffle shard pulls in this process").set(n)
+
+
+def record_shuffle_bytes(n: int, link: str = "") -> None:
+    """Shard bytes a reducer pulled, tagged by producer-node link."""
+    global _ops
+    _ops += 1
+    if n > 0:
+        _metric("shuffle_bytes_pulled_total", "counter",
+                "Shuffle shard bytes pulled, by producer-node link",
+                tag_keys=("link",)).inc(n, tags={"link": link or "local"})
+
+
+def record_shuffle_merge_backlog(n: int) -> None:
+    """Un-merged shard blocks buffered by a shuffle reducer."""
+    global _ops
+    _ops += 1
+    _metric("shuffle_merge_backlog", "gauge",
+            "Shard blocks a shuffle reducer holds un-merged").set(
+                max(0, int(n)))
+
+
 # -- serve plane ------------------------------------------------------------
 # Request-path gauge writes are DEFERRED: the per-request hot path only
 # touches a plain dict under one lock and marks the deployment dirty;

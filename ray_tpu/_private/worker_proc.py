@@ -754,6 +754,26 @@ class Worker:
             self._fn_blobs.pop(spec.fn_id, None)
         return fn
 
+    def _put_return(self, oid, sobj) -> int:
+        """Land one task return in the store, waiting out transient
+        full-store pressure. A full store is not always terminal: a
+        concurrent writer on this node (e.g. a neighboring shuffle
+        reducer mid-merge) holds an unsealed segment that will seal —
+        and become spillable — shortly. Blocking here is the return
+        path's share of store backpressure; only a store that stays
+        full past the deadline fails the task."""
+        from ..exceptions import ObjectStoreFullError
+        from .config import ray_config
+        deadline_s = float(ray_config.put_pressure_deadline_s)
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                return self.store.put_serialized(oid, sobj)
+            except ObjectStoreFullError:
+                if deadline_s <= 0 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+
     def _package_returns(self, spec: P.TaskSpec, result: Any):
         if spec.num_returns == 1:
             values = [result]
@@ -771,7 +791,12 @@ class Worker:
             if sobj.total_size <= inline_threshold():
                 locs.append((P.LOC_INLINE, sobj.to_bytes()))
             else:
-                size = self.store.put_serialized(oid, sobj)
+                try:
+                    size = self._put_return(oid, sobj)
+                except FileExistsError:
+                    # Deterministic return id already landed (idempotent
+                    # re-execution of the same task): keep the original.
+                    size = sobj.total_size
                 locs.append((P.LOC_SHM, size))
         return locs, nested_per_return
 
@@ -796,7 +821,7 @@ class Worker:
             if sobj.total_size <= inline_threshold():
                 loc = (P.LOC_INLINE, sobj.to_bytes())
             else:
-                size = self.store.put_serialized(oid, sobj)
+                size = self._put_return(oid, sobj)
                 loc = (P.LOC_SHM, size)
             if direct_chan is not None:
                 self.direct.send_gen_item(direct_chan, spec.task_id,

@@ -112,6 +112,15 @@ class Cluster:
             str(int(_rc.direct_seq_reorder_cap))
         env["RAY_TPU_DIRECT_SEQ_HOLD_TIMEOUT_S"] = \
             str(_rc.direct_seq_hold_timeout_s)
+        # Shuffle-exchange knobs follow the same coherence rule: the
+        # per-link pull gate and merge budget run in THIS daemon's
+        # workers, so the driver's programmatic value must reach them.
+        env["RAY_TPU_SHUFFLE_PARTITIONS"] = \
+            str(int(_rc.shuffle_partitions))
+        env["RAY_TPU_SHUFFLE_LINK_INFLIGHT"] = \
+            str(int(_rc.shuffle_link_inflight))
+        env["RAY_TPU_SHUFFLE_MERGE_BUDGET"] = \
+            str(int(_rc.shuffle_merge_budget))
         argv = [sys.executable, "-m", "ray_tpu._private.daemon",
                 "--address", f"{host}:{port}",
                 "--num-cpus", str(num_cpus)]

@@ -14,6 +14,8 @@ import os
 import threading
 from typing import ClassVar, Optional
 
+from .._private.config import ray_config as _ray_config
+
 
 @dataclasses.dataclass
 class DataContext:
@@ -45,8 +47,23 @@ class DataContext:
     # Output partition count for STREAMING shuffles/sorts/groupbys — the
     # stream's length is unknown when the operator starts, so the bulk
     # path's n=num_blocks heuristic doesn't apply (reference:
-    # DataContext.min_parallelism feeding the shuffle planner).
-    shuffle_partitions: int = 16
+    # DataContext.min_parallelism feeding the shuffle planner). Seeded
+    # from ray_config.shuffle_partitions (env RAY_TPU_SHUFFLE_PARTITIONS)
+    # so it survives the worker/daemon env-coherence propagation.
+    shuffle_partitions: int = dataclasses.field(
+        default_factory=lambda: int(_ray_config.shuffle_partitions))
+    # Streaming shuffles ride the all-to-all exchange subsystem
+    # (data/shuffle.py: reducer actors pulling shard sets over the
+    # direct transfer plane, merging as shards arrive). Off: the
+    # barrier-based in-executor path (executor.ShuffleOperator).
+    use_streaming_shuffle: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "RAY_TPU_STREAMING_SHUFFLE", "1") not in ("0", "false", ""))
+    # Reducer-actor pool size for one streaming exchange (each reducer
+    # owns ceil(n/pool) output partitions). Small by default: reducers
+    # are num_cpus=0 and pull-bound, and a pool per live exchange must
+    # not swamp a 4-CPU test cluster with processes.
+    shuffle_reducer_pool: int = 4
 
     _lock: ClassVar[threading.Lock] = threading.Lock()
     _current: ClassVar[Optional["DataContext"]] = None

@@ -119,6 +119,12 @@ def _partition_block(blk: B.Block, n: int, mode, key, boundaries, seed):
     elif mode == "sort":
         vals = blk[key]
         assign = np.searchsorted(boundaries, vals, side="right")
+    elif mode == "repartition":
+        # Balanced contiguous chunks: row r of this block goes to
+        # partition r*n//len — output j is the arrival-order concat of
+        # every block's j-th chunk, so counts balance without any
+        # global slice plan (the streaming path can't know the total).
+        assign = (np.arange(length, dtype=np.int64) * n) // max(1, length)
     else:  # groupby hash
         # Deterministic cross-process hash: Python's hash() is salted per
         # process for str/bytes (PYTHONHASHSEED), and partition maps run in
@@ -505,11 +511,14 @@ class _Plan:
 
 
 def _bulk_shuffle(bundles: List["_RefBundle"], mode: str, key,
-                  descending: bool, seed, boundaries
-                  ) -> List["_RefBundle"]:
+                  descending: bool, seed, boundaries,
+                  n: Optional[int] = None) -> List["_RefBundle"]:
     """Shared bulk two-phase shuffle body (map-side partition +
-    reduce-side merge) used by _shuffle_like and sort's stage."""
-    n = max(1, len(bundles))
+    reduce-side merge) used by _shuffle_like and sort's stage. `n`
+    overrides the output partition count (repartition; also the
+    streaming byte-identity guard, which must match partition counts
+    across paths)."""
+    n = max(1, len(bundles)) if n is None else max(1, int(n))
     part_refs = []
     for b in bundles:
         parts = _partition_block.options(
@@ -783,8 +792,43 @@ class Dataset:
                 ref = _concat_blocks.remote(*plist)
                 out.append(_RefBundle(ref, _wait_rows(ref)))
             return out
+
+        def make_operator():
+            # Streaming repartition rides the exchange with
+            # mode="repartition" (balanced contiguous chunks per block,
+            # arrival-order concat per output). Row ORDER differs from
+            # the bulk slice plan — order-sensitive consumers (zip,
+            # split_at_indices, take) all run the bulk execute() path,
+            # and iter_* consumers of a repartition only rely on
+            # multiset/count semantics. The bulk stage_fn above keeps
+            # the exact global order for everyone else.
+            from . import executor as EX
+            from .context import DataContext
+            n = max(1, int(num_blocks))
+
+            def partition_submit(ref, nparts):
+                parts = _partition_block.options(
+                    num_returns=nparts).remote(ref, nparts,
+                                               "repartition", None,
+                                               None, None)
+                return [parts] if nparts == 1 else list(parts)
+
+            if DataContext.get_current().use_streaming_shuffle:
+                from . import shuffle as SH
+                return SH.StreamingShuffleOperator(
+                    "Repartition", n, partition_submit,
+                    mode="repartition")
+
+            def reduce_submit(j, parts):
+                return _reduce_partition.remote(
+                    "repartition", None, False, None, *parts)
+
+            return EX.ShuffleOperator(
+                "Repartition", n, partition_submit, reduce_submit)
+
         return Dataset(self._plan.with_stage(
-            _Stage("Repartition", stage_fn)))
+            _Stage("Repartition", stage_fn,
+                   make_operator=make_operator)))
 
     def _shuffle_like(self, mode: str, key: Optional[str] = None,
                       descending: bool = False, seed: Optional[int] = None,
@@ -794,21 +838,29 @@ class Dataset:
                                  boundaries)
 
         def make_operator():
-            # Streaming shuffle (reference: the reference's shuffle task
-            # scheduler under the streaming executor): map-side
-            # partitions stream with a bounded budget; partition blocks
-            # live in the store (spilling under pressure); reduces
-            # stream their outputs after the barrier. Partition count is
-            # a context knob because the stream's length is unknown.
+            # Streaming shuffle. Default: the all-to-all exchange on
+            # the direct transfer plane (shuffle.py — reducer actors
+            # pull shard sets from every producer node as maps land).
+            # use_streaming_shuffle=False falls back to the in-executor
+            # barrier op. Partition count is a context knob because the
+            # stream's length is unknown.
             from . import executor as EX
             from .context import DataContext
-            n = DataContext.get_current().shuffle_partitions
+            ctx = DataContext.get_current()
+            n = ctx.shuffle_partitions
 
             def partition_submit(ref, nparts):
                 parts = _partition_block.options(
                     num_returns=nparts).remote(ref, nparts, mode, key,
                                                boundaries, seed)
                 return [parts] if nparts == 1 else list(parts)
+
+            if ctx.use_streaming_shuffle:
+                from . import shuffle as SH
+                return SH.StreamingShuffleOperator(
+                    name, n, partition_submit, mode=mode, key=key,
+                    descending=descending, seed=seed,
+                    reverse_output=(mode == "sort" and descending))
 
             def reduce_submit(j, parts):
                 return _reduce_partition.remote(
@@ -863,7 +915,8 @@ class Dataset:
         def make_operator():
             from . import executor as EX
             from .context import DataContext
-            n = DataContext.get_current().shuffle_partitions
+            ctx = DataContext.get_current()
+            n = ctx.shuffle_partitions
 
             def sort_and_sample(ref):
                 return _sort_and_sample.options(num_returns=2).remote(
@@ -875,12 +928,18 @@ class Dataset:
                                                key)
                 return [parts] if nparts == 1 else list(parts)
 
+            def bounds_from_samples(sample_refs, nparts):
+                return _sort_bounds.remote(nparts, *sample_refs)
+
+            if ctx.use_streaming_shuffle:
+                from . import shuffle as SH
+                return SH.StreamingSortOperator(
+                    "Sort", n, sort_and_sample, partition_with_bounds,
+                    bounds_from_samples, key, descending)
+
             def reduce_submit(j, parts):
                 return _reduce_partition.remote(
                     "sort", key, descending, None, *parts)
-
-            def bounds_from_samples(sample_refs, nparts):
-                return _sort_bounds.remote(nparts, *sample_refs)
 
             return EX.SampledSortOperator(
                 "Sort", n, sort_and_sample, partition_with_bounds,
@@ -1090,16 +1149,25 @@ class Dataset:
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False,
-                     prefetch_batches: Optional[int] = None) -> Iterator:
+                     prefetch_batches: Optional[int] = None,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator:
         """(reference: dataset.py:4092 iter_batches) — streamed: blocks
         are produced by in-flight task chains while earlier batches are
-        consumed."""
+        consumed. `local_shuffle_buffer_size` mixes rows through a
+        consumption-side buffer (streaming.shuffled_blocks) — the cheap
+        per-epoch randomizer when a full random_shuffle exchange is
+        overkill."""
         from . import streaming
         from .context import DataContext
         if prefetch_batches is None:
             prefetch_batches = DataContext.get_current().prefetch_batches
         blocks = streaming.iter_blocks(self._iter_bundles(),
                                        prefetch=prefetch_batches)
+        if local_shuffle_buffer_size:
+            blocks = streaming.shuffled_blocks(
+                blocks, int(local_shuffle_buffer_size),
+                local_shuffle_seed)
         yield from streaming.batches_from_blocks(
             blocks, batch_size, batch_format, drop_last)
 

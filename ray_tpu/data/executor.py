@@ -68,6 +68,7 @@ class Operator:
         self.watch: Callable[[api.ObjectRef, Callable], None] = None
         self.in_flight = 0          # submitted-not-completed remote work
         self.max_in_flight = 4      # per-operator budget (resource mgr)
+        self.min_in_flight = 0      # floor the resource mgr must honor
         self.queued: collections.deque = collections.deque()
         self.done_called = False
 
@@ -84,6 +85,13 @@ class Operator:
 
     def work_left(self) -> bool:
         return bool(self.in_flight or self.queued or not self.done_called)
+
+    def active(self) -> int:
+        """Remote work outstanding right now (tasks or actor calls whose
+        completion will wake the pump). Distinct from work_left(): an
+        all-to-all op with every input still pending has work left but
+        nothing active — the stalled-source check keys off this."""
+        return self.in_flight
 
     def close(self) -> None:
         pass
@@ -415,7 +423,13 @@ class OperatorResourceManager:
         budget = max(2, ctx.max_in_flight_bundles)
         per = max(2, budget // max(1, len(ops)))
         for op in ops:
-            op.max_in_flight = per
+            # min_in_flight floor: an all-to-all exchange declares one —
+            # its map wave must cover the cluster's cores (a window of
+            # budget/len(ops) serializes maps that the bulk path runs in
+            # one wave) and its finish fan-out must cover the reducer
+            # pool. Pressure response stays with dispatch_budget, which
+            # throttles per-ROUND submission without shrinking windows.
+            op.max_in_flight = max(per, op.min_in_flight)
 
     def store_pressure(self) -> bool:
         used, cap = _store_stats()
@@ -443,6 +457,21 @@ class OperatorResourceManager:
             if op.queued or (op.work_left() and op.done_called):
                 return [i]
         return idxs[:1] if idxs else []
+
+    def dispatch_budget(self, op_index: int) -> int:
+        """Per-round dispatch budget for op `op_index`. Under store
+        pressure the drain op (most downstream — the only one
+        dispatch_order returns then) keeps the FULL budget: completing
+        its work is what frees store bytes, and throttling it raises
+        the peak. Every op UPSTREAM of the last is what shrinks — an
+        all-to-all exchange map lands n shard objects per input, and
+        submitting those into a strained store must trickle, not
+        burst (the driver-side half of the exchange's backpressure,
+        paired with reserve/seal + HostCopyGate pacing on workers)."""
+        if op_index + 1 < len(self._ops) and self.store_pressure():
+            self._ctx.backpressure_throttle_count += 1
+            return 2
+        return 8
 
 
 class StreamingExecutor:
@@ -536,10 +565,21 @@ class StreamingExecutor:
                 # below).
                 for fn, ref in cbs:
                     fn(ref)
-                # Source admission.
+                # Source admission. Pressure pauses the source, but an
+                # all-to-all exchange can only RELIEVE pressure after it
+                # has every input — pausing forever deadlocks (shards
+                # pinned in the store, no task in flight anywhere, no
+                # output to drain). When the pipeline is fully idle,
+                # admit one bundle despite pressure: the store's spill
+                # path absorbs the overflow, and one-at-a-time is the
+                # correct trickle for a strained store.
                 total_queued = sum(len(op.queued) for op in self._ops)
+                stalled = (not exhausted and total_queued == 0
+                           and not self._output
+                           and all(op.active() == 0 for op in self._ops))
                 while (not exhausted and self._ops
-                       and self._rm.admit_source(total_queued)
+                       and (self._rm.admit_source(total_queued)
+                            or (stalled and total_queued == 0))
                        and len(self._output) < self._output_cap):
                     try:
                         bundle = next(source)
@@ -554,7 +594,8 @@ class StreamingExecutor:
                 # the chain as ops drain.
                 if len(self._output) < self._output_cap:
                     for i in self._rm.dispatch_order():
-                        self._ops[i].dispatch(budget=8)
+                        self._ops[i].dispatch(
+                            budget=self._rm.dispatch_budget(i))
                 for i in range(len(self._ops) - 1):
                     op, nxt = self._ops[i], self._ops[i + 1]
                     if (op.done_called and not op.work_left()

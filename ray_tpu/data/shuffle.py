@@ -1,0 +1,547 @@
+"""Streaming all-to-all shuffle exchange on the direct transfer plane.
+
+Reference lineage: the push-based shuffle of the Exoshuffle line of
+work (python/ray/data/_internal/planner/exchange/ + the magnet-style
+pipelined map/reduce schedulers) — map-side partition tasks land shards
+in their node's object store, reduce-side consumers pull their shard
+sets from every producer node as they appear and merge incrementally,
+instead of waiting at a full map barrier.
+
+How it maps onto this runtime's planes:
+
+  map side    — the existing `_partition_block` / `_partition_sorted`
+                tasks (dataset.py), submitted with num_returns=n from
+                the driver so every shard ref carries LINEAGE: a shard
+                lost to a node SIGKILL or drain re-derives through the
+                head's `_ensure_ready` reconstruction when any getter
+                touches it. Shard bytes land via the zero-copy put path
+                (serialize-into-reservation, striped pool).
+  reduce side — `_ShuffleReducer` actors (num_cpus=0, restartable).
+                As each map task lands, the driver streams its shard
+                refs to the owning reducers (`prefetch`) which pull the
+                bytes NOW — over PULL_DIRECT channels when the shard is
+                remote — so the network overlaps the remaining map
+                compute. The authoritative, idempotent `finish` call
+                pulls whatever prefetch didn't cache, folds arrived
+                shards in map order under a bounded merge backlog
+                (`shuffle_merge_budget`), and applies the exact
+                `_reduce_partition` transform, so the output is
+                bit-identical to the bulk path by construction.
+  pacing      — caller-side per-link gates in DirectPlane.pull_object
+                (`shuffle_link_inflight`) keep a reduce's fan-in from
+                stampeding one producer past its serving-admission cap;
+                store backpressure rides the existing reserve/seal +
+                HostCopyGate machinery; the scheduler's link-saturation
+                penalty reads the `transfer_inflight` gauges these
+                pulls bump.
+
+Failure semantics: a restarted reducer (max_restarts) loses its soft
+prefetch/merge state and `finish` — retried on actor death via
+max_task_retries — simply re-pulls every shard, each pull re-deriving
+lost producers through lineage. Arrival order never affects output
+bytes: folds are prefix-only in map-index order and block_concat is
+associative.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+from . import block as B
+from .executor import Operator
+
+# Process-local count of streaming-exchange operations (exchanges
+# started + reducer calls served). The perf_smoke guard proves the
+# barrier fallback (use_streaming_shuffle=False) does ZERO exchange
+# work — not "cheap", zero — same discipline as pull_ops()/serve ops.
+_exchange_ops = 0
+
+
+def exchange_ops() -> int:
+    return _exchange_ops
+
+
+def _bump() -> None:
+    global _exchange_ops
+    _exchange_ops += 1
+
+
+def _apply_reduce_transform(out: B.Block, mode: str, key, descending: bool,
+                            seed) -> B.Block:
+    """EXACTLY dataset._reduce_partition's tail: the terminal transform
+    over the map-order concat. Kept in one place so the streaming
+    reducer cannot drift from the bulk task — bit-identity between the
+    two paths reduces to 'same concat order, same transform'."""
+    n = B.block_length(out)
+    if n == 0:
+        return out
+    if mode == "shuffle":
+        rng = np.random.default_rng(seed)
+        return B.block_take_indices(out, rng.permutation(n))
+    if mode == "sort":
+        order = np.argsort(out[key], kind="stable")
+        if descending:
+            order = order[::-1]
+        return B.block_take_indices(out, order)
+    return out
+
+
+@api.remote(max_restarts=4, max_task_retries=4)
+class _ShuffleReducer:
+    """Reduce-side consumer of streaming exchanges. num_cpus=0 (the
+    actor-path default): reducers are pull-bound and must stay
+    schedulable on a fully-reserved cluster, like _slice_block.
+
+    One reducer owns every partition j with j % pool_size == its slot,
+    across all concurrent exchanges of one dataset plan. All state is
+    SOFT: prefetch futures and cached blocks only ever shortcut work
+    `finish` would redo from the shard refs it receives."""
+
+    def __init__(self):
+        from .._private.config import ray_config
+        self._link_cap = int(ray_config.shuffle_link_inflight) or 4
+        self._merge_budget = max(1, int(ray_config.shuffle_merge_budget))
+        self._lock = threading.Lock()
+        self._pool = None
+        self._futs: Dict[tuple, "object"] = {}  # (xid, j, i) -> Future
+
+    def _executor(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            # 2x the per-link cap: with >=2 producer nodes the pool —
+            # not the per-link gate in pull_object — would otherwise be
+            # the fan-in bound and idle the second link.
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(2, self._link_cap * 2),
+                thread_name_prefix="shuffle-pull")
+        return self._pool
+
+    def _pull_batch(self, refs: List) -> List[B.Block]:
+        """Land one shard SET locally in a single batched get — one
+        location round trip for the whole set (the per-shard gets this
+        replaces paid one per shard and swamped the head broker under
+        reduce fan-in). Each read rides PULL_DIRECT for remote shards
+        (per-link gated) and triggers head-side lineage reconstruction
+        for LOST ones; a batch-level failure retries shard-by-shard so
+        one bad ref cannot poison its set."""
+        from .._private import telemetry
+        if telemetry.enabled:
+            telemetry.record_shuffle_shards_inflight(len(refs))
+        try:
+            links = self._locate_batch(refs) if telemetry.enabled else None
+            try:
+                blks = api.get(list(refs))
+            except Exception:  # lint: broad-except-ok shard-by-shard retry: each get re-resolves and rides reconstruction; a repeat failure propagates
+                blks = [api.get(r) for r in refs]
+            if telemetry.enabled:
+                for blk, (link, size) in zip(blks, links):
+                    telemetry.record_shuffle_bytes(
+                        size or sum(getattr(v, "nbytes", 0)
+                                    for v in blk.values()), link)
+            return blks
+        finally:
+            if telemetry.enabled:
+                telemetry.record_shuffle_shards_inflight(-len(refs))
+
+    @staticmethod
+    def _locate_batch(refs):
+        """Best-effort [(link_hex, size)] of a shard set for the
+        per-link byte counters — one batched lookup; never fails a
+        pull over telemetry."""
+        out = [("local", 0)] * len(refs)
+        try:
+            from .._private import state
+            from .._private import protocol as P
+            rt = state.current()
+            locs = rt.get_locations([r.id for r in refs])
+            for k, loc in enumerate(locs):
+                if loc and loc[0] == P.LOC_SHM and len(loc) > 2 and loc[2]:
+                    out[k] = (str(loc[2])[:8], int(loc[1] or 0))
+        except Exception:  # lint: broad-except-ok telemetry-only lookup; the pull itself re-resolves
+            pass
+        return out
+
+    def forget(self, xid: str) -> int:
+        """Drop one exchange's soft state (operator close): pending
+        pulls are cancelled where possible and their cached blocks
+        released — the shared-pool replacement for killing the actor."""
+        with self._lock:
+            keys = [k for k in self._futs if k[0] == xid]
+            for k in keys:
+                self._futs.pop(k)[0].cancel()
+        return len(keys)
+
+    def prefetch(self, xid: str, shards: List[tuple]) -> int:
+        """Advisory streaming hint: schedule pulls for [(j, i, ref)]
+        NOW so shard transfer overlaps the still-running map phase.
+        One batched pull per call (the shards of one call came from one
+        map task on one node); every (xid, j, i) key maps to (future,
+        index-into-batch). Purely soft — finish re-pulls anything
+        missing."""
+        _bump()
+        fresh = []
+        with self._lock:
+            for j, i, ref in shards:
+                if (xid, j, i) not in self._futs:
+                    fresh.append((j, i, ref))
+            if fresh:
+                fut = self._executor().submit(
+                    self._pull_batch, [r for _, _, r in fresh])
+                for k, (j, i, _ref) in enumerate(fresh):
+                    self._futs[(xid, j, i)] = (fut, k)
+        return len(fresh)
+
+    def finish(self, xid: str, j: int, refs: List, mode: str, key,
+               descending: bool, seed) -> B.Block:
+        """Authoritative merge of output partition j: pull every shard
+        not already prefetched — consecutive missing shards batch into
+        merge-budget-sized gets — fold the arrived prefix in MAP ORDER
+        under the merge budget, then apply the terminal transform.
+        Idempotent — a retry after an actor restart starts from the
+        refs alone and produces identical bytes."""
+        from .._private import telemetry
+        _bump()
+        with self._lock:
+            cached = [self._futs.pop((xid, j, i), None)
+                      for i in range(len(refs))]
+        acc: Optional[B.Block] = None
+        pending: List[B.Block] = []
+
+        def _fold():
+            nonlocal acc, pending
+            if telemetry.enabled:
+                telemetry.record_shuffle_merge_backlog(len(pending))
+            if len(pending) >= self._merge_budget:
+                acc = B.block_concat(
+                    ([acc] if acc is not None else []) + pending)
+                pending = []
+
+        i = 0
+        while i < len(refs):
+            if cached[i] is not None:
+                fut, k = cached[i]
+                try:
+                    pending.append(fut.result()[k])
+                except Exception:  # lint: broad-except-ok one inline re-pull: a fresh get re-resolves locations and rides lineage reconstruction; a second failure propagates
+                    pending.append(api.get(refs[i]))
+                i += 1
+                _fold()
+                continue
+            chunk = []
+            while (i < len(refs) and cached[i] is None
+                   and len(chunk) < self._merge_budget):
+                chunk.append(refs[i])
+                i += 1
+            for blk in self._pull_batch(chunk):
+                pending.append(blk)
+                _fold()
+        if telemetry.enabled:
+            telemetry.record_shuffle_merge_backlog(0)
+        out = B.block_concat(([acc] if acc is not None else []) + pending)
+        return _apply_reduce_transform(out, mode, key, descending, seed)
+
+
+_pool_lock = threading.Lock()
+_pool_rt: Optional[str] = None      # node hex of the runtime that owns it
+_pool_cache: Dict[int, List] = {}   # size -> reducer handles
+
+
+def _shared_pool(size: int) -> List:
+    """Process-wide reducer pool, shared across exchanges: spawning a
+    pool of num_cpus=0 actors costs ~1s — paid per exchange it would
+    swamp the exchange itself on anything but huge datasets. Keyed by
+    the live runtime's node id so a shutdown/init cycle (every test)
+    drops the dead handles; per-exchange state on the reducers is
+    keyed by xid and dropped via forget() at operator close."""
+    global _pool_rt
+    from .._private import state
+    rt_hex = state.current().node_id.hex()
+    with _pool_lock:
+        if _pool_rt != rt_hex:
+            _pool_cache.clear()
+            _pool_rt = rt_hex
+        pool = _pool_cache.get(size)
+        if pool is None:
+            # SPREAD: one reducer per node round-robin, so the merge
+            # compute uses every node's CPUs and the shard pulls are
+            # genuine cross-link traffic (the multi-link workload the
+            # scheduler's link-saturation penalty scores) — head-packed
+            # zero-cpu actors would serialize every merge on the head.
+            pool = [_ShuffleReducer.options(
+                scheduling_strategy="SPREAD").remote()
+                for _ in range(size)]
+            _pool_cache[size] = pool
+        return pool
+
+
+class StreamingShuffleOperator(Operator):
+    """All-to-all exchange operator for shuffle/groupby/repartition
+    (mode in {"shuffle", "groupby", "repartition"} — anything whose map
+    side is `_partition_block`). Map partitions stream under the
+    operator budget; each completed map's shards are streamed to their
+    reducers immediately (prefetch); finishes stream after the input
+    barrier. Emission is ALWAYS in partition order — determinism is
+    what makes the byte-identity guard against the bulk path possible.
+
+    partition_submit(ref, n) -> [n shard refs] (num_returns=n task)
+    """
+
+    def __init__(self, name: str, num_partitions: int,
+                 partition_submit, *, mode: str, key=None,
+                 descending: bool = False, seed=None,
+                 reverse_output: bool = False, max_in_flight: int = 8):
+        super().__init__()
+        _bump()
+        self.name = name
+        self._n = max(1, int(num_partitions))
+        self._partition = partition_submit
+        self._mode = mode
+        self._key = key
+        self._descending = descending
+        self._seed = seed
+        self._reverse = reverse_output
+        self.max_in_flight = max_in_flight
+        self.min_in_flight = max_in_flight  # resource-manager floor
+        self._xid = uuid.uuid4().hex[:12]
+        self._pool: List = []
+        self._maps: List[List] = []      # map index -> n shard refs
+        self._map_done = 0
+        self._finish_started = False
+        self._finish_next = 0
+        self._finish_in_flight: Dict[int, api.ObjectRef] = {}
+        self._out: Dict[int, api.ObjectRef] = {}
+        self._emitted = 0
+
+    # -- reducer pool ------------------------------------------------------
+    def _ensure_pool(self) -> None:
+        if self._pool:
+            return
+        from .context import DataContext
+        size = max(1, int(DataContext.get_current().shuffle_reducer_pool))
+        # Slice of the shared pool: ownership (j % len) needs at most
+        # n reducers, and the slice keeps it stable per exchange.
+        self._pool = _shared_pool(size)[:max(1, min(self._n, size))]
+
+    def _reducer_for(self, j: int):
+        return self._pool[j % len(self._pool)]
+
+    # -- map phase ---------------------------------------------------------
+    def add_input(self, bundle) -> None:
+        self.queued.append(bundle)
+
+    def dispatch(self, budget: int) -> int:
+        started = 0
+        while (self.queued and started < budget
+               and self.in_flight < self.max_in_flight):
+            ref, _rows = self.queued.popleft()
+            self._ensure_pool()
+            i = len(self._maps)
+            parts = self._partition(ref, self._n)
+            self._maps.append(parts)
+            self.in_flight += 1
+            started += 1
+            # All n shards come from one num_returns=n task and land
+            # together; watching the last is watching the map.
+            self.watch(parts[-1], lambda _r, i=i: self._on_map_ready(i))
+        # Finishes dispatch at the SUBMISSION barrier, not the
+        # completion barrier: every shard ref exists the moment its map
+        # task is submitted (num_returns=n), so once the queue is empty
+        # the per-partition ref lists are complete and the reducers can
+        # start — their pulls block shard-by-shard and the prefix folds
+        # proceed as maps land, overlapping merge with residual map
+        # compute (the magnet-style pipelining this operator is for).
+        if self.done_called and not self.queued:
+            if not self._finish_started:
+                self._finish_started = True
+                self._ensure_pool()  # zero-input edge: empty finishes
+                started += self._dispatch_finishes(max(1, budget))
+            else:
+                started += self._dispatch_finishes(budget)
+        return started
+
+    def _on_map_ready(self, i: int) -> None:
+        self.in_flight -= 1
+        self._map_done += 1
+        self._stream_shards(i)
+
+    def _stream_shards(self, i: int) -> None:
+        """Map i landed: hand each reducer its shards of that map so it
+        pulls them while other maps still run. Fire-and-forget — the
+        returned ack ref is dropped (prefetch is advisory)."""
+        if self._finish_started:
+            # The finishes own every pull from the refs they received;
+            # a prefetch landing after finish popped its keys would
+            # schedule a DUPLICATE pull nobody consumes. (Prefetch still
+            # earns its keep when upstream trickles: maps complete long
+            # before done_called and their shards stream early.)
+            return
+        parts = self._maps[i]
+        by_reducer: Dict[int, List[tuple]] = {}
+        for j in range(self._n):
+            by_reducer.setdefault(j % len(self._pool), []).append(
+                (j, i, parts[j]))
+        for slot, shards in by_reducer.items():
+            self._pool[slot].prefetch.remote(self._xid, shards)
+
+    # -- reduce phase ------------------------------------------------------
+    def _dispatch_finishes(self, budget: int) -> int:
+        started = 0
+        # Finish window scales with the pool: each reducer serializes
+        # its calls, so in-flight below 2x the pool idles reducers while
+        # anything past it only queues on busy actors.
+        window = max(self.max_in_flight, 2 * max(1, len(self._pool)))
+        while (self._finish_next < self._n and started < budget
+               and len(self._finish_in_flight) < window):
+            j = self._finish_next
+            self._finish_next += 1
+            out = self._reducer_for(j).finish.remote(
+                self._xid, j, [m[j] for m in self._maps], self._mode,
+                self._key, self._descending,
+                None if self._seed is None else self._seed + j)
+            self._finish_in_flight[j] = out
+            started += 1
+            self.watch(out, lambda r, j=j: self._on_finish_ready(j, r))
+        return started
+
+    def _on_finish_ready(self, j: int, ref: api.ObjectRef) -> None:
+        self._finish_in_flight.pop(j, None)
+        self._out[j] = ref
+        order = range(self._n - 1, -1, -1) if self._reverse \
+            else range(self._n)
+        order = list(order)
+        while self._emitted < self._n:
+            want = order[self._emitted]
+            if want not in self._out:
+                break
+            self._emitted += 1
+            self.emit((self._out.pop(want), -1))
+        if self._emitted == self._n:
+            self._release_working_set()
+
+    def _release_working_set(self) -> None:
+        # Shard refs are the exchange's working set (potentially the
+        # whole dataset); they must not outlive the reduce.
+        self._maps = []
+
+    def work_left(self) -> bool:
+        if not self.done_called or self.queued or self.in_flight:
+            return True
+        return self._emitted < self._n
+
+    def active(self) -> int:
+        # Reducer finish calls are outstanding remote work too; the
+        # executor's stalled-source check must see them.
+        return self.in_flight + len(self._finish_in_flight)
+
+    def close(self) -> None:
+        """Executor teardown (runs on EVERY path — success, error,
+        abandoned generator): release this exchange's soft state on the
+        shared reducers. Fire-and-forget; the ack refs are dropped."""
+        pool, self._pool = self._pool, []
+        for a in pool:
+            try:
+                a.forget.remote(self._xid)
+            except Exception:  # lint: broad-except-ok teardown; a dead reducer holds no state worth forgetting
+                pass
+
+
+class StreamingSortOperator(StreamingShuffleOperator):
+    """External sort on the exchange: phase 1 (sort+sample each block,
+    streaming) and the boundary barrier are the SampledSortOperator's;
+    phases 2-3 (range partition + merge) ride the exchange — partition
+    maps stream shards to reducers as they land, reducers merge ranges
+    with stable-sort finish, emission in range order (reversed for
+    descending)."""
+
+    def __init__(self, name: str, num_partitions: int,
+                 sort_and_sample, partition_with_bounds,
+                 bounds_from_samples, key: str, descending: bool,
+                 max_in_flight: int = 8):
+        super().__init__(
+            name, num_partitions,
+            partition_submit=None, mode="sort", key=key,
+            descending=descending, seed=None, reverse_output=descending,
+            max_in_flight=max_in_flight)
+        self._sort_and_sample = sort_and_sample
+        self._partition_with_bounds = partition_with_bounds
+        self._bounds_from_samples = bounds_from_samples
+        self._sorted: List[api.ObjectRef] = []
+        self._samples: List[api.ObjectRef] = []
+        self._phase1_in_flight = 0
+        self._bounds_ref = None
+        self._part_next = 0
+
+    def dispatch(self, budget: int) -> int:
+        started = 0
+        # Phase 1: sort+sample the stream.
+        while (self.queued and started < budget
+               and self._phase1_in_flight < self.max_in_flight):
+            ref, _rows = self.queued.popleft()
+            sorted_ref, sample_ref = self._sort_and_sample(ref)
+            self._sorted.append(sorted_ref)
+            self._samples.append(sample_ref)
+            self._phase1_in_flight += 1
+            self.in_flight += 1
+            started += 1
+            self.watch(sorted_ref, self._on_phase1_ready)
+        # Barrier: boundaries once the stream is fully sorted. The
+        # partition count clamps to the block count BEFORE the pool
+        # spawns, so reducer ownership (j % pool) is stable.
+        if (self.done_called and not self.queued
+                and self._phase1_in_flight == 0
+                and self._bounds_ref is None):
+            self._n = max(1, min(self._n, len(self._sorted)) or 1)
+            self._bounds_ref = self._bounds_from_samples(
+                self._samples, self._n)
+            self._samples = []
+        # Phase 2: range-partition sorted blocks onto the exchange.
+        if self._bounds_ref is not None:
+            while (self._part_next < len(self._sorted)
+                   and started < budget
+                   and self.in_flight < self.max_in_flight):
+                self._ensure_pool()
+                i = self._part_next
+                self._part_next += 1
+                parts = self._partition_with_bounds(
+                    self._sorted[i], self._n, self._bounds_ref)
+                self._maps.append(parts)
+                self.in_flight += 1
+                started += 1
+                self.watch(parts[-1],
+                           lambda _r, i=i: self._on_map_ready(i))
+            # Phase 3: merge each range once every block is PARTITION-
+            # SUBMITTED (the shard refs exist from that point; reducer
+            # pulls block per-shard, overlapping merge with residual
+            # partition compute, same as the base operator). The sorted
+            # blocks — still live as in-flight task args — release with
+            # the shard refs once every range has emitted.
+            if (self._part_next == len(self._sorted)
+                    and not self._finish_started):
+                self._finish_started = True
+                self._ensure_pool()
+                started += self._dispatch_finishes(max(1, budget))
+            elif self._finish_started:
+                started += self._dispatch_finishes(budget)
+        return started
+
+    def _release_working_set(self) -> None:
+        super()._release_working_set()
+        self._sorted = []
+
+    def _on_phase1_ready(self, _ref) -> None:
+        self._phase1_in_flight -= 1
+        self.in_flight -= 1
+
+    def work_left(self) -> bool:
+        if not self.done_called or self.queued or self.in_flight:
+            return True
+        if self._bounds_ref is None:
+            return True
+        if self._part_next < len(self._sorted):
+            return True
+        return self._emitted < self._n

@@ -38,6 +38,33 @@ def iter_blocks(bundles: Iterator[StreamedBundle],
         yield api.get(window.popleft()[0])
 
 
+def shuffled_blocks(blocks: Iterator[B.Block], buffer_size: int,
+                    seed: Optional[int] = None) -> Iterator[B.Block]:
+    """Consumption-side local shuffle (reference: ShufflingBatcher,
+    _internal/block_batching/util — iter_batches'
+    local_shuffle_buffer_size): hold a row buffer of at least
+    `buffer_size` rows; each emission permutes the buffer once and
+    yields the surplus prefix — a uniform draw without replacement —
+    so rows mix across neighboring blocks without a distributed
+    exchange. The tail is flushed permuted. Row-identity preserving:
+    multiset in == multiset out."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    buf: Optional[B.Block] = None
+    for blk in blocks:
+        if not B.block_length(blk):
+            continue
+        buf = blk if buf is None else B.block_concat([buf, blk])
+        n = B.block_length(buf)
+        if n > buffer_size:
+            buf = B.block_take_indices(buf, rng.permutation(n))
+            yield B.block_slice(buf, 0, n - buffer_size)
+            buf = B.block_slice(buf, n - buffer_size, n)
+    if buf is not None and B.block_length(buf):
+        n = B.block_length(buf)
+        yield B.block_take_indices(buf, rng.permutation(n))
+
+
 def batches_from_blocks(
     blocks: Iterator[B.Block],
     batch_size: Optional[int],

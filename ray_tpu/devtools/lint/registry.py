@@ -561,6 +561,10 @@ PROTOCOL_SEND_FUNCS = {
     # -- direct object transfer plane ----------------------------------
     ("_private/direct.py", "DirectPlane.pull_object"):
         (("direct", "caller", ("OPEN",)),),
+    # pull_object's send body after the in-process duplicate-pull
+    # dedup gate was split out (r18); same session/role/states.
+    ("_private/direct.py", "DirectPlane._pull_object_gated"):
+        (("direct", "caller", ("OPEN",)),),
     ("_private/direct.py", "DirectPlane._send_pull_eof"):
         (("direct", "callee", ("OPEN", "DRAINING")),),
     ("_private/direct.py", "DirectPlane._pull_serve_exec"):

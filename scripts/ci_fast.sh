@@ -39,6 +39,16 @@ echo "== zero-copy put path (striped reservation, lockdep+refdebug) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_put_path.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== streaming shuffle exchange (fast tier, guard suites) =="
+# The all-to-all exchange's fast tier: byte-identity vs the bulk
+# two-phase path (both reducer backends), idempotent finish retry,
+# working-set release, config plumbing through worker/daemon spawn.
+# The conftest guard suites run this module under lockdep, refdebug
+# AND wiretap; the @slow/@chaos kill/drain tier stays out of CI-fast.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_shuffle.py -q \
+    -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== perf_smoke + lint-marked tests =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'perf_smoke or lint' \

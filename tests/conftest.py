@@ -59,7 +59,7 @@ def pytest_configure(config):
 # attributable to the test that produced it.
 _LOCKDEP_SUITES = {"test_transport_framing", "test_fault_injection",
                    "test_direct_calls", "test_cross_plane_ordering",
-                   "test_serve_direct", "test_put_path"}
+                   "test_serve_direct", "test_put_path", "test_shuffle"}
 
 
 @pytest.fixture(autouse=True)
@@ -114,7 +114,7 @@ def _lockdep_guard(request, tmp_path_factory):
 _REFDEBUG_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
                     "test_fault_injection", "test_drain",
                     "test_serve_direct", "test_transfer",
-                    "test_put_path"}
+                    "test_put_path", "test_shuffle"}
 
 
 @pytest.fixture(autouse=True)
@@ -160,7 +160,7 @@ def _refdebug_guard(request, tmp_path_factory):
 # it (every process of the run appends violations at record time,
 # SIGKILL-safe).
 _WIRETAP_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
-                   "test_serve_direct", "test_transfer"}
+                   "test_serve_direct", "test_transfer", "test_shuffle"}
 
 
 @pytest.fixture(autouse=True)

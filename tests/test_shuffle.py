@@ -1,0 +1,427 @@
+"""Streaming shuffle exchange (data/shuffle.py) tests.
+
+Fast tier: the byte-identity guard — the streaming all-to-all exchange
+must produce BIT-EXACT output against the bulk two-phase path
+(`_bulk_shuffle`) for seeded random_shuffle, repartition and sort, on
+both store backends (arena + file); the barrier in-executor fallback
+(use_streaming_shuffle=False) must agree too, and a perf_smoke guard
+proves the fallback does ZERO exchange work (not "cheap" — zero). Plus
+the worker-env coherence regression for the shuffle knobs and the
+consumption-side local_shuffle_buffer_size.
+
+Chaos tier (slow): a producer node SIGKILLed or drained mid-exchange —
+lost shards re-derive through lineage reconstruction, dead reducers
+restart and their finish calls retry, and the output stays bit-exact
+against a pure-numpy oracle computed without the cluster. The module
+runs under ALL THREE conftest guards (lockdep + refdebug + wiretap):
+every run must come out with zero potential-ABBA cycles, a clean
+refcount ledger, and a conforming wire journal.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu._private.config import ray_config
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.data import shuffle as shuffle_mod
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import _bulk_shuffle
+from ray_tpu.util.state import drain_node
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _materialize_stream(ds):
+    """Run `ds` on the streaming executor and land its blocks in
+    emission order."""
+    return [ray_tpu.get(ref) for ref, _ in ds._iter_bundles()]
+
+
+def _assert_blocks_identical(got, want):
+    """Bit-exactness, block-by-block: same partition count, same
+    columns, same dtype/shape, same BYTES."""
+    assert len(got) == len(want), (len(got), len(want))
+    for j, (g, w) in enumerate(zip(got, want)):
+        assert set(g.keys()) == set(w.keys()), (j, g.keys(), w.keys())
+        for k in w:
+            ga, wa = np.asarray(g[k]), np.asarray(w[k])
+            assert ga.dtype == wa.dtype, (j, k, ga.dtype, wa.dtype)
+            assert ga.shape == wa.shape, (j, k, ga.shape, wa.shape)
+            assert ga.tobytes() == wa.tobytes(), (j, k)
+
+
+def _concat_col(blocks, col):
+    arrs = [np.asarray(b[col]) for b in blocks if col in b]
+    return np.concatenate(arrs) if arrs else np.asarray([])
+
+
+def _expected_exchange(blocks, n, seed):
+    """Pure-numpy oracle for a seeded mode="shuffle" exchange —
+    replicates _partition_block (one rng per map, same seed) +
+    _reduce_partition (map-order concat, then a seed+j permutation)
+    without touching the cluster, so chaos runs have a ground truth
+    that cannot itself be corrupted by the fault."""
+    cols = list(blocks[0].keys())
+    shards = [[] for _ in range(n)]
+    for blk in blocks:
+        length = len(np.asarray(blk[cols[0]]))
+        assign = np.random.default_rng(seed).integers(0, n, size=length)
+        for j in range(n):
+            idx = np.nonzero(assign == j)[0]
+            shards[j].append({k: np.asarray(blk[k])[idx] for k in cols})
+    out = []
+    for j in range(n):
+        cat = {k: np.concatenate([s[k] for s in shards[j]])
+               for k in cols}
+        perm = np.random.default_rng(seed + j).permutation(
+            len(cat[cols[0]]))
+        out.append({k: cat[k][perm] for k in cols})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def fresh_ctx():
+    """Own DataContext per test (shuffle_partitions and the streaming
+    flag are mutated here); the previous singleton is restored so
+    module ordering can't leak configuration."""
+    prev = DataContext.get_current()
+    ctx = DataContext()
+    DataContext._set_current(ctx)
+    yield ctx
+    DataContext._set_current(prev)
+
+
+@pytest.fixture(params=["arena", "file"])
+def both_backends(request):
+    """Fresh local session per test on each store backend: the shard
+    bytes land through reserve/seal on the arena and through the
+    file-per-object fallback with RAY_TPU_FILE_STORE=1 — identity must
+    hold on both."""
+    ray_tpu.shutdown()
+    prev = os.environ.get("RAY_TPU_FILE_STORE")
+    if request.param == "file":
+        os.environ["RAY_TPU_FILE_STORE"] = "1"
+    else:
+        os.environ.pop("RAY_TPU_FILE_STORE", None)
+    ray_tpu.init(num_cpus=4)
+    yield request.param
+    ray_tpu.shutdown()
+    if prev is None:
+        os.environ.pop("RAY_TPU_FILE_STORE", None)
+    else:
+        os.environ["RAY_TPU_FILE_STORE"] = prev
+
+
+# ---------------------------------------------------------------------------
+# byte-identity guard: streaming exchange vs the bulk path
+# ---------------------------------------------------------------------------
+class TestByteIdentity:
+    def test_random_shuffle_matches_bulk(self, both_backends, fresh_ctx):
+        """Seeded shuffle, per-block identity: same partition count and
+        the same (seed, seed+j) discipline on both paths means every
+        output block must be byte-equal, not merely the same multiset."""
+        fresh_ctx.shuffle_partitions = 4
+        base = rd.range(400, override_num_blocks=4).map_batches(
+            lambda b: {"id": b["id"], "v": b["id"] * 3 + 1})
+        bundles = base._plan.execute()
+        bulk = [ray_tpu.get(b.ref) for b in _bulk_shuffle(
+            bundles, "shuffle", None, False, 7, None, n=4)]
+        stream = _materialize_stream(base.random_shuffle(seed=7))
+        _assert_blocks_identical(stream, bulk)
+
+    def test_repartition_matches_bulk_exchange(self, both_backends,
+                                               fresh_ctx):
+        """mode="repartition" on the exchange vs the same mode through
+        _bulk_shuffle: balanced contiguous chunks, arrival-order concat
+        — deterministic, so per-block byte identity holds."""
+        base = rd.range(250, override_num_blocks=5)
+        bundles = base._plan.execute()
+        bulk = [ray_tpu.get(b.ref) for b in _bulk_shuffle(
+            bundles, "repartition", None, False, None, None, n=3)]
+        stream = _materialize_stream(base.repartition(3))
+        _assert_blocks_identical(stream, bulk)
+        # And the repartition contract itself: balanced, multiset kept.
+        sizes = [len(b["id"]) for b in stream]
+        assert sum(sizes) == 250 and max(sizes) - min(sizes) <= 5
+        assert sorted(_concat_col(stream, "id").tolist()) == \
+            list(range(250))
+
+    @pytest.mark.parametrize("descending", [False, True])
+    def test_sort_matches_bulk(self, both_backends, fresh_ctx,
+                               descending):
+        """External streaming sort vs the bulk sampled sort: boundary
+        sets differ between the paths, but equal keys always co-locate
+        (searchsorted is deterministic per value) and stable sorts keep
+        ties in map order on both — so the CONCATENATED output is
+        byte-identical even though the partition cuts are not."""
+        fresh_ctx.shuffle_partitions = 4
+        base = rd.range(300, override_num_blocks=6).map_batches(
+            lambda b: {"v": b["id"] % 17, "id": b["id"]})
+        base._plan.execute()  # pin identical inputs for both paths
+        bulk = [ray_tpu.get(b.ref) for b in
+                base.sort("v", descending=descending)._plan.execute()]
+        stream = _materialize_stream(
+            base.sort("v", descending=descending))
+        for col in ("v", "id"):
+            assert _concat_col(stream, col).tobytes() == \
+                _concat_col(bulk, col).tobytes(), col
+        vals = _concat_col(stream, "v")
+        assert (vals == np.sort(vals)[::-1 if descending else 1]).all()
+
+    def test_barrier_fallback_identical(self, fresh_ctx, shutdown_only):
+        """use_streaming_shuffle=False routes to the in-executor
+        barrier op; flipping the flag must not change a single byte."""
+        ray_tpu.init(num_cpus=4)
+        fresh_ctx.shuffle_partitions = 3
+        base = rd.range(200, override_num_blocks=4)
+        base._plan.execute()
+        fresh_ctx.use_streaming_shuffle = True
+        exchange = _materialize_stream(base.random_shuffle(seed=11))
+        fresh_ctx.use_streaming_shuffle = False
+        barrier = _materialize_stream(base.random_shuffle(seed=11))
+        _assert_blocks_identical(exchange, barrier)
+
+
+# ---------------------------------------------------------------------------
+# perf_smoke: the fallback does ZERO exchange work
+# ---------------------------------------------------------------------------
+@pytest.mark.perf_smoke
+def test_fallback_does_zero_exchange_work(fresh_ctx, shutdown_only):
+    """With the flag off, the exchange subsystem must be COMPLETELY
+    cold — no operator constructed, no reducer spawned, no prefetch —
+    same op-count discipline as the pull_ops()/serve guards. With the
+    flag on, the same pipeline must register exchange work."""
+    ray_tpu.init(num_cpus=4)
+    fresh_ctx.shuffle_partitions = 3
+    base = rd.range(120, override_num_blocks=3)
+    base._plan.execute()
+
+    fresh_ctx.use_streaming_shuffle = False
+    before = shuffle_mod.exchange_ops()
+    _materialize_stream(base.random_shuffle(seed=1))
+    _materialize_stream(base.repartition(2))
+    assert shuffle_mod.exchange_ops() == before, \
+        "barrier fallback performed streaming-exchange work"
+
+    fresh_ctx.use_streaming_shuffle = True
+    _materialize_stream(base.random_shuffle(seed=1))
+    assert shuffle_mod.exchange_ops() > before
+
+
+# ---------------------------------------------------------------------------
+# worker-env coherence for the shuffle knobs
+# ---------------------------------------------------------------------------
+def test_config_set_overrides_exported_env_in_workers(shutdown_only):
+    """A programmatic ray_config.set of a shuffle knob must reach
+    worker environments even when the operator's shell exported the
+    opposite value — the per-link pull gate runs in reducer workers,
+    and a diverging cap would let one reduce stampede a producer past
+    its serving admission."""
+    prev_env = os.environ.get("RAY_TPU_SHUFFLE_LINK_INFLIGHT")
+    os.environ["RAY_TPU_SHUFFLE_LINK_INFLIGHT"] = "9"
+    prev_cfg = ray_config.shuffle_link_inflight
+    ray_config.set("shuffle_link_inflight", 2)
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def worker_env(k):
+            return os.environ.get(k)
+
+        assert ray_tpu.get(worker_env.remote(
+            "RAY_TPU_SHUFFLE_LINK_INFLIGHT")) == "2"
+    finally:
+        ray_config.set("shuffle_link_inflight", prev_cfg)
+        if prev_env is None:
+            os.environ.pop("RAY_TPU_SHUFFLE_LINK_INFLIGHT", None)
+        else:
+            os.environ["RAY_TPU_SHUFFLE_LINK_INFLIGHT"] = prev_env
+
+
+# ---------------------------------------------------------------------------
+# return-path store backpressure
+# ---------------------------------------------------------------------------
+def test_put_return_waits_out_transient_full_store():
+    """A task return hitting a full store blocks and retries instead
+    of failing: concurrent reducers on one node each hold an unsealed
+    output segment while merging, and unsealed bytes cannot spill —
+    the neighbor seals moments later. Only a store that stays full
+    past put_pressure_deadline_s fails the put."""
+    import types
+
+    from ray_tpu._private.worker_proc import Worker
+    from ray_tpu.exceptions import ObjectStoreFullError
+
+    calls = {"n": 0}
+
+    class FlakyStore:
+        def put_serialized(self, oid, sobj):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ObjectStoreFullError("full: neighbor unsealed")
+            return 42
+
+    shim = types.SimpleNamespace(store=FlakyStore())
+    assert Worker._put_return(shim, b"oid", object()) == 42
+    assert calls["n"] == 3
+
+    prev = ray_config.put_pressure_deadline_s
+    ray_config.set("put_pressure_deadline_s", 0)
+    try:
+        calls["n"] = 0
+        with pytest.raises(ObjectStoreFullError):
+            Worker._put_return(shim, b"oid", object())
+        assert calls["n"] == 1, "deadline 0 must not retry"
+    finally:
+        ray_config.set("put_pressure_deadline_s", prev)
+
+
+# ---------------------------------------------------------------------------
+# consumption-side local shuffle
+# ---------------------------------------------------------------------------
+def test_local_shuffle_buffer_size(ray_start_regular):
+    """iter_batches(local_shuffle_buffer_size=...) mixes rows across
+    neighboring blocks without an exchange: multiset preserved, order
+    perturbed, and a fixed seed replays the same order."""
+    def collect():
+        ds = rd.range(100, override_num_blocks=4)
+        out = []
+        for b in ds.iter_batches(batch_size=25,
+                                 local_shuffle_buffer_size=30,
+                                 local_shuffle_seed=11):
+            out.extend(int(v) for v in b["id"])
+        return out
+
+    got = collect()
+    assert sorted(got) == list(range(100))
+    assert got != list(range(100))
+    assert got == collect()  # seeded -> replayable
+
+
+# ---------------------------------------------------------------------------
+# chaos tier: node loss mid-exchange, output bit-exact
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def exchange_cluster():
+    """head + two real daemon nodes: partition maps spread across all
+    three, so shard pulls genuinely cross the direct transfer plane and
+    killing a daemon genuinely loses shard primaries."""
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    a = cluster.add_node(num_cpus=2, resources={"A": 2}, daemon=True)
+    b = cluster.add_node(num_cpus=2, resources={"B": 2}, daemon=True)
+    yield cluster, a, b
+    try:
+        cluster.shutdown()
+    except Exception:  # lint: broad-except-ok teardown after an intentional node kill
+        pass
+    ray_tpu.shutdown()
+
+
+def _run_exchange_with_fault(fault_fn, n=8, seed=5, rows=40_000):
+    """Shared chaos body: oracle first, then stream the exchange and
+    inject `fault_fn` after the first output partition lands. The
+    remaining partitions' finishes are still pulling shards when the
+    fault hits — exactly the mid-exchange window."""
+    ctx = DataContext.get_current()
+    ctx.shuffle_partitions = n
+    base = rd.range(rows, override_num_blocks=8)
+    local = [ray_tpu.get(bd.ref) for bd in base._plan.execute()]
+    expected = _expected_exchange(local, n, seed)
+    assert all(len(e["id"]) for e in expected)  # oracle sanity
+
+    it = base.random_shuffle(seed=seed)._iter_bundles()
+    first_ref, _ = next(it)
+    fault_fn()
+    out = [ray_tpu.get(first_ref, timeout=180)]
+    out.extend(ray_tpu.get(ref, timeout=180) for ref, _ in it)
+    _assert_blocks_identical(out, expected)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_sigkill_node_mid_shuffle_bit_exact(exchange_cluster,
+                                                  fresh_ctx):
+    """SIGKILL a producer node after the first output partition: its
+    shard primaries (and any reducers it hosted) die mid-exchange.
+    Lost shards re-derive through lineage reconstruction when the
+    surviving reducers' pulls touch them, restarted reducers retry
+    finish from the refs alone, and the output is bit-exact against
+    the numpy oracle — with clean refdebug/wiretap journals."""
+    cluster, a, b = exchange_cluster
+    _run_exchange_with_fault(
+        lambda: os.kill(a.proc.pid, signal.SIGKILL))
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_reducer_pulls_lost_shards_via_lineage(exchange_cluster,
+                                                     fresh_ctx):
+    """Deterministic lost-shard coverage (the streaming operator's
+    prefetch usually caches shards before a mid-run kill can matter):
+    pin the partition map to node A with soft affinity, SIGKILL A
+    after its shards land, THEN hand a fresh reducer the refs with no
+    prefetch. Every pull hits a LOST primary, re-derives through the
+    head's lineage reconstruction (the soft affinity respills to the
+    survivors), and the merged output is bit-exact."""
+    import time
+
+    from ray_tpu._private import state as _state
+    from ray_tpu.data.dataset import _partition_block
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    cluster, a, b = exchange_cluster
+    n, seed = 4, 3
+    blk = {"id": np.arange(5000, dtype=np.int64),
+           "v": np.arange(5000, dtype=np.float64) * 0.5}
+    ref = ray_tpu.put(blk)
+    parts = list(_partition_block.options(
+        num_returns=n,
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=a.node_id, soft=True)).remote(
+                ref, n, "shuffle", None, None, seed))
+    ready, _ = ray_tpu.wait(parts, num_returns=n, timeout=60)
+    assert len(ready) == n  # shard primaries live on A only
+
+    os.kill(a.proc.pid, signal.SIGKILL)
+    rt = _state.current()
+    deadline = time.monotonic() + 30.0
+    while (a.node_id in rt.head_server.daemons
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+
+    expected = _expected_exchange([blk], n, seed)
+    red = shuffle_mod._ShuffleReducer.remote()
+    out = [ray_tpu.get(
+        red.finish.remote("xlineage", j, [parts[j]], "shuffle", None,
+                          False, seed + j), timeout=120)
+        for j in range(n)]
+    _assert_blocks_identical(out, expected)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_drain_node_mid_shuffle_bit_exact(exchange_cluster,
+                                                fresh_ctx):
+    """Graceful drain of a producer node mid-exchange: sole-copy shard
+    primaries re-home before the node leaves, so the remaining reduces
+    pull migrated copies instead of reconstructing — same bit-exact
+    output, zero loss."""
+    cluster, a, b = exchange_cluster
+
+    def drain():
+        st = drain_node(a.node_id, wait=True)
+        assert st["state"] == "DRAINED", st
+
+    _run_exchange_with_fault(drain)
