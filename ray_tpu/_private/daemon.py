@@ -31,6 +31,7 @@ from ..util import tracing
 from . import fault
 from . import lockdep
 from . import protocol as P
+from . import racedebug
 from . import telemetry
 from . import wiretap
 from .config import ray_config
@@ -244,7 +245,7 @@ class NodeDaemon:
             w = self._writer
         w.send_message(msg_type, payload)
 
-    def _recv(self):
+    def _recv(self):  # lint: guarded-by-ok recv-thread-only: the daemon loop is the sole consumer; _connect_head resets the backlog on this same thread (under _conn_lock for the writer pair)
         """Read the next message, buffering coalesced frame-mates."""
         if self._recv_backlog:
             return self._recv_backlog.pop(0)
@@ -260,6 +261,8 @@ class NodeDaemon:
         with self._req_lock:
             self._req_counter += 1
             req_id = self._req_counter
+            if racedebug.enabled:
+                racedebug.access(self, "_pending", write=True)
             self._pending[req_id] = fut
         try:
             self._send(P.NODE_REQUEST, {"req_id": req_id, "op": op,
@@ -414,6 +417,8 @@ class NodeDaemon:
             self._exec.submit(_localize)
         elif msg_type == P.NODE_REPLY:
             with self._req_lock:
+                if racedebug.enabled:
+                    racedebug.access(self, "_pending", write=True)
                 fut = self._pending.pop(payload["req_id"], None)
             if fut is not None:
                 fut.set_result(payload.get("result"))
@@ -725,9 +730,11 @@ class NodeDaemon:
             self._route_exec.close(drain_timeout=0.5)
         except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
+        with self._conn_lock:
+            w = self._writer
         try:
-            if self._writer is not None:
-                self._writer.close(flush_timeout=0.5)
+            if w is not None:
+                w.close(flush_timeout=0.5)
         except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
             pass
         try:

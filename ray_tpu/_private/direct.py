@@ -60,6 +60,7 @@ from . import fault
 from . import object_store
 from . import lockdep
 from . import protocol as P
+from . import racedebug
 from . import refdebug
 from . import serialization
 from . import telemetry
@@ -448,8 +449,8 @@ class DirectPlane:
         # Racy fast path: both buffers only become non-empty under
         # _cond; if another thread's entries are in flight, our own
         # messages carry no dependency on them.
-        if not self._done_buf and not self._ref_buf \
-                and not (self._n_calls or self._n_results):
+        if (not self._done_buf and not self._ref_buf  # lint: guarded-by-ok documented racy fast path: buffers fill under _cond; our own frames carry no dependency on another thread's in-flight entries
+                and not (self._n_calls or self._n_results)):
             return
         _bump()
         with self._cond:
@@ -635,7 +636,7 @@ class DirectPlane:
         HEAD converts to event dicts at ingest, so the hot path and
         the worker-side drain pay tuple appends and one pickle each,
         nothing more."""
-        if not self._sub_evts:
+        if not self._sub_evts:  # lint: guarded-by-ok racy emptiness fast path: a miss just defers the drain to the next TASK_EVENTS tick
             return []
         with self._cond:
             staged, self._sub_evts = self._sub_evts, []
@@ -662,6 +663,8 @@ class DirectPlane:
     # local result cache / pending markers
     # ------------------------------------------------------------------
     def _cache_put_locked(self, ob: bytes, loc) -> None:
+        if racedebug.enabled:
+            racedebug.access(self, "_results", write=True)
         res = self._results
         res[ob] = loc
         res.move_to_end(ob)
@@ -858,7 +861,7 @@ class DirectPlane:
 
     def _channel_for(self, actor_id) -> Optional[_DirectChannel]:
         ab = actor_id.binary()
-        chan = self._chans.get(ab)
+        chan = self._chans.get(ab)  # lint: guarded-by-ok double-checked fast path: GIL-atomic get, re-read below before any mutation
         if isinstance(chan, _Fallback):
             # Transient pins (channel death, dial failure) re-dial once
             # the backoff cooldown elapses, bounded by
@@ -868,7 +871,7 @@ class DirectPlane:
         elif chan is not None and chan.alive:
             return chan
         with self._estab_lock:
-            chan = self._chans.get(ab)
+            chan = self._chans.get(ab)  # lint: guarded-by-ok _estab_lock serializes dialers; _chans INSERTS happen under it too, only retirement needs _cond
             prior = None
             if isinstance(chan, _Fallback):
                 if not chan.redial_due():
@@ -1637,15 +1640,15 @@ class DirectPlane:
         # INSIDE the _cond critical section that retires the local
         # refcounts (the ordering invariant: later decrefs for these ids
         # must enqueue after the accounting that transfers them).
+        fut: Future = Future()
         with w._req_lock:
             w._req_counter += 1
             req_id = w._req_counter
-        fut: Future = Future()
-        w._pending[req_id] = fut
+            w._pending[req_id] = fut  # lint: guarded-by-ok receiver is the head-link Worker, not the plane: ITS _pending is guarded by w._req_lock, held here
         stream_cbs: List = []
         with self._cond:
             if not chan.alive:
-                w._pending.pop(req_id, None)
+                w._pending.pop(req_id, None)  # lint: guarded-by-ok receiver is the head-link Worker: GIL-atomic pop of OUR slot, no other thread knows this req_id yet
                 return
             chan.alive = False
             # Parked completion accounting registers head-side BEFORE
@@ -1722,7 +1725,7 @@ class DirectPlane:
             except Exception:  # lint: broad-except-ok user stream-done callback; reconcile must proceed
                 logger.debug("stream done-callback raised", exc_info=True)
         if not specs:
-            w._pending.pop(req_id, None)
+            w._pending.pop(req_id, None)  # lint: guarded-by-ok receiver is the head-link Worker: GIL-atomic pop of OUR slot, no other thread knows this req_id yet
             return
         try:
             out = fut.result(timeout=60.0)

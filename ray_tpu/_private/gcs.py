@@ -10,6 +10,7 @@ pluggable snapshot (the reference's in_memory_store_client default).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -17,9 +18,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..exceptions import ObjectLostError
 from . import fault
+from . import lockdep
+from . import racedebug
 from . import protocol as P
 from . import refdebug
 from .ids import ActorID, ObjectID, TaskID, WorkerID
+
+logger = logging.getLogger(__name__)
 
 # Object lifecycle states (reference: object directory + reference_count.h)
 PENDING = "pending"
@@ -68,10 +73,10 @@ class ObjectDirectory:
     """Owner-side object table: state, location, refcount, lineage."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = lockdep.rlock("gcs.object_dir")
         self._entries: Dict[ObjectID, ObjectEntry] = {}
-        self._on_ready: List[Callable[[ObjectID], None]] = []
-        self._on_free: List[Callable[[List[ObjectID]], None]] = []
+        self._on_ready: List[Callable[[ObjectID], None]] = []  # lint: guarded-by-ok subscribe-at-startup list: appended before threads spawn, read-only afterwards
+        self._on_free: List[Callable[[List[ObjectID]], None]] = []  # lint: guarded-by-ok subscribe-at-startup list: appended before threads spawn, read-only afterwards
 
     def subscribe_ready(self, cb: Callable[[ObjectID], None]):
         self._on_ready.append(cb)
@@ -133,8 +138,9 @@ class ObjectDirectory:
         for cb in waiters:
             try:
                 cb()
-            except Exception:
-                pass
+            except Exception:  # lint: broad-except-ok one bad waiter must not starve the rest; logged below
+                logger.debug("ready-waiter callback for %s failed",
+                             oid.hex(), exc_info=True)
         if pending_free:
             self.decref(oid, 0)  # re-run free logic
 
@@ -165,8 +171,9 @@ class ObjectDirectory:
         for cb in waiters:
             try:
                 cb()
-            except Exception:
-                pass
+            except Exception:  # lint: broad-except-ok one bad waiter must not starve the rest; logged below
+                logger.debug("lost-waiter callback for %s failed",
+                             oid.hex(), exc_info=True)
 
     def mark_node_lost(self, node_id_hex: str,
                        relocate: Optional[Callable] = None
@@ -199,8 +206,9 @@ class ObjectDirectory:
         for cb in waiters:
             try:
                 cb()
-            except Exception:
-                pass
+            except Exception:  # lint: broad-except-ok one bad waiter must not starve the rest; logged below
+                logger.debug("node-lost waiter callback failed",
+                             exc_info=True)
         return lost
 
     def primaries_on_node(self, node_id_hex: str
@@ -239,6 +247,8 @@ class ObjectDirectory:
 
     def entry(self, oid: ObjectID) -> Optional[ObjectEntry]:
         with self._lock:
+            if racedebug.enabled:
+                racedebug.access(self, "_entries")
             return self._entries.get(oid)
 
     def location(self, oid: ObjectID) -> Optional[Tuple]:
@@ -259,6 +269,8 @@ class ObjectDirectory:
     # -- reference counting (driver-side python refs) ----------------------
     def incref(self, oid: ObjectID):
         with self._lock:
+            if racedebug.enabled:
+                racedebug.access(self, "_entries", write=True)
             e = self._entries.setdefault(oid, ObjectEntry())
             e.refcount += 1
             # Journaled under the directory lock: the replay checker
@@ -288,6 +300,8 @@ class ObjectDirectory:
         freed = None
         nested = None
         with self._lock:
+            if racedebug.enabled:
+                racedebug.access(self, "_entries", write=True)
             e = self._entries.get(oid)
             if e is None:
                 return
@@ -356,7 +370,7 @@ class ActorDirectory:
     """Actor table + named-actor registry (reference: GcsActorManager)."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = lockdep.rlock("gcs.actor_dir")
         self._actors: Dict[ActorID, ActorEntry] = {}
         self._named: Dict[Tuple[str, str], ActorID] = {}
 
@@ -516,7 +530,7 @@ class Pubsub:
     """Minimal pubsub for cluster events (reference: src/ray/pubsub/)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("gcs.pubsub")
         self._subs: Dict[str, List[Callable[[Any], None]]] = {}
 
     def subscribe(self, channel: str, cb: Callable[[Any], None]):
@@ -529,8 +543,9 @@ class Pubsub:
         for cb in cbs:
             try:
                 cb(message)
-            except Exception:
-                pass
+            except Exception:  # lint: broad-except-ok one bad subscriber must not starve the rest; logged below
+                logger.debug("pubsub subscriber on %r failed", channel,
+                             exc_info=True)
 
 
 class Gcs:

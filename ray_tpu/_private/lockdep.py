@@ -61,8 +61,14 @@ _DUMP_ENV_VAR = "RAY_TPU_LOCKDEP_DIR"
 
 
 def _env_enabled() -> bool:
-    return os.environ.get(_ENV_VAR, "").strip().lower() in (
-        "1", "true", "yes", "on")
+    # RAY_TPU_RACEDEBUG implies lockdep: the Eraser lockset detector
+    # (racedebug.py) reads the per-thread held stack recorded here, so
+    # the named-lock wrappers must be live whenever it is.
+    for var in (_ENV_VAR, "RAY_TPU_RACEDEBUG"):
+        if os.environ.get(var, "").strip().lower() in (
+                "1", "true", "yes", "on"):
+            return True
+    return False
 
 
 # Falsy-flag gate (fault.py discipline): module attribute, one dict
@@ -185,6 +191,16 @@ def _held_stack() -> List[dict]:
     if stack is None:
         stack = _tls.held = []
     return stack
+
+
+def held_classes() -> frozenset:
+    """Lock CLASSES currently held by the calling thread (racedebug's
+    lockset source). Reflects Condition.wait correctly: _release_save
+    pops the held entry, so a waiter holds nothing while parked."""
+    held = getattr(_tls, "held", None)
+    if not held:
+        return frozenset()
+    return frozenset(entry["name"] for entry in held)
 
 
 def _find_path(src: str, dst: str) -> Optional[List[str]]:

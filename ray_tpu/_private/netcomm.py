@@ -37,6 +37,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from . import fault
 from . import lockdep
 from . import protocol as P
+from . import racedebug
 from . import telemetry
 
 _MAGIC = b"RTX2"
@@ -274,6 +275,8 @@ class SerialExecutor:
                 self._cond.wait(timeout=1.0)
             if self._stopped:
                 return
+            if racedebug.enabled:
+                racedebug.access(self, "_q", write=True)
             self._q.append((fn, args))
             self._cond.notify()
 
@@ -281,7 +284,7 @@ class SerialExecutor:
         """Queued (not yet executing) items — the head's loop-depth
         gauge reads this at exposition time (len() is GIL-atomic on a
         deque; no lock, no hot-path cost)."""
-        return len(self._q)
+        return len(self._q)  # lint: guarded-by-ok exposition-time gauge: len() of a deque is GIL-atomic; no lock on the hot path
 
     def _loop(self):
         while True:
@@ -292,6 +295,8 @@ class SerialExecutor:
                     self._cond.wait()
                 if not self._q and self._stopped:
                     return
+                if racedebug.enabled:
+                    racedebug.access(self, "_q", write=True)
                 fn, args = self._q.popleft()
                 self._busy = True
             try:
@@ -419,7 +424,7 @@ class ConnectionWriter:
     def queued_bytes(self) -> int:
         """Bytes currently queued behind this writer (exposition-time
         gauge; a plain int read, no lock)."""
-        return self._q_bytes
+        return self._q_bytes  # lint: guarded-by-ok exposition-time gauge: plain int read, torn values are harmless
 
     # -- enqueue -------------------------------------------------------
     def send_message(self, msg_type: str, payload: dict):
@@ -447,6 +452,8 @@ class ConnectionWriter:
                 raise self._error
             if self._stopped:
                 raise OSError("connection writer stopped")
+            if racedebug.enabled:
+                racedebug.access(self, "_q", write=True)
             self._q.append(chunks)
             self._q_bytes += nbytes
             self._cond.notify()
@@ -498,6 +505,8 @@ class ConnectionWriter:
         with self._cond:
             if not self._q:
                 return 0
+            if racedebug.enabled:
+                racedebug.access(self, "_q", write=True)
             items = list(self._q)
             self._q.clear()
             self._q_bytes = 0

@@ -25,6 +25,7 @@ from ..util import tracing
 from . import fault
 from . import lockdep
 from . import protocol as P
+from . import racedebug
 from . import telemetry
 from . import wiretap
 from .ids import WorkerID
@@ -142,6 +143,8 @@ class DaemonHandle:
         with self._req_lock:
             self._req_counter += 1
             req_id = self._req_counter
+            if racedebug.enabled:
+                racedebug.access(self, "_pending", write=True)
             self._pending[req_id] = fut
         payload = dict(payload)
         payload["req_id"] = req_id
@@ -157,6 +160,8 @@ class DaemonHandle:
 
     def resolve_reply(self, payload: dict):
         with self._req_lock:
+            if racedebug.enabled:
+                racedebug.access(self, "_pending", write=True)
             fut = self._pending.pop(payload["req_id"], None)
         if fut is not None:
             fut.set_result(payload.get("result"))
@@ -172,12 +177,12 @@ class DaemonHandle:
         """Tear down the writer + routing executor (connection gone)."""
         try:
             self._route_exec.close()
-        except Exception:
-            pass
+        except Exception:  # lint: broad-except-ok teardown of an already-dead link; logged below
+            logger.debug("route-executor close failed", exc_info=True)
         try:
             self._writer.close(flush_timeout=0.5)
-        except Exception:
-            pass
+        except Exception:  # lint: broad-except-ok teardown of an already-dead link; logged below
+            logger.debug("writer close failed", exc_info=True)
 
     # -- worker pool face (mirrors WorkerPool pop/push/remove) ---------
     def pop_idle(self, env_key: str = "") -> Optional[RemoteWorkerProxy]:
@@ -548,14 +553,14 @@ class HeadServer:
                            handle.node_id_hex[:8])
 
     def _route_from_worker(self, handle: DaemonHandle, payload: dict):
-        proxy = handle.proxies.get(payload["worker"])
+        proxy = handle.proxies.get(payload["worker"])  # lint: guarded-by-ok GIL-atomic get on the hot routing path; a miss during registration is indistinguishable from the frame arriving first
         if proxy is None:
             return
         for inner_type, inner_payload in P.load_messages(payload["frame"]):
             self._node._on_worker_message(proxy, inner_type, inner_payload)
 
     def _route_worker_died(self, handle: DaemonHandle, payload: dict):
-        proxy = handle.proxies.get(payload["worker"])
+        proxy = handle.proxies.get(payload["worker"])  # lint: guarded-by-ok GIL-atomic get; the dead_workers fallback below re-checks under the lock
         if proxy is None:
             with handle._lock:
                 handle.dead_workers.add(payload["worker"])
@@ -603,7 +608,10 @@ class HeadServer:
     def all_proxies(self) -> List[RemoteWorkerProxy]:
         out: List[RemoteWorkerProxy] = []
         for d in self.all_daemons():
-            out.extend(d.proxies.values())
+            # Snapshot under the daemon's lock: start_worker/remove
+            # mutate the table concurrently with this iteration.
+            with d._lock:
+                out.extend(d.proxies.values())
         return out
 
     def stop(self):

@@ -27,6 +27,7 @@ from ..exceptions import ObjectStoreFullError
 from ..util import tracing
 from . import fault
 from . import lockdep
+from . import racedebug
 from . import serialization
 from . import telemetry
 from .ids import ObjectID
@@ -382,7 +383,7 @@ class ObjectStore:
 
     @property
     def used_bytes(self) -> int:
-        return self._used
+        return self._used  # lint: guarded-by-ok exposition-time gauge: plain int read feeding heuristics, torn values are harmless
 
     @property
     def capacity(self) -> int:
@@ -399,7 +400,7 @@ class ObjectStore:
     def _pool_bytes(self) -> int:
         # Torn reads across stripes are fine: this feeds capacity
         # heuristics, and each stripe's int is GIL-consistent.
-        return sum(st.bytes for st in self._stripes)
+        return sum(st.bytes for st in self._stripes)  # lint: guarded-by-ok torn reads across stripes feed capacity heuristics only; each stripe int is GIL-consistent
 
     @property
     def pool_reclaimed_bytes(self) -> int:
@@ -614,6 +615,8 @@ class ObjectStore:
                 if staged is None:
                     # mm attaches lazily on first read (_open handles
                     # mm=None).
+                    if racedebug.enabled:
+                        racedebug.access(self, "_segments", write=True)
                     self._segments[object_id] = _Segment(
                         self._path(object_id), None,  # type: ignore[arg-type]
                         size)

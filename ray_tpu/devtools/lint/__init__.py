@@ -38,6 +38,13 @@ Passes (see docs/STATIC_ANALYSIS.md for the full catalog):
     payload-schema      send-site payload shapes match the per-constant
                         schema (orphan keys, phantom consumer reads,
                         compact-tuple arity drift, dead model keys)
+    guarded-by          every read/write of a field registered in
+                        registry.GUARDED_FIELDS happens under its owning
+                        lockdep lock (lexical `with`, HOLDS_LOCK helper,
+                        or reasoned annotation), with registry-rot
+                        detection and a coverage ratchet on new
+                        __init__ fields of guarded classes; dynamic
+                        half: _private/racedebug.py (Eraser locksets)
 
 The protocol model has a dynamic half too: ``_private/wiretap.py``
 replays live frame sequences through the same session DFAs when
@@ -69,4 +76,5 @@ PASS_NAMES = (
     "barrier-coverage",
     "protocol-order",
     "payload-schema",
+    "guarded-by",
 )

@@ -26,7 +26,7 @@ import ast
 from typing import List, Optional, Set
 
 from . import registry
-from .core import LintTree, Violation
+from .core import LintTree, Violation, walk
 
 PASS = "barrier-coverage"
 RULE = "barrier"
@@ -43,7 +43,7 @@ def _p_const(node: ast.AST) -> Optional[str]:
 
 def _barrier_lines(fn: ast.AST) -> List[int]:
     out = []
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if isinstance(node, ast.Call):
             name = None
             if isinstance(node.func, ast.Name):
@@ -63,13 +63,13 @@ def run(tree: LintTree) -> List[Violation]:
         sf = tree.get(rel)
         if sf is None:
             continue
-        for node in ast.walk(sf.tree):
+        for node in walk(sf.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             qual = sf.scope_of(node)
             in_barrier = node.name in registry.REF_BARRIER_FUNCS
             barriers = _barrier_lines(node)
-            for sub in ast.walk(node):
+            for sub in walk(node):
                 if not (isinstance(sub, ast.Call)
                         and isinstance(sub.func, ast.Attribute)
                         and sub.func.attr in registry.BARRIER_SEND_ATTRS
@@ -114,7 +114,7 @@ def run(tree: LintTree) -> List[Violation]:
         for fn in fns:
             barriers = _barrier_lines(fn)
             first_send = None
-            for sub in ast.walk(fn):
+            for sub in walk(fn):
                 if isinstance(sub, ast.Call) \
                         and isinstance(sub.func, ast.Attribute) \
                         and sub.func.attr in (registry.BARRIER_SEND_ATTRS

@@ -18,7 +18,7 @@ import ast
 from typing import List
 
 from . import registry
-from .core import LintTree, Violation
+from .core import LintTree, Violation, walk
 
 PASS = "broad-except"
 RULE = "broad-except"
@@ -58,7 +58,7 @@ def _is_pure_swallow(body: List[ast.stmt]) -> bool:
 def run(tree: LintTree) -> List[Violation]:
     out: List[Violation] = []
     for sf in tree.iter_files(registry.BROAD_EXCEPT_PREFIX):
-        for node in ast.walk(sf.tree):
+        for node in walk(sf.tree):
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not _is_broad(node) or not _is_pure_swallow(node.body):

@@ -100,7 +100,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         baseline_path = DEFAULT_BASELINE if args.root is None else None
 
     tree = LintTree(root)
-    violations = run_passes(tree, args.passes)
+    timings = {}
+    violations = run_passes(tree, args.passes, timings=timings)
     per_pass = {}
     for v in violations:
         per_pass[v.pass_name] = per_pass.get(v.pass_name, 0) + 1
@@ -162,6 +163,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             "new": len(res.new),
             "baselined": len(violations) - len(res.new),
             "per_pass": {k: per_pass.get(k, 0) for k in PASS_NAMES},
+            "per_pass_ms": {k: round(timings[k], 3)
+                            for k in PASS_NAMES if k in timings},
             "stale_fingerprints": sorted(res.fixed),
             "violations": [
                 {"file": v.file, "line": v.line, "pass": v.pass_name,

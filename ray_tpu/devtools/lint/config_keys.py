@@ -15,7 +15,7 @@ from __future__ import annotations
 import ast
 from typing import List, Set
 
-from .core import LintTree, SourceFile, Violation
+from .core import LintTree, SourceFile, Violation, walk
 
 PASS = "config-keys"
 CONFIG_FILE = "_private/config.py"
@@ -24,7 +24,7 @@ _METHODS = {"set", "snapshot"}
 
 
 def parse_default_keys(sf: SourceFile) -> Set[str]:
-    for node in ast.walk(sf.tree):
+    for node in walk(sf.tree):
         if isinstance(node, ast.ClassDef) and node.name == "RayConfig":
             for stmt in node.body:
                 targets = []
@@ -63,7 +63,7 @@ def run(tree: LintTree) -> List[Violation]:
     for sf in tree.iter_files():
         if sf.relpath == CONFIG_FILE:
             continue
-        for node in ast.walk(sf.tree):
+        for node in walk(sf.tree):
             if isinstance(node, ast.Attribute) \
                     and isinstance(node.value, ast.Name) \
                     and node.value.id == "ray_config":

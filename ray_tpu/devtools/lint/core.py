@@ -18,6 +18,7 @@ import ast
 import json
 import os
 import re
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -70,8 +71,20 @@ class SourceFile:
 
     def _annotate(self) -> None:
         scopes: List[str] = []
+        # Every node in lexical (DFS pre-order) order, collected during
+        # the same visit that wires parents/scopes: passes that only
+        # FILTER nodes by type iterate this instead of re-walking the
+        # tree (ast.walk re-derives child lists each call; over a full
+        # run the repeated walks dominate a pass's wall clock).
+        self.nodes: List[ast.AST] = []
 
         def visit(node: ast.AST, parent: Optional[ast.AST]) -> None:
+            # DFS pre-order index + subtree end: nodes[idx:end] is the
+            # node's whole subtree, so walk() serves both full-tree and
+            # per-function scans from the one cached list.
+            node._lint_idx = len(self.nodes)  # type: ignore[attr-defined]
+            node._lint_nodes = self.nodes  # type: ignore[attr-defined]
+            self.nodes.append(node)
             node._lint_parent = parent  # type: ignore[attr-defined]
             node._lint_scope = (  # type: ignore[attr-defined]
                 ".".join(scopes) if scopes else "<module>")
@@ -85,10 +98,18 @@ class SourceFile:
                 visit(child, node)
             if named:
                 scopes.pop()
+            node._lint_end = len(self.nodes)  # type: ignore[attr-defined]
 
         visit(self.tree, None)
 
     # -- helpers used by the passes ------------------------------------
+    def walk(self, node: Optional[ast.AST] = None) -> List[ast.AST]:
+        """The cached DFS pre-order node list — the whole file, or one
+        node's subtree via the module-level :func:`walk`."""
+        if node is None:
+            return self.nodes
+        return walk(node)
+
     def scope_of(self, node: ast.AST) -> str:
         return getattr(node, "_lint_scope", "<module>")
 
@@ -112,11 +133,49 @@ class SourceFile:
         name) is in `qualnames`."""
         wanted = set(qualnames)
         out: List[ast.AST] = []
-        for node in ast.walk(self.tree):
+        for node in self.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and self.scope_of(node) in wanted:
                 out.append(node)
         return out
+
+
+def walk(node: ast.AST) -> List[ast.AST]:
+    """Drop-in for ``ast.walk`` over annotated nodes: returns the cached
+    DFS pre-order subtree slice (node included) recorded while the
+    owning SourceFile wired parents/scopes, so passes do not re-derive
+    child lists on every scan — over a full run the repeated walks
+    dominated several passes' wall clock (the <5s pin in test_lint.py
+    budgets the whole suite). Membership is identical to ``ast.walk``;
+    order is lexical rather than breadth-first. Unannotated nodes
+    (synthetic fixtures, ast.parse done by a pass itself) fall back to
+    the real ``ast.walk``."""
+    nodes = getattr(node, "_lint_nodes", None)
+    if nodes is None:
+        return list(ast.walk(node))
+    return nodes[node._lint_idx:node._lint_end]
+
+
+# Cross-LintTree source cache: a CLI run, the lint test suite, and the
+# fixture helpers each build their own LintTree over the same (mostly
+# unchanged) package dir; parsing + annotating dominates the wall clock,
+# so parsed files are shared across constructions keyed by identity
+# (root, relpath) and content freshness (mtime_ns, size). SourceFile is
+# immutable after construction (passes only read), so sharing is safe.
+_SOURCE_CACHE: Dict[Tuple[str, str, int, int], "SourceFile"] = {}
+_SOURCE_CACHE_MAX = 4096  # fixture mirrors are deleted; bound the keys
+
+
+def _load_source(root: str, relpath: str) -> "SourceFile":
+    st = os.stat(os.path.join(root, relpath))
+    key = (root, relpath, st.st_mtime_ns, st.st_size)
+    sf = _SOURCE_CACHE.get(key)
+    if sf is None:
+        if len(_SOURCE_CACHE) >= _SOURCE_CACHE_MAX:
+            _SOURCE_CACHE.clear()
+        sf = SourceFile(root, relpath)
+        _SOURCE_CACHE[key] = sf
+    return sf
 
 
 class LintTree:
@@ -143,7 +202,7 @@ class LintTree:
                 if any(rel.startswith(p) for p in exclude_prefixes):
                     continue
                 try:
-                    self.files[rel] = SourceFile(self.root, rel)
+                    self.files[rel] = _load_source(self.root, rel)
                 except (SyntaxError, UnicodeDecodeError, OSError) as e:
                     self.parse_errors.append(Violation(
                         "parse", rel, getattr(e, "lineno", 0) or 0,
@@ -162,9 +221,13 @@ class LintTree:
 # pass driver
 # ---------------------------------------------------------------------------
 def run_passes(tree: LintTree,
-               passes: Optional[Iterable[str]] = None) -> List[Violation]:
+               passes: Optional[Iterable[str]] = None,
+               timings: Optional[Dict[str, float]] = None) -> List[Violation]:
+    """Run the named passes (all by default). When `timings` is given it
+    is filled with per-pass wall-clock milliseconds (surfaced in the
+    CLI's ``--format json`` report)."""
     from . import barrier_coverage, broad_except, config_keys, \
-        gate_discipline, lock_discipline, payload_schema, \
+        gate_discipline, guarded_by, lock_discipline, payload_schema, \
         protocol_coverage, protocol_order, ref_discipline
     table = {
         "protocol-coverage": protocol_coverage.run,
@@ -176,11 +239,15 @@ def run_passes(tree: LintTree,
         "barrier-coverage": barrier_coverage.run,
         "protocol-order": protocol_order.run,
         "payload-schema": payload_schema.run,
+        "guarded-by": guarded_by.run,
     }
     names = list(passes) if passes is not None else list(table)
     out: List[Violation] = list(tree.parse_errors)
     for name in names:
+        t0 = time.perf_counter()
         out.extend(table[name](tree))
+        if timings is not None:
+            timings[name] = (time.perf_counter() - t0) * 1e3
     out.sort(key=lambda v: (v.file, v.line, v.pass_name))
     return out
 

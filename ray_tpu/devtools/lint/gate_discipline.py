@@ -26,7 +26,7 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from . import registry
-from .core import LintTree, SourceFile, Violation
+from .core import LintTree, SourceFile, Violation, walk
 
 PASS = "gate-discipline"
 RULE_UNGATED = "ungated-instrumentation"
@@ -55,7 +55,7 @@ def parse_gated_helpers(sf: SourceFile) -> Set[str]:
     out: Set[str] = set()
     for node in sf.tree.body:
         if isinstance(node, ast.FunctionDef):
-            for inner in ast.walk(node):
+            for inner in walk(node):
                 if isinstance(inner, ast.Global) and "_ops" in inner.names:
                     out.add(node.name)
                     break
@@ -132,7 +132,7 @@ def run(tree: LintTree) -> List[Violation]:
 
     for sf in tree.iter_files():
         impl_file = sf.relpath in registry.GATE_IMPL_FILES
-        for node in ast.walk(sf.tree):
+        for node in walk(sf.tree):
             if not isinstance(node, ast.Call):
                 continue
 

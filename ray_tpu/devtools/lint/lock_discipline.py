@@ -23,7 +23,7 @@ import ast
 from typing import Dict, Iterable, List, Optional, Set
 
 from . import registry
-from .core import LintTree, SourceFile, Violation
+from .core import LintTree, SourceFile, Violation, walk
 
 PASS = "lock-discipline"
 RULE = "blocking-under-lock"
@@ -118,7 +118,7 @@ def run(tree: LintTree) -> List[Violation]:
         if sf is None:
             continue
         file_attrs: Set[str] = set().union(*class_attrs.values())
-        for node in ast.walk(sf.tree):
+        for node in walk(sf.tree):
             if not isinstance(node, ast.With):
                 continue
             scope = sf.scope_of(node)

@@ -34,7 +34,7 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from . import protocol_model, registry
-from .core import LintTree, SourceFile, Violation
+from .core import LintTree, SourceFile, Violation, walk
 from .protocol_coverage import PROTOCOL_FILE, parse_planes
 from .protocol_order import Suppressions, _const_lines, iter_send_sites
 
@@ -79,7 +79,7 @@ def _subscript_stores(fn: ast.AST, name: str, before: int) -> Set[str]:
             return [k for elt in target.elts for k in keys_of(elt)]
         return []
 
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if getattr(node, "lineno", before) >= before:
             continue
         if isinstance(node, ast.Assign):
@@ -109,7 +109,7 @@ def resolve_payload(sf: SourceFile, call: ast.Call
         return None
     lit: Optional[ast.Dict] = None
     lit_line = -1
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             target = node.targets[0]
         elif isinstance(node, ast.AnnAssign):  # msg: Dict[...] = {...}
@@ -261,7 +261,7 @@ def run(tree: LintTree) -> List[Violation]:
             pv = set(spec["payload_vars"])
             for fn in fns:
                 qual = sf.scope_of(fn)
-                for node in ast.walk(fn):
+                for node in walk(fn):
                     key = line = None
                     if isinstance(node, ast.Subscript) \
                             and isinstance(node.value, ast.Name) \

@@ -22,7 +22,7 @@ import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from . import registry
-from .core import LintTree, SourceFile, Violation
+from .core import LintTree, SourceFile, Violation, walk
 
 PASS = "protocol-coverage"
 
@@ -142,7 +142,7 @@ def _const_names(node: ast.AST) -> List[str]:
 
 
 def _tests_dispatch_var(test: ast.AST, dispatch_vars: Set[str]) -> bool:
-    for cmp_node in ast.walk(test):
+    for cmp_node in walk(test):
         if isinstance(cmp_node, ast.Compare) \
                 and isinstance(cmp_node.left, ast.Name) \
                 and cmp_node.left.id in dispatch_vars:
@@ -155,7 +155,7 @@ def dispatched_constants(sf: SourceFile, functions, dispatch_vars
     found: Set[str] = set()
     dv = set(dispatch_vars)
     for fn in sf.functions(functions):
-        for node in ast.walk(fn):
+        for node in walk(fn):
             if not isinstance(node, ast.Compare):
                 continue
             if isinstance(node.left, ast.Name) and node.left.id in dv:
@@ -173,7 +173,7 @@ def dispatched_constants(sf: SourceFile, functions, dispatch_vars
 def _chain_heads(sf: SourceFile, fn: ast.AST,
                  dispatch_vars: Set[str]) -> List[ast.If]:
     heads: List[ast.If] = []
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if not (isinstance(node, ast.If)
                 and _tests_dispatch_var(node.test, dispatch_vars)):
             continue
@@ -187,7 +187,7 @@ def _chain_heads(sf: SourceFile, fn: ast.AST,
 
 def _handles_unknown(stmts: List[ast.stmt]) -> bool:
     for stmt in stmts:
-        for node in ast.walk(stmt):
+        for node in walk(stmt):
             if isinstance(node, ast.Raise):
                 return True
             if isinstance(node, ast.Call) \
@@ -266,7 +266,7 @@ def detect_unregistered_loops(tree: LintTree,
         if sf.relpath == PROTOCOL_FILE:
             continue
         registered = registered_by_file.get(sf.relpath, set())
-        for fn in ast.walk(sf.tree):
+        for fn in walk(sf.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             qual = sf.scope_of(fn)
@@ -277,7 +277,7 @@ def detect_unregistered_loops(tree: LintTree,
             if allow:
                 continue
             per_var: Dict[str, Set[str]] = {}
-            for node in ast.walk(fn):
+            for node in walk(fn):
                 if not isinstance(node, ast.Compare):
                     continue
                 if isinstance(node.left, ast.Name):

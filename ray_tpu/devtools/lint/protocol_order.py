@@ -32,7 +32,7 @@ import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from . import protocol_model, registry
-from .core import LintTree, SourceFile, Violation
+from .core import LintTree, SourceFile, Violation, walk
 from .protocol_coverage import PROTOCOL_FILE, dispatched_constants, \
     parse_planes
 
@@ -65,7 +65,7 @@ def iter_send_sites(sf: SourceFile, consts: Set[str]
                     ) -> Iterable[Tuple[ast.Call, str, str]]:
     """Yield (call, CONST, enclosing qualname) for every send of a
     protocol constant in `sf`."""
-    for node in ast.walk(sf.tree):
+    for node in walk(sf.tree):
         if not isinstance(node, ast.Call):
             continue
         const = send_const(node)
@@ -131,7 +131,7 @@ class Suppressions:
 def _scope_at_line(sf: SourceFile, line: int) -> str:
     best = "<module>"
     best_span = None
-    for node in ast.walk(sf.tree):
+    for node in walk(sf.tree):
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         end = node.end_lineno or node.lineno
@@ -159,7 +159,7 @@ def _dotted(node: ast.AST) -> Optional[str]:
 
 def _close_sites(fn: ast.AST) -> List[Tuple[int, str]]:
     out: List[Tuple[int, str]] = []
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if isinstance(node, ast.Call) \
                 and isinstance(node.func, ast.Attribute) \
                 and node.func.attr in registry.PROTOCOL_CLOSE_ATTRS \

@@ -37,7 +37,7 @@ import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from . import registry
-from .core import LintTree, SourceFile, Violation
+from .core import LintTree, SourceFile, Violation, walk
 
 PASS = "ref-discipline"
 PARK_RULE = "ref-park"
@@ -66,7 +66,7 @@ def _call_names(func: ast.AST) -> Iterable[str]:
 
 def _function_calls(fn: ast.AST, names: Set[str]) -> List[ast.Call]:
     out = []
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if isinstance(node, ast.Call):
             for n in _call_names(node.func):
                 if n in names:
@@ -103,7 +103,7 @@ def check_mutation_inventory(tree: LintTree) -> List[Violation]:
         sf = tree.get(rel)
         if sf is None:
             continue
-        for node in ast.walk(sf.tree):
+        for node in walk(sf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and node.name in registry.REF_MUTATION_METHOD_NAMES:
                 qual = sf.scope_of(node)
@@ -140,7 +140,7 @@ def _park_sites(sf: SourceFile, fn: ast.AST) -> List[Tuple[str, int]]:
     ``.append(...)`` calls on one. Whole-attr reassignment (the drain)
     and reads/pops are NOT parks."""
     sites: List[Tuple[str, int]] = []
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if isinstance(node, (ast.Assign, ast.AugAssign)):
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]
@@ -164,7 +164,7 @@ def check_park_pairing(tree: LintTree) -> List[Violation]:
         sf = tree.get(rel)
         if sf is None:
             continue
-        for node in ast.walk(sf.tree):
+        for node in walk(sf.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             qual = sf.scope_of(node)
@@ -199,11 +199,11 @@ def check_park_pairing(tree: LintTree) -> List[Violation]:
 def _escape_tainted_names(fn: ast.AST) -> Set[str]:
     """Local names assigned from an expression that reads escape state."""
     tainted: Set[str] = set()
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if isinstance(node, ast.Assign):
             reads_escape = any(
                 _self_attr(sub) in registry.REF_ESCAPE_STATE
-                for sub in ast.walk(node.value))
+                for sub in walk(node.value))
             if reads_escape:
                 for t in node.targets:
                     if isinstance(t, ast.Name):
@@ -212,7 +212,7 @@ def _escape_tainted_names(fn: ast.AST) -> Set[str]:
 
 
 def _references_escape_state(test: ast.AST, tainted: Set[str]) -> bool:
-    for sub in ast.walk(test):
+    for sub in walk(test):
         if _self_attr(sub) in registry.REF_ESCAPE_STATE:
             return True
         if isinstance(sub, ast.Name) and sub.id in tainted:
@@ -236,7 +236,7 @@ def check_elision_guards(tree: LintTree) -> List[Violation]:
             continue
         for fn in fns:
             tainted = _escape_tainted_names(fn)
-            for node in ast.walk(fn):
+            for node in walk(fn):
                 if not isinstance(node, ast.If):
                     continue
                 if len(node.body) != 1 \
@@ -269,7 +269,7 @@ def _produced_fields(sf: SourceFile, fn: ast.AST, entry_vars: Set[str],
     def note(key: str, line: int) -> None:
         fields.setdefault(key, line)
 
-    for node in ast.walk(fn):
+    for node in walk(fn):
         # {'k': ...} literal bound to an entry var
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
             for t in node.targets:
@@ -301,7 +301,7 @@ def _consumed_fields(fn: ast.AST, payload_vars: Set[str]) -> Set[str]:
     """String keys read off the payload vars: var['k'] loads and
     var.get('k', ...) calls."""
     keys: Set[str] = set()
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if isinstance(node, ast.Subscript) \
                 and isinstance(node.ctx, ast.Load) \
                 and isinstance(node.value, ast.Name) \
@@ -413,7 +413,7 @@ def check_reserve_pairing(tree: LintTree) -> List[Violation]:
         sf = tree.get(rel)
         if sf is None:
             continue
-        for node in ast.walk(sf.tree):
+        for node in walk(sf.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if node.name in registry.RESERVE_CALL_NAMES \

@@ -223,12 +223,13 @@ BLOCKING_MODULES = frozenset({"subprocess", "shutil"})
 # "wiretap" joined in PR 14: the protocol-conformance tap's frame hooks
 # sit on every recv mux and send chokepoint and must be zero-work when
 # RAY_TPU_WIRETAP is off.
-GATED_MODULES = ("telemetry", "fault", "tracing", "refdebug", "wiretap")
+GATED_MODULES = ("telemetry", "fault", "tracing", "refdebug",
+                 "wiretap", "racedebug")
 # Files that implement the planes themselves (helpers live here; their
 # internal calls are exempt from the gating requirement).
 GATE_IMPL_FILES = ("_private/telemetry.py", "_private/fault.py",
                    "util/tracing.py", "_private/refdebug.py",
-                   "_private/wiretap.py")
+                   "_private/wiretap.py", "_private/racedebug.py")
 # Where each gated module's ``_ops``-bumping helpers are parsed from
 # (the functions that MUST be gated at call sites).
 GATED_HELPER_FILES = {
@@ -236,12 +237,266 @@ GATED_HELPER_FILES = {
     "tracing": "util/tracing.py",
     "refdebug": "_private/refdebug.py",
     "wiretap": "_private/wiretap.py",
+    "racedebug": "_private/racedebug.py",
 }
 
 # ---------------------------------------------------------------------------
 # broad-except: scope — only the runtime core is held to the standard.
 # ---------------------------------------------------------------------------
 BROAD_EXCEPT_PREFIX = "_private/"
+
+# ---------------------------------------------------------------------------
+# guarded-by: the field-level data-race tier (static half; dynamic:
+# _private/racedebug.py).
+#
+# GUARDED_FIELDS maps shared mutable attributes of the hot concurrent
+# classes to the lock that guards them:
+#
+#   (file, Class) -> {field: (lock_attr, lockdep_class)}
+#
+# `lock_attr` is the attribute the guarding lock lives at on the same
+# object (`with self.<lock_attr>:` is the guard); `lockdep_class` is
+# the name the lock was created under via the lockdep factory
+# (`self.<lock_attr> = lockdep.lock("<class>")`) — the pass verifies
+# the two still agree, so the static registry and the runtime lockset
+# detector describe the SAME lock and neither can rot silently.
+#
+# Every read/write of a registered field must be lexically under a
+# `with <recv>.<lock_attr>:` of the owning lock, inside a function
+# registered as lock-held (HOLDS_LOCK below), or carry a reasoned
+# `# lint: guarded-by-ok <reason>` annotation. `__init__` is exempt
+# (init-then-publish: the object is not visible to other threads yet —
+# the dynamic half's first-thread state encodes the same exemption).
+#
+# Coverage ratchet: a field assigned in `__init__` of a registered
+# class but absent from its registry entry is flagged
+# (`unregistered-field`) and baselined like broad-except — new fields
+# on these classes must either be registered (and their accesses
+# proven) or annotated with a reason; the debt only burns down.
+# ---------------------------------------------------------------------------
+GUARDED_FIELDS = {
+    # -- gcs.py: the metadata directories ------------------------------
+    ("_private/gcs.py", "ObjectDirectory"): {
+        "_entries": ("_lock", "gcs.object_dir"),
+    },
+    ("_private/gcs.py", "ActorDirectory"): {
+        "_actors": ("_lock", "gcs.actor_dir"),
+        "_named": ("_lock", "gcs.actor_dir"),
+    },
+    ("_private/gcs.py", "Pubsub"): {
+        "_subs": ("_lock", "gcs.pubsub"),
+    },
+    # -- scheduler.py: queues, pools, muxes ----------------------------
+    ("_private/scheduler.py", "ResourceManager"): {
+        "totals": ("_lock", "scheduler.resource_manager"),
+        "available": ("_lock", "scheduler.resource_manager"),
+        "_retired": ("_lock", "scheduler.resource_manager"),
+    },
+    ("_private/scheduler.py", "NodeRegistry"): {
+        "_nodes": ("_lock", "scheduler.node_registry"),
+        "_spread_rr": ("_lock", "scheduler.node_registry"),
+        "_multi_node": ("_lock", "scheduler.node_registry"),
+    },
+    ("_private/scheduler.py", "WorkerHandle"): {
+        "coalesce_buf": ("send_lock", "scheduler.worker_send"),
+        "native_mux": ("send_lock", "scheduler.worker_send"),
+        "native_token": ("send_lock", "scheduler.worker_send"),
+    },
+    ("_private/scheduler.py", "_RecvMux"): {
+        "_pending_add": ("_lock", "scheduler.recv_mux"),
+    },
+    ("_private/scheduler.py", "_NativeMux"): {
+        "_states": ("_lock", "scheduler.native_mux"),
+        "_next_token": ("_lock", "scheduler.native_mux"),
+    },
+    ("_private/scheduler.py", "WorkerPool"): {
+        "_idle": ("_lock", "scheduler.worker_pool"),
+        "workers": ("_lock", "scheduler.worker_pool"),
+    },
+    # NOT registered on Scheduler: _task_node and _cancelled are
+    # deliberately GIL-atomic tables (the pop is the idempotence
+    # arbiter between concurrent failure paths — see
+    # release_task_resources), and _infeasible_since is touched only
+    # by the dispatch-loop thread; their __init__ assignments carry
+    # the reasoned ratchet annotations.
+    ("_private/scheduler.py", "Scheduler"): {
+        "_ready": ("_lock", "scheduler.queue"),
+        "_waiting": ("_lock", "scheduler.queue"),
+        "_leased": ("_lock", "scheduler.queue"),
+        "_free_chips": ("_lock", "scheduler.queue"),
+        "_started_workers": ("_lock", "scheduler.queue"),
+    },
+    # -- runtime.py: the head node's shared tables ---------------------
+    ("_private/runtime.py", "_ActorState"): {
+        "worker": ("lock", "runtime.actor_queue"),
+        "ready": ("lock", "runtime.actor_queue"),
+        "dead": ("lock", "runtime.actor_queue"),
+        "queue": ("lock", "runtime.actor_queue"),
+        "in_flight": ("lock", "runtime.actor_queue"),
+        "seq_settled": ("lock", "runtime.actor_queue"),
+    },
+    ("_private/runtime.py", "Node"): {
+        "_pg_ready_refs": ("_pg_ready_lock", "runtime.pg_ready"),
+        "_draining_nodes": ("_drain_lock", "runtime.drain"),
+        "_drains": ("_drain_lock", "runtime.drain"),
+        "_actor_dep_waiters": ("_actor_dep_lock", "runtime.actor_deps"),
+        "_release_buf": ("_release_lock", "runtime.release_buf"),
+        "_gen_streams": ("_gen_lock", "runtime.gen_streams"),
+        "_chan_waiters": ("_chan_lock", "runtime.chan_broker"),
+        "_chan_token": ("_chan_lock", "runtime.chan_broker"),
+        "_fwd_bufs": ("_fwd_lock", "runtime.result_fwd"),
+        "_fwd_flushing": ("_fwd_lock", "runtime.result_fwd"),
+    },
+    # -- worker_proc.py: the worker's shared tables --------------------
+    ("_private/worker_proc.py", "SequenceGate"): {
+        "_callers": ("_lock", "worker.seq_gate"),
+        "_resync_running": ("_lock", "worker.seq_gate"),
+    },
+    ("_private/worker_proc.py", "Worker"): {
+        "_req_counter": ("_req_lock", "worker.req"),
+        "_pending": ("_req_lock", "worker.req"),
+        "_running": ("_running_lock", "worker.running"),
+        "_done_buf": ("_done_lock", "worker.done"),
+        "_done_flushing": ("_done_lock", "worker.done"),
+        "_actor_loop": ("_actor_loop_lock", "worker.actor_loop"),
+    },
+    # -- daemon.py: the per-host daemon --------------------------------
+    ("_private/daemon.py", "NodeDaemon"): {
+        "_free_chips": ("_lock", "daemon.state"),
+        "_pool_workers": ("_lock", "daemon.state"),
+        "_writer": ("_conn_lock", "daemon.conn"),
+        "_recv_backlog": ("_conn_lock", "daemon.conn"),
+        "_req_counter": ("_req_lock", "daemon.req"),
+        "_pending": ("_req_lock", "daemon.req"),
+    },
+    # -- direct.py: the direct-call plane ------------------------------
+    ("_private/direct.py", "DirectPlane"): {
+        "_chans": ("_cond", "direct.state"),
+        "_results": ("_cond", "direct.state"),
+        "_pending": ("_cond", "direct.state"),
+        "_waiters": ("_cond", "direct.state"),
+        "_refs": ("_cond", "direct.state"),
+        "_ref_buf": ("_cond", "direct.state"),
+        "_done_buf": ("_cond", "direct.state"),
+        "_seq": ("_cond", "direct.state"),
+        "_streams": ("_cond", "direct.state"),
+        "_sub_evts": ("_cond", "direct.state"),
+        "_escaped": ("_cond", "direct.state"),
+        "_pulls": ("_pull_lock", "direct.pulls"),
+        "_pull_seq": ("_pull_lock", "direct.pulls"),
+        "_inflight_pulls": ("_pull_lock", "direct.pulls"),
+        "_serving_pulls": ("_pull_lock", "direct.pulls"),
+        "_link_sems": ("_pull_lock", "direct.pulls"),
+    },
+    # -- netcomm.py: gates, executors, writers -------------------------
+    ("_private/netcomm.py", "HostCopyGate"): {
+        "_queue": ("_lock", "netcomm.host_copy_gate"),
+        "_holders": ("_lock", "netcomm.host_copy_gate"),
+    },
+    ("_private/netcomm.py", "SerialExecutor"): {
+        "_q": ("_cond", "netcomm.serial_exec"),
+        "_stopped": ("_cond", "netcomm.serial_exec"),
+        "_busy": ("_cond", "netcomm.serial_exec"),
+    },
+    ("_private/netcomm.py", "ConnectionWriter"): {
+        "_q": ("_cond", "netcomm.writer"),
+        "_q_bytes": ("_cond", "netcomm.writer"),
+        "_busy": ("_cond", "netcomm.writer"),
+        "_stopped": ("_cond", "netcomm.writer"),
+        "_error": ("_cond", "netcomm.writer"),
+    },
+    ("_private/netcomm.py", "PullManager"): {
+        "_inflight": ("_lock", "netcomm.pull_manager"),
+        "_conns": ("_lock", "netcomm.pull_manager"),
+    },
+    # -- object_store.py: segment tables + pools -----------------------
+    ("_private/object_store.py", "_PoolStripe"): {
+        "cache": ("lock", "object_store.pool_stripe"),
+        "bytes": ("lock", "object_store.pool_stripe"),
+    },
+    ("_private/object_store.py", "ObjectStore"): {
+        "_segments": ("_lock", "object_store.file_store"),
+        "_used": ("_lock", "object_store.file_store"),
+        "_graveyard": ("_lock", "object_store.file_store"),
+        "_freeing": ("_lock", "object_store.file_store"),
+    },
+    ("_private/object_store.py", "ArenaObjectStore"): {
+        "_meta": ("_lock", "object_store.arena_store"),
+        "_access": ("_lock", "object_store.arena_store"),
+        "_clock": ("_lock", "object_store.arena_store"),
+        "_pending_delete": ("_lock", "object_store.arena_store"),
+        "_external": ("_lock", "object_store.arena_store"),
+        "_foreign": ("_lock", "object_store.arena_store"),
+    },
+    # -- node_service.py: the head's daemon registry -------------------
+    ("_private/node_service.py", "DaemonHandle"): {
+        "proxies": ("_lock", "node_service.daemon_handle"),
+        "_idle": ("_lock", "node_service.daemon_handle"),
+        "dead_workers": ("_lock", "node_service.daemon_handle"),
+        "_req_counter": ("_req_lock", "node_service.daemon_req"),
+        "_pending": ("_req_lock", "node_service.daemon_req"),
+    },
+    ("_private/node_service.py", "HeadServer"): {
+        "daemons": ("_lock", "node_service.head_registry"),
+    },
+}
+
+# Functions that run WITH a guarded lock already held by their caller
+# (the `*_locked` convention): (file, qualname) -> {lock_attr, ...}.
+# Checked both directions for rot, like REF_MUTATION_HELPERS: every
+# entry must still exist in the tree, every `*_locked` def in a
+# registered class must be declared here, and every lexical CALL of a
+# declared helper must itself sit under the held lock(s).
+HOLDS_LOCK = {
+    ("_private/scheduler.py", "WorkerHandle._flush_coalesced_locked"):
+        {"send_lock"},
+    ("_private/scheduler.py", "Scheduler._enqueue_locked"): {"_lock"},
+    ("_private/worker_proc.py", "SequenceGate._caller_locked"): {"_lock"},
+    ("_private/worker_proc.py", "SequenceGate._mark_locked"): {"_lock"},
+    ("_private/worker_proc.py", "SequenceGate._admissible_locked"):
+        {"_lock"},
+    ("_private/worker_proc.py", "SequenceGate._hold_locked"): {"_lock"},
+    ("_private/worker_proc.py", "SequenceGate._drain_locked"): {"_lock"},
+    ("_private/worker_proc.py", "SequenceGate._force_oldest_locked"):
+        {"_lock"},
+    ("_private/worker_proc.py", "SequenceGate._ensure_resync_locked"):
+        {"_lock"},
+    ("_private/direct.py", "DirectPlane._flush_accounting_locked"):
+        {"_cond"},
+    ("_private/direct.py", "DirectPlane._seq_state_locked"): {"_cond"},
+    ("_private/direct.py", "DirectPlane._mark_routed_locked"): {"_cond"},
+    ("_private/direct.py", "DirectPlane._settle_seq_locked"): {"_cond"},
+    ("_private/direct.py", "DirectPlane._seq_snapshot_locked"): {"_cond"},
+    ("_private/direct.py", "DirectPlane._cache_put_locked"): {"_cond"},
+    ("_private/direct.py", "DirectPlane._resolve_pending_locked"):
+        {"_cond"},
+    ("_private/direct.py", "DirectPlane._retire_locked"): {"_cond"},
+    ("_private/direct.py", "DirectPlane._retire_stream_locked"): {"_cond"},
+    ("_private/netcomm.py", "HostCopyGate._pump_locked"): {"_lock"},
+    ("_private/runtime.py", "Node._gen_stream_state"): {"_gen_lock"},
+    ("_private/object_store.py", "ObjectStore._collect_graveyard"):
+        {"_lock"},
+    ("_private/object_store.py", "ObjectStore._audit_report_locked"):
+        {"_lock"},
+    ("_private/object_store.py", "ObjectStore._drain_pool_locked"):
+        {"_lock"},
+    ("_private/object_store.py", "ObjectStore._spill_locked"): {"_lock"},
+    ("_private/object_store.py", "ObjectStore._segment_census_locked"):
+        {"_lock"},
+    ("_private/object_store.py", "ObjectStore._spill_candidates_locked"):
+        {"_lock"},
+    ("_private/object_store.py", "ObjectStore._stage_remote_spill_locked"):
+        {"_lock"},
+    ("_private/object_store.py", "ObjectStore._commit_staged_spill_locked"):
+        {"_lock"},
+    ("_private/object_store.py", "ArenaObjectStore._spill_locked"):
+        {"_lock"},
+}
+
+# Attribute names too generic to match on a non-self receiver when
+# resolving cross-object accesses to a registered class's field.
+GUARDED_GENERIC_ATTRS = frozenset({
+    "_lock", "_cond", "lock", "_state", "_queue", "_refs", "_closed"})
 
 # ---------------------------------------------------------------------------
 # ref-discipline: the ownership/refcount conservation surface.

@@ -49,6 +49,19 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_shuffle.py -q \
     -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== racedebug smoke (Eraser lockset detector) =="
+# The dynamic half of the field-level data-race tier: the detector's
+# own suite first (a seeded unprotected-sharing fixture MUST produce a
+# race report with both stacks — proves the tier can still see), then
+# a guarded runtime suite under RAY_TPU_RACEDEBUG=1 via the conftest
+# guard (every tracked field in the hot classes must keep a non-empty
+# lockset — proves the runtime is still clean). test_shuffle above
+# already ran under the guard; test_direct_calls drives the
+# scheduler/worker/reply-table hooks hardest.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_racedebug.py \
+    tests/test_direct_calls.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== perf_smoke + lint-marked tests =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'perf_smoke or lint' \

@@ -193,6 +193,59 @@ def _wiretap_guard(request, tmp_path_factory):
             os.environ["RAY_TPU_WIRETAP_DIR"] = prev_dir
 
 
+# Suites that run under the Eraser-style lockset race detector
+# (_private/racedebug.py): the direct-call, cross-plane, shuffle and
+# chaos tiers drive the hot concurrent classes (scheduler queue,
+# writer queues, reply tables, actor queues) from many threads at
+# once — every tracked field must keep a non-empty candidate lockset
+# for the whole test. Per-test spill dir so a race is attributable to
+# the test that produced it (spawned daemons/workers inherit
+# RAY_TPU_RACEDEBUG=1 and append reports at record time, SIGKILL-safe).
+_RACEDEBUG_SUITES = {"test_direct_calls", "test_cross_plane_ordering",
+                     "test_shuffle", "test_fault_injection"}
+
+
+@pytest.fixture(autouse=True)
+def _racedebug_guard(request, tmp_path_factory):
+    name = getattr(request.module, "__name__", "")
+    if name.rpartition(".")[2] not in _RACEDEBUG_SUITES:
+        yield
+        return
+    from ray_tpu._private import racedebug
+    racedebug.reset()
+    prev = racedebug.enabled
+    dump_dir = str(tmp_path_factory.mktemp("racedebug"))
+    prev_dir = os.environ.get("RAY_TPU_RACEDEBUG_DIR")
+    os.environ["RAY_TPU_RACEDEBUG_DIR"] = dump_dir
+    racedebug.configure(True)
+    try:
+        yield
+        races = racedebug.race_reports()
+        seen = {(r["owner"], r["field"], r.get("pid")) for r in races}
+        for rep in racedebug.collect_dumped_races(dump_dir):
+            key = (rep["owner"], rep["field"], rep.get("pid"))
+            if key not in seen:
+                seen.add(key)
+                races.append(rep)
+        if races:
+            child = [r for r in races if r.get("pid") != os.getpid()]
+            pytest.fail(
+                f"racedebug: {len(races)} potential data race(s) "
+                f"recorded during this test ({len(child)} in child "
+                f"processes):\n" + racedebug.format_reports()
+                + "".join(f"\n[child pid {r.get('pid')}] "
+                          f"{r['owner']}.{r['field']}" for r in child))
+    finally:
+        # configure(prev) restores the racedebug flag only; lockdep —
+        # which racedebug.configure(True) switched on as its lockset
+        # source — is left alone (the lockdep guard owns that flag).
+        racedebug.configure(prev)
+        if prev_dir is None:
+            os.environ.pop("RAY_TPU_RACEDEBUG_DIR", None)
+        else:
+            os.environ["RAY_TPU_RACEDEBUG_DIR"] = prev_dir
+
+
 @pytest.fixture(scope="module")
 def ray_start_shared():
     """Module-shared cluster (reference: ray_start_regular_shared)."""

@@ -40,7 +40,7 @@ def _run(root, passes=None):
 # the live tree
 # ---------------------------------------------------------------------------
 def test_live_tree_zero_unbaselined_violations():
-    """All nine passes over the real package: nothing beyond the
+    """All ten passes over the real package: nothing beyond the
     checked-in baseline (the ratchet contract — any NEW violation
     fails tier-1 right here)."""
     rc = cli.main(["-q"])
@@ -50,14 +50,23 @@ def test_live_tree_zero_unbaselined_violations():
     assert rc == 0
 
 
-def test_live_tree_baseline_is_broad_except_only():
-    """The baseline holds ONLY pre-existing broad-except swallows: the
-    other six passes are clean at zero and must stay there (they have
-    no burn-down debt to hide behind)."""
+def test_live_tree_baseline_is_burndown_debt_only():
+    """The baseline holds ONLY the two burn-down ratchets: pre-existing
+    broad-except swallows and guarded-by COVERAGE debt (fields of
+    registered classes not yet proven). Access violations (unguarded
+    read/write, stale annotations, registry rot) must never be
+    baselined — those fail tier-1 outright; the other eight passes are
+    clean at zero and must stay there."""
     baseline = core.load_baseline(cli.DEFAULT_BASELINE)
     assert baseline, "checked-in baseline missing or empty"
-    wrong = [fp for fp in baseline if not fp.startswith("broad-except:")]
+    wrong = [fp for fp in baseline
+             if not fp.startswith(("broad-except:", "guarded-by:"))]
     assert wrong == []
+    # guarded-by debt is coverage-ratchet ONLY (fingerprint format:
+    # pass:file:scope:key) — never a baselined access violation.
+    bad = [fp for fp in baseline if fp.startswith("guarded-by:")
+           and ":unregistered-field:" not in fp]
+    assert bad == []
 
 
 # ---------------------------------------------------------------------------
@@ -734,10 +743,10 @@ def test_cli_format_github(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
-# budget: the full nine-pass live-tree run must stay interactive
+# budget: the full ten-pass live-tree run must stay interactive
 # ---------------------------------------------------------------------------
 def test_full_tree_wall_clock():
-    """The whole suite (parse once + nine passes) gates tier-1 and the
+    """The whole suite (parse once + ten passes) gates tier-1 and the
     pre-push loop: pin it under 5s so it never becomes a tax anyone is
     tempted to skip."""
     root = os.path.join(REPO, "ray_tpu")
@@ -1192,3 +1201,283 @@ def test_cli_since_refuses_update_baseline(tmp_path):
     assert cli.main(["--root", root, "--update-baseline",
                      "--baseline", bl, "--since", "HEAD"]) == 2
     assert not os.path.exists(bl)
+
+
+# ---------------------------------------------------------------------------
+# guarded-by: field-level lock-coverage proofs
+# ---------------------------------------------------------------------------
+# Mirrors the real registry entries for _private/gcs.py (all three
+# registered classes, so the fixture itself carries no rot flags).
+_GUARDED_GCS = """\
+    from . import lockdep
+
+
+    class ObjectDirectory:
+        def __init__(self):
+            self._lock = lockdep.rlock("gcs.object_dir")
+            self._entries = {}
+
+        def entry(self, oid):
+            with self._lock:
+                return self._entries.get(oid)
+
+        def drop(self, oid):
+            with self._lock:
+                self._entries.pop(oid, None)
+
+
+    class ActorDirectory:
+        def __init__(self):
+            self._lock = lockdep.rlock("gcs.actor_dir")
+            self._actors = {}
+            self._named = {}
+
+        def register(self, aid, name):
+            with self._lock:
+                self._actors[aid] = name
+                self._named[name] = aid
+
+
+    class Pubsub:
+        def __init__(self):
+            self._lock = lockdep.lock("gcs.pubsub")
+            self._subs = {}
+
+        def subscribe(self, topic, fn):
+            with self._lock:
+                self._subs.setdefault(topic, []).append(fn)
+"""
+
+
+def test_guarded_by_clean_fixture(tmp_path):
+    root = _tree(tmp_path, {"_private/gcs.py": _GUARDED_GCS})
+    vs = [v for v in _run(root, ["guarded-by"])
+          if v.file == "_private/gcs.py"]
+    assert vs == []
+
+
+def test_guarded_by_unguarded_access_flagged_and_annotated(tmp_path):
+    """The seeded unguarded-field fixture: a write outside the owning
+    lock is caught BY NAME; a reasoned annotation on the access line
+    suppresses; a read is distinguished from a write in the key."""
+    src = _GUARDED_GCS + """\
+
+        def seeded_unlocked_write(self, topic):
+            self._subs[topic] = []
+
+        def seeded_unlocked_read(self, topic):
+            return self._subs.get(topic)
+
+        def annotated(self, topic):
+            return len(self._subs)  # lint: guarded-by-ok exposition-time gauge, len() is GIL-atomic
+    """
+    root = _tree(tmp_path, {"_private/gcs.py": src})
+    keys = [v.key for v in _run(root, ["guarded-by"])
+            if v.file == "_private/gcs.py"]
+    assert sorted(keys) == ["unguarded-read:Pubsub._subs",
+                            "unguarded-write:Pubsub._subs"]
+
+
+def test_guarded_by_def_line_annotation_covers_function(tmp_path):
+    """An annotation on the def line blesses every guarded access in
+    that function — the idiom for single-thread-phase helpers."""
+    src = _GUARDED_GCS + """\
+
+        def snapshot(self):  # lint: guarded-by-ok startup-only: called before the server threads spawn
+            return dict(self._subs), len(self._subs)
+    """
+    root = _tree(tmp_path, {"_private/gcs.py": src})
+    vs = [v for v in _run(root, ["guarded-by"])
+          if v.file == "_private/gcs.py"]
+    assert vs == []
+
+
+def test_guarded_by_stale_annotation(tmp_path):
+    """An annotation that suppresses nothing (the access it blessed is
+    properly locked, or gone) is itself flagged — drift both ways."""
+    src = _GUARDED_GCS.replace(
+        "                self._subs.setdefault(topic, []).append(fn)",
+        "                self._subs.setdefault(topic, []).append(fn)"
+        "  # lint: guarded-by-ok vestigial reason")
+    assert src != _GUARDED_GCS
+    root = _tree(tmp_path, {"_private/gcs.py": src})
+    keys = [v.key for v in _run(root, ["guarded-by"])
+            if v.file == "_private/gcs.py"]
+    assert len(keys) == 1 and keys[0].startswith("stale-annotation:")
+
+
+def test_guarded_by_registry_rot_class_field_lock(tmp_path):
+    """Registry rot, all three axes: a registered class gone from the
+    file; a registered field never accessed; a guard lock that is not a
+    lockdep-named primitive (the runtime lockset detector could not see
+    it); a lock whose lockdep class diverged from the registry."""
+    gone_cls = _GUARDED_GCS.replace("class Pubsub:", "class PubsubV2:")
+    root = _tree(tmp_path, {"_private/gcs.py": gone_cls})
+    keys = {v.key for v in _run(root, ["guarded-by"])
+            if v.file == "_private/gcs.py"}
+    assert "stale-guarded-class:Pubsub" in keys
+
+    gone_field = _GUARDED_GCS.replace(
+        "            self._named = {}\n", "").replace(
+        "self._named[name] = aid", "pass")
+    root2 = _tree(tmp_path / "f", {"_private/gcs.py": gone_field})
+    keys2 = {v.key for v in _run(str(tmp_path / "f"), ["guarded-by"])
+             if v.file == "_private/gcs.py"}
+    assert "stale-guarded-field:ActorDirectory._named" in keys2
+
+    plain = _GUARDED_GCS.replace(
+        'self._lock = lockdep.lock("gcs.pubsub")',
+        "self._lock = __import__('threading').Lock()")
+    root3 = _tree(tmp_path / "p", {"_private/gcs.py": plain})
+    keys3 = {v.key for v in _run(str(tmp_path / "p"), ["guarded-by"])
+             if v.file == "_private/gcs.py"}
+    assert "unnamed-guard-lock:Pubsub._lock" in keys3
+
+    renamed = _GUARDED_GCS.replace('"gcs.pubsub"', '"gcs.pubsub_v2"')
+    root4 = _tree(tmp_path / "w", {"_private/gcs.py": renamed})
+    keys4 = {v.key for v in _run(str(tmp_path / "w"), ["guarded-by"])
+             if v.file == "_private/gcs.py"}
+    assert "wrong-lock-class:Pubsub._lock" in keys4
+
+
+def test_guarded_by_ratchet_unregistered_init_field(tmp_path):
+    """The coverage ratchet: a NEW field assigned in __init__ of a
+    registered class must be registered or annotated (baselined like
+    broad-except; the debt only burns down). Guard locks are exempt."""
+    src = _GUARDED_GCS.replace(
+        "            self._subs = {}",
+        "            self._subs = {}\n            self._stats = {}")
+    root = _tree(tmp_path, {"_private/gcs.py": src})
+    keys = [v.key for v in _run(root, ["guarded-by"])
+            if v.file == "_private/gcs.py"]
+    assert keys == ["unregistered-field:Pubsub._stats"]
+
+
+def test_guarded_by_holds_lock_and_condition_alias(tmp_path,
+                                                   monkeypatch):
+    """A synthetic registry entry exercises the two lexical-proof
+    extensions: (a) a HOLDS_LOCK helper's body needs no `with` (its
+    callers hold the lock — and an unlocked CALL of it is itself
+    flagged); (b) a Condition constructed over the guard lock aliases
+    it (acquiring either IS holding the guard)."""
+    monkeypatch.setitem(
+        registry.GUARDED_FIELDS, ("_private/fake.py", "Box"),
+        {"_q": ("_lock", "fake.box")})
+    monkeypatch.setitem(
+        registry.HOLDS_LOCK, ("_private/fake.py", "Box._pop_locked"),
+        {"_lock"})
+    src = """\
+        import threading
+
+        from . import lockdep
+
+
+        class Box:
+            def __init__(self):
+                self._lock = lockdep.lock("fake.box")
+                self._cond = threading.Condition(self._lock)  # lint: guarded-by-ok condition alias over the guard lock, not state
+                self._q = []
+
+            def _pop_locked(self):
+                return self._q.pop()
+
+            def good_call(self):
+                with self._lock:
+                    return self._pop_locked()
+
+            def cond_guarded(self, item):
+                with self._cond:
+                    self._q.append(item)
+
+            def bad_call(self):
+                return self._pop_locked()
+    """
+    root = _tree(tmp_path, {"_private/fake.py": src})
+    vs = [v for v in _run(root, ["guarded-by"])
+          if v.file == "_private/fake.py"]
+    assert [(v.scope, v.key) for v in vs] == [
+        ("Box.bad_call", "unguarded-locked-call:Box._pop_locked")]
+
+
+def test_guarded_by_locked_convention_needs_registration(tmp_path):
+    """A *_locked-suffixed method on a registered class without a
+    HOLDS_LOCK entry is flagged: the convention is a claim, and claims
+    must be registered to be checkable."""
+    src = _GUARDED_GCS + """\
+
+        def _purge_locked(self):
+            return len(self._subs)
+    """
+    root = _tree(tmp_path, {"_private/gcs.py": src})
+    keys = [v.key for v in _run(root, ["guarded-by"])
+            if v.file == "_private/gcs.py"]
+    assert "unregistered-locked-helper:Pubsub._purge_locked" in keys
+
+
+def test_guarded_by_unguarded_field_on_real_tree(tmp_path):
+    """Re-introduce the unguarded reply-slot insert into a COPY of the
+    live package: strip the req-lock from Worker.request's bookkeeping
+    — the pass must flag exactly those field accesses by name."""
+    import ray_tpu
+    pkg = os.path.dirname(ray_tpu.__file__)
+    dst = str(tmp_path / "ray_tpu")
+    shutil.copytree(pkg, dst,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    p = os.path.join(dst, "_private", "worker_proc.py")
+    with open(p) as f:
+        src = f.read()
+    locked = """\
+        with self._req_lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+            self._pending[req_id] = fut
+"""
+    seeded_body = """\
+        self._req_counter += 1
+        req_id = self._req_counter
+        self._pending[req_id] = fut
+"""
+    assert locked in src, "live request() bookkeeping moved; update test"
+    with open(p, "w") as f:
+        f.write(src.replace(locked, seeded_body))
+    keys = sorted(v.key for v in _run(dst, ["guarded-by"])
+                  if not v.key.startswith(("unregistered-field:",
+                                           "stale-annotation:")))
+    assert keys == ["unguarded-read:Worker._req_counter",
+                    "unguarded-write:Worker._pending",
+                    "unguarded-write:Worker._req_counter"]
+    # The pristine copy carries no access violations at all (the live
+    # tree's only guarded-by debt is the coverage ratchet).
+    with open(p, "w") as f:
+        f.write(src)
+    assert [v for v in _run(dst, ["guarded-by"])
+            if not v.key.startswith("unregistered-field:")] == []
+
+
+# ---------------------------------------------------------------------------
+# parse-once cache + per-pass timing
+# ---------------------------------------------------------------------------
+def test_source_cache_reuses_parsed_trees(tmp_path):
+    """Two LintTree walks over an unchanged tree parse each file once
+    (keyed by path+mtime+size); an edit invalidates only that entry."""
+    root = _tree(tmp_path, _SWALLOW)
+    t1 = core.LintTree(root)
+    sf_a = t1.get("_private/x.py")
+    t2 = core.LintTree(root)
+    assert t2.get("_private/x.py") is sf_a  # cache hit: same object
+    # Touch the file with different content: fresh parse.
+    p = tmp_path / "_private/x.py"
+    p.write_text("A = 2\n")
+    os.utime(p, (os.path.getmtime(p) + 2, os.path.getmtime(p) + 2))
+    t3 = core.LintTree(root)
+    assert t3.get("_private/x.py") is not sf_a
+
+
+def test_cli_json_reports_per_pass_timing(tmp_path, capsys):
+    root = _tree(tmp_path, _SWALLOW)
+    cli.main(["--root", root, "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    ms = data["per_pass_ms"]
+    assert set(ms) == set(cli.PASS_NAMES)
+    assert all(isinstance(v, (int, float)) and v >= 0
+               for v in ms.values())

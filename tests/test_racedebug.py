@@ -1,0 +1,272 @@
+"""Racedebug (Eraser-style runtime lockset detector) suite: seeded
+unprotected sharing caught with both stacks, the first-thread and
+read-shared exemptions that keep init-then-publish and read-only
+fields quiet, lockset correctness through rlock reentrancy and
+condition.wait, cross-process collection through the spill dir, and
+the zero-work disabled path (perf_smoke, counter-based — the same
+guard pattern as lockdep's)."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from ray_tpu._private import lockdep, racedebug
+
+
+@pytest.fixture(autouse=True)
+def _fresh_racedebug():
+    prev_race = racedebug.enabled
+    prev_lock = lockdep.enabled
+    racedebug.reset()
+    lockdep.reset()
+    yield
+    racedebug.configure(prev_race, propagate_env=False)
+    lockdep.configure(prev_lock, propagate_env=False)
+    racedebug.reset()
+    lockdep.reset()
+
+
+def _in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(10.0)
+    assert not t.is_alive()
+
+
+class _Obj:
+    pass
+
+
+def _touch(obj, field="_table", write=True):
+    racedebug.access(obj, field, write=write)
+
+
+def test_seeded_unlocked_sharing_detected_with_both_stacks():
+    """Two threads writing the same field with NO common lock: the
+    candidate lockset shrinks to empty and exactly one report carries
+    the stacks of both sides of the conflict. (Three accesses needed:
+    the first merely claims FIRST_THREAD; the second arms sharing and
+    records the previous-access stack; the third empties the set.)"""
+    racedebug.configure(True, propagate_env=False)
+    obj = _Obj()
+    _touch(obj)                       # main thread: FIRST_THREAD
+
+    def racer():
+        _touch(obj)                   # second thread, no lock held
+
+    _in_thread(racer)                 # -> SHARED, lockset = {}
+    _touch(obj)                       # refine: empty & empty -> report
+    reports = racedebug.race_reports()
+    assert len(reports) == 1
+    rep = reports[0]
+    assert (rep["owner"], rep["field"]) == ("_Obj", "_table")
+    assert rep["held_b"] == []
+    for key in ("stack_a", "stack_b"):
+        assert "test_racedebug.py" in rep[key], (key, rep[key])
+    assert rep["stack_a"].count("racer")   # previous access: the thread
+    text = racedebug.format_reports()
+    assert "POTENTIAL DATA RACE" in text
+    assert "_Obj._table" in text
+
+
+def test_consistently_locked_sharing_is_clean():
+    racedebug.configure(True, propagate_env=False)
+    lk = lockdep.lock("race.guard")
+    obj = _Obj()
+
+    def worker():
+        for _ in range(5):
+            with lk:
+                _touch(obj)
+
+    worker()
+    _in_thread(worker)
+    _in_thread(worker)
+    assert racedebug.race_reports() == []
+
+
+def test_one_report_per_class_field_pair():
+    """Repeated empty intersections on the same (class, field) are
+    noise after the first; distinct fields still report separately."""
+    racedebug.configure(True, propagate_env=False)
+    obj = _Obj()
+    for field in ("_a", "_b"):
+        _touch(obj, field)
+        _in_thread(lambda f=field: _touch(obj, f))
+        for _ in range(4):
+            _touch(obj, field)
+    reports = racedebug.race_reports()
+    assert len(reports) == 2
+    assert {r["field"] for r in reports} == {"_a", "_b"}
+
+
+def test_first_thread_accesses_never_report():
+    """The init-then-publish idiom: one thread hammering a field
+    unlocked is not sharing — no lockset, no checking, no report."""
+    racedebug.configure(True, propagate_env=False)
+    obj = _Obj()
+    for _ in range(100):
+        _touch(obj)
+    assert racedebug.race_reports() == []
+
+
+def test_read_only_sharing_never_reports():
+    """Build-once/read-everywhere tables: cross-thread READS refine the
+    lockset (to empty, here) but READ_SHARED never escalates without a
+    writer."""
+    racedebug.configure(True, propagate_env=False)
+    obj = _Obj()
+    _touch(obj, write=True)           # builder thread
+    for _ in range(3):
+        _in_thread(lambda: _touch(obj, write=False))
+    assert racedebug.race_reports() == []
+
+
+def test_write_after_read_sharing_reports():
+    """...but the first unprotected WRITE into a read-shared field arms
+    refinement and the empty intersection reports."""
+    racedebug.configure(True, propagate_env=False)
+    obj = _Obj()
+    _touch(obj, write=True)
+    _in_thread(lambda: _touch(obj, write=False))   # READ_SHARED
+    _in_thread(lambda: _touch(obj, write=True))    # SHARED + empty set
+    reports = racedebug.race_reports()
+    assert len(reports) == 1
+    assert reports[0]["kind_b"] == "write"
+
+
+def test_rlock_reentrant_hold_stays_in_lockset():
+    """A reentrant re-acquire must not drop the lock from the held
+    set: accesses at depth 2 still see the guard."""
+    racedebug.configure(True, propagate_env=False)
+    rl = lockdep.rlock("race.re")
+    obj = _Obj()
+
+    def worker():
+        with rl:
+            with rl:
+                assert "race.re" in lockdep.held_classes()
+                _touch(obj)
+
+    worker()
+    _in_thread(worker)
+    _in_thread(worker)
+    assert racedebug.race_reports() == []
+
+
+def test_condition_wait_restores_lockset():
+    """Condition.wait releases the underlying lock (lockdep pops the
+    held entry) and re-acquires on wake: accesses BEFORE and AFTER the
+    wait are both under the guard, so the field stays clean."""
+    racedebug.configure(True, propagate_env=False)
+    cond = lockdep.condition("race.cv")
+    obj = _Obj()
+
+    def worker():
+        with cond:
+            _touch(obj)
+            cond.wait(timeout=0.02)
+            assert "race.cv" in lockdep.held_classes()
+            _touch(obj)
+
+    worker()
+    _in_thread(worker)
+    assert racedebug.race_reports() == []
+
+
+def test_configure_enables_lockdep_as_lockset_source():
+    """racedebug without lockdep would see every lockset empty (the
+    wrappers are plain primitives when lockdep is off): configure(True)
+    therefore switches lockdep on; configure(False) leaves it alone."""
+    lockdep.configure(False, propagate_env=False)
+    racedebug.configure(True, propagate_env=False)
+    assert lockdep.enabled
+    racedebug.configure(False, propagate_env=False)
+    assert lockdep.enabled     # borrowed, not owned
+
+
+def test_env_propagation_to_children():
+    prev = {k: os.environ.get(k)
+            for k in ("RAY_TPU_RACEDEBUG", "RAY_TPU_LOCKDEP")}
+    try:
+        racedebug.configure(True)
+        assert os.environ.get("RAY_TPU_RACEDEBUG") == "1"
+        # The lockset source rides along for spawned daemons/workers.
+        assert os.environ.get("RAY_TPU_LOCKDEP") == "1"
+        racedebug.configure(False)
+        assert "RAY_TPU_RACEDEBUG" not in os.environ
+    finally:
+        for k, v in prev.items():
+            if v is not None:
+                os.environ[k] = v
+            else:
+                os.environ.pop(k, None)
+
+
+def test_child_process_races_collected_via_dump_dir(tmp_path):
+    """Races recorded in spawned processes (which die with their
+    in-memory reports) surface through RAY_TPU_RACEDEBUG_DIR — the
+    channel the conftest guard asserts over for the whole tree."""
+    import subprocess
+    import sys
+    import textwrap
+
+    dump = str(tmp_path)
+    env = dict(os.environ, RAY_TPU_RACEDEBUG="1",
+               RAY_TPU_RACEDEBUG_DIR=dump,
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    child = textwrap.dedent("""\
+        import threading
+        from ray_tpu._private import racedebug
+        class Shared: pass
+        obj = Shared()
+        racedebug.access(obj, "_hits", write=True)
+        def racer():
+            racedebug.access(obj, "_hits", write=True)
+        t = threading.Thread(target=racer); t.start(); t.join()
+        racedebug.access(obj, "_hits", write=True)
+        assert len(racedebug.race_reports()) == 1
+    """)
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    reports = racedebug.collect_dumped_races(dump)
+    assert len(reports) == 1
+    assert (reports[0]["owner"], reports[0]["field"]) == \
+        ("Shared", "_hits")
+    assert reports[0]["pid"] != os.getpid()
+
+
+def test_collect_tolerates_torn_tail(tmp_path):
+    """A writer SIGKILLed mid-append leaves a torn final line; the
+    collector keeps every complete record and skips the fragment."""
+    good = {"owner": "X", "field": "_f", "pid": 1,
+            "lockset_before": [], "thread_b": "t", "kind_b": "write",
+            "held_b": [], "stack_b": "s", "thread_a": "t0",
+            "kind_a": "read", "stack_a": "s0"}
+    path = tmp_path / "racedebug-races-1.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps(good) + "\n")
+        f.write(json.dumps(good)[: 25])   # torn: no newline, cut JSON
+    reports = racedebug.collect_dumped_races(str(tmp_path))
+    assert len(reports) == 1
+    assert reports[0]["owner"] == "X"
+
+
+@pytest.mark.perf_smoke
+def test_disabled_path_does_zero_racedebug_work():
+    """fault.py discipline: call sites gate on the module flag, so a
+    disabled process performs ZERO tracking operations (counter-based,
+    never wall-clock). This is the exact hook shape used in the hot
+    files (scheduler/netcomm/worker_proc/...)."""
+    racedebug.configure(False, propagate_env=False)
+    obj = _Obj()
+    before = racedebug.instrument_ops()
+    for _ in range(5000):
+        if racedebug.enabled:           # the production gate
+            racedebug.access(obj, "_table", write=True)
+    assert racedebug.instrument_ops() == before
+    assert racedebug.race_reports() == []
