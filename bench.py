@@ -1497,6 +1497,127 @@ def _focus_serve_http_multi(ray_tpu):
     return measure
 
 
+def _focus_head_control(ray_tpu):
+    """Head control-plane throughput: 200 stub daemons (real auth +
+    REGISTER_NODE over TCP, zero resources, one client-side selector
+    thread) pump NODE_PING windows; the value is NODE_SYNC acks/s —
+    each ack is one full ping -> head route -> sync round trip, so it
+    prices the head's per-message cost including the O(N) view fanout.
+    Self-contained on purpose: --ab replays this closure inside the
+    stashed HEAD tree, so it only touches long-stable internals
+    (state.get_node, head_server.address, cluster_token, protocol
+    framing)."""
+    import os as _os
+    import selectors
+    import socket as _socket
+    import threading
+    from multiprocessing.connection import Client
+
+    from ray_tpu._private import protocol as _P
+    from ray_tpu._private import state as _state
+
+    node = _state.get_node()
+    address = tuple(node.head_server.address)
+    token = node.cluster_token
+
+    n_stubs = 200
+    conns = []
+    sel = selectors.DefaultSelector()
+    counts = {"acked": 0, "synced": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    for i in range(n_stubs):
+        conn = Client(address, family="AF_INET", authkey=token)
+        payload = {"node_id_hex": f"{0xbe9c0000 + i:08x}" + "00" * 12,
+                   "resources": {}, "transfer_port": 0,
+                   "hostname": f"bench-stub-{i}", "pid": 0, "labels": {}}
+        conn.send_bytes(_P.dump_message(_P.REGISTER_NODE, payload))
+        sock = _socket.socket(fileno=_os.dup(conn.fileno()))
+        sel.register(sock, selectors.EVENT_READ,
+                     (sock, _P.FrameParser()))
+        conns.append(conn)
+
+    scratch = bytearray(1 << 20)
+    view = memoryview(scratch)
+
+    def pump_recv():
+        while not stop.is_set():
+            for key, _ in sel.select(timeout=0.2):
+                sock, parser = key.data
+                while True:
+                    try:
+                        r = sock.recv_into(scratch, len(scratch),
+                                           _socket.MSG_DONTWAIT)
+                    except (BlockingIOError, InterruptedError):
+                        break
+                    except OSError:
+                        r = 0
+                    if r == 0:
+                        try:
+                            sel.unregister(sock)
+                        except (KeyError, ValueError):
+                            pass
+                        break
+                    parser.feed(view[:r])
+                n_ack = n_sync = 0
+                for msg_type, _payload in parser.messages():
+                    if msg_type == _P.NODE_SYNC:
+                        n_sync += 1
+                    elif msg_type == _P.NODE_ACK:
+                        n_ack += 1
+                if n_ack or n_sync:
+                    with lock:
+                        counts["acked"] += n_ack
+                        counts["synced"] += n_sync
+
+    threading.Thread(target=pump_recv, daemon=True,
+                     name="bench-stub-swarm").start()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        with lock:
+            if counts["acked"] >= n_stubs:
+                break
+        time.sleep(0.02)
+    with lock:
+        if counts["acked"] < n_stubs:
+            raise RuntimeError(
+                f"only {counts['acked']}/{n_stubs} stub daemons acked")
+
+    def measure():
+        # The stub fleet (and its registered head-side state) is leaked
+        # at exit like the serve scaffolds — run_focus tears the whole
+        # process down right after the reps.
+        rounds = 8
+        with lock:
+            start = counts["synced"]
+        payload = {"ts": time.time(), "store_used": 0,
+                   "num_workers": 0, "free_chips": 0, "pool_workers": 0}
+        frame = _P.dump_message(_P.NODE_PING, payload)
+        sent = 0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for conn in conns:
+                try:
+                    conn.send_bytes(frame)
+                except OSError:
+                    pass
+                else:
+                    sent += 1
+        want = start + sent
+        wait_until = time.time() + 120
+        while time.time() < wait_until:
+            with lock:
+                if counts["synced"] >= want:
+                    break
+            time.sleep(0.005)
+        dt = time.perf_counter() - t0
+        with lock:
+            done = counts["synced"] - start
+        return done / dt
+    return measure
+
+
 FOCUS_METRICS = {
     "tasks_async_per_s": _focus_tasks_async,
     "put_get_per_s": _focus_put_get,
@@ -1510,6 +1631,7 @@ FOCUS_METRICS = {
     "streaming_gen_items_per_s": _focus_streaming_gen,
     "serve_http_req_per_s": _focus_serve_http,
     "serve_http_multi": _focus_serve_http_multi,
+    "head_control_msgs_per_s": _focus_head_control,
 }
 
 

@@ -97,6 +97,12 @@ class RayConfig:
         "node_host": "127.0.0.1",
         # Fixed head control port (0 = ephemeral).
         "head_port": 0,
+        # Sharded selector event loops owning every daemon connection
+        # on the head (reads, frame reassembly, writer drains — the
+        # reference's GCS asio io_service face). 0 = auto: half the
+        # cores, capped at 2 (control traffic is cheap per event; the
+        # shards exist for fairness, not throughput).
+        "head_event_loops": 0,
         # Daemon heartbeat interval (liveness + load report).
         "node_heartbeat_s": 2.0,
         # Missed heartbeats tolerated before the head declares a node

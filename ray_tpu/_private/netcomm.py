@@ -253,9 +253,18 @@ class SerialExecutor:
     Bounded: past `max_queued` items submit() blocks the caller — the
     graceful degradation back to the old inline-routing throttling,
     instead of unbounded memory growth when handlers fall behind a
-    message flood."""
+    message flood.
+
+    The worker thread is LAZY: spawned on first submit and retired
+    after `_IDLE_EXIT_S` with an empty queue, so an idle connection's
+    executor costs zero threads (at 1,000 registered daemons the head
+    would otherwise park 1,000 route threads that fire a few times a
+    minute). Invariant: queue non-empty => a live thread owns draining
+    it (submit re-spawns under the same condvar the retiree exits
+    under, so no item is ever stranded)."""
 
     _MAX_QUEUED = 10_000
+    _IDLE_EXIT_S = 5.0
 
     def __init__(self, name: str = "serial-exec",
                  max_queued: Optional[int] = None):
@@ -265,9 +274,16 @@ class SerialExecutor:
         self._cond = lockdep.condition("netcomm.serial_exec")
         self._stopped = False
         self._busy = False  # a handler is executing right now
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name=name)
-        self._thread.start()
+        self._name = name  # lint: guarded-by-ok immutable after __init__: thread-name template
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread_locked(self):
+        """Spawn the drain thread if none is live (caller holds _cond)."""
+        t = self._thread
+        if t is None or not t.is_alive():
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name=self._name)
+            self._thread.start()
 
     def submit(self, fn, *args):
         with self._cond:
@@ -278,6 +294,7 @@ class SerialExecutor:
             if racedebug.enabled:
                 racedebug.access(self, "_q", write=True)
             self._q.append((fn, args))
+            self._ensure_thread_locked()
             self._cond.notify()
 
     def qsize(self) -> int:
@@ -292,7 +309,14 @@ class SerialExecutor:
                 self._busy = False
                 self._cond.notify_all()  # close()/submit() waiters
                 while not self._q and not self._stopped:
-                    self._cond.wait()
+                    if (not self._cond.wait(timeout=self._IDLE_EXIT_S)
+                            and not self._q and not self._stopped):
+                        # Idle window expired with an empty queue:
+                        # retire. Clearing _thread under the condvar is
+                        # what lets submit() re-spawn race-free.
+                        if self._thread is threading.current_thread():
+                            self._thread = None
+                        return
                 if not self._q and self._stopped:
                     return
                 if racedebug.enabled:
@@ -575,6 +599,502 @@ class ConnectionWriter:
         t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=1.0)
+
+
+class LoopWriter(ConnectionWriter):
+    """ConnectionWriter without the thread: the owning ControlLoop
+    drains the queue with nonblocking os.writev on EVENT_WRITE. At
+    1,000 daemon connections the threaded writer costs 1,000 parked
+    threads; folding the drain into the head's event loops makes the
+    outbound side O(loops) too (reference: the GCS server's sends ride
+    the same asio io_service as its reads).
+
+    The ConnectionWriter contract is preserved EXACTLY — strict
+    per-connection FIFO (single queue, single drainer: the loop
+    thread), pickle-at-enqueue, first-error latched and re-raised on
+    later send() calls with a one-shot `on_error`, byte-bounded
+    blocking backpressure (bytes accepted-but-not-yet-on-the-wire
+    count against the high-water mark, so a stalled peer still blocks
+    senders instead of growing the process), coalesced one-frame
+    bursts via the same _assemble, and flush()/close() waiting for the
+    wire, not just the queue.
+
+    Arming: senders set write interest through the loop's pending
+    list + self-pipe (never touching the selector cross-thread); the
+    loop drops interest when a drain pass ends idle. The arm runs
+    OUTSIDE _cond — the loop thread nests loop._lock -> writer._cond,
+    so arming under _cond would be the ABBA half."""
+
+    def __init__(self, conn, loop: "ControlLoop",
+                 name: str = "loop-writer",
+                 on_error: Optional[Callable[[BaseException], None]] = None,
+                 max_queued_bytes: Optional[int] = None):
+        super().__init__(conn, name=name, on_error=on_error,
+                         autostart=False, max_queued_bytes=max_queued_bytes)
+        self._loop_owner = loop  # immutable: the owning event loop
+        # Drain state owned by the loop thread (the single drainer):
+        self._pending: List = []  # loop-thread-only (the single drainer)
+        self._pending_items = 0  # loop-thread-only (the single drainer)
+        # Shared with senders under _cond (backpressure + arming).
+        # Guarded by the INHERITED ConnectionWriter._cond (same
+        # "netcomm.writer" lockdep class) — the static pass cannot see
+        # a base-class lock, so the contract is annotated here and
+        # proven dynamically by the lockset detector.
+        self._pending_bytes = 0
+        self._armed = False
+
+    def start(self):
+        """No writer thread: the ControlLoop drains this writer."""
+        return
+
+    def queued_bytes(self) -> int:
+        """Bytes accepted but not yet on the wire (queued + mid-drain;
+        exposition-time gauge, plain int reads)."""
+        return self._q_bytes + self._pending_bytes
+
+    def send_chunks(self, chunks: List):
+        nbytes = sum(P._chunk_len(c) for c in chunks)
+        arm = False
+        with self._cond:
+            # High-water backpressure: pending (drained-but-unsent)
+            # bytes still count — against a zero-window peer the loop
+            # parks the batch in _pending, and senders must block on
+            # that exactly like they blocked on the writer thread.
+            while (self._q_bytes + self._pending_bytes > self._max_q_bytes
+                   and self._error is None and not self._stopped):
+                self._cond.wait(timeout=1.0)
+            if self._error is not None:
+                raise self._error
+            if self._stopped:
+                raise OSError("connection writer stopped")
+            if racedebug.enabled:
+                racedebug.access(self, "_q", write=True)
+            self._q.append(chunks)
+            self._q_bytes += nbytes
+            if not self._armed:
+                self._armed = True
+                arm = True
+        if arm:
+            self._loop_owner.arm_writer(self)
+
+    def _latch_error(self, e: BaseException):
+        with self._cond:
+            self._error = e
+            self._q.clear()
+            self._q_bytes = 0
+            self._pending = []
+            self._pending_bytes = 0
+            self._pending_items = 0
+            self._busy = False
+            self._armed = False
+            self._cond.notify_all()
+        if self._on_error is not None:
+            try:
+                self._on_error(e)
+            except Exception:  # lint: broad-except-ok user error callback on the loop thread; the latched error (raised to later senders) is the real signal
+                pass
+
+    def _drain_nonblocking(self) -> str:
+        """One drain pass on the loop thread. Returns 'idle' (all on
+        the wire; write interest can drop), 'more' (socket
+        backpressure mid-batch; keep EVENT_WRITE armed) or 'dead'
+        (error latched; the read side owns teardown, as with the dead
+        writer thread before)."""
+        while True:
+            if not self._pending:
+                with self._cond:
+                    if self._error is not None:
+                        return "dead"
+                    if not self._q:
+                        self._busy = False
+                        self._armed = False
+                        self._cond.notify_all()  # flush() waiters
+                        return "idle"
+                    if racedebug.enabled:
+                        racedebug.access(self, "_q", write=True)
+                    items = list(self._q)
+                    self._q.clear()
+                    self._pending_bytes += self._q_bytes
+                    self._q_bytes = 0
+                    self._busy = True
+                self._pending = [
+                    v for v in
+                    (memoryview(c).cast("B")
+                     if not isinstance(c, memoryview) else c.cast("B")
+                     for c in self._assemble(items))
+                    if v.nbytes]
+                self._pending_items = len(items)
+            wrote = 0
+            err: Optional[BaseException] = None
+            blocked = False
+            try:
+                while self._pending:
+                    n = os.writev(self._fd, self._pending[:_IOV_MAX])
+                    self.write_calls += 1
+                    wrote += n
+                    while n > 0:
+                        v = self._pending[0]
+                        if n >= v.nbytes:
+                            n -= v.nbytes
+                            self._pending.pop(0)
+                        else:
+                            self._pending[0] = v[n:]
+                            n = 0
+            except (BlockingIOError, InterruptedError):
+                blocked = True
+            except (OSError, ValueError) as e:
+                err = e
+            if wrote:
+                with self._cond:
+                    self._pending_bytes -= wrote
+                    self._cond.notify_all()  # backpressured senders
+            if err is not None:
+                self._latch_error(err)
+                return "dead"
+            if blocked or self._pending:
+                return "more"
+            # One coalesced batch fully on the wire.
+            self.frames_sent += self._pending_items
+            if telemetry.enabled:
+                telemetry.record_writer_batch(self._pending_items)
+            self._pending_items = 0
+            # Loop: the queue may have refilled while we wrote.
+
+
+class _LoopConn:
+    """Per-connection state owned by a ControlLoop (loop thread only)."""
+
+    __slots__ = ("conn", "sock", "fd", "parser", "writer", "on_msgs",
+                 "on_eof", "ctx", "want_write")
+
+    def __init__(self, conn, sock, fd, writer, on_msgs, on_eof, ctx):
+        self.conn = conn          # keep the Connection alive with us
+        self.sock = sock          # dup'd fd wrapped for recv_into
+        self.fd = fd
+        self.parser = P.FrameParser()
+        self.writer = writer      # LoopWriter or None
+        self.on_msgs = on_msgs    # fn(ctx, [(msg_type, payload), ...])
+        self.on_eof = on_eof      # fn(ctx)
+        self.ctx = ctx
+        self.want_write = False
+
+
+class ControlLoop:
+    """One selectors-based control-plane event loop: nonblocking
+    accept, MSG_DONTWAIT reads through per-connection FrameParsers,
+    and LoopWriter drains on EVENT_WRITE — the head-side analogue of
+    the scheduler's _RecvMux, extended with the outbound half
+    (reference: the GCS server's asio io_service owning both
+    directions of every raylet connection;
+    common/asio/instrumented_io_context.h).
+
+    Threading model: the loop thread OWNS the selector and every
+    _LoopConn. Other threads talk to it only through the pending-ops
+    list under `_lock` plus the self-pipe wake (the _RecvMux idiom) —
+    registering connections/acceptors, arming writers. Handlers run ON
+    the loop thread, so they must stay nonblocking-cheap and offload
+    anything slow (node_service routes worker-plane messages to the
+    per-connection SerialExecutor for exactly this reason)."""
+
+    def __init__(self, name: str = "control-loop"):
+        import selectors
+        self._sel = selectors.DefaultSelector()  # lint: guarded-by-ok loop-thread-only after __init__: every selector op runs on _run
+        self._lock = lockdep.lock("netcomm.control_loop")
+        self._pending_ops: list = []
+        self._stopped = False
+        self._conns: Dict[int, _LoopConn] = {}  # lint: guarded-by-ok loop-thread-only table; len() reads for the fd gauge are GIL-atomic
+        self._rd, self._wr = os.pipe()  # lint: guarded-by-ok immutable fd pair after __init__: the self-pipe wake idiom
+        os.set_blocking(self._rd, False)
+        self._sel.register(self._rd, selectors.EVENT_READ, None)
+        # Telemetry counters: loop thread writes, exposition reads
+        # (plain ints; torn reads are harmless scrape noise).
+        self.wakeups = 0  # lint: guarded-by-ok loop-thread writer, exposition-time reader; torn int reads are harmless scrape noise
+        self.iterations = 0  # lint: guarded-by-ok loop-thread writer, exposition-time reader; torn int reads are harmless scrape noise
+        self.last_iter_s = 0.0  # lint: guarded-by-ok loop-thread writer, exposition-time reader; torn float reads are harmless scrape noise
+        self._name = name  # lint: guarded-by-ok immutable after __init__
+        self._thread = threading.Thread(target=self._run, daemon=True,  # lint: guarded-by-ok immutable after __init__: stop() only joins it
+                                        name=name)
+        self._thread.start()
+
+    # -- cross-thread API ----------------------------------------------
+    def add_acceptor(self, sock, on_accept: Callable):
+        """Register a nonblocking listening socket; `on_accept(client)`
+        runs on the loop thread per accepted (blocking-mode) client."""
+        sock.setblocking(False)
+        with self._lock:
+            self._pending_ops.append(("acceptor", sock, on_accept))
+        self._wake()
+
+    def register_conn(self, conn, writer: Optional[LoopWriter],
+                      on_msgs: Callable, on_eof: Callable, ctx):
+        """Adopt an established connection: reads feed a FrameParser
+        and whole frames reach `on_msgs(ctx, msgs)` on the loop
+        thread; EOF/error runs `on_eof(ctx)` once. Any bytes already
+        queued on `writer` are drained at adoption (sends enqueued
+        between handshake and registration are NOT lost)."""
+        with self._lock:
+            self._pending_ops.append(("add", conn, writer, on_msgs,
+                                      on_eof, ctx))
+        self._wake()
+
+    def arm_writer(self, writer: LoopWriter):
+        with self._lock:
+            self._pending_ops.append(("arm", writer))
+        self._wake()
+
+    def registered_fds(self) -> int:
+        """Connections owned by this loop (exposition-time gauge)."""
+        return len(self._conns)
+
+    def backlog_bytes(self) -> int:
+        """Bytes buffered mid-frame across this loop's connections
+        (exposition-time gauge; racy reads under the GIL)."""
+        total = 0
+        try:
+            for state in list(self._conns.values()):
+                total += len(state.parser.buf)
+        except RuntimeError:
+            pass  # table mutating mid-iteration: scrape-time only
+        return total
+
+    def stats(self) -> dict:
+        return {"name": self._name, "fds": self.registered_fds(),
+                "wakeups": self.wakeups, "iterations": self.iterations,
+                "last_iter_s": self.last_iter_s,
+                "backlog_bytes": self.backlog_bytes()}
+
+    def stop(self, join_timeout: float = 2.0):
+        with self._lock:
+            self._stopped = True
+        self._wake()
+        t = self._thread
+        if t is not threading.current_thread():
+            t.join(timeout=join_timeout)
+
+    def _wake(self):
+        try:
+            os.write(self._wr, b"x")
+        except OSError:
+            pass
+
+    # -- loop internals (loop thread only) -----------------------------
+    def _apply_op(self, op):
+        import selectors
+        kind = op[0]
+        if kind == "add":
+            _, conn, writer, on_msgs, on_eof, ctx = op
+            try:
+                fd = conn.fileno()
+                # Nonblocking on the REAL fd: writev must never block
+                # the loop (reads already use MSG_DONTWAIT).
+                os.set_blocking(fd, False)
+                sock = socket.socket(fileno=os.dup(fd))
+            except (OSError, ValueError):
+                self._safe_eof(on_eof, ctx)
+                return
+            state = _LoopConn(conn, sock, fd, writer, on_msgs, on_eof,
+                              ctx)
+            self._conns[fd] = state
+            self._sel.register(fd, selectors.EVENT_READ, state)
+            # Recover sends enqueued before adoption (NODE_ACK and
+            # anything the registration callbacks queued).
+            if writer is not None:
+                self._drain_writer(state)
+        elif kind == "acceptor":
+            _, sock, on_accept = op
+            self._sel.register(sock.fileno(), selectors.EVENT_READ,
+                               ("accept", sock, on_accept))
+        elif kind == "arm":
+            writer = op[1]
+            state = self._conns.get(writer._fd)
+            if state is not None and state.writer is writer:
+                self._drain_writer(state)
+            # Unknown fd: the arm raced adoption — register_conn's
+            # drain-at-adoption covers the queued bytes. Dropped.
+
+    def _drain_writer(self, state: _LoopConn):
+        import selectors
+        res = state.writer._drain_nonblocking()
+        want = res == "more"
+        if want != state.want_write and state.fd in self._conns:
+            state.want_write = want
+            events = selectors.EVENT_READ | (
+                selectors.EVENT_WRITE if want else 0)
+            try:
+                self._sel.modify(state.fd, events, state)
+            except (KeyError, ValueError, OSError):
+                pass
+        # 'dead': error latched; the read side sees the broken socket
+        # and runs the one true teardown path (writer-thread parity).
+
+    def _safe_eof(self, on_eof, ctx):
+        try:
+            on_eof(ctx)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+
+    def _close_conn(self, state: _LoopConn):
+        try:
+            self._sel.unregister(state.fd)
+        except (KeyError, ValueError):
+            pass
+        self._conns.pop(state.fd, None)
+        try:
+            state.sock.close()
+        except OSError:
+            pass
+        self._safe_eof(state.on_eof, state.ctx)
+
+    def _on_acceptable(self, sock, on_accept):
+        while True:
+            try:
+                client, _addr = sock.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            try:
+                on_accept(client)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _on_readable(self, state: _LoopConn, scratch, scratch_view,
+                     scratch_n):
+        eof = False
+        while True:
+            try:
+                r = state.sock.recv_into(scratch, scratch_n,
+                                         socket.MSG_DONTWAIT)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                eof = True
+                break
+            if r == 0:
+                eof = True
+                break
+            state.parser.feed(scratch_view[:r])
+            if r < scratch_n:
+                break
+        for frame in state.parser.frames():
+            try:
+                # One frame may carry a coalesced burst from the
+                # peer's writer; the handler takes the whole batch (it
+                # routes in order — burst framing must not reorder).
+                state.on_msgs(state.ctx, P.load_messages(frame))
+            except Exception:
+                import traceback
+                traceback.print_exc()
+        if eof:
+            self._close_conn(state)
+
+    def _run(self):
+        import time as _t
+
+        import selectors
+        _SCRATCH_N = 1 << 20
+        scratch = bytearray(_SCRATCH_N)
+        scratch_view = memoryview(scratch)
+        while True:
+            with self._lock:
+                ops, self._pending_ops = self._pending_ops, []
+                stopped = self._stopped
+            for op in ops:
+                try:
+                    self._apply_op(op)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+            if stopped:
+                self._shutdown()
+                return
+            try:
+                events = self._sel.select(timeout=1.0)
+            except OSError:
+                continue
+            self.wakeups += 1
+            t0 = _t.monotonic()
+            for key, mask in events:
+                data = key.data
+                if data is None:
+                    try:
+                        while os.read(self._rd, 4096):
+                            pass
+                    except OSError:
+                        pass
+                    continue
+                if isinstance(data, tuple):
+                    self._on_acceptable(data[1], data[2])
+                    continue
+                state: _LoopConn = data
+                if mask & selectors.EVENT_WRITE and state.writer is not None:
+                    self._drain_writer(state)
+                if mask & selectors.EVENT_READ:
+                    self._on_readable(state, scratch, scratch_view,
+                                      _SCRATCH_N)
+            self.iterations += 1
+            self.last_iter_s = _t.monotonic() - t0
+
+    def _shutdown(self):
+        # Close OUR dup'd fds and the selector; the owner (HeadServer
+        # stop) runs connection teardown explicitly — on_eof must not
+        # fire here on top of it.
+        for state in list(self._conns.values()):
+            try:
+                self._sel.unregister(state.fd)
+            except (KeyError, ValueError, OSError):
+                pass
+            try:
+                state.sock.close()
+            except OSError:
+                pass
+        self._conns.clear()
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        for fd in (self._rd, self._wr):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+class ControlLoopGroup:
+    """A small fixed shard of ControlLoops: connections are assigned
+    round-robin at registration and stay put (per-connection ordering
+    lives inside one loop). O(loops) threads for any number of
+    connections — the head's thread ceiling."""
+
+    def __init__(self, n: int, name: str = "control-loop"):
+        n = max(1, int(n))
+        self._loops = [ControlLoop(name=f"{name}-{i}") for i in range(n)]  # lint: guarded-by-ok immutable shard list after __init__
+        self._next = 0
+        self._lock = lockdep.lock("netcomm.control_loop_group")
+
+    def __len__(self) -> int:
+        return len(self._loops)
+
+    def assign(self) -> ControlLoop:
+        with self._lock:
+            i = self._next % len(self._loops)
+            self._next += 1
+        return self._loops[i]
+
+    def add_acceptor(self, sock, on_accept: Callable):
+        self._loops[0].add_acceptor(sock, on_accept)
+
+    def stats(self) -> List[dict]:
+        return [loop.stats() for loop in self._loops]
+
+    def backlog_bytes(self) -> int:
+        return sum(loop.backlog_bytes() for loop in self._loops)
+
+    def stop(self):
+        for loop in self._loops:
+            loop.stop()
 
 
 class TransferServer:

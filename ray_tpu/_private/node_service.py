@@ -93,7 +93,7 @@ class DaemonHandle:
 
     def __init__(self, conn, node_id_hex: str, resources: Dict[str, float],
                  transfer_addr: Tuple[str, int], hostname: str, pid: int,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None, loop=None):
         self.conn = conn
         self.node_id_hex = node_id_hex
         self.resources = resources
@@ -105,14 +105,21 @@ class DaemonHandle:
         self.last_ping = time.time()        # wall clock: display only
         self.last_ping_mono = time.monotonic()  # liveness decisions
         self.load: dict = {}
-        # Outbound writer thread: sends from ANY head thread (scheduler
+        # Outbound writer: sends from ANY head thread (scheduler
         # dispatch, broadcasts, request replies) enqueue here and the
-        # writer coalesces them into one vectored write per wakeup —
-        # the old per-send lock serialized unrelated dispatches on a
-        # write(2) each (netcomm.ConnectionWriter).
-        from .netcomm import ConnectionWriter
-        self._writer = ConnectionWriter(
-            conn, name=f"daemon-writer-{node_id_hex[:8]}")
+        # drain coalesces them into one vectored write per wakeup.
+        # With a ControlLoop the drain rides the loop's EVENT_WRITE
+        # (netcomm.LoopWriter — zero threads per connection); without
+        # one (direct construction in tests) the threaded
+        # ConnectionWriter stands in with identical semantics.
+        if loop is not None:
+            from .netcomm import LoopWriter
+            self._writer = LoopWriter(
+                conn, loop, name=f"daemon-writer-{node_id_hex[:8]}")
+        else:
+            from .netcomm import ConnectionWriter
+            self._writer = ConnectionWriter(
+                conn, name=f"daemon-writer-{node_id_hex[:8]}")
         self._lock = lockdep.lock("node_service.daemon_handle")
         self.proxies: Dict[bytes, RemoteWorkerProxy] = {}
         self._idle: Dict[str, Deque[RemoteWorkerProxy]] = \
@@ -240,23 +247,40 @@ class HeadServer:
     def __init__(self, node, token: bytes, host: str = "127.0.0.1",
                  port: int = 0):
         import socket as _socket
+        from concurrent.futures import ThreadPoolExecutor
+        from .config import ray_config
+        from .netcomm import ControlLoopGroup
         self._node = node
         self._token = token
-        # Raw socket accept + per-connection handshake thread: a client
-        # that connects and sends nothing must not wedge the accept loop
-        # (Listener.accept runs the auth challenge inline, unbounded).
         self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
         self._sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(16)
+        self._sock.listen(128)
         self.address: Tuple[str, int] = self._sock.getsockname()
         self.daemons: Dict[str, DaemonHandle] = {}
         self._lock = lockdep.lock("node_service.head_registry")
         self._stopped = False
         self._stop_event = threading.Event()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="head-accept")
-        self._accept_thread.start()
+        # Sharded selector event loops own every daemon connection —
+        # reads, frame reassembly and writer drains all run on
+        # O(loops) threads instead of 2-3 threads per connection (the
+        # reference's GCS server: one asio io_service face for every
+        # raylet; SURVEY L1). head_event_loops=0 means auto (half the
+        # cores, capped at 2 — control traffic is cheap per event).
+        n_loops = int(ray_config.head_event_loops)
+        if n_loops <= 0:
+            n_loops = min(2, max(1, (os.cpu_count() or 1) // 2))
+        self._loops = ControlLoopGroup(n_loops, name="head-loop")  # lint: guarded-by-ok immutable after __init__: the loop group owns its own locking
+        # The auth challenge + REGISTER_NODE read are BLOCKING
+        # (multiprocessing's deliver/answer_challenge, bounded by a 10s
+        # SO_RCVTIMEO) — a small pool keeps a connect-and-send-nothing
+        # dialer from wedging registration, without hand-rolling the
+        # hmac dance as a nonblocking DFA. Connection teardown (which
+        # drains executors for up to seconds) offloads here too: the
+        # loops must never block on a dying link.
+        self._hs_pool = ThreadPoolExecutor(  # lint: guarded-by-ok immutable after __init__: stdlib executor is internally synchronized
+            max_workers=4, thread_name_prefix="head-handshake")
+        self._loops.add_acceptor(self._sock, self._on_accept)
         # Liveness beyond TCP: a frozen daemon (or a half-open link)
         # keeps its connection "up" while pings stop. Bounded tolerance,
         # then the node is declared dead (reference:
@@ -265,6 +289,11 @@ class HeadServer:
             target=self._heartbeat_monitor, daemon=True,
             name="head-hb-monitor")
         self._monitor_thread.start()
+
+    def loop_stats(self) -> List[dict]:
+        """Per-event-loop gauges (registered fds, wakeups, iteration
+        lag) for the federated /metrics exposition."""
+        return self._loops.stats()
 
     def _heartbeat_monitor(self):
         from .config import ray_config
@@ -334,16 +363,24 @@ class HeadServer:
                 except Exception:  # lint: broad-except-ok fd already closed by the recv loop's finally; either path ends the link
                     pass
 
-    def _accept_loop(self):
-        while not self._stopped:
+    def _on_accept(self, sock):
+        """Loop-thread accept callback: hand the blocking auth
+        handshake to the pool — the loop itself never blocks on a
+        dialer."""
+        if self._stopped:
             try:
-                sock, _addr = self._sock.accept()
+                sock.close()
             except OSError:
-                if self._stopped:
-                    return
-                continue
-            threading.Thread(target=self._serve_daemon, args=(sock,),
-                             daemon=True, name="daemon-conn").start()
+                pass
+            return
+        try:
+            self._hs_pool.submit(self._handshake_and_register, sock)
+        except RuntimeError:
+            # Pool already shut down (stop() raced the accept).
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def _handshake(self, sock):
         """multiprocessing-compatible auth with a deadline, then wrap the
@@ -366,8 +403,12 @@ class HeadServer:
         answer_challenge(conn, self._token)
         return conn
 
-    def _serve_daemon(self, sock):
-        import cloudpickle
+    def _handshake_and_register(self, sock):
+        """Pool-thread registration: blocking auth + the REGISTER_NODE
+        first frame (both bounded by the 10s SO_RCVTIMEO), then the
+        connection is ADOPTED by its assigned event loop — from that
+        point reads, routing and writer drains cost this connection
+        zero dedicated threads."""
         handle: Optional[DaemonHandle] = None
         conn = None
         try:
@@ -406,17 +447,20 @@ class HeadServer:
                 s.close()
             except Exception:  # lint: broad-except-ok peer address is cosmetic; loopback default stands
                 pass
+            loop = self._loops.assign()
             handle = DaemonHandle(
                 conn, payload["node_id_hex"], payload["resources"],
                 (peer_host, payload["transfer_port"]),
                 payload.get("hostname", ""), payload.get("pid", 0),
-                labels=payload.get("labels"))
+                labels=payload.get("labels"), loop=loop)
             if wiretap.enabled:
                 wiretap.frame("daemon", "head", id(handle), "recv",
                               P.REGISTER_NODE, payload)
             # ACK strictly FIRST: registration wakes the scheduler, which
             # may dispatch START_WORKER to this daemon immediately — the
-            # daemon's handshake must not see that before the ack.
+            # daemon's handshake must not see that before the ack. The
+            # enqueue order on the writer queue is the wire order; the
+            # bytes ship when the loop adopts the connection below.
             ack = {
                 "head_node_id_hex": self._node.node_id.hex(),
                 "head_transfer_port": self._node.transfer_port}
@@ -429,54 +473,73 @@ class HeadServer:
                 self.daemons[handle.node_id_hex] = handle
             # A reconnecting daemon's writer may have coalesced early
             # messages (heartbeats, worker relays) into the SAME frame
-            # as REGISTER_NODE; route them now or they are lost.
+            # as REGISTER_NODE; route them now or they are lost. This
+            # MUST precede loop adoption: once the loop owns the fd it
+            # may dispatch later frames, and those must not overtake
+            # the frame-mates.
             for mt, pl in first_msgs[1:]:
                 self._route(handle, mt, pl)
-            while True:
-                data = conn.recv_bytes()
-                # A frame may carry a coalesced burst from the daemon's
-                # writer; expand and route in order.
-                for msg_type, payload in P.load_messages(data):
-                    self._route(handle, msg_type, payload)
-        except (EOFError, OSError):
-            pass
-        except Exception:  # lint: broad-except-ok malformed frame from a skewed daemon; finally runs the one true loss path
-            pass
-        finally:
+            loop.register_conn(conn, handle._writer, self._on_daemon_msgs,
+                               self._on_conn_eof, handle)
+        except Exception:  # noqa: BLE001 — registration failed mid-flight (EOF, reset, malformed frame, or a registration callback); run the one true loss path
             if handle is not None:
-                handle.alive = False
-                # Drain routed-but-unprocessed worker messages (bounded)
-                # BEFORE death handling: completions that arrived ahead
-                # of the EOF must not be retried as failures, exactly as
-                # under the old inline routing.
-                handle.close_link()
-                from ..exceptions import NodeDiedError
-                handle.fail_pending(
-                    NodeDiedError(handle.node_id_hex,
-                                  f"node {handle.node_id_hex[:8]} "
-                                  f"disconnected"))
-                # A reconnecting daemon re-registers the SAME node id on
-                # a fresh connection; this stale connection's cleanup
-                # must not evict the new registration (reference: GCS
-                # node re-registration vs. old-channel teardown race).
-                with self._lock:
-                    current = self.daemons.get(handle.node_id_hex)
-                    superseded = current is not None and current is not handle
-                    if not superseded:
-                        self.daemons.pop(handle.node_id_hex, None)
-                if not self._stopped:
-                    if superseded:
-                        # The node re-registered on a fresh connection;
-                        # keep it alive but fail THIS connection's
-                        # worker proxies (their processes are gone and
-                        # can never report WORKER_DIED).
-                        self._node._fail_daemon_worker_proxies(handle)
-                    else:
-                        self._node._on_daemon_lost(handle)
-            try:
-                conn.close()
-            except Exception:  # lint: broad-except-ok conn may never have opened; teardown is idempotent
-                pass
+                self._teardown_conn(handle)
+            elif conn is not None:
+                try:
+                    conn.close()
+                except Exception:  # lint: broad-except-ok conn half-open from a failed handshake; teardown is idempotent
+                    pass
+
+    def _on_daemon_msgs(self, handle: DaemonHandle, msgs):
+        """Loop-thread frame dispatch: a frame may carry a coalesced
+        burst from the daemon's writer; expand and route in order."""
+        for msg_type, payload in msgs:
+            self._route(handle, msg_type, payload)
+
+    def _on_conn_eof(self, handle: DaemonHandle):
+        """Loop-thread EOF/error: the loop already dropped the fd;
+        offload the teardown (executor drains block for up to seconds
+        and must never stall the other connections on this loop)."""
+        try:
+            self._hs_pool.submit(self._teardown_conn, handle)
+        except RuntimeError:
+            # Pool gone: stop() owns teardown of every live handle.
+            pass
+
+    def _teardown_conn(self, handle: DaemonHandle):
+        handle.alive = False
+        # Drain routed-but-unprocessed worker messages (bounded)
+        # BEFORE death handling: completions that arrived ahead
+        # of the EOF must not be retried as failures, exactly as
+        # under the old inline routing.
+        handle.close_link()
+        from ..exceptions import NodeDiedError
+        handle.fail_pending(
+            NodeDiedError(handle.node_id_hex,
+                          f"node {handle.node_id_hex[:8]} "
+                          f"disconnected"))
+        # A reconnecting daemon re-registers the SAME node id on
+        # a fresh connection; this stale connection's cleanup
+        # must not evict the new registration (reference: GCS
+        # node re-registration vs. old-channel teardown race).
+        with self._lock:
+            current = self.daemons.get(handle.node_id_hex)
+            superseded = current is not None and current is not handle
+            if not superseded:
+                self.daemons.pop(handle.node_id_hex, None)
+        if not self._stopped:
+            if superseded:
+                # The node re-registered on a fresh connection;
+                # keep it alive but fail THIS connection's
+                # worker proxies (their processes are gone and
+                # can never report WORKER_DIED).
+                self._node._fail_daemon_worker_proxies(handle)
+            else:
+                self._node._on_daemon_lost(handle)
+        try:
+            handle.conn.close()
+        except Exception:  # lint: broad-except-ok conn may already be closed; teardown is idempotent
+            pass
 
     def _route(self, handle: DaemonHandle, msg_type: str, payload: dict):
         # Worker-plane messages run on the handle's ordered executor,
@@ -617,13 +680,12 @@ class HeadServer:
     def stop(self):
         self._stopped = True
         self._stop_event.set()
-        try:
-            self._sock.close()
-        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
-            pass
         with self._lock:
             daemons = list(self.daemons.values())
             self.daemons.clear()
+        # Goodbyes FIRST, while the loops still drain writers; the
+        # flush bounds how long each daemon's SHUTDOWN_NODE may take to
+        # reach the wire.
         for d in daemons:
             try:
                 d.send(P.SHUTDOWN_NODE, {})
@@ -633,7 +695,19 @@ class HeadServer:
                 d._writer.flush(0.5)
             except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
                 pass
+        self._loops.stop()
+        self._hs_pool.shutdown(wait=False)
+        try:
+            self._sock.close()
+        except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead
+            pass
+        from ..exceptions import NodeDiedError
+        for d in daemons:
+            d.alive = False
             d.close_link()
+            d.fail_pending(NodeDiedError(
+                d.node_id_hex,
+                f"node {d.node_id_hex[:8]} disconnected"))
             try:
                 d.conn.close()
             except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead

@@ -870,6 +870,32 @@ def _refresh_head_self_gauges(node) -> None:
     _metric("head_writer_queue_bytes", "gauge",
             "Bytes queued on the head's outbound connection writers"
             ).set(float(writer_bytes))
+    try:
+        stats = node.head_server.loop_stats()
+    except Exception:  # lint: broad-except-ok head server may be absent/tearing down mid-scrape; exposition must not 500
+        stats = []
+        logger.debug("event-loop gauge refresh failed", exc_info=True)
+    if stats:
+        fds_m = _metric("head_loop_fds", "gauge",
+                        "Daemon connections registered per head "
+                        "control-plane event loop", tag_keys=("loop",))
+        lag_m = _metric("head_loop_iter_lag_s", "gauge",
+                        "Seconds the last dispatch pass of each head "
+                        "event loop spent off select()",
+                        tag_keys=("loop",))
+        wake_m = _metric("head_loop_wakeups_total", "gauge",
+                         "select() returns per head event loop since "
+                         "start (with the iteration counter this "
+                         "yields wakeups/s)", tag_keys=("loop",))
+        backlog_m = _metric("head_loop_backlog_bytes", "gauge",
+                            "Bytes buffered mid-frame per head event "
+                            "loop", tag_keys=("loop",))
+        for st in stats:
+            tags = {"loop": st["name"]}
+            fds_m.set(float(st["fds"]), tags=tags)
+            lag_m.set(float(st["last_iter_s"]), tags=tags)
+            wake_m.set(float(st["wakeups"]), tags=tags)
+            backlog_m.set(float(st["backlog_bytes"]), tags=tags)
 
 
 def federated_prometheus_text(node) -> str:

@@ -88,7 +88,9 @@ RECV_LOOPS = {
     },
     "head.daemon_serve": {
         "file": "_private/node_service.py",
-        "functions": ("HeadServer._serve_daemon", "HeadServer._route"),
+        "functions": ("HeadServer._handshake_and_register",
+                      "HeadServer._on_daemon_msgs",
+                      "HeadServer._route"),
         "plane": "daemon_to_head",
         "dispatch_vars": ("msg_type",),
         "fallthrough": "HeadServer._route",
@@ -174,6 +176,8 @@ FALLTHROUGH_HANDLER_ATTRS = frozenset({
 # ---------------------------------------------------------------------------
 HOT_LOCKS = {
     ("_private/netcomm.py", "ConnectionWriter"): {"_cond"},
+    ("_private/netcomm.py", "LoopWriter"): {"_cond"},
+    ("_private/netcomm.py", "ControlLoop"): {"_lock"},
     ("_private/netcomm.py", "SerialExecutor"): {"_cond"},
     ("_private/netcomm.py", "HostCopyGate"): {"_lock"},
     ("_private/scheduler.py", "Scheduler"): {"_lock", "_cond"},
@@ -397,6 +401,9 @@ GUARDED_FIELDS = {
         "_q": ("_cond", "netcomm.serial_exec"),
         "_stopped": ("_cond", "netcomm.serial_exec"),
         "_busy": ("_cond", "netcomm.serial_exec"),
+        # Lazy drain thread: spawned/retired under the condvar so the
+        # queue-non-empty => thread-alive invariant holds.
+        "_thread": ("_cond", "netcomm.serial_exec"),
     },
     ("_private/netcomm.py", "ConnectionWriter"): {
         "_q": ("_cond", "netcomm.writer"),
@@ -404,6 +411,15 @@ GUARDED_FIELDS = {
         "_busy": ("_cond", "netcomm.writer"),
         "_stopped": ("_cond", "netcomm.writer"),
         "_error": ("_cond", "netcomm.writer"),
+    },
+    ("_private/netcomm.py", "ControlLoop"): {
+        # Cross-thread seam of the head event loop: every other field
+        # is loop-thread-owned (the _RecvMux model).
+        "_pending_ops": ("_lock", "netcomm.control_loop"),
+        "_stopped": ("_lock", "netcomm.control_loop"),
+    },
+    ("_private/netcomm.py", "ControlLoopGroup"): {
+        "_next": ("_lock", "netcomm.control_loop_group"),
     },
     ("_private/netcomm.py", "PullManager"): {
         "_inflight": ("_lock", "netcomm.pull_manager"),
@@ -473,6 +489,8 @@ HOLDS_LOCK = {
     ("_private/direct.py", "DirectPlane._retire_locked"): {"_cond"},
     ("_private/direct.py", "DirectPlane._retire_stream_locked"): {"_cond"},
     ("_private/netcomm.py", "HostCopyGate._pump_locked"): {"_lock"},
+    ("_private/netcomm.py", "SerialExecutor._ensure_thread_locked"):
+        {"_cond"},
     ("_private/runtime.py", "Node._gen_stream_state"): {"_gen_lock"},
     ("_private/object_store.py", "ObjectStore._collect_graveyard"):
         {"_lock"},
@@ -838,7 +856,7 @@ PROTOCOL_SEND_FUNCS = {
         (("daemon", "head", ("REGISTERED",)),),
     ("_private/node_service.py", "DaemonHandle.start_worker"):
         (("daemon", "head", ("REGISTERED",)),),
-    ("_private/node_service.py", "HeadServer._serve_daemon"):
+    ("_private/node_service.py", "HeadServer._handshake_and_register"):
         (("daemon", "head", ("NEW",)),),
     ("_private/node_service.py", "HeadServer._route"):
         (("daemon", "head", ("REGISTERED",)),),
@@ -933,7 +951,7 @@ PAYLOAD_CONSUMERS = {
     ),
     "REGISTER_NODE": (
         {"file": "_private/node_service.py",
-         "functions": ("HeadServer._serve_daemon",),
+         "functions": ("HeadServer._handshake_and_register",),
          "payload_vars": ("payload",)},
     ),
 }

@@ -62,6 +62,16 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_racedebug.py \
     tests/test_direct_calls.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== scale-sim smoke (stub-daemon fleet vs the event-loop head) =="
+# Seconds-scale slice of the virtual-scale tier: ~50 protocol-speaking
+# stub daemons attach to a real head under the wiretap, asserting
+# clean DFA journals on both ends and the head thread ceiling
+# (O(event loops), not O(connections)) — the thread-per-connection
+# regression fails here, not at the 1,000-node tier.
+env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_scale_sim.py::test_scale_smoke_wiretap -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== perf_smoke + lint-marked tests =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'perf_smoke or lint' \

@@ -1,0 +1,464 @@
+"""Virtual-scale tier: hundreds of protocol-speaking stub daemons
+against one real head.
+
+Reference strategy: Ray sizes the GCS for thousands of raylets by
+keeping the head on a handful of gRPC event loops (GcsServer's
+io_contexts + ray_syncer) and proves it with many_nodes release tests
+that attach simulated raylets. Here the stubs are not subprocesses:
+each is one TCP connection speaking the real daemon wire protocol
+(auth handshake, REGISTER_NODE/NODE_ACK, NODE_PING/NODE_SYNC), driven
+and *validated* by the protocol-model session DFAs so a stub that
+drifts from the protocol fails the test rather than silently skewing
+the measurement. One test-side selector thread serves every stub —
+the swarm itself must not be the thread wall it exists to detect.
+
+What the tier judges (straight from the PR 7 / PR 20 metrics):
+  - head msgs/s: `head_ingest_messages{msg_type="NODE_PING"}` deltas
+  - heartbeat RTT p99: `node_heartbeat_rtt_s` buckets (stubs record
+    the ping->sync round trip into the in-process registry exactly
+    where a real daemon would)
+  - scheduler dispatch latency: `scheduler_dispatch_latency_s` after
+    real nop tasks on the head's own workers, with the stub fleet
+    attached (control-plane load must not starve dispatch)
+  - head thread count: O(event loops), not O(connections)
+"""
+
+import os
+import re
+import selectors
+import socket
+import threading
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu._private import protocol as P
+from ray_tpu._private import state as rt_state
+from ray_tpu._private import telemetry
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.devtools.lint import protocol_model
+from ray_tpu.devtools.lint.protocol_model import SessionDFA
+
+# The DFA speaks constant NAMES; the wire speaks their values (the
+# same mapping the wiretap builds at configure time).
+_WIRE_TO_CONST = {
+    getattr(P, name): name
+    for name in protocol_model.all_modeled_constants()
+    if getattr(P, name, None) is not None
+}
+
+
+# -- stub swarm --------------------------------------------------------------
+
+class _Stub:
+    __slots__ = ("idx", "hexid", "conn", "sock", "parser", "dfa", "lock",
+                 "acked", "synced", "ping_sent_mono", "rtts", "violations")
+
+    def __init__(self, idx, conn):
+        self.idx = idx
+        self.hexid = f"{0xfade0000 + idx:08x}" + "00" * 12
+        self.conn = conn
+        # MSG_DONTWAIT reads work on the blocking fd, so the pump can
+        # keep using plain blocking send_bytes on `conn`.
+        self.sock = socket.socket(fileno=os.dup(conn.fileno()))
+        self.parser = P.FrameParser()
+        # Honesty tap: every frame this stub sends or receives replays
+        # through the modeled daemon session.
+        self.dfa = SessionDFA("daemon", "daemon", f"stub-{idx}")
+        self.lock = threading.Lock()
+        self.acked = False
+        self.synced = 0
+        self.ping_sent_mono = None
+        self.rtts = []
+        self.violations = []
+
+
+class StubSwarm:
+    """N protocol-speaking stub daemons on ONE selector thread."""
+
+    def __init__(self, address, token, n):
+        self.address = tuple(address)
+        self.token = token
+        self.n = n
+        self.stubs = []
+        self._sel = selectors.DefaultSelector()
+        self._stop = threading.Event()
+        self._thread = None
+        self._scratch = bytearray(1 << 20)
+
+    def dial(self, deadline_s=180.0):
+        """Connect + authenticate + register stubs sequentially.
+        Returns how many attached (an fd ceiling caps gracefully)."""
+        from multiprocessing.connection import Client
+        t0 = time.monotonic()
+        for i in range(self.n):
+            if time.monotonic() - t0 > deadline_s:
+                break
+            try:
+                conn = Client(self.address, family="AF_INET",
+                              authkey=self.token)
+                stub = _Stub(i, conn)
+            except OSError:
+                break  # out of fds: attach what we can
+            payload = {"node_id_hex": stub.hexid, "resources": {},
+                       "transfer_port": 0, "hostname": f"stub-{i}",
+                       "pid": 0, "labels": {"stub": "1"}}
+            stub.violations += stub.dfa.feed("send", "REGISTER_NODE",
+                                             payload)
+            conn.send_bytes(P.dump_message(P.REGISTER_NODE, payload))
+            self._sel.register(stub.sock, selectors.EVENT_READ, stub)
+            self.stubs.append(stub)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="stub-swarm")
+        self._thread.start()
+        return len(self.stubs)
+
+    def _loop(self):
+        scratch = self._scratch
+        view = memoryview(scratch)
+        while not self._stop.is_set():
+            for key, _ in self._sel.select(timeout=0.2):
+                stub = key.data
+                eof = False
+                while True:
+                    try:
+                        r = stub.sock.recv_into(scratch, len(scratch),
+                                                socket.MSG_DONTWAIT)
+                    except (BlockingIOError, InterruptedError):
+                        break
+                    except OSError:
+                        eof = True
+                        break
+                    if r == 0:
+                        eof = True
+                        break
+                    stub.parser.feed(view[:r])
+                for msg_type, payload in stub.parser.messages():
+                    self._on_msg(stub, msg_type, payload)
+                if eof:
+                    try:
+                        self._sel.unregister(stub.sock)
+                    except (KeyError, ValueError):
+                        pass
+                    stub.sock.close()
+
+    def _on_msg(self, stub, msg_type, payload):
+        const = _WIRE_TO_CONST.get(msg_type)
+        with stub.lock:
+            if const is None:
+                stub.violations.append(
+                    {"kind": "unmodeled-recv", "const": msg_type,
+                     "conn": f"stub-{stub.idx}"})
+                return
+            stub.violations += stub.dfa.feed("recv", const, payload)
+            if msg_type == P.NODE_ACK:
+                stub.acked = True
+            elif msg_type == P.NODE_SYNC:
+                stub.synced += 1
+                sent = stub.ping_sent_mono
+                if sent is not None:
+                    stub.ping_sent_mono = None
+                    dt = time.monotonic() - sent
+                    stub.rtts.append(dt)
+                    # Same registry a real daemon would write: the
+                    # RTT tier reads this back out of /metrics.
+                    telemetry.record_heartbeat_rtt(dt)
+
+    def wait_acked(self, timeout=90.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(s.acked for s in self.stubs):
+                return True
+            time.sleep(0.05)
+        return all(s.acked for s in self.stubs)
+
+    def ping_round(self):
+        """One NODE_PING from every acked stub; returns sends."""
+        now = time.time()
+        sent = 0
+        for stub in self.stubs:
+            if not stub.acked:
+                continue
+            payload = {"ts": now, "store_used": 0, "num_workers": 0,
+                       "free_chips": 0, "pool_workers": 0}
+            with stub.lock:
+                stub.violations += stub.dfa.feed("send", "NODE_PING",
+                                                 payload)
+                if stub.ping_sent_mono is None:
+                    stub.ping_sent_mono = time.monotonic()
+            try:
+                stub.conn.send_bytes(P.dump_message(P.NODE_PING, payload))
+                sent += 1
+            except OSError:
+                pass
+        return sent
+
+    def wait_synced(self, want, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.total_synced() >= want:
+                return True
+            time.sleep(0.05)
+        return self.total_synced() >= want
+
+    def total_synced(self):
+        return sum(s.synced for s in self.stubs)
+
+    def all_violations(self):
+        out = []
+        for s in self.stubs:
+            with s.lock:
+                out += s.violations
+        return out
+
+    def stop(self):
+        for s in self.stubs:
+            try:
+                s.conn.close()
+            except OSError:
+                pass
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        for s in self.stubs:
+            try:
+                s.sock.close()
+            except OSError:
+                pass
+
+
+# -- metric readers (the PR 7 exposition IS the measurement API) -------------
+
+def _federated_text():
+    return telemetry.federated_prometheus_text(rt_state.get_node())
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$")
+
+
+def _sample_sum(text, name, must_contain=()):
+    """Sum of all exposition samples named exactly `name` whose label
+    block contains every substring in `must_contain`. None if absent."""
+    total, found = 0.0, False
+    for line in text.splitlines():
+        m = _SAMPLE_RE.match(line)
+        if not m or m.group(1) != name:
+            continue
+        labels = m.group(2) or ""
+        if all(s in labels for s in must_contain):
+            total += float(m.group(3))
+            found = True
+    return total if found else None
+
+
+def _hist_cum(text, name):
+    """Cumulative bucket counts (le -> count) of histogram `name`,
+    summed across every tag series in the federated text."""
+    by_le = {}
+    for line in text.splitlines():
+        m = _SAMPLE_RE.match(line)
+        if not m or m.group(1) != name + "_bucket":
+            continue
+        lm = re.search(r'le="([^"]+)"', m.group(2) or "")
+        if lm is None:
+            continue
+        le = float("inf") if lm.group(1) == "+Inf" else float(lm.group(1))
+        by_le[le] = by_le.get(le, 0.0) + float(m.group(3))
+    return by_le
+
+
+def _hist_p99_window(before, after):
+    """Estimated p99 (upper bucket bound) of the observations that
+    landed between two `_hist_cum` snapshots — the registry is
+    process-global and cumulative, so scenario assertions must diff
+    their own window. None if the window saw no observations."""
+    delta = {le: after.get(le, 0.0) - before.get(le, 0.0)
+             for le in after}
+    total = delta.get(float("inf"), 0.0)
+    if total <= 0:
+        return None
+    for le in sorted(delta):
+        if delta[le] >= 0.99 * total:
+            return le
+    return float("inf")
+
+
+# -- head thread accounting --------------------------------------------------
+
+def _assert_head_threads_o_loops(node, n_stubs, threads_before):
+    """The whole point of PR 20: attaching N connections must not have
+    added O(N) threads. Per-connection recv threads and writer threads
+    are gone entirely; loops are the configured handful. Counts are
+    relative to `threads_before` — under the full suite the process
+    inherits leaked threads from earlier tests, which are not ours to
+    assert on."""
+    names = [t.name for t in threading.enumerate()]
+    conn_threads = [nm for nm in names if nm.startswith("daemon-conn")]
+    writer_threads = [nm for nm in names
+                      if nm.startswith("daemon-writer-")]
+    loops = [nm for nm in names if nm.startswith("head-loop-")]
+    route = [nm for nm in names if nm.startswith("daemon-route-")]
+    assert not conn_threads, f"per-conn recv threads: {conn_threads}"
+    assert not writer_threads, f"per-conn writer threads: {writer_threads}"
+    assert len(loops) <= len(node.head_server._loops), (
+        f"{len(loops)} event-loop threads for "
+        f"{len(node.head_server._loops)} configured loops")
+    # Route executors are lazy and idle-retiring; heartbeats are routed
+    # inline on the loop so stubs never spawn one.
+    assert len(route) < max(8, n_stubs // 8), (
+        f"{len(route)} route threads for {n_stubs} stub connections")
+    grown = threading.active_count() - threads_before
+    assert grown < n_stubs, (
+        f"thread count grew by {grown} with {n_stubs} connections "
+        f"attached — head is back to O(connections)")
+
+
+def _drain_daemons(node, timeout=60.0):
+    """Close-side settle: wait for the head to tear down every stub."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not node.head_server.daemons:
+            return True
+        time.sleep(0.05)
+    return not node.head_server.daemons
+
+
+# -- the scenario ------------------------------------------------------------
+
+def _run_scale(n_stubs, rounds, num_cpus=2, rtt_p99_max=10.0):
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": num_cpus})
+    swarm = None
+    try:
+        node = rt_state.get_node()
+        threads_before = threading.active_count()
+        swarm = StubSwarm(node.head_server.address, node.cluster_token,
+                          n_stubs)
+        attached = swarm.dial()
+        assert attached >= min(n_stubs, 200), (
+            f"only {attached}/{n_stubs} stubs attached")
+        assert swarm.wait_acked(), "not every stub saw its NODE_ACK"
+        # Every stub is a registered node in the head's view.
+        assert len(node.head_server.daemons) >= attached
+
+        _assert_head_threads_o_loops(node, attached, threads_before)
+        # The swarm itself adds one selector thread; the head adds its
+        # bounded pools — nothing here may scale with `attached`.
+        grown = threading.active_count() - threads_before
+        assert grown <= 16, (
+            f"thread count grew by {grown} after attaching {attached} "
+            f"stub connections")
+
+        ping_label = f'msg_type="{P.NODE_PING}"'
+        base_text = _federated_text()
+        base = _sample_sum(base_text, "head_ingest_messages",
+                           (ping_label,)) or 0.0
+        rtt_before = _hist_cum(base_text, "node_heartbeat_rtt_s")
+        t0 = time.monotonic()
+        sent = 0
+        for _ in range(rounds):
+            sent += swarm.ping_round()
+            time.sleep(0.05)
+        assert swarm.wait_synced(sent), (
+            f"{swarm.total_synced()}/{sent} NODE_SYNC acks arrived")
+        elapsed = time.monotonic() - t0
+
+        text = _federated_text()
+        pings = _sample_sum(text, "head_ingest_messages", (ping_label,))
+        assert pings is not None and pings - base >= sent, (
+            f"head ingested {pings} NODE_PINGs (baseline {base}) "
+            f"but the swarm sent {sent}")
+        msgs_per_s = (pings - base) / max(elapsed, 1e-9)
+        assert msgs_per_s > 0
+
+        rtt_p99 = _hist_p99_window(rtt_before,
+                                   _hist_cum(text, "node_heartbeat_rtt_s"))
+        assert rtt_p99 is not None, "heartbeat RTT histogram missing"
+        if rtt_p99_max is not None:
+            assert rtt_p99 <= rtt_p99_max, (
+                f"heartbeat RTT p99 bucket {rtt_p99}s "
+                f"(ceiling {rtt_p99_max}s)")
+
+        # Dispatch under control-plane load: real nop tasks on the
+        # head's own workers while the fleet stays attached.
+        disp_before = _hist_cum(text, "scheduler_dispatch_latency_s")
+
+        @ray.remote
+        def nop():
+            return 1
+
+        assert ray.get([nop.remote() for _ in range(16)]) == [1] * 16
+        disp_p99 = _hist_p99_window(
+            disp_before,
+            _hist_cum(_federated_text(), "scheduler_dispatch_latency_s"))
+        assert disp_p99 is not None, "dispatch latency histogram missing"
+
+        violations = swarm.all_violations()
+        assert violations == [], (
+            f"{len(violations)} protocol-DFA violations: "
+            f"{violations[:5]}")
+
+        swarm.stop()
+        assert _drain_daemons(node), "head did not tear down all stubs"
+        swarm = None
+        return {"attached": attached, "msgs_per_s": msgs_per_s,
+                "rtt_p99": rtt_p99, "dispatch_p99": disp_p99}
+    finally:
+        if swarm is not None:
+            swarm.stop()
+        cluster.shutdown()
+
+
+def test_scale_200_stub_daemons():
+    stats = _run_scale(200, rounds=4)
+    assert stats["attached"] == 200
+
+
+@pytest.mark.slow
+def test_scale_1000_stub_daemons():
+    import resource
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = 8192
+    if soft < want:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(want, hard), hard))
+        except (ValueError, OSError):
+            pass
+    soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    # Each stub costs ~4 fds (test conn + dup, head conn + loop dup);
+    # leave headroom for the runtime itself.
+    n = max(200, min(1000, (soft - 512) // 4))
+    # A simultaneous 1,000-ping burst is a worst case no staggered
+    # real fleet produces (each NODE_SYNC ack carries the O(N) cluster
+    # view); the tier reports the p99 rather than bounding it here.
+    stats = _run_scale(n, rounds=2, rtt_p99_max=None)
+    assert stats["attached"] >= 200
+    print(f"scale-sim: {stats['attached']} stubs, "
+          f"{stats['msgs_per_s']:.0f} msgs/s, "
+          f"rtt_p99<={stats['rtt_p99']}s, "
+          f"dispatch_p99<={stats['dispatch_p99']}s")
+
+
+def test_scale_smoke_wiretap(tmp_path):
+    """Seconds-scale smoke for ci_fast: a small stub fleet under the
+    wiretap, asserting clean DFA journals on BOTH ends (stub-side
+    SessionDFAs in the swarm, head-side frames replayed from the
+    journal) plus the head thread ceiling."""
+    from ray_tpu._private import wiretap
+    wiretap.reset()
+    prev = wiretap.enabled
+    prev_dir = os.environ.get("RAY_TPU_WIRETAP_DIR")
+    os.environ["RAY_TPU_WIRETAP_DIR"] = str(tmp_path)
+    wiretap.configure(True)
+    try:
+        _run_scale(50, rounds=2, num_cpus=1)
+        wiretap.reset()  # close the journal handle before replay
+        violations = wiretap.collect_violations(str(tmp_path))
+        assert not violations, wiretap.format_report(violations)
+    finally:
+        wiretap.configure(prev)
+        if prev_dir is None:
+            os.environ.pop("RAY_TPU_WIRETAP_DIR", None)
+        else:
+            os.environ["RAY_TPU_WIRETAP_DIR"] = prev_dir
