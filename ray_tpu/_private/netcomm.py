@@ -655,12 +655,24 @@ class LoopWriter(ConnectionWriter):
     def send_chunks(self, chunks: List):
         nbytes = sum(P._chunk_len(c) for c in chunks)
         arm = False
+        # The loop thread is this writer's SOLE drainer. An inline
+        # handler sending on its own loop (the head's NODE_PING ->
+        # NODE_SYNC ack) must therefore NEVER block here: at the
+        # high-water mark nothing else can drain _pending/_q, latch an
+        # error, or stop the writer, so the wait would deadlock the
+        # whole shard (and the heartbeat rescue runs on this same
+        # thread). Loop-thread sends skip backpressure and enqueue
+        # unconditionally — they are self-limiting (bounded per
+        # inbound frame), so the overshoot is one reply per read.
+        on_loop = self._loop_owner.on_loop_thread()
         with self._cond:
             # High-water backpressure: pending (drained-but-unsent)
             # bytes still count — against a zero-window peer the loop
             # parks the batch in _pending, and senders must block on
             # that exactly like they blocked on the writer thread.
-            while (self._q_bytes + self._pending_bytes > self._max_q_bytes
+            while (not on_loop
+                   and self._q_bytes + self._pending_bytes
+                   > self._max_q_bytes
                    and self._error is None and not self._stopped):
                 self._cond.wait(timeout=1.0)
             if self._error is not None:
@@ -714,7 +726,8 @@ class LoopWriter(ConnectionWriter):
                         racedebug.access(self, "_q", write=True)
                     items = list(self._q)
                     self._q.clear()
-                    self._pending_bytes += self._q_bytes
+                    took = self._q_bytes
+                    self._pending_bytes += took
                     self._q_bytes = 0
                     self._busy = True
                 self._pending = [
@@ -724,6 +737,17 @@ class LoopWriter(ConnectionWriter):
                      for c in self._assemble(items))
                     if v.nbytes]
                 self._pending_items = len(items)
+                # _assemble added framing (conn_frame_header + batch
+                # layout) on top of the payload bytes credited above,
+                # and the debit below is raw `wrote` — which includes
+                # that framing. Credit the delta so each completed
+                # batch returns _pending_bytes to exactly zero instead
+                # of drifting negative (queued_bytes gauge + the
+                # backpressure threshold must not loosen over time).
+                framing = (sum(v.nbytes for v in self._pending) - took)
+                if framing:
+                    with self._cond:
+                        self._pending_bytes += framing
             wrote = 0
             err: Optional[BaseException] = None
             blocked = False
@@ -841,6 +865,14 @@ class ControlLoop:
         with self._lock:
             self._pending_ops.append(("arm", writer))
         self._wake()
+
+    def on_loop_thread(self) -> bool:
+        """True when the caller IS this loop's thread. LoopWriter uses
+        this to keep loop-originated sends (inline handler replies)
+        nonblocking: the loop thread is the sole drainer, so blocking
+        it on its own writer's backpressure would deadlock the
+        shard."""
+        return threading.current_thread() is self._thread
 
     def registered_fds(self) -> int:
         """Connections owned by this loop (exposition-time gauge)."""

@@ -275,11 +275,17 @@ class HeadServer:
         # (multiprocessing's deliver/answer_challenge, bounded by a 10s
         # SO_RCVTIMEO) — a small pool keeps a connect-and-send-nothing
         # dialer from wedging registration, without hand-rolling the
-        # hmac dance as a nonblocking DFA. Connection teardown (which
-        # drains executors for up to seconds) offloads here too: the
-        # loops must never block on a dying link.
+        # hmac dance as a nonblocking DFA.
         self._hs_pool = ThreadPoolExecutor(  # lint: guarded-by-ok immutable after __init__: stdlib executor is internally synchronized
             max_workers=4, thread_name_prefix="head-handshake")
+        # Connection teardown (close_link drains the route executor +
+        # writer for up to ~2.5s) gets its OWN pool: under a mass
+        # disconnect (partition, head restart) teardowns would
+        # otherwise occupy every handshake worker and reconnecting
+        # daemons' registrations would queue behind them for minutes.
+        # Threads spawn lazily, so an idle head pays nothing.
+        self._td_pool = ThreadPoolExecutor(  # lint: guarded-by-ok immutable after __init__: stdlib executor is internally synchronized
+            max_workers=16, thread_name_prefix="head-teardown")
         self._loops.add_acceptor(self._sock, self._on_accept)
         # Liveness beyond TCP: a frozen daemon (or a half-open link)
         # keeps its connection "up" while pings stop. Bounded tolerance,
@@ -499,9 +505,11 @@ class HeadServer:
     def _on_conn_eof(self, handle: DaemonHandle):
         """Loop-thread EOF/error: the loop already dropped the fd;
         offload the teardown (executor drains block for up to seconds
-        and must never stall the other connections on this loop)."""
+        and must never stall the other connections on this loop —
+        nor the handshake pool, which disconnect storms would
+        starve)."""
         try:
-            self._hs_pool.submit(self._teardown_conn, handle)
+            self._td_pool.submit(self._teardown_conn, handle)
         except RuntimeError:
             # Pool gone: stop() owns teardown of every live handle.
             pass
@@ -697,6 +705,7 @@ class HeadServer:
                 pass
         self._loops.stop()
         self._hs_pool.shutdown(wait=False)
+        self._td_pool.shutdown(wait=False)
         try:
             self._sock.close()
         except Exception:  # lint: broad-except-ok best-effort teardown: every subsystem stops even if one is already dead

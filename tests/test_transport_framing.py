@@ -223,6 +223,113 @@ def test_writer_latches_error_and_raises():
 
 
 # ---------------------------------------------------------------------------
+# loop writer (selector-drained ConnectionWriter)
+# ---------------------------------------------------------------------------
+class _FakeLoop:
+    """Quacks like ControlLoop for a LoopWriter whose drains the test
+    runs by hand (the test thread plays the loop thread)."""
+
+    def on_loop_thread(self):
+        return False
+
+    def arm_writer(self, writer):
+        pass
+
+
+def test_loop_writer_pending_bytes_balance():
+    """Accounting symmetry: _pending_bytes is credited with payload
+    bytes at drain-start and debited with raw wrote (which includes
+    conn_frame_header/batch framing) — the framing delta must be
+    credited too, or every completed batch drifts the queued_bytes()
+    gauge negative and silently loosens the backpressure threshold."""
+    from ray_tpu._private.netcomm import LoopWriter
+    a, b = socket.socketpair()
+    try:
+        w = LoopWriter(_FakeConn(a), _FakeLoop())
+        # Single-message frame path (header + body).
+        w.send_message("one", {"i": 1})
+        assert w._drain_nonblocking() == "idle"
+        assert w.queued_bytes() == 0, w.queued_bytes()
+        assert w._pending_bytes == 0, w._pending_bytes
+        # Batch frame path (assemble_batch adds per-message framing).
+        for i in range(20):
+            w.send_message("burst", {"i": i, "pad": b"x" * 100})
+        assert w._drain_nonblocking() == "idle"
+        assert w.queued_bytes() == 0, w.queued_bytes()
+        assert w._pending_bytes == 0, w._pending_bytes
+        a.close()
+        got = _drain_messages(b)
+        assert len(got) == 21
+    finally:
+        a.close()
+        b.close()
+
+
+def test_loop_writer_send_on_loop_thread_never_blocks():
+    """Deadlock guard: the loop thread is a LoopWriter's SOLE drainer,
+    so an inline handler sending on its own loop (the head's NODE_PING
+    -> NODE_SYNC ack) must enqueue past the high-water mark instead of
+    blocking — against a zero-window peer a blocking wait could never
+    be satisfied and the whole loop shard would wedge."""
+    from ray_tpu._private.netcomm import ControlLoop, LoopWriter
+    loop = ControlLoop(name="test-loop")
+    a, b = socket.socketpair()
+    try:
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        w = LoopWriter(_FakeConn(a), loop, max_queued_bytes=8192)
+        # Stalled peer: b never reads. Push one message far past the
+        # high-water mark (the check happens at entry, so a fresh
+        # sender slips a large chunk through) — the loop parks the
+        # overflow in _pending and _pending_bytes stays > max.
+        w.send_message("big", {"pad": b"z" * (256 << 10)})
+        sent_on_loop = threading.Event()
+
+        def on_msgs(ctx, msgs):
+            # Runs ON the loop thread, writer saturated: must return,
+            # not block.
+            w.send_message("sync", {"ok": True})
+            sent_on_loop.set()
+
+        loop.register_conn(_FakeConn(a), w, on_msgs, lambda ctx: None,
+                           None)
+        # Wait until the loop parked the overflow (writer saturated).
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and w._pending_bytes <= 8192:
+            time.sleep(0.01)
+        assert w._pending_bytes > 8192, "loop never parked the overflow"
+        # Poke the loop: one inbound frame -> on_msgs on the loop
+        # thread -> send_message on the saturated writer.
+        body = P.dump_message("ping", {})
+        import struct
+        b.sendall(struct.pack("!i", len(body)) + body)
+        assert sent_on_loop.wait(5.0), (
+            "loop-thread send blocked on its own writer's backpressure "
+            "(shard deadlock)")
+        # The loop thread is still alive and draining: release the
+        # stall and everything lands.
+        b.settimeout(5.0)
+        parser = P.FrameParser()
+        types = []
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                chunk = b.recv(1 << 20)
+            except OSError:
+                break
+            if not chunk:
+                break
+            parser.feed(chunk)
+            types.extend(t for t, _p in parser.messages())
+            if "sync" in types:
+                break
+        assert types and types[0] == "big" and "sync" in types, types
+    finally:
+        loop.stop()
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
 # host copy gate
 # ---------------------------------------------------------------------------
 def test_copy_gate_width_and_fifo():
